@@ -1,0 +1,14 @@
+"""cometbft_tpu — a TPU-native BFT state-machine-replication framework.
+
+Capability surface modeled on CometBFT/Tendermint v0.34 (reference layer map in
+SURVEY.md §1): consensus engine, ABCI application boundary, mempool, block/state
+storage, block sync, light client, evidence, p2p gossip, RPC, CLI. All
+signature-verification and Merkle-hashing hot paths route through a pluggable
+batch-crypto boundary (``cometbft_tpu.crypto.batch``) whose ``tpu`` backend runs
+batched Ed25519 (double-scalar-mult + SHA-512) as JAX/Pallas kernels vmapped and
+sharded over the validator set.
+"""
+
+from cometbft_tpu.version import __version__, CMT_SEM_VER
+
+__all__ = ["__version__", "CMT_SEM_VER"]

@@ -1,0 +1,1 @@
+"""Support runtime (reference: libs/ — SURVEY.md §2.14)."""

@@ -1,0 +1,177 @@
+"""Crash-safe rotating file group — the WAL substrate.
+
+Reference: libs/autofile/{autofile,group}.go — a Group manages a "head" file
+plus rotated chunks ``<path>.NNN``. Writes go to the head; when the head
+exceeds head_size_limit it is rotated. Total size is bounded by
+group_size_limit (oldest chunks deleted). Readers can scan the whole group
+in order across chunk boundaries, and search by a user predicate.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import BinaryIO, Callable, Iterator, List, Optional, Tuple
+
+DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024  # 10MB (reference: group.go)
+DEFAULT_GROUP_SIZE_LIMIT = 1024 * 1024 * 1024  # 1GB
+
+
+class Group:
+    def __init__(
+        self,
+        head_path: str,
+        head_size_limit: int = DEFAULT_HEAD_SIZE_LIMIT,
+        group_size_limit: int = DEFAULT_GROUP_SIZE_LIMIT,
+    ):
+        self.head_path = head_path
+        self.head_size_limit = head_size_limit
+        self.group_size_limit = group_size_limit
+        self._mtx = threading.RLock()
+        self._head: Optional[BinaryIO] = None
+        os.makedirs(os.path.dirname(os.path.abspath(head_path)), exist_ok=True)
+        self._open_head()
+
+    # -- writing -----------------------------------------------------------
+
+    def _open_head(self) -> None:
+        self._head = open(self.head_path, "ab")
+
+    def write(self, data: bytes) -> int:
+        with self._mtx:
+            assert self._head is not None
+            n = self._head.write(data)
+            return n
+
+    def flush(self) -> None:
+        with self._mtx:
+            if self._head:
+                self._head.flush()
+
+    def flush_and_sync(self) -> None:
+        with self._mtx:
+            if self._head:
+                self._head.flush()
+                os.fsync(self._head.fileno())
+
+    def close(self) -> None:
+        with self._mtx:
+            if self._head:
+                self._head.flush()
+                self._head.close()
+                self._head = None
+
+    # -- rotation ----------------------------------------------------------
+
+    def check_head_size_limit(self) -> None:
+        """Rotate head if oversized; then enforce total size (reference:
+        group.go processTicks)."""
+        with self._mtx:
+            if self.head_size_limit <= 0 or self._head is None:
+                return
+            self._head.flush()
+            if os.path.getsize(self.head_path) >= self.head_size_limit:
+                self.rotate_file()
+            self._check_total_size_limit()
+
+    def rotate_file(self) -> None:
+        with self._mtx:
+            assert self._head is not None
+            self._head.flush()
+            os.fsync(self._head.fileno())
+            self._head.close()
+            _, max_idx = self.min_max_index()
+            dst = f"{self.head_path}.{max_idx + 1:03d}"
+            os.rename(self.head_path, dst)
+            self._open_head()
+
+    def _check_total_size_limit(self) -> None:
+        if self.group_size_limit <= 0:
+            return
+        paths = [p for _, p in self._chunk_files()] + [self.head_path]
+        total = sum(os.path.getsize(p) for p in paths if os.path.exists(p))
+        if total <= self.group_size_limit:
+            return
+        for _, p in self._chunk_files():
+            if total <= self.group_size_limit:
+                break
+            sz = os.path.getsize(p)
+            os.remove(p)
+            total -= sz
+
+    # -- reading -----------------------------------------------------------
+
+    def _chunk_files(self) -> List[Tuple[int, str]]:
+        """Sorted (index, path) for rotated chunks."""
+        d = os.path.dirname(os.path.abspath(self.head_path)) or "."
+        base = os.path.basename(self.head_path)
+        pat = re.compile(re.escape(base) + r"\.(\d{3,})$")
+        out = []
+        for fn in os.listdir(d):
+            m = pat.match(fn)
+            if m:
+                out.append((int(m.group(1)), os.path.join(d, fn)))
+        out.sort()
+        return out
+
+    def min_max_index(self) -> Tuple[int, int]:
+        chunks = self._chunk_files()
+        if not chunks:
+            return 0, 0
+        return chunks[0][0], chunks[-1][0]
+
+    def all_paths(self) -> List[str]:
+        """Chunks oldest→newest, then head."""
+        with self._mtx:
+            paths = [p for _, p in self._chunk_files()]
+            if os.path.exists(self.head_path):
+                paths.append(self.head_path)
+            return paths
+
+    def reader(self) -> "GroupReader":
+        self.flush()
+        return GroupReader(self.all_paths())
+
+
+class GroupReader:
+    """Sequential reader across all files of a group."""
+
+    def __init__(self, paths: List[str]):
+        self._paths = paths
+        self._idx = 0
+        self._f: Optional[BinaryIO] = None
+        self._advance()
+
+    def _advance(self) -> None:
+        if self._f:
+            self._f.close()
+            self._f = None
+        while self._idx < len(self._paths):
+            p = self._paths[self._idx]
+            self._idx += 1
+            if os.path.exists(p):
+                self._f = open(p, "rb")
+                return
+
+    def read(self, n: int = -1) -> bytes:
+        out = bytearray()
+        while self._f is not None and (n < 0 or len(out) < n):
+            want = -1 if n < 0 else n - len(out)
+            chunk = self._f.read(want)
+            if chunk:
+                out.extend(chunk)
+            else:
+                self._advance()
+        return bytes(out)
+
+    def close(self) -> None:
+        if self._f:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self) -> "GroupReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,127 @@
+"""Concurrent doubly-linked list with blocking wait for the next element.
+
+Reference: libs/clist/clist.go — the mempool and evidence pool gossip cursors
+walk this structure: a reader holds a CElement and blocks on next_wait()
+until a producer appends, so per-peer broadcast routines can stream entries
+without polling (mempool/v0/clist_mempool.go:43, evidence/pool.go:15).
+
+Removal detaches an element; a waiting reader is woken and should restart
+from the front if its element was removed (`removed` flag, as the reference).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Iterator, Optional
+
+
+class CElement:
+    def __init__(self, value: Any):
+        self.value = value
+        self._mtx = threading.Lock()
+        self._next: Optional["CElement"] = None
+        self._prev: Optional["CElement"] = None
+        self._next_cond = threading.Condition(self._mtx)
+        self.removed = False
+
+    def next(self) -> Optional["CElement"]:
+        with self._mtx:
+            return self._next
+
+    def prev(self) -> Optional["CElement"]:
+        with self._mtx:
+            return self._prev
+
+    def next_wait(self, timeout: Optional[float] = None) -> Optional["CElement"]:
+        """Block until a next element exists or this element is removed.
+
+        Returns the next element, or None on removal/timeout.
+        """
+        with self._mtx:
+            if self._next is None and not self.removed:
+                self._next_cond.wait(timeout)
+            return self._next
+
+    def _set_next(self, e: Optional["CElement"]) -> None:
+        with self._mtx:
+            self._next = e
+            if e is not None:
+                self._next_cond.notify_all()
+
+    def _set_prev(self, e: Optional["CElement"]) -> None:
+        with self._mtx:
+            self._prev = e
+
+    def _mark_removed(self) -> None:
+        with self._mtx:
+            self.removed = True
+            self._next_cond.notify_all()
+
+
+class CList:
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self._head: Optional[CElement] = None
+        self._tail: Optional[CElement] = None
+        self._len = 0
+        self._wait_cond = threading.Condition(self._mtx)
+
+    def __len__(self) -> int:
+        with self._mtx:
+            return self._len
+
+    def front(self) -> Optional[CElement]:
+        with self._mtx:
+            return self._head
+
+    def back(self) -> Optional[CElement]:
+        with self._mtx:
+            return self._tail
+
+    def front_wait(self, timeout: Optional[float] = None) -> Optional[CElement]:
+        """Block until the list is non-empty; returns front element."""
+        with self._mtx:
+            if self._head is None:
+                self._wait_cond.wait(timeout)
+            return self._head
+
+    def push_back(self, value: Any) -> CElement:
+        e = CElement(value)
+        with self._mtx:
+            if self._tail is None:
+                self._head = self._tail = e
+                self._wait_cond.notify_all()
+            else:
+                e._set_prev(self._tail)
+                self._tail._set_next(e)
+                self._tail = e
+            self._len += 1
+        return e
+
+    def remove(self, e: CElement) -> Any:
+        with self._mtx:
+            prev, nxt = e.prev(), e.next()
+            if prev is None and nxt is None and e is not self._head:
+                # already detached
+                e._mark_removed()
+                return e.value
+            if prev is not None:
+                prev._set_next(nxt)
+            else:
+                self._head = nxt
+            if nxt is not None:
+                nxt._set_prev(prev)
+            else:
+                self._tail = prev
+            self._len -= 1
+            e._mark_removed()
+            # keep e.next for in-flight iterators (reference keeps next to
+            # allow waiters to continue); detach prev only.
+            e._set_prev(None)
+            return e.value
+
+    def __iter__(self) -> Iterator[CElement]:
+        e = self.front()
+        while e is not None:
+            yield e
+            e = e.next()

@@ -1,0 +1,44 @@
+"""Thread-safe map (reference: libs/cmap/cmap.go) — peer metadata kv etc."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class CMap:
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self._m: Dict[str, Any] = {}
+
+    def set(self, key: str, value: Any) -> None:
+        with self._mtx:
+            self._m[key] = value
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._mtx:
+            return self._m.get(key)
+
+    def has(self, key: str) -> bool:
+        with self._mtx:
+            return key in self._m
+
+    def delete(self, key: str) -> None:
+        with self._mtx:
+            self._m.pop(key, None)
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._m)
+
+    def clear(self) -> None:
+        with self._mtx:
+            self._m.clear()
+
+    def keys(self) -> List[str]:
+        with self._mtx:
+            return list(self._m.keys())
+
+    def values(self) -> List[Any]:
+        with self._mtx:
+            return list(self._m.values())

@@ -1,0 +1,43 @@
+"""Synchronous in-process event switch.
+
+Reference: libs/events/events.go — used inside the consensus reactor to fan
+out round-state/vote broadcast hooks (consensus/reactor.go:435). Listeners
+are called synchronously on the firing thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Tuple
+
+EventCallback = Callable[[Any], None]
+
+
+class EventSwitch:
+    def __init__(self):
+        self._mtx = threading.RLock()
+        # event -> [(listener_id, cb)]
+        self._listeners: Dict[str, List[Tuple[str, EventCallback]]] = {}
+
+    def add_listener_for_event(
+        self, listener_id: str, event: str, cb: EventCallback
+    ) -> None:
+        with self._mtx:
+            self._listeners.setdefault(event, []).append((listener_id, cb))
+
+    def remove_listener(self, listener_id: str) -> None:
+        with self._mtx:
+            for event in list(self._listeners):
+                self._listeners[event] = [
+                    (lid, cb)
+                    for lid, cb in self._listeners[event]
+                    if lid != listener_id
+                ]
+                if not self._listeners[event]:
+                    del self._listeners[event]
+
+    def fire_event(self, event: str, data: Any) -> None:
+        with self._mtx:
+            cbs = list(self._listeners.get(event, ()))
+        for _, cb in cbs:
+            cb(data)

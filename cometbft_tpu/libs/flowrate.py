@@ -1,0 +1,71 @@
+"""Flow-rate monitoring and limiting.
+
+Reference: libs/flowrate/flowrate.go — EWMA transfer-rate monitor with an
+optional limit used by MConnection to throttle per-peer send/recv
+(p2p/conn/connection.go:84, default 500KB/s).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class Status:
+    bytes: int = 0
+    duration: float = 0.0
+    avg_rate: float = 0.0
+    cur_rate: float = 0.0
+
+
+class Monitor:
+    """Sliding-EWMA rate monitor.
+
+    sample_period: how often the current-rate estimate updates.
+    """
+
+    def __init__(self, sample_period: float = 0.1, ewma_window: float = 1.0):
+        self._mtx = threading.Lock()
+        self._start = time.monotonic()
+        self._bytes = 0
+        self._sample_period = sample_period
+        self._alpha = min(sample_period / ewma_window, 1.0)
+        self._last_sample = self._start
+        self._sample_bytes = 0
+        self._cur_rate = 0.0
+
+    def update(self, n: int) -> int:
+        with self._mtx:
+            now = time.monotonic()
+            self._bytes += n
+            self._sample_bytes += n
+            elapsed = now - self._last_sample
+            if elapsed >= self._sample_period:
+                inst = self._sample_bytes / elapsed
+                self._cur_rate += self._alpha * (inst - self._cur_rate)
+                self._sample_bytes = 0
+                self._last_sample = now
+            return n
+
+    def status(self) -> Status:
+        with self._mtx:
+            dur = time.monotonic() - self._start
+            avg = self._bytes / dur if dur > 0 else 0.0
+            return Status(self._bytes, dur, avg, self._cur_rate)
+
+    def limit(self, want: int, rate: int, block: bool = True) -> int:
+        """Return how many bytes may be transferred now to stay under
+        `rate` B/s; sleeps if block and quota exhausted."""
+        if rate <= 0:
+            return want
+        while True:
+            with self._mtx:
+                dur = time.monotonic() - self._start
+                allowed = int(rate * dur) - self._bytes
+            if allowed > 0:
+                return min(want, allowed)
+            if not block:
+                return 0
+            time.sleep(self._sample_period)

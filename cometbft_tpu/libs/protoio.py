@@ -1,0 +1,238 @@
+"""Varint-delimited proto stream IO + protobuf wire-format primitives.
+
+Reference: libs/protoio — varint length-delimited writers/readers used for
+p2p wire framing, the WAL, the ABCI socket protocol, and canonical sign-bytes
+(types/vote.go:93-101). We hand-roll the protobuf wire format (no codegen):
+encoders produce byte-identical output to gogoproto's Marshal for the message
+layouts defined in cometbft_tpu.proto.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import BinaryIO, Tuple
+
+MAX_VARINT_LEN = 10
+
+
+def encode_uvarint(n: int) -> bytes:
+    """Protobuf base-128 unsigned varint."""
+    if n < 0:
+        raise ValueError("uvarint of negative")
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def encode_varint_zigzag(n: int) -> bytes:
+    """Zigzag-encoded signed varint (sint64)."""
+    return encode_uvarint((n << 1) ^ (n >> 63) if n >= 0 else ((-n) << 1) - 1)
+
+
+def encode_varint(n: int) -> bytes:
+    """Two's-complement signed varint (int64/int32 fields)."""
+    if n < 0:
+        n += 1 << 64
+    return encode_uvarint(n)
+
+
+def decode_uvarint(data: bytes, pos: int = 0) -> Tuple[int, int]:
+    """Returns (value, new_pos)."""
+    result = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise EOFError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            if shift >= 63 and b > 1:
+                raise ValueError("varint overflows uint64")
+            return result, pos
+        shift += 7
+        if shift >= 70:
+            raise ValueError("varint too long")
+
+
+def decode_varint(data: bytes, pos: int = 0) -> Tuple[int, int]:
+    v, pos = decode_uvarint(data, pos)
+    if v >= 1 << 63:
+        v -= 1 << 64
+    return v, pos
+
+
+def uvarint_size(n: int) -> int:
+    return len(encode_uvarint(n))
+
+
+# ---------------------------------------------------------------------------
+# Delimited stream IO (reference: libs/protoio/{writer,reader}.go)
+# ---------------------------------------------------------------------------
+
+
+def write_delimited(w: BinaryIO, msg_bytes: bytes) -> int:
+    """Write length-prefixed message; returns bytes written."""
+    prefix = encode_uvarint(len(msg_bytes))
+    w.write(prefix)
+    w.write(msg_bytes)
+    return len(prefix) + len(msg_bytes)
+
+
+def read_delimited(r: BinaryIO, max_size: int = 0) -> bytes:
+    """Read one length-prefixed message. Raises EOFError at stream end."""
+    length = 0
+    shift = 0
+    nread = 0
+    while True:
+        b = r.read(1)
+        if not b:
+            if nread == 0:
+                raise EOFError("eof")
+            raise EOFError("truncated varint")
+        nread += 1
+        if nread > MAX_VARINT_LEN:
+            raise ValueError("varint too long")
+        length |= (b[0] & 0x7F) << shift
+        if not (b[0] & 0x80):
+            break
+        shift += 7
+    if max_size and length + nread > max_size:
+        raise ValueError(f"message exceeds max size {max_size}")
+    data = r.read(length)
+    if len(data) != length:
+        raise EOFError("truncated message")
+    return data
+
+
+def marshal_delimited(msg_bytes: bytes) -> bytes:
+    """Length-prefix a serialized message — the canonical sign-bytes framing
+    (reference: libs/protoio/io.go MarshalDelimited; types/vote.go:93)."""
+    buf = io.BytesIO()
+    write_delimited(buf, msg_bytes)
+    return buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# Protobuf wire-format field encoders (gogoproto-compatible)
+# ---------------------------------------------------------------------------
+
+WIRE_VARINT = 0
+WIRE_FIXED64 = 1
+WIRE_BYTES = 2
+WIRE_FIXED32 = 5
+
+
+def tag(field_num: int, wire_type: int) -> bytes:
+    return encode_uvarint((field_num << 3) | wire_type)
+
+
+def field_varint(field_num: int, value: int) -> bytes:
+    """int32/int64/uint64/bool/enum field. Zero values are omitted (proto3)."""
+    if value == 0 or value is False:
+        return b""
+    if value is True:
+        value = 1
+    return tag(field_num, WIRE_VARINT) + encode_varint(value)
+
+
+def field_bytes(field_num: int, value: bytes) -> bytes:
+    """bytes/string/embedded-message field. Empty omitted (proto3 scalar)."""
+    if not value:
+        return b""
+    return tag(field_num, WIRE_BYTES) + encode_uvarint(len(value)) + value
+
+
+def field_message(field_num: int, value: bytes) -> bytes:
+    """Embedded message — encoded even when empty bytes would be elided?
+    Per proto3, an unset message is omitted but a present-empty message is
+    encoded with length 0. Callers pass None to omit."""
+    return tag(field_num, WIRE_BYTES) + encode_uvarint(len(value)) + value
+
+
+def field_fixed64(field_num: int, value: int) -> bytes:
+    if value == 0:
+        return b""
+    return tag(field_num, WIRE_FIXED64) + struct.pack("<Q", value & ((1 << 64) - 1))
+
+
+def field_sfixed64(field_num: int, value: int) -> bytes:
+    if value == 0:
+        return b""
+    return tag(field_num, WIRE_FIXED64) + struct.pack("<q", value)
+
+
+def field_string(field_num: int, value: str) -> bytes:
+    return field_bytes(field_num, value.encode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# Decoder helper
+# ---------------------------------------------------------------------------
+
+
+class WireReader:
+    """Minimal protobuf wire decoder for hand-rolled message parsers."""
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def at_end(self) -> bool:
+        return self.pos >= len(self.data)
+
+    def read_tag(self) -> Tuple[int, int]:
+        v, self.pos = decode_uvarint(self.data, self.pos)
+        return v >> 3, v & 7
+
+    def read_varint(self) -> int:
+        v, self.pos = decode_varint(self.data, self.pos)
+        return v
+
+    def read_uvarint(self) -> int:
+        v, self.pos = decode_uvarint(self.data, self.pos)
+        return v
+
+    def read_bytes(self) -> bytes:
+        n, self.pos = decode_uvarint(self.data, self.pos)
+        if self.pos + n > len(self.data):
+            raise EOFError("truncated bytes field")
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def read_string(self) -> str:
+        return self.read_bytes().decode("utf-8")
+
+    def read_fixed64(self) -> int:
+        if self.pos + 8 > len(self.data):
+            raise EOFError("truncated fixed64")
+        (v,) = struct.unpack_from("<Q", self.data, self.pos)
+        self.pos += 8
+        return v
+
+    def read_sfixed64(self) -> int:
+        if self.pos + 8 > len(self.data):
+            raise EOFError("truncated sfixed64")
+        (v,) = struct.unpack_from("<q", self.data, self.pos)
+        self.pos += 8
+        return v
+
+    def skip(self, wire_type: int) -> None:
+        if wire_type == WIRE_VARINT:
+            self.read_uvarint()
+        elif wire_type == WIRE_FIXED64:
+            self.pos += 8
+        elif wire_type == WIRE_BYTES:
+            self.read_bytes()
+        elif wire_type == WIRE_FIXED32:
+            self.pos += 4
+        else:
+            raise ValueError(f"unknown wire type {wire_type}")

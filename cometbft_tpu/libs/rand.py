@@ -1,0 +1,23 @@
+"""Random helpers (reference: libs/rand) — test fixtures & jitter."""
+
+from __future__ import annotations
+
+import random
+import secrets
+import string
+
+_ALPHANUM = string.ascii_letters + string.digits
+
+
+def rand_bytes(n: int) -> bytes:
+    return secrets.token_bytes(n)
+
+
+def rand_str(n: int, rng: random.Random | None = None) -> str:
+    r = rng or random
+    return "".join(r.choice(_ALPHANUM) for _ in range(n))
+
+
+def rand_int63n(n: int, rng: random.Random | None = None) -> int:
+    r = rng or random
+    return r.randrange(n)

@@ -1,0 +1,30 @@
+"""Atomic file write.
+
+Reference: libs/tempfile/tempfile.go WriteFileAtomic — write to a temp file
+in the same directory, fsync, rename over the destination. Used by privval
+last-sign-state persistence and the address book.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def write_file_atomic(path: str, data: bytes, mode: int = 0o600) -> None:
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.chmod(tmp, mode)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise

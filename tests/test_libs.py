@@ -1,0 +1,282 @@
+"""Tests for the support runtime (reference test models: libs/*/… _test.go)."""
+
+import io
+import os
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.libs.autofile import Group
+from cometbft_tpu.libs.bits import BitArray
+from cometbft_tpu.libs.clist import CList
+from cometbft_tpu.libs.db import MemDB, SQLiteDB, prefix_end
+from cometbft_tpu.libs.events import EventSwitch
+from cometbft_tpu.libs.pubsub import Empty, Server, SubscriptionCancelled, parse_query
+from cometbft_tpu.libs.service import AlreadyStartedError, BaseService
+
+
+class TestService:
+    def test_lifecycle(self):
+        s = BaseService("svc")
+        s.start()
+        assert s.is_running()
+        with pytest.raises(AlreadyStartedError):
+            s.start()
+        s.stop()
+        assert not s.is_running()
+        s.reset()
+        s.start()
+        assert s.is_running()
+        s.stop()
+
+
+class TestVarint:
+    @pytest.mark.parametrize("n", [0, 1, 127, 128, 300, 2**32, 2**63 - 1])
+    def test_uvarint_roundtrip(self, n):
+        enc = protoio.encode_uvarint(n)
+        val, pos = protoio.decode_uvarint(enc)
+        assert val == n and pos == len(enc)
+
+    @pytest.mark.parametrize("n", [0, 1, -1, 300, -300, 2**62, -(2**62)])
+    def test_signed_roundtrip(self, n):
+        enc = protoio.encode_varint(n)
+        val, pos = protoio.decode_varint(enc)
+        assert val == n and pos == len(enc)
+
+    def test_delimited_stream(self):
+        buf = io.BytesIO()
+        msgs = [b"hello", b"", b"x" * 300]
+        for m in msgs:
+            protoio.write_delimited(buf, m)
+        buf.seek(0)
+        out = [protoio.read_delimited(buf) for _ in msgs]
+        assert out == msgs
+        with pytest.raises(EOFError):
+            protoio.read_delimited(buf)
+
+    def test_known_encodings(self):
+        # protobuf reference values
+        assert protoio.encode_uvarint(300) == b"\xac\x02"
+        assert protoio.encode_varint(-1) == b"\xff" * 9 + b"\x01"
+
+
+class TestBitArray:
+    def test_basic(self):
+        ba = BitArray(70)
+        assert not ba.get_index(0)
+        assert ba.set_index(0, True)
+        assert ba.set_index(69, True)
+        assert not ba.set_index(70, True)
+        assert ba.get_index(0) and ba.get_index(69)
+        assert ba.num_true_bits() == 2
+        assert ba.true_indices() == [0, 69]
+
+    def test_algebra(self):
+        a, b = BitArray(10), BitArray(10)
+        a.set_index(1, True)
+        a.set_index(3, True)
+        b.set_index(3, True)
+        b.set_index(5, True)
+        assert (a.or_(b)).true_indices() == [1, 3, 5]
+        assert (a.and_(b)).true_indices() == [3]
+        assert (a.sub(b)).true_indices() == [1]
+        assert (a.not_()).num_true_bits() == 8
+
+    def test_full_empty(self):
+        ba = BitArray(5)
+        assert ba.is_empty() and not ba.is_full()
+        for i in range(5):
+            ba.set_index(i, True)
+        assert ba.is_full()
+
+    def test_elems_roundtrip(self):
+        ba = BitArray(130)
+        ba.set_index(0, True)
+        ba.set_index(129, True)
+        ba2 = BitArray.from_elems(130, ba.elems())
+        assert ba == ba2
+
+    def test_pick_random(self):
+        ba = BitArray(64)
+        assert ba.pick_random() is None
+        ba.set_index(17, True)
+        assert ba.pick_random() == 17
+
+
+class TestCList:
+    def test_push_iterate_remove(self):
+        cl = CList()
+        elems = [cl.push_back(i) for i in range(5)]
+        assert len(cl) == 5
+        assert [e.value for e in cl] == list(range(5))
+        cl.remove(elems[2])
+        assert [e.value for e in cl] == [0, 1, 3, 4]
+        assert elems[2].removed
+
+    def test_wait_semantics(self):
+        cl = CList()
+        got = []
+
+        def reader():
+            e = cl.front_wait(2.0)
+            while e is not None and len(got) < 3:
+                got.append(e.value)
+                nxt = e.next_wait(2.0)
+                e = nxt
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.05)
+        for i in range(3):
+            cl.push_back(i)
+        t.join(3.0)
+        assert got == [0, 1, 2]
+
+
+class TestEvents:
+    def test_fire(self):
+        sw = EventSwitch()
+        seen = []
+        sw.add_listener_for_event("a", "ev1", lambda d: seen.append(("a", d)))
+        sw.add_listener_for_event("b", "ev1", lambda d: seen.append(("b", d)))
+        sw.fire_event("ev1", 42)
+        assert seen == [("a", 42), ("b", 42)]
+        sw.remove_listener("a")
+        sw.fire_event("ev1", 43)
+        assert seen[-1] == ("b", 43)
+
+
+class TestQuery:
+    def test_parse_and_match(self):
+        q = parse_query("tm.event='NewBlock' AND tx.height>5")
+        assert q.matches({"tm.event": ["NewBlock"], "tx.height": ["10"]})
+        assert not q.matches({"tm.event": ["NewBlock"], "tx.height": ["5"]})
+        assert not q.matches({"tm.event": ["Tx"], "tx.height": ["10"]})
+
+    def test_ops(self):
+        assert parse_query("a.b='x'").matches({"a.b": ["y", "x"]})
+        assert parse_query("a.b CONTAINS 'ell'").matches({"a.b": ["hello"]})
+        assert parse_query("a.b EXISTS").matches({"a.b": [""]})
+        assert not parse_query("a.b EXISTS").matches({"c": ["1"]})
+        assert parse_query("a.h<=3").matches({"a.h": ["3"]})
+        assert parse_query("a.h>=3").matches({"a.h": ["3"]})
+
+    def test_empty(self):
+        assert Empty().matches({"anything": ["x"]})
+
+    def test_bad_queries(self):
+        for bad in ["AND", "a.b=", "a.b = 'x' AND", "=3"]:
+            with pytest.raises(ValueError):
+                parse_query(bad)
+
+
+class TestPubSub:
+    def test_subscribe_publish(self):
+        s = Server()
+        s.start()
+        sub = s.subscribe("client1", parse_query("tm.event='Tx'"), out_capacity=4)
+        s.publish_with_events("data1", {"tm.event": ["Tx"]})
+        s.publish_with_events("data2", {"tm.event": ["NewBlock"]})
+        msg = sub.next(timeout=1.0)
+        assert msg.data == "data1"
+        assert sub.try_next() is None
+        s.stop()
+
+    def test_unsubscribe_cancels(self):
+        s = Server()
+        s.start()
+        q = parse_query("a.b='c'")
+        sub = s.subscribe("c1", q)
+        s.unsubscribe("c1", q)
+        with pytest.raises(SubscriptionCancelled):
+            sub.next(timeout=0.2)
+
+    def test_slow_client_evicted(self):
+        s = Server()
+        s.start()
+        sub = s.subscribe("slow", Empty(), out_capacity=0)
+        s.publish_with_events("m1", {"x": ["1"]})
+        s.publish_with_events("m2", {"x": ["1"]})  # queue full → evict
+        # drains the buffered message then reports cancellation
+        assert sub.next(timeout=1.0).data == "m1"
+        with pytest.raises(SubscriptionCancelled):
+            sub.next(timeout=1.0)
+
+
+class TestAutofile:
+    def test_write_read_rotate(self, tmp_path):
+        head = str(tmp_path / "wal")
+        g = Group(head, head_size_limit=100, group_size_limit=100000)
+        g.write(b"a" * 80)
+        g.flush_and_sync()
+        g.check_head_size_limit()  # under limit, no rotation
+        g.write(b"b" * 40)
+        g.check_head_size_limit()  # now over → rotated
+        g.write(b"c" * 10)
+        g.flush()
+        with g.reader() as r:
+            data = r.read()
+        assert data == b"a" * 80 + b"b" * 40 + b"c" * 10
+        assert g.min_max_index() == (1, 1)
+        g.close()
+
+    def test_group_size_limit_prunes(self, tmp_path):
+        head = str(tmp_path / "wal")
+        g = Group(head, head_size_limit=50, group_size_limit=120)
+        for _ in range(6):
+            g.write(b"z" * 50)
+            g.check_head_size_limit()
+        paths = g.all_paths()
+        total = sum(os.path.getsize(p) for p in paths)
+        assert total <= 120 + 50
+        g.close()
+
+
+class TestDB:
+    @pytest.mark.parametrize("make", [lambda p: MemDB(), lambda p: SQLiteDB(str(p / "x.db"))])
+    def test_crud_and_iteration(self, tmp_path, make):
+        db = make(tmp_path)
+        db.set(b"b", b"2")
+        db.set(b"a", b"1")
+        db.set(b"c", b"3")
+        assert db.get(b"b") == b"2"
+        assert db.has(b"a")
+        db.delete(b"b")
+        assert db.get(b"b") is None
+        assert list(db.iterator()) == [(b"a", b"1"), (b"c", b"3")]
+        assert list(db.reverse_iterator()) == [(b"c", b"3"), (b"a", b"1")]
+        db.close()
+
+    def test_prefix_iteration(self, tmp_path):
+        db = SQLiteDB(str(tmp_path / "p.db"))
+        for k in [b"H:1", b"H:2", b"P:1", b"H:3"]:
+            db.set(k, k)
+        assert [k for k, _ in db.prefix_iterator(b"H:")] == [b"H:1", b"H:2", b"H:3"]
+        db.close()
+
+    def test_batch_atomicity(self, tmp_path):
+        db = SQLiteDB(str(tmp_path / "b.db"))
+        db.set(b"x", b"old")
+        b = db.new_batch()
+        b.set(b"y", b"1")
+        b.delete(b"x")
+        assert db.get(b"x") == b"old"  # not applied yet
+        b.write()
+        assert db.get(b"x") is None and db.get(b"y") == b"1"
+        db.close()
+
+    def test_prefix_end(self):
+        assert prefix_end(b"ab") == b"ac"
+        assert prefix_end(b"a\xff") == b"b"
+        assert prefix_end(b"\xff\xff") is None
+
+    def test_sqlite_persistence(self, tmp_path):
+        path = str(tmp_path / "persist.db")
+        db = SQLiteDB(path)
+        db.set_sync(b"k", b"v")
+        db.close()
+        db2 = SQLiteDB(path)
+        assert db2.get(b"k") == b"v"
+        db2.close()
