@@ -1,0 +1,148 @@
+"""Batch signature verification — THE plugin boundary this framework
+introduces.
+
+The v0.34 reference has no crypto/batch package: every hot path
+(types/validator_set.go:685-823 VerifyCommit*, types/vote_set.go:205 addVote,
+light/verifier.go:58-126, blockchain/v0/reactor.go:366) loops over
+PubKey.VerifySignature one signature at a time. Here those call sites route
+through a BatchVerifier selected by config ``[crypto] backend = "cpu"|"tpu"``.
+
+Semantics contract: verify() returns (all_ok, per_sig_mask) with accept/
+reject per signature bit-identical to the serial VerifySignature calls.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cometbft_tpu.crypto import PubKey
+from cometbft_tpu.crypto import ed25519 as ed
+
+
+class BatchVerifier:
+    """Interface (new; upstream cometbft >= v0.35 has an analogous shape)."""
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        raise NotImplementedError
+
+    def count(self) -> int:
+        raise NotImplementedError
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        """Returns (all_valid, per-entry validity mask) and resets the batch."""
+        raise NotImplementedError
+
+
+class CPUBatchVerifier(BatchVerifier):
+    """Serial CPU fallback — semantics ground truth."""
+
+    def __init__(self):
+        self._items: List[Tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key is None:
+            raise ValueError("nil pubkey")
+        self._items.append((pub_key, bytes(msg), bytes(sig)))
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        mask = [pk.verify_signature(msg, sig) for pk, msg, sig in self._items]
+        self._items = []
+        return all(mask) if mask else False, mask
+
+
+class TPUBatchVerifier(BatchVerifier):
+    """Routes ed25519 entries to the JAX/TPU batched kernel; any other key
+    type falls back to serial CPU verification in place (mixed batches are
+    partitioned by curve — SURVEY.md §7 stage 10)."""
+
+    def __init__(self, min_batch: int = 2):
+        # fail fast if the kernel module is unavailable rather than erroring
+        # mid-verify after add() calls succeeded
+        from cometbft_tpu.crypto.tpu import ed25519_batch  # noqa: F401
+
+        self._items: List[Tuple[PubKey, bytes, bytes]] = []
+        # below min_batch the kernel-launch overhead dominates; verify on CPU
+        self._min_batch = min_batch
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key is None:
+            raise ValueError("nil pubkey")
+        self._items.append((pub_key, bytes(msg), bytes(sig)))
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        items, self._items = self._items, []
+        if not items:
+            return False, []
+        mask: List[Optional[bool]] = [None] * len(items)
+        ed_idx: List[int] = []
+        for i, (pk, msg, sig) in enumerate(items):
+            if pk.type() == ed.KEY_TYPE and len(sig) == ed.SIGNATURE_SIZE:
+                ed_idx.append(i)
+            else:
+                mask[i] = pk.verify_signature(msg, sig)
+        if ed_idx:
+            if len(ed_idx) < self._min_batch:
+                for i in ed_idx:
+                    pk, msg, sig = items[i]
+                    mask[i] = pk.verify_signature(msg, sig)
+            else:
+                from cometbft_tpu.crypto.tpu import ed25519_batch
+
+                pks = [items[i][0].bytes() for i in ed_idx]
+                msgs = [items[i][1] for i in ed_idx]
+                sigs = [items[i][2] for i in ed_idx]
+                ok = ed25519_batch.verify_batch(pks, msgs, sigs)
+                for j, i in enumerate(ed_idx):
+                    mask[i] = bool(ok[j])
+        final = [bool(m) for m in mask]
+        return all(final), final
+
+
+# ---------------------------------------------------------------------------
+# Backend registry + default selection (config [crypto] backend)
+# ---------------------------------------------------------------------------
+
+_registry: Dict[str, Callable[[], BatchVerifier]] = {
+    "cpu": CPUBatchVerifier,
+    "tpu": TPUBatchVerifier,
+}
+_default_backend = os.environ.get("CMT_CRYPTO_BACKEND", "cpu")
+_mtx = threading.Lock()
+
+
+def register_backend(name: str, factory: Callable[[], BatchVerifier]) -> None:
+    with _mtx:
+        _registry[name] = factory
+
+
+def set_default_backend(name: str) -> None:
+    global _default_backend
+    with _mtx:
+        if name not in _registry:
+            raise ValueError(f"unknown crypto backend {name!r}")
+        _default_backend = name
+
+
+def default_backend() -> str:
+    return _default_backend
+
+
+def new_batch_verifier(backend: Optional[str] = None) -> BatchVerifier:
+    with _mtx:
+        name = backend or _default_backend
+        factory = _registry.get(name)
+    if factory is None:
+        raise ValueError(f"unknown crypto backend {name!r}")
+    return factory()
+
+
+def supports_batch_verification(pub_key: PubKey) -> bool:
+    return pub_key.type() == ed.KEY_TYPE

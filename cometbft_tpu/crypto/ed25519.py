@@ -1,0 +1,108 @@
+"""Ed25519 keys — the consensus default key type.
+
+Reference: crypto/ed25519/ed25519.go — Sign (:57), VerifySignature (:148),
+GenPrivKey, GenPrivKeyFromSecret; Address = SumTruncated(pubkey) (:140).
+
+CPU implementation wraps the OpenSSL-backed `cryptography` package, whose
+verify semantics (cofactorless sB - hA == R byte-compare, reject s >= L,
+reject non-canonical A) match Go's crypto/ed25519 used by the reference.
+The TPU batch implementation lives in cometbft_tpu.crypto.tpu.
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import Optional
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives import serialization
+
+from cometbft_tpu.crypto import PrivKey, PubKey, address_hash, sha256
+
+KEY_TYPE = "ed25519"
+PUB_KEY_SIZE = 32
+PRIVATE_KEY_SIZE = 64  # seed || pubkey, as Go's ed25519.PrivateKey
+SIGNATURE_SIZE = 64
+SEED_SIZE = 32
+
+# amino-compatible JSON type tags (crypto/ed25519/ed25519.go:37-40)
+PUB_KEY_NAME = "tendermint/PubKeyEd25519"
+PRIV_KEY_NAME = "tendermint/PrivKeyEd25519"
+
+
+class PubKeyEd25519(PubKey):
+    def __init__(self, key_bytes: bytes):
+        if len(key_bytes) != PUB_KEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(key_bytes)
+        self._pk: Optional[Ed25519PublicKey] = None
+
+    def address(self) -> bytes:
+        return address_hash(self._bytes)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        try:
+            if self._pk is None:
+                self._pk = Ed25519PublicKey.from_public_bytes(self._bytes)
+            self._pk.verify(sig, msg)
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+    def __repr__(self) -> str:
+        return f"PubKeyEd25519{{{self._bytes.hex().upper()}}}"
+
+
+class PrivKeyEd25519(PrivKey):
+    def __init__(self, key_bytes: bytes):
+        # accept 64-byte Go-style (seed||pub) or 32-byte seed
+        if len(key_bytes) == SEED_SIZE:
+            seed = bytes(key_bytes)
+            pub = (
+                Ed25519PrivateKey.from_private_bytes(seed)
+                .public_key()
+                .public_bytes(
+                    serialization.Encoding.Raw, serialization.PublicFormat.Raw
+                )
+            )
+            key_bytes = seed + pub
+        if len(key_bytes) != PRIVATE_KEY_SIZE:
+            raise ValueError(f"ed25519 privkey must be {PRIVATE_KEY_SIZE} bytes")
+        self._bytes = bytes(key_bytes)
+        self._sk = Ed25519PrivateKey.from_private_bytes(self._bytes[:SEED_SIZE])
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def sign(self, msg: bytes) -> bytes:
+        """Reference: crypto/ed25519/ed25519.go:57."""
+        return self._sk.sign(msg)
+
+    def pub_key(self) -> PubKeyEd25519:
+        return PubKeyEd25519(self._bytes[SEED_SIZE:])
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> PrivKeyEd25519:
+    """Reference: GenPrivKey — CSPRNG seed."""
+    return PrivKeyEd25519(secrets.token_bytes(SEED_SIZE))
+
+
+def gen_priv_key_from_secret(secret: bytes) -> PrivKeyEd25519:
+    """Deterministic keygen for tests (reference: GenPrivKeyFromSecret —
+    seed = SHA256(secret))."""
+    return PrivKeyEd25519(sha256(secret))

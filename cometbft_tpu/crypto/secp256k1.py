@@ -1,0 +1,201 @@
+"""secp256k1 ECDSA keys.
+
+Reference: crypto/secp256k1/secp256k1.go — deterministic (RFC 6979) ECDSA
+signing producing compact 64-byte r||s signatures with low-S normalization;
+Bitcoin-style address RIPEMD160(SHA256(compressed_pubkey)).
+
+Pure-Python big-int curve arithmetic (off the consensus hot path; the batch
+hot path is ed25519 on TPU — a secp256k1 kernel is a stretch goal, SURVEY.md
+§7 stage 10).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+
+from cometbft_tpu.crypto import PrivKey, PubKey, sha256
+from cometbft_tpu.crypto.ripemd160 import ripemd160
+
+KEY_TYPE = "secp256k1"
+PUB_KEY_SIZE = 33  # compressed
+PRIV_KEY_SIZE = 32
+SIG_SIZE = 64
+
+PUB_KEY_NAME = "tendermint/PubKeySecp256k1"
+PRIV_KEY_NAME = "tendermint/PrivKeySecp256k1"
+
+# curve parameters
+_P = 2**256 - 2**32 - 977
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+_GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+def _point_add(p, q):
+    if p is None:
+        return q
+    if q is None:
+        return p
+    (x1, y1), (x2, y2) = p, q
+    if x1 == x2 and (y1 + y2) % _P == 0:
+        return None
+    if p == q:
+        lam = (3 * x1 * x1) * _inv(2 * y1, _P) % _P
+    else:
+        lam = (y2 - y1) * _inv(x2 - x1, _P) % _P
+    x3 = (lam * lam - x1 - x2) % _P
+    y3 = (lam * (x1 - x3) - y1) % _P
+    return (x3, y3)
+
+
+def _point_mul(k: int, p):
+    result = None
+    addend = p
+    while k:
+        if k & 1:
+            result = _point_add(result, addend)
+        addend = _point_add(addend, addend)
+        k >>= 1
+    return result
+
+
+def _compress(pt) -> bytes:
+    x, y = pt
+    return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+
+
+def _decompress(data: bytes):
+    if len(data) != 33 or data[0] not in (2, 3):
+        raise ValueError("bad compressed point")
+    x = int.from_bytes(data[1:], "big")
+    if x >= _P:
+        raise ValueError("x out of range")
+    y2 = (pow(x, 3, _P) + 7) % _P
+    y = pow(y2, (_P + 1) // 4, _P)
+    if y * y % _P != y2:
+        raise ValueError("not on curve")
+    if (y & 1) != (data[0] & 1):
+        y = _P - y
+    return (x, y)
+
+
+def _rfc6979_k(priv: int, h1: bytes) -> int:
+    """Deterministic nonce per RFC 6979 (SHA-256)."""
+    x = priv.to_bytes(32, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = hmac.new(k, v + b"\x00" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + x + h1, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 1 <= cand < _N:
+            return cand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+class PubKeySecp256k1(PubKey):
+    def __init__(self, key_bytes: bytes):
+        if len(key_bytes) != PUB_KEY_SIZE:
+            raise ValueError(f"secp256k1 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._bytes = bytes(key_bytes)
+
+    def address(self) -> bytes:
+        """RIPEMD160(SHA256(compressed)) — secp256k1.go:1-25 header."""
+        return ripemd160(sha256(self._bytes))
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIG_SIZE:
+            return False
+        try:
+            pt = _decompress(self._bytes)
+        except ValueError:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (1 <= r < _N and 1 <= s < _N):
+            return False
+        # reject high-S (malleability rule, as btcec's Signature.Verify
+        # combined with the reference's serialization which always low-S)
+        if s > _N // 2:
+            return False
+        e = int.from_bytes(sha256(msg), "big") % _N
+        w = _inv(s, _N)
+        u1 = e * w % _N
+        u2 = r * w % _N
+        pt = _point_add(_point_mul(u1, (_GX, _GY)), _point_mul(u2, pt))
+        if pt is None:
+            return False
+        return pt[0] % _N == r
+
+    def __repr__(self) -> str:
+        return f"PubKeySecp256k1{{{self._bytes.hex().upper()}}}"
+
+
+class PrivKeySecp256k1(PrivKey):
+    def __init__(self, key_bytes: bytes):
+        if len(key_bytes) != PRIV_KEY_SIZE:
+            raise ValueError(f"secp256k1 privkey must be {PRIV_KEY_SIZE} bytes")
+        d = int.from_bytes(key_bytes, "big")
+        if not (1 <= d < _N):
+            raise ValueError("privkey scalar out of range")
+        self._bytes = bytes(key_bytes)
+        self._d = d
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def sign(self, msg: bytes) -> bytes:
+        h1 = sha256(msg)
+        e = int.from_bytes(h1, "big") % _N
+        while True:
+            k = _rfc6979_k(self._d, h1)
+            pt = _point_mul(k, (_GX, _GY))
+            r = pt[0] % _N
+            if r == 0:
+                continue
+            s = _inv(k, _N) * (e + r * self._d) % _N
+            if s == 0:
+                continue
+            if s > _N // 2:
+                s = _N - s
+            return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> PubKeySecp256k1:
+        return PubKeySecp256k1(_compress(_point_mul(self._d, (_GX, _GY))))
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> PrivKeySecp256k1:
+    while True:
+        b = secrets.token_bytes(32)
+        d = int.from_bytes(b, "big")
+        if 1 <= d < _N:
+            return PrivKeySecp256k1(b)
+
+
+def gen_priv_key_from_secret(secret: bytes) -> PrivKeySecp256k1:
+    """Reference: GenPrivKeySecp256k1 — hashes secret until valid scalar."""
+    seed = sha256(secret)
+    while True:
+        d = int.from_bytes(seed, "big")
+        if 1 <= d < _N:
+            return PrivKeySecp256k1(seed)
+        seed = sha256(seed)

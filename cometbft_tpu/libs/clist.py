@@ -100,11 +100,9 @@ class CList:
 
     def remove(self, e: CElement) -> Any:
         with self._mtx:
-            prev, nxt = e.prev(), e.next()
-            if prev is None and nxt is None and e is not self._head:
-                # already detached
-                e._mark_removed()
+            if e.removed:
                 return e.value
+            prev, nxt = e.prev(), e.next()
             if prev is not None:
                 prev._set_next(nxt)
             else:

@@ -17,10 +17,6 @@ _call_index = -1
 _fail_index = None  # lazily read from env
 
 
-class CrashInjected(SystemExit):
-    pass
-
-
 def _target() -> int:
     global _fail_index
     if _fail_index is None:

@@ -54,7 +54,7 @@ class Logger:
         mod = self._context.get("module")
         if mod is not None and mod in self._module_levels:
             return self._module_levels[mod]
-        return self._level
+        return self._module_levels.get("*", self._level)
 
     def _log(self, level: int, msg: str, kv: Dict[str, Any]) -> None:
         if self._sink is None or level < self._effective_level():

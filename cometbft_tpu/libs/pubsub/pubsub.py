@@ -168,9 +168,10 @@ class Server:
             for client_id, client_subs in list(self._subs.items()):
                 for qstr, sub in list(client_subs.items()):
                     if sub.query.matches(events):
-                        if not sub._deliver(msg) and sub._unbuffered:
-                            # slow unbuffered client: evict (reference:
-                            # pubsub.go client send timeout → cancel)
+                        if not sub._deliver(msg):
+                            # slow client (queue full): evict with reason
+                            # rather than silently dropping events
+                            # (reference: pubsub.go send timeout → cancel)
                             client_subs.pop(qstr)
                             evicted.append(sub)
                 if not client_subs:

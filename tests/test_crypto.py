@@ -1,0 +1,201 @@
+"""Crypto layer tests (reference models: crypto/*/..._test.go)."""
+
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519, secp256k1, sha256, tmhash
+from cometbft_tpu.crypto.batch import CPUBatchVerifier, new_batch_verifier
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.crypto.ripemd160 import ripemd160
+
+
+class TestEd25519:
+    def test_sign_verify(self):
+        priv = ed25519.gen_priv_key()
+        pub = priv.pub_key()
+        msg = b"sign me please"
+        sig = priv.sign(msg)
+        assert len(sig) == 64
+        assert pub.verify_signature(msg, sig)
+        assert not pub.verify_signature(b"other msg", sig)
+        bad = bytearray(sig)
+        bad[0] ^= 1
+        assert not pub.verify_signature(msg, bytes(bad))
+
+    def test_rfc8032_vector(self):
+        # RFC 8032 §7.1 TEST 3
+        seed = bytes.fromhex(
+            "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7"
+        )
+        pub = bytes.fromhex(
+            "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025"
+        )
+        msg = bytes.fromhex("af82")
+        sig = bytes.fromhex(
+            "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+            "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"
+        )
+        priv = ed25519.PrivKeyEd25519(seed)
+        assert priv.pub_key().bytes() == pub
+        assert priv.sign(msg) == sig
+        assert priv.pub_key().verify_signature(msg, sig)
+
+    def test_deterministic_keygen(self):
+        a = ed25519.gen_priv_key_from_secret(b"secret")
+        b = ed25519.gen_priv_key_from_secret(b"secret")
+        assert a.bytes() == b.bytes()
+        assert a.pub_key() == b.pub_key()
+
+    def test_address_is_truncated_sha(self):
+        priv = ed25519.gen_priv_key_from_secret(b"addr")
+        pub = priv.pub_key()
+        assert pub.address() == hashlib.sha256(pub.bytes()).digest()[:20]
+        assert len(pub.address()) == 20
+
+    def test_malformed_sig_len(self):
+        priv = ed25519.gen_priv_key()
+        assert not priv.pub_key().verify_signature(b"m", b"short")
+
+
+class TestSecp256k1:
+    def test_sign_verify(self):
+        priv = secp256k1.gen_priv_key_from_secret(b"sec")
+        pub = priv.pub_key()
+        assert len(pub.bytes()) == 33
+        msg = b"hello secp"
+        sig = priv.sign(msg)
+        assert len(sig) == 64
+        assert pub.verify_signature(msg, sig)
+        assert not pub.verify_signature(b"tampered", sig)
+
+    def test_deterministic_signature(self):
+        priv = secp256k1.gen_priv_key_from_secret(b"rfc6979")
+        assert priv.sign(b"m") == priv.sign(b"m")
+
+    def test_low_s_enforced(self):
+        priv = secp256k1.gen_priv_key_from_secret(b"lows")
+        sig = priv.sign(b"m")
+        s = int.from_bytes(sig[32:], "big")
+        n = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+        assert s <= n // 2
+        # the high-S form of a valid sig must be rejected
+        high = sig[:32] + (n - s).to_bytes(32, "big")
+        assert not priv.pub_key().verify_signature(b"m", high)
+
+    def test_address_len(self):
+        pub = secp256k1.gen_priv_key_from_secret(b"a").pub_key()
+        assert len(pub.address()) == 20
+
+
+class TestRipemd160:
+    def test_vectors(self):
+        # standard RIPEMD-160 test vectors (Dobbertin et al.)
+        assert ripemd160(b"").hex() == "9c1185a5c5e9fc54612808977ee8f548b2258d31"
+        assert (
+            ripemd160(b"abc").hex() == "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"
+        )
+        assert (
+            ripemd160(b"message digest").hex()
+            == "5d0689ef49d2fae572b881b123a85ffa21595f36"
+        )
+        assert (
+            ripemd160(b"a" * 1000000).hex()
+            == "52783243c1697bdbe16d37f97f68f08325dc1528"
+        )
+
+
+class TestMerkle:
+    def test_rfc6962_empty_and_leaf(self):
+        # RFC 6962 test vectors (same layout as reference tree.go)
+        assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+        assert (
+            merkle.leaf_hash(b"").hex()
+            == "6e340b9cffb37a989ca544e6bb780a2c78901d3fb33738768511a30617afa01d"
+        )
+        assert (
+            merkle.hash_from_byte_slices([b"L123456"]).hex()
+            == "395aa064aa4c29f7010acfe3f25db9485bbd4b91897b6ad7ad547639252b4d56"
+        )
+
+    def test_inner_split(self):
+        items = [b"a", b"b", b"c"]
+        root = merkle.hash_from_byte_slices(items)
+        l = merkle.inner_hash(merkle.leaf_hash(b"a"), merkle.leaf_hash(b"b"))
+        expect = merkle.inner_hash(l, merkle.leaf_hash(b"c"))
+        assert root == expect
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 33, 100])
+    def test_proofs(self, n):
+        items = [bytes([i]) * 3 for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, p in enumerate(proofs):
+            p.verify(root, items[i])
+            with pytest.raises(ValueError):
+                p.verify(root, b"wrong leaf")
+        # cross-proof misuse: proof i must not verify item j
+        if n >= 2:
+            with pytest.raises(ValueError):
+                proofs[0].verify(root, items[1])
+
+    def test_split_point(self):
+        assert merkle.get_split_point(2) == 1
+        assert merkle.get_split_point(3) == 2
+        assert merkle.get_split_point(8) == 4
+        assert merkle.get_split_point(9) == 8
+
+
+class TestBatchVerifier:
+    def _mk(self, n, bad=()):
+        triples = []
+        for i in range(n):
+            priv = ed25519.gen_priv_key_from_secret(f"k{i}".encode())
+            msg = f"msg {i}".encode()
+            sig = priv.sign(msg)
+            if i in bad:
+                sig = sig[:-1] + bytes([sig[-1] ^ 1])
+            triples.append((priv.pub_key(), msg, sig))
+        return triples
+
+    def test_cpu_all_valid(self):
+        bv = CPUBatchVerifier()
+        for pk, m, s in self._mk(16):
+            bv.add(pk, m, s)
+        assert bv.count() == 16
+        ok, mask = bv.verify()
+        assert ok and mask == [True] * 16
+        assert bv.count() == 0  # reset
+
+    def test_cpu_mixed_validity(self):
+        bv = CPUBatchVerifier()
+        for pk, m, s in self._mk(8, bad={2, 5}):
+            bv.add(pk, m, s)
+        ok, mask = bv.verify()
+        assert not ok
+        assert [i for i, v in enumerate(mask) if not v] == [2, 5]
+
+    def test_empty_batch(self):
+        ok, mask = CPUBatchVerifier().verify()
+        assert not ok and mask == []
+
+    def test_mixed_key_types(self):
+        bv = CPUBatchVerifier()
+        e = ed25519.gen_priv_key_from_secret(b"e")
+        s = secp256k1.gen_priv_key_from_secret(b"s")
+        bv.add(e.pub_key(), b"m1", e.sign(b"m1"))
+        bv.add(s.pub_key(), b"m2", s.sign(b"m2"))
+        ok, mask = bv.verify()
+        assert ok and mask == [True, True]
+
+    def test_registry(self):
+        assert isinstance(new_batch_verifier("cpu"), CPUBatchVerifier)
+        with pytest.raises(ValueError):
+            new_batch_verifier("quantum")
+
+
+class TestHashers:
+    def test_tmhash(self):
+        assert tmhash.sum(b"x") == hashlib.sha256(b"x").digest()
+        assert tmhash.sum_truncated(b"x") == hashlib.sha256(b"x").digest()[:20]
+        assert sha256(b"") == hashlib.sha256(b"").digest()
