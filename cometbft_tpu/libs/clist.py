@@ -23,6 +23,7 @@ class CElement:
         self._prev: Optional["CElement"] = None
         self._next_cond = threading.Condition(self._mtx)
         self.removed = False
+        self._owner: Optional["CList"] = None
 
     def next(self) -> Optional["CElement"]:
         with self._mtx:
@@ -87,6 +88,7 @@ class CList:
 
     def push_back(self, value: Any) -> CElement:
         e = CElement(value)
+        e._owner = self
         with self._mtx:
             if self._tail is None:
                 self._head = self._tail = e
@@ -100,7 +102,7 @@ class CList:
 
     def remove(self, e: CElement) -> Any:
         with self._mtx:
-            if e.removed:
+            if e.removed or e._owner is not self:
                 return e.value
             prev, nxt = e.prev(), e.next()
             if prev is not None:

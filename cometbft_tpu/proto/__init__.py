@@ -1,0 +1,38 @@
+"""Hand-rolled protobuf wire encoders for the tendermint proto surface.
+
+Reference: proto/tendermint/** (gogoproto-generated). We reproduce the exact
+byte layouts — field numbers, wire types, gogoproto nullability conventions —
+so that sign-bytes, hashes, and wire frames are bit-identical to the
+reference (SURVEY.md §2.15). Conventions encoded here:
+
+- proto3 scalar zero values are omitted;
+- gogoproto ``(nullable) = false`` embedded messages are ALWAYS emitted,
+  even when zero-valued (tag + len, possibly len 0);
+- nullable embedded messages are omitted when None;
+- ``stdtime`` timestamps marshal as google.protobuf.Timestamp, with Go's
+  zero time == seconds -62135596800 (year 1 UTC).
+"""
+
+from cometbft_tpu.proto.gogo import (
+    Timestamp,
+    ZERO_TIME,
+    encode_timestamp,
+    decode_timestamp,
+    cdc_encode_string,
+    cdc_encode_int64,
+    cdc_encode_bytes,
+)
+from cometbft_tpu.proto.keys import PublicKeyProto
+from cometbft_tpu.proto.version import ConsensusVersion
+
+__all__ = [
+    "Timestamp",
+    "ZERO_TIME",
+    "encode_timestamp",
+    "decode_timestamp",
+    "cdc_encode_string",
+    "cdc_encode_int64",
+    "cdc_encode_bytes",
+    "PublicKeyProto",
+    "ConsensusVersion",
+]

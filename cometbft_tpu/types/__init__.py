@@ -1,0 +1,56 @@
+"""Core consensus types (reference: types/ — SURVEY.md §1 layer 2)."""
+
+from cometbft_tpu.types.block import (
+    BlockID,
+    PartSetHeader,
+    CommitSig,
+    Commit,
+    Header,
+    Data,
+    Block,
+    BlockMeta,
+    BLOCK_ID_FLAG_ABSENT,
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+)
+from cometbft_tpu.types.vote import (
+    Vote,
+    SIGNED_MSG_TYPE_UNKNOWN,
+    SIGNED_MSG_TYPE_PREVOTE,
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PROPOSAL,
+)
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.validator import Validator
+from cometbft_tpu.types.validator_set import ValidatorSet
+from cometbft_tpu.types.part_set import Part, PartSet, BLOCK_PART_SIZE_BYTES
+from cometbft_tpu.types.params import ConsensusParams
+from cometbft_tpu.types.tx import Tx, Txs
+
+__all__ = [
+    "BlockID",
+    "PartSetHeader",
+    "CommitSig",
+    "Commit",
+    "Header",
+    "Data",
+    "Block",
+    "BlockMeta",
+    "Vote",
+    "Proposal",
+    "Validator",
+    "ValidatorSet",
+    "Part",
+    "PartSet",
+    "ConsensusParams",
+    "Tx",
+    "Txs",
+    "BLOCK_ID_FLAG_ABSENT",
+    "BLOCK_ID_FLAG_COMMIT",
+    "BLOCK_ID_FLAG_NIL",
+    "SIGNED_MSG_TYPE_UNKNOWN",
+    "SIGNED_MSG_TYPE_PREVOTE",
+    "SIGNED_MSG_TYPE_PRECOMMIT",
+    "SIGNED_MSG_TYPE_PROPOSAL",
+    "BLOCK_PART_SIZE_BYTES",
+]

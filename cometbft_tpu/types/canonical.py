@@ -1,0 +1,72 @@
+"""Canonical sign-bytes encodings.
+
+Reference: types/canonical.go + proto/tendermint/types/canonical.proto.
+These byte layouts are consensus-critical: a signature is over
+MarshalDelimited(CanonicalVote/CanonicalProposal) — varint length prefix
+followed by the proto encoding with sfixed64 height/round
+(types/vote.go:93-101). Golden vectors: types/vote_test.go:60.
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.types.block import BlockID
+
+
+def canonicalize_block_id(block_id: BlockID) -> bytes | None:
+    """CanonicalBlockID proto bytes, or None for a zero block id
+    (canonical.go:18 — nil when IsZero)."""
+    if block_id.is_zero():
+        return None
+    psh = protoio.field_varint(
+        1, block_id.part_set_header.total
+    ) + protoio.field_bytes(2, block_id.part_set_header.hash)
+    return protoio.field_bytes(1, block_id.hash) + protoio.field_message(2, psh)
+
+
+def _canonical_vote_bytes(
+    msg_type: int,
+    height: int,
+    round_: int,
+    block_id: BlockID,
+    timestamp: Timestamp,
+    chain_id: str,
+) -> bytes:
+    """CanonicalVote: type=1 varint, height=2 sfixed64, round=3 sfixed64,
+    block_id=4 (nullable), timestamp=5 (non-null), chain_id=6."""
+    out = protoio.field_varint(1, msg_type)
+    out += protoio.field_sfixed64(2, height)
+    out += protoio.field_sfixed64(3, round_)
+    cbid = canonicalize_block_id(block_id)
+    if cbid is not None:
+        out += protoio.field_message(4, cbid)
+    out += protoio.field_message(5, timestamp.encode())
+    out += protoio.field_string(6, chain_id)
+    return out
+
+
+def canonical_vote_bytes(chain_id: str, vote) -> bytes:
+    """Sign bytes for a Vote: MarshalDelimited(CanonicalVote)
+    (types/vote.go:93 VoteSignBytes)."""
+    body = _canonical_vote_bytes(
+        vote.type, vote.height, vote.round, vote.block_id, vote.timestamp, chain_id
+    )
+    return protoio.marshal_delimited(body)
+
+
+def canonical_proposal_bytes(chain_id: str, proposal) -> bytes:
+    """Sign bytes for a Proposal: MarshalDelimited(CanonicalProposal)
+    (types/proposal.go ProposalSignBytes). Field layout per canonical.proto:
+    type=1, height=2 sfixed64, round=3 sfixed64, pol_round=4 int64,
+    block_id=5, timestamp=6, chain_id=7."""
+    out = protoio.field_varint(1, proposal.type)
+    out += protoio.field_sfixed64(2, proposal.height)
+    out += protoio.field_sfixed64(3, proposal.round)
+    out += protoio.field_varint(4, proposal.pol_round)
+    cbid = canonicalize_block_id(proposal.block_id)
+    if cbid is not None:
+        out += protoio.field_message(5, cbid)
+    out += protoio.field_message(6, proposal.timestamp.encode())
+    out += protoio.field_string(7, chain_id)
+    return protoio.marshal_delimited(out)

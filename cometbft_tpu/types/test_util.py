@@ -1,0 +1,83 @@
+"""Test fixtures shared by the test suite.
+
+Reference: types/test_util.go (MakeCommit) and the randomized fixtures in
+types/validator_set.go:1027 (RandValidatorSet).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.types.block import BlockID, Commit, PartSetHeader
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.validator import Validator
+from cometbft_tpu.types.validator_set import ValidatorSet
+from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PRECOMMIT, Vote
+
+
+def deterministic_validator_set(
+    n: int = 10, power: int = 100
+) -> Tuple[ValidatorSet, List[MockPV]]:
+    """N validators with deterministic keys, equal power."""
+    privs = [
+        MockPV(ed25519.gen_priv_key_from_secret(f"validator-{i}".encode()))
+        for i in range(n)
+    ]
+    vals = [Validator.new(pv.get_pub_key(), power) for pv in privs]
+    vs = ValidatorSet(vals)
+    # align signer order with the set's canonical validator order
+    by_addr = {pv.get_pub_key().address(): pv for pv in privs}
+    ordered = [by_addr[v.address] for v in vs.validators]
+    return vs, ordered
+
+
+def make_block_id(
+    hash_: bytes = b"\x01" * 32, total: int = 1000, part_hash: bytes = b"\x02" * 32
+) -> BlockID:
+    return BlockID(hash_, PartSetHeader(total, part_hash))
+
+
+def make_vote(
+    pv: MockPV,
+    chain_id: str,
+    val_index: int,
+    height: int,
+    round_: int,
+    msg_type: int,
+    block_id: BlockID,
+    timestamp: Timestamp | None = None,
+) -> Vote:
+    """Reference: types/test_util.go makeVote."""
+    vote = Vote(
+        type=msg_type,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp=timestamp or Timestamp.now(),
+        validator_address=pv.get_pub_key().address(),
+        validator_index=val_index,
+    )
+    pv.sign_vote(chain_id, vote)
+    return vote
+
+
+def make_commit(
+    block_id: BlockID,
+    height: int,
+    round_: int,
+    val_set: ValidatorSet,
+    privs: List[MockPV],
+    chain_id: str,
+    now: Timestamp | None = None,
+) -> Commit:
+    """Reference: types/test_util.go MakeCommit — all validators sign."""
+    now = now or Timestamp.now()
+    sigs = []
+    for i, pv in enumerate(privs):
+        vote = make_vote(
+            pv, chain_id, i, height, round_, SIGNED_MSG_TYPE_PRECOMMIT, block_id, now
+        )
+        sigs.append(vote.to_commit_sig())
+    return Commit(height=height, round=round_, block_id=block_id, signatures=sigs)

@@ -1,0 +1,475 @@
+"""Types-layer tests, including the reference's golden sign-bytes vectors
+(types/vote_test.go:60 TestVoteSignBytesTestVectors) — byte-for-byte parity
+with gogoproto canonical encodings is consensus-critical.
+"""
+
+import pytest
+
+from cometbft_tpu.proto.gogo import Timestamp, ZERO_TIME
+from cometbft_tpu.types import (
+    Block,
+    BlockID,
+    Commit,
+    CommitSig,
+    Header,
+    PartSet,
+    PartSetHeader,
+    Proposal,
+    Validator,
+    ValidatorSet,
+    Vote,
+)
+from cometbft_tpu.types.block import (
+    BLOCK_ID_FLAG_COMMIT,
+    BLOCK_ID_FLAG_NIL,
+    make_block,
+)
+from cometbft_tpu.types.part_set import Part
+from cometbft_tpu.types.params import ConsensusParams
+from cometbft_tpu.types.test_util import (
+    deterministic_validator_set,
+    make_block_id,
+    make_commit,
+)
+from cometbft_tpu.types.validator_set import (
+    ErrNotEnoughVotingPowerSigned,
+    Fraction,
+)
+from cometbft_tpu.types.vote import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+)
+from cometbft_tpu.types.tx import Txs
+
+
+class TestVoteSignBytesGoldenVectors:
+    """The exact vectors from types/vote_test.go:60."""
+
+    def test_empty_vote(self):
+        v = Vote()
+        want = bytes(
+            [0xD, 0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1]
+        )
+        assert v.sign_bytes("") == want
+
+    def test_precommit(self):
+        v = Vote(height=1, round=1, type=SIGNED_MSG_TYPE_PRECOMMIT)
+        want = bytes(
+            [0x21, 0x8, 0x2, 0x11, 0x1, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x19]
+            + [0x1, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0]
+            + [0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1]
+        )
+        assert v.sign_bytes("") == want
+
+    def test_prevote(self):
+        v = Vote(height=1, round=1, type=SIGNED_MSG_TYPE_PREVOTE)
+        want = bytes(
+            [0x21, 0x8, 0x1, 0x11, 0x1, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x19]
+            + [0x1, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0]
+            + [0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1]
+        )
+        assert v.sign_bytes("") == want
+
+    def test_no_type(self):
+        v = Vote(height=1, round=1)
+        want = bytes(
+            [0x1F, 0x11, 0x1, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x19]
+            + [0x1, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0]
+            + [0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1]
+        )
+        assert v.sign_bytes("") == want
+
+    def test_with_chain_id(self):
+        v = Vote(height=1, round=1)
+        want = bytes(
+            [0x2E, 0x11, 0x1, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x19]
+            + [0x1, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0, 0x0]
+            + [0x2A, 0xB, 0x8, 0x80, 0x92, 0xB8, 0xC3, 0x98, 0xFE, 0xFF, 0xFF, 0xFF, 0x1]
+            + [0x32, 0xD]
+            + list(b"test_chain_id")
+        )
+        assert v.sign_bytes("test_chain_id") == want
+
+    def test_vote_proposal_not_eq(self):
+        """canonical.go invariant: a vote and proposal with the same fields
+        produce different sign bytes (types/vote_test.go TestVoteProposalNotEq)."""
+        bid = make_block_id()
+        v = Vote(height=1, round=1, block_id=bid, timestamp=ZERO_TIME)
+        p = Proposal(height=1, round=1, block_id=bid, timestamp=ZERO_TIME)
+        assert v.sign_bytes("chain") != p.sign_bytes("chain")
+
+
+class TestRoundTrips:
+    def test_vote_roundtrip(self):
+        v = Vote(
+            type=SIGNED_MSG_TYPE_PRECOMMIT,
+            height=12345,
+            round=2,
+            block_id=make_block_id(),
+            timestamp=Timestamp(1700000000, 123456789),
+            validator_address=b"\xaa" * 20,
+            validator_index=3,
+            signature=b"\x55" * 64,
+        )
+        assert Vote.decode(v.encode()) == v
+
+    def test_header_roundtrip(self):
+        h = Header(
+            chain_id="test",
+            height=7,
+            time=Timestamp(1700000000, 5),
+            last_block_id=make_block_id(),
+            validators_hash=b"\x01" * 32,
+            next_validators_hash=b"\x02" * 32,
+            consensus_hash=b"\x03" * 32,
+            app_hash=b"\x04" * 32,
+            proposer_address=b"\x05" * 20,
+        )
+        assert Header.decode(h.encode()) == h
+        assert h.hash() is not None
+        assert Header().hash() is None  # no validators hash -> nil
+
+    def test_commit_roundtrip(self):
+        _, privs = deterministic_validator_set(4)
+        vs, privs = deterministic_validator_set(4)
+        commit = make_commit(make_block_id(), 5, 1, vs, privs, "chain")
+        commit2 = Commit.decode(commit.encode())
+        assert commit2.height == 5 and commit2.round == 1
+        assert commit2.hash() == commit.hash()
+
+    def test_proposal_roundtrip(self):
+        p = Proposal(
+            height=3,
+            round=1,
+            pol_round=0,
+            block_id=make_block_id(),
+            timestamp=Timestamp(1000, 1),
+            signature=b"\x11" * 64,
+        )
+        assert Proposal.decode(p.encode()) == p
+
+    def test_params_roundtrip_and_hash(self):
+        cp = ConsensusParams()
+        assert ConsensusParams.decode(cp.encode()) == cp
+        assert len(cp.hash()) == 32
+        cp.validate_basic()
+
+    def test_validator_set_roundtrip(self):
+        vs, _ = deterministic_validator_set(5)
+        vs2 = ValidatorSet.decode(vs.encode())
+        assert vs2.hash() == vs.hash()
+        assert [v.address for v in vs2.validators] == [
+            v.address for v in vs.validators
+        ]
+
+
+class TestValidatorSet:
+    def test_proposer_rotation_is_fair(self):
+        vs, _ = deterministic_validator_set(4, power=100)
+        seen = {}
+        for _ in range(40):
+            p = vs.get_proposer()
+            seen[p.address] = seen.get(p.address, 0) + 1
+            vs.increment_proposer_priority(1)
+        # equal power -> equal share (10 each over 40 rounds)
+        assert all(c == 10 for c in seen.values())
+
+    def test_proposer_weighted_rotation(self):
+        from cometbft_tpu.crypto import ed25519 as edlib
+        from cometbft_tpu.types.validator import Validator as V
+
+        k1 = edlib.gen_priv_key_from_secret(b"a").pub_key()
+        k2 = edlib.gen_priv_key_from_secret(b"b").pub_key()
+        vs = ValidatorSet([V.new(k1, 3), V.new(k2, 1)])
+        counts = {k1.address(): 0, k2.address(): 0}
+        for _ in range(40):
+            counts[vs.get_proposer().address] += 1
+            vs.increment_proposer_priority(1)
+        assert counts[k1.address()] == 30
+        assert counts[k2.address()] == 10
+
+    def test_update_with_change_set(self):
+        from cometbft_tpu.crypto import ed25519 as edlib
+        from cometbft_tpu.types.validator import Validator as V
+
+        vs, _ = deterministic_validator_set(3, power=10)
+        old_hash = vs.hash()
+        new_key = edlib.gen_priv_key_from_secret(b"new").pub_key()
+        vs.update_with_change_set([V.new(new_key, 50)])
+        assert vs.size() == 4
+        assert vs.hash() != old_hash
+        assert vs.total_voting_power() == 80
+        # power-desc order puts the 50-power validator first
+        assert vs.validators[0].address == new_key.address()
+        # removal
+        vs.update_with_change_set([V.new(new_key, 0)])
+        assert vs.size() == 3
+        assert vs.total_voting_power() == 30
+
+    def test_duplicate_changes_rejected(self):
+        from cometbft_tpu.crypto import ed25519 as edlib
+        from cometbft_tpu.types.validator import Validator as V
+
+        vs, _ = deterministic_validator_set(3)
+        k = edlib.gen_priv_key_from_secret(b"dup").pub_key()
+        with pytest.raises(ValueError, match="duplicate"):
+            vs.update_with_change_set([V.new(k, 5), V.new(k, 6)])
+
+
+class TestVerifyCommit:
+    CHAIN = "test_chain"
+
+    def _setup(self, n=10):
+        vs, privs = deterministic_validator_set(n)
+        block_id = make_block_id()
+        commit = make_commit(block_id, 5, 0, vs, privs, self.CHAIN)
+        return vs, privs, block_id, commit
+
+    def test_verify_commit_ok(self):
+        vs, _, block_id, commit = self._setup()
+        vs.verify_commit(self.CHAIN, block_id, 5, commit)
+        vs.verify_commit_light(self.CHAIN, block_id, 5, commit)
+        vs.verify_commit_light_trusting(self.CHAIN, commit, Fraction(1, 3))
+
+    def test_wrong_height(self):
+        vs, _, block_id, commit = self._setup()
+        with pytest.raises(ValueError, match="wrong height"):
+            vs.verify_commit(self.CHAIN, block_id, 6, commit)
+
+    def test_wrong_block_id(self):
+        vs, _, block_id, commit = self._setup()
+        other = make_block_id(b"\x09" * 32)
+        with pytest.raises(ValueError, match="wrong block ID"):
+            vs.verify_commit(self.CHAIN, other, 5, commit)
+
+    def test_wrong_set_size(self):
+        vs, _, block_id, commit = self._setup()
+        commit.signatures.append(CommitSig.absent())
+        with pytest.raises(ValueError, match="wrong set size"):
+            vs.verify_commit(self.CHAIN, block_id, 5, commit)
+
+    def test_bad_signature_detected(self):
+        vs, _, block_id, commit = self._setup()
+        sig = commit.signatures[3].signature
+        commit.signatures[3].signature = sig[:-1] + bytes([sig[-1] ^ 1])
+        with pytest.raises(ValueError, match=r"wrong signature \(#3\)"):
+            vs.verify_commit(self.CHAIN, block_id, 5, commit)
+
+    def test_insufficient_power(self):
+        from cometbft_tpu.types.test_util import make_vote
+
+        vs, privs, block_id, commit = self._setup(n=10)
+        # 4 of 10 equal-power validators genuinely voted nil:
+        # tallied 600 <= needed (2/3 of 1000 = 666)
+        for i in range(4):
+            nil_vote = make_vote(
+                privs[i], self.CHAIN, i, 5, 0, SIGNED_MSG_TYPE_PRECOMMIT, BlockID()
+            )
+            commit.signatures[i] = nil_vote.to_commit_sig()
+        with pytest.raises(ErrNotEnoughVotingPowerSigned):
+            vs.verify_commit(self.CHAIN, block_id, 5, commit)
+
+    def test_light_ignores_bad_sig_after_quorum(self):
+        """VerifyCommitLight early-exits at +2/3: a bad sig after quorum is
+        NOT checked (validator_set.go:758-761), unlike VerifyCommit."""
+        vs, _, block_id, commit = self._setup(n=10)
+        sig = commit.signatures[9].signature
+        commit.signatures[9].signature = sig[:-1] + bytes([sig[-1] ^ 1])
+        vs.verify_commit_light(self.CHAIN, block_id, 5, commit)  # passes
+        with pytest.raises(ValueError, match=r"wrong signature \(#9\)"):
+            vs.verify_commit(self.CHAIN, block_id, 5, commit)
+
+    def test_light_trusting_different_valset(self):
+        """Trusting verification uses address lookup — works when the
+        trusted set only overlaps the commit's set."""
+        vs, privs, block_id, commit = self._setup(n=10)
+        # trusted set = 6 of the 10 validators
+        subset = ValidatorSet([vs.validators[i].copy() for i in range(6)])
+        subset.verify_commit_light_trusting(self.CHAIN, commit, Fraction(1, 3))
+
+    def test_absent_sigs_ok(self):
+        vs, _, block_id, commit = self._setup(n=10)
+        commit.signatures[0] = CommitSig.absent()
+        vs.verify_commit(self.CHAIN, block_id, 5, commit)
+
+
+class TestPartSet:
+    def test_split_and_reassemble(self):
+        data = bytes(range(256)) * 1000  # 256000 bytes -> 4 parts at 64KiB
+        ps = PartSet.from_data(data)
+        assert ps.total() == 4
+        assert ps.is_complete()
+        assert ps.get_reader() == data
+        # rebuild from header + parts (gossip path)
+        ps2 = PartSet.from_header(ps.header())
+        for i in range(ps.total()):
+            added, err = ps2.add_part(ps.get_part(i))
+            assert added, err
+        assert ps2.is_complete()
+        assert ps2.get_reader() == data
+
+    def test_bad_part_rejected(self):
+        data = b"z" * 100000
+        ps = PartSet.from_data(data)
+        ps2 = PartSet.from_header(ps.header())
+        part = ps.get_part(0)
+        bad = Part(part.index, part.bytes_[:-1] + b"\x00", part.proof)
+        added, err = ps2.add_part(bad)
+        assert not added and "invalid part proof" in err
+
+    def test_duplicate_part(self):
+        ps = PartSet.from_data(b"q" * 1000)
+        added, err = ps.add_part(ps.get_part(0))
+        assert not added and err is None
+
+
+class TestBlock:
+    def test_block_hash_and_validate(self):
+        vs, privs = deterministic_validator_set(4)
+        block_id = make_block_id()
+        commit = make_commit(block_id, 9, 0, vs, privs, "chain")
+        block = make_block(10, [b"tx1", b"tx2"], commit, [])
+        block.header.validators_hash = vs.hash()
+        block.header.next_validators_hash = vs.hash()
+        block.header.consensus_hash = b"\x01" * 32
+        block.header.proposer_address = vs.validators[0].address
+        block.header.last_block_id = block_id
+        block.fill_header()
+        assert block.hash() is not None
+        block.validate_basic()
+        # roundtrip
+        b2 = Block.decode(block.encode())
+        assert b2.hash() == block.hash()
+        assert b2.data.txs == block.data.txs
+
+    def test_txs_hash_is_merkle_of_tx_hashes(self):
+        from cometbft_tpu.crypto import merkle
+        from cometbft_tpu.types.tx import Tx
+
+        txs = Txs([b"a", b"b"])
+        assert txs.hash() == merkle.hash_from_byte_slices(
+            [Tx(b"a").hash(), Tx(b"b").hash()]
+        )
+
+    def test_commit_to_vote_set_roundtrip(self):
+        from cometbft_tpu.types.block import commit_to_vote_set
+
+        vs, privs = deterministic_validator_set(4)
+        block_id = make_block_id()
+        commit = make_commit(block_id, 3, 0, vs, privs, "chain")
+        vote_set = commit_to_vote_set("chain", commit, vs)
+        maj, ok = vote_set.two_thirds_majority()
+        assert ok and maj == block_id
+
+
+class TestVoteSetSemantics:
+    """Reference-exact equivocation and commit-construction semantics
+    (vote_set.go addVerifiedVote / MakeCommit)."""
+
+    CHAIN = "vs_chain"
+
+    def _setup(self, n=4):
+        from cometbft_tpu.types.vote_set import VoteSet
+
+        vs, privs = deterministic_validator_set(n)
+        vset = VoteSet(self.CHAIN, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, vs)
+        return vs, privs, vset
+
+    def test_conflicting_vote_raises(self):
+        from cometbft_tpu.types.test_util import make_vote
+        from cometbft_tpu.types.vote_set import ErrVoteConflictingVotes
+
+        _, privs, vset = self._setup()
+        a = make_block_id(b"\x0a" * 32)
+        b = make_block_id(b"\x0b" * 32)
+        v1 = make_vote(privs[0], self.CHAIN, 0, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, a)
+        added, _ = vset.add_vote(v1)
+        assert added
+        v2 = make_vote(privs[0], self.CHAIN, 0, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, b)
+        with pytest.raises(ErrVoteConflictingVotes) as ei:
+            vset.add_vote(v2)
+        assert ei.value.added is False
+        assert ei.value.vote_a.block_id == a
+
+    def test_conflicting_vote_tracked_for_peer_maj23_still_raises(self):
+        from cometbft_tpu.types.test_util import make_vote
+        from cometbft_tpu.types.vote_set import ErrVoteConflictingVotes
+
+        _, privs, vset = self._setup()
+        a = make_block_id(b"\x0a" * 32)
+        b = make_block_id(b"\x0b" * 32)
+        v1 = make_vote(privs[0], self.CHAIN, 0, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, a)
+        vset.add_vote(v1)
+        vset.set_peer_maj23("peer1", b)
+        v2 = make_vote(privs[0], self.CHAIN, 0, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, b)
+        with pytest.raises(ErrVoteConflictingVotes) as ei:
+            vset.add_vote(v2)
+        # tracked under the peer-claimed block -> added=True, still an error
+        assert ei.value.added is True
+        assert vset.bit_array_by_block_id(b).get_index(0)
+
+    def test_non_deterministic_signature_rejected(self):
+        from cometbft_tpu.types.test_util import make_vote
+
+        _, privs, vset = self._setup()
+        a = make_block_id(b"\x0a" * 32)
+        v1 = make_vote(privs[0], self.CHAIN, 0, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, a)
+        vset.add_vote(v1)
+        # same vote content, different timestamp -> different signature
+        v2 = make_vote(
+            privs[0], self.CHAIN, 0, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, a,
+            timestamp=Timestamp(123, 0),
+        )
+        added, err = vset.add_vote(v2)
+        assert not added and "non-deterministic" in (err or "")
+        # identical vote -> plain duplicate
+        added, err = vset.add_vote(v1)
+        assert not added and err is None
+
+    def test_make_commit_excludes_other_block_sigs(self):
+        from cometbft_tpu.types.test_util import make_vote
+        from cometbft_tpu.types.vote_set import ErrVoteConflictingVotes
+
+        vs, privs, vset = self._setup(4)
+        a = make_block_id(b"\x0a" * 32)
+        b = make_block_id(b"\x0b" * 32)
+        # validator 3 votes for block B first
+        vset.add_vote(make_vote(privs[3], self.CHAIN, 3, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, b))
+        for i in range(3):
+            vset.add_vote(make_vote(privs[i], self.CHAIN, i, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, a))
+        maj, ok = vset.two_thirds_majority()
+        assert ok and maj == a
+        commit = vset.make_commit()
+        # validator 3's B-vote must be excluded (absent), not kept
+        assert commit.signatures[3].is_absent()
+        vs.verify_commit(self.CHAIN, a, 1, commit)
+
+    def test_conflicting_vote_for_maj23_replaces_master(self):
+        from cometbft_tpu.types.test_util import make_vote
+        from cometbft_tpu.types.vote_set import ErrVoteConflictingVotes
+
+        vs, privs, vset = self._setup(4)
+        a = make_block_id(b"\x0a" * 32)
+        b = make_block_id(b"\x0b" * 32)
+        # validator 3 votes B, then 3 validators reach maj23 on A
+        vset.add_vote(make_vote(privs[3], self.CHAIN, 3, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, b))
+        for i in range(3):
+            vset.add_vote(make_vote(privs[i], self.CHAIN, i, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, a))
+        # now validator 3 also votes A (the maj23 block): conflict error
+        # (added=False, vote_set.go:249 returns before by-block tracking) but
+        # the master list is replaced so MakeCommit includes their signature
+        with pytest.raises(ErrVoteConflictingVotes) as ei:
+            vset.add_vote(make_vote(privs[3], self.CHAIN, 3, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT, a))
+        assert ei.value.added is False
+        commit = vset.make_commit()
+        assert not commit.signatures[3].is_absent()
+        vs.verify_commit(self.CHAIN, a, 1, commit)
+
+
+class TestEvidenceHashable:
+    def test_evidence_set_semantics(self):
+        from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+
+        e1 = DuplicateVoteEvidence(total_voting_power=10)
+        e2 = DuplicateVoteEvidence(total_voting_power=10)
+        assert e1 == e2 and len({e1, e2}) == 1
