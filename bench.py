@@ -1,0 +1,77 @@
+"""Headline benchmark: batched Ed25519 verification throughput on TPU vs the
+reference's serial CPU path.
+
+The reference (dymensionxyz/cometbft) verifies every commit signature one at
+a time on one core (types/validator_set.go:685-707 → ed25519.go:148).
+Baseline here = that same serial loop on this host's CPU (OpenSSL-backed,
+the strongest single-core implementation available). Value = sigs/sec
+through the JAX batch kernel on the attached chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def _make_batch(n: int):
+    from cometbft_tpu.crypto import ed25519 as ed
+
+    rng = np.random.default_rng(42)
+    keys = [ed.gen_priv_key_from_secret(bytes([i & 0xFF, i >> 8])) for i in range(min(n, 128))]
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        k = keys[i % len(keys)]
+        m = rng.bytes(120)  # ~ a canonical vote's sign-bytes size
+        pks.append(k.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(k.sign(m))
+    return pks, msgs, sigs
+
+
+def bench_tpu(pks, msgs, sigs) -> float:
+    from cometbft_tpu.crypto.tpu import ed25519_batch
+
+    # warmup: compile + one full pass
+    out = ed25519_batch.verify_batch(pks, msgs, sigs)
+    assert all(out), "benchmark batch must verify"
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ed25519_batch.verify_batch(pks, msgs, sigs)
+        best = min(best, time.perf_counter() - t0)
+    return len(pks) / best
+
+
+def bench_cpu_serial(pks, msgs, sigs, n: int = 512) -> float:
+    from cometbft_tpu.crypto import ed25519 as ed
+
+    keys = [ed.PubKeyEd25519(pk) for pk in pks[:n]]
+    t0 = time.perf_counter()
+    for k, m, s in zip(keys, msgs[:n], sigs[:n]):
+        assert k.verify_signature(m, s)
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    batch = 2048
+    pks, msgs, sigs = _make_batch(batch)
+    cpu = bench_cpu_serial(pks, msgs, sigs)
+    tpu = bench_tpu(pks, msgs, sigs)
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_batch_verify_throughput",
+                "value": round(tpu, 1),
+                "unit": "sigs/sec",
+                "vs_baseline": round(tpu / cpu, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

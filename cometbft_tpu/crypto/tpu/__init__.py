@@ -1,0 +1,9 @@
+"""TPU crypto plane — batched kernels behind the crypto.batch boundary.
+
+The reference (dymensionxyz/cometbft) runs every signature check serially on
+CPU (types/validator_set.go:685-823, types/vote_set.go:205,
+blockchain/v0/reactor.go:366, light/verifier.go:58-126). This package is the
+TPU-native replacement: one SPMD tensor program verifies the whole batch.
+"""
+
+from cometbft_tpu.crypto.tpu import ed25519_batch, field  # noqa: F401

@@ -1,0 +1,225 @@
+"""GF(2^255-19) arithmetic on TPU-friendly limb vectors.
+
+Design notes (tpu-first, not a port — the reference delegates all field math
+to assembly in golang.org/x/crypto; there is no Go source to mirror):
+
+* A field element is ``int32[..., 17]`` — seventeen little-endian
+  radix-2^15 limbs in a *redundant signed* representation: limbs live in
+  [-4, 2^15 + 127] rather than strictly [0, 2^15). The slack is what makes
+  the representation SIMD-friendly: carries are resolved by 1-3
+  *vectorized* rounds over the whole limb axis (`_carry_round`) instead of
+  a sequential 17-step scan, so every op is a handful of wide [batch, 17]
+  VPU instructions. Exact bounds are proven per-op below; limb products
+  (2^15+127)^2 < 2^31 stay inside native int32 multiplies.
+* 17 × 15 = 255 bits exactly, so the carry out of the top limb has weight
+  2^255 ≡ 19 (mod p) — the cheapest possible fold.
+* All ops are batch-aware over leading dimensions: the whole point is to
+  verify thousands of signatures as one SPMD tensor program. The batch
+  dimension is explicit so pjit/shard_map can shard it over an ICI mesh.
+* Only `to_canonical` produces the unique representative mod p, and only
+  where encoding/comparison semantics require it (matching the ref10
+  fe_frombytes convention the CPU backend's OpenSSL inherits:
+  non-canonical encodings are reduced mod p, not rejected —
+  crypto/ed25519/ed25519.go:148 parity contract).
+* No data-dependent control flow: selections are jnp.where, loops are
+  lax.fori_loop with static trip counts — everything stays inside one XLA
+  computation.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+from jax import lax
+
+P = 2**255 - 19
+# group order of the prime-order subgroup
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+D2 = (2 * D) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)
+
+NUM_LIMBS = 17
+RADIX = 15
+_MASK = 0x7FFF
+
+
+def int_to_limbs(n: int) -> List[int]:
+    return [(n >> (RADIX * i)) & _MASK for i in range(NUM_LIMBS)]
+
+
+def limbs_to_int(limbs) -> int:
+    total = 0
+    for i, limb in enumerate(limbs):
+        total += int(limb) << (RADIX * i)
+    return total
+
+
+def const_fe(n: int) -> jnp.ndarray:
+    """A field-element constant (rank-1; broadcasts against any batch)."""
+    return jnp.array(int_to_limbs(n % P), jnp.int32)
+
+
+# 4p = 2^257 - 76 as signed radix-2^15 columns (2^257 = 2^17 · 2^(15·16)).
+_FOUR_P_COLS = jnp.zeros(NUM_LIMBS, jnp.int32).at[0].add(-76).at[16].add(0x20000)
+_P_LIMBS = jnp.array(int_to_limbs(P), jnp.int32)
+
+
+def _carry_round(x: jnp.ndarray) -> jnp.ndarray:
+    """One vectorized carry round: each limb keeps its low 15 bits and
+    passes the (signed, arithmetic-shift) carry one limb up; the top carry
+    wraps to limb 0 multiplied by 19 (2^255 ≡ 19). Value-preserving mod p.
+    """
+    c = x >> RADIX
+    return (x & _MASK) + jnp.concatenate(
+        [19 * c[..., NUM_LIMBS - 1 :], c[..., : NUM_LIMBS - 1]], axis=-1
+    )
+
+
+def _reduce(cols: jnp.ndarray) -> jnp.ndarray:
+    """Signed columns with |col| < 2^25 → invariant representation.
+
+    Round 1: carries ≤ 2^10, limbs ≤ 2^15 + 2^10, limb0 ≤ 2^15 + 19·2^10
+    (< 46340, safe: never multiplied before round 2 tightens it).
+    Round 2: carries ≤ 1, limbs ≤ 2^15, limb0 ≤ 2^15 + 19 — inside the
+    [-4, 2^15+127] invariant. Limbs ≥ -1 throughout (carries ≥ -1).
+    """
+    return _carry_round(_carry_round(cols))
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    # inputs ≤ 2^15+127 → sum ≤ 2^16+254, carries ≤ 2; one round suffices:
+    # limbs ≤ 2^15-1+2, limb0 ≤ 2^15+37. Inputs ≥ -4 → limbs ≥ -1.
+    return _carry_round(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    # a - b + 4p keeps the value non-negative for any invariant a, b.
+    # Columns ∈ [-2^15-131, 2^17+2^15+131]: carries ∈ [-1, 5], so limbs
+    # ≥ -1 and limb0 ≤ 2^15-1+19·5 = 2^15+94 — inside the invariant.
+    return _carry_round(a - b + _FOUR_P_COLS)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _carry_round(_FOUR_P_COLS - a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook 17×17-limb multiply, all in native int32 lanes.
+
+    Limb products ≤ (2^15+127)^2 < 2^31 are exact in int32. Each product
+    splits into a 15-bit low part and a signed high part before column
+    accumulation, keeping columns ≤ 34·(2^15+2^8) < 2^21; the fold of
+    columns 17..33 (weight 2^255 ≡ 19) brings them to < 2^25 — the
+    _reduce precondition.
+    """
+    prod = a[..., :, None] * b[..., None, :]  # [..., 17, 17]
+    lo = prod & _MASK
+    hi = prod >> RADIX
+    batch = prod.shape[:-2]
+    width = 2 * NUM_LIMBS  # 34 columns: lo_i spans i..i+16, hi_i spans i+1..i+17
+    rows = []
+    pad_cfg = [(0, 0)] * len(batch)
+    for i in range(NUM_LIMBS):
+        rows.append(jnp.pad(lo[..., i, :], pad_cfg + [(i, width - NUM_LIMBS - i)]))
+        rows.append(jnp.pad(hi[..., i, :], pad_cfg + [(i + 1, width - NUM_LIMBS - i - 1)]))
+    cols = jnp.sum(jnp.stack(rows, axis=-2), axis=-2)
+    folded = cols[..., :NUM_LIMBS] + 19 * cols[..., NUM_LIMBS:]
+    return _reduce(folded)
+
+
+def sq(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_small(a: jnp.ndarray, c: int) -> jnp.ndarray:
+    """Multiply by a small constant (|c| ≤ 16)."""
+    return _reduce(a * c)
+
+
+def _carry_seq(x: jnp.ndarray):
+    """Exact sequential carry pass (only used by to_canonical — the rare
+    encode/compare path). Returns (limbs in [0, 2^15), carry_out)."""
+    out = []
+    carry = jnp.zeros(x.shape[:-1], jnp.int32)
+    for i in range(NUM_LIMBS):
+        t = x[..., i] + carry
+        out.append(t & _MASK)
+        carry = t >> RADIX
+    return jnp.stack(out, axis=-1), carry
+
+
+def to_canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Invariant fe (value in [0, ~2^255.01)) → unique representative in [0, p)."""
+    # Two fold+propagate iterations: first brings value < 2^255 + 19,
+    # second < 2^255 (the +19 can set bit 255 only for values < 2^255+19).
+    for _ in range(2):
+        x, c = _carry_seq(x)
+        x = x.at[..., 0].add(19 * c)
+        x, _ = _carry_seq(x)
+    # Conditionally subtract p (value < 2^255 < 2p ⇒ at most once).
+    diff, borrow = _carry_seq(x - _P_LIMBS)
+    return jnp.where((borrow == 0)[..., None], diff, x)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Constant-shape equality in the field → bool[batch]."""
+    return jnp.all(to_canonical(a) == to_canonical(b), axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(to_canonical(a) == 0, axis=-1)
+
+
+def select(pred: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """pred: bool[batch] → element-wise fe select (a where pred)."""
+    return jnp.where(pred[..., None], a, b)
+
+
+def _exp_bits(e: int) -> jnp.ndarray:
+    bits = [int(b) for b in bin(e)[2:]]  # MSB first
+    return jnp.array(bits, jnp.int32)
+
+
+_INV_BITS = _exp_bits(P - 2)
+_P58_BITS = _exp_bits((P - 5) // 8)
+
+
+def _pow_bits(x: jnp.ndarray, bits: jnp.ndarray) -> jnp.ndarray:
+    """Square-and-multiply with a static-length constant exponent.
+
+    Runs as a fori_loop so the (large) exponent chain compiles to one
+    rolled body; the conditional multiply is a where-select, keeping the
+    program free of data-dependent branching.
+    """
+    one = const_fe(1)
+    acc0 = jnp.broadcast_to(one, x.shape)
+
+    def body(i, acc):
+        acc = mul(acc, acc)
+        return jnp.where(bits[i] == 1, mul(acc, x), acc)
+
+    return lax.fori_loop(0, bits.shape[0], body, acc0)
+
+
+def invert(x: jnp.ndarray) -> jnp.ndarray:
+    """x^(p-2). invert(0) = 0 (harmless: used only on Z ≠ 0)."""
+    return _pow_bits(x, _INV_BITS)
+
+
+def pow_p58(x: jnp.ndarray) -> jnp.ndarray:
+    """x^((p-5)/8) — the square-root-ratio exponent for decompression."""
+    return _pow_bits(x, _P58_BITS)
+
+
+def bytes_to_limbs_np(data):
+    """numpy uint8[..., 32] → int32[..., 17] limbs of the low 255 bits
+    (bit 255 — the ed25519 sign bit — is excluded; handle it separately)."""
+    import numpy as np
+
+    b = np.asarray(data, dtype=np.uint8)
+    bits = np.unpackbits(b, axis=-1, bitorder="little")[..., : NUM_LIMBS * RADIX]
+    weights = (1 << np.arange(RADIX, dtype=np.int32)).astype(np.int32)
+    shaped = bits.reshape(b.shape[:-1] + (NUM_LIMBS, RADIX)).astype(np.int32)
+    return shaped @ weights

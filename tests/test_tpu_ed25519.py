@@ -1,0 +1,202 @@
+"""TPU batched ed25519 — bit-identical parity with the CPU verifier.
+
+The north-star contract (BASELINE.json): accept/reject from the JAX batch
+kernel must match the serial CPU path (crypto/ed25519/ed25519.go:148
+semantics) on valid, corrupted, and adversarial edge-case signatures.
+Runs on the virtual 8-device CPU mesh (conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import batch as cbatch
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto.tpu import ed25519_batch, field as fe
+
+
+def _cpu_verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    return ed.PubKeyEd25519(pk).verify_signature(msg, sig)
+
+
+def _assert_parity(pks, msgs, sigs):
+    got = ed25519_batch.verify_batch(pks, msgs, sigs)
+    want = [_cpu_verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+    assert got == want, f"mismatch: tpu={got} cpu={want}"
+    return got
+
+
+class TestField:
+    def test_roundtrip_and_ops(self):
+        rng = np.random.default_rng(7)
+        import jax.numpy as jnp
+
+        for _ in range(20):
+            a = int(rng.integers(0, 2**63)) * int(rng.integers(0, 2**63)) % fe.P
+            b = int(rng.integers(0, 2**63)) ** 3 % fe.P
+            fa = jnp.array([fe.int_to_limbs(a)], jnp.int32)
+            fb = jnp.array([fe.int_to_limbs(b)], jnp.int32)
+            assert fe.limbs_to_int(np.asarray(fe.to_canonical(fe.add(fa, fb)))[0]) == (a + b) % fe.P
+            assert fe.limbs_to_int(np.asarray(fe.to_canonical(fe.sub(fa, fb)))[0]) == (a - b) % fe.P
+            assert fe.limbs_to_int(np.asarray(fe.to_canonical(fe.mul(fa, fb)))[0]) == (a * b) % fe.P
+
+    def test_invert(self):
+        import jax.numpy as jnp
+
+        a = 0xDEADBEEFCAFEBABE1234567890ABCDEF
+        fa = jnp.array([fe.int_to_limbs(a)], jnp.int32)
+        inv = fe.limbs_to_int(np.asarray(fe.to_canonical(fe.invert(fa)))[0])
+        assert a * inv % fe.P == 1
+
+    def test_weak_input_canonicalized(self):
+        import jax.numpy as jnp
+
+        # value p + 5 in limbs (non-canonical but weakly reduced)
+        fa = jnp.array([fe.int_to_limbs(fe.P + 5)], jnp.int32)
+        assert fe.limbs_to_int(np.asarray(fe.to_canonical(fa))[0]) == 5
+
+
+class TestVerifyBatchParity:
+    def test_valid_signatures(self):
+        keys = [ed.gen_priv_key_from_secret(bytes([i])) for i in range(8)]
+        msgs = [b"vote %d" % i for i in range(8)]
+        sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+        pks = [k.pub_key().bytes() for k in keys]
+        got = _assert_parity(pks, msgs, sigs)
+        assert all(got)
+
+    def test_corrupted_signature_rejected(self):
+        k = ed.gen_priv_key_from_secret(b"x")
+        msg = b"block part"
+        sig = bytearray(k.sign(msg))
+        pks, msgs, sigs = [], [], []
+        # flip a bit in R, in S, and in the message
+        for variant in range(3):
+            s = bytearray(sig)
+            m = msg
+            if variant == 0:
+                s[0] ^= 1
+            elif variant == 1:
+                s[40] ^= 0x80
+            else:
+                m = b"other msg"
+            pks.append(k.pub_key().bytes())
+            msgs.append(m)
+            sigs.append(bytes(s))
+        got = _assert_parity(pks, msgs, sigs)
+        assert not any(got)
+
+    def test_wrong_pubkey_rejected(self):
+        k1 = ed.gen_priv_key_from_secret(b"a")
+        k2 = ed.gen_priv_key_from_secret(b"b")
+        msg = b"proposal"
+        got = _assert_parity([k2.pub_key().bytes()], [msg], [k1.sign(msg)])
+        assert got == [False]
+
+    def test_noncanonical_s_rejected(self):
+        k = ed.gen_priv_key_from_secret(b"s")
+        msg = b"m"
+        sig = bytearray(k.sign(msg))
+        s_int = int.from_bytes(sig[32:], "little") + fe.L
+        sig[32:] = s_int.to_bytes(32, "little")
+        got = _assert_parity([k.pub_key().bytes()], [msg], [bytes(sig)])
+        assert got == [False]
+
+    def test_mixed_batch(self):
+        rng = np.random.default_rng(3)
+        pks, msgs, sigs, expect = [], [], [], []
+        for i in range(33):  # odd size → exercises padding
+            k = ed.gen_priv_key_from_secret(bytes([i, 1]))
+            m = rng.bytes(rng.integers(0, 200))
+            s = bytearray(k.sign(m))
+            good = i % 3 != 0
+            if not good:
+                s[rng.integers(0, 64)] ^= 1 << rng.integers(0, 8)
+            pks.append(k.pub_key().bytes())
+            msgs.append(bytes(m))
+            sigs.append(bytes(s))
+            expect.append(good)
+        got = _assert_parity(pks, msgs, sigs)
+        # corrupt sigs could theoretically still verify; parity is the real
+        # assertion — but sanity-check the good ones accepted
+        for i, e in enumerate(expect):
+            if e:
+                assert got[i]
+
+    def test_garbage_pubkey(self):
+        # all-0xff y is not on the curve → decompression failure path
+        pks = [b"\xff" * 32, b"\x00" * 32]
+        msgs = [b"m1", b"m2"]
+        k = ed.gen_priv_key_from_secret(b"g")
+        sigs = [k.sign(b"m1"), k.sign(b"m2")]
+        _assert_parity(pks, msgs, sigs)
+
+    def test_identity_pubkey_parity(self):
+        # A = neutral element (y=1, x=0): [h]A vanishes, check degenerates
+        # to [s]B == R. Craft an "accepting" signature without any secret:
+        # pick s, set R = encode([s]B). Parity with OpenSSL matters most.
+        import jax.numpy as jnp
+
+        ident_pk = (1).to_bytes(32, "little")
+        s = 12345
+        s_bytes = s.to_bytes(32, "little")
+        # compute [s]B via the kernel's own point ops on host python ints
+        bx, by = ed25519_batch._BX, ed25519_batch._BY
+
+        def edwards_add(p, q):
+            (x1, y1), (x2, y2) = p, q
+            den = fe.D * x1 * x2 * y1 * y2 % fe.P
+            x3 = (x1 * y2 + x2 * y1) * pow(1 + den, fe.P - 2, fe.P) % fe.P
+            y3 = (y1 * y2 + x1 * x2) * pow(1 - den, fe.P - 2, fe.P) % fe.P
+            return (x3, y3)
+
+        acc = (0, 1)
+        base = (bx, by)
+        for bit in bin(s)[2:]:
+            acc = edwards_add(acc, acc)
+            if bit == "1":
+                acc = edwards_add(acc, base)
+        r_enc = bytearray(acc[1].to_bytes(32, "little"))
+        r_enc[31] |= (acc[0] & 1) << 7
+        sig = bytes(r_enc) + s_bytes
+        _assert_parity([ident_pk], [b"any message"], [sig])
+
+    def test_wrong_length_inputs(self):
+        k = ed.gen_priv_key_from_secret(b"l")
+        got = ed25519_batch.verify_batch(
+            [k.pub_key().bytes()], [b"m"], [b"\x01" * 63]
+        )
+        assert got == [False]
+
+    def test_empty_batch(self):
+        assert ed25519_batch.verify_batch([], [], []) == []
+
+
+class TestTPUBatchVerifier:
+    def test_backend_routing(self):
+        bv = cbatch.new_batch_verifier("tpu")
+        keys = [ed.gen_priv_key_from_secret(bytes([i, 9])) for i in range(5)]
+        for i, k in enumerate(keys):
+            msg = b"height %d" % i
+            sig = k.sign(msg) if i != 2 else b"\x00" * 64
+            bv.add(k.pub_key(), msg, sig)
+        ok, mask = bv.verify()
+        assert not ok
+        assert mask == [True, True, False, True, True]
+        assert bv.count() == 0
+
+    def test_matches_cpu_backend(self):
+        keys = [ed.gen_priv_key_from_secret(bytes([i, 7])) for i in range(6)]
+        entries = []
+        for i, k in enumerate(keys):
+            msg = b"commit sig %d" % i
+            sig = bytearray(k.sign(msg))
+            if i % 2:
+                sig[10] ^= 4
+            entries.append((k.pub_key(), msg, bytes(sig)))
+        results = []
+        for backend in ("cpu", "tpu"):
+            bv = cbatch.new_batch_verifier(backend)
+            for pk, msg, sig in entries:
+                bv.add(pk, msg, sig)
+            results.append(bv.verify())
+        assert results[0] == results[1]
