@@ -79,7 +79,12 @@ class Timestamp:
         """RFC3339Nano, the reference's CanonicalTime format
         (types/canonical.go:68)."""
         dt = self.to_datetime()
-        base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+        # strftime %Y does not zero-pad years < 1000 on glibc; Go's
+        # RFC3339Nano prints 4 digits ("0001-01-01..." for the zero time)
+        base = (
+            f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d}"
+            f"T{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}"
+        )
         if self.nanos:
             frac = f"{self.nanos:09d}".rstrip("0")
             return f"{base}.{frac}Z"

@@ -434,6 +434,16 @@ class Header:
         )
 
     def validate_basic(self) -> None:
+        """Reference: Header.ValidateBasic (types/block.go:378-432). Every
+        hash field uses ValidateHash semantics: empty OR exactly 32 bytes
+        (types/validation.go:32-40)."""
+        from cometbft_tpu.version import BLOCK_PROTOCOL
+
+        if self.version.block != BLOCK_PROTOCOL:
+            raise ValueError(
+                f"block protocol is incorrect: got {self.version.block}, "
+                f"want {BLOCK_PROTOCOL}"
+            )
         if len(self.chain_id) > 50:
             raise ValueError("chainID too long")
         if self.height < 0:
@@ -445,17 +455,14 @@ class Header:
             ("LastCommitHash", self.last_commit_hash),
             ("DataHash", self.data_hash),
             ("EvidenceHash", self.evidence_hash),
+            ("ValidatorsHash", self.validators_hash),
+            ("NextValidatorsHash", self.next_validators_hash),
+            ("ConsensusHash", self.consensus_hash),
+            ("LastResultsHash", self.last_results_hash),
         ]:
             if h and len(h) != tmhash.SIZE:
                 raise ValueError(f"wrong {name} size")
-        if len(self.validators_hash) != tmhash.SIZE:
-            raise ValueError("wrong ValidatorsHash size")
-        if len(self.next_validators_hash) != tmhash.SIZE:
-            raise ValueError("wrong NextValidatorsHash size")
-        if len(self.consensus_hash) != tmhash.SIZE:
-            raise ValueError("wrong ConsensusHash size")
-        if len(self.last_results_hash) and len(self.last_results_hash) != tmhash.SIZE:
-            raise ValueError("wrong LastResultsHash size")
+        # NOTE: AppHash is arbitrary length
         if len(self.proposer_address) != 20:
             raise ValueError("invalid ProposerAddress length")
 
@@ -597,13 +604,21 @@ class BlockMeta:
 def make_block(
     height: int, txs, last_commit: Commit, evidence: list
 ) -> Block:
-    """Reference: types/block.go MakeBlock."""
-    return Block(
-        header=Header(height=height),
+    """Reference: types/test_util.go:87-101 MakeBlock — sets
+    Version.Block = BlockProtocol and fills derived header hashes."""
+    from cometbft_tpu.version import BLOCK_PROTOCOL
+
+    block = Block(
+        header=Header(
+            version=ConsensusVersion(block=BLOCK_PROTOCOL, app=0),
+            height=height,
+        ),
         data=Data(txs=Txs(txs)),
         evidence=list(evidence),
         last_commit=last_commit,
     )
+    block.fill_header()
+    return block
 
 
 def commit_to_vote_set(chain_id: str, commit: Commit, vals) -> "object":

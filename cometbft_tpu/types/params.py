@@ -186,6 +186,8 @@ class ConsensusParams:
             raise ValueError("block.MaxBytes too big")
         if self.block.max_gas < -1:
             raise ValueError("block.MaxGas must be >= -1")
+        if self.block.time_iota_ms <= 0:
+            raise ValueError("block.TimeIotaMs must be greater than 0")
         if self.evidence.max_age_num_blocks <= 0:
             raise ValueError("evidence.MaxAgeNumBlocks must be greater than 0")
         if self.evidence.max_age_duration_ns <= 0:
@@ -227,4 +229,10 @@ class ConsensusParams:
         return res
 
 
-DEFAULT_CONSENSUS_PARAMS = ConsensusParams
+def default_consensus_params() -> ConsensusParams:
+    """Reference: types/params.go DefaultConsensusParams — a fresh value
+    each call (params are mutable per-height state)."""
+    return ConsensusParams()
+
+
+DEFAULT_CONSENSUS_PARAMS = default_consensus_params()

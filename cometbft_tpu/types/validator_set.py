@@ -193,9 +193,11 @@ class ValidatorSet:
             v.proposer_priority = _clip(v.proposer_priority - avg)
 
     def _compute_avg_proposer_priority(self) -> int:
-        # Go uses big.Int for the sum then divides (truncating)
+        # Go uses big.Int for the sum then big.Int.Div — *Euclidean*
+        # division (floor, for a positive divisor), unlike native int64
+        # `/` (validator_set.go:181-190). Python `//` floors: exact match.
         total = sum(v.proposer_priority for v in self.validators)
-        return _go_div(total, len(self.validators))
+        return total // len(self.validators)
 
     # -- updates (validator_set.go:365-660) --------------------------------
 

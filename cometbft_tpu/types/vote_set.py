@@ -193,9 +193,6 @@ class VoteSet:
             raise ErrVoteConflictingVotes(conflicting, vote, added=True)
         return True, None
 
-    def _peer_maj23_for(self, block_key: bytes) -> bool:
-        return any(b.key() == block_key for b in self._peer_maj23s.values())
-
     def _get_vote(self, val_index: int, block_key: bytes) -> Optional[Vote]:
         v = self._votes[val_index]
         if v is not None and v.block_id.key() == block_key:
