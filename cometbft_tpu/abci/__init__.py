@@ -1,0 +1,11 @@
+"""ABCI — the application boundary.
+
+Reference: abci/ (types, client, server, examples) + proxy/. The protocol
+is v0.34 ABCI (Info/CheckTx/BeginBlock/DeliverTx/EndBlock/Commit +
+snapshots) over an in-process client or a length-prefixed proto socket.
+This fork's proto additions (RollappParams, consensus_messages,
+genesis_checksum — proto/tendermint/abci/types.proto) are carried as
+optional fields for wire parity.
+"""
+
+from cometbft_tpu.abci import types  # noqa: F401

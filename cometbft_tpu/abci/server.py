@@ -1,0 +1,94 @@
+"""ABCI socket server — the app side of an out-of-process connection.
+
+Reference: abci/server/socket_server.go (listener + per-connection
+read/dispatch/write loop over length-prefixed proto frames).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Optional
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.application import Application, dispatch_request
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.libs.service import BaseService
+
+
+class SocketServer(BaseService):
+    def __init__(self, addr: str, app: Application):
+        super().__init__("ABCIServer")
+        self._addr = addr
+        self._app = app
+        self._app_mtx = threading.Lock()
+        self._listener: Optional[socket.socket] = None
+        self._conns = []
+
+    @property
+    def addr(self) -> str:
+        return self._addr
+
+    def on_start(self) -> None:
+        if self._addr.startswith("unix://"):
+            path = self._addr[len("unix://") :]
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._listener.bind(path)
+        else:
+            addr = self._addr
+            if addr.startswith("tcp://"):
+                addr = addr[len("tcp://") :]
+            host, _, port = addr.rpartition(":")
+            self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind((host or "127.0.0.1", int(port)))
+            if int(port) == 0:
+                self._addr = "tcp://%s:%d" % self._listener.getsockname()
+        self._listener.listen(16)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def on_stop(self) -> None:
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while self.is_running():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            self._conns.append(conn)
+            threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        rfile = conn.makefile("rb")
+        wfile = conn.makefile("wb")
+        while self.is_running():
+            try:
+                data = protoio.read_delimited(rfile)
+            except (OSError, EOFError, ValueError):
+                return
+            req = abci.Request.decode(data)
+            with self._app_mtx:
+                res = dispatch_request(self._app, req)
+            try:
+                protoio.write_delimited(wfile, res.encode())
+                if req.kind == "flush":
+                    wfile.flush()
+            except OSError:
+                return

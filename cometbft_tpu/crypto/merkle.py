@@ -191,6 +191,62 @@ class ProofOp:
     key: bytes
     data: bytes
 
+    def encode(self) -> bytes:
+        """proto crypto.ProofOp {string type=1, bytes key=2, bytes data=3}."""
+        from cometbft_tpu.libs import protoio
+
+        out = b""
+        if self.type:
+            out += protoio.field_string(1, self.type)
+        out += protoio.field_bytes(2, self.key)
+        out += protoio.field_bytes(3, self.data)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ProofOp":
+        from cometbft_tpu.libs import protoio
+
+        r = protoio.WireReader(data)
+        out = cls("", b"", b"")
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.type = r.read_string()
+            elif f == 2:
+                out.key = r.read_bytes()
+            elif f == 3:
+                out.data = r.read_bytes()
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class ProofOps:
+    """proto crypto.ProofOps {repeated ProofOp ops=1} — carried in ABCI
+    query responses (abci ResponseQuery.proof_ops)."""
+
+    ops: List[ProofOp] = field(default_factory=list)
+
+    def encode(self) -> bytes:
+        from cometbft_tpu.libs import protoio
+
+        return b"".join(protoio.field_message(1, op.encode()) for op in self.ops)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ProofOps":
+        from cometbft_tpu.libs import protoio
+
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.ops.append(ProofOp.decode(r.read_bytes()))
+            else:
+                r.skip(wt)
+        return out
+
 
 class ProofOperator:
     def run(self, leaves: List[bytes]) -> List[bytes]:

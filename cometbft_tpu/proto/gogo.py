@@ -53,6 +53,22 @@ class Timestamp:
         return cls.from_datetime(dt)
 
     @classmethod
+    def from_rfc3339(cls, s: str) -> "Timestamp":
+        """Inverse of to_rfc3339 (accepts fractional seconds up to ns)."""
+        if not s.endswith("Z"):
+            raise ValueError(f"expected UTC RFC3339 time, got {s!r}")
+        body = s[:-1]
+        nanos = 0
+        if "." in body:
+            body, frac = body.split(".", 1)
+            nanos = int(frac.ljust(9, "0")[:9])
+        dt = _dt.datetime.strptime(body, "%Y-%m-%dT%H:%M:%S").replace(
+            tzinfo=_dt.timezone.utc
+        )
+        ts = cls.from_datetime(dt)
+        return cls(ts.seconds, nanos)
+
+    @classmethod
     def from_datetime(cls, dt: _dt.datetime) -> "Timestamp":
         if dt.tzinfo is None:
             dt = dt.replace(tzinfo=_dt.timezone.utc)

@@ -1,0 +1,98 @@
+"""Block validation against state.
+
+Reference: state/validation.go:15-120 validateBlock — header wiring vs
+state, LastCommit verification (the full VerifyCommit at :93 — routed here
+through the batch-verification boundary via ValidatorSet.verify_commit),
+evidence size checks.
+"""
+
+from __future__ import annotations
+
+from cometbft_tpu.state import State
+from cometbft_tpu.types.block import Block
+
+
+def validate_block(state: State, block: Block) -> None:
+    """Raises ValueError on the first violation (error strings mirror the
+    reference's so tests can assert on them)."""
+    block.validate_basic()
+
+    h = block.header
+    if h.version.app != state.version.consensus_app or (
+        h.version.block != state.version.consensus_block
+    ):
+        raise ValueError(
+            f"wrong Block.Header.Version. Expected "
+            f"{state.version.consensus_block}, got {h.version.block}"
+        )
+    if h.chain_id != state.chain_id:
+        raise ValueError(
+            f"wrong Block.Header.ChainID. Expected {state.chain_id}, "
+            f"got {h.chain_id}"
+        )
+    if state.last_block_height == 0 and h.height != state.initial_height:
+        raise ValueError(
+            f"wrong Block.Header.Height. Expected {state.initial_height} "
+            f"for initial block, got {h.height}"
+        )
+    if state.last_block_height > 0 and h.height != state.last_block_height + 1:
+        raise ValueError(
+            f"wrong Block.Header.Height. Expected "
+            f"{state.last_block_height + 1}, got {h.height}"
+        )
+    if h.last_block_id != state.last_block_id:
+        raise ValueError(
+            f"wrong Block.Header.LastBlockID.  Expected {state.last_block_id}, "
+            f"got {h.last_block_id}"
+        )
+    if h.app_hash != state.app_hash:
+        raise ValueError(
+            f"wrong Block.Header.AppHash.  Expected "
+            f"{state.app_hash.hex().upper()}, got {h.app_hash.hex()}"
+        )
+    if h.consensus_hash != state.consensus_params.hash():
+        raise ValueError("wrong Block.Header.ConsensusHash")
+    if h.last_results_hash != state.last_results_hash:
+        raise ValueError("wrong Block.Header.LastResultsHash")
+    if h.validators_hash != state.validators.hash():
+        raise ValueError("wrong Block.Header.ValidatorsHash")
+    if h.next_validators_hash != state.next_validators.hash():
+        raise ValueError("wrong Block.Header.NextValidatorsHash")
+
+    # LastCommit
+    if block.header.height == state.initial_height:
+        if len(block.last_commit.signatures) != 0:
+            raise ValueError("initial block can't have LastCommit signatures")
+    else:
+        if len(block.last_commit.signatures) != len(state.last_validators.validators):
+            raise ValueError(
+                f"invalid block commit size. Expected "
+                f"{len(state.last_validators.validators)}, got "
+                f"{len(block.last_commit.signatures)}"
+            )
+        # the hot VerifyCommit (state/validation.go:93) — batch boundary
+        state.last_validators.verify_commit(
+            state.chain_id,
+            state.last_block_id,
+            block.header.height - 1,
+            block.last_commit,
+        )
+
+    # Evidence: the limit applies to the EvidenceData proto size including
+    # repeated-field framing (state/validation.go:146 Evidence.ByteSize())
+    from cometbft_tpu.types.evidence import encode_evidence_list
+
+    max_bytes = state.consensus_params.evidence.max_bytes
+    got = len(encode_evidence_list(block.evidence))
+    if got > max_bytes:
+        raise ValueError(
+            f"evidence in block exceeds maximum size ({got} > {max_bytes})"
+        )
+
+    if len(h.proposer_address) != 20 or not state.validators.has_address(
+        h.proposer_address
+    ):
+        raise ValueError(
+            f"block proposer is not in the validator set "
+            f"({h.proposer_address.hex()})"
+        )

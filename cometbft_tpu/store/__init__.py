@@ -1,0 +1,3 @@
+"""store — the block store."""
+
+from cometbft_tpu.store.block_store import BlockStore  # noqa: F401

@@ -210,3 +210,9 @@ def decode_evidence_list(data: bytes) -> List[Evidence]:
 def evidence_list_hash(evs: List[Evidence]) -> bytes:
     """Merkle root over evidence bytes (types/evidence.go EvidenceList.Hash)."""
     return merkle.hash_from_byte_slices([ev.bytes() for ev in evs])
+
+
+def evidence_size(ev: Evidence) -> int:
+    """Proto wire size of one evidence message (reference: evidence sizing
+    in state/validation.go and types MaxEvidenceBytes accounting)."""
+    return len(encode_evidence(ev))

@@ -229,6 +229,74 @@ class ConsensusParams:
         return res
 
 
+def _params_to_json(p: ConsensusParams) -> dict:
+    """Genesis-file JSON form (int64s as strings, amino-style)."""
+    return {
+        "block": {
+            "max_bytes": str(p.block.max_bytes),
+            "max_gas": str(p.block.max_gas),
+            "time_iota_ms": str(p.block.time_iota_ms),
+        },
+        "evidence": {
+            "max_age_num_blocks": str(p.evidence.max_age_num_blocks),
+            "max_age_duration": str(p.evidence.max_age_duration_ns),
+            "max_bytes": str(p.evidence.max_bytes),
+        },
+        "validator": {"pub_key_types": list(p.validator.pub_key_types)},
+        "version": (
+            {"app_version": str(p.version.app_version)}
+            if p.version.app_version
+            else {}
+        ),
+    }
+
+
+def _params_from_json(obj: dict) -> ConsensusParams:
+    p = ConsensusParams()
+    b = obj.get("block") or {}
+    p.block = BlockParams(
+        max_bytes=int(b.get("max_bytes", p.block.max_bytes)),
+        max_gas=int(b.get("max_gas", p.block.max_gas)),
+        time_iota_ms=int(b.get("time_iota_ms", p.block.time_iota_ms)),
+    )
+    e = obj.get("evidence") or {}
+    p.evidence = EvidenceParams(
+        max_age_num_blocks=int(
+            e.get("max_age_num_blocks", p.evidence.max_age_num_blocks)
+        ),
+        max_age_duration_ns=int(
+            e.get("max_age_duration", p.evidence.max_age_duration_ns)
+        ),
+        max_bytes=int(e.get("max_bytes", p.evidence.max_bytes)),
+    )
+    v = obj.get("validator") or {}
+    if v.get("pub_key_types"):
+        p.validator = ValidatorParams(list(v["pub_key_types"]))
+    ver = obj.get("version") or {}
+    if ver.get("app_version"):
+        p.version = VersionParams(int(ver["app_version"]))
+    return p
+
+
+def _params_empty() -> "ConsensusParams":
+    """All-zero params — the 'not persisted at this height' sentinel used
+    by the state store's back-pointer scheme (state/store.go:265)."""
+    return ConsensusParams(
+        BlockParams(0, 0, 0), EvidenceParams(0, 0, 0), ValidatorParams([]),
+        VersionParams(0),
+    )
+
+
+def _params_is_empty(p: "ConsensusParams") -> bool:
+    return p == _params_empty()
+
+
+ConsensusParams.to_json = _params_to_json
+ConsensusParams.from_json = staticmethod(_params_from_json)
+ConsensusParams.empty = staticmethod(_params_empty)
+ConsensusParams.is_empty = _params_is_empty
+
+
 def default_consensus_params() -> ConsensusParams:
     """Reference: types/params.go DefaultConsensusParams — a fresh value
     each call (params are mutable per-height state)."""

@@ -1,0 +1,196 @@
+"""ABCI layer: message codecs, local + socket clients, kvstore apps.
+
+Modeled on the reference's abci tests (abci/tests, example tests) —
+envelope roundtrips, app semantics, and the socket transport end-to-end.
+"""
+
+import base64
+import os
+import tempfile
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import LocalClient, SocketClient
+from cometbft_tpu.abci.kvstore import (
+    KVStoreApplication,
+    PersistentKVStoreApplication,
+)
+from cometbft_tpu.abci.server import SocketServer
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.proto.keys import PublicKeyProto
+
+
+class TestCodecs:
+    def test_request_envelope_roundtrip_all_kinds(self):
+        samples = {
+            "echo": abci.RequestEcho("hi"),
+            "flush": abci.RequestFlush(),
+            "info": abci.RequestInfo("v1", 11, 8),
+            "set_option": abci.RequestSetOption("k", "v"),
+            "init_chain": abci.RequestInitChain(chain_id="c", initial_height=5),
+            "query": abci.RequestQuery(data=b"q", path="/p", height=3, prove=True),
+            "check_tx": abci.RequestCheckTx(tx=b"t", type=abci.CHECK_TX_TYPE_RECHECK),
+            "deliver_tx": abci.RequestDeliverTx(tx=b"x"),
+            "end_block": abci.RequestEndBlock(height=9),
+            "commit": abci.RequestCommit(),
+            "list_snapshots": abci.RequestListSnapshots(),
+            "offer_snapshot": abci.RequestOfferSnapshot(
+                snapshot=abci.Snapshot(1, 2, 3, b"h", b"m"), app_hash=b"a"
+            ),
+            "load_snapshot_chunk": abci.RequestLoadSnapshotChunk(1, 2, 3),
+            "apply_snapshot_chunk": abci.RequestApplySnapshotChunk(1, b"c", "s"),
+        }
+        for kind, msg in samples.items():
+            env = abci.Request(kind, msg)
+            dec = abci.Request.decode(env.encode())
+            assert dec.kind == kind
+            assert dec.value == msg, kind
+
+    def test_response_envelope_roundtrip(self):
+        samples = {
+            "exception": abci.ResponseException("boom"),
+            "info": abci.ResponseInfo("d", "v", 1, 10, b"hash"),
+            "check_tx": abci.ResponseCheckTx(code=1, gas_wanted=5, priority=7),
+            "deliver_tx": abci.ResponseDeliverTx(
+                code=0,
+                data=b"d",
+                events=[
+                    abci.Event("e", [abci.EventAttribute(b"k", b"v", True)])
+                ],
+            ),
+            "end_block": abci.ResponseEndBlock(
+                validator_updates=[
+                    abci.ValidatorUpdate(PublicKeyProto("ed25519", b"\x01" * 32), 7)
+                ]
+            ),
+            "commit": abci.ResponseCommit(data=b"apphash", retain_height=3),
+        }
+        for kind, msg in samples.items():
+            dec = abci.Response.decode(abci.Response(kind, msg).encode())
+            assert dec.kind == kind and dec.value == msg, kind
+
+    def test_fork_extension_fields(self):
+        r = abci.ResponseInitChain(
+            app_hash=b"h",
+            rollapp_params=abci.RollappParams(da="celestia", drs_version=2),
+            genesis_bridge_data_bytes=b"gb",
+        )
+        dec = abci.ResponseInitChain.decode(r.encode())
+        assert dec.rollapp_params == abci.RollappParams("celestia", 2)
+        assert dec.genesis_bridge_data_bytes == b"gb"
+        q = abci.RequestInitChain(chain_id="c", genesis_checksum="abc123")
+        assert abci.RequestInitChain.decode(q.encode()).genesis_checksum == "abc123"
+
+
+class TestKVStore:
+    def test_deliver_commit_query(self):
+        app = KVStoreApplication()
+        assert app.deliver_tx(abci.RequestDeliverTx(b"name=satoshi")).is_ok()
+        res = app.commit()
+        assert len(res.data) == 8
+        q = app.query(abci.RequestQuery(data=b"name"))
+        assert q.value == b"satoshi" and q.log == "exists"
+        q2 = app.query(abci.RequestQuery(data=b"missing"))
+        assert q2.value == b"" and q2.log == "does not exist"
+        info = app.info(abci.RequestInfo())
+        assert info.last_block_height == 1
+        assert info.last_block_app_hash == res.data
+
+    def test_raw_tx_uses_tx_as_key_and_value(self):
+        app = KVStoreApplication()
+        app.deliver_tx(abci.RequestDeliverTx(b"solo"))
+        assert app.query(abci.RequestQuery(data=b"solo")).value == b"solo"
+
+    def test_persistent_validator_updates(self):
+        app = PersistentKVStoreApplication()
+        pk = ed25519.gen_priv_key_from_secret(b"v1").pub_key()
+        b64 = base64.b64encode(pk.bytes()).decode()
+        tx = PersistentKVStoreApplication.make_val_set_change_tx(b64, 10)
+        app.begin_block(abci.RequestBeginBlock())
+        assert app.deliver_tx(abci.RequestDeliverTx(tx)).is_ok()
+        updates = app.end_block(abci.RequestEndBlock(height=1)).validator_updates
+        assert len(updates) == 1 and updates[0].power == 10
+        assert len(app.validators()) == 1
+        # remove
+        app.begin_block(abci.RequestBeginBlock())
+        tx0 = PersistentKVStoreApplication.make_val_set_change_tx(b64, 0)
+        assert app.deliver_tx(abci.RequestDeliverTx(tx0)).is_ok()
+        assert len(app.validators()) == 0
+
+    def test_bad_validator_tx(self):
+        app = PersistentKVStoreApplication()
+        res = app.deliver_tx(abci.RequestDeliverTx(b"val:garbage-no-bang"))
+        assert not res.is_ok()
+
+
+class TestLocalClient:
+    def test_sync_calls(self):
+        c = LocalClient(KVStoreApplication())
+        c.start()
+        try:
+            assert c.echo_sync("ping").message == "ping"
+            assert c.deliver_tx_sync(abci.RequestDeliverTx(b"a=b")).is_ok()
+            assert len(c.commit_sync().data) == 8
+        finally:
+            c.stop()
+
+    def test_async_callback(self):
+        c = LocalClient(KVStoreApplication())
+        c.start()
+        got = []
+        rr = c.check_tx_async(abci.RequestCheckTx(tx=b"t"))
+        rr.set_callback(lambda res: got.append(res.kind))
+        assert got == ["check_tx"]
+        c.stop()
+
+
+class TestSocketTransport:
+    def test_end_to_end_over_unix_socket(self):
+        with tempfile.TemporaryDirectory() as d:
+            addr = f"unix://{os.path.join(d, 'abci.sock')}"
+            server = SocketServer(addr, KVStoreApplication())
+            server.start()
+            client = SocketClient(addr)
+            client.start()
+            try:
+                assert client.echo_sync("hello").message == "hello"
+                info = client.info_sync(abci.RequestInfo(version="x"))
+                assert info.last_block_height == 0
+                # pipelined delivers + flush
+                rrs = [
+                    client.deliver_tx_async(
+                        abci.RequestDeliverTx(b"k%d=v%d" % (i, i))
+                    )
+                    for i in range(10)
+                ]
+                client.flush_sync()
+                for rr in rrs:
+                    assert rr.wait(5).value.is_ok()
+                commit = client.commit_sync()
+                assert len(commit.data) == 8
+                q = client.query_sync(abci.RequestQuery(data=b"k3"))
+                assert q.value == b"v3"
+            finally:
+                client.stop()
+                server.stop()
+
+    def test_exception_response(self):
+        class BoomApp(KVStoreApplication):
+            def query(self, req):
+                raise RuntimeError("kaboom")
+
+        with tempfile.TemporaryDirectory() as d:
+            addr = f"unix://{os.path.join(d, 'abci.sock')}"
+            server = SocketServer(addr, BoomApp())
+            server.start()
+            client = SocketClient(addr)
+            client.start()
+            try:
+                from cometbft_tpu.abci.client import ClientError
+
+                with pytest.raises(ClientError, match="kaboom"):
+                    client.query_sync(abci.RequestQuery(data=b"x"))
+            finally:
+                client.stop()
+                server.stop()

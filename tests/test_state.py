@@ -1,0 +1,264 @@
+"""State layer: genesis, state store, block store, block executor.
+
+Modeled on the reference's state package tests (state/state_test.go,
+state/execution_test.go, store tests) — multi-height apply loop against
+the kvstore app, validator-set persistence back-pointers, pruning.
+"""
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.proxy import AppConnConsensus
+from cometbft_tpu.state import State, StateVersion, make_genesis_state, median_time
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.store import ABCIResponses, Store
+from cometbft_tpu.state.validation import validate_block
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.types import test_util
+from cometbft_tpu.types.block import BlockID, Commit
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.event_bus import (
+    EVENT_QUERY_NEW_BLOCK,
+    EVENT_QUERY_TX,
+    EventBus,
+)
+
+
+def _genesis_doc(n=4, power=10):
+    vals, privs = test_util.deterministic_validator_set(n, power)
+    gvs = [
+        GenesisValidator(v.address, v.pub_key, v.voting_power, f"v{i}")
+        for i, v in enumerate(vals.validators)
+    ]
+    doc = GenesisDoc(
+        genesis_time=Timestamp(1_700_000_000, 0),
+        chain_id="exec-chain",
+        validators=gvs,
+    )
+    return doc, vals, privs
+
+
+def _make_executor(event_bus=None):
+    doc, vals, privs = _genesis_doc()
+    state = make_genesis_state(doc)
+    store = Store(MemDB())
+    store.save(state)
+    client = LocalClient(KVStoreApplication())
+    client.start()
+    executor = BlockExecutor(
+        store, AppConnConsensus(client), event_bus=event_bus
+    )
+    return executor, state, privs, store
+
+
+def _apply_n_blocks(executor, state, privs, n, txs_fn=None):
+    last_commit = Commit(0, 0, BlockID(), [])
+    for h in range(1, n + 1):
+        proposer = state.validators.proposer.address
+        block, parts = executor.create_proposal_block(
+            h, state, last_commit, proposer
+        )
+        if txs_fn:
+            from cometbft_tpu.types.tx import Txs
+
+            block.data.txs = Txs(txs_fn(h))
+            block.header.data_hash = b""
+            block.fill_header()
+        # recompute hash after any data change
+        block._hash = None
+        block_id = BlockID(block.hash(), parts.header())
+        state, _ = executor.apply_block(state, block_id, block)
+        last_commit = test_util.make_commit(
+            block_id, h, 0, state.last_validators, privs, state.chain_id
+        )
+    return state
+
+
+class TestGenesis:
+    def test_roundtrip_json(self):
+        doc, _, _ = _genesis_doc()
+        raw = doc.to_json()
+        doc2 = GenesisDoc.from_json(raw)
+        assert doc2.chain_id == doc.chain_id
+        assert doc2.initial_height == 1
+        assert len(doc2.validators) == 4
+        assert doc2.validator_hash() == doc.validator_hash()
+
+    def test_validate_rejects_bad(self):
+        doc = GenesisDoc(chain_id="")
+        assert "chain_id" in doc.validate_and_complete()
+        doc = GenesisDoc(chain_id="x" * 51)
+        assert "too long" in doc.validate_and_complete()
+        doc, _, _ = _genesis_doc()
+        doc.validators[0].power = 0
+        assert "voting power" in doc.validate_and_complete()
+
+    def test_genesis_state(self):
+        doc, vals, _ = _genesis_doc()
+        st = make_genesis_state(doc)
+        assert st.last_block_height == 0
+        assert st.validators.hash() == vals.hash()
+        assert st.next_validators is not st.validators
+        assert st.initial_height == 1
+
+
+class TestStateStore:
+    def test_save_load_roundtrip(self):
+        doc, _, _ = _genesis_doc()
+        st = make_genesis_state(doc)
+        store = Store(MemDB())
+        store.save(st)
+        st2 = store.load()
+        assert st2.equals(st)
+
+    def test_validator_back_pointers(self):
+        executor, state, privs, store = _make_executor()
+        state = _apply_n_blocks(executor, state, privs, 5)
+        # validators were never changed: every stored height resolves
+        for h in range(1, 7):
+            vs = store.load_validators(h)
+            assert vs.size() == 4
+        from cometbft_tpu.state.store import ErrNoValSetForHeight
+
+        with pytest.raises(ErrNoValSetForHeight):
+            store.load_validators(100)
+
+    def test_consensus_params_info(self):
+        doc, _, _ = _genesis_doc()
+        st = make_genesis_state(doc)
+        store = Store(MemDB())
+        store.save(st)
+        params = store.load_consensus_params(1)
+        assert params.block.max_bytes == st.consensus_params.block.max_bytes
+
+
+class TestBlockStore:
+    def test_save_load_prune(self):
+        from cometbft_tpu.types.part_set import PartSet, BLOCK_PART_SIZE_BYTES
+
+        doc, vals, privs = _genesis_doc()
+        st = make_genesis_state(doc)
+        bs = BlockStore(MemDB())
+        assert bs.height() == 0 and bs.base() == 0
+
+        last_commit = Commit(0, 0, BlockID(), [])
+        blocks = []
+        for h in range(1, 5):
+            block, parts = st.make_block(
+                h, [b"tx-%d" % h], last_commit, [], st.validators.proposer.address
+            )
+            block_id = BlockID(block.hash(), parts.header())
+            seen = test_util.make_commit(
+                block_id, h, 0, st.validators, privs, st.chain_id
+            )
+            bs.save_block(block, parts, seen)
+            blocks.append((block, block_id))
+            # advance minimal state bits used by make_block
+            st.last_block_height = h
+            st.last_block_id = block_id
+            last_commit = seen
+
+        assert bs.height() == 4 and bs.base() == 1 and bs.size() == 4
+        b2 = bs.load_block(2)
+        assert b2.hash() == blocks[1][0].hash()
+        assert bs.load_block_by_hash(b2.hash()).header.height == 2
+        meta = bs.load_block_meta(3)
+        assert meta.block_id == blocks[2][1]
+        assert bs.load_seen_commit(4).height == 4
+        assert bs.load_block_commit(3).height == 3  # saved from block 4's LastCommit
+
+        pruned = bs.prune_blocks(3)
+        assert pruned == 2
+        assert bs.base() == 3
+        assert bs.load_block(2) is None
+        assert bs.load_block(3) is not None
+
+    def test_non_contiguous_save_rejected(self):
+        doc, _, privs = _genesis_doc()
+        st = make_genesis_state(doc)
+        bs = BlockStore(MemDB())
+        block, parts = st.make_block(
+            1, [], Commit(0, 0, BlockID(), []), [], st.validators.proposer.address
+        )
+        bid = BlockID(block.hash(), parts.header())
+        seen = test_util.make_commit(bid, 1, 0, st.validators, privs, st.chain_id)
+        bs.save_block(block, parts, seen)
+        block3, parts3 = st.make_block(
+            3, [], Commit(0, 0, BlockID(), []), [], st.validators.proposer.address
+        )
+        with pytest.raises(ValueError, match="contiguous"):
+            bs.save_block(block3, parts3, seen)
+
+
+class TestBlockExecutor:
+    def test_apply_five_blocks(self):
+        executor, state, privs, store = _make_executor()
+        state = _apply_n_blocks(
+            executor, state, privs, 5, txs_fn=lambda h: [b"k%d=v%d" % (h, h)]
+        )
+        assert state.last_block_height == 5
+        # kvstore app hash is the 8-byte varint of tx count
+        assert len(state.app_hash) == 8
+        reloaded = store.load()
+        assert reloaded.equals(state)
+        responses = store.load_abci_responses(3)
+        assert len(responses.deliver_txs) == 1
+        assert responses.deliver_txs[0].is_ok()
+
+    def test_validate_block_rejects_tampering(self):
+        executor, state, privs, store = _make_executor()
+        state = _apply_n_blocks(executor, state, privs, 1)
+        proposer = state.validators.proposer.address
+        last_commit_bad = Commit(0, 0, BlockID(), [])
+        with pytest.raises(ValueError):
+            # wrong height commit for h=2 (needs real last commit)
+            block, parts = executor.create_proposal_block(
+                2, state, last_commit_bad, proposer
+            )
+            validate_block(state, block)
+
+    def test_wrong_app_hash_rejected(self):
+        executor, state, privs, store = _make_executor()
+        state = _apply_n_blocks(executor, state, privs, 2)
+        bad = state.copy()
+        bad.app_hash = b"\x01" * 8
+        proposer = state.validators.proposer.address
+        # build block against the real state, validate against tampered
+        last_commit = test_util.make_commit(
+            state.last_block_id, 2, 0, state.last_validators, privs, state.chain_id
+        )
+        block, parts = executor.create_proposal_block(
+            3, state, last_commit, proposer
+        )
+        with pytest.raises(ValueError, match="AppHash"):
+            validate_block(bad, block)
+
+    def test_events_fired(self):
+        bus = EventBus()
+        bus.start()
+        sub_block = bus.subscribe("test", EVENT_QUERY_NEW_BLOCK)
+        sub_tx = bus.subscribe("test2", EVENT_QUERY_TX)
+        executor, state, privs, store = _make_executor(event_bus=bus)
+        state = _apply_n_blocks(
+            executor, state, privs, 1, txs_fn=lambda h: [b"a=b"]
+        )
+        msg = sub_block.next(timeout=2)
+        assert msg.data.block.header.height == 1
+        txmsg = sub_tx.next(timeout=2)
+        assert txmsg.data.tx == b"a=b"
+        assert "tx.hash" in txmsg.events
+        bus.stop()
+
+
+class TestMedianTime:
+    def test_weighted_median(self):
+        vals, privs = test_util.deterministic_validator_set(3, 10)
+        bid = test_util.make_block_id()
+        t0 = Timestamp(100, 0)
+        commit = test_util.make_commit(bid, 5, 0, vals, privs, "c", now=t0)
+        # all timestamps equal → median equals it
+        assert median_time(commit, vals) == t0
