@@ -1,0 +1,351 @@
+"""Node configuration — 9 sections, TOML-serialized.
+
+Reference: config/config.go:66-81 (master Config), defaults per section
+(Base :228, RPC :440, P2P :563, Mempool :697, StateSync :771, FastSync
+:844, Consensus :969-1037, TxIndex :1112, Instrumentation :1141) and the
+TOML writer config/toml.go. Durations are stored in nanoseconds like Go's
+time.Duration; TOML round-trips them as "300ms"/"10s" strings.
+
+New in this framework: the [crypto] section selecting the signature-
+verification backend ("cpu" | "tpu") — SURVEY.md §7's plugin boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+_SECOND = 1_000_000_000
+_MS = 1_000_000
+
+
+def duration_to_str(ns: int) -> str:
+    if ns % _SECOND == 0:
+        return f"{ns // _SECOND}s"
+    if ns % _MS == 0:
+        return f"{ns // _MS}ms"
+    return f"{ns}ns"
+
+
+def parse_duration(s: str) -> int:
+    """Go-style duration string → nanoseconds."""
+    if isinstance(s, (int, float)):
+        return int(s)
+    units = {
+        "ns": 1, "us": 1_000, "µs": 1_000, "ms": _MS, "s": _SECOND,
+        "m": 60 * _SECOND, "h": 3600 * _SECOND,
+    }
+    total = 0
+    pos = 0
+    token = re.compile(r"([\d.]+)(ns|us|µs|ms|s|m|h)")
+    while pos < len(s):
+        m = token.match(s, pos)
+        if m is None:
+            raise ValueError(f"invalid duration {s!r}")
+        total += int(float(m.group(1)) * units[m.group(2)])
+        pos = m.end()
+    return total
+
+
+@dataclass
+class BaseConfig:
+    """[top-level] (config/config.go:145-226)."""
+
+    root_dir: str = ""
+    proxy_app: str = "tcp://127.0.0.1:26658"
+    moniker: str = "anonymous"
+    fast_sync_mode: bool = True
+    db_backend: str = "sqlite"
+    db_dir: str = "data"
+    log_level: str = "info"
+    log_format: str = "plain"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    priv_validator_laddr: str = ""
+    node_key_file: str = "config/node_key.json"
+    abci: str = "socket"  # "socket" | "grpc" | "builtin"
+    filter_peers: bool = False
+
+    def genesis_path(self) -> str:
+        return os.path.join(self.root_dir, self.genesis_file)
+
+    def priv_validator_key_path(self) -> str:
+        return os.path.join(self.root_dir, self.priv_validator_key_file)
+
+    def priv_validator_state_path(self) -> str:
+        return os.path.join(self.root_dir, self.priv_validator_state_file)
+
+    def node_key_path(self) -> str:
+        return os.path.join(self.root_dir, self.node_key_file)
+
+    def db_path(self) -> str:
+        return os.path.join(self.root_dir, self.db_dir)
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    cors_allowed_origins: List[str] = field(default_factory=list)
+    grpc_laddr: str = ""
+    unsafe: bool = False
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit_ns: int = 10 * _SECOND
+    max_body_bytes: int = 1000000
+    max_header_bytes: int = 1 << 20
+    pprof_laddr: str = ""
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    upnp: bool = False
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    unconditional_peer_ids: str = ""
+    persistent_peers_max_dial_period_ns: int = 0
+    flush_throttle_timeout_ns: int = 100 * _MS
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5120000  # 5 MB/s
+    recv_rate: int = 5120000
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    allow_duplicate_ip: bool = False
+    handshake_timeout_ns: int = 20 * _SECOND
+    dial_timeout_ns: int = 3 * _SECOND
+    test_fuzz: bool = False
+
+
+@dataclass
+class MempoolConfig:
+    version: str = "v0"
+    recheck: bool = True
+    broadcast: bool = True
+    wal_dir: str = ""
+    size: int = 5000
+    max_txs_bytes: int = 1073741824  # 1GB
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1048576  # 1MB
+    max_batch_bytes: int = 0
+    ttl_duration_ns: int = 0
+    ttl_num_blocks: int = 0
+
+
+@dataclass
+class StateSyncConfig:
+    enable: bool = False
+    rpc_servers: List[str] = field(default_factory=list)
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period_ns: int = 168 * 3600 * _SECOND  # 168h0m0s
+    discovery_time_ns: int = 15 * _SECOND
+    temp_dir: str = ""
+    chunk_request_timeout_ns: int = 10 * _SECOND
+    chunk_fetchers: int = 4
+
+
+@dataclass
+class FastSyncConfig:
+    version: str = "v0"
+
+
+@dataclass
+class ConsensusConfig:
+    """[consensus] (config/config.go:969-1037). Round-scaled accessors
+    mirror the reference's Propose(round)/Prevote(round)/Precommit(round)."""
+
+    wal_path: str = "data/cs.wal/wal"
+    root_dir: str = ""
+    timeout_propose_ns: int = 3 * _SECOND
+    timeout_propose_delta_ns: int = 500 * _MS
+    timeout_prevote_ns: int = 1 * _SECOND
+    timeout_prevote_delta_ns: int = 500 * _MS
+    timeout_precommit_ns: int = 1 * _SECOND
+    timeout_precommit_delta_ns: int = 500 * _MS
+    timeout_commit_ns: int = 1 * _SECOND
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval_ns: int = 0
+    peer_gossip_sleep_duration_ns: int = 100 * _MS
+    peer_query_maj23_sleep_duration_ns: int = 2 * _SECOND
+    double_sign_check_height: int = 0
+
+    def propose_timeout(self, round_: int) -> float:
+        return (
+            self.timeout_propose_ns + self.timeout_propose_delta_ns * round_
+        ) / _SECOND
+
+    def prevote_timeout(self, round_: int) -> float:
+        return (
+            self.timeout_prevote_ns + self.timeout_prevote_delta_ns * round_
+        ) / _SECOND
+
+    def precommit_timeout(self, round_: int) -> float:
+        return (
+            self.timeout_precommit_ns + self.timeout_precommit_delta_ns * round_
+        ) / _SECOND
+
+    def commit_time(self) -> float:
+        return self.timeout_commit_ns / _SECOND
+
+    def wal_file(self) -> str:
+        return os.path.join(self.root_dir, self.wal_path)
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"  # "kv" | "null"
+    psql_conn: str = ""
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    max_open_connections: int = 3
+    namespace: str = "cometbft"
+
+
+@dataclass
+class CryptoConfig:
+    """[crypto] — NEW: signature-verification backend selection
+    (SURVEY.md §7; no reference counterpart — v0.34 has no batch plane)."""
+
+    backend: str = "cpu"  # "cpu" | "tpu"
+    # Below min_batch signatures, a batch falls back to the serial CPU
+    # path (kernel launch overhead dominates tiny batches).
+    min_batch: int = 2
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    fastsync: FastSyncConfig = field(default_factory=FastSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+    crypto: CryptoConfig = field(default_factory=CryptoConfig)
+
+    def set_root(self, root: str) -> "Config":
+        self.base.root_dir = root
+        self.consensus.root_dir = root
+        return self
+
+    @property
+    def root_dir(self) -> str:
+        return self.base.root_dir
+
+    def validate_basic(self) -> None:
+        if self.base.abci not in ("socket", "grpc", "builtin"):
+            raise ValueError(f"unknown abci transport {self.base.abci!r}")
+        if self.mempool.size < 0:
+            raise ValueError("mempool.size can't be negative")
+        if self.consensus.timeout_propose_ns < 0:
+            raise ValueError("consensus.timeout_propose can't be negative")
+        if self.crypto.backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown crypto backend {self.crypto.backend!r}")
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def test_config() -> Config:
+    """Reference: config.TestConfig — aggressive timeouts for tests."""
+    cfg = Config()
+    c = cfg.consensus
+    c.timeout_propose_ns = 40 * _MS
+    c.timeout_propose_delta_ns = 1 * _MS
+    c.timeout_prevote_ns = 10 * _MS
+    c.timeout_prevote_delta_ns = 1 * _MS
+    c.timeout_precommit_ns = 10 * _MS
+    c.timeout_precommit_delta_ns = 1 * _MS
+    c.timeout_commit_ns = 10 * _MS
+    c.skip_timeout_commit = True
+    cfg.p2p.flush_throttle_timeout_ns = 10 * _MS
+    cfg.base.fast_sync_mode = False
+    return cfg
+
+
+# --- TOML ------------------------------------------------------------------
+
+_DURATION_FIELDS = re.compile(r"_ns$")
+
+
+def _to_toml_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, list):
+        return "[" + ", ".join(f'"{x}"' for x in v) + "]"
+    return f'"{v}"'
+
+
+_SECTIONS = [
+    ("", "base"),
+    ("rpc", "rpc"),
+    ("p2p", "p2p"),
+    ("mempool", "mempool"),
+    ("statesync", "statesync"),
+    ("fastsync", "fastsync"),
+    ("consensus", "consensus"),
+    ("tx_index", "tx_index"),
+    ("instrumentation", "instrumentation"),
+    ("crypto", "crypto"),
+]
+
+
+def write_config_file(path: str, cfg: Config) -> None:
+    lines = ["# This is a TOML config file generated by cometbft_tpu.", ""]
+    for section, attr in _SECTIONS:
+        obj = getattr(cfg, attr)
+        if section:
+            lines.append(f"[{section}]")
+        for name, value in vars(obj).items():
+            if name == "root_dir":
+                continue
+            if _DURATION_FIELDS.search(name):
+                key = name[: -len("_ns")]
+                lines.append(f'{key} = "{duration_to_str(value)}"')
+            else:
+                lines.append(f"{name} = {_to_toml_value(value)}")
+        lines.append("")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+
+
+def load_config_file(path: str, cfg: Optional[Config] = None) -> Config:
+    import tomllib
+
+    with open(path, "rb") as f:
+        data = tomllib.load(f)
+    cfg = cfg or Config()
+    for section, attr in _SECTIONS:
+        obj = getattr(cfg, attr)
+        src = data if section == "" else data.get(section, {})
+        for name in list(vars(obj)):
+            if name == "root_dir":
+                continue
+            if _DURATION_FIELDS.search(name):
+                key = name[: -len("_ns")]
+                if isinstance(src, dict) and key in src:
+                    setattr(obj, name, parse_duration(src[key]))
+            elif isinstance(src, dict) and name in src:
+                setattr(obj, name, src[name])
+    return cfg

@@ -1,0 +1,13 @@
+"""consensus — the Tendermint state machine, WAL, replay, and gossip types.
+
+Reference layout: consensus/state.go (algorithm), consensus/types/
+(RoundState, HeightVoteSet), consensus/wal.go (+libs/autofile),
+consensus/replay.go (crash recovery + ABCI handshake),
+consensus/ticker.go (timeout scheduling).
+"""
+
+from cometbft_tpu.consensus.round_state import (  # noqa: F401
+    HeightVoteSet,
+    RoundState,
+    RoundStepType,
+)

@@ -1,0 +1,535 @@
+"""Consensus gossip + WAL message codecs.
+
+Field numbers per proto/tendermint/consensus/types.proto (Message oneof
+:80-92) and wal.proto (WALMessage oneof, TimedWALMessage). These are the
+payloads of p2p channels 0x20-0x23 and of WAL records, so wire layout
+matters; in-memory they are plain dataclasses.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.libs.bits import BitArray
+from cometbft_tpu.proto.gogo import Timestamp, ZERO_TIME
+from cometbft_tpu.types.block import BlockID
+from cometbft_tpu.types.part_set import Part, PartSetHeader
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.vote import Vote
+
+
+def _encode_bit_array(ba: Optional[BitArray]) -> bytes:
+    """proto libs.bits.BitArray {int64 bits=1, repeated uint64 elems=2}."""
+    if ba is None:
+        return b""
+    out = protoio.field_varint(1, ba.size())
+    for e in ba.elems():
+        out += protoio.field_varint(2, e)
+    return out
+
+
+def _decode_bit_array(data: bytes) -> Optional[BitArray]:
+    r = protoio.WireReader(data)
+    bits, elems = 0, []
+    while not r.at_end():
+        f, wt = r.read_tag()
+        if f == 1:
+            bits = r.read_varint()
+        elif f == 2:
+            elems.append(r.read_varint())
+        else:
+            r.skip(wt)
+    if bits == 0:
+        return None
+    return BitArray.from_elems(bits, elems)
+
+
+@dataclass
+class NewRoundStepMessage:
+    height: int = 0
+    round: int = 0
+    step: int = 0
+    seconds_since_start_time: int = 0
+    last_commit_round: int = 0
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.height:
+            out += protoio.field_varint(1, self.height)
+        if self.round:
+            out += protoio.field_varint(2, self.round)
+        if self.step:
+            out += protoio.field_varint(3, self.step)
+        if self.seconds_since_start_time:
+            out += protoio.field_varint(4, self.seconds_since_start_time)
+        if self.last_commit_round:
+            out += protoio.field_varint(5, self.last_commit_round)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NewRoundStepMessage":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            elif f == 2:
+                out.round = r.read_varint()
+            elif f == 3:
+                out.step = r.read_varint()
+            elif f == 4:
+                out.seconds_since_start_time = r.read_varint()
+            elif f == 5:
+                out.last_commit_round = r.read_varint()
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class NewValidBlockMessage:
+    height: int = 0
+    round: int = 0
+    block_part_set_header: PartSetHeader = field(default_factory=PartSetHeader)
+    block_parts: Optional[BitArray] = None
+    is_commit: bool = False
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.height:
+            out += protoio.field_varint(1, self.height)
+        if self.round:
+            out += protoio.field_varint(2, self.round)
+        out += protoio.field_message(3, self.block_part_set_header.encode())
+        if self.block_parts is not None:
+            out += protoio.field_message(4, _encode_bit_array(self.block_parts))
+        if self.is_commit:
+            out += protoio.field_varint(5, 1)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NewValidBlockMessage":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            elif f == 2:
+                out.round = r.read_varint()
+            elif f == 3:
+                out.block_part_set_header = PartSetHeader.decode(r.read_bytes())
+            elif f == 4:
+                out.block_parts = _decode_bit_array(r.read_bytes())
+            elif f == 5:
+                out.is_commit = bool(r.read_varint())
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class ProposalMessage:
+    proposal: Proposal = field(default_factory=Proposal)
+
+    def encode(self) -> bytes:
+        return protoio.field_message(1, self.proposal.encode())
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ProposalMessage":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.proposal = Proposal.decode(r.read_bytes())
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class ProposalPOLMessage:
+    height: int = 0
+    proposal_pol_round: int = 0
+    proposal_pol: Optional[BitArray] = None
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.height:
+            out += protoio.field_varint(1, self.height)
+        if self.proposal_pol_round:
+            out += protoio.field_varint(2, self.proposal_pol_round)
+        out += protoio.field_message(3, _encode_bit_array(self.proposal_pol))
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ProposalPOLMessage":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            elif f == 2:
+                out.proposal_pol_round = r.read_varint()
+            elif f == 3:
+                out.proposal_pol = _decode_bit_array(r.read_bytes())
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class BlockPartMessage:
+    height: int = 0
+    round: int = 0
+    part: Optional[Part] = None
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.height:
+            out += protoio.field_varint(1, self.height)
+        if self.round:
+            out += protoio.field_varint(2, self.round)
+        if self.part is not None:
+            out += protoio.field_message(3, self.part.encode())
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockPartMessage":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            elif f == 2:
+                out.round = r.read_varint()
+            elif f == 3:
+                out.part = Part.decode(r.read_bytes())
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class VoteMessage:
+    vote: Optional[Vote] = None
+
+    def encode(self) -> bytes:
+        if self.vote is None:
+            return b""
+        return protoio.field_message(1, self.vote.encode())
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VoteMessage":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.vote = Vote.decode(r.read_bytes())
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class HasVoteMessage:
+    height: int = 0
+    round: int = 0
+    type: int = 0
+    index: int = 0
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.height:
+            out += protoio.field_varint(1, self.height)
+        if self.round:
+            out += protoio.field_varint(2, self.round)
+        if self.type:
+            out += protoio.field_varint(3, self.type)
+        if self.index:
+            out += protoio.field_varint(4, self.index)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "HasVoteMessage":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            elif f == 2:
+                out.round = r.read_varint()
+            elif f == 3:
+                out.type = r.read_varint()
+            elif f == 4:
+                out.index = r.read_varint()
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class VoteSetMaj23Message:
+    height: int = 0
+    round: int = 0
+    type: int = 0
+    block_id: BlockID = field(default_factory=BlockID)
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.height:
+            out += protoio.field_varint(1, self.height)
+        if self.round:
+            out += protoio.field_varint(2, self.round)
+        if self.type:
+            out += protoio.field_varint(3, self.type)
+        out += protoio.field_message(4, self.block_id.encode())
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VoteSetMaj23Message":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            elif f == 2:
+                out.round = r.read_varint()
+            elif f == 3:
+                out.type = r.read_varint()
+            elif f == 4:
+                out.block_id = BlockID.decode(r.read_bytes())
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class VoteSetBitsMessage:
+    height: int = 0
+    round: int = 0
+    type: int = 0
+    block_id: BlockID = field(default_factory=BlockID)
+    votes: Optional[BitArray] = None
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.height:
+            out += protoio.field_varint(1, self.height)
+        if self.round:
+            out += protoio.field_varint(2, self.round)
+        if self.type:
+            out += protoio.field_varint(3, self.type)
+        out += protoio.field_message(4, self.block_id.encode())
+        out += protoio.field_message(5, _encode_bit_array(self.votes))
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VoteSetBitsMessage":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            elif f == 2:
+                out.round = r.read_varint()
+            elif f == 3:
+                out.type = r.read_varint()
+            elif f == 4:
+                out.block_id = BlockID.decode(r.read_bytes())
+            elif f == 5:
+                out.votes = _decode_bit_array(r.read_bytes())
+            else:
+                r.skip(wt)
+        return out
+
+
+_MESSAGE_FIELDS = {
+    "new_round_step": (1, NewRoundStepMessage),
+    "new_valid_block": (2, NewValidBlockMessage),
+    "proposal": (3, ProposalMessage),
+    "proposal_pol": (4, ProposalPOLMessage),
+    "block_part": (5, BlockPartMessage),
+    "vote": (6, VoteMessage),
+    "has_vote": (7, HasVoteMessage),
+    "vote_set_maj23": (8, VoteSetMaj23Message),
+    "vote_set_bits": (9, VoteSetBitsMessage),
+}
+_MESSAGE_BY_TYPE = {cls: (name, num) for name, (num, cls) in _MESSAGE_FIELDS.items()}
+_MESSAGE_BY_NUM = {num: (name, cls) for name, (num, cls) in _MESSAGE_FIELDS.items()}
+
+
+def encode_consensus_message(msg) -> bytes:
+    """Message oneof envelope."""
+    name, num = _MESSAGE_BY_TYPE[type(msg)]
+    return protoio.field_message(num, msg.encode())
+
+
+def decode_consensus_message(data: bytes):
+    r = protoio.WireReader(data)
+    result = None
+    while not r.at_end():
+        f, wt = r.read_tag()
+        if f in _MESSAGE_BY_NUM:
+            _, cls = _MESSAGE_BY_NUM[f]
+            result = cls.decode(r.read_bytes())
+        else:
+            r.skip(wt)
+    if result is None:
+        raise ValueError("empty consensus Message")
+    return result
+
+
+# --- WAL messages ----------------------------------------------------------
+
+
+@dataclass
+class MsgInfo:
+    """A consensus message + its origin peer ('' = internal)."""
+
+    msg: object = None
+    peer_id: str = ""
+
+
+@dataclass
+class TimeoutInfo:
+    duration_s: float = 0.0
+    height: int = 0
+    round: int = 0
+    step: int = 0
+
+    def __str__(self) -> str:
+        return f"{self.duration_s}s ; {self.height}/{self.round}/{self.step}"
+
+
+@dataclass
+class EndHeightMessage:
+    """WAL #ENDHEIGHT marker (wal.proto EndHeight)."""
+
+    height: int = 0
+
+
+@dataclass
+class EventDataRoundStateWAL:
+    height: int = 0
+    round: int = 0
+    step: str = ""
+
+
+def encode_wal_message(msg) -> bytes:
+    """WALMessage oneof (wal.proto): event=1, msg_info=2, timeout=3, end=4."""
+    if isinstance(msg, EventDataRoundStateWAL):
+        body = b""
+        if msg.height:
+            body += protoio.field_varint(1, msg.height)
+        if msg.round:
+            body += protoio.field_varint(2, msg.round)
+        if msg.step:
+            body += protoio.field_string(3, msg.step)
+        return protoio.field_message(1, body)
+    if isinstance(msg, MsgInfo):
+        body = protoio.field_message(1, encode_consensus_message(msg.msg))
+        if msg.peer_id:
+            body += protoio.field_string(2, msg.peer_id)
+        return protoio.field_message(2, body)
+    if isinstance(msg, TimeoutInfo):
+        ns = int(msg.duration_s * 1_000_000_000)
+        dur = protoio.field_varint(1, ns // 1_000_000_000)
+        if ns % 1_000_000_000:
+            dur += protoio.field_varint(2, ns % 1_000_000_000)
+        body = protoio.field_message(1, dur)
+        if msg.height:
+            body += protoio.field_varint(2, msg.height)
+        if msg.round:
+            body += protoio.field_varint(3, msg.round)
+        if msg.step:
+            body += protoio.field_varint(4, msg.step)
+        return protoio.field_message(3, body)
+    if isinstance(msg, EndHeightMessage):
+        body = protoio.field_varint(1, msg.height) if msg.height else b""
+        return protoio.field_message(4, body)
+    raise TypeError(f"unknown WAL message {type(msg)}")
+
+
+def decode_wal_message(data: bytes):
+    r = protoio.WireReader(data)
+    result = None
+    while not r.at_end():
+        f, wt = r.read_tag()
+        if f == 1:
+            body = protoio.WireReader(r.read_bytes())
+            out = EventDataRoundStateWAL()
+            while not body.at_end():
+                bf, bwt = body.read_tag()
+                if bf == 1:
+                    out.height = body.read_varint()
+                elif bf == 2:
+                    out.round = body.read_varint()
+                elif bf == 3:
+                    out.step = body.read_string()
+                else:
+                    body.skip(bwt)
+            result = out
+        elif f == 2:
+            body = protoio.WireReader(r.read_bytes())
+            out = MsgInfo()
+            while not body.at_end():
+                bf, bwt = body.read_tag()
+                if bf == 1:
+                    out.msg = decode_consensus_message(body.read_bytes())
+                elif bf == 2:
+                    out.peer_id = body.read_string()
+                else:
+                    body.skip(bwt)
+            result = out
+        elif f == 3:
+            body = protoio.WireReader(r.read_bytes())
+            out = TimeoutInfo()
+            while not body.at_end():
+                bf, bwt = body.read_tag()
+                if bf == 1:
+                    dr = protoio.WireReader(body.read_bytes())
+                    secs = nanos = 0
+                    while not dr.at_end():
+                        df, dwt = dr.read_tag()
+                        if df == 1:
+                            secs = dr.read_varint()
+                        elif df == 2:
+                            nanos = dr.read_varint()
+                        else:
+                            dr.skip(dwt)
+                    out.duration_s = secs + nanos / 1_000_000_000
+                elif bf == 2:
+                    out.height = body.read_varint()
+                elif bf == 3:
+                    out.round = body.read_varint()
+                elif bf == 4:
+                    out.step = body.read_varint()
+                else:
+                    body.skip(bwt)
+            result = out
+        elif f == 4:
+            body = protoio.WireReader(r.read_bytes())
+            out = EndHeightMessage()
+            while not body.at_end():
+                bf, bwt = body.read_tag()
+                if bf == 1:
+                    out.height = body.read_varint()
+                else:
+                    body.skip(bwt)
+            result = out
+        else:
+            r.skip(wt)
+    if result is None:
+        raise ValueError("empty WALMessage")
+    return result

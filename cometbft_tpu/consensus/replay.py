@@ -1,0 +1,315 @@
+"""Crash recovery: WAL catch-up replay + the ABCI handshake.
+
+Reference: consensus/replay.go — catchupReplay :93 (re-apply WAL messages
+recorded after the last #ENDHEIGHT), Handshaker.Handshake :241 (ABCI Info
+→ compare app height vs store height), ReplayBlocks :284 (InitChain at
+genesis; re-execute stored blocks until the app catches up, ApplyBlock for
+the final one when the state snapshot is also behind).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.consensus.messages import (
+    EndHeightMessage,
+    EventDataRoundStateWAL,
+    MsgInfo,
+    TimeoutInfo,
+)
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.proto.keys import pub_key_to_proto
+from cometbft_tpu.state import State as SMState
+from cometbft_tpu.state.execution import (
+    exec_block_on_proxy_app,
+    validator_from_update,
+)
+from cometbft_tpu.state.store import Store
+from cometbft_tpu.types.block import BlockID
+from cometbft_tpu.types.genesis import GenesisDoc
+from cometbft_tpu.types.validator_set import ValidatorSet
+from cometbft_tpu.version import BLOCK_PROTOCOL, P2P_PROTOCOL
+
+
+def catchup_replay(cs, cs_height: int) -> None:
+    """Replay WAL messages recorded after the last completed height into a
+    freshly-constructed ConsensusState (reference: catchupReplay :93).
+    Must run before the receive routine starts; messages are applied
+    directly (they are already in the WAL — no re-logging)."""
+    # sanity: nothing for cs_height must have completed already
+    tail, found = cs.wal.search_for_end_height(cs_height)
+    if found:
+        raise RuntimeError(
+            f"WAL should not contain #ENDHEIGHT {cs_height}"
+        )
+    tail, found = cs.wal.search_for_end_height(cs_height - 1)
+    if not found:
+        # a fresh WAL carries the EndHeight(0) sentinel; missing marker for
+        # an older height means the WAL was truncated/pruned
+        if cs_height > 1:
+            raise RuntimeError(
+                f"cannot replay height {cs_height}: WAL has no #ENDHEIGHT "
+                f"{cs_height - 1}"
+            )
+        return
+    for msg in tail or []:
+        _replay_one(cs, msg)
+    cs.logger.info("replay: done", height=cs_height, messages=len(tail or []))
+
+
+def _replay_one(cs, msg) -> None:
+    if isinstance(msg, EventDataRoundStateWAL):
+        return  # informational
+    if isinstance(msg, TimeoutInfo):
+        with cs._mtx:
+            cs._handle_timeout(msg)
+        return
+    if isinstance(msg, MsgInfo):
+        with cs._mtx:
+            cs._handle_msg(msg)
+        return
+    if isinstance(msg, EndHeightMessage):
+        return
+    raise TypeError(f"unknown WAL message {type(msg)!r}")
+
+
+class _MockReqRes:
+    def __init__(self, response: abci.Response):
+        self._response = response
+
+    def wait(self, timeout=None) -> abci.Response:
+        return self._response
+
+
+class _MockProxyAppConn:
+    """Replays recorded ABCIResponses (reference: newMockProxyApp
+    consensus/replay.go — used when only the state snapshot is behind)."""
+
+    def __init__(self, responses, app_hash: bytes):
+        self._responses = responses
+        self._app_hash = app_hash
+        self._tx_index = 0
+
+    def begin_block_sync(self, req) -> abci.ResponseBeginBlock:
+        return self._responses.begin_block or abci.ResponseBeginBlock()
+
+    def deliver_tx_async(self, req) -> _MockReqRes:
+        res = self._responses.deliver_txs[self._tx_index]
+        self._tx_index += 1
+        return _MockReqRes(abci.Response("deliver_tx", res))
+
+    def end_block_sync(self, req) -> abci.ResponseEndBlock:
+        return self._responses.end_block or abci.ResponseEndBlock()
+
+    def commit_sync(self) -> abci.ResponseCommit:
+        return abci.ResponseCommit(data=self._app_hash)
+
+    def flush_sync(self) -> None:
+        pass
+
+    def error(self):
+        return None
+
+
+class Handshaker:
+    """Reconcile the app's height with the block store's via ABCI Info,
+    re-executing stored blocks as needed."""
+
+    def __init__(
+        self,
+        state_store: Store,
+        state: SMState,
+        block_store,
+        genesis_doc: GenesisDoc,
+        event_bus=None,
+        logger: Optional[Logger] = None,
+    ):
+        self._state_store = state_store
+        self._initial_state = state
+        self._block_store = block_store
+        self._gen_doc = genesis_doc
+        self._event_bus = event_bus
+        self._logger = logger or new_nop_logger()
+        self.n_blocks = 0
+
+    def handshake(self, proxy_app) -> None:
+        """proxy_app: proxy.AppConns. Reference: Handshake :241."""
+        res = proxy_app.query().info_sync(
+            abci.RequestInfo(version="", block_version=BLOCK_PROTOCOL,
+                             p2p_version=P2P_PROTOCOL)
+        )
+        app_block_height = res.last_block_height
+        if app_block_height < 0:
+            raise RuntimeError(f"got negative last block height {app_block_height}")
+        app_hash = res.last_block_app_hash
+        self._logger.info(
+            "ABCI Handshake App Info",
+            height=app_block_height,
+            hash=app_hash.hex(),
+        )
+        app_hash = self.replay_blocks(
+            self._initial_state, app_hash, app_block_height, proxy_app
+        )
+        self._logger.info(
+            "Completed ABCI Handshake - CometBFT and App are synced",
+            app_height=app_block_height,
+            app_hash=app_hash.hex(),
+        )
+
+    def replay_blocks(
+        self,
+        state: SMState,
+        app_hash: bytes,
+        app_block_height: int,
+        proxy_app,
+    ) -> bytes:
+        """Reference: ReplayBlocks :284."""
+        store_height = self._block_store.height()
+        store_base = self._block_store.base()
+        state_height = state.last_block_height
+
+        # Genesis: the app has no state — InitChain.
+        if app_block_height == 0:
+            validators = [
+                abci.ValidatorUpdate(pub_key_to_proto(gv.pub_key), gv.power)
+                for gv in self._gen_doc.validators
+            ]
+            from cometbft_tpu.types.params import ConsensusParams
+
+            p = self._gen_doc.consensus_params or ConsensusParams()
+            req = abci.RequestInitChain(
+                time=self._gen_doc.genesis_time,
+                chain_id=self._gen_doc.chain_id,
+                consensus_params=abci.AbciConsensusParams(
+                    block=abci.AbciBlockParams(p.block.max_bytes, p.block.max_gas),
+                    evidence=p.evidence,
+                    validator=p.validator,
+                    version=p.version,
+                ),
+                validators=validators,
+                app_state_bytes=self._gen_doc.app_state,
+                initial_height=self._gen_doc.initial_height,
+            )
+            res_ic = proxy_app.consensus().init_chain_sync(req)
+
+            if store_height == 0:
+                # apply InitChain results to the genesis state and persist
+                if res_ic.app_hash:
+                    app_hash = res_ic.app_hash
+                    state.app_hash = res_ic.app_hash
+                if res_ic.validators:
+                    vals = [validator_from_update(u) for u in res_ic.validators]
+                    state.validators = ValidatorSet(vals)
+                    nv = ValidatorSet(vals)
+                    nv.increment_proposer_priority(1)
+                    state.next_validators = nv
+                elif not self._gen_doc.validators:
+                    raise RuntimeError(
+                        "validator set is nil in genesis and still empty "
+                        "after InitChain"
+                    )
+                if res_ic.consensus_params is not None:
+                    state.consensus_params = state.consensus_params.update(
+                        res_ic.consensus_params
+                    )
+                self._state_store.save(state)
+
+        # First handshake: nothing stored yet.
+        if store_height == 0:
+            self._check_app_hash(state, app_hash)
+            return app_hash
+
+        if store_height < app_block_height:
+            raise RuntimeError(
+                f"app block height {app_block_height} is ahead of "
+                f"store height {store_height}"
+            )
+        if store_height < state_height:
+            raise RuntimeError(
+                f"state height {state_height} is ahead of store height "
+                f"{store_height}"
+            )
+
+        if store_height == state_height and app_block_height == store_height:
+            self._check_app_hash(state, app_hash)
+            return app_hash
+
+        if app_block_height == store_height and state_height < store_height:
+            # Crash landed between the app's Commit and the state save
+            # (reference replay.go:419): the app already executed the final
+            # block, so advance the state snapshot against a mock app that
+            # replays the recorded ABCI responses instead of re-executing.
+            return self._replay_final_with_mock(state, store_height, app_hash)
+
+        return self._replay_range(
+            state, proxy_app, app_block_height, store_height, state_height,
+            app_hash,
+        )
+
+    def _replay_final_with_mock(
+        self, state: SMState, height: int, app_hash: bytes
+    ) -> bytes:
+        from cometbft_tpu.state.execution import BlockExecutor
+
+        responses = self._state_store.load_abci_responses(height)
+        block = self._block_store.load_block(height)
+        meta = self._block_store.load_block_meta(height)
+        if block is None or meta is None:
+            raise RuntimeError(f"missing block #{height} during mock replay")
+        mock = _MockProxyAppConn(responses, app_hash)
+        executor = BlockExecutor(
+            self._state_store, mock, event_bus=self._event_bus,
+            logger=self._logger,
+        )
+        new_state, _ = executor.apply_block(state, meta.block_id, block)
+        state.__dict__.update(new_state.__dict__)
+        self.n_blocks += 1
+        return new_state.app_hash
+
+    def _replay_range(
+        self,
+        state: SMState,
+        proxy_app,
+        app_height: int,
+        store_height: int,
+        state_height: int,
+        app_hash: bytes,
+    ) -> bytes:
+        from cometbft_tpu.state.execution import BlockExecutor
+
+        for h in range(app_height + 1, store_height + 1):
+            block = self._block_store.load_block(h)
+            if block is None:
+                raise RuntimeError(f"missing block #{h} during replay")
+            final = h == store_height
+            if final and state_height < store_height:
+                # the final block also advances the state snapshot
+                meta = self._block_store.load_block_meta(h)
+                executor = BlockExecutor(
+                    self._state_store, proxy_app.consensus(),
+                    event_bus=self._event_bus, logger=self._logger,
+                )
+                new_state, _ = executor.apply_block(
+                    state, meta.block_id, block
+                )
+                state.__dict__.update(new_state.__dict__)
+                app_hash = new_state.app_hash
+            else:
+                self._logger.info("Applying block", height=h)
+                responses = exec_block_on_proxy_app(
+                    proxy_app.consensus(), block, self._state_store,
+                    state.initial_height, self._logger,
+                )
+                res_commit = proxy_app.consensus().commit_sync()
+                app_hash = res_commit.data
+                del responses
+            self.n_blocks += 1
+        return app_hash
+
+    def _check_app_hash(self, state: SMState, app_hash: bytes) -> None:
+        if state.app_hash and state.app_hash != app_hash:
+            raise RuntimeError(
+                f"app hash mismatch: state has "
+                f"{state.app_hash.hex()}, app returned {app_hash.hex()}"
+            )

@@ -1,0 +1,175 @@
+"""Round state + per-height vote bookkeeping.
+
+Reference: consensus/types/round_state.go:67-94 (RoundState),
+consensus/types/height_vote_set.go:41-50 (HeightVoteSet — one prevote and
+one precommit VoteSet per round, with a peer-catchup round limit).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Dict, List, Optional, Tuple
+
+from cometbft_tpu.proto.gogo import Timestamp, ZERO_TIME
+from cometbft_tpu.types.block import Block, BlockID, Commit
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.validator_set import ValidatorSet
+from cometbft_tpu.types.vote import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+    Vote,
+)
+from cometbft_tpu.types.vote_set import VoteSet
+
+
+class RoundStepType(IntEnum):
+    """consensus/types/round_state.go:12-40."""
+
+    NEW_HEIGHT = 1
+    NEW_ROUND = 2
+    PROPOSE = 3
+    PREVOTE = 4
+    PREVOTE_WAIT = 5
+    PRECOMMIT = 6
+    PRECOMMIT_WAIT = 7
+    COMMIT = 8
+
+    def short(self) -> str:
+        return {
+            1: "NewHeight", 2: "NewRound", 3: "Propose", 4: "Prevote",
+            5: "PrevoteWait", 6: "Precommit", 7: "PrecommitWait", 8: "Commit",
+        }[int(self)]
+
+
+@dataclass
+class RoundState:
+    height: int = 0
+    round: int = 0
+    step: RoundStepType = RoundStepType.NEW_HEIGHT
+    start_time: float = 0.0
+    commit_time: float = 0.0
+    validators: Optional[ValidatorSet] = None
+    proposal: Optional[Proposal] = None
+    proposal_block: Optional[Block] = None
+    proposal_block_parts: Optional[object] = None  # PartSet
+    locked_round: int = -1
+    locked_block: Optional[Block] = None
+    locked_block_parts: Optional[object] = None
+    valid_round: int = -1
+    valid_block: Optional[Block] = None
+    valid_block_parts: Optional[object] = None
+    votes: Optional["HeightVoteSet"] = None
+    commit_round: int = -1
+    last_commit: Optional[VoteSet] = None
+    last_validators: Optional[ValidatorSet] = None
+    triggered_timeout_precommit: bool = False
+
+    def step_str(self) -> str:
+        return f"{self.height}/{self.round}/{self.step.short()}"
+
+
+class HeightVoteSet:
+    """Keeps prevote/precommit VoteSets for every round of one height.
+
+    Peers can only make us create up to 2 extra catch-up rounds
+    (reference: height_vote_set.go SetPeerMaj23 round limit).
+    """
+
+    MAX_CATCHUP_ROUNDS = 2  # height_vote_set.go:26
+
+    def __init__(self, chain_id: str, height: int, val_set: ValidatorSet):
+        self.chain_id = chain_id
+        self._mtx = threading.RLock()
+        self.reset(height, val_set)
+
+    def reset(self, height: int, val_set: ValidatorSet) -> None:
+        with self._mtx:
+            self.height = height
+            self.val_set = val_set
+            self._round_vote_sets: Dict[int, Tuple[VoteSet, VoteSet]] = {}
+            self._peer_catchup_rounds: Dict[str, List[int]] = {}
+            self._add_round(0)
+            self.round = 0
+
+    def _add_round(self, round_: int) -> None:
+        if round_ in self._round_vote_sets:
+            return
+        prevotes = VoteSet(
+            self.chain_id, self.height, round_, SIGNED_MSG_TYPE_PREVOTE, self.val_set
+        )
+        precommits = VoteSet(
+            self.chain_id, self.height, round_, SIGNED_MSG_TYPE_PRECOMMIT,
+            self.val_set,
+        )
+        self._round_vote_sets[round_] = (prevotes, precommits)
+
+    def set_round(self, round_: int) -> None:
+        """Create vote sets up to round_ + 1 (reference allows future round
+        +1 for gossip)."""
+        with self._mtx:
+            for r in range(self.round, round_ + 2):
+                self._add_round(r)
+            self.round = round_
+
+    def add_vote(self, vote: Vote, peer_id: str) -> Tuple[bool, Optional[str]]:
+        with self._mtx:
+            if not _is_vote_type_valid(vote.type):
+                return False, f"invalid vote type {vote.type}"
+            vs = self._get_vote_set(vote.round, vote.type)
+            if vs is None:
+                rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+                if len(rounds) < self.MAX_CATCHUP_ROUNDS:
+                    self._add_round(vote.round)
+                    vs = self._get_vote_set(vote.round, vote.type)
+                    rounds.append(vote.round)
+                else:
+                    return False, "peer has sent a vote that does not match our round for more than one round"
+            return vs.add_vote(vote)
+
+    def prevotes(self, round_: int) -> Optional[VoteSet]:
+        with self._mtx:
+            return self._get_vote_set(round_, SIGNED_MSG_TYPE_PREVOTE)
+
+    def precommits(self, round_: int) -> Optional[VoteSet]:
+        with self._mtx:
+            return self._get_vote_set(round_, SIGNED_MSG_TYPE_PRECOMMIT)
+
+    def _get_vote_set(self, round_: int, type_: int) -> Optional[VoteSet]:
+        pair = self._round_vote_sets.get(round_)
+        if pair is None:
+            return None
+        return pair[0] if type_ == SIGNED_MSG_TYPE_PREVOTE else pair[1]
+
+    def pol_info(self) -> Tuple[int, Optional[BlockID]]:
+        """Last round with a prevote +2/3 (proof-of-lock), searching from
+        the current round down (reference: POLInfo)."""
+        with self._mtx:
+            for r in range(self.round, -1, -1):
+                vs = self._get_vote_set(r, SIGNED_MSG_TYPE_PREVOTE)
+                if vs is not None:
+                    block_id, ok = vs.two_thirds_majority()
+                    if ok:
+                        return r, block_id
+            return -1, None
+
+    def set_peer_maj23(
+        self, round_: int, type_: int, peer_id: str, block_id: BlockID
+    ) -> None:
+        with self._mtx:
+            if not _is_vote_type_valid(type_):
+                return
+            vs = self._get_vote_set(round_, type_)
+            if vs is None:
+                rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
+                if len(rounds) >= self.MAX_CATCHUP_ROUNDS:
+                    return
+                self._add_round(round_)
+                vs = self._get_vote_set(round_, type_)
+                rounds.append(round_)
+            vs.set_peer_maj23(peer_id, block_id)
+
+
+def _is_vote_type_valid(t: int) -> bool:
+    return t in (SIGNED_MSG_TYPE_PREVOTE, SIGNED_MSG_TYPE_PRECOMMIT)

@@ -1,0 +1,47 @@
+"""Timeout ticker — schedules at most one outstanding consensus timeout.
+
+Reference: consensus/ticker.go — timeoutTicker keeps a single timer keyed
+by (height, round, step); scheduling a newer timeout replaces the old one,
+and stale fires are filtered by the state machine's handleTimeout checks.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from cometbft_tpu.consensus.messages import TimeoutInfo
+from cometbft_tpu.libs.service import BaseService
+
+
+class TimeoutTicker(BaseService):
+    def __init__(self):
+        super().__init__("TimeoutTicker")
+        self._timer: Optional[threading.Timer] = None
+        self._mtx = threading.Lock()
+        self.tock_chan: "queue.Queue[TimeoutInfo]" = queue.Queue(maxsize=100)
+
+    def on_stop(self) -> None:
+        with self._mtx:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+    def schedule_timeout(self, ti: TimeoutInfo) -> None:
+        """Replaces any pending timeout (the reference relies on newer
+        (H,R,S) always superseding older)."""
+        with self._mtx:
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(
+                max(ti.duration_s, 0.0), self._fire, args=(ti,)
+            )
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _fire(self, ti: TimeoutInfo) -> None:
+        try:
+            self.tock_chan.put(ti, timeout=1)
+        except queue.Full:
+            pass

@@ -1,0 +1,206 @@
+"""Consensus write-ahead log.
+
+Reference: consensus/wal.go — WAL interface :58-69, BaseWAL over a
+rotating autofile.Group, CRC32C+length-framed TimedWALMessage records
+(WALEncoder :130), 2-second periodic fsync (:28), WriteSync before own
+messages are sent (consensus/state.go:771), SearchForEndHeight :63 used
+by crash recovery.
+
+Record framing: crc32(4 bytes BE) ‖ length(4 bytes BE) ‖ proto(TimedWALMessage).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import threading
+import time
+import zlib
+from typing import Iterator, Optional, Tuple
+
+from cometbft_tpu.consensus.messages import (
+    EndHeightMessage,
+    decode_wal_message,
+    encode_wal_message,
+)
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.libs.autofile import Group
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.proto.gogo import Timestamp
+
+MAX_MSG_SIZE_BYTES = 1024 * 1024  # 1MB (wal.go:32)
+_FLUSH_INTERVAL_S = 2.0  # walDefaultFlushInterval (wal.go:28)
+
+
+def _encode_timed(msg, ts: Optional[Timestamp] = None) -> bytes:
+    ts = ts or Timestamp.now()
+    body = protoio.field_message(1, ts.encode()) + protoio.field_message(
+        2, encode_wal_message(msg)
+    )
+    crc = zlib.crc32(body) & 0xFFFFFFFF
+    return struct.pack(">II", crc, len(body)) + body
+
+
+class WALDecodeError(ValueError):
+    """Data corruption — caller may repair by truncating (reference:
+    DataCorruptionError)."""
+
+
+def _decode_record(r) -> Optional[object]:
+    """Read one framed record from a binary reader; None at clean EOF."""
+    head = r.read(8)
+    if len(head) == 0:
+        return None
+    if len(head) < 8:
+        raise WALDecodeError("truncated record header")
+    crc, length = struct.unpack(">II", head)
+    if length > MAX_MSG_SIZE_BYTES:
+        raise WALDecodeError(f"length {length} exceeds max msg size")
+    body = r.read(length)
+    if len(body) < length:
+        raise WALDecodeError("truncated record body")
+    if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+        raise WALDecodeError("CRC mismatch")
+    # TimedWALMessage {Timestamp time=1, WALMessage msg=2}
+    reader = protoio.WireReader(body)
+    msg = None
+    while not reader.at_end():
+        f, wt = reader.read_tag()
+        if f == 2:
+            msg = decode_wal_message(reader.read_bytes())
+        else:
+            reader.skip(wt)
+    if msg is None:
+        raise WALDecodeError("record without WALMessage")
+    return msg
+
+
+class WAL(BaseService):
+    """BaseWAL: group-backed, periodically flushed."""
+
+    def __init__(self, wal_file: str, group_head_size: int = 10 * 1024 * 1024):
+        super().__init__("baseWAL")
+        self._group = Group(wal_file, head_size_limit=group_head_size)
+        self._mtx = threading.Lock()
+        self._flush_thread: Optional[threading.Thread] = None
+
+    def on_start(self) -> None:
+        # write an EndHeight(0) sentinel on a fresh WAL so replay finds a
+        # terminator even before the first height completes (wal.go OnStart)
+        size = self._group_total_size()
+        if size == 0:
+            self.write_sync(EndHeightMessage(0))
+        self._flush_thread = threading.Thread(
+            target=self._flush_loop, daemon=True
+        )
+        self._flush_thread.start()
+
+    def on_stop(self) -> None:
+        with self._mtx:
+            self._group.flush_and_sync()
+            self._group.close()
+
+    def _group_total_size(self) -> int:
+        import os
+
+        total = 0
+        for p in self._group.all_paths():
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                pass
+        return total
+
+    def _flush_loop(self) -> None:
+        while self.is_running():
+            time.sleep(_FLUSH_INTERVAL_S)
+            if not self.is_running():
+                return
+            try:
+                with self._mtx:
+                    self._group.flush_and_sync()
+            except (OSError, ValueError):
+                return
+
+    def write(self, msg) -> None:
+        """Log before processing (reference: Write — no fsync)."""
+        if not self.is_running():
+            return
+        with self._mtx:
+            self._group.write(_encode_timed(msg))
+
+    def write_sync(self, msg) -> None:
+        """Log + fsync — used for our own votes/proposals and #ENDHEIGHT
+        (reference: WriteSync)."""
+        if not self.is_running() and self._flush_thread is not None:
+            return
+        with self._mtx:
+            self._group.write(_encode_timed(msg))
+            self._group.flush_and_sync()
+
+    def flush_and_sync(self) -> None:
+        with self._mtx:
+            self._group.flush_and_sync()
+
+    def group(self) -> Group:
+        return self._group
+
+    # -- replay -------------------------------------------------------------
+
+    def iter_messages(self) -> Iterator[object]:
+        """All decodable messages, oldest first. Raises WALDecodeError on
+        corruption (caller decides whether to repair)."""
+        with self._mtx:
+            self._group.flush_and_sync()
+        with self._group.reader() as r:
+            while True:
+                msg = _decode_record(r)
+                if msg is None:
+                    return
+                yield msg
+
+    def search_for_end_height(
+        self, height: int
+    ) -> Tuple[Optional[list], bool]:
+        """Returns (messages_after_marker, found). Reference:
+        WALSearchForEndHeight — position the reader just after
+        EndHeight(height)."""
+        found = False
+        tail: list = []
+        try:
+            for msg in self.iter_messages():
+                if isinstance(msg, EndHeightMessage) and msg.height == height:
+                    found = True
+                    tail = []
+                    continue
+                if found:
+                    tail.append(msg)
+        except WALDecodeError:
+            if not found:
+                raise
+        return (tail, True) if found else (None, False)
+
+
+class NilWAL:
+    """Reference: nilWAL — used when the WAL is disabled."""
+
+    def write(self, msg) -> None:
+        pass
+
+    def write_sync(self, msg) -> None:
+        pass
+
+    def flush_and_sync(self) -> None:
+        pass
+
+    def search_for_end_height(self, height: int):
+        return None, False
+
+    def start(self) -> None:
+        pass
+
+    def stop(self) -> None:
+        pass
+
+    def is_running(self) -> bool:
+        return True

@@ -215,6 +215,9 @@ class EventBus(BaseService):
     ) -> None:
         self._publish(EVENT_COMPLETE_PROPOSAL, data, {})
 
+    def publish_event_polka(self, data: EventDataRoundState) -> None:
+        self._publish(EVENT_POLKA, data, {})
+
     def publish_event_lock(self, data: EventDataRoundState) -> None:
         self._publish(EVENT_LOCK, data, {})
 
