@@ -1,0 +1,7 @@
+"""evidence — pool + verification of validator misbehavior."""
+
+from cometbft_tpu.evidence.pool import Pool  # noqa: F401
+from cometbft_tpu.evidence.verify import (  # noqa: F401
+    verify_duplicate_vote,
+    verify_light_client_attack,
+)

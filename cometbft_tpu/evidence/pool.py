@@ -1,0 +1,291 @@
+"""Evidence pool: pending/committed bookkeeping + gossip cursor.
+
+Reference: evidence/pool.go — AddEvidence :134, CheckEvidence :192 (called
+from block validation), Update :103 (mark committed, prune expired),
+consensus-originated conflicting votes buffered until the height advances
+(ReportConflictingVotes :179, processConsensusBuffer :459), clist cursor
+for the reactor's gossip loop.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from cometbft_tpu.evidence.verify import (
+    verify_duplicate_vote,
+    verify_light_client_attack,
+)
+from cometbft_tpu.libs.clist import CList
+from cometbft_tpu.libs.db import DB
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.types.evidence import (
+    DuplicateVoteEvidence,
+    Evidence,
+    LightClientAttackEvidence,
+    decode_evidence,
+    encode_evidence,
+)
+
+_PENDING_PREFIX = b"\x00"
+_COMMITTED_PREFIX = b"\x01"
+
+
+def _key(prefix: bytes, ev: Evidence) -> bytes:
+    return prefix + b"%016x/%s" % (ev.height(), ev.hash().hex().encode())
+
+
+class Pool:
+    def __init__(
+        self,
+        db: DB,
+        state_store,  # state.store.Store
+        block_store,
+        logger: Optional[Logger] = None,
+    ):
+        self._db = db
+        self._state_store = state_store
+        self._block_store = block_store
+        self._logger = logger or new_nop_logger()
+
+        state = state_store.load()
+        if state is None:
+            raise ValueError("cannot start evidence pool with no state")
+        self._state = state
+        self._mtx = threading.Lock()
+        self.evidence_list = CList()  # gossip cursor for the reactor
+        self._consensus_buffer: List[Tuple[object, object]] = []
+
+        # load pending evidence into the gossip list
+        for ev, _ in self._list_evidence(_PENDING_PREFIX, -1):
+            self.evidence_list.push_back(ev)
+
+    # -- accessors -----------------------------------------------------------
+
+    def state(self):
+        with self._mtx:
+            return self._state
+
+    def size(self) -> int:
+        return len(self.evidence_list)
+
+    def pending_evidence(self, max_bytes: int) -> Tuple[List[Evidence], int]:
+        """Reference: PendingEvidence — up to max_bytes of proto size
+        including list framing."""
+        from cometbft_tpu.libs.protoio import uvarint_size
+
+        out: List[Evidence] = []
+        size = 0
+        try:
+            for ev, ev_size in self._list_evidence(_PENDING_PREFIX, -1):
+                framed = ev_size + 1 + uvarint_size(ev_size)
+                if max_bytes != -1 and size + framed > max_bytes:
+                    return out, size
+                size += framed
+                out.append(ev)
+        except Exception as e:
+            self._logger.error("failed listing pending evidence", err=str(e))
+        return out, size
+
+    def _list_evidence(self, prefix: bytes, max_count: int):
+        count = 0
+        for key, raw in self._db.prefix_iterator(prefix):
+            if max_count != -1 and count >= max_count:
+                return
+            count += 1
+            yield decode_evidence(raw), len(raw)
+
+    # -- adding --------------------------------------------------------------
+
+    def add_evidence(self, ev: Evidence) -> None:
+        """Reference: AddEvidence :134."""
+        with self._mtx:
+            if self._is_pending(ev):
+                return
+            if self._is_committed(ev):
+                return
+            ev.validate_basic()
+            self._verify(ev)
+            self._add_pending(ev)
+            self.evidence_list.push_back(ev)
+            self._logger.info("verified new evidence of byzantine behavior",
+                              evidence=str(ev))
+
+    def add_evidence_from_consensus(self, ev: Evidence) -> None:
+        """Evidence our own consensus observed — already verified."""
+        with self._mtx:
+            if self._is_pending(ev) or self._is_committed(ev):
+                return
+            self._add_pending(ev)
+            self.evidence_list.push_back(ev)
+
+    def report_conflicting_votes(self, vote_a, vote_b) -> None:
+        """Buffered until the next Update so the timestamp/validator info
+        can be filled from the committed block (reference :179)."""
+        with self._mtx:
+            self._consensus_buffer.append((vote_a, vote_b))
+
+    def check_evidence(self, ev_list: List[Evidence]) -> None:
+        """Validation-path check (reference: CheckEvidence :192)."""
+        hashes = set()
+        for ev in ev_list:
+            with self._mtx:
+                ok = self._is_pending(ev)
+                if not ok:
+                    if self._is_committed(ev):
+                        raise ValueError("evidence was already committed")
+                    ev.validate_basic()
+                    self._verify(ev)
+                    self._add_pending(ev)
+                    self.evidence_list.push_back(ev)
+            h = ev.hash()
+            if h in hashes:
+                raise ValueError(f"duplicate evidence {ev}")
+            hashes.add(h)
+
+    # -- update on commit ----------------------------------------------------
+
+    def update(self, state, ev_list: List[Evidence]) -> None:
+        """Reference: Update :103 — called by BlockExecutor.ApplyBlock."""
+        with self._mtx:
+            if state.last_block_height <= self._state.last_block_height:
+                raise ValueError(
+                    "failed EvidencePool.Update new state has less or equal "
+                    "height than previous"
+                )
+            self._state = state
+            self._mark_committed(ev_list)
+            self._process_consensus_buffer(state)
+            self._prune_expired()
+
+    def _mark_committed(self, ev_list: List[Evidence]) -> None:
+        batch = self._db.new_batch()
+        for ev in ev_list:
+            batch.set(_key(_COMMITTED_PREFIX, ev), encode_evidence(ev))
+            batch.delete(_key(_PENDING_PREFIX, ev))
+        batch.write()
+        committed = {ev.hash() for ev in ev_list}
+        for elem in list(self.evidence_list):
+            if elem.value.hash() in committed:
+                self.evidence_list.remove(elem)
+
+    def _process_consensus_buffer(self, state) -> None:
+        for vote_a, vote_b in self._consensus_buffer:
+            try:
+                val_set = self._state_store.load_validators(vote_a.height)
+                meta = self._block_store.load_block_meta(vote_a.height)
+                if meta is None:
+                    continue
+                ev = DuplicateVoteEvidence.new(
+                    vote_a, vote_b, meta.header.time, val_set
+                )
+                if not self._is_pending(ev) and not self._is_committed(ev):
+                    self._add_pending(ev)
+                    self.evidence_list.push_back(ev)
+            except Exception as e:
+                self._logger.error(
+                    "failed to form duplicate-vote evidence from consensus",
+                    err=str(e),
+                )
+        self._consensus_buffer = []
+
+    def _prune_expired(self) -> None:
+        state = self._state
+        params = state.consensus_params.evidence
+        batch = self._db.new_batch()
+        expired_hashes = set()
+        for ev, _ in self._list_evidence(_PENDING_PREFIX, -1):
+            if self._is_expired(ev.height(), ev.time(), state, params):
+                batch.delete(_key(_PENDING_PREFIX, ev))
+                expired_hashes.add(ev.hash())
+        batch.write()
+        for elem in list(self.evidence_list):
+            if elem.value.hash() in expired_hashes:
+                self.evidence_list.remove(elem)
+
+    @staticmethod
+    def _is_expired(height, ev_time, state, params) -> bool:
+        age_blocks = state.last_block_height - height
+        age_ns = state.last_block_time.to_unix_ns() - ev_time.to_unix_ns()
+        return (
+            age_ns > params.max_age_duration_ns
+            and age_blocks > params.max_age_num_blocks
+        )
+
+    # -- verification --------------------------------------------------------
+
+    def _verify(self, ev: Evidence) -> None:
+        """Reference: pool.verify :19."""
+        state = self._state
+        height = state.last_block_height
+        params = state.consensus_params.evidence
+
+        meta = self._block_store.load_block_meta(ev.height())
+        if meta is None:
+            raise ValueError(f"don't have header #{ev.height()}")
+        ev_time = meta.header.time
+        if ev.time() != ev_time:
+            raise ValueError(
+                f"evidence has a different time to the block it is "
+                f"associated with ({ev.time()} != {ev_time})"
+            )
+        age_blocks = height - ev.height()
+        age_ns = state.last_block_time.to_unix_ns() - ev_time.to_unix_ns()
+        if age_ns > params.max_age_duration_ns and (
+            age_blocks > params.max_age_num_blocks
+        ):
+            raise ValueError(
+                f"evidence from height {ev.height()} is too old"
+            )
+
+        if isinstance(ev, DuplicateVoteEvidence):
+            val_set = self._state_store.load_validators(ev.height())
+            verify_duplicate_vote(ev, state.chain_id, val_set)
+        elif isinstance(ev, LightClientAttackEvidence):
+            common_header = self._signed_header(ev.height())
+            common_vals = self._state_store.load_validators(ev.height())
+            trusted_header = common_header
+            cb_height = ev.conflicting_block.signed_header.header.height
+            if ev.height() != cb_height:
+                trusted_header = self._try_signed_header(cb_height)
+                if trusted_header is None:
+                    # possible forward lunatic attack
+                    latest = self._block_store.height()
+                    trusted_header = self._signed_header(latest)
+                    if trusted_header.header.time < (
+                        ev.conflicting_block.signed_header.header.time
+                    ):
+                        raise ValueError(
+                            "latest block time is before conflicting block time"
+                        )
+            verify_light_client_attack(
+                ev, common_header, trusted_header, common_vals
+            )
+        else:
+            raise ValueError(f"unrecognized evidence type: {type(ev)}")
+
+    def _signed_header(self, height: int):
+        sh = self._try_signed_header(height)
+        if sh is None:
+            raise ValueError(f"don't have header/commit at height #{height}")
+        return sh
+
+    def _try_signed_header(self, height: int):
+        from cometbft_tpu.types.light_block import SignedHeader
+
+        meta = self._block_store.load_block_meta(height)
+        commit = self._block_store.load_block_commit(height)
+        if meta is None or commit is None:
+            return None
+        return SignedHeader(meta.header, commit)
+
+    # -- pending/committed state --------------------------------------------
+
+    def _is_pending(self, ev: Evidence) -> bool:
+        return self._db.has(_key(_PENDING_PREFIX, ev))
+
+    def _is_committed(self, ev: Evidence) -> bool:
+        return self._db.has(_key(_COMMITTED_PREFIX, ev))
+
+    def _add_pending(self, ev: Evidence) -> None:
+        self._db.set(_key(_PENDING_PREFIX, ev), encode_evidence(ev))

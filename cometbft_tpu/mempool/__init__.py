@@ -1,0 +1,114 @@
+"""mempool — pending-transaction pool.
+
+Reference: mempool/mempool.go — the Mempool interface :30 (CheckTx /
+ReapMaxBytesMaxGas / Update / FlushAppConn / TxsAvailable), tx keys :149,
+pre/post-check hooks :104-147; p2p channel 0x30 (:14).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Callable, Optional
+
+from cometbft_tpu.abci import types as abci
+
+MEMPOOL_CHANNEL = 0x30
+
+TX_KEY_SIZE = 32
+
+
+def tx_key(tx: bytes) -> bytes:
+    """sha256 — mempool/mempool.go TxKey."""
+    return hashlib.sha256(tx).digest()
+
+
+class ErrTxInCache(ValueError):
+    def __init__(self):
+        super().__init__("tx already exists in cache")
+
+
+class ErrTxTooLarge(ValueError):
+    def __init__(self, max_size: int, actual: int):
+        super().__init__(f"Tx too large. Max size is {max_size}, but got {actual}")
+
+
+class ErrMempoolIsFull(ValueError):
+    def __init__(self, num_txs: int, max_txs: int, txs_bytes: int, max_bytes: int):
+        super().__init__(
+            f"mempool is full: number of txs {num_txs} (max: {max_txs}), "
+            f"total txs bytes {txs_bytes} (max: {max_bytes})"
+        )
+
+
+class ErrPreCheck(ValueError):
+    def __init__(self, reason: str):
+        super().__init__(f"tx rejected by pre-check: {reason}")
+
+
+PreCheckFunc = Callable[[bytes], Optional[str]]  # returns error string or None
+PostCheckFunc = Callable[[bytes, abci.ResponseCheckTx], Optional[str]]
+
+
+def pre_check_max_bytes(max_bytes: int) -> PreCheckFunc:
+    """Reference: PreCheckMaxBytes."""
+
+    def check(tx: bytes) -> Optional[str]:
+        if len(tx) > max_bytes:
+            return f"tx size {len(tx)} exceeds max {max_bytes}"
+        return None
+
+    return check
+
+
+def post_check_max_gas(max_gas: int) -> PostCheckFunc:
+    """Reference: PostCheckMaxGas."""
+
+    def check(tx: bytes, res: abci.ResponseCheckTx) -> Optional[str]:
+        if res.gas_wanted < 0:
+            return f"gas wanted {res.gas_wanted} is negative"
+        if max_gas != -1 and res.gas_wanted > max_gas:
+            return f"gas wanted {res.gas_wanted} exceeds max {max_gas}"
+        return None
+
+    return check
+
+
+class Mempool:
+    """The interface consensus and RPC program against."""
+
+    def check_tx(self, tx: bytes, callback=None, tx_info=None) -> None:
+        raise NotImplementedError
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int):
+        raise NotImplementedError
+
+    def reap_max_txs(self, n: int):
+        raise NotImplementedError
+
+    def lock(self) -> None:
+        raise NotImplementedError
+
+    def unlock(self) -> None:
+        raise NotImplementedError
+
+    def update(self, height, txs, deliver_tx_responses, pre_check=None,
+               post_check=None) -> None:
+        raise NotImplementedError
+
+    def flush_app_conn(self) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        raise NotImplementedError
+
+    def txs_available(self) -> bool:
+        raise NotImplementedError
+
+    def enable_txs_available(self) -> None:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def size_bytes(self) -> int:
+        raise NotImplementedError

@@ -1,0 +1,57 @@
+"""Tx dedup cache.
+
+Reference: mempool/cache.go — LRU keyed by sha256(tx); NopTxCache when
+cache_size = 0.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from cometbft_tpu.mempool import tx_key
+
+
+class LRUTxCache:
+    def __init__(self, size: int):
+        self._size = size
+        self._map: "OrderedDict[bytes, None]" = OrderedDict()
+        self._mtx = threading.Lock()
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._map.clear()
+
+    def push(self, tx: bytes) -> bool:
+        """Returns False if already present (and refreshes recency)."""
+        key = tx_key(tx)
+        with self._mtx:
+            if key in self._map:
+                self._map.move_to_end(key)
+                return False
+            self._map[key] = None
+            if len(self._map) > self._size:
+                self._map.popitem(last=False)
+            return True
+
+    def remove(self, tx: bytes) -> None:
+        with self._mtx:
+            self._map.pop(tx_key(tx), None)
+
+    def has(self, tx: bytes) -> bool:
+        with self._mtx:
+            return tx_key(tx) in self._map
+
+
+class NopTxCache:
+    def reset(self) -> None:
+        pass
+
+    def push(self, tx: bytes) -> bool:
+        return True
+
+    def remove(self, tx: bytes) -> None:
+        pass
+
+    def has(self, tx: bytes) -> bool:
+        return False

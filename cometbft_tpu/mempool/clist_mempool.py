@@ -1,0 +1,337 @@
+"""The v0 (default) mempool: a concurrent linked list of pending txs.
+
+Reference: mempool/v0/clist_mempool.go — CheckTx :203 (cache → pre-check
+→ async ABCI CheckTx → resCbFirstTime :372 appends a MempoolTx to the
+clist), ReapMaxBytesMaxGas :521 (proposer), Update :579 (drop committed
+txs, then recheckTxs :641 re-runs CheckTx on survivors), TxsAvailable
+notification for CreateEmptyBlocks=false.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.config import MempoolConfig
+from cometbft_tpu.libs.clist import CList
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.mempool import (
+    ErrMempoolIsFull,
+    ErrPreCheck,
+    ErrTxInCache,
+    ErrTxTooLarge,
+    Mempool,
+    PostCheckFunc,
+    PreCheckFunc,
+    tx_key,
+)
+from cometbft_tpu.mempool.cache import LRUTxCache, NopTxCache
+
+
+@dataclass
+class MempoolTx:
+    """One pending tx (reference: mempoolTx)."""
+
+    height: int  # height at which it was validated
+    gas_wanted: int
+    tx: bytes
+    senders: Set[str] = field(default_factory=set)  # peers that sent it
+
+
+@dataclass
+class TxInfo:
+    sender_id: str = ""
+
+
+class CListMempool(Mempool):
+    def __init__(
+        self,
+        config: MempoolConfig,
+        proxy_app,  # proxy.AppConnMempool
+        height: int = 0,
+        logger: Optional[Logger] = None,
+    ):
+        self.config = config
+        self._proxy_app = proxy_app
+        self._height = height
+        self._logger = logger or new_nop_logger()
+
+        self._txs = CList()
+        self._txs_map: Dict[bytes, object] = {}  # tx key -> CElement
+        self._txs_bytes = 0
+        self._cache = (
+            LRUTxCache(config.cache_size) if config.cache_size > 0 else NopTxCache()
+        )
+
+        self._update_mtx = threading.RLock()  # held across Update by caller
+        self._internal_mtx = threading.Lock()
+
+        self._pre_check: Optional[PreCheckFunc] = None
+        self._post_check: Optional[PostCheckFunc] = None
+
+        self._txs_available: Optional[threading.Event] = None
+        self._notified_txs_available = False
+        self._recheck_cursor = None  # next element to expect a recheck for
+        self._recheck_end = None
+
+        # hook for the consensus tx notifier / reactor
+        self.on_txs_available = None
+
+    # -- config hooks --------------------------------------------------------
+
+    def set_pre_check(self, f: Optional[PreCheckFunc]) -> None:
+        self._pre_check = f
+
+    def set_post_check(self, f: Optional[PostCheckFunc]) -> None:
+        self._post_check = f
+
+    def enable_txs_available(self) -> None:
+        self._txs_available = threading.Event()
+
+    def txs_available(self) -> bool:
+        return self._txs_available is not None and self._txs_available.is_set()
+
+    def txs_available_event(self) -> Optional[threading.Event]:
+        return self._txs_available
+
+    # -- sizes ---------------------------------------------------------------
+
+    def size(self) -> int:
+        return len(self._txs)
+
+    def size_bytes(self) -> int:
+        with self._internal_mtx:
+            return self._txs_bytes
+
+    def is_full(self, tx_size: int) -> Optional[ErrMempoolIsFull]:
+        mem_size = self.size()
+        txs_bytes = self.size_bytes()
+        if (
+            mem_size >= self.config.size
+            or tx_size + txs_bytes > self.config.max_txs_bytes
+        ):
+            return ErrMempoolIsFull(
+                mem_size, self.config.size, txs_bytes, self.config.max_txs_bytes
+            )
+        return None
+
+    # -- locking (held by consensus around Commit) ---------------------------
+
+    def lock(self) -> None:
+        self._update_mtx.acquire()
+
+    def unlock(self) -> None:
+        self._update_mtx.release()
+
+    # -- CheckTx -------------------------------------------------------------
+
+    def check_tx(self, tx: bytes, callback=None, tx_info: Optional[TxInfo] = None) -> None:
+        """May raise ErrTxInCache/ErrTxTooLarge/ErrMempoolIsFull/ErrPreCheck.
+        `callback` receives the abci.Response after app validation."""
+        tx_info = tx_info or TxInfo()
+        with self._update_mtx:
+            if len(tx) > self.config.max_tx_bytes:
+                raise ErrTxTooLarge(self.config.max_tx_bytes, len(tx))
+            err = self.is_full(len(tx))
+            if err is not None:
+                raise err
+            if self._pre_check is not None:
+                reason = self._pre_check(tx)
+                if reason is not None:
+                    raise ErrPreCheck(reason)
+            if not self._cache.push(tx):
+                # record the sender for dedup tracking, then reject
+                elem = self._txs_map.get(tx_key(tx))
+                if elem is not None and tx_info.sender_id:
+                    elem.value.senders.add(tx_info.sender_id)
+                raise ErrTxInCache()
+
+            if self._proxy_app.error() is not None:
+                self._cache.remove(tx)
+                raise RuntimeError(str(self._proxy_app.error()))
+
+            rr = self._proxy_app.check_tx_async(
+                abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW)
+            )
+            rr.set_callback(
+                lambda res: self._res_cb_first_time(tx, tx_info, res, callback)
+            )
+
+    def _res_cb_first_time(self, tx: bytes, tx_info: TxInfo, res, user_cb) -> None:
+        """Reference: resCbFirstTime :372."""
+        if res.kind != "check_tx":
+            if user_cb is not None:
+                user_cb(res)
+            return
+        r: abci.ResponseCheckTx = res.value
+        post_err = None
+        if self._post_check is not None:
+            post_err = self._post_check(tx, r)
+        if r.code == abci.CODE_TYPE_OK and post_err is None:
+            err = self.is_full(len(tx))
+            if err is not None:
+                self._cache.remove(tx)
+                self._logger.error("rejected valid tx; mempool full", err=str(err))
+            else:
+                mem_tx = MempoolTx(self._height, r.gas_wanted, tx)
+                if tx_info.sender_id:
+                    mem_tx.senders.add(tx_info.sender_id)
+                self._add_tx(mem_tx)
+                self._notify_txs_available()
+        else:
+            # invalid tx
+            if not self.config.keep_invalid_txs_in_cache:
+                self._cache.remove(tx)
+        if user_cb is not None:
+            user_cb(res)
+
+    def _add_tx(self, mem_tx: MempoolTx) -> None:
+        elem = self._txs.push_back(mem_tx)
+        with self._internal_mtx:
+            self._txs_map[tx_key(mem_tx.tx)] = elem
+            self._txs_bytes += len(mem_tx.tx)
+
+    def _remove_tx(self, tx: bytes, elem, remove_from_cache: bool) -> None:
+        self._txs.remove(elem)
+        with self._internal_mtx:
+            self._txs_map.pop(tx_key(tx), None)
+            self._txs_bytes -= len(tx)
+        if remove_from_cache:
+            self._cache.remove(tx)
+
+    def _notify_txs_available(self) -> None:
+        if self.size() == 0:
+            return
+        if self._txs_available is not None and not self._notified_txs_available:
+            self._notified_txs_available = True
+            self._txs_available.set()
+            if self.on_txs_available is not None:
+                self.on_txs_available()
+
+    # -- reaping -------------------------------------------------------------
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        """Reference: ReapMaxBytesMaxGas :521 — FIFO under byte+gas budget."""
+        with self._update_mtx:
+            txs: List[bytes] = []
+            total_bytes = 0
+            total_gas = 0
+            for elem in self._txs:
+                mem_tx: MempoolTx = elem.value
+                tx_sz = len(mem_tx.tx)
+                if max_bytes > -1 and total_bytes + tx_sz > max_bytes:
+                    break
+                new_gas = total_gas + mem_tx.gas_wanted
+                if max_gas > -1 and new_gas > max_gas:
+                    break
+                total_bytes += tx_sz
+                total_gas = new_gas
+                txs.append(mem_tx.tx)
+            return txs
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        with self._update_mtx:
+            if n < 0:
+                n = self.size()
+            out = []
+            for elem in self._txs:
+                if len(out) >= n:
+                    break
+                out.append(elem.value.tx)
+            return out
+
+    # -- update after a block commit ----------------------------------------
+
+    def update(
+        self,
+        height: int,
+        txs: List[bytes],
+        deliver_tx_responses: List[abci.ResponseDeliverTx],
+        pre_check: Optional[PreCheckFunc] = None,
+        post_check: Optional[PostCheckFunc] = None,
+    ) -> None:
+        """CONTRACT: caller holds lock() (reference: Update :579)."""
+        self._height = height
+        self._notified_txs_available = False
+        if self._txs_available is not None:
+            self._txs_available.clear()
+        if pre_check is not None:
+            self._pre_check = pre_check
+        if post_check is not None:
+            self._post_check = post_check
+
+        for i, tx in enumerate(txs):
+            ok = (
+                i < len(deliver_tx_responses)
+                and deliver_tx_responses[i].code == abci.CODE_TYPE_OK
+            )
+            if ok:
+                # committed txs are added to the cache so re-broadcasts are
+                # dropped (reference :597)
+                self._cache.push(tx)
+            elif not self.config.keep_invalid_txs_in_cache:
+                self._cache.remove(tx)
+            elem = self._txs_map.get(tx_key(tx))
+            if elem is not None:
+                self._remove_tx(tx, elem, remove_from_cache=False)
+
+        if self.size() > 0:
+            if self.config.recheck:
+                self._recheck_txs()
+            else:
+                self._notify_txs_available()
+
+    def _recheck_txs(self) -> None:
+        """Re-run CheckTx on surviving txs (reference: recheckTxs :641)."""
+        for elem in list(self._txs):
+            mem_tx: MempoolTx = elem.value
+            rr = self._proxy_app.check_tx_async(
+                abci.RequestCheckTx(
+                    tx=mem_tx.tx, type=abci.CHECK_TX_TYPE_RECHECK
+                )
+            )
+            rr.set_callback(
+                lambda res, _tx=mem_tx.tx, _e=elem: self._res_cb_recheck(_tx, _e, res)
+            )
+        self._proxy_app.flush_async()
+
+    def _res_cb_recheck(self, tx: bytes, elem, res) -> None:
+        if res.kind != "check_tx":
+            return
+        r: abci.ResponseCheckTx = res.value
+        post_err = None
+        if self._post_check is not None:
+            post_err = self._post_check(tx, r)
+        if r.code != abci.CODE_TYPE_OK or post_err is not None:
+            # tx became invalid
+            if tx_key(tx) in self._txs_map:
+                self._remove_tx(
+                    tx, elem,
+                    remove_from_cache=not self.config.keep_invalid_txs_in_cache,
+                )
+        self._notify_txs_available()
+
+    # -- app conn plumbing ---------------------------------------------------
+
+    def flush_app_conn(self) -> None:
+        self._proxy_app.flush_sync()
+
+    def flush(self) -> None:
+        """Drop everything (reference: Flush — RPC unsafe_flush_mempool)."""
+        with self._update_mtx:
+            self._cache.reset()
+            for elem in list(self._txs):
+                self._txs.remove(elem)
+            with self._internal_mtx:
+                self._txs_map.clear()
+                self._txs_bytes = 0
+
+    # -- gossip support ------------------------------------------------------
+
+    def txs_front(self):
+        return self._txs.front()
+
+    def txs_wait_chan(self):
+        return self._txs

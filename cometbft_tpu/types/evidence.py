@@ -116,6 +116,22 @@ class DuplicateVoteEvidence(Evidence):
     def time(self) -> Timestamp:
         return self.timestamp
 
+    def abci(self) -> list:
+        """Reference: DuplicateVoteEvidence.ABCI()."""
+        from cometbft_tpu.abci import types as abci_types
+
+        return [
+            abci_types.Misbehavior(
+                type=abci_types.EVIDENCE_TYPE_DUPLICATE_VOTE,
+                validator=abci_types.Validator(
+                    self.vote_a.validator_address, self.validator_power
+                ),
+                height=self.vote_a.height,
+                time=self.timestamp,
+                total_voting_power=self.total_voting_power,
+            )
+        ]
+
     def validate_basic(self) -> None:
         if self.vote_a is None or self.vote_b is None:
             raise ValueError("empty duplicate vote evidence")
@@ -161,6 +177,22 @@ class LightClientAttackEvidence(Evidence):
 
     def time(self) -> Timestamp:
         return self.timestamp
+
+    def abci(self) -> list:
+        """Reference: LightClientAttackEvidence.ABCI() — one entry per
+        byzantine validator."""
+        from cometbft_tpu.abci import types as abci_types
+
+        return [
+            abci_types.Misbehavior(
+                type=abci_types.EVIDENCE_TYPE_LIGHT_CLIENT_ATTACK,
+                validator=abci_types.Validator(v.address, v.voting_power),
+                height=self.common_height,
+                time=self.timestamp,
+                total_voting_power=self.total_voting_power,
+            )
+            for v in self.byzantine_validators
+        ]
 
     def validate_basic(self) -> None:
         if self.conflicting_block is None:
