@@ -1,0 +1,866 @@
+"""Consensus reactor — gossips the consensus protocol over four channels.
+
+Reference: consensus/reactor.go — channels State=0x20, Data=0x21, Vote=0x22,
+VoteSetBits=0x23 (:26-29); per-peer gossip threads for block data
+(gossipDataRoutine :564, incl. catch-up from the block store :671), votes
+(gossipVotesRoutine :723) and maj23 queries (queryMaj23Routine :856);
+broadcast of round-step/valid-block/has-vote on the state channel via the
+consensus state's internal hooks (subscribeToBroadcastEvents :435).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from cometbft_tpu.consensus.messages import (
+    BlockPartMessage,
+    HasVoteMessage,
+    NewRoundStepMessage,
+    NewValidBlockMessage,
+    ProposalMessage,
+    ProposalPOLMessage,
+    VoteMessage,
+    VoteSetBitsMessage,
+    VoteSetMaj23Message,
+    decode_consensus_message,
+    encode_consensus_message,
+)
+from cometbft_tpu.consensus.round_state import RoundState, RoundStepType
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.libs.bits import BitArray
+from cometbft_tpu.libs.log import Logger
+from cometbft_tpu.p2p.base_reactor import Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.peer import Peer
+from cometbft_tpu.types.block import Commit
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.vote import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+    Vote,
+)
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+PEER_STATE_KEY = "ConsensusReactor.peerState"
+PEER_GOSSIP_SLEEP = 0.1  # config/config.go:983 PeerGossipSleepDuration
+PEER_QUERY_MAJ23_SLEEP = 2.0  # config/config.go:984
+VOTES_TO_BECOME_GOOD_PEER = 10000
+BLOCKS_TO_BECOME_GOOD_PEER = 10000
+
+
+class CommitVoteReader:
+    """Adapts a stored Commit to the vote-set reader shape pick_send_vote
+    needs (reference: Commit implements VoteSetReader, types/block.go)."""
+
+    def __init__(self, commit: Commit):
+        self._commit = commit
+        self.height = commit.height
+        self.round = commit.round
+        self.signed_msg_type = SIGNED_MSG_TYPE_PRECOMMIT
+
+    def size(self) -> int:
+        return len(self._commit.signatures)
+
+    def is_commit(self) -> bool:
+        return True
+
+    def bit_array(self) -> BitArray:
+        ba = BitArray(len(self._commit.signatures))
+        for i, cs in enumerate(self._commit.signatures):
+            ba.set_index(i, not cs.is_absent())
+        return ba
+
+    def get_by_index(self, idx: int) -> Optional[Vote]:
+        if self._commit.signatures[idx].is_absent():
+            return None
+        return self._commit.get_vote(idx)
+
+
+class VoteSetReader:
+    """Uniform view over a live VoteSet (which is already reader-shaped)."""
+
+    @staticmethod
+    def wrap(vs):
+        return vs  # VoteSet already exposes the needed surface
+
+
+@dataclass
+class PeerRoundState:
+    """consensus/types/peer_round_state.go."""
+
+    height: int = 0
+    round: int = -1
+    step: RoundStepType = RoundStepType.NEW_HEIGHT
+    start_time: float = 0.0
+    proposal: bool = False
+    proposal_block_part_set_header: PartSetHeader = field(
+        default_factory=PartSetHeader
+    )
+    proposal_block_parts: Optional[BitArray] = None
+    proposal_pol_round: int = -1
+    proposal_pol: Optional[BitArray] = None
+    prevotes: Optional[BitArray] = None
+    precommits: Optional[BitArray] = None
+    last_commit_round: int = -1
+    last_commit: Optional[BitArray] = None
+    catchup_commit_round: int = -1
+    catchup_commit: Optional[BitArray] = None
+
+
+def compare_hrs(h1, r1, s1, h2, r2, s2) -> int:
+    """Reference: consensus/types/peer_round_state.go CompareHRS."""
+    if (h1, r1, int(s1)) < (h2, r2, int(s2)):
+        return -1
+    if (h1, r1, int(s1)) == (h2, r2, int(s2)):
+        return 0
+    return 1
+
+
+class PeerState:
+    """Known consensus state of one peer (reactor.go:1040 PeerState)."""
+
+    def __init__(self, peer: Peer):
+        self.peer = peer
+        self._mtx = threading.RLock()
+        self.prs = PeerRoundState()
+        self.stats_votes = 0
+        self.stats_block_parts = 0
+
+    def get_round_state(self) -> PeerRoundState:
+        with self._mtx:
+            import copy
+
+            return copy.copy(self.prs)
+
+    def get_height(self) -> int:
+        with self._mtx:
+            return self.prs.height
+
+    # -- setters ------------------------------------------------------------
+
+    def set_has_proposal(self, proposal) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height != proposal.height or prs.round != proposal.round:
+                return
+            if prs.proposal:
+                return
+            prs.proposal = True
+            if prs.proposal_block_parts is not None:
+                return  # already set by NewValidBlockMessage
+            prs.proposal_block_part_set_header = proposal.block_id.part_set_header
+            prs.proposal_block_parts = BitArray(
+                proposal.block_id.part_set_header.total
+            )
+            prs.proposal_pol_round = proposal.pol_round
+            prs.proposal_pol = None
+
+    def init_proposal_block_parts(self, header: PartSetHeader) -> None:
+        with self._mtx:
+            if self.prs.proposal_block_parts is not None:
+                return
+            self.prs.proposal_block_part_set_header = header
+            self.prs.proposal_block_parts = BitArray(header.total)
+
+    def set_has_proposal_block_part(
+        self, height: int, round_: int, index: int
+    ) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height != height or prs.round != round_:
+                return
+            if prs.proposal_block_parts is not None:
+                prs.proposal_block_parts.set_index(index, True)
+
+    def set_has_vote(self, vote: Vote) -> None:
+        with self._mtx:
+            self._set_has_vote(
+                vote.height, vote.round, vote.type, vote.validator_index
+            )
+
+    def _set_has_vote(self, height, round_, vote_type, index) -> None:
+        ba = self._get_vote_bit_array(height, round_, vote_type)
+        if ba is not None:
+            ba.set_index(index, True)
+
+    def _get_vote_bit_array(self, height, round_, vote_type):
+        prs = self.prs
+        if prs.height == height:
+            if prs.round == round_:
+                return (
+                    prs.prevotes
+                    if vote_type == SIGNED_MSG_TYPE_PREVOTE
+                    else prs.precommits
+                )
+            if prs.catchup_commit_round == round_:
+                if vote_type == SIGNED_MSG_TYPE_PRECOMMIT:
+                    return prs.catchup_commit
+                return None
+            if prs.proposal_pol_round == round_:
+                if vote_type == SIGNED_MSG_TYPE_PREVOTE:
+                    return prs.proposal_pol
+                return None
+            return None
+        if prs.height == height + 1:
+            if prs.last_commit_round == round_:
+                if vote_type == SIGNED_MSG_TYPE_PRECOMMIT:
+                    return prs.last_commit
+                return None
+            return None
+        return None
+
+    def ensure_vote_bit_arrays(self, height: int, num_validators: int) -> None:
+        with self._mtx:
+            self._ensure_vote_bit_arrays(height, num_validators)
+
+    def _ensure_vote_bit_arrays(self, height: int, num_validators: int) -> None:
+        prs = self.prs
+        if prs.height == height:
+            if prs.prevotes is None:
+                prs.prevotes = BitArray(num_validators)
+            if prs.precommits is None:
+                prs.precommits = BitArray(num_validators)
+            if prs.catchup_commit is None:
+                prs.catchup_commit = BitArray(num_validators)
+            if prs.proposal_pol is None:
+                prs.proposal_pol = BitArray(num_validators)
+        elif prs.height == height + 1:
+            if prs.last_commit is None:
+                prs.last_commit = BitArray(num_validators)
+
+    def _ensure_catchup_commit_round(self, height, round_, num_validators):
+        prs = self.prs
+        if prs.height != height:
+            return
+        if prs.catchup_commit_round == round_:
+            return
+        prs.catchup_commit_round = round_
+        if round_ == prs.round:
+            prs.catchup_commit = prs.precommits
+        else:
+            prs.catchup_commit = BitArray(num_validators)
+
+    # -- vote picking -------------------------------------------------------
+
+    def pick_send_vote(self, votes) -> bool:
+        """Pick a random vote the peer lacks, send it (reactor.go:1200)."""
+        picked = self.pick_vote_to_send(votes)
+        if picked is None:
+            return False
+        msg = encode_consensus_message(VoteMessage(vote=picked))
+        if self.peer.send(VOTE_CHANNEL, msg):
+            self.set_has_vote(picked)
+            return True
+        return False
+
+    def pick_vote_to_send(self, votes) -> Optional[Vote]:
+        with self._mtx:
+            if votes is None or votes.size() == 0:
+                return None
+            height, round_ = votes.height, votes.round
+            vote_type, size = votes.signed_msg_type, votes.size()
+            if getattr(votes, "is_commit", lambda: False)():
+                self._ensure_catchup_commit_round(height, round_, size)
+            self._ensure_vote_bit_arrays(height, size)
+            ps_votes = self._get_vote_bit_array(height, round_, vote_type)
+            if ps_votes is None:
+                return None
+            idx = votes.bit_array().sub(ps_votes).pick_random()
+            if idx is None:
+                return None
+            return votes.get_by_index(idx)
+
+    # -- message appliers ---------------------------------------------------
+
+    def apply_new_round_step(self, msg: NewRoundStepMessage) -> None:
+        with self._mtx:
+            prs = self.prs
+            if (
+                compare_hrs(
+                    msg.height, msg.round, msg.step,
+                    prs.height, prs.round, prs.step,
+                )
+                <= 0
+            ):
+                return
+            ps_height, ps_round = prs.height, prs.round
+            ps_catchup_round = prs.catchup_commit_round
+            ps_catchup_commit = prs.catchup_commit
+            last_precommits = prs.precommits
+
+            prs.height = msg.height
+            prs.round = msg.round
+            prs.step = RoundStepType(msg.step)
+            prs.start_time = time.monotonic() - msg.seconds_since_start_time
+            if ps_height != msg.height or ps_round != msg.round:
+                prs.proposal = False
+                prs.proposal_block_part_set_header = PartSetHeader()
+                prs.proposal_block_parts = None
+                prs.proposal_pol_round = -1
+                prs.proposal_pol = None
+                prs.prevotes = None
+                prs.precommits = None
+            if (
+                ps_height == msg.height
+                and ps_round != msg.round
+                and msg.round == ps_catchup_round
+            ):
+                prs.precommits = ps_catchup_commit
+            if ps_height != msg.height:
+                if ps_height + 1 == msg.height and ps_round == msg.last_commit_round:
+                    prs.last_commit_round = msg.last_commit_round
+                    prs.last_commit = last_precommits
+                else:
+                    prs.last_commit_round = msg.last_commit_round
+                    prs.last_commit = None
+                prs.catchup_commit_round = -1
+                prs.catchup_commit = None
+
+    def apply_new_valid_block(self, msg: NewValidBlockMessage) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height != msg.height:
+                return
+            if prs.round != msg.round and not msg.is_commit:
+                return
+            prs.proposal_block_part_set_header = msg.block_part_set_header
+            prs.proposal_block_parts = msg.block_parts
+
+    def apply_proposal_pol(self, msg: ProposalPOLMessage) -> None:
+        with self._mtx:
+            prs = self.prs
+            if prs.height != msg.height:
+                return
+            if prs.proposal_pol_round != msg.proposal_pol_round:
+                return
+            prs.proposal_pol = msg.proposal_pol
+
+    def apply_has_vote(self, msg: HasVoteMessage) -> None:
+        with self._mtx:
+            if self.prs.height != msg.height:
+                return
+            self._set_has_vote(msg.height, msg.round, msg.type, msg.index)
+
+    def apply_vote_set_bits(
+        self, msg: VoteSetBitsMessage, our_votes: Optional[BitArray]
+    ) -> None:
+        with self._mtx:
+            ba = self._get_vote_bit_array(msg.height, msg.round, msg.type)
+            if ba is None:
+                return
+            if our_votes is not None and msg.votes is not None:
+                # have = ourVotes | (theirVotes & msgVotes)
+                other_votes = ba.sub(our_votes)
+                has_votes = other_votes.or_(msg.votes)
+                ba.update(has_votes)
+            elif msg.votes is not None:
+                ba.update(msg.votes)
+
+    def record_vote(self) -> int:
+        with self._mtx:
+            self.stats_votes += 1
+            return self.stats_votes
+
+    def record_block_part(self) -> int:
+        with self._mtx:
+            self.stats_block_parts += 1
+            return self.stats_block_parts
+
+
+class ConsensusReactor(Reactor):
+    def __init__(
+        self,
+        cons_state: ConsensusState,
+        wait_sync: bool = False,
+        gossip_sleep: float = PEER_GOSSIP_SLEEP,
+        query_maj23_sleep: float = PEER_QUERY_MAJ23_SLEEP,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("ConsensusReactor", logger)
+        self.cons = cons_state
+        self._wait_sync = wait_sync
+        self._wait_sync_mtx = threading.Lock()
+        self.gossip_sleep = gossip_sleep
+        self.query_maj23_sleep = query_maj23_sleep
+
+    # -- reactor interface --------------------------------------------------
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                id=STATE_CHANNEL, priority=6,
+                send_queue_capacity=100,
+            ),
+            ChannelDescriptor(
+                id=DATA_CHANNEL, priority=10,
+                send_queue_capacity=100,
+            ),
+            ChannelDescriptor(
+                id=VOTE_CHANNEL, priority=7,
+                send_queue_capacity=100,
+            ),
+            ChannelDescriptor(
+                id=VOTE_SET_BITS_CHANNEL, priority=1,
+                send_queue_capacity=2,
+            ),
+        ]
+
+    def on_start(self) -> None:
+        self._subscribe_broadcast_hooks()
+        if not self.wait_sync():
+            if not self.cons.is_running():
+                self.cons.start()
+
+    def on_stop(self) -> None:
+        self._unsubscribe_broadcast_hooks()
+        if self.cons.is_running():
+            self.cons.stop()
+
+    def wait_sync(self) -> bool:
+        with self._wait_sync_mtx:
+            return self._wait_sync
+
+    def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
+        """Called by blocksync when caught up (reactor.go:108)."""
+        self.cons.update_to_state(state)
+        with self._wait_sync_mtx:
+            self._wait_sync = False
+        self.cons.start()
+        # let peers know where we are
+        rs = self.cons.get_round_state()
+        self._broadcast_new_round_step(rs)
+
+    # -- peer lifecycle -----------------------------------------------------
+
+    def init_peer(self, peer: Peer) -> Peer:
+        peer.set(PEER_STATE_KEY, PeerState(peer))
+        return peer
+
+    def add_peer(self, peer: Peer) -> None:
+        if not self.is_running():
+            return
+        ps: PeerState = peer.get(PEER_STATE_KEY)
+        for fn in (
+            self._gossip_data_routine,
+            self._gossip_votes_routine,
+            self._query_maj23_routine,
+        ):
+            threading.Thread(
+                target=fn, args=(peer, ps), daemon=True,
+                name=f"cons-gossip-{peer.id()[:6]}",
+            ).start()
+        if not self.wait_sync():
+            self._send_new_round_step(peer)
+
+    # -- receive ------------------------------------------------------------
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        if not self.is_running():
+            return
+        try:
+            msg = decode_consensus_message(msg_bytes)
+        except Exception as exc:  # noqa: BLE001
+            assert self.switch is not None
+            self.switch.stop_peer_for_error(peer, exc)
+            return
+        ps: PeerState = peer.get(PEER_STATE_KEY)
+        if ps is None:
+            return
+
+        if ch_id == STATE_CHANNEL:
+            self._receive_state(msg, peer, ps)
+        elif ch_id == DATA_CHANNEL:
+            if self.wait_sync():
+                return
+            self._receive_data(msg, peer, ps)
+        elif ch_id == VOTE_CHANNEL:
+            if self.wait_sync():
+                return
+            if isinstance(msg, VoteMessage):
+                cs = self.cons
+                with cs._mtx:
+                    height = cs.rs.height
+                    val_size = cs.rs.validators.size()
+                    last_commit_size = (
+                        cs.rs.last_commit.size() if cs.rs.last_commit else 0
+                    )
+                ps.ensure_vote_bit_arrays(height, val_size)
+                ps.ensure_vote_bit_arrays(height - 1, last_commit_size)
+                ps.set_has_vote(msg.vote)
+                self.cons.send_peer_message(msg, peer.id())
+        elif ch_id == VOTE_SET_BITS_CHANNEL:
+            if self.wait_sync():
+                return
+            if isinstance(msg, VoteSetBitsMessage):
+                cs = self.cons
+                with cs._mtx:
+                    height, votes = cs.rs.height, cs.rs.votes
+                if height == msg.height and votes is not None:
+                    if msg.type == SIGNED_MSG_TYPE_PREVOTE:
+                        vs = votes.prevotes(msg.round)
+                    else:
+                        vs = votes.precommits(msg.round)
+                    our = (
+                        vs.bit_array_by_block_id(msg.block_id)
+                        if vs is not None
+                        else None
+                    )
+                    ps.apply_vote_set_bits(msg, our)
+                else:
+                    ps.apply_vote_set_bits(msg, None)
+
+    def _receive_state(self, msg, peer: Peer, ps: PeerState) -> None:
+        if isinstance(msg, NewRoundStepMessage):
+            ps.apply_new_round_step(msg)
+        elif isinstance(msg, NewValidBlockMessage):
+            ps.apply_new_valid_block(msg)
+        elif isinstance(msg, HasVoteMessage):
+            ps.apply_has_vote(msg)
+        elif isinstance(msg, VoteSetMaj23Message):
+            cs = self.cons
+            with cs._mtx:
+                height, votes = cs.rs.height, cs.rs.votes
+            if height != msg.height or votes is None:
+                return
+            votes.set_peer_maj23(msg.round, msg.type, peer.id(), msg.block_id)
+            if msg.type == SIGNED_MSG_TYPE_PREVOTE:
+                vs = votes.prevotes(msg.round)
+            else:
+                vs = votes.precommits(msg.round)
+            our = (
+                vs.bit_array_by_block_id(msg.block_id) if vs is not None else None
+            )
+            reply = VoteSetBitsMessage(
+                height=msg.height,
+                round=msg.round,
+                type=msg.type,
+                block_id=msg.block_id,
+                votes=our,
+            )
+            peer.try_send(
+                VOTE_SET_BITS_CHANNEL, encode_consensus_message(reply)
+            )
+
+    def _receive_data(self, msg, peer: Peer, ps: PeerState) -> None:
+        if isinstance(msg, ProposalMessage):
+            ps.set_has_proposal(msg.proposal)
+            self.cons.send_peer_message(msg, peer.id())
+        elif isinstance(msg, ProposalPOLMessage):
+            ps.apply_proposal_pol(msg)
+        elif isinstance(msg, BlockPartMessage):
+            ps.set_has_proposal_block_part(
+                msg.height, msg.round, msg.part.index
+            )
+            if ps.record_block_part() % BLOCKS_TO_BECOME_GOOD_PEER == 0:
+                assert self.switch is not None
+                self.switch.mark_peer_as_good(peer)
+            self.cons.send_peer_message(msg, peer.id())
+
+    # -- broadcast hooks ----------------------------------------------------
+
+    def _subscribe_broadcast_hooks(self) -> None:
+        self.cons.on_new_round_step = self._broadcast_new_round_step
+        self.cons.on_valid_block = self._broadcast_new_valid_block
+        self.cons.on_has_vote = self._broadcast_has_vote
+
+    def _unsubscribe_broadcast_hooks(self) -> None:
+        self.cons.on_new_round_step = None
+        self.cons.on_valid_block = None
+        self.cons.on_has_vote = None
+
+    def _make_round_step_message(self, rs: RoundState) -> NewRoundStepMessage:
+        last_commit_round = (
+            rs.last_commit.round if rs.last_commit is not None else -1
+        )
+        return NewRoundStepMessage(
+            height=rs.height,
+            round=rs.round,
+            step=int(rs.step),
+            seconds_since_start_time=max(
+                int(time.monotonic() - rs.start_time), 0
+            ),
+            last_commit_round=last_commit_round,
+        )
+
+    def _broadcast_new_round_step(self, rs: RoundState) -> None:
+        if self.switch is None:
+            return
+        msg = encode_consensus_message(self._make_round_step_message(rs))
+        self.switch.broadcast(STATE_CHANNEL, msg)
+
+    def _broadcast_new_valid_block(self, rs: RoundState) -> None:
+        if self.switch is None or rs.proposal_block_parts is None:
+            return
+        msg = NewValidBlockMessage(
+            height=rs.height,
+            round=rs.round,
+            block_part_set_header=rs.proposal_block_parts.header(),
+            block_parts=rs.proposal_block_parts.bit_array(),
+            is_commit=rs.step == RoundStepType.COMMIT,
+        )
+        self.switch.broadcast(STATE_CHANNEL, encode_consensus_message(msg))
+
+    def _broadcast_has_vote(self, vote: Vote) -> None:
+        if self.switch is None:
+            return
+        msg = HasVoteMessage(
+            height=vote.height,
+            round=vote.round,
+            type=vote.type,
+            index=vote.validator_index,
+        )
+        self.switch.broadcast(STATE_CHANNEL, encode_consensus_message(msg))
+
+    def _send_new_round_step(self, peer: Peer) -> None:
+        rs = self.cons.get_round_state()
+        msg = encode_consensus_message(self._make_round_step_message(rs))
+        peer.send(STATE_CHANNEL, msg)
+
+    # -- gossip routines ----------------------------------------------------
+
+    def _gossip_data_routine(self, peer: Peer, ps: PeerState) -> None:
+        while peer.is_running() and self.is_running():
+            rs = self.cons.get_round_state()
+            prs = ps.get_round_state()
+
+            # send proposal block parts the peer lacks
+            if (
+                rs.proposal_block_parts is not None
+                and rs.proposal_block_parts.has_header(
+                    prs.proposal_block_part_set_header
+                )
+                and prs.proposal_block_parts is not None
+            ):
+                idx = (
+                    rs.proposal_block_parts.bit_array()
+                    .sub(prs.proposal_block_parts)
+                    .pick_random()
+                )
+                if idx is not None:
+                    part = rs.proposal_block_parts.get_part(idx)
+                    if part is not None:
+                        msg = BlockPartMessage(
+                            height=rs.height, round=rs.round, part=part
+                        )
+                        if peer.send(
+                            DATA_CHANNEL, encode_consensus_message(msg)
+                        ):
+                            ps.set_has_proposal_block_part(
+                                prs.height, prs.round, idx
+                            )
+                        continue
+
+            # peer on an earlier height we have: catch it up from the store
+            store = self.cons.block_store
+            base = store.base() if store is not None else 0
+            if (
+                store is not None
+                and base > 0
+                and 0 < prs.height < rs.height
+                and prs.height >= base
+            ):
+                if prs.proposal_block_parts is None:
+                    meta = store.load_block_meta(prs.height)
+                    if meta is not None:
+                        ps.init_proposal_block_parts(
+                            meta.block_id.part_set_header
+                        )
+                    else:
+                        time.sleep(self.gossip_sleep)
+                    continue
+                self._gossip_data_for_catchup(rs, prs, ps, peer)
+                continue
+
+            if rs.height != prs.height or rs.round != prs.round:
+                time.sleep(self.gossip_sleep)
+                continue
+
+            # send the Proposal (+POL) itself
+            if rs.proposal is not None and not prs.proposal:
+                msg = ProposalMessage(proposal=rs.proposal)
+                if peer.send(DATA_CHANNEL, encode_consensus_message(msg)):
+                    ps.set_has_proposal(rs.proposal)
+                if rs.proposal.pol_round >= 0 and rs.votes is not None:
+                    pol = rs.votes.prevotes(rs.proposal.pol_round)
+                    if pol is not None:
+                        pol_msg = ProposalPOLMessage(
+                            height=rs.height,
+                            proposal_pol_round=rs.proposal.pol_round,
+                            proposal_pol=pol.bit_array(),
+                        )
+                        peer.send(
+                            DATA_CHANNEL, encode_consensus_message(pol_msg)
+                        )
+                continue
+
+            time.sleep(self.gossip_sleep)
+
+    def _gossip_data_for_catchup(self, rs, prs, ps: PeerState, peer: Peer):
+        """reactor.go:671 gossipDataForCatchup."""
+        store = self.cons.block_store
+        idx = prs.proposal_block_parts.not_().pick_random()
+        if idx is None:
+            time.sleep(self.gossip_sleep)
+            return
+        meta = store.load_block_meta(prs.height)
+        if meta is None or not (
+            meta.block_id.part_set_header == prs.proposal_block_part_set_header
+        ):
+            time.sleep(self.gossip_sleep)
+            return
+        part = store.load_block_part(prs.height, idx)
+        if part is None:
+            time.sleep(self.gossip_sleep)
+            return
+        msg = BlockPartMessage(height=prs.height, round=prs.round, part=part)
+        if peer.send(DATA_CHANNEL, encode_consensus_message(msg)):
+            ps.set_has_proposal_block_part(prs.height, prs.round, idx)
+        else:
+            time.sleep(self.gossip_sleep)
+
+    def _gossip_votes_routine(self, peer: Peer, ps: PeerState) -> None:
+        while peer.is_running() and self.is_running():
+            rs = self.cons.get_round_state()
+            prs = ps.get_round_state()
+
+            if rs.height == prs.height:
+                if self._gossip_votes_for_height(rs, prs, ps):
+                    continue
+
+            # peer lagging by one: send our last commit votes
+            if (
+                prs.height != 0
+                and rs.height == prs.height + 1
+                and rs.last_commit is not None
+            ):
+                if ps.pick_send_vote(rs.last_commit):
+                    continue
+
+            # peer lagging by 2+: send the stored commit
+            store = self.cons.block_store
+            base = store.base() if store is not None else 0
+            if (
+                store is not None
+                and base > 0
+                and prs.height != 0
+                and rs.height >= prs.height + 2
+                and prs.height >= base
+            ):
+                commit = store.load_block_commit(prs.height)
+                if commit is not None and ps.pick_send_vote(
+                    CommitVoteReader(commit)
+                ):
+                    continue
+
+            time.sleep(self.gossip_sleep)
+
+    def _gossip_votes_for_height(self, rs, prs, ps: PeerState) -> bool:
+        """reactor.go:797 gossipVotesForHeight."""
+        votes = rs.votes
+        if votes is None:
+            return False
+        # last commit to a peer still in NewHeight
+        if prs.step == RoundStepType.NEW_HEIGHT and rs.last_commit is not None:
+            if ps.pick_send_vote(rs.last_commit):
+                return True
+        # POL prevotes
+        if (
+            prs.step <= RoundStepType.PROPOSE
+            and prs.round != -1
+            and prs.round <= rs.round
+            and prs.proposal_pol_round != -1
+        ):
+            pol = votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and ps.pick_send_vote(pol):
+                return True
+        # prevotes
+        if (
+            prs.step <= RoundStepType.PREVOTE_WAIT
+            and prs.round != -1
+            and prs.round <= rs.round
+        ):
+            vs = votes.prevotes(prs.round)
+            if vs is not None and ps.pick_send_vote(vs):
+                return True
+        # precommits
+        if (
+            prs.step <= RoundStepType.PRECOMMIT_WAIT
+            and prs.round != -1
+            and prs.round <= rs.round
+        ):
+            vs = votes.precommits(prs.round)
+            if vs is not None and ps.pick_send_vote(vs):
+                return True
+        # prevotes again (valid-block mechanism)
+        if prs.round != -1 and prs.round <= rs.round:
+            vs = votes.prevotes(prs.round)
+            if vs is not None and ps.pick_send_vote(vs):
+                return True
+        # POL prevotes again
+        if prs.proposal_pol_round != -1:
+            pol = votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and ps.pick_send_vote(pol):
+                return True
+        return False
+
+    def _query_maj23_routine(self, peer: Peer, ps: PeerState) -> None:
+        while peer.is_running() and self.is_running():
+            rs = self.cons.get_round_state()
+            prs = ps.get_round_state()
+            if rs.height == prs.height and rs.votes is not None:
+                # prevotes
+                vs = rs.votes.prevotes(prs.round)
+                if vs is not None:
+                    maj23, ok = vs.two_thirds_majority()
+                    if ok:
+                        self._send_maj23(
+                            peer, prs.height, prs.round,
+                            SIGNED_MSG_TYPE_PREVOTE, maj23,
+                        )
+                # precommits
+                vs = rs.votes.precommits(prs.round)
+                if vs is not None:
+                    maj23, ok = vs.two_thirds_majority()
+                    if ok:
+                        self._send_maj23(
+                            peer, prs.height, prs.round,
+                            SIGNED_MSG_TYPE_PRECOMMIT, maj23,
+                        )
+                # POL prevotes
+                if prs.proposal_pol_round >= 0:
+                    vs = rs.votes.prevotes(prs.proposal_pol_round)
+                    if vs is not None:
+                        maj23, ok = vs.two_thirds_majority()
+                        if ok:
+                            self._send_maj23(
+                                peer, prs.height, prs.proposal_pol_round,
+                                SIGNED_MSG_TYPE_PREVOTE, maj23,
+                            )
+            # catchup commit
+            store = self.cons.block_store
+            if (
+                store is not None
+                and prs.catchup_commit_round != -1
+                and 0 < prs.height <= store.height()
+                and prs.height >= store.base()
+            ):
+                commit = store.load_block_commit(prs.height)
+                if commit is not None:
+                    self._send_maj23(
+                        peer, prs.height, commit.round,
+                        SIGNED_MSG_TYPE_PRECOMMIT, commit.block_id,
+                    )
+            time.sleep(self.query_maj23_sleep)
+
+    def _send_maj23(self, peer, height, round_, vote_type, block_id) -> None:
+        msg = VoteSetMaj23Message(
+            height=height, round=round_, type=vote_type, block_id=block_id
+        )
+        peer.try_send(STATE_CHANNEL, encode_consensus_message(msg))

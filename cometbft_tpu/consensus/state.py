@@ -174,7 +174,17 @@ class ConsensusState(BaseService):
         self.peer_msg_queue.put(MsgInfo(msg, peer_id))
 
     def send_internal(self, msg) -> None:
-        self.internal_msg_queue.put(MsgInfo(msg, ""))
+        # Never block: the only consumer is the receive thread, which may be
+        # the caller (via _decide_proposal) — a blocking put on a full queue
+        # would deadlock the node. Mirror sendInternalMessage's goroutine
+        # fallback (reference consensus/state.go:1181-1190).
+        mi = MsgInfo(msg, "")
+        try:
+            self.internal_msg_queue.put_nowait(mi)
+        except queue.Full:
+            threading.Thread(
+                target=self.internal_msg_queue.put, args=(mi,), daemon=True
+            ).start()
 
     def notify_txs_available(self) -> None:
         """Mempool → consensus: txs exist (for CreateEmptyBlocks=false)."""
@@ -269,6 +279,8 @@ class ConsensusState(BaseService):
     def _handle_txs_available(self) -> None:
         """Reference: handleTxsAvailable :947-972."""
         rs = self.rs
+        if rs.round != 0:  # only the first round of a height waits on txs (:953)
+            return
         if rs.step == RoundStepType.NEW_HEIGHT:
             # still in the commit window from the prior block: preserve the
             # remaining timeout_commit (+1ms), don't truncate it (:964)

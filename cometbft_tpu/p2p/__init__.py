@@ -1,0 +1,40 @@
+"""TCP p2p stack: authenticated encrypted transport, multiplexed prioritized
+connections, switch/reactor registry, peer exchange.
+
+Reference: /root/reference/p2p (transport.go, conn/, switch.go, peer.go,
+pex/). The gossip plane stays CPU/TCP-side by design — the TPU device plane
+(crypto.tpu) is internal to verification, per SURVEY.md §2.16.
+"""
+
+from cometbft_tpu.p2p.base_reactor import Reactor
+from cometbft_tpu.p2p.conn.connection import (
+    ChannelDescriptor,
+    MConnConfig,
+    MConnection,
+)
+from cometbft_tpu.p2p.conn.secret_connection import SecretConnection
+from cometbft_tpu.p2p.key import NodeKey, pub_key_to_id
+from cometbft_tpu.p2p.netaddr import NetAddress
+from cometbft_tpu.p2p.node_info import NodeInfo, NodeInfoOther, ProtocolVersion
+from cometbft_tpu.p2p.peer import Peer
+from cometbft_tpu.p2p.switch import PeerSet, Switch
+from cometbft_tpu.p2p.transport import MultiplexTransport, RejectedError
+
+__all__ = [
+    "ChannelDescriptor",
+    "MConnConfig",
+    "MConnection",
+    "MultiplexTransport",
+    "NetAddress",
+    "NodeInfo",
+    "NodeInfoOther",
+    "NodeKey",
+    "Peer",
+    "PeerSet",
+    "ProtocolVersion",
+    "Reactor",
+    "RejectedError",
+    "SecretConnection",
+    "Switch",
+    "pub_key_to_id",
+]

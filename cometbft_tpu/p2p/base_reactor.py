@@ -1,0 +1,43 @@
+"""Reactor interface.
+
+Reference: p2p/base_reactor.go — a Reactor handles one or more message
+channels; the Switch calls InitPeer/AddPeer/RemovePeer on peer lifecycle and
+Receive (on the connection's recv thread) for each complete inbound message.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, List, Optional
+
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+
+if TYPE_CHECKING:
+    from cometbft_tpu.p2p.peer import Peer
+    from cometbft_tpu.p2p.switch import Switch
+
+
+class Reactor(BaseService):
+    def __init__(self, name: str, logger: Optional[Logger] = None):
+        super().__init__(name, logger or new_nop_logger())
+        self.switch: Optional["Switch"] = None
+
+    def set_switch(self, sw: "Switch") -> None:
+        self.switch = sw
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        raise NotImplementedError
+
+    def init_peer(self, peer: "Peer") -> "Peer":
+        """Called before the peer starts; may set peer data."""
+        return peer
+
+    def add_peer(self, peer: "Peer") -> None:
+        """Called after the peer is started and added to the peer set."""
+
+    def remove_peer(self, peer: "Peer", reason: object) -> None:
+        """Called after the peer is removed."""
+
+    def receive(self, ch_id: int, peer: "Peer", msg_bytes: bytes) -> None:
+        """Called (on the peer's recv thread) for each complete message."""

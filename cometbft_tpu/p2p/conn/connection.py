@@ -1,0 +1,470 @@
+"""Multiplexed prioritized connection (MConnection).
+
+Reference: p2p/conn/connection.go:66 — one TCP/secret connection carries many
+abstract Channels, each with a byte ID and a relative priority. Outbound
+messages are chopped into <=1024-byte PacketMsgs; the send routine repeatedly
+picks the channel with the least recentlySent/priority ratio (connection.go
+sendPacketMsg), batches 10 packets between flow-rate checks, and throttles
+flushes. Ping/pong keepalive with a pong timeout; flowrate monitors bound
+send/recv throughput (500 KB/s default).
+
+Wire format: varint-delimited tendermint.p2p.Packet protos
+(proto/tendermint/p2p/conn.proto).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.libs.flowrate import Monitor
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.libs.service import BaseService
+
+DEFAULT_MAX_PACKET_MSG_PAYLOAD_SIZE = 1024
+NUM_BATCH_PACKET_MSGS = 10
+DEFAULT_SEND_QUEUE_CAPACITY = 1
+DEFAULT_RECV_MESSAGE_CAPACITY = 22020096  # 21 MB
+DEFAULT_SEND_RATE = 512000  # 500 KB/s
+DEFAULT_RECV_RATE = 512000
+DEFAULT_SEND_TIMEOUT = 10.0
+DEFAULT_PING_INTERVAL = 60.0
+DEFAULT_PONG_TIMEOUT = 45.0
+DEFAULT_FLUSH_THROTTLE = 0.1
+UPDATE_STATS_INTERVAL = 2.0
+
+
+# -- Packet proto -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class PacketMsg:
+    channel_id: int
+    eof: bool
+    data: bytes
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.channel_id:
+            out += protoio.field_varint(1, self.channel_id)
+        if self.eof:
+            out += protoio.field_varint(2, 1)
+        if self.data:
+            out += protoio.field_bytes(3, self.data)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PacketMsg":
+        r = protoio.WireReader(data)
+        ch, eof, payload = 0, False, b""
+        while not r.at_end():
+            fnum, wt = r.read_tag()
+            if fnum == 1:
+                ch = r.read_varint()
+            elif fnum == 2:
+                eof = bool(r.read_varint())
+            elif fnum == 3:
+                payload = r.read_bytes()
+            else:
+                r.skip(wt)
+        return cls(ch, eof, payload)
+
+
+PACKET_PING = "ping"
+PACKET_PONG = "pong"
+
+
+def wrap_packet_ping() -> bytes:
+    return protoio.field_message(1, b"")
+
+
+def wrap_packet_pong() -> bytes:
+    return protoio.field_message(2, b"")
+
+
+def wrap_packet_msg(pm: PacketMsg) -> bytes:
+    return protoio.field_message(3, pm.encode())
+
+
+def unwrap_packet(data: bytes):
+    """→ ("ping"|"pong", None) or ("msg", PacketMsg)."""
+    r = protoio.WireReader(data)
+    while not r.at_end():
+        fnum, wt = r.read_tag()
+        if fnum == 1:
+            r.read_bytes()
+            return PACKET_PING, None
+        if fnum == 2:
+            r.read_bytes()
+            return PACKET_PONG, None
+        if fnum == 3:
+            return "msg", PacketMsg.decode(r.read_bytes())
+        r.skip(wt)
+    raise ValueError("empty Packet")
+
+
+# -- config / channel descriptors -------------------------------------------
+
+
+@dataclass
+class MConnConfig:
+    send_rate: int = DEFAULT_SEND_RATE
+    recv_rate: int = DEFAULT_RECV_RATE
+    max_packet_msg_payload_size: int = DEFAULT_MAX_PACKET_MSG_PAYLOAD_SIZE
+    flush_throttle: float = DEFAULT_FLUSH_THROTTLE
+    ping_interval: float = DEFAULT_PING_INTERVAL
+    pong_timeout: float = DEFAULT_PONG_TIMEOUT
+
+
+@dataclass
+class ChannelDescriptor:
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = DEFAULT_SEND_QUEUE_CAPACITY
+    recv_message_capacity: int = DEFAULT_RECV_MESSAGE_CAPACITY
+
+
+class Channel:
+    """One logical channel inside an MConnection (connection.go:744)."""
+
+    def __init__(self, desc: ChannelDescriptor, max_payload: int):
+        if desc.priority <= 0:
+            raise ValueError("channel priority must be positive")
+        self.desc = desc
+        self.send_queue: "queue.Queue[bytes]" = queue.Queue(
+            desc.send_queue_capacity
+        )
+        self.recving = bytearray()
+        self.sending: Optional[bytes] = None
+        self.recently_sent = 0.0  # EMA for priority scheduling
+        self.max_payload = max_payload
+
+    def send_bytes(self, data: bytes, timeout: float = DEFAULT_SEND_TIMEOUT) -> bool:
+        try:
+            self.send_queue.put(data, timeout=timeout)
+            return True
+        except queue.Full:
+            return False
+
+    def try_send_bytes(self, data: bytes) -> bool:
+        try:
+            self.send_queue.put_nowait(data)
+            return True
+        except queue.Full:
+            return False
+
+    def can_send(self) -> bool:
+        return self.send_queue.qsize() < self.desc.send_queue_capacity
+
+    def is_send_pending(self) -> bool:
+        if self.sending is None:
+            try:
+                self.sending = self.send_queue.get_nowait()
+            except queue.Empty:
+                return False
+        return True
+
+    def next_packet_msg(self) -> PacketMsg:
+        assert self.sending is not None
+        data = self.sending[: self.max_payload]
+        if len(self.sending) <= self.max_payload:
+            pm = PacketMsg(self.desc.id, True, bytes(data))
+            self.sending = None
+        else:
+            pm = PacketMsg(self.desc.id, False, bytes(data))
+            self.sending = self.sending[self.max_payload :]
+        return pm
+
+    def recv_packet_msg(self, pm: PacketMsg) -> Optional[bytes]:
+        if len(self.recving) + len(pm.data) > self.desc.recv_message_capacity:
+            raise ValueError(
+                f"received message exceeds available capacity: "
+                f"{self.desc.recv_message_capacity} < "
+                f"{len(self.recving) + len(pm.data)}"
+            )
+        self.recving.extend(pm.data)
+        if pm.eof:
+            msg = bytes(self.recving)
+            self.recving.clear()
+            return msg
+        return None
+
+    def update_stats(self) -> None:
+        self.recently_sent *= 0.8
+
+
+# -- MConnection ------------------------------------------------------------
+
+
+class MConnection(BaseService):
+    """Multiplexed connection over a stream with read_exact/write/close.
+
+    on_receive(ch_id, msg_bytes) runs on the recv thread (same contract as the
+    reference: reactor Receive executes on the p2p recv routine).
+    """
+
+    def __init__(
+        self,
+        conn,
+        ch_descs: List[ChannelDescriptor],
+        on_receive: Callable[[int, bytes], None],
+        on_error: Callable[[Exception], None],
+        config: Optional[MConnConfig] = None,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("MConn", logger or new_nop_logger())
+        self.conn = conn
+        self.config = config or MConnConfig()
+        self.channels: List[Channel] = []
+        self.channels_idx: Dict[int, Channel] = {}
+        for desc in ch_descs:
+            ch = Channel(desc, self.config.max_packet_msg_payload_size)
+            self.channels.append(ch)
+            self.channels_idx[desc.id] = ch
+        self.on_receive = on_receive
+        self.on_error = on_error
+        self.send_monitor = Monitor()
+        self.recv_monitor = Monitor()
+        self._send_signal = threading.Event()
+        self._pong_pending = threading.Event()
+        self._pong_deadline: Optional[float] = None
+        self._errored = False
+        self._err_mtx = threading.Lock()
+        self._write_mtx = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        # max wire size of one packet (payload + proto overhead)
+        self._max_packet_msg_size = (
+            self.config.max_packet_msg_payload_size + 16
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def on_start(self) -> None:
+        for fn, name in (
+            (self._send_routine, "mconn-send"),
+            (self._recv_routine, "mconn-recv"),
+        ):
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def on_stop(self) -> None:
+        self._send_signal.set()
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+    def flush_stop(self) -> None:
+        """Best-effort: drain pending sends before stopping (FlushStop)."""
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            if not any(ch.is_send_pending() for ch in self.channels):
+                break
+            self._send_signal.set()
+            time.sleep(0.01)
+        self.stop()
+
+    def _stop_for_error(self, err: Exception) -> None:
+        with self._err_mtx:
+            if self._errored:
+                return
+            self._errored = True
+        if self.is_running():
+            try:
+                self.stop()
+            except Exception:
+                pass
+        self.on_error(err)
+
+    # -- send API -----------------------------------------------------------
+
+    def send(self, ch_id: int, msg_bytes: bytes) -> bool:
+        if not self.is_running():
+            return False
+        ch = self.channels_idx.get(ch_id)
+        if ch is None:
+            self.logger.error("cannot send to unknown channel", ch=ch_id)
+            return False
+        ok = ch.send_bytes(msg_bytes)
+        if ok:
+            self._send_signal.set()
+        return ok
+
+    def try_send(self, ch_id: int, msg_bytes: bytes) -> bool:
+        if not self.is_running():
+            return False
+        ch = self.channels_idx.get(ch_id)
+        if ch is None:
+            return False
+        ok = ch.try_send_bytes(msg_bytes)
+        if ok:
+            self._send_signal.set()
+        return ok
+
+    def can_send(self, ch_id: int) -> bool:
+        ch = self.channels_idx.get(ch_id)
+        return ch.can_send() if ch is not None else False
+
+    # -- routines -----------------------------------------------------------
+
+    def _write_packet(self, packet_bytes: bytes) -> int:
+        framed = protoio.marshal_delimited(packet_bytes)
+        with self._write_mtx:
+            self.conn.write(framed)
+        return len(framed)
+
+    def _send_routine(self) -> None:
+        last_ping = time.monotonic()
+        last_stats = time.monotonic()
+        try:
+            while self.is_running():
+                now = time.monotonic()
+                if now - last_stats >= UPDATE_STATS_INTERVAL:
+                    for ch in self.channels:
+                        ch.update_stats()
+                    last_stats = now
+                if now - last_ping >= self.config.ping_interval:
+                    n = self._write_packet(wrap_packet_ping())
+                    self.send_monitor.update(n)
+                    self._pong_deadline = now + self.config.pong_timeout
+                    last_ping = now
+                if self._pong_pending.is_set():
+                    self._pong_pending.clear()
+                    n = self._write_packet(wrap_packet_pong())
+                    self.send_monitor.update(n)
+                if (
+                    self._pong_deadline is not None
+                    and now > self._pong_deadline
+                ):
+                    raise TimeoutError("pong timeout")
+                exhausted = self._send_some_packet_msgs()
+                if exhausted:
+                    self._send_signal.wait(0.05)
+                    self._send_signal.clear()
+        except Exception as exc:
+            if self.is_running():
+                self._stop_for_error(exc)
+
+    def _send_some_packet_msgs(self) -> bool:
+        self.send_monitor.limit(
+            self._max_packet_msg_size, self.config.send_rate, True
+        )
+        for _ in range(NUM_BATCH_PACKET_MSGS):
+            if self._send_packet_msg():
+                return True
+        return False
+
+    def _send_packet_msg(self) -> bool:
+        """Send one packet from the least-ratio channel; True if exhausted."""
+        least_ratio = float("inf")
+        least_channel: Optional[Channel] = None
+        for ch in self.channels:
+            if not ch.is_send_pending():
+                continue
+            ratio = ch.recently_sent / ch.desc.priority
+            if ratio < least_ratio:
+                least_ratio = ratio
+                least_channel = ch
+        if least_channel is None:
+            return True
+        pm = least_channel.next_packet_msg()
+        n = self._write_packet(wrap_packet_msg(pm))
+        least_channel.recently_sent += n
+        self.send_monitor.update(n)
+        return False
+
+    def _read_delimited(self) -> bytes:
+        length = 0
+        shift = 0
+        while True:
+            b = self.conn.read_exact(1)
+            length |= (b[0] & 0x7F) << shift
+            if not b[0] & 0x80:
+                break
+            shift += 7
+            if shift > 63:
+                raise ValueError("varint overflow")
+        if length > self._max_packet_msg_size * 2:
+            raise ValueError(f"packet too large: {length}")
+        return self.conn.read_exact(length)
+
+    def _recv_routine(self) -> None:
+        try:
+            while self.is_running():
+                self.recv_monitor.limit(
+                    self._max_packet_msg_size, self.config.recv_rate, True
+                )
+                data = self._read_delimited()
+                self.recv_monitor.update(len(data))
+                kind, pm = unwrap_packet(data)
+                if kind == PACKET_PING:
+                    self._pong_pending.set()
+                    self._send_signal.set()
+                elif kind == PACKET_PONG:
+                    self._pong_deadline = None
+                else:
+                    assert pm is not None
+                    ch = self.channels_idx.get(pm.channel_id)
+                    if ch is None:
+                        raise ValueError(f"unknown channel {pm.channel_id:#x}")
+                    msg_bytes = ch.recv_packet_msg(pm)
+                    if msg_bytes is not None:
+                        self.on_receive(pm.channel_id, msg_bytes)
+        except Exception as exc:
+            if self.is_running():
+                self._stop_for_error(exc)
+
+    # -- status -------------------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "send": self.send_monitor.status(),
+            "recv": self.recv_monitor.status(),
+            "channels": [
+                {
+                    "id": ch.desc.id,
+                    "priority": ch.desc.priority,
+                    "send_queue_size": ch.send_queue.qsize(),
+                    "recently_sent": int(ch.recently_sent),
+                }
+                for ch in self.channels
+            ],
+        }
+
+
+class SocketStream:
+    """Adapter giving a plain socket the read_exact/write/close interface."""
+
+    def __init__(self, sock):
+        self._sock = sock
+
+    def write(self, data: bytes) -> int:
+        self._sock.sendall(data)
+        return len(data)
+
+    def read_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed mid-read")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def close(self) -> None:
+        # shutdown first: close() alone does not interrupt a recv() blocked
+        # in another thread, and the peer would never see EOF
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

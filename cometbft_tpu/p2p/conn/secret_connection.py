@@ -1,0 +1,277 @@
+"""Authenticated encrypted connections (STS protocol).
+
+Reference: p2p/conn/secret_connection.go:92 MakeSecretConnection — X25519
+ephemeral DH, merlin transcript binding, HKDF-SHA256 key derivation into two
+ChaCha20-Poly1305 AEADs (one per direction), 1024-byte frames with a 4-byte
+little-endian length prefix, and an ed25519 signature over the 32-byte
+transcript challenge to authenticate the long-term node key.
+
+Wire-compatible with the reference: same labels, same HKDF info string, same
+frame layout, same nonce schedule (64-bit LE counter in nonce[4:12]).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Optional, Tuple
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey,
+    X25519PublicKey,
+)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+from cryptography.hazmat.primitives.hashes import SHA256
+from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.crypto.merlin import Transcript
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.proto.keys import (
+    PublicKeyProto,
+    pub_key_from_proto,
+    pub_key_to_proto,
+)
+
+DATA_LEN_SIZE = 4
+DATA_MAX_SIZE = 1024
+TOTAL_FRAME_SIZE = DATA_MAX_SIZE + DATA_LEN_SIZE
+AEAD_SIZE_OVERHEAD = 16
+AEAD_NONCE_SIZE = 12
+
+_LABEL_EPH_LO = b"EPHEMERAL_LOWER_PUBLIC_KEY"
+_LABEL_EPH_HI = b"EPHEMERAL_UPPER_PUBLIC_KEY"
+_LABEL_DH_SECRET = b"DH_SECRET"
+_LABEL_MAC = b"SECRET_CONNECTION_MAC"
+_HKDF_INFO = b"TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
+_TRANSCRIPT_LABEL = b"TENDERMINT_SECRET_CONNECTION_TRANSCRIPT_HASH"
+
+
+class HandshakeError(Exception):
+    pass
+
+
+class SmallOrderRemotePubKey(HandshakeError):
+    """Low-order X25519 point from the remote peer (secret_connection.go:44)."""
+
+
+def _read_exact(sock, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed mid-read")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _read_delimited_from_sock(sock, max_size: int) -> bytes:
+    """protoio varint-delimited read directly off a socket."""
+    length = 0
+    shift = 0
+    while True:
+        b = sock.recv(1)
+        if not b:
+            raise ConnectionError("connection closed mid-varint")
+        length |= (b[0] & 0x7F) << shift
+        if not b[0] & 0x80:
+            break
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint overflow")
+    if length > max_size:
+        raise ValueError(f"message too large: {length} > {max_size}")
+    return _read_exact(sock, length)
+
+
+class _Nonce:
+    """96-bit AEAD nonce: zero prefix + 64-bit LE counter in bytes 4:12."""
+
+    __slots__ = ("counter",)
+
+    def __init__(self) -> None:
+        self.counter = 0
+
+    def bytes(self) -> bytes:
+        return b"\x00\x00\x00\x00" + struct.pack("<Q", self.counter)
+
+    def incr(self) -> None:
+        self.counter += 1
+        if self.counter >= 1 << 64:
+            raise OverflowError("AEAD nonce overflow; terminate session")
+
+
+class SecretConnection:
+    """Encrypted, authenticated stream over a socket-like object.
+
+    The socket must provide ``recv``, ``sendall`` and ``close``.
+    """
+
+    def __init__(self, sock, send_key: bytes, recv_key: bytes, rem_pub_key):
+        self._sock = sock
+        self._send_aead = ChaCha20Poly1305(send_key)
+        self._recv_aead = ChaCha20Poly1305(recv_key)
+        self._send_nonce = _Nonce()
+        self._recv_nonce = _Nonce()
+        self._send_mtx = threading.Lock()
+        self._recv_mtx = threading.Lock()
+        self._recv_buffer = b""
+        self.rem_pub_key = rem_pub_key
+
+    # -- handshake -----------------------------------------------------------
+
+    @classmethod
+    def make(cls, sock, loc_priv_key: ed25519.PrivKeyEd25519) -> "SecretConnection":
+        """Perform the STS handshake (secret_connection.go:92)."""
+        eph_priv = X25519PrivateKey.generate()
+        loc_eph_pub = eph_priv.public_key().public_bytes_raw()
+
+        # exchange ephemeral pubkeys as delimited BytesValue (field 1)
+        sock.sendall(
+            protoio.marshal_delimited(protoio.field_bytes(1, loc_eph_pub))
+        )
+        msg = _read_delimited_from_sock(sock, 1024 * 1024)
+        r = protoio.WireReader(msg)
+        rem_eph_pub = b""
+        while not r.at_end():
+            field, wt = r.read_tag()
+            if field == 1:
+                rem_eph_pub = r.read_bytes()
+            else:
+                r.skip(wt)
+        if len(rem_eph_pub) != 32:
+            raise HandshakeError("bad ephemeral pubkey size")
+
+        lo, hi = sorted([loc_eph_pub, rem_eph_pub])
+        loc_is_least = loc_eph_pub == lo
+
+        transcript = Transcript(_TRANSCRIPT_LABEL)
+        transcript.append_message(_LABEL_EPH_LO, lo)
+        transcript.append_message(_LABEL_EPH_HI, hi)
+
+        try:
+            dh_secret = eph_priv.exchange(
+                X25519PublicKey.from_public_bytes(rem_eph_pub)
+            )
+        except Exception as exc:
+            raise SmallOrderRemotePubKey(str(exc)) from exc
+
+        transcript.append_message(_LABEL_DH_SECRET, dh_secret)
+
+        okm = HKDF(
+            algorithm=SHA256(), length=96, salt=None, info=_HKDF_INFO
+        ).derive(dh_secret)
+        if loc_is_least:
+            recv_key, send_key = okm[0:32], okm[32:64]
+        else:
+            send_key, recv_key = okm[0:32], okm[32:64]
+
+        challenge = transcript.extract_bytes(_LABEL_MAC, 32)
+
+        sc = cls(sock, send_key, recv_key, rem_pub_key=None)
+
+        # authenticate: exchange AuthSigMessage over the encrypted channel
+        loc_sig = loc_priv_key.sign(challenge)
+        auth = protoio.field_message(
+            1, pub_key_to_proto(loc_priv_key.pub_key()).encode()
+        ) + protoio.field_bytes(2, loc_sig)
+        sc.write(protoio.marshal_delimited(auth))
+
+        rem_auth = sc._read_delimited(1024 * 1024)
+        rr = protoio.WireReader(rem_auth)
+        rem_pub = None
+        rem_sig = b""
+        while not rr.at_end():
+            field, wt = rr.read_tag()
+            if field == 1:
+                rem_pub = pub_key_from_proto(PublicKeyProto.decode(rr.read_bytes()))
+            elif field == 2:
+                rem_sig = rr.read_bytes()
+            else:
+                rr.skip(wt)
+        if not isinstance(rem_pub, ed25519.PubKeyEd25519):
+            raise HandshakeError(f"expected ed25519 pubkey, got {type(rem_pub)}")
+        if not rem_pub.verify_signature(challenge, rem_sig):
+            raise HandshakeError("challenge verification failed")
+
+        sc.rem_pub_key = rem_pub
+        return sc
+
+    # -- encrypted IO --------------------------------------------------------
+
+    def write(self, data: bytes) -> int:
+        """Write in sealed 1028-byte frames (secret_connection.go:188)."""
+        n = 0
+        with self._send_mtx:
+            view = memoryview(data)
+            while len(view) > 0:
+                chunk = view[:DATA_MAX_SIZE]
+                view = view[DATA_MAX_SIZE:]
+                frame = bytearray(TOTAL_FRAME_SIZE)
+                struct.pack_into("<I", frame, 0, len(chunk))
+                frame[DATA_LEN_SIZE : DATA_LEN_SIZE + len(chunk)] = chunk
+                sealed = self._send_aead.encrypt(
+                    self._send_nonce.bytes(), bytes(frame), None
+                )
+                self._send_nonce.incr()
+                self._sock.sendall(sealed)
+                n += len(chunk)
+        return n
+
+    def read(self, n: int) -> bytes:
+        """Read up to n bytes (one frame at most, like the reference Read)."""
+        with self._recv_mtx:
+            if self._recv_buffer:
+                out, self._recv_buffer = (
+                    self._recv_buffer[:n],
+                    self._recv_buffer[n:],
+                )
+                return out
+            sealed = _read_exact(self._sock, TOTAL_FRAME_SIZE + AEAD_SIZE_OVERHEAD)
+            frame = self._recv_aead.decrypt(self._recv_nonce.bytes(), sealed, None)
+            self._recv_nonce.incr()
+            (chunk_len,) = struct.unpack_from("<I", frame, 0)
+            if chunk_len > DATA_MAX_SIZE:
+                raise ValueError("chunk length greater than dataMaxSize")
+            chunk = frame[DATA_LEN_SIZE : DATA_LEN_SIZE + chunk_len]
+            out, self._recv_buffer = chunk[:n], bytes(chunk[n:])
+            return out
+
+    def read_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self.read(n - len(buf))
+            if not chunk:
+                raise ConnectionError("secret connection closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def _read_delimited(self, max_size: int) -> bytes:
+        length = 0
+        shift = 0
+        while True:
+            b = self.read_exact(1)
+            length |= (b[0] & 0x7F) << shift
+            if not b[0] & 0x80:
+                break
+            shift += 7
+            if shift > 63:
+                raise ValueError("varint overflow")
+        if length > max_size:
+            raise ValueError(f"message too large: {length} > {max_size}")
+        return self.read_exact(length)
+
+    def close(self) -> None:
+        import socket as _socket
+
+        # shutdown first so a recv() blocked in another thread wakes up and
+        # the remote end sees EOF immediately
+        try:
+            self._sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,79 @@
+"""Persistent node identity key.
+
+Reference: p2p/key.go — NodeKey wraps an ed25519 private key; the node ID is
+the lowercase hex of the pubkey's 20-byte address.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs.tempfile import write_file_atomic
+
+ID_BYTE_LENGTH = 20
+
+
+def pub_key_to_id(pub_key) -> str:
+    """Reference: p2p/key.go:45 PubKeyToID."""
+    return pub_key.address().hex()
+
+
+def validate_id(node_id: str) -> None:
+    if len(node_id) != 2 * ID_BYTE_LENGTH:
+        raise ValueError(
+            f"invalid hex length - got {len(node_id)}, "
+            f"expected {2 * ID_BYTE_LENGTH}"
+        )
+    bytes.fromhex(node_id)  # raises on non-hex
+
+
+@dataclass
+class NodeKey:
+    priv_key: ed25519.PrivKeyEd25519
+
+    def id(self) -> str:
+        return pub_key_to_id(self.priv_key.pub_key())
+
+    def pub_key(self) -> ed25519.PubKeyEd25519:
+        return self.priv_key.pub_key()
+
+    # -- persistence (amino-style JSON, p2p/key.go:74 LoadOrGenNodeKey) -----
+
+    def save_as(self, path: str) -> None:
+        doc = {
+            "priv_key": {
+                "type": "tendermint/PrivKeyEd25519",
+                "value": _b64(self.priv_key.bytes()),
+            }
+        }
+        write_file_atomic(path, json.dumps(doc).encode(), 0o600)
+
+    @classmethod
+    def load(cls, path: str) -> "NodeKey":
+        with open(path, "rb") as f:
+            doc = json.load(f)
+        raw = _unb64(doc["priv_key"]["value"])
+        return cls(ed25519.PrivKeyEd25519(raw))
+
+    @classmethod
+    def load_or_gen(cls, path: str) -> "NodeKey":
+        if os.path.exists(path):
+            return cls.load(path)
+        nk = cls(ed25519.gen_priv_key())
+        nk.save_as(path)
+        return nk
+
+
+def _b64(b: bytes) -> str:
+    import base64
+
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    import base64
+
+    return base64.b64decode(s)

@@ -1,0 +1,144 @@
+"""Network addresses with node IDs.
+
+Reference: p2p/netaddress.go — NetAddress = (id, ip, port); string form
+``id@host:port``; routability classification for the address book.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import socket
+from dataclasses import dataclass
+from typing import Optional
+
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.p2p.key import validate_id
+
+
+@dataclass(frozen=True)
+class NetAddress:
+    id: str
+    ip: str
+    port: int
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_string(cls, addr: str) -> "NetAddress":
+        """Parse ``id@host:port`` (netaddress.go:70 NewNetAddressString)."""
+        addr = addr.removeprefix("tcp://").removeprefix("unix://")
+        if "@" not in addr:
+            raise ValueError(f"address {addr!r} does not contain ID")
+        node_id, hostport = addr.split("@", 1)
+        validate_id(node_id)
+        host, port = _split_host_port(hostport)
+        ip = _resolve(host)
+        return cls(node_id, ip, port)
+
+    @classmethod
+    def from_ip_port(cls, ip: str, port: int, node_id: str = "") -> "NetAddress":
+        return cls(node_id, ip, port)
+
+    # -- proto (proto/tendermint/p2p/types.proto NetAddress) ----------------
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.id:
+            out += protoio.field_string(1, self.id)
+        if self.ip:
+            out += protoio.field_string(2, self.ip)
+        if self.port:
+            out += protoio.field_varint(3, self.port)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NetAddress":
+        r = protoio.WireReader(data)
+        node_id, ip, port = "", "", 0
+        while not r.at_end():
+            fnum, wt = r.read_tag()
+            if fnum == 1:
+                node_id = r.read_string()
+            elif fnum == 2:
+                ip = r.read_string()
+            elif fnum == 3:
+                port = r.read_varint()
+            else:
+                r.skip(wt)
+        return cls(node_id, ip, port)
+
+    # -- semantics ----------------------------------------------------------
+
+    def __str__(self) -> str:
+        if self.id:
+            return f"{self.id}@{self.dial_string()}"
+        return self.dial_string()
+
+    def dial_string(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def equals(self, other: "NetAddress") -> bool:
+        return str(self) == str(other)
+
+    def same(self, other: "NetAddress") -> bool:
+        """Same dial addr or same ID (netaddress.go:198)."""
+        return self.dial_string() == other.dial_string() or (
+            bool(self.id) and self.id == other.id
+        )
+
+    def valid(self) -> Optional[str]:
+        """→ error string, or None if valid (netaddress.go:264)."""
+        if self.id:
+            try:
+                validate_id(self.id)
+            except ValueError as e:
+                return f"invalid ID: {e}"
+        try:
+            ipaddress.ip_address(self.ip)
+        except ValueError:
+            return "no IP address"
+        if self.port == 0:
+            return "invalid port"
+        return None
+
+    def routable(self) -> bool:
+        """Globally-dialable address (netaddress.go:253)."""
+        if self.valid() is not None:
+            return False
+        ip = ipaddress.ip_address(self.ip)
+        return not (
+            ip.is_private
+            or ip.is_loopback
+            or ip.is_link_local
+            or ip.is_multicast
+            or ip.is_unspecified
+            or ip.is_reserved
+        )
+
+    def local(self) -> bool:
+        ip = ipaddress.ip_address(self.ip)
+        return ip.is_loopback or ip.is_private
+
+
+def _split_host_port(hostport: str) -> tuple:
+    if hostport.startswith("["):  # [ipv6]:port
+        host, _, rest = hostport[1:].partition("]")
+        if not rest.startswith(":"):
+            raise ValueError(f"bad address {hostport!r}")
+        return host, int(rest[1:])
+    host, sep, port = hostport.rpartition(":")
+    if not sep:
+        raise ValueError(f"address {hostport!r} missing port")
+    return host, int(port)
+
+
+def _resolve(host: str) -> str:
+    try:
+        ipaddress.ip_address(host)
+        return host
+    except ValueError:
+        pass
+    try:
+        return socket.gethostbyname(host)
+    except OSError as exc:
+        raise ValueError(f"cannot resolve host {host!r}: {exc}") from exc

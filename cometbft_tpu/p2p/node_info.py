@@ -1,0 +1,193 @@
+"""Node identity exchanged during the p2p handshake.
+
+Reference: p2p/node_info.go DefaultNodeInfo — protocol versions, node ID,
+listen addr, network (chain id), channels bitmap, moniker, tx_index +
+rpc_address. Proto: proto/tendermint/p2p/types.proto.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from cometbft_tpu.libs import protoio
+
+MAX_NODE_INFO_SIZE = 10240
+MAX_NUM_CHANNELS = 16
+
+
+@dataclass(frozen=True)
+class ProtocolVersion:
+    p2p: int = 8
+    block: int = 11
+    app: int = 0
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.p2p:
+            out += protoio.field_varint(1, self.p2p)
+        if self.block:
+            out += protoio.field_varint(2, self.block)
+        if self.app:
+            out += protoio.field_varint(3, self.app)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ProtocolVersion":
+        r = protoio.WireReader(data)
+        p2p = block = app = 0
+        while not r.at_end():
+            fnum, wt = r.read_tag()
+            if fnum == 1:
+                p2p = r.read_varint()
+            elif fnum == 2:
+                block = r.read_varint()
+            elif fnum == 3:
+                app = r.read_varint()
+            else:
+                r.skip(wt)
+        return cls(p2p, block, app)
+
+
+@dataclass
+class NodeInfoOther:
+    tx_index: str = "on"
+    rpc_address: str = ""
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.tx_index:
+            out += protoio.field_string(1, self.tx_index)
+        if self.rpc_address:
+            out += protoio.field_string(2, self.rpc_address)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NodeInfoOther":
+        r = protoio.WireReader(data)
+        tx_index, rpc = "", ""
+        while not r.at_end():
+            fnum, wt = r.read_tag()
+            if fnum == 1:
+                tx_index = r.read_string()
+            elif fnum == 2:
+                rpc = r.read_string()
+            else:
+                r.skip(wt)
+        return cls(tx_index, rpc)
+
+
+def _is_ascii_text(s: str) -> bool:
+    return bool(s) and all(32 <= ord(c) <= 126 for c in s)
+
+
+@dataclass
+class NodeInfo:
+    protocol_version: ProtocolVersion = field(default_factory=ProtocolVersion)
+    node_id: str = ""
+    listen_addr: str = ""
+    network: str = ""
+    version: str = "0.34.28"
+    channels: bytes = b""
+    moniker: str = "node"
+    other: NodeInfoOther = field(default_factory=NodeInfoOther)
+
+    def id(self) -> str:
+        return self.node_id
+
+    def validate(self) -> None:
+        """Reference: node_info.go:122 Validate."""
+        from cometbft_tpu.p2p.netaddr import NetAddress
+
+        NetAddress.from_string(f"{self.node_id}@{self.listen_addr}")
+        if self.version and not _is_ascii_text(self.version):
+            raise ValueError(
+                f"info.Version must be valid ASCII text, got {self.version!r}"
+            )
+        if len(self.channels) > MAX_NUM_CHANNELS:
+            raise ValueError(
+                f"info.Channels is too long ({len(self.channels)}). "
+                f"Max is {MAX_NUM_CHANNELS}"
+            )
+        if len(set(self.channels)) != len(self.channels):
+            raise ValueError("info.Channels contains duplicate channel id")
+        if not _is_ascii_text(self.moniker):
+            raise ValueError("info.Moniker must be valid non-empty ASCII text")
+        if self.other.tx_index not in ("", "on", "off"):
+            raise ValueError(
+                f"info.Other.TxIndex should be 'on', 'off' or empty, "
+                f"got {self.other.tx_index!r}"
+            )
+
+    def compatible_with(self, other: "NodeInfo") -> None:
+        """Reference: node_info.go:179 CompatibleWith."""
+        if self.protocol_version.block != other.protocol_version.block:
+            raise ValueError(
+                f"peer is on a different Block version. Got "
+                f"{other.protocol_version.block}, expected "
+                f"{self.protocol_version.block}"
+            )
+        if self.network != other.network:
+            raise ValueError(
+                f"peer is on a different network. Got {other.network!r}, "
+                f"expected {self.network!r}"
+            )
+        if not self.channels:
+            return
+        if not set(self.channels) & set(other.channels):
+            raise ValueError(
+                f"peer has no common channels. Our channels: "
+                f"{self.channels.hex()}; Peer channels: {other.channels.hex()}"
+            )
+
+    def has_channel(self, ch_id: int) -> bool:
+        return ch_id in self.channels
+
+    def net_address(self):
+        from cometbft_tpu.p2p.netaddr import NetAddress
+
+        return NetAddress.from_string(f"{self.node_id}@{self.listen_addr}")
+
+    # -- proto --------------------------------------------------------------
+
+    def encode(self) -> bytes:
+        out = protoio.field_message(1, self.protocol_version.encode())
+        if self.node_id:
+            out += protoio.field_string(2, self.node_id)
+        if self.listen_addr:
+            out += protoio.field_string(3, self.listen_addr)
+        if self.network:
+            out += protoio.field_string(4, self.network)
+        if self.version:
+            out += protoio.field_string(5, self.version)
+        if self.channels:
+            out += protoio.field_bytes(6, self.channels)
+        if self.moniker:
+            out += protoio.field_string(7, self.moniker)
+        out += protoio.field_message(8, self.other.encode())
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NodeInfo":
+        r = protoio.WireReader(data)
+        info = cls()
+        while not r.at_end():
+            fnum, wt = r.read_tag()
+            if fnum == 1:
+                info.protocol_version = ProtocolVersion.decode(r.read_bytes())
+            elif fnum == 2:
+                info.node_id = r.read_string()
+            elif fnum == 3:
+                info.listen_addr = r.read_string()
+            elif fnum == 4:
+                info.network = r.read_string()
+            elif fnum == 5:
+                info.version = r.read_string()
+            elif fnum == 6:
+                info.channels = r.read_bytes()
+            elif fnum == 7:
+                info.moniker = r.read_string()
+            elif fnum == 8:
+                info.other = NodeInfoOther.decode(r.read_bytes())
+            else:
+                r.skip(wt)
+        return info

@@ -1,0 +1,125 @@
+"""Peer — one connected remote node.
+
+Reference: p2p/peer.go — wraps the MConnection, carries the authenticated
+NodeInfo, outbound/persistent flags, and a per-peer key/value metadata map
+used by reactors (consensus stores PeerState here).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from cometbft_tpu.libs.cmap import CMap
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.p2p.conn.connection import (
+    ChannelDescriptor,
+    MConnConfig,
+    MConnection,
+)
+from cometbft_tpu.p2p.netaddr import NetAddress
+from cometbft_tpu.p2p.node_info import NodeInfo
+
+
+class Peer(BaseService):
+    def __init__(
+        self,
+        conn,  # stream with read_exact/write/close (SecretConnection)
+        node_info: NodeInfo,
+        ch_descs: List[ChannelDescriptor],
+        on_peer_receive: Callable[[int, "Peer", bytes], None],
+        on_peer_error: Callable[["Peer", Exception], None],
+        outbound: bool,
+        persistent: bool = False,
+        socket_addr: Optional[NetAddress] = None,
+        mconfig: Optional[MConnConfig] = None,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__(f"Peer:{node_info.id()[:10]}", logger or new_nop_logger())
+        self.node_info = node_info
+        self.outbound = outbound
+        self.persistent = persistent
+        self.socket_addr = socket_addr
+        self.data = CMap()  # reactor scratch space (peer.go Get/Set)
+        self._on_peer_receive = on_peer_receive
+        self._on_peer_error = on_peer_error
+        self.mconn = MConnection(
+            conn,
+            ch_descs,
+            on_receive=self._receive,
+            on_error=self._error,
+            config=mconfig,
+            logger=self.logger,
+        )
+
+    # -- identity -----------------------------------------------------------
+
+    def id(self) -> str:
+        return self.node_info.id()
+
+    def is_outbound(self) -> bool:
+        return self.outbound
+
+    def is_persistent(self) -> bool:
+        return self.persistent
+
+    def net_address(self) -> Optional[NetAddress]:
+        """Self-reported listen addr with authenticated ID (peer.go)."""
+        try:
+            return self.node_info.net_address()
+        except ValueError:
+            return None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def on_start(self) -> None:
+        self.mconn.start()
+
+    def on_stop(self) -> None:
+        try:
+            self.mconn.stop()
+        except Exception:
+            pass
+
+    def flush_stop(self) -> None:
+        self.mconn.flush_stop()
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+    # -- IO -----------------------------------------------------------------
+
+    def send(self, ch_id: int, msg_bytes: bytes) -> bool:
+        if not self.is_running():
+            return False
+        if not self.node_info.has_channel(ch_id) and self.node_info.channels:
+            return False
+        return self.mconn.send(ch_id, msg_bytes)
+
+    def try_send(self, ch_id: int, msg_bytes: bytes) -> bool:
+        if not self.is_running():
+            return False
+        if not self.node_info.has_channel(ch_id) and self.node_info.channels:
+            return False
+        return self.mconn.try_send(ch_id, msg_bytes)
+
+    def get(self, key: str):
+        return self.data.get(key)
+
+    def set(self, key: str, value) -> None:
+        self.data.set(key, value)
+
+    def status(self) -> dict:
+        return self.mconn.status()
+
+    def _receive(self, ch_id: int, msg_bytes: bytes) -> None:
+        self._on_peer_receive(ch_id, self, msg_bytes)
+
+    def _error(self, err: Exception) -> None:
+        self._on_peer_error(self, err)
+
+    def __repr__(self) -> str:
+        arrow = "out" if self.outbound else "in"
+        return f"Peer{{{self.id()[:10]} {arrow}}}"

@@ -1,0 +1,276 @@
+"""Address book — known-peer store backing PEX.
+
+Reference: p2p/pex/addrbook.go — addresses live in hashed "new" buckets
+(heard about, never connected) and "old" buckets (connected successfully);
+MarkGood promotes new→old, MarkBad bans for a duration, PickAddress biases
+between bucket types, and the whole book is persisted to JSON.
+
+This implementation keeps the new/old split, per-address attempt/ban
+bookkeeping, biased picking and JSON persistence; the 256/64 hashed-bucket
+fan-out (an anti-eclipse measure sized for mainnet-scale books) is collapsed
+to two flat tables with the same external behavior.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.libs.tempfile import write_file_atomic
+from cometbft_tpu.p2p.netaddr import NetAddress
+
+NEED_ADDRESS_THRESHOLD = 1000
+DEFAULT_BAN_TIME = 24 * 3600.0
+GET_SELECTION_PERCENT = 23
+MAX_GET_SELECTION = 250
+MIN_GET_SELECTION = 32
+
+
+@dataclass
+class KnownAddress:
+    """Reference: p2p/pex/known_address.go."""
+
+    addr: NetAddress
+    src: Optional[NetAddress] = None
+    attempts: int = 0
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    banned_until: float = 0.0
+    is_old: bool = False  # old = proven good; new = merely heard of
+
+    def is_banned(self) -> bool:
+        return self.banned_until > time.time()
+
+    def to_json(self) -> dict:
+        return {
+            "addr": {
+                "id": self.addr.id,
+                "ip": self.addr.ip,
+                "port": self.addr.port,
+            },
+            "src": (
+                {"id": self.src.id, "ip": self.src.ip, "port": self.src.port}
+                if self.src
+                else None
+            ),
+            "attempts": self.attempts,
+            "last_attempt": self.last_attempt,
+            "last_success": self.last_success,
+            "banned_until": self.banned_until,
+            "is_old": self.is_old,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "KnownAddress":
+        a = d["addr"]
+        s = d.get("src")
+        return cls(
+            addr=NetAddress(a["id"], a["ip"], a["port"]),
+            src=NetAddress(s["id"], s["ip"], s["port"]) if s else None,
+            attempts=d.get("attempts", 0),
+            last_attempt=d.get("last_attempt", 0.0),
+            last_success=d.get("last_success", 0.0),
+            banned_until=d.get("banned_until", 0.0),
+            is_old=d.get("is_old", False),
+        )
+
+
+class AddrBook(BaseService):
+    def __init__(
+        self,
+        file_path: str = "",
+        routability_strict: bool = True,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("AddrBook", logger or new_nop_logger())
+        self.file_path = file_path
+        self.routability_strict = routability_strict
+        self._mtx = threading.RLock()
+        self._addrs: Dict[str, KnownAddress] = {}  # by node ID
+        self._our_addrs: set = set()
+        self._private_ids: set = set()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def on_start(self) -> None:
+        if self.file_path and os.path.exists(self.file_path):
+            self._load()
+
+    def on_stop(self) -> None:
+        self.save()
+
+    # -- our own identity ---------------------------------------------------
+
+    def add_our_address(self, addr: NetAddress) -> None:
+        with self._mtx:
+            self._our_addrs.add(str(addr))
+
+    def our_address(self, addr: NetAddress) -> bool:
+        with self._mtx:
+            return str(addr) in self._our_addrs
+
+    def add_private_ids(self, ids: List[str]) -> None:
+        with self._mtx:
+            self._private_ids.update(ids)
+
+    # -- core ops -----------------------------------------------------------
+
+    def add_address(self, addr: NetAddress, src: Optional[NetAddress]) -> None:
+        """addrbook.go:213 AddAddress — new addresses land in 'new'."""
+        with self._mtx:
+            if addr.valid() is not None:
+                raise ValueError(f"invalid address {addr}: {addr.valid()}")
+            if self.routability_strict and not addr.routable():
+                raise ValueError(f"non-routable address {addr}")
+            if str(addr) in self._our_addrs or addr.id in self._private_ids:
+                return
+            ka = self._addrs.get(addr.id)
+            if ka is not None:
+                if ka.is_banned():
+                    return
+                if ka.is_old:
+                    return  # already proven; keep old record
+                ka.addr = addr
+                ka.src = src or ka.src
+                return
+            self._addrs[addr.id] = KnownAddress(addr=addr, src=src)
+
+    def remove_address(self, addr: NetAddress) -> None:
+        with self._mtx:
+            self._addrs.pop(addr.id, None)
+
+    def has_address(self, addr: NetAddress) -> bool:
+        with self._mtx:
+            return addr.id in self._addrs
+
+    def is_good(self, addr: NetAddress) -> bool:
+        with self._mtx:
+            ka = self._addrs.get(addr.id)
+            return ka is not None and ka.is_old
+
+    def is_banned(self, addr: NetAddress) -> bool:
+        with self._mtx:
+            ka = self._addrs.get(addr.id)
+            return ka is not None and ka.is_banned()
+
+    def mark_good(self, node_id: str) -> None:
+        """addrbook.go:322 — promote to 'old' on successful connection."""
+        with self._mtx:
+            ka = self._addrs.get(node_id)
+            if ka is None:
+                return
+            ka.last_success = time.time()
+            ka.attempts = 0
+            ka.is_old = True
+
+    def mark_attempt(self, addr: NetAddress) -> None:
+        with self._mtx:
+            ka = self._addrs.get(addr.id)
+            if ka is None:
+                return
+            ka.attempts += 1
+            ka.last_attempt = time.time()
+
+    def mark_bad(self, addr: NetAddress, ban_time: float = DEFAULT_BAN_TIME) -> None:
+        with self._mtx:
+            ka = self._addrs.get(addr.id)
+            if ka is None:
+                return
+            ka.banned_until = time.time() + ban_time
+            ka.is_old = False
+
+    def reinstate_bad_peers(self) -> None:
+        with self._mtx:
+            now = time.time()
+            for ka in self._addrs.values():
+                if ka.banned_until and ka.banned_until <= now:
+                    ka.banned_until = 0.0
+
+    # -- queries ------------------------------------------------------------
+
+    def size(self) -> int:
+        with self._mtx:
+            return sum(1 for k in self._addrs.values() if not k.is_banned())
+
+    def empty(self) -> bool:
+        return self.size() == 0
+
+    def need_more_addrs(self) -> bool:
+        return self.size() < NEED_ADDRESS_THRESHOLD
+
+    def pick_address(self, bias_towards_new: int) -> Optional[NetAddress]:
+        """addrbook.go:272 — pick random, biased between old/new (0..100)."""
+        bias = max(0, min(100, bias_towards_new))
+        with self._mtx:
+            news = [
+                k for k in self._addrs.values()
+                if not k.is_old and not k.is_banned()
+            ]
+            olds = [
+                k for k in self._addrs.values()
+                if k.is_old and not k.is_banned()
+            ]
+            if not news and not olds:
+                return None
+            pick_new = (
+                bool(news)
+                and (not olds or random.random() * 100 < bias)
+            )
+            pool = news if pick_new else olds
+            return random.choice(pool).addr
+
+    def get_selection(self) -> List[NetAddress]:
+        """Random ~23% (bounded) of the book for a PEX reply."""
+        with self._mtx:
+            cands = [
+                k.addr for k in self._addrs.values() if not k.is_banned()
+            ]
+        if not cands:
+            return []
+        n = max(
+            min(len(cands), MIN_GET_SELECTION),
+            len(cands) * GET_SELECTION_PERCENT // 100,
+        )
+        n = min(n, MAX_GET_SELECTION, len(cands))
+        return random.sample(cands, n)
+
+    def get_selection_with_bias(self, bias: int) -> List[NetAddress]:
+        out, seen = [], set()
+        for _ in range(MAX_GET_SELECTION):
+            a = self.pick_address(bias)
+            if a is None:
+                break
+            if a.id in seen:
+                continue
+            seen.add(a.id)
+            out.append(a)
+            if len(out) >= self.size():
+                break
+        return out
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self) -> None:
+        if not self.file_path:
+            return
+        with self._mtx:
+            doc = {
+                "key": "addrbook",
+                "addrs": [k.to_json() for k in self._addrs.values()],
+            }
+        write_file_atomic(self.file_path, json.dumps(doc, indent=1).encode())
+
+    def _load(self) -> None:
+        with open(self.file_path) as f:
+            doc = json.load(f)
+        with self._mtx:
+            for d in doc.get("addrs", []):
+                ka = KnownAddress.from_json(d)
+                self._addrs[ka.addr.id] = ka

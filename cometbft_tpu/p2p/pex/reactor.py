@@ -1,0 +1,242 @@
+"""PEX (peer exchange) reactor — channel 0x00.
+
+Reference: p2p/pex/pex_reactor.go — on connect, outbound peers are asked for
+addresses (PexRequest) when the book is low; PexAddrs replies feed the book;
+an ensure-peers routine dials from the book (biased by how starved we are)
+to keep the outbound slots full. Request rate-limiting per peer guards
+against address-book pollution; seed mode answers one request then hangs up.
+
+Wire: proto/tendermint/p2p/pex.proto Message{PexRequest=1, PexAddrs=2}.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.libs.log import Logger
+from cometbft_tpu.p2p.base_reactor import Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.netaddr import NetAddress
+from cometbft_tpu.p2p.peer import Peer
+from cometbft_tpu.p2p.pex.addrbook import AddrBook
+
+PEX_CHANNEL = 0x00
+DEFAULT_ENSURE_PEERS_PERIOD = 30.0
+MIN_RECEIVE_REQUEST_INTERVAL = 0.1  # reference: ensurePeersPeriod/3; scaled
+MAX_ATTEMPTS_TO_DIAL = 16
+
+
+# -- wire --------------------------------------------------------------------
+
+
+def encode_pex_request() -> bytes:
+    return protoio.field_message(1, b"")
+
+
+def encode_pex_addrs(addrs: List[NetAddress]) -> bytes:
+    inner = b"".join(protoio.field_message(1, a.encode()) for a in addrs)
+    return protoio.field_message(2, inner)
+
+
+def decode_pex_message(data: bytes):
+    """→ ("request", None) | ("addrs", [NetAddress])."""
+    r = protoio.WireReader(data)
+    while not r.at_end():
+        fnum, wt = r.read_tag()
+        if fnum == 1:
+            r.read_bytes()
+            return "request", None
+        if fnum == 2:
+            inner = protoio.WireReader(r.read_bytes())
+            addrs = []
+            while not inner.at_end():
+                f2, w2 = inner.read_tag()
+                if f2 == 1:
+                    addrs.append(NetAddress.decode(inner.read_bytes()))
+                else:
+                    inner.skip(w2)
+            return "addrs", addrs
+        r.skip(wt)
+    raise ValueError("empty pex message")
+
+
+# -- reactor -----------------------------------------------------------------
+
+
+class PEXReactor(Reactor):
+    def __init__(
+        self,
+        book: AddrBook,
+        seeds: Optional[List[str]] = None,
+        seed_mode: bool = False,
+        ensure_peers_period: float = DEFAULT_ENSURE_PEERS_PERIOD,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("PEXReactor", logger)
+        self.book = book
+        self.seeds = [NetAddress.from_string(s) for s in (seeds or [])]
+        self.seed_mode = seed_mode
+        self.ensure_peers_period = ensure_peers_period
+        self._requests_sent: set = set()  # peer ids we await addrs from
+        self._last_received_request: Dict[str, float] = {}
+        self._attempts: Dict[str, int] = {}  # dial attempts per addr id
+        self._mtx = threading.Lock()
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                id=PEX_CHANNEL, priority=1, send_queue_capacity=10
+            )
+        ]
+
+    def on_start(self) -> None:
+        if not self.book.is_running():
+            self.book.start()
+        threading.Thread(
+            target=self._ensure_peers_routine, name="pex-ensure", daemon=True
+        ).start()
+
+    def on_stop(self) -> None:
+        if self.book.is_running():
+            self.book.stop()
+
+    # -- peer lifecycle -----------------------------------------------------
+
+    def add_peer(self, peer: Peer) -> None:
+        if peer.is_outbound():
+            # ask for more addresses if the book is low (pex_reactor.go:205)
+            if self.book.need_more_addrs():
+                self._request_addrs(peer)
+        else:
+            addr = peer.net_address()
+            if addr is not None:
+                try:
+                    self.book.add_address(addr, addr)
+                except ValueError:
+                    pass
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        with self._mtx:
+            self._requests_sent.discard(peer.id())
+            self._last_received_request.pop(peer.id(), None)
+
+    # -- receive ------------------------------------------------------------
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        kind, addrs = decode_pex_message(msg_bytes)
+        if kind == "request":
+            if not self._receive_request_ok(peer):
+                assert self.switch is not None
+                self.switch.stop_peer_for_error(
+                    peer, ValueError("too-frequent pex requests")
+                )
+                return
+            selection = self.book.get_selection()
+            peer.send(PEX_CHANNEL, encode_pex_addrs(selection))
+            if self.seed_mode:
+                # answer once, then disconnect (pex_reactor.go seed logic)
+                assert self.switch is not None
+                self.switch.stop_peer_gracefully(peer)
+        else:
+            with self._mtx:
+                if peer.id() not in self._requests_sent:
+                    assert self.switch is not None
+                    self.switch.stop_peer_for_error(
+                        peer, ValueError("unsolicited pexAddrsMessage")
+                    )
+                    return
+                self._requests_sent.discard(peer.id())
+            src = peer.net_address()
+            for addr in addrs or []:
+                try:
+                    self.book.add_address(addr, src)
+                except ValueError:
+                    continue
+
+    def _receive_request_ok(self, peer: Peer) -> bool:
+        now = time.monotonic()
+        with self._mtx:
+            last = self._last_received_request.get(peer.id(), 0.0)
+            if now - last < MIN_RECEIVE_REQUEST_INTERVAL:
+                return False
+            self._last_received_request[peer.id()] = now
+        return True
+
+    def _request_addrs(self, peer: Peer) -> None:
+        with self._mtx:
+            if peer.id() in self._requests_sent:
+                return
+            self._requests_sent.add(peer.id())
+        peer.send(PEX_CHANNEL, encode_pex_request())
+
+    # -- ensure-peers loop --------------------------------------------------
+
+    def _ensure_peers_routine(self) -> None:
+        # small initial jitter, then periodic (pex_reactor.go:190)
+        time.sleep(self.ensure_peers_period * 0.1)
+        while self.is_running():
+            self._ensure_peers()
+            time.sleep(self.ensure_peers_period)
+
+    def _ensure_peers(self) -> None:
+        assert self.switch is not None
+        sw = self.switch
+        nums = sw.num_peers()
+        out, dialing = nums["outbound"], nums["dialing"]
+        need = sw.max_outbound_peers - out - dialing
+        if need <= 0:
+            return
+        # bias: the fewer connected peers, the more we explore new addrs
+        connected = out + nums["inbound"]
+        bias = max(30, 100 - connected * 10)
+        to_dial: Dict[str, NetAddress] = {}
+        for _ in range(need * 3):
+            if len(to_dial) >= need:
+                break
+            addr = self.book.pick_address(bias)
+            if addr is None:
+                break
+            if addr.id in to_dial or sw.peers.has(addr.id):
+                continue
+            with self._mtx:
+                if self._attempts.get(addr.id, 0) > MAX_ATTEMPTS_TO_DIAL:
+                    self.book.mark_bad(addr)
+                    continue
+            to_dial[addr.id] = addr
+        for addr in to_dial.values():
+            threading.Thread(
+                target=self._dial, args=(addr,), daemon=True
+            ).start()
+        # if the book is dry, fall back to seeds (pex_reactor.go:307)
+        if not to_dial and self.seeds and sw.num_peers()["outbound"] == 0:
+            self._dial_seeds()
+
+    def _dial(self, addr: NetAddress) -> None:
+        assert self.switch is not None
+        self.book.mark_attempt(addr)
+        with self._mtx:
+            self._attempts[addr.id] = self._attempts.get(addr.id, 0) + 1
+        try:
+            self.switch.dial_peer_with_address(addr)
+        except Exception as exc:
+            self.logger.info("pex dial failed", addr=str(addr), err=str(exc))
+        else:
+            with self._mtx:
+                self._attempts.pop(addr.id, None)
+            self.book.mark_good(addr.id)
+
+    def _dial_seeds(self) -> None:
+        assert self.switch is not None
+        import random as _random
+
+        seeds = list(self.seeds)
+        _random.shuffle(seeds)
+        for seed in seeds:
+            try:
+                self.switch.dial_peer_with_address(seed)
+                return
+            except Exception:
+                continue

@@ -1,0 +1,328 @@
+"""The Switch — reactor registry and peer lifecycle.
+
+Reference: p2p/switch.go — owns the transport, all reactors and the peer set;
+accepts inbound peers, dials outbound ones (with reconnect-with-backoff for
+persistent peers), routes inbound messages to reactors by channel ID, and
+broadcasts to all peers in parallel (switch.go:306 Broadcast).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.p2p.base_reactor import Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor, MConnConfig
+from cometbft_tpu.p2p.netaddr import NetAddress
+from cometbft_tpu.p2p.peer import Peer
+from cometbft_tpu.p2p.transport import (
+    MultiplexTransport,
+    RejectedError,
+    UpgradedConn,
+)
+
+DEFAULT_MAX_INBOUND_PEERS = 40
+DEFAULT_MAX_OUTBOUND_PEERS = 10
+RECONNECT_ATTEMPTS = 20
+RECONNECT_INTERVAL = 0.5  # reference: 5s; scaled for tests via config
+RECONNECT_BACK_OFF_ATTEMPTS = 10
+RECONNECT_BACK_OFF_BASE = 3.0
+
+
+class PeerSet:
+    """Thread-safe peer registry keyed by node ID (p2p/peer_set.go)."""
+
+    def __init__(self) -> None:
+        self._mtx = threading.Lock()
+        self._by_id: Dict[str, Peer] = {}
+
+    def add(self, peer: Peer) -> None:
+        with self._mtx:
+            if peer.id() in self._by_id:
+                raise KeyError(f"duplicate peer {peer.id()}")
+            self._by_id[peer.id()] = peer
+
+    def has(self, peer_id: str) -> bool:
+        with self._mtx:
+            return peer_id in self._by_id
+
+    def get(self, peer_id: str) -> Optional[Peer]:
+        with self._mtx:
+            return self._by_id.get(peer_id)
+
+    def remove(self, peer: Peer) -> bool:
+        with self._mtx:
+            return self._by_id.pop(peer.id(), None) is not None
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._by_id)
+
+    def list(self) -> List[Peer]:
+        with self._mtx:
+            return list(self._by_id.values())
+
+
+class Switch(BaseService):
+    def __init__(
+        self,
+        transport: MultiplexTransport,
+        max_inbound_peers: int = DEFAULT_MAX_INBOUND_PEERS,
+        max_outbound_peers: int = DEFAULT_MAX_OUTBOUND_PEERS,
+        reconnect_interval: float = RECONNECT_INTERVAL,
+        mconfig: Optional[MConnConfig] = None,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("P2P Switch", logger or new_nop_logger())
+        self.transport = transport
+        self.reactors: Dict[str, Reactor] = {}
+        self.ch_descs: List[ChannelDescriptor] = []
+        self.reactors_by_ch: Dict[int, Reactor] = {}
+        self.peers = PeerSet()
+        self.dialing: Dict[str, bool] = {}
+        self.reconnecting: Dict[str, bool] = {}
+        self._dialing_mtx = threading.Lock()
+        self.persistent_peer_ids: set = set()
+        self.max_inbound_peers = max_inbound_peers
+        self.max_outbound_peers = max_outbound_peers
+        self.reconnect_interval = reconnect_interval
+        self.mconfig = mconfig or MConnConfig()
+        self._accept_thread: Optional[threading.Thread] = None
+        # addr book hook (set by PEX); called with the addr of good peers
+        self.addr_book = None
+
+    # -- reactor registry ---------------------------------------------------
+
+    def add_reactor(self, name: str, reactor: Reactor) -> Reactor:
+        for desc in reactor.get_channels():
+            if desc.id in self.reactors_by_ch:
+                raise ValueError(
+                    f"channel {desc.id:#x} already registered to "
+                    f"{self.reactors_by_ch[desc.id]}"
+                )
+            self.ch_descs.append(desc)
+            self.reactors_by_ch[desc.id] = reactor
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+        return reactor
+
+    def reactor(self, name: str) -> Optional[Reactor]:
+        return self.reactors.get(name)
+
+    def node_info(self):
+        return self.transport.node_info
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def on_start(self) -> None:
+        for reactor in self.reactors.values():
+            reactor.start()
+        if self.transport._listener is not None:
+            self._accept_thread = threading.Thread(
+                target=self._accept_routine, name="switch-accept", daemon=True
+            )
+            self._accept_thread.start()
+
+    def on_stop(self) -> None:
+        self.transport.close()
+        for peer in self.peers.list():
+            self._stop_and_remove_peer(peer, None)
+        for reactor in self.reactors.values():
+            if reactor.is_running():
+                reactor.stop()
+
+    # -- inbound ------------------------------------------------------------
+
+    def _accept_routine(self) -> None:
+        while self.is_running():
+            try:
+                up = self.transport.accept()
+            except RejectedError as exc:
+                self.logger.info("inbound peer rejected", err=str(exc))
+                continue
+            except OSError:
+                break  # listener closed
+            if (
+                self._inbound_count() >= self.max_inbound_peers
+                and not self._is_unconditional(up.node_info.id())
+            ):
+                self.logger.info(
+                    "ignoring inbound connection: already have enough peers",
+                    peer=up.node_info.id()[:10],
+                )
+                up.secret_conn.close()
+                continue
+            try:
+                self._add_peer(up)
+            except Exception as exc:
+                self.logger.error("failed to add inbound peer", err=str(exc))
+                up.secret_conn.close()
+
+    def _inbound_count(self) -> int:
+        return sum(1 for p in self.peers.list() if not p.is_outbound())
+
+    def _is_unconditional(self, peer_id: str) -> bool:
+        return peer_id in self.persistent_peer_ids
+
+    # -- outbound -----------------------------------------------------------
+
+    def add_persistent_peers(self, addrs: List[str]) -> List[NetAddress]:
+        out = []
+        for a in addrs:
+            na = NetAddress.from_string(a)
+            self.persistent_peer_ids.add(na.id)
+            out.append(na)
+        return out
+
+    def dial_peers_async(self, addrs: List[NetAddress]) -> None:
+        for addr in addrs:
+            if addr.id == self.transport.node_key.id():
+                continue
+            threading.Thread(
+                target=self._dial_with_jitter, args=(addr,), daemon=True
+            ).start()
+
+    def _dial_with_jitter(self, addr: NetAddress) -> None:
+        time.sleep(random.random() * 0.1)
+        try:
+            self.dial_peer_with_address(addr)
+        except Exception as exc:
+            self.logger.info("dial failed", addr=str(addr), err=str(exc))
+            if addr.id in self.persistent_peer_ids:
+                self._reconnect_to_peer(addr)
+
+    def dial_peer_with_address(self, addr: NetAddress) -> None:
+        """Blocking dial+add (switch.go DialPeerWithAddress)."""
+        if self.peers.has(addr.id):
+            raise RejectedError("duplicate peer", is_duplicate=True)
+        with self._dialing_mtx:
+            if self.dialing.get(addr.id):
+                raise RejectedError("already dialing", is_duplicate=True)
+            self.dialing[addr.id] = True
+        try:
+            up = self.transport.dial(addr)
+            self._add_peer(up)
+        finally:
+            with self._dialing_mtx:
+                self.dialing.pop(addr.id, None)
+
+    def _reconnect_to_peer(self, addr: NetAddress) -> None:
+        with self._dialing_mtx:
+            if self.reconnecting.get(addr.id):
+                return
+            self.reconnecting[addr.id] = True
+        try:
+            for attempt in range(RECONNECT_ATTEMPTS):
+                if not self.is_running():
+                    return
+                time.sleep(
+                    self.reconnect_interval * (1 + random.random() * 0.2)
+                )
+                try:
+                    self.dial_peer_with_address(addr)
+                    return
+                except RejectedError as exc:
+                    if exc.is_duplicate:
+                        return
+                except Exception:
+                    pass
+        finally:
+            with self._dialing_mtx:
+                self.reconnecting.pop(addr.id, None)
+
+    # -- peer add/remove ----------------------------------------------------
+
+    def _add_peer(self, up: UpgradedConn) -> None:
+        peer = Peer(
+            up.secret_conn,
+            up.node_info,
+            self.ch_descs,
+            on_peer_receive=self._on_peer_receive,
+            on_peer_error=self.stop_peer_for_error,
+            outbound=up.outbound,
+            persistent=up.node_info.id() in self.persistent_peer_ids,
+            socket_addr=up.socket_addr,
+            mconfig=self.mconfig,
+            logger=self.logger,
+        )
+        if not self.is_running():
+            up.secret_conn.close()
+            return
+        for reactor in self.reactors.values():
+            peer = reactor.init_peer(peer)
+        self.peers.add(peer)  # raises on duplicate
+        try:
+            peer.start()
+        except Exception:
+            self.peers.remove(peer)
+            raise
+        for reactor in self.reactors.values():
+            reactor.add_peer(peer)
+        self.logger.info(
+            "added peer", peer=peer.id()[:10], outbound=peer.is_outbound()
+        )
+
+    def _on_peer_receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        reactor = self.reactors_by_ch.get(ch_id)
+        if reactor is None:
+            self.stop_peer_for_error(
+                peer, ValueError(f"no reactor for channel {ch_id:#x}")
+            )
+            return
+        reactor.receive(ch_id, peer, msg_bytes)
+
+    def stop_peer_for_error(self, peer: Peer, reason) -> None:
+        """switch.go:367 StopPeerForError — remove, then maybe reconnect."""
+        if not self.peers.has(peer.id()):
+            return
+        self.logger.info(
+            "stopping peer for error", peer=peer.id()[:10], err=str(reason)
+        )
+        self._stop_and_remove_peer(peer, reason)
+        if peer.is_persistent():
+            addr = peer.socket_addr if peer.is_outbound() else peer.net_address()
+            if addr is not None:
+                threading.Thread(
+                    target=self._reconnect_to_peer, args=(addr,), daemon=True
+                ).start()
+
+    def stop_peer_gracefully(self, peer: Peer) -> None:
+        self._stop_and_remove_peer(peer, None)
+
+    def _stop_and_remove_peer(self, peer: Peer, reason) -> None:
+        removed = self.peers.remove(peer)
+        try:
+            if peer.is_running():
+                peer.stop()
+        except Exception:
+            pass
+        if removed:
+            for reactor in self.reactors.values():
+                reactor.remove_peer(peer, reason)
+
+    # -- broadcast ----------------------------------------------------------
+
+    def broadcast(self, ch_id: int, msg_bytes: bytes) -> None:
+        """Parallel TrySend to every peer (switch.go:306). Fire-and-forget."""
+        for peer in self.peers.list():
+            threading.Thread(
+                target=peer.send, args=(ch_id, msg_bytes), daemon=True
+            ).start()
+
+    def num_peers(self) -> dict:
+        peers = self.peers.list()
+        return {
+            "outbound": sum(1 for p in peers if p.is_outbound()),
+            "inbound": sum(1 for p in peers if not p.is_outbound()),
+            "dialing": len(self.dialing),
+        }
+
+    def mark_peer_as_good(self, peer: Peer) -> None:
+        if self.addr_book is not None and peer.is_outbound():
+            na = peer.net_address()
+            if na is not None:
+                self.addr_book.mark_good(na.id)

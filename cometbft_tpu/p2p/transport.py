@@ -1,0 +1,202 @@
+"""TCP transport with encrypted-authenticated upgrade.
+
+Reference: p2p/transport.go MultiplexTransport — listen/accept loop, dial,
+and the connection "upgrade": SecretConnection handshake, dialed-ID check,
+NodeInfo exchange + validation, duplicate-/self-connection filtering.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.p2p.conn.secret_connection import SecretConnection
+from cometbft_tpu.p2p.key import NodeKey, pub_key_to_id
+from cometbft_tpu.p2p.netaddr import NetAddress
+from cometbft_tpu.p2p.node_info import MAX_NODE_INFO_SIZE, NodeInfo
+
+DEFAULT_DIAL_TIMEOUT = 3.0
+DEFAULT_HANDSHAKE_TIMEOUT = 3.0
+
+
+class RejectedError(Exception):
+    def __init__(
+        self,
+        msg: str,
+        *,
+        node_id: str = "",
+        is_self: bool = False,
+        is_duplicate: bool = False,
+        is_auth_failure: bool = False,
+        is_incompatible: bool = False,
+        is_filtered: bool = False,
+    ):
+        super().__init__(msg)
+        self.node_id = node_id
+        self.is_self = is_self
+        self.is_duplicate = is_duplicate
+        self.is_auth_failure = is_auth_failure
+        self.is_incompatible = is_incompatible
+        self.is_filtered = is_filtered
+
+
+@dataclass
+class UpgradedConn:
+    """Result of a successful upgrade: encrypted stream + peer identity."""
+
+    secret_conn: SecretConnection
+    node_info: NodeInfo
+    socket_addr: NetAddress
+    outbound: bool
+
+
+def _exchange_node_info(
+    sc: SecretConnection, our_info: NodeInfo
+) -> NodeInfo:
+    """Send ours, read theirs (transport.go:535 handshake). Writing first is
+    safe: the message is far below the socket buffer size."""
+    sc.write(protoio.marshal_delimited(our_info.encode()))
+    raw = sc._read_delimited(MAX_NODE_INFO_SIZE)
+    return NodeInfo.decode(raw)
+
+
+class MultiplexTransport:
+    """Accept/dial with the full upgrade path (transport.go:150)."""
+
+    def __init__(
+        self,
+        node_info: NodeInfo,
+        node_key: NodeKey,
+        handshake_timeout: float = DEFAULT_HANDSHAKE_TIMEOUT,
+        dial_timeout: float = DEFAULT_DIAL_TIMEOUT,
+        logger: Optional[Logger] = None,
+    ):
+        self.node_info = node_info
+        self.node_key = node_key
+        self.handshake_timeout = handshake_timeout
+        self.dial_timeout = dial_timeout
+        self.logger = logger or new_nop_logger()
+        self._listener: Optional[socket.socket] = None
+        self.listen_addr: Optional[NetAddress] = None
+        # conn filters, e.g. the switch's duplicate-IP guard
+        self.conn_filters: List[Callable[[socket.socket], None]] = []
+        self._closed = False
+
+    # -- listening ----------------------------------------------------------
+
+    def listen(self, addr: NetAddress) -> None:
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((addr.ip, addr.port))
+        ls.listen(64)
+        host, port = ls.getsockname()[:2]
+        self._listener = ls
+        self.listen_addr = NetAddress(self.node_key.id(), host, port)
+
+    def accept(self) -> UpgradedConn:
+        """Block for one inbound connection and upgrade it."""
+        if self._listener is None:
+            raise RuntimeError("transport not listening")
+        c, (rip, rport) = self._listener.accept()
+        for f in self.conn_filters:
+            try:
+                f(c)
+            except Exception as exc:
+                c.close()
+                raise RejectedError(str(exc), is_filtered=True) from exc
+        return self._upgrade(c, None, NetAddress("", rip, rport))
+
+    # -- dialing ------------------------------------------------------------
+
+    def dial(self, addr: NetAddress) -> UpgradedConn:
+        c = socket.create_connection(
+            (addr.ip, addr.port), timeout=self.dial_timeout
+        )
+        c.settimeout(None)
+        return self._upgrade(c, addr, addr)
+
+    # -- upgrade ------------------------------------------------------------
+
+    def _upgrade(
+        self,
+        c: socket.socket,
+        dialed_addr: Optional[NetAddress],
+        socket_addr: NetAddress,
+    ) -> UpgradedConn:
+        c.settimeout(self.handshake_timeout)
+        try:
+            sc = SecretConnection.make(c, self.node_key.priv_key)
+        except Exception as exc:
+            c.close()
+            raise RejectedError(
+                f"secret conn failed: {exc}", is_auth_failure=True
+            ) from exc
+
+        conn_id = pub_key_to_id(sc.rem_pub_key)
+        if dialed_addr is not None and dialed_addr.id and conn_id != dialed_addr.id:
+            sc.close()
+            raise RejectedError(
+                f"conn.ID ({conn_id}) dialed ID ({dialed_addr.id}) mismatch",
+                node_id=conn_id,
+                is_auth_failure=True,
+            )
+
+        try:
+            peer_info = _exchange_node_info(sc, self.node_info)
+        except Exception as exc:
+            sc.close()
+            raise RejectedError(
+                f"handshake failed: {exc}", is_auth_failure=True
+            ) from exc
+
+        try:
+            peer_info.validate()
+        except ValueError as exc:
+            sc.close()
+            raise RejectedError(str(exc), node_id=conn_id) from exc
+
+        if conn_id != peer_info.id():
+            sc.close()
+            raise RejectedError(
+                f"conn.ID ({conn_id}) NodeInfo.ID ({peer_info.id()}) mismatch",
+                node_id=conn_id,
+                is_auth_failure=True,
+            )
+
+        if peer_info.id() == self.node_info.id():
+            sc.close()
+            raise RejectedError(
+                "self connection", node_id=conn_id, is_self=True
+            )
+
+        try:
+            self.node_info.compatible_with(peer_info)
+        except ValueError as exc:
+            sc.close()
+            raise RejectedError(
+                str(exc), node_id=conn_id, is_incompatible=True
+            ) from exc
+
+        c.settimeout(None)
+        out_addr = socket_addr
+        if dialed_addr is None:
+            # inbound: remember the remote's socket address with its real ID
+            out_addr = NetAddress(conn_id, socket_addr.ip, socket_addr.port)
+        return UpgradedConn(
+            secret_conn=sc,
+            node_info=peer_info,
+            socket_addr=out_addr,
+            outbound=dialed_addr is not None,
+        )
+
+    def close(self) -> None:
+        self._closed = True
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass

@@ -78,6 +78,42 @@ def validate_block(state: State, block: Block) -> None:
             block.last_commit,
         )
 
+    if len(h.proposer_address) != 20 or not state.validators.has_address(
+        h.proposer_address
+    ):
+        raise ValueError(
+            f"block proposer is not in the validator set "
+            f"({h.proposer_address.hex()})"
+        )
+
+    # Block time (state/validation.go:114-137): strictly after LastBlockTime
+    # and exactly the weighted median of LastCommit timestamps; the initial
+    # block must carry the genesis time verbatim.
+    from cometbft_tpu.state import median_time
+
+    if h.height > state.initial_height:
+        if not h.time > state.last_block_time:
+            raise ValueError(
+                f"block time {h.time} not greater than last block time "
+                f"{state.last_block_time}"
+            )
+        expected = median_time(block.last_commit, state.last_validators)
+        if h.time != expected:
+            raise ValueError(
+                f"invalid block time. Expected {expected}, got {h.time}"
+            )
+    elif h.height == state.initial_height:
+        if h.time != state.last_block_time:
+            raise ValueError(
+                f"block time {h.time} is not equal to genesis time "
+                f"{state.last_block_time}"
+            )
+    else:
+        raise ValueError(
+            f"block height {h.height} lower than initial height "
+            f"{state.initial_height}"
+        )
+
     # Evidence: the limit applies to the EvidenceData proto size including
     # repeated-field framing (state/validation.go:146 Evidence.ByteSize())
     from cometbft_tpu.types.evidence import encode_evidence_list
@@ -87,12 +123,4 @@ def validate_block(state: State, block: Block) -> None:
     if got > max_bytes:
         raise ValueError(
             f"evidence in block exceeds maximum size ({got} > {max_bytes})"
-        )
-
-    if len(h.proposer_address) != 20 or not state.validators.has_address(
-        h.proposer_address
-    ):
-        raise ValueError(
-            f"block proposer is not in the validator set "
-            f"({h.proposer_address.hex()})"
         )

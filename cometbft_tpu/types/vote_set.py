@@ -226,6 +226,18 @@ class VoteSet:
                 return self._votes[val_index]
             return None
 
+    # reader-shape alias used by the consensus reactor's vote gossip
+    # (reference: VoteSetReader.GetByIndex, types/vote_set.go:60)
+    get_by_index = get_vote
+
+    def is_commit(self) -> bool:
+        """A precommit set with a known +2/3 block (vote_set.go IsCommit)."""
+        with self._mtx:
+            return (
+                self.signed_msg_type == SIGNED_MSG_TYPE_PRECOMMIT
+                and self._maj23 is not None
+            )
+
     def get_vote_by_address(self, address: bytes) -> Optional[Vote]:
         with self._mtx:
             idx, _ = self.val_set.get_by_address(address)

@@ -17,6 +17,11 @@ if "xla_force_host_platform_device_count" not in flags:
 # on CPU; cache it across pytest runs.
 import jax  # noqa: E402
 
+# The environment may pre-import jax at interpreter startup (sitecustomize)
+# with JAX_PLATFORMS=axon — the env vars above are then too late, so force
+# the platform through the live config before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
 _CACHE_DIR = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
 jax.config.update("jax_compilation_cache_dir", os.path.abspath(_CACHE_DIR))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)

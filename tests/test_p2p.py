@@ -1,0 +1,480 @@
+"""P2P stack tests: merlin transcript, SecretConnection handshake/IO,
+MConnection multiplexing + priorities, transport upgrade, switch lifecycle
+over real TCP sockets.
+
+Model: reference p2p/conn/secret_connection_test.go, connection_test.go,
+switch_test.go.
+"""
+
+import queue
+import socket
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto.merlin import Strobe128, Transcript
+from cometbft_tpu.p2p import (
+    ChannelDescriptor,
+    MConnConfig,
+    MConnection,
+    MultiplexTransport,
+    NetAddress,
+    NodeInfo,
+    NodeKey,
+    ProtocolVersion,
+    Reactor,
+    RejectedError,
+    SecretConnection,
+    Switch,
+    pub_key_to_id,
+)
+from cometbft_tpu.p2p.conn.connection import (
+    PacketMsg,
+    SocketStream,
+    unwrap_packet,
+    wrap_packet_msg,
+    wrap_packet_ping,
+    wrap_packet_pong,
+)
+
+
+# -- merlin ------------------------------------------------------------------
+
+
+class TestMerlin:
+    def test_published_vector(self):
+        # merlin's own conformance test (equivalence_simple)
+        t = Transcript(b"test protocol")
+        t.append_message(b"some label", b"some data")
+        c = t.challenge_bytes(b"challenge", 32)
+        assert (
+            c.hex()
+            == "d5a21972d0d5fe320c0d263fac7fffb8145aa640af6e9bca177c03c7efcf0615"
+        )
+
+    def test_deterministic(self):
+        def run():
+            t = Transcript(b"proto")
+            t.append_message(b"a", b"b" * 100)
+            return t.challenge_bytes(b"c", 64)
+
+        assert run() == run()
+
+    def test_order_matters(self):
+        t1 = Transcript(b"p")
+        t1.append_message(b"x", b"1")
+        t1.append_message(b"y", b"2")
+        t2 = Transcript(b"p")
+        t2.append_message(b"y", b"2")
+        t2.append_message(b"x", b"1")
+        assert t1.challenge_bytes(b"c", 32) != t2.challenge_bytes(b"c", 32)
+
+
+# -- secret connection -------------------------------------------------------
+
+
+def _make_secret_pair(key_a=None, key_b=None):
+    a, b = socket.socketpair()
+    ka = key_a or ed.gen_priv_key()
+    kb = key_b or ed.gen_priv_key()
+    out = {}
+    errs = {}
+
+    def side(name, sock, key):
+        try:
+            out[name] = SecretConnection.make(sock, key)
+        except Exception as exc:  # noqa: BLE001
+            errs[name] = exc
+
+    t1 = threading.Thread(target=side, args=("a", a, ka))
+    t2 = threading.Thread(target=side, args=("b", b, kb))
+    t1.start()
+    t2.start()
+    t1.join(10)
+    t2.join(10)
+    if errs:
+        raise RuntimeError(errs)
+    return out["a"], out["b"], ka, kb
+
+
+class TestSecretConnection:
+    def test_handshake_authenticates_both_sides(self):
+        sca, scb, ka, kb = _make_secret_pair()
+        assert sca.rem_pub_key.bytes() == kb.pub_key().bytes()
+        assert scb.rem_pub_key.bytes() == ka.pub_key().bytes()
+
+    def test_roundtrip_small_and_large(self):
+        sca, scb, _, _ = _make_secret_pair()
+        sca.write(b"ping")
+        assert scb.read_exact(4) == b"ping"
+        big = bytes(range(256)) * 40  # > 1 frame
+        scb.write(big)
+        assert sca.read_exact(len(big)) == big
+
+    def test_tampered_frame_rejected(self):
+        a, b = socket.socketpair()
+        ka, kb = ed.gen_priv_key(), ed.gen_priv_key()
+        out = {}
+
+        def side(name, sock, key):
+            out[name] = SecretConnection.make(sock, key)
+
+        t1 = threading.Thread(target=side, args=("a", a, ka))
+        t2 = threading.Thread(target=side, args=("b", b, kb))
+        t1.start(), t2.start(), t1.join(10), t2.join(10)
+        sca, scb = out["a"], out["b"]
+        # write a tampered sealed frame directly to the raw socket
+        sca.write(b"x")  # advance nonce legitimately once
+        scb.read_exact(1)
+        a.sendall(b"\x00" * (1028 + 16))
+        with pytest.raises(Exception):
+            scb.read_exact(1)
+
+
+# -- packets -----------------------------------------------------------------
+
+
+class TestPackets:
+    def test_packet_msg_roundtrip(self):
+        pm = PacketMsg(0x22, True, b"payload")
+        kind, got = unwrap_packet(wrap_packet_msg(pm))
+        assert kind == "msg"
+        assert got == pm
+
+    def test_channel_zero_roundtrip(self):
+        pm = PacketMsg(0x00, False, b"pex")
+        kind, got = unwrap_packet(wrap_packet_msg(pm))
+        assert got.channel_id == 0 and got.data == b"pex"
+
+    def test_ping_pong(self):
+        assert unwrap_packet(wrap_packet_ping())[0] == "ping"
+        assert unwrap_packet(wrap_packet_pong())[0] == "pong"
+
+
+# -- mconnection -------------------------------------------------------------
+
+
+def _mconn_pair(descs, on_recv_b, config=None):
+    a, b = socket.socketpair()
+    errs = []
+    m1 = MConnection(
+        SocketStream(a), descs, lambda ch, m: None, errs.append, config=config
+    )
+    m2 = MConnection(SocketStream(b), descs, on_recv_b, errs.append, config=config)
+    m1.start()
+    m2.start()
+    return m1, m2, errs
+
+
+class TestMConnection:
+    def test_send_receive_multiplexed(self):
+        got = queue.Queue()
+        descs = [
+            ChannelDescriptor(id=0x01, priority=5),
+            ChannelDescriptor(id=0x02, priority=1),
+        ]
+        m1, m2, errs = _mconn_pair(descs, lambda ch, m: got.put((ch, m)))
+        try:
+            assert m1.send(0x01, b"one")
+            assert m1.send(0x02, b"B" * 4000)
+            msgs = {got.get(timeout=5)[0]: 1, got.get(timeout=5)[0]: 1}
+            assert set(msgs) == {0x01, 0x02}
+            assert not errs
+        finally:
+            _safe_stop(m1)
+            _safe_stop(m2)
+
+    def test_send_to_unknown_channel_fails(self):
+        descs = [ChannelDescriptor(id=0x01)]
+        m1, m2, _ = _mconn_pair(descs, lambda ch, m: None)
+        try:
+            assert not m1.send(0x99, b"nope")
+        finally:
+            _safe_stop(m1)
+            _safe_stop(m2)
+
+    def test_large_message_reassembled(self):
+        got = queue.Queue()
+        descs = [ChannelDescriptor(id=0x01, priority=1)]
+        m1, m2, errs = _mconn_pair(descs, lambda ch, m: got.put(m))
+        try:
+            big = bytes(i % 251 for i in range(100_000))
+            assert m1.send(0x01, big)
+            assert got.get(timeout=10) == big
+            assert not errs
+        finally:
+            _safe_stop(m1)
+            _safe_stop(m2)
+
+    def test_ping_pong_keepalive(self):
+        got = queue.Queue()
+        descs = [ChannelDescriptor(id=0x01)]
+        cfg = MConnConfig(ping_interval=0.2, pong_timeout=2.0)
+        m1, m2, errs = _mconn_pair(descs, lambda ch, m: got.put(m), config=cfg)
+        try:
+            time.sleep(0.8)  # several ping rounds
+            assert not errs  # pongs arrived; no pong-timeout errors
+            assert m1.is_running() and m2.is_running()
+        finally:
+            _safe_stop(m1)
+            _safe_stop(m2)
+
+
+# -- transport + switch ------------------------------------------------------
+
+
+def _node(network="test-chain", channels=bytes([0x01, 0x02])):
+    nk = NodeKey(ed.gen_priv_key())
+    info = NodeInfo(
+        protocol_version=ProtocolVersion(),
+        node_id=nk.id(),
+        listen_addr="127.0.0.1:0",
+        network=network,
+        channels=channels,
+        moniker="test",
+    )
+    return nk, info
+
+
+def _make_transport(network="test-chain", channels=bytes([0x01, 0x02])):
+    nk, info = _node(network, channels)
+    t = MultiplexTransport(info, nk)
+    t.listen(NetAddress("", "127.0.0.1", 0))
+    # advertise the bound port
+    info.listen_addr = f"127.0.0.1:{t.listen_addr.port}"
+    return t
+
+
+class TestTransport:
+    def test_dial_accept_upgrade(self):
+        t1 = _make_transport()
+        t2 = _make_transport()
+        result = {}
+
+        def accept():
+            result["up"] = t1.accept()
+
+        th = threading.Thread(target=accept)
+        th.start()
+        up2 = t2.dial(t1.listen_addr)
+        th.join(10)
+        up1 = result["up"]
+        assert up1.node_info.id() == t2.node_info.id()
+        assert up2.node_info.id() == t1.node_info.id()
+        assert up2.outbound and not up1.outbound
+        t1.close()
+        t2.close()
+
+    def test_dialed_id_mismatch_rejected(self):
+        t1 = _make_transport()
+        t2 = _make_transport()
+        threading.Thread(target=lambda: _try(t1.accept), daemon=True).start()
+        wrong_id = NodeKey(ed.gen_priv_key()).id()
+        bad = NetAddress(wrong_id, t1.listen_addr.ip, t1.listen_addr.port)
+        with pytest.raises(RejectedError, match="mismatch"):
+            t2.dial(bad)
+        t1.close()
+        t2.close()
+
+    def test_network_mismatch_rejected(self):
+        t1 = _make_transport(network="chain-A")
+        t2 = _make_transport(network="chain-B")
+        threading.Thread(target=lambda: _try(t1.accept), daemon=True).start()
+        with pytest.raises(RejectedError, match="different network"):
+            t2.dial(t1.listen_addr)
+        t1.close()
+        t2.close()
+
+
+def _try(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+
+
+class EchoReactor(Reactor):
+    """Test reactor: records receives; echoes on the other channel."""
+
+    def __init__(self, ch_ids, priority=1):
+        super().__init__("echo")
+        self.ch_ids = ch_ids
+        self.priority = priority
+        self.received = queue.Queue()
+        self.peers_added = []
+        self.peers_removed = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=c, priority=self.priority) for c in self.ch_ids]
+
+    def add_peer(self, peer):
+        self.peers_added.append(peer.id())
+
+    def remove_peer(self, peer, reason):
+        self.peers_removed.append(peer.id())
+
+    def receive(self, ch_id, peer, msg_bytes):
+        self.received.put((ch_id, peer.id(), msg_bytes))
+
+
+def _make_switch(network="test-chain", chs=(0x01, 0x02)):
+    t = _make_transport(network, bytes(chs))
+    sw = Switch(t, reconnect_interval=0.1)
+    r = EchoReactor(list(chs))
+    sw.add_reactor("echo", r)
+    return sw, r
+
+
+class TestSwitch:
+    def test_two_switches_connect_and_exchange(self):
+        sw1, r1 = _make_switch()
+        sw2, r2 = _make_switch()
+        sw1.start()
+        sw2.start()
+        try:
+            sw2.dial_peer_with_address(sw1.transport.listen_addr)
+            _wait(lambda: sw1.peers.size() == 1 and sw2.peers.size() == 1)
+            # both reactors saw the peer (add_peer fires just after peer add)
+            _wait(lambda: r1.peers_added and r2.peers_added)
+            # exchange on both channels
+            p21 = sw2.peers.list()[0]
+            assert p21.send(0x01, b"hello-1")
+            assert p21.send(0x02, b"hello-2")
+            got = {r1.received.get(timeout=5)[0], r1.received.get(timeout=5)[0]}
+            assert got == {0x01, 0x02}
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+    def test_broadcast_reaches_all_peers(self):
+        hub, rhub = _make_switch()
+        spokes = [_make_switch() for _ in range(3)]
+        hub.start()
+        for sw, _ in spokes:
+            sw.start()
+            sw.dial_peer_with_address(hub.transport.listen_addr)
+        try:
+            _wait(lambda: hub.peers.size() == 3)
+            hub.broadcast(0x01, b"fan-out")
+            for _, r in spokes:
+                ch, _, msg = r.received.get(timeout=5)
+                assert (ch, msg) == (0x01, b"fan-out")
+        finally:
+            hub.stop()
+            for sw, _ in spokes:
+                sw.stop()
+
+    def test_stop_peer_for_error_removes_and_notifies(self):
+        sw1, r1 = _make_switch()
+        sw2, r2 = _make_switch()
+        sw1.start()
+        sw2.start()
+        try:
+            sw2.dial_peer_with_address(sw1.transport.listen_addr)
+            _wait(lambda: sw1.peers.size() == 1)
+            peer = sw1.peers.list()[0]
+            sw1.stop_peer_for_error(peer, ValueError("test error"))
+            _wait(lambda: sw1.peers.size() == 0)
+            assert r1.peers_removed == [peer.id()]
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+    def test_peer_disconnect_detected_and_removed(self):
+        sw1, r1 = _make_switch()
+        sw2, r2 = _make_switch()
+        sw1.start()
+        sw2.start()
+        try:
+            sw2.dial_peer_with_address(sw1.transport.listen_addr)
+            _wait(lambda: sw1.peers.size() == 1 and sw2.peers.size() == 1)
+            sw2.stop()  # closes connections
+            _wait(lambda: sw1.peers.size() == 0, timeout=10)
+        finally:
+            sw1.stop()
+
+    def test_duplicate_dial_rejected(self):
+        sw1, _ = _make_switch()
+        sw2, _ = _make_switch()
+        sw1.start()
+        sw2.start()
+        try:
+            sw2.dial_peer_with_address(sw1.transport.listen_addr)
+            _wait(lambda: sw2.peers.size() == 1)
+            with pytest.raises(RejectedError):
+                sw2.dial_peer_with_address(sw1.transport.listen_addr)
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+    def test_persistent_peer_reconnects(self):
+        sw1, _ = _make_switch()
+        sw2, _ = _make_switch()
+        sw1.start()
+        sw2.start()
+        try:
+            addr = sw1.transport.listen_addr
+            sw2.add_persistent_peers([str(addr)])
+            sw2.dial_peers_async([addr])
+            _wait(lambda: sw2.peers.size() == 1)
+            # kill from sw1 side; sw2 should re-dial
+            peer = sw1.peers.list()[0]
+            sw1.stop_peer_for_error(peer, RuntimeError("boom"))
+            _wait(lambda: sw2.peers.size() == 0, timeout=10)
+            _wait(lambda: sw2.peers.size() == 1, timeout=10)
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+
+def _safe_stop(svc):
+    """Stop tolerating the race where the error path already stopped it."""
+    try:
+        svc.stop()
+    except Exception:
+        pass
+
+
+def _wait(cond, timeout=8.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError("condition not met before timeout")
+
+
+class TestNodeKey:
+    def test_id_is_hex_address(self):
+        nk = NodeKey(ed.gen_priv_key())
+        assert nk.id() == nk.pub_key().address().hex()
+        assert len(nk.id()) == 40
+
+    def test_save_load_roundtrip(self, tmp_path):
+        p = str(tmp_path / "node_key.json")
+        nk = NodeKey.load_or_gen(p)
+        nk2 = NodeKey.load_or_gen(p)
+        assert nk.id() == nk2.id()
+
+
+class TestNetAddress:
+    def test_parse_roundtrip(self):
+        nid = "aa" * 20
+        na = NetAddress.from_string(f"{nid}@127.0.0.1:26656")
+        assert na.id == nid and na.ip == "127.0.0.1" and na.port == 26656
+        assert str(na) == f"{nid}@127.0.0.1:26656"
+
+    def test_missing_id_rejected(self):
+        with pytest.raises(ValueError):
+            NetAddress.from_string("127.0.0.1:26656")
+
+    def test_proto_roundtrip(self):
+        na = NetAddress("bb" * 20, "10.0.0.1", 1234)
+        assert NetAddress.decode(na.encode()) == na
+
+    def test_routable(self):
+        assert not NetAddress("", "127.0.0.1", 80).routable()
+        assert not NetAddress("", "192.168.1.1", 80).routable()
+        assert NetAddress("", "8.8.8.8", 80).routable()

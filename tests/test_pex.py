@@ -1,0 +1,118 @@
+"""PEX + address book tests.
+
+Model: reference p2p/pex/addrbook_test.go, pex_reactor_test.go.
+"""
+
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.p2p import NetAddress, NodeKey
+from cometbft_tpu.p2p.pex.addrbook import AddrBook, KnownAddress
+from cometbft_tpu.p2p.pex.reactor import (
+    PEX_CHANNEL,
+    PEXReactor,
+    decode_pex_message,
+    encode_pex_addrs,
+    encode_pex_request,
+)
+
+
+def _addr(i: int, port: int = 26656) -> NetAddress:
+    nid = ed.gen_priv_key_from_secret(bytes([i, 7])).pub_key().address().hex()
+    return NetAddress(nid, f"8.8.{i % 256}.{(i * 7) % 256}", port)
+
+
+class TestAddrBook:
+    def test_add_and_pick(self):
+        book = AddrBook()
+        for i in range(10):
+            book.add_address(_addr(i), None)
+        assert book.size() == 10
+        picked = book.pick_address(50)
+        assert picked is not None and book.has_address(picked)
+
+    def test_non_routable_rejected_when_strict(self):
+        book = AddrBook(routability_strict=True)
+        local = NetAddress("aa" * 20, "127.0.0.1", 26656)
+        with pytest.raises(ValueError):
+            book.add_address(local, None)
+        lax = AddrBook(routability_strict=False)
+        lax.add_address(local, None)
+        assert lax.size() == 1
+
+    def test_mark_good_promotes_to_old(self):
+        book = AddrBook()
+        a = _addr(1)
+        book.add_address(a, None)
+        assert not book.is_good(a)
+        book.mark_good(a.id)
+        assert book.is_good(a)
+        # old picks with bias 0
+        assert book.pick_address(0) == a
+
+    def test_mark_bad_bans(self):
+        book = AddrBook()
+        a = _addr(2)
+        book.add_address(a, None)
+        book.mark_bad(a, ban_time=60.0)
+        assert book.is_banned(a)
+        assert book.size() == 0
+        assert book.pick_address(50) is None
+
+    def test_ban_expires(self):
+        book = AddrBook()
+        a = _addr(3)
+        book.add_address(a, None)
+        book.mark_bad(a, ban_time=0.01)
+        time.sleep(0.05)
+        book.reinstate_bad_peers()
+        assert not book.is_banned(a)
+        assert book.size() == 1
+
+    def test_our_address_ignored(self):
+        book = AddrBook()
+        a = _addr(4)
+        book.add_our_address(a)
+        book.add_address(a, None)
+        assert book.size() == 0
+
+    def test_selection_bounds(self):
+        book = AddrBook()
+        for i in range(50):
+            book.add_address(_addr(i), None)
+        sel = book.get_selection()
+        assert 0 < len(sel) <= 50
+        assert len({a.id for a in sel}) == len(sel)
+
+    def test_persistence_roundtrip(self, tmp_path):
+        path = str(tmp_path / "addrbook.json")
+        book = AddrBook(file_path=path)
+        book.start()
+        for i in range(5):
+            book.add_address(_addr(i), None)
+        book.mark_good(_addr(0).id)
+        book.stop()
+
+        book2 = AddrBook(file_path=path)
+        book2.start()
+        assert book2.size() == 5
+        assert book2.is_good(_addr(0))
+        book2.stop()
+
+
+class TestPexWire:
+    def test_request_roundtrip(self):
+        kind, addrs = decode_pex_message(encode_pex_request())
+        assert kind == "request" and addrs is None
+
+    def test_addrs_roundtrip(self):
+        addrs = [_addr(i) for i in range(3)]
+        kind, got = decode_pex_message(encode_pex_addrs(addrs))
+        assert kind == "addrs"
+        assert got == addrs
+
+    def test_empty_addrs(self):
+        kind, got = decode_pex_message(encode_pex_addrs([]))
+        assert kind == "addrs" and got == []
