@@ -20,13 +20,27 @@ from cometbft_tpu.types.proposal import Proposal
 from cometbft_tpu.types.vote import Vote
 
 
+# Generous upper bound on decoded bitmap size: covers vote bitmaps (validator
+# count) and part-set bitmaps (max block bytes / 64 KiB parts) with orders of
+# magnitude to spare, while capping what a hostile 12-byte message can make us
+# allocate (bits is attacker-controlled and drives a [0]*(bits//64) alloc).
+MAX_BIT_ARRAY_BITS = 1 << 24
+
+
 def _encode_bit_array(ba: Optional[BitArray]) -> bytes:
-    """proto libs.bits.BitArray {int64 bits=1, repeated uint64 elems=2}."""
+    """proto libs.bits.BitArray {int64 bits=1, repeated uint64 elems=2}.
+
+    Elems are emitted packed (wire type 2), matching gogoproto's proto3
+    default for repeated scalars, and unconditionally — zero elems are data
+    (an all-zero bitmap must round-trip to its full length).
+    """
     if ba is None:
         return b""
-    out = protoio.field_varint(1, ba.size())
-    for e in ba.elems():
-        out += protoio.field_varint(2, e)
+    out = protoio.field_varint(1, ba.size)
+    elems = ba.elems()
+    if elems:
+        packed = b"".join(protoio.encode_varint(e) for e in elems)
+        out += protoio.field_bytes(2, packed)
     return out
 
 
@@ -37,12 +51,25 @@ def _decode_bit_array(data: bytes) -> Optional[BitArray]:
         f, wt = r.read_tag()
         if f == 1:
             bits = r.read_varint()
+        elif f == 2 and wt == protoio.WIRE_BYTES:
+            # packed repeated uint64 (gogoproto/proto3 default)
+            pr = protoio.WireReader(r.read_bytes())
+            while not pr.at_end():
+                elems.append(pr.read_uvarint())
         elif f == 2:
-            elems.append(r.read_varint())
+            elems.append(r.read_uvarint())
         else:
             r.skip(wt)
     if bits == 0:
         return None
+    if bits < 0 or bits > MAX_BIT_ARRAY_BITS:
+        raise ValueError(f"bit array size {bits} out of range")
+    want = (bits + 63) // 64
+    if not elems:
+        # an encoder that omits zero fields sends an all-zero bitmap as
+        # bits-only; anything partially present is ambiguous (interior zero
+        # elems shift the map) and stays a hard error in from_elems
+        elems = [0] * want
     return BitArray.from_elems(bits, elems)
 
 

@@ -68,6 +68,93 @@ class TestWALCodec:
         assert isinstance(dec, ProposalMessage)
         assert dec.proposal.height == 5
 
+    def test_all_gossip_messages_roundtrip(self):
+        """Every consensus wire message — including the BitArray-bearing ones
+        (NewValidBlock/ProposalPOL/VoteSetBits) that only appear after the
+        first commit — must encode and decode losslessly."""
+        from cometbft_tpu.consensus.messages import (
+            HasVoteMessage,
+            NewRoundStepMessage,
+            NewValidBlockMessage,
+            ProposalPOLMessage,
+            VoteSetBitsMessage,
+            VoteSetMaj23Message,
+        )
+        from cometbft_tpu.libs.bits import BitArray
+
+        ba = BitArray(10)
+        ba.set_index(0, True)
+        ba.set_index(7, True)
+        bid = BlockID(
+            hash=b"\x01" * 32, part_set_header=PartSetHeader(3, b"\x02" * 32)
+        )
+        msgs = [
+            NewRoundStepMessage(5, 2, 3, 17, 1),
+            NewValidBlockMessage(5, 2, PartSetHeader(3, b"\x02" * 32), ba, True),
+            ProposalMessage(Proposal(height=5, round=1)),
+            ProposalPOLMessage(5, 1, ba),
+            BlockPartMessage(
+                9, 0, PartSet.from_data(b"some block data").get_part(0)
+            ),
+            HasVoteMessage(5, 2, SIGNED_MSG_TYPE_PREVOTE, 3),
+            VoteSetMaj23Message(5, 2, SIGNED_MSG_TYPE_PREVOTE, bid),
+            VoteSetBitsMessage(5, 2, SIGNED_MSG_TYPE_PREVOTE, bid, ba),
+        ]
+        for m in msgs:
+            dec = decode_consensus_message(encode_consensus_message(m))
+            assert type(dec) is type(m), m
+        # BitArray contents survive
+        dec = decode_consensus_message(
+            encode_consensus_message(VoteSetBitsMessage(5, 2, 1, bid, ba))
+        )
+        assert dec.votes.size == 10
+        assert dec.votes.get_index(0) and dec.votes.get_index(7)
+        assert not dec.votes.get_index(1)
+        # all-zero bitmaps (fresh part sets) must round-trip to full length
+        empty = BitArray(100)
+        dec = decode_consensus_message(
+            encode_consensus_message(
+                NewValidBlockMessage(5, 0, PartSetHeader(2, b"\x02" * 32), empty)
+            )
+        )
+        assert dec.block_parts.size == 100
+        assert not any(dec.block_parts.get_index(i) for i in range(100))
+
+    def test_bit_array_decode_hardening(self):
+        """Packed elems parse correctly; hostile/ambiguous inputs raise."""
+        from cometbft_tpu.consensus.messages import (
+            _decode_bit_array,
+            _encode_bit_array,
+        )
+        from cometbft_tpu.libs import protoio
+        from cometbft_tpu.libs.bits import BitArray
+
+        # our encoder emits packed; decode round-trips bit-exactly
+        ba = BitArray(130)
+        for i in (0, 64, 129):
+            ba.set_index(i, True)
+        dec = _decode_bit_array(_encode_bit_array(ba))
+        assert [dec.get_index(i) for i in range(130)] == [
+            ba.get_index(i) for i in range(130)
+        ]
+        # unpacked (one varint per elem) still accepted
+        unpacked = protoio.field_varint(1, 70)
+        for e in ba.elems()[:2]:
+            unpacked += protoio.tag(2, protoio.WIRE_VARINT) + protoio.encode_varint(e)
+        dec = _decode_bit_array(unpacked)
+        assert dec.get_index(0) and dec.get_index(64)
+        # a 12-byte message must not drive a multi-GB allocation
+        hostile = protoio.field_varint(1, 1 << 40)
+        with pytest.raises(ValueError):
+            _decode_bit_array(hostile)
+        # partially-omitted elems are ambiguous (interior zeros shift the
+        # bitmap) — hard error, not silent padding
+        partial = protoio.field_varint(1, 128) + protoio.field_bytes(
+            2, protoio.encode_varint(1)
+        )
+        with pytest.raises(ValueError):
+            _decode_bit_array(partial)
+
 
 class TestWAL:
     def test_write_read_search(self):

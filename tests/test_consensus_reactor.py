@@ -113,13 +113,41 @@ def _make_net(n=4):
     return [Node(doc, privs[i]) for i in range(n)], doc, privs
 
 
-def _connect_all(nodes):
-    for i, a in enumerate(nodes):
-        for b in nodes[i + 1 :]:
-            try:
-                a.switch.dial_peer_with_address(b.addr())
-            except Exception:
-                pass  # may already be connected in the other direction
+def _connect_all(nodes, timeout=60.0):
+    """Dial until a full mesh forms, re-dialing failed pairs.
+
+    Simultaneous cross-dials can reject one direction as a duplicate while
+    the other also dies (close races) — on a single-core box with no retry
+    the mesh never completes, so retry with surfaced errors instead of
+    fire-and-forget (reference: p2p/switch.go reconnectToPeer persistence).
+    """
+    want = len(nodes) - 1
+    deadline = time.monotonic() + timeout
+    errs: list = []
+    while time.monotonic() < deadline:
+        if all(
+            _dial_from(a, nodes, errs) >= want for a in nodes
+        ):
+            return
+        time.sleep(0.25)
+    raise AssertionError(
+        f"mesh incomplete after {timeout}s; peers="
+        f"{[n.switch.peers.size() for n in nodes]}; "
+        f"last dial error: {errs[-1] if errs else None!r}"
+    )
+
+
+def _dial_from(node, peers, errs: list = None) -> int:
+    """Dial every not-yet-connected peer once; return current peer count."""
+    for p in peers:
+        if p is node or node.switch.peers.has(p.node_key.id()):
+            continue
+        try:
+            node.switch.dial_peer_with_address(p.addr())
+        except Exception as exc:
+            if errs is not None:
+                errs.append(exc)
+    return node.switch.peers.size()
 
 
 def _wait(cond, timeout=60.0, interval=0.05, desc=""):
@@ -194,11 +222,12 @@ class TestConsensusOverTCP:
             # exclusively via consensus gossip (block parts from the store
             # + catchup commits)
             nodes[3].start()
-            for peer in nodes[:3]:
-                try:
-                    nodes[3].switch.dial_peer_with_address(peer.addr())
-                except Exception:
-                    pass
+            _wait(
+                lambda: _dial_from(nodes[3], nodes[:3]) >= 1,
+                timeout=30,
+                interval=0.25,
+                desc="late node connecting to at least one peer",
+            )
             target = max(n.height() for n in nodes[:3])
             _wait(
                 lambda: nodes[3].height() >= target,
