@@ -48,7 +48,7 @@ DATA_CHANNEL = 0x21
 VOTE_CHANNEL = 0x22
 VOTE_SET_BITS_CHANNEL = 0x23
 
-PEER_STATE_KEY = "ConsensusReactor.peerState"
+from cometbft_tpu.types.keys import PEER_STATE_KEY  # shared with mempool/evidence
 PEER_GOSSIP_SLEEP = 0.1  # config/config.go:983 PeerGossipSleepDuration
 PEER_QUERY_MAJ23_SLEEP = 2.0  # config/config.go:984
 VOTES_TO_BECOME_GOOD_PEER = 10000

@@ -21,6 +21,7 @@ from cometbft_tpu.libs.db import DB
 from cometbft_tpu.libs.log import Logger, new_nop_logger
 from cometbft_tpu.types.evidence import (
     DuplicateVoteEvidence,
+    ErrInvalidEvidence,
     Evidence,
     LightClientAttackEvidence,
     decode_evidence,
@@ -222,12 +223,15 @@ class Pool:
 
         meta = self._block_store.load_block_meta(ev.height())
         if meta is None:
+            # not a protocol violation: we may simply not have (or have
+            # pruned) that header — plain ValueError, sender not punished
             raise ValueError(f"don't have header #{ev.height()}")
         ev_time = meta.header.time
         if ev.time() != ev_time:
-            raise ValueError(
+            raise ErrInvalidEvidence(
+                ev,
                 f"evidence has a different time to the block it is "
-                f"associated with ({ev.time()} != {ev_time})"
+                f"associated with ({ev.time()} != {ev_time})",
             )
         age_blocks = height - ev.height()
         age_ns = state.last_block_time.to_unix_ns() - ev_time.to_unix_ns()
@@ -240,7 +244,10 @@ class Pool:
 
         if isinstance(ev, DuplicateVoteEvidence):
             val_set = self._state_store.load_validators(ev.height())
-            verify_duplicate_vote(ev, state.chain_id, val_set)
+            try:
+                verify_duplicate_vote(ev, state.chain_id, val_set)
+            except ValueError as exc:
+                raise ErrInvalidEvidence(ev, str(exc)) from exc
         elif isinstance(ev, LightClientAttackEvidence):
             common_header = self._signed_header(ev.height())
             common_vals = self._state_store.load_validators(ev.height())
@@ -258,11 +265,14 @@ class Pool:
                         raise ValueError(
                             "latest block time is before conflicting block time"
                         )
-            verify_light_client_attack(
-                ev, common_header, trusted_header, common_vals
-            )
+            try:
+                verify_light_client_attack(
+                    ev, common_header, trusted_header, common_vals
+                )
+            except ValueError as exc:
+                raise ErrInvalidEvidence(ev, str(exc)) from exc
         else:
-            raise ValueError(f"unrecognized evidence type: {type(ev)}")
+            raise ErrInvalidEvidence(ev, f"unrecognized evidence type: {type(ev)}")
 
     def _signed_header(self, height: int):
         sh = self._try_signed_header(height)

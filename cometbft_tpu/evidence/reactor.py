@@ -1,0 +1,139 @@
+"""Evidence reactor — gossips byzantine-behavior evidence on channel 0x38.
+
+Reference: evidence/reactor.go — one broadcastEvidenceRoutine per peer
+(:119) walks the pool's concurrent list and re-broadcasts pending evidence
+every broadcastEvidenceIntervalS until it's committed; evidence is only
+sent to peers whose height makes it committable for them
+(prepareEvidenceMessage :178: peerHeight - maxAge < evHeight < peerHeight).
+Wire format: tendermint.types.EvidenceList{repeated Evidence evidence=1}.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from cometbft_tpu.evidence.pool import Pool
+from cometbft_tpu.libs.log import Logger
+from cometbft_tpu.p2p.base_reactor import Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.peer import Peer
+from cometbft_tpu.types.evidence import (
+    ErrInvalidEvidence,
+    Evidence,
+    decode_evidence_list,
+    encode_evidence_list,
+)
+
+from cometbft_tpu.types.keys import PEER_STATE_KEY
+
+EVIDENCE_CHANNEL = 0x38
+MAX_MSG_SIZE = 1048576  # 1 MB (reference :18)
+BROADCAST_EVIDENCE_INTERVAL = 10.0  # reference :24
+PEER_RETRY_MESSAGE_INTERVAL = 0.1  # reference :26
+
+
+class EvidenceReactor(Reactor):
+    def __init__(self, evpool: Pool, logger: Optional[Logger] = None):
+        super().__init__("EvidenceReactor", logger)
+        self.evpool = evpool
+
+    # -- Reactor interface ---------------------------------------------------
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                id=EVIDENCE_CHANNEL,
+                priority=6,
+                recv_message_capacity=MAX_MSG_SIZE,
+            )
+        ]
+
+    def add_peer(self, peer: Peer) -> None:
+        threading.Thread(
+            target=self._broadcast_evidence_routine,
+            args=(peer,),
+            name=f"evidence-gossip-{peer.id()[:8]}",
+            daemon=True,
+        ).start()
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        try:
+            evis = decode_evidence_list(msg_bytes)
+        except Exception as exc:
+            self.switch.stop_peer_for_error(peer, exc)
+            return
+        for ev in evis:
+            try:
+                self.evpool.add_evidence(ev)
+            except ErrInvalidEvidence as exc:
+                # cryptographically invalid evidence is a protocol violation
+                # by the sender (reference reactor.go:82)
+                self.logger.error(
+                    "evidence is not valid", evidence=str(ev), err=str(exc)
+                )
+                self.switch.stop_peer_for_error(peer, exc)
+                return
+            except Exception as exc:
+                # context failures (missing header, expiry race) — log only
+                self.logger.info("evidence has not been added", err=str(exc))
+
+    # -- gossip --------------------------------------------------------------
+
+    def _peer_height(self, peer: Peer) -> Optional[int]:
+        ps = peer.get(PEER_STATE_KEY)
+        if ps is None:
+            return None
+        try:
+            return ps.get_height()
+        except Exception:
+            return None
+
+    def _prepare_evidence_message(
+        self, peer: Peer, ev: Evidence
+    ) -> List[Evidence]:
+        """Empty list = not (yet) sendable to this peer (reference :178)."""
+        peer_height = self._peer_height(peer)
+        if peer_height is None:
+            # no consensus state yet (reactor start ordering) — wait for the
+            # consensus reactor to set it rather than sending blind
+            # (reference :185-193)
+            return []
+        params = self.evpool.state().consensus_params.evidence
+        ev_height = ev.height()
+        if peer_height <= ev_height:
+            return []  # peer is behind; wait for it to catch up
+        if peer_height - ev_height > params.max_age_num_blocks:
+            return []  # too old relative to the peer; it can never commit it
+        return [ev]
+
+    def _broadcast_evidence_routine(self, peer: Peer) -> None:
+        next_elem = None
+        while self.is_running() and peer.is_running():
+            if next_elem is None:
+                next_elem = self.evpool.evidence_list.front_wait(timeout=0.5)
+                if next_elem is None:
+                    continue
+            ev: Evidence = next_elem.value
+            evis = self._prepare_evidence_message(peer, ev)
+            if evis:
+                ok = peer.send(EVIDENCE_CHANNEL, encode_evidence_list(evis))
+                if not ok:
+                    time.sleep(PEER_RETRY_MESSAGE_INTERVAL)
+                    continue
+            elif not next_elem.removed:
+                # not sendable yet — retry this element after a short sleep
+                # (not the 10s broadcast interval below)
+                time.sleep(PEER_RETRY_MESSAGE_INTERVAL)
+                continue
+
+            nxt = next_elem.next_wait(timeout=BROADCAST_EVIDENCE_INTERVAL)
+            if nxt is not None:
+                next_elem = nxt
+            elif next_elem.removed:
+                next_elem = None  # restart from the front
+            else:
+                # interval elapsed: restart from the front so uncommitted
+                # evidence is re-broadcast (reference :159-164)
+                next_elem = None

@@ -1,0 +1,199 @@
+"""Mempool reactor — gossips pending transactions on channel 0x30.
+
+Reference: mempool/v0/reactor.go — one broadcastTxRoutine per peer (:216)
+walks the mempool's concurrent list and streams each tx the peer hasn't
+already sent us (sender tracking via a peer-ID map, mempool/ids.go); the
+routine lags behind peers that are catching up (height gating against the
+consensus reactor's PeerState) and Receive (:160) feeds inbound txs to
+CheckTx. Wire format: tendermint.mempool.Message{Txs{repeated bytes txs=1}}.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.libs.log import Logger
+from cometbft_tpu.mempool import ErrTxInCache
+from cometbft_tpu.mempool.clist_mempool import CListMempool, TxInfo
+from cometbft_tpu.p2p.base_reactor import Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.peer import Peer
+
+from cometbft_tpu.types.keys import PEER_STATE_KEY
+
+MEMPOOL_CHANNEL = 0x30
+PEER_CATCHUP_SLEEP = 0.1  # reference: PeerCatchupSleepIntervalMS = 100
+MAX_ACTIVE_IDS = 1 << 16
+UNKNOWN_PEER_ID = 0  # reserved for txs submitted locally (RPC)
+
+
+def encode_txs_message(txs: List[bytes]) -> bytes:
+    """Message{ Txs{ repeated bytes txs = 1 } } (mempool/types.proto)."""
+    inner = b"".join(protoio.field_bytes(1, tx) for tx in txs)
+    return protoio.field_message(1, inner)
+
+
+def decode_txs_message(data: bytes) -> List[bytes]:
+    r = protoio.WireReader(data)
+    txs: List[bytes] = []
+    while not r.at_end():
+        f, wt = r.read_tag()
+        if f == 1:
+            tr = protoio.WireReader(r.read_bytes())
+            while not tr.at_end():
+                tf, twt = tr.read_tag()
+                if tf == 1:
+                    txs.append(tr.read_bytes())
+                else:
+                    tr.skip(twt)
+        else:
+            r.skip(wt)
+    return txs
+
+
+class MempoolIDs:
+    """Peer ID → small-int map for compact sender tracking (mempool/ids.go)."""
+
+    def __init__(self) -> None:
+        self._mtx = threading.Lock()
+        self._peer_map: Dict[str, int] = {}
+        self._active: set = {UNKNOWN_PEER_ID}
+        self._next_id = 1
+
+    def reserve_for_peer(self, peer: Peer) -> int:
+        with self._mtx:
+            if len(self._active) >= MAX_ACTIVE_IDS:
+                raise RuntimeError("max active peer IDs reached")
+            while self._next_id in self._active:
+                self._next_id += 1
+            cur = self._next_id
+            self._next_id += 1
+            self._peer_map[peer.id()] = cur
+            self._active.add(cur)
+            return cur
+
+    def reclaim(self, peer: Peer) -> None:
+        with self._mtx:
+            cur = self._peer_map.pop(peer.id(), None)
+            if cur is not None:
+                self._active.discard(cur)
+                if cur < self._next_id:
+                    self._next_id = cur
+
+    def get_for_peer(self, peer: Peer) -> int:
+        with self._mtx:
+            return self._peer_map.get(peer.id(), UNKNOWN_PEER_ID)
+
+
+class MempoolReactor(Reactor):
+    def __init__(
+        self,
+        config,  # MempoolConfig
+        mempool: CListMempool,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("MempoolReactor", logger)
+        self.config = config
+        self.mempool = mempool
+        self.ids = MempoolIDs()
+
+    # -- Reactor interface ---------------------------------------------------
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        # capacity for one batch message holding one max-size tx
+        largest = self.config.max_tx_bytes + 64
+        return [
+            ChannelDescriptor(
+                id=MEMPOOL_CHANNEL,
+                priority=5,
+                recv_message_capacity=largest,
+            )
+        ]
+
+    def init_peer(self, peer: Peer) -> Peer:
+        self.ids.reserve_for_peer(peer)
+        return peer
+
+    def add_peer(self, peer: Peer) -> None:
+        if self.config.broadcast:
+            threading.Thread(
+                target=self._broadcast_tx_routine,
+                args=(peer,),
+                name=f"mempool-gossip-{peer.id()[:8]}",
+                daemon=True,
+            ).start()
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self.ids.reclaim(peer)
+        # the broadcast routine notices peer.is_running() is false and exits
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        try:
+            txs = decode_txs_message(msg_bytes)
+        except Exception as exc:
+            self.switch.stop_peer_for_error(peer, exc)
+            return
+        if not txs:
+            self.logger.error("received empty txs from peer", peer=peer.id()[:8])
+            return
+        tx_info = TxInfo(sender_id=self.ids.get_for_peer(peer))
+        for tx in txs:
+            try:
+                self.mempool.check_tx(tx, None, tx_info)
+            except ErrTxInCache:
+                pass  # normal under gossip flooding
+            except Exception as exc:
+                self.logger.info("could not check tx", err=str(exc))
+
+    # -- gossip --------------------------------------------------------------
+
+    def _peer_height(self, peer: Peer) -> Optional[int]:
+        ps = peer.get(PEER_STATE_KEY)
+        if ps is None:
+            return None
+        try:
+            return ps.get_height()
+        except Exception:
+            return None
+
+    def _broadcast_tx_routine(self, peer: Peer) -> None:
+        peer_id = self.ids.get_for_peer(peer)
+        next_elem = None
+        handled_elem = None  # tail element already sent (or sender-skipped)
+        while self.is_running() and peer.is_running():
+            if next_elem is None:
+                next_elem = self.mempool.txs_wait_chan().front_wait(timeout=0.5)
+                if next_elem is None:
+                    continue
+            mem_tx = next_elem.value
+
+            # don't flood peers still catching up: allow a one-block lag
+            # (reference :250). A peer with no consensus state yet (reactor
+            # start ordering) is treated as current — unlike the reference we
+            # don't spin-wait, so the mempool works without consensus wired.
+            h = self._peer_height(peer)
+            if h is not None and 0 < h < mem_tx.height - 1:
+                time.sleep(PEER_CATCHUP_SLEEP)
+                continue
+
+            # each element is sent at most once per peer: a next_wait timeout
+            # at the list tail must not re-enter the send path (the reference
+            # blocks on NextWaitChan, so it never revisits an element)
+            if next_elem is not handled_elem:
+                if peer_id not in mem_tx.senders:
+                    ok = peer.send(
+                        MEMPOOL_CHANNEL, encode_txs_message([mem_tx.tx])
+                    )
+                    if not ok:
+                        time.sleep(PEER_CATCHUP_SLEEP)
+                        continue
+                handled_elem = next_elem
+
+            nxt = next_elem.next_wait(timeout=0.5)
+            if nxt is None and next_elem.removed:
+                next_elem = None  # restart from the front
+            elif nxt is not None:
+                next_elem = nxt

@@ -26,6 +26,7 @@ from cometbft_tpu.types.validator_set import ValidatorSet
 from cometbft_tpu.types.part_set import Part, PartSet, BLOCK_PART_SIZE_BYTES
 from cometbft_tpu.types.params import ConsensusParams
 from cometbft_tpu.types.tx import Tx, Txs
+from cometbft_tpu.types.keys import PEER_STATE_KEY
 
 __all__ = [
     "BlockID",

@@ -201,6 +201,18 @@ class LightClientAttackEvidence(Evidence):
             raise ValueError("negative or zero common height")
 
 
+class ErrInvalidEvidence(ValueError):
+    """Evidence that fails cryptographic/semantic verification — a protocol
+    violation by whoever relayed it (reference: types/evidence.go:521).
+    Context failures (missing header, expiry races) are plain ValueError so
+    honest-but-racing peers are not punished."""
+
+    def __init__(self, ev: Evidence, reason: str):
+        super().__init__(f"invalid evidence: {reason}")
+        self.evidence = ev
+        self.reason = reason
+
+
 def encode_evidence(ev: Evidence) -> bytes:
     return ev.bytes()
 

@@ -1,0 +1,8 @@
+"""Shared peer-data keys (reference: types/keys.go:5).
+
+The consensus reactor stores its PeerState under this key; the mempool and
+evidence reactors read it (height gating) — a shared constant so a rename
+fails loudly instead of silently disabling the gating.
+"""
+
+PEER_STATE_KEY = "ConsensusReactor.peerState"

@@ -41,29 +41,59 @@ from cometbft_tpu.store import BlockStore
 from cometbft_tpu.types import test_util
 from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
 
+from cometbft_tpu.evidence.reactor import EVIDENCE_CHANNEL
+from cometbft_tpu.mempool.reactor import MEMPOOL_CHANNEL
+
 CHANNELS = bytes(
-    [STATE_CHANNEL, DATA_CHANNEL, VOTE_CHANNEL, VOTE_SET_BITS_CHANNEL]
+    [
+        STATE_CHANNEL,
+        DATA_CHANNEL,
+        VOTE_CHANNEL,
+        VOTE_SET_BITS_CHANNEL,
+        MEMPOOL_CHANNEL,
+        EVIDENCE_CHANNEL,
+    ]
 )
 
 
 class Node:
     def __init__(self, doc: GenesisDoc, priv_val):
+        from cometbft_tpu.evidence.pool import Pool as EvidencePool
+        from cometbft_tpu.evidence.reactor import EvidenceReactor
+        from cometbft_tpu.mempool.clist_mempool import CListMempool
+        from cometbft_tpu.mempool.reactor import MempoolReactor
+        from cometbft_tpu.proxy import AppConnMempool
+        from cometbft_tpu.state.execution import BlockExecutor
+
         state = make_genesis_state(doc)
         self.state_store = Store(MemDB())
         self.state_store.save(state)
         self.block_store = BlockStore(MemDB())
         self.client = LocalClient(KVStoreApplication())
         self.client.start()
-        from cometbft_tpu.state.execution import BlockExecutor
 
-        executor = BlockExecutor(self.state_store, AppConnConsensus(self.client))
-        cfg = make_test_config().consensus
+        test_cfg = make_test_config()
+        self.mempool = CListMempool(
+            test_cfg.mempool, AppConnMempool(self.client)
+        )
+        self.evpool = EvidencePool(
+            MemDB(), self.state_store, self.block_store
+        )
+        executor = BlockExecutor(
+            self.state_store,
+            AppConnConsensus(self.client),
+            mempool=self.mempool,
+            evidence_pool=self.evpool,
+        )
+        cfg = test_cfg.consensus
         cfg.wal_path = ""
         self.cons = ConsensusState(
             cfg, state, executor, self.block_store, wal=NilWAL()
         )
         self.cons.set_priv_validator(priv_val)
         self.reactor = ConsensusReactor(self.cons)
+        self.mempool_reactor = MempoolReactor(test_cfg.mempool, self.mempool)
+        self.evidence_reactor = EvidenceReactor(self.evpool)
 
         self.node_key = NodeKey(ed.gen_priv_key())
         info = NodeInfo(
@@ -80,6 +110,8 @@ class Node:
             f"127.0.0.1:{self.transport.listen_addr.port}"
         )
         self.switch = Switch(self.transport, reconnect_interval=0.2)
+        self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
+        self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
         self.switch.add_reactor("CONSENSUS", self.reactor)
 
     def start(self):
@@ -201,6 +233,123 @@ class TestConsensusOverTCP:
                 lambda: all(n.height() > 2 for n in nodes[:3]),
                 timeout=90,
                 desc="progress with 3/4 validators",
+            )
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_tx_gossips_and_commits_across_the_net(self):
+        """Reference: the full tx lifecycle (SURVEY §3.3) — a tx submitted
+        to one node travels mempool gossip (0x30), is reaped by whichever
+        node proposes, committed, and the app state is updated on every
+        node."""
+        nodes, _, _ = _make_net(4)
+        for n in nodes:
+            n.start()
+        try:
+            _connect_all(nodes)
+            _wait(
+                lambda: all(n.height() > 1 for n in nodes),
+                timeout=60,
+                desc="initial progress",
+            )
+            # submit to node 0 only
+            nodes[0].mempool.check_tx(b"k1=v1", None)
+            # every other node's mempool sees it via gossip (unless it was
+            # already committed out from under the mempool)
+            def tx_committed(n):
+                from cometbft_tpu.abci import types as abci
+
+                res = n.client.query_sync(
+                    abci.RequestQuery(path="/store", data=b"k1")
+                )
+                return res.value == b"v1"
+
+            _wait(
+                lambda: all(tx_committed(n) for n in nodes),
+                timeout=90,
+                desc="tx committed and readable on all nodes",
+            )
+            # the tx is inside one committed block, identical everywhere
+            heights_with_tx = [
+                h
+                for h in range(1, nodes[0].height() + 1)
+                if nodes[0].block_store.load_block(h) is not None
+                and b"k1=v1" in list(nodes[0].block_store.load_block(h).data.txs)
+            ]
+            assert len(heights_with_tx) == 1, heights_with_tx
+            h = heights_with_tx[0]
+            for n in nodes[1:]:
+                blk = n.block_store.load_block(h)
+                assert blk is not None and b"k1=v1" in list(blk.data.txs)
+            # mempools drained
+            _wait(
+                lambda: all(n.mempool.size() == 0 for n in nodes),
+                timeout=30,
+                desc="mempools drained",
+            )
+        finally:
+            for n in nodes:
+                n.stop()
+
+    def test_evidence_gossips_and_lands_in_a_block(self):
+        """Duplicate-vote evidence added on one node is gossiped (0x38),
+        included in a proposal, validated by every node's pool, and marked
+        committed everywhere (reference: evidence/reactor.go +
+        state/execution.go CreateProposalBlock evidence inclusion)."""
+        from cometbft_tpu.proto.gogo import Timestamp
+        from cometbft_tpu.types import test_util
+        from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+        from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PRECOMMIT
+
+        nodes, doc, privs = _make_net(4)
+        for n in nodes:
+            n.start()
+        try:
+            _connect_all(nodes)
+            _wait(
+                lambda: all(n.height() > 2 for n in nodes),
+                timeout=60,
+                desc="initial progress",
+            )
+            # craft equivocation by validator 0 at height 1, timestamped
+            # with block 1's committed time so every pool verifies it
+            block_time = nodes[0].block_store.load_block_meta(1).header.time
+            vals = nodes[0].cons.state.last_validators
+            pv = privs[0]
+            idx, _ = vals.get_by_address(pv.get_pub_key().address())
+            v1 = test_util.make_vote(
+                pv, doc.chain_id, idx, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT,
+                test_util.make_block_id(b"\xaa" * 32), timestamp=block_time,
+            )
+            v2 = test_util.make_vote(
+                pv, doc.chain_id, idx, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT,
+                test_util.make_block_id(b"\xbb" * 32), timestamp=block_time,
+            )
+            ev = DuplicateVoteEvidence.new(
+                v1, v2, block_time, nodes[0].cons.state.validators
+            )
+            nodes[1].evpool.add_evidence(ev)
+
+            def ev_in_committed_block(n):
+                for h in range(2, n.height() + 1):
+                    blk = n.block_store.load_block(h)
+                    if blk is not None and any(
+                        e.hash() == ev.hash() for e in blk.evidence
+                    ):
+                        return True
+                return False
+
+            _wait(
+                lambda: all(ev_in_committed_block(n) for n in nodes),
+                timeout=90,
+                desc="evidence committed on all nodes",
+            )
+            # pools marked it committed: pending everywhere drains
+            _wait(
+                lambda: all(n.evpool.size() == 0 for n in nodes),
+                timeout=30,
+                desc="evidence pools drained",
             )
         finally:
             for n in nodes:

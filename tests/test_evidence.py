@@ -1,0 +1,216 @@
+"""Evidence pool + verification unit tests.
+
+Model: reference evidence/pool_test.go (add/duplicate/expiry/committed/
+pending caps/consensus buffer) and evidence/verify_test.go (duplicate-vote
+signature and power checks).
+"""
+
+import pytest
+
+from cometbft_tpu.evidence.pool import Pool
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.state import make_genesis_state
+from cometbft_tpu.state.store import Store
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import test_util
+from cometbft_tpu.types.block import Commit
+from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PRECOMMIT
+
+CHAIN_ID = "evidence-test-chain"
+GENESIS_TIME = Timestamp(1_700_000_000, 0)
+
+
+def _make_chain(n_vals=4, heights=3):
+    """Build a state store + block store with `heights` committed empty
+    blocks, signed by a deterministic validator set."""
+    vals, privs = test_util.deterministic_validator_set(n_vals, 10)
+    doc = GenesisDoc(
+        genesis_time=GENESIS_TIME,
+        chain_id=CHAIN_ID,
+        validators=[
+            GenesisValidator(v.address, v.pub_key, v.voting_power, "")
+            for v in vals.validators
+        ],
+    )
+    state = make_genesis_state(doc)
+    state_store = Store(MemDB())
+    state_store.save(state)
+    block_store = BlockStore(MemDB())
+
+    last_commit = Commit(height=0, round=0)
+    for h in range(1, heights + 1):
+        proposer = state.validators.validators[0].address
+        block, parts = state.make_block(h, [], last_commit, [], proposer)
+        block_id = test_util.make_block_id(
+            block.hash(), parts.header().total, parts.header().hash
+        )
+        seen_commit = test_util.make_commit(
+            block_id, h, 0, state.validators, privs, CHAIN_ID,
+            now=Timestamp(GENESIS_TIME.seconds + h, 0),
+        )
+        block_store.save_block(block, parts, seen_commit)
+        state.last_block_height = h
+        state.last_block_id = block_id
+        state.last_block_time = block.header.time
+        state.last_validators = state.validators
+        state_store.save(state)
+        last_commit = seen_commit
+    return state, state_store, block_store, vals, privs
+
+
+def _dup_vote_ev(state, block_store, vals, privs, height=1, val_idx=0):
+    """Two conflicting precommits from the same validator at `height`."""
+    block_time = block_store.load_block_meta(height).header.time
+    pv = privs[val_idx]
+    v1 = test_util.make_vote(
+        pv, CHAIN_ID, val_idx, height, 0, SIGNED_MSG_TYPE_PRECOMMIT,
+        test_util.make_block_id(b"\xaa" * 32), timestamp=block_time,
+    )
+    v2 = test_util.make_vote(
+        pv, CHAIN_ID, val_idx, height, 0, SIGNED_MSG_TYPE_PRECOMMIT,
+        test_util.make_block_id(b"\xbb" * 32), timestamp=block_time,
+    )
+    return DuplicateVoteEvidence.new(v1, v2, block_time, vals)
+
+
+def _mk_pool(state_store, block_store):
+    return Pool(MemDB(), state_store, block_store)
+
+
+class TestEvidencePool:
+    def test_add_valid_evidence(self):
+        state, ss, bs, vals, privs = _make_chain()
+        pool = _mk_pool(ss, bs)
+        ev = _dup_vote_ev(state, bs, vals, privs)
+        pool.add_evidence(ev)
+        assert pool.size() == 1
+        pending, size = pool.pending_evidence(-1)
+        assert pending == [ev] and size > 0
+        # idempotent
+        pool.add_evidence(ev)
+        assert pool.size() == 1
+
+    def test_reject_bad_signature(self):
+        from cometbft_tpu.types.evidence import ErrInvalidEvidence
+
+        state, ss, bs, vals, privs = _make_chain()
+        pool = _mk_pool(ss, bs)
+        ev = _dup_vote_ev(state, bs, vals, privs)
+        ev.vote_b.signature = b"\x00" * 64
+        # a verification failure is classified as invalid (peer-punishable)
+        with pytest.raises(ErrInvalidEvidence, match="signature"):
+            pool.add_evidence(ev)
+        assert pool.size() == 0
+
+    def test_missing_header_is_not_invalid_evidence(self):
+        """Context failures must NOT be ErrInvalidEvidence — the reactor
+        would disconnect an honest peer over a pruning/height race."""
+        from cometbft_tpu.types.evidence import ErrInvalidEvidence
+
+        state, ss, bs, vals, privs = _make_chain(heights=3)
+        pool = _mk_pool(ss, bs)
+        ev = _dup_vote_ev(state, bs, vals, privs, height=1)
+        # evidence claims a height this node has no header for
+        ev.vote_a.height = ev.vote_b.height = 50
+        with pytest.raises(ValueError, match="don't have header") as ei:
+            pool.add_evidence(ev)
+        assert not isinstance(ei.value, ErrInvalidEvidence)
+
+    def test_reject_unknown_validator(self):
+        state, ss, bs, vals, privs = _make_chain()
+        pool = _mk_pool(ss, bs)
+        other_vals, other_privs = test_util.deterministic_validator_set(5, 7)
+        block_time = bs.load_block_meta(1).header.time
+        pv = other_privs[4]
+        v1 = test_util.make_vote(
+            pv, CHAIN_ID, 0, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT,
+            test_util.make_block_id(b"\xaa" * 32), timestamp=block_time,
+        )
+        v2 = test_util.make_vote(
+            pv, CHAIN_ID, 0, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT,
+            test_util.make_block_id(b"\xbb" * 32), timestamp=block_time,
+        )
+        ev = DuplicateVoteEvidence.new(v1, v2, block_time, other_vals)
+        with pytest.raises(ValueError):
+            pool.add_evidence(ev)
+
+    def test_reject_wrong_time(self):
+        state, ss, bs, vals, privs = _make_chain()
+        pool = _mk_pool(ss, bs)
+        ev = _dup_vote_ev(state, bs, vals, privs)
+        ev.timestamp = Timestamp(ev.timestamp.seconds + 100, 0)
+        with pytest.raises(ValueError, match="different time"):
+            pool.add_evidence(ev)
+
+    def test_reject_expired_evidence(self):
+        state, ss, bs, vals, privs = _make_chain(heights=3)
+        # tighten the expiry window so height-1 evidence is already stale
+        state.consensus_params.evidence.max_age_num_blocks = 1
+        state.consensus_params.evidence.max_age_duration_ns = 1
+        ss.save(state)
+        pool = _mk_pool(ss, bs)
+        ev = _dup_vote_ev(state, bs, vals, privs, height=1)
+        with pytest.raises(ValueError, match="too old"):
+            pool.add_evidence(ev)
+
+    def test_update_marks_committed_and_prunes(self):
+        state, ss, bs, vals, privs = _make_chain()
+        pool = _mk_pool(ss, bs)
+        ev = _dup_vote_ev(state, bs, vals, privs)
+        pool.add_evidence(ev)
+        assert pool.size() == 1
+        state.last_block_height += 1  # the block carrying the evidence
+        pool.update(state, [ev])
+        assert pool.size() == 0
+        assert pool.pending_evidence(-1)[0] == []
+        # committed evidence can't come back
+        pool.add_evidence(ev)
+        assert pool.size() == 0
+        with pytest.raises(ValueError, match="committed"):
+            pool.check_evidence([ev])
+
+    def test_check_evidence_adds_unseen_and_rejects_duplicates_in_block(self):
+        state, ss, bs, vals, privs = _make_chain()
+        pool = _mk_pool(ss, bs)
+        ev = _dup_vote_ev(state, bs, vals, privs)
+        pool.check_evidence([ev])  # not pending yet → verified + added
+        assert pool.size() == 1
+        with pytest.raises(ValueError, match="duplicate"):
+            pool.check_evidence([ev, ev])
+
+    def test_pending_evidence_byte_cap(self):
+        state, ss, bs, vals, privs = _make_chain()
+        pool = _mk_pool(ss, bs)
+        e1 = _dup_vote_ev(state, bs, vals, privs, val_idx=0)
+        e2 = _dup_vote_ev(state, bs, vals, privs, val_idx=1)
+        pool.add_evidence(e1)
+        pool.add_evidence(e2)
+        all_evs, total = pool.pending_evidence(-1)
+        assert len(all_evs) == 2
+        some, size = pool.pending_evidence(total - 1)
+        assert len(some) == 1 and size < total
+
+    def test_consensus_buffer_processed_on_update(self):
+        state, ss, bs, vals, privs = _make_chain()
+        pool = _mk_pool(ss, bs)
+        block_time = bs.load_block_meta(1).header.time
+        pv = privs[2]
+        v1 = test_util.make_vote(
+            pv, CHAIN_ID, 2, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT,
+            test_util.make_block_id(b"\xaa" * 32), timestamp=block_time,
+        )
+        v2 = test_util.make_vote(
+            pv, CHAIN_ID, 2, 1, 0, SIGNED_MSG_TYPE_PRECOMMIT,
+            test_util.make_block_id(b"\xbb" * 32), timestamp=block_time,
+        )
+        pool.report_conflicting_votes(v1, v2)
+        assert pool.size() == 0  # buffered, not yet pending
+        state.last_block_height += 1
+        pool.update(state, [])
+        assert pool.size() == 1
+        ev = pool.pending_evidence(-1)[0][0]
+        assert isinstance(ev, DuplicateVoteEvidence)
+        assert ev.validator_power == 10

