@@ -1,0 +1,41 @@
+"""Block sync ("fast sync") — catch up to the chain tip by downloading
+committed blocks from peers instead of walking consensus.
+
+Reference: blockchain/v0 — BlockPool with per-height requesters
+(blockchain/v0/pool.go), a reactor serving/fetching blocks on channel 0x40
+and a poolRoutine that verifies each fetched block with the NEXT block's
+LastCommit via VerifyCommitLight (blockchain/v0/reactor.go:309-420,
+verify at :366).
+
+TPU-first design departure: the reference verifies one block per loop
+iteration (~N serial ed25519 verifies per block). Here the pool exposes a
+contiguous *window* of buffered blocks and the reactor verifies every
+commit in the window through ONE BatchVerifier call (pipeline-depth ×
+quorum sigs per device round-trip) — see reactor.BlocksyncReactor.
+"""
+
+from cometbft_tpu.blocksync.messages import (
+    BLOCKSYNC_CHANNEL,
+    BlockRequest,
+    BlockResponse,
+    NoBlockResponse,
+    StatusRequest,
+    StatusResponse,
+    decode_blocksync_message,
+    encode_blocksync_message,
+)
+from cometbft_tpu.blocksync.pool import BlockPool
+from cometbft_tpu.blocksync.reactor import BlocksyncReactor
+
+__all__ = [
+    "BLOCKSYNC_CHANNEL",
+    "BlockPool",
+    "BlockRequest",
+    "BlockResponse",
+    "BlocksyncReactor",
+    "NoBlockResponse",
+    "StatusRequest",
+    "StatusResponse",
+    "decode_blocksync_message",
+    "encode_blocksync_message",
+]

@@ -1,0 +1,143 @@
+"""Blocksync wire messages — channel 0x40.
+
+Reference: blockchain/msgs.go + proto/tendermint/blockchain/types.proto:
+Message{oneof sum: BlockRequest=1, NoBlockResponse=2, BlockResponse=3,
+StatusRequest=4, StatusResponse=5}.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.types.block import Block
+
+BLOCKSYNC_CHANNEL = 0x40
+# matching the reference's MaxMsgSize (blockchain/msgs.go: types.MaxBlockSizeBytes + overhead)
+MAX_MSG_SIZE = 104857600 + 1024
+
+
+@dataclass
+class BlockRequest:
+    height: int = 0
+
+    def encode(self) -> bytes:
+        return protoio.field_varint(1, self.height)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockRequest":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class NoBlockResponse:
+    height: int = 0
+
+    def encode(self) -> bytes:
+        return protoio.field_varint(1, self.height)
+
+    @classmethod
+    def decode(cls, data: bytes) -> "NoBlockResponse":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class BlockResponse:
+    block: Optional[Block] = None
+
+    def encode(self) -> bytes:
+        return protoio.field_message(
+            1, self.block.encode() if self.block else b""
+        )
+
+    @classmethod
+    def decode(cls, data: bytes) -> "BlockResponse":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.block = Block.decode(r.read_bytes())
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class StatusRequest:
+    def encode(self) -> bytes:
+        return b""
+
+    @classmethod
+    def decode(cls, data: bytes) -> "StatusRequest":
+        return cls()
+
+
+@dataclass
+class StatusResponse:
+    height: int = 0
+    base: int = 0
+
+    def encode(self) -> bytes:
+        out = protoio.field_varint(1, self.height)
+        out += protoio.field_varint(2, self.base)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "StatusResponse":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            elif f == 2:
+                out.base = r.read_varint()
+            else:
+                r.skip(wt)
+        return out
+
+
+_BY_FIELD = {
+    1: BlockRequest,
+    2: NoBlockResponse,
+    3: BlockResponse,
+    4: StatusRequest,
+    5: StatusResponse,
+}
+_FIELD_BY_TYPE = {cls: num for num, cls in _BY_FIELD.items()}
+
+
+def encode_blocksync_message(msg) -> bytes:
+    num = _FIELD_BY_TYPE.get(type(msg))
+    if num is None:
+        raise ValueError(f"unknown blocksync message {type(msg)}")
+    return protoio.field_message(num, msg.encode())
+
+
+def decode_blocksync_message(data: bytes):
+    r = protoio.WireReader(data)
+    while not r.at_end():
+        f, wt = r.read_tag()
+        cls = _BY_FIELD.get(f)
+        if cls is not None:
+            return cls.decode(r.read_bytes())
+        r.skip(wt)
+    raise ValueError("empty blocksync Message")

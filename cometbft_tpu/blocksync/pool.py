@@ -1,0 +1,319 @@
+"""BlockPool — schedules block requests across peers and buffers responses.
+
+Reference: blockchain/v0/pool.go — per-height bpRequesters with peer
+backpressure (maxPendingRequestsPerPeer), peer timeout detection, redo on
+bad blocks, IsCaughtUp against the max reported peer height, and
+PeekTwoBlocks/PopRequest consumed by the reactor's sync loop.
+
+Design departure from the reference: Go runs one goroutine per requester
+(up to 600); on a GIL runtime that's pure scheduler churn, so a single
+scheduler thread drives every requester as a small state machine —
+dispatching requests, retrying timed-out heights on other peers, and
+expiring silent peers. Semantics (assignment, redo, backpressure,
+caught-up condition) match the reference.
+
+It also generalizes PeekTwoBlocks to peek_window(): the contiguous run of
+buffered blocks from the pool height, so the reactor can batch-verify many
+commits in one TPU call instead of one block per iteration.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.types.block import Block
+
+MAX_TOTAL_REQUESTERS = 600
+MAX_PENDING_REQUESTS_PER_PEER = 20
+REQUEST_RETRY_SECONDS = 30.0
+PEER_TIMEOUT = 15.0
+SCHEDULER_INTERVAL = 0.02
+MAX_DIFF_CURRENT_AND_RECEIVED_HEIGHT = 100
+CAUGHT_UP_MIN_WAIT = 5.0
+
+
+@dataclass
+class _Requester:
+    """One in-flight height (reference: bpRequester, minus the goroutine)."""
+
+    height: int
+    peer_id: str = ""
+    block: Optional[Block] = None
+    sent_at: float = 0.0
+
+
+@dataclass
+class _BPPeer:
+    """Reference: bpPeer."""
+
+    id: str
+    base: int = 0
+    height: int = 0
+    num_pending: int = 0
+    last_recv: float = field(default_factory=time.monotonic)
+    did_timeout: bool = False
+
+
+class BlockPool(BaseService):
+    def __init__(
+        self,
+        start_height: int,
+        request_cb: Callable[[int, str], None],
+        error_cb: Callable[[Exception, str], None],
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("BlockPool", logger or new_nop_logger())
+        self._mtx = threading.RLock()
+        self.height = start_height  # lowest height not yet popped
+        self._requesters: Dict[int, _Requester] = {}
+        self._peers: Dict[str, _BPPeer] = {}
+        self._max_peer_height = 0
+        self._request_cb = request_cb
+        self._error_cb = error_cb
+        self._start_time = 0.0
+        self._received_any = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._start_time = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._scheduler_routine, name="blockpool-sched", daemon=True
+        )
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        pass
+
+    # -- scheduler (the one thread) -------------------------------------------
+
+    def _scheduler_routine(self) -> None:
+        while self.is_running():
+            try:
+                self._schedule_once()
+            except Exception as exc:
+                self.logger.error("block pool scheduler", err=str(exc))
+            time.sleep(SCHEDULER_INTERVAL)
+
+    def _schedule_once(self) -> None:
+        now = time.monotonic()
+        dispatch: List[Tuple[int, str]] = []
+        errors: List[Tuple[Exception, str]] = []
+        with self._mtx:
+            # expire silent peers (reference: bpPeer.onTimeout)
+            for peer in list(self._peers.values()):
+                if (
+                    peer.num_pending > 0
+                    and now - peer.last_recv > PEER_TIMEOUT
+                ):
+                    peer.did_timeout = True
+                    errors.append(
+                        (TimeoutError("peer did not send us anything"), peer.id)
+                    )
+                    self._remove_peer_locked(peer.id)
+
+            # retry requests stuck past the retry window on a new peer
+            for req in self._requesters.values():
+                if (
+                    req.block is None
+                    and req.peer_id
+                    and now - req.sent_at > REQUEST_RETRY_SECONDS
+                ):
+                    self._unassign_locked(req)
+
+            # assign unassigned requesters + spawn new ones
+            next_height = self.height + len(self._requesters)
+            while (
+                len(self._requesters) < MAX_TOTAL_REQUESTERS
+                and next_height <= self._max_peer_height
+            ):
+                self._requesters[next_height] = _Requester(next_height)
+                next_height += 1
+            for req in sorted(self._requesters.values(), key=lambda r: r.height):
+                if req.block is None and not req.peer_id:
+                    peer = self._pick_peer_locked(req.height)
+                    if peer is None:
+                        continue
+                    req.peer_id = peer.id
+                    req.sent_at = now
+                    peer.num_pending += 1
+                    dispatch.append((req.height, peer.id))
+        # callbacks outside the lock (they send on the switch)
+        for height, peer_id in dispatch:
+            self._request_cb(height, peer_id)
+        for err, peer_id in errors:
+            self._error_cb(err, peer_id)
+
+    def _pick_peer_locked(self, height: int) -> Optional[_BPPeer]:
+        for peer in self._peers.values():
+            if peer.did_timeout:
+                continue
+            if peer.num_pending >= MAX_PENDING_REQUESTS_PER_PEER:
+                continue
+            if height < peer.base or height > peer.height:
+                continue
+            return peer
+        return None
+
+    def _unassign_locked(self, req: _Requester) -> None:
+        peer = self._peers.get(req.peer_id)
+        if peer is not None and peer.num_pending > 0:
+            peer.num_pending -= 1
+        req.peer_id = ""
+        req.sent_at = 0.0
+
+    # -- peer management -------------------------------------------------------
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        """Reference: SetPeerRange — from a StatusResponse."""
+        with self._mtx:
+            peer = self._peers.get(peer_id)
+            if peer is not None:
+                peer.base = base
+                peer.height = height
+            else:
+                self._peers[peer_id] = _BPPeer(peer_id, base, height)
+            if height > self._max_peer_height:
+                self._max_peer_height = height
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            self._remove_peer_locked(peer_id)
+
+    def _remove_peer_locked(self, peer_id: str) -> None:
+        for req in self._requesters.values():
+            if req.peer_id == peer_id and req.block is None:
+                req.peer_id = ""
+                req.sent_at = 0.0
+        peer = self._peers.pop(peer_id, None)
+        if peer is not None and peer.height == self._max_peer_height:
+            self._max_peer_height = max(
+                (p.height for p in self._peers.values()), default=0
+            )
+
+    def max_peer_height(self) -> int:
+        with self._mtx:
+            return self._max_peer_height
+
+    def num_peers(self) -> int:
+        with self._mtx:
+            return len(self._peers)
+
+    # -- blocks ----------------------------------------------------------------
+
+    def add_block(self, peer_id: str, block: Block, block_size: int) -> None:
+        """Reference: AddBlock — only accepted from the assigned peer."""
+        with self._mtx:
+            req = self._requesters.get(block.header.height)
+            if req is None:
+                diff = abs(self.height - block.header.height)
+                if diff > MAX_DIFF_CURRENT_AND_RECEIVED_HEIGHT:
+                    self._error_cb(
+                        ValueError(
+                            "peer sent us a block we didn't expect with a "
+                            "height too far ahead/behind"
+                        ),
+                        peer_id,
+                    )
+                return
+            if req.block is not None or req.peer_id != peer_id:
+                self._error_cb(
+                    ValueError("block from peer we didn't request it from"),
+                    peer_id,
+                )
+                return
+            req.block = block
+            self._received_any = True
+            peer = self._peers.get(peer_id)
+            if peer is not None:
+                if peer.num_pending > 0:
+                    peer.num_pending -= 1
+                peer.last_recv = time.monotonic()
+
+    def peek_two_blocks(self) -> Tuple[Optional[Block], Optional[Block]]:
+        """Reference: PeekTwoBlocks — block H is verified by H+1's commit."""
+        with self._mtx:
+            first = self._requesters.get(self.height)
+            second = self._requesters.get(self.height + 1)
+            return (
+                first.block if first else None,
+                second.block if second else None,
+            )
+
+    def peek_window(self, max_blocks: int) -> List[Block]:
+        """The contiguous run of buffered blocks from the pool height, plus
+        the one after (its LastCommit verifies the last block in the run).
+        Returns [] unless at least blocks H and H+1 are present.
+
+        This is the TPU batching surface: k+1 buffered blocks let the
+        reactor verify k commits in one device call.
+        """
+        with self._mtx:
+            out: List[Block] = []
+            h = self.height
+            while len(out) < max_blocks + 1:
+                req = self._requesters.get(h)
+                if req is None or req.block is None:
+                    break
+                out.append(req.block)
+                h += 1
+            return out if len(out) >= 2 else []
+
+    def pop_request(self) -> None:
+        """Drop the verified block at pool height (reference: PopRequest)."""
+        with self._mtx:
+            req = self._requesters.pop(self.height, None)
+            if req is None:
+                raise RuntimeError(
+                    f"expected requester to pop at height {self.height}"
+                )
+            self.height += 1
+
+    def redo_request(self, height: int) -> str:
+        """Invalidate the block at `height`; requests assigned to its peer
+        are re-dispatched (reference: RedoRequest → removePeer)."""
+        with self._mtx:
+            req = self._requesters.get(height)
+            if req is None:
+                return ""
+            peer_id = req.peer_id
+            req.block = None
+            if peer_id:
+                # drop every block we got from the lying peer
+                for r in self._requesters.values():
+                    if r.peer_id == peer_id:
+                        r.block = None
+                        r.peer_id = ""
+                        r.sent_at = 0.0
+                self._remove_peer_locked(peer_id)
+            return peer_id
+
+    # -- status -----------------------------------------------------------------
+
+    def get_status(self) -> Tuple[int, int, int]:
+        with self._mtx:
+            pending = sum(
+                1 for r in self._requesters.values() if r.block is None
+            )
+            return self.height, pending, len(self._requesters)
+
+    def is_caught_up(self) -> bool:
+        """Reference: IsCaughtUp — needs a peer, and our height within one of
+        the best peer height (H+1's commit is needed to verify H)."""
+        with self._mtx:
+            if not self._peers:
+                return False
+            received_or_waited = self._received_any or (
+                time.monotonic() - self._start_time > CAUGHT_UP_MIN_WAIT
+            )
+            chain_is_longest = (
+                self._max_peer_height == 0
+                or self.height >= self._max_peer_height - 1
+            )
+            return received_or_waited and chain_is_longest

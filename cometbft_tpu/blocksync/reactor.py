@@ -1,0 +1,376 @@
+"""Blocksync reactor — serves blocks to peers and fast-syncs from them.
+
+Reference: blockchain/v0/reactor.go — AddPeer sends our StatusResponse
+(:150-166), Receive handles the five message kinds (:198-235), and
+poolRoutine (:309-420) drives the sync: verify the block at pool height
+with the NEXT block's LastCommit (VerifyCommitLight :366), ValidateBlock,
+SaveBlock, ApplyBlock, and SwitchToConsensus when caught up (:317-331).
+
+TPU-first: instead of one VerifyCommitLight per loop iteration, the sync
+loop takes the pool's contiguous window of fetched blocks and verifies
+every commit in it through ONE BatchVerifier call — pipeline-depth ×
+quorum-sigs signatures per device round-trip, which is where batch
+hardware wins (BASELINE.md config #4). Validator-set changes inside the
+window are detected via header.validators_hash and those blocks drop out
+of the batch to the exact reference per-block path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from cometbft_tpu.blocksync.messages import (
+    BLOCKSYNC_CHANNEL,
+    MAX_MSG_SIZE,
+    BlockRequest,
+    BlockResponse,
+    NoBlockResponse,
+    StatusRequest,
+    StatusResponse,
+    decode_blocksync_message,
+    encode_blocksync_message,
+)
+from cometbft_tpu.blocksync.pool import BlockPool
+from cometbft_tpu.crypto import batch as cryptobatch
+from cometbft_tpu.libs.log import Logger
+from cometbft_tpu.p2p.base_reactor import Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.peer import Peer
+from cometbft_tpu.types.block import Block, BlockID
+from cometbft_tpu.types.part_set import BLOCK_PART_SIZE_BYTES
+from cometbft_tpu.types.validator_set import cs_sig
+
+TRY_SYNC_INTERVAL = 0.01  # reference: trySyncIntervalMS = 10
+STATUS_UPDATE_INTERVAL = 10.0  # reference :36
+SWITCH_TO_CONSENSUS_INTERVAL = 1.0  # reference :39
+DEFAULT_VERIFY_WINDOW = 16  # blocks batch-verified per device call
+
+
+class BlocksyncReactor(Reactor):
+    def __init__(
+        self,
+        state,  # state.State at store height
+        block_exec,  # state.execution.BlockExecutor
+        block_store,
+        fast_sync: bool,
+        verify_window: int = DEFAULT_VERIFY_WINDOW,
+        crypto_backend: Optional[str] = None,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("BlocksyncReactor", logger)
+        if state.last_block_height != block_store.height():
+            raise ValueError(
+                f"state ({state.last_block_height}) and store "
+                f"({block_store.height()}) height mismatch"
+            )
+        self.initial_state = state
+        self.block_exec = block_exec
+        self.store = block_store
+        self.fast_sync = fast_sync
+        self.verify_window = verify_window
+        self.crypto_backend = crypto_backend
+        start_height = block_store.height() + 1
+        if start_height == 1:
+            start_height = state.initial_height
+        self.pool = BlockPool(
+            start_height, self._send_request, self._on_pool_error,
+            logger=self.logger,
+        )
+        self.blocks_synced = 0
+        self.sync_error: Optional[Exception] = None
+        self._pool_thread: Optional[threading.Thread] = None
+
+    # -- Reactor interface ---------------------------------------------------
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                id=BLOCKSYNC_CHANNEL,
+                priority=5,
+                send_queue_capacity=1000,
+                recv_message_capacity=MAX_MSG_SIZE,
+            )
+        ]
+
+    def on_start(self) -> None:
+        if self.fast_sync:
+            self.pool.start()
+            self._pool_thread = threading.Thread(
+                target=self._pool_routine, name="blocksync-pool", daemon=True
+            )
+            self._pool_thread.start()
+
+    def on_stop(self) -> None:
+        if self.pool.is_running():
+            self.pool.stop()
+
+    def add_peer(self, peer: Peer) -> None:
+        # tell the peer our range; it adds us to its pool on receipt
+        peer.send(
+            BLOCKSYNC_CHANNEL,
+            encode_blocksync_message(
+                StatusResponse(self.store.height(), self.store.base())
+            ),
+        )
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        self.pool.remove_peer(peer.id())
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        try:
+            msg = decode_blocksync_message(msg_bytes)
+        except Exception as exc:
+            self.switch.stop_peer_for_error(peer, exc)
+            return
+        if isinstance(msg, BlockRequest):
+            self._respond_to_peer(msg, peer)
+        elif isinstance(msg, BlockResponse):
+            if msg.block is not None:
+                self.pool.add_block(peer.id(), msg.block, len(msg_bytes))
+        elif isinstance(msg, StatusRequest):
+            peer.send(
+                BLOCKSYNC_CHANNEL,
+                encode_blocksync_message(
+                    StatusResponse(self.store.height(), self.store.base())
+                ),
+            )
+        elif isinstance(msg, StatusResponse):
+            self.pool.set_peer_range(peer.id(), msg.base, msg.height)
+        elif isinstance(msg, NoBlockResponse):
+            self.logger.debug(
+                "peer does not have the requested block", height=msg.height
+            )
+
+    def _respond_to_peer(self, msg: BlockRequest, peer: Peer) -> None:
+        block = self.store.load_block(msg.height)
+        if block is not None:
+            peer.try_send(
+                BLOCKSYNC_CHANNEL,
+                encode_blocksync_message(BlockResponse(block)),
+            )
+        else:
+            peer.try_send(
+                BLOCKSYNC_CHANNEL,
+                encode_blocksync_message(NoBlockResponse(msg.height)),
+            )
+
+    # -- pool callbacks -------------------------------------------------------
+
+    def _send_request(self, height: int, peer_id: str) -> None:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is None:
+            return
+        peer.try_send(
+            BLOCKSYNC_CHANNEL,
+            encode_blocksync_message(BlockRequest(height)),
+        )
+
+    def _on_pool_error(self, err: Exception, peer_id: str) -> None:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is not None:
+            self.switch.stop_peer_for_error(peer, err)
+
+    def broadcast_status_request(self) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(
+                BLOCKSYNC_CHANNEL, encode_blocksync_message(StatusRequest())
+            )
+
+    # -- sync loop -------------------------------------------------------------
+
+    def _pool_routine(self) -> None:
+        chain_id = self.initial_state.chain_id
+        state = self.initial_state
+        last_status = 0.0
+        last_switch_check = 0.0
+        while self.is_running() and self.pool.is_running():
+            now = time.monotonic()
+            if now - last_status >= STATUS_UPDATE_INTERVAL:
+                self.broadcast_status_request()
+                last_status = now
+            if now - last_switch_check >= SWITCH_TO_CONSENSUS_INTERVAL:
+                last_switch_check = now
+                if self.pool.is_caught_up():
+                    self.logger.info(
+                        "switching to consensus", height=self.pool.height
+                    )
+                    self.pool.stop()
+                    con_r = (
+                        self.switch.reactor("CONSENSUS")
+                        if self.switch
+                        else None
+                    )
+                    if con_r is not None and hasattr(
+                        con_r, "switch_to_consensus"
+                    ):
+                        con_r.switch_to_consensus(
+                            state, self.blocks_synced > 0
+                        )
+                    return
+            try:
+                state = self._try_sync_window(chain_id, state)
+            except Exception as exc:
+                # the reference panics here ("failed to process committed
+                # block"); a dead daemon thread would leave a zombie node,
+                # so fail visibly: record the error and stop the pool so
+                # is_caught_up()/sync_error surface the broken state
+                self.sync_error = exc
+                self.logger.error(
+                    "FATAL: failed to process committed block — "
+                    "stopping blocksync", err=str(exc),
+                )
+                self.pool.stop()
+                return
+            time.sleep(TRY_SYNC_INTERVAL)
+
+    def _try_sync_window(self, chain_id: str, state):
+        """Verify + apply the buffered window. Returns the new state.
+
+        Batch path: one BatchVerifier call covers the quorum signatures of
+        every window block whose validator set is the current one. Any
+        failure falls back to the reference's single-block path so error
+        attribution (redo + peer punishment) is identical.
+        """
+        window = self.pool.peek_window(self.verify_window)
+        if not window:
+            return state
+        val_hash = state.validators.hash()
+        # blocks past a validator-set change can't share the batch
+        batchable = 0
+        for blk in window[:-1]:
+            if blk.header.validators_hash != val_hash:
+                break
+            batchable += 1
+        if batchable == 0:
+            return self._sync_one(chain_id, state)
+
+        firsts = window[:batchable]
+        block_ids: List[BlockID] = []
+        part_sets: List[object] = []
+        per_block: List[List[Tuple[int, object]]] = []
+        bv = cryptobatch.new_batch_verifier(self.crypto_backend)
+        needed = state.validators.total_voting_power() * 2 // 3
+        for i, first in enumerate(firsts):
+            parts = first.make_part_set(BLOCK_PART_SIZE_BYTES)
+            block_id = BlockID(first.hash(), parts.header())
+            block_ids.append(block_id)
+            part_sets.append(parts)
+            second = window[i + 1]
+            commit = second.last_commit
+            entries = []
+            try:
+                self._check_commit_shape(
+                    state, block_id, first.header.height, commit
+                )
+                speculative = 0
+                for idx, csig in enumerate(commit.signatures):
+                    if not csig.for_block():
+                        continue
+                    val = state.validators.validators[idx]
+                    entries.append((idx, val))
+                    bv.add(
+                        val.pub_key,
+                        commit.vote_sign_bytes(chain_id, idx),
+                        cs_sig(commit, idx),
+                    )
+                    speculative += val.voting_power
+                    if speculative > needed:
+                        break
+            except Exception:
+                # malformed commit in the window — single-block path will
+                # attribute and redo it
+                return self._sync_one(chain_id, state)
+            per_block.append(entries)
+
+        ok, mask = bv.verify() if bv.count() else (True, [])
+        if not ok:
+            return self._sync_one(chain_id, state)
+
+        # all signatures verified: check quorum per block, then apply
+        pos = 0
+        for i, entries in enumerate(per_block):
+            tallied = 0
+            for (idx, val), sig_ok in zip(entries, mask[pos : pos + len(entries)]):
+                if sig_ok:
+                    tallied += val.voting_power
+            pos += len(entries)
+            if tallied <= needed:
+                return self._sync_one(chain_id, state)
+
+        for i, first in enumerate(firsts):
+            # a validator-set change mid-window invalidates the batch
+            # assumption from this point on — re-verify individually
+            if state.validators.hash() != val_hash:
+                return state
+            try:
+                self.block_exec.validate_block(state, first)
+            except Exception:
+                # single-block path re-verifies and attributes the failure
+                return self._sync_one(chain_id, state)
+            state = self._apply_one(
+                state, block_ids[i], first, part_sets[i],
+                window[i + 1].last_commit,
+            )
+        return state
+
+    def _sync_one(self, chain_id: str, state):
+        """The reference's exact PeekTwoBlocks path (:348-404): verify one
+        block, redo + punish on failure."""
+        first, second = self.pool.peek_two_blocks()
+        if first is None or second is None:
+            return state
+        parts = first.make_part_set(BLOCK_PART_SIZE_BYTES)
+        block_id = BlockID(first.hash(), parts.header())
+        try:
+            state.validators.verify_commit_light(
+                chain_id,
+                block_id,
+                first.header.height,
+                second.last_commit,
+                backend=self.crypto_backend,
+            )
+            self.block_exec.validate_block(state, first)
+        except Exception as exc:
+            self.logger.error("error in validation", err=str(exc))
+            for h in (first.header.height, second.header.height):
+                peer_id = self.pool.redo_request(h)
+                peer = (
+                    self.switch.peers.get(peer_id)
+                    if self.switch and peer_id
+                    else None
+                )
+                if peer is not None:
+                    self.switch.stop_peer_for_error(
+                        peer, ValueError(f"blocksync validation error: {exc}")
+                    )
+            return state
+        return self._apply_one(state, block_id, first, parts, second.last_commit)
+
+    def _apply_one(self, state, block_id: BlockID, first: Block, parts, seen_commit):
+        self.pool.pop_request()
+        self.store.save_block(first, parts, seen_commit)
+        new_state, _ = self.block_exec.apply_block(state, block_id, first)
+        self.blocks_synced += 1
+        if self.blocks_synced % 100 == 0:
+            self.logger.info(
+                "blocksync rate", height=self.pool.height,
+                max_peer_height=self.pool.max_peer_height(),
+            )
+        return new_state
+
+    @staticmethod
+    def _check_commit_shape(state, block_id: BlockID, height: int, commit) -> None:
+        """The non-crypto preconditions of VerifyCommitLight."""
+        if commit is None:
+            raise ValueError("nil commit")
+        if state.validators.size() != len(commit.signatures):
+            raise ValueError(
+                f"wrong signature count: {state.validators.size()} != "
+                f"{len(commit.signatures)}"
+            )
+        if height != commit.height:
+            raise ValueError(f"wrong commit height {commit.height} != {height}")
+        if block_id != commit.block_id:
+            raise ValueError("commit for a different block ID")

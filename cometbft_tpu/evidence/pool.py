@@ -105,7 +105,10 @@ class Pool:
                 return
             if self._is_committed(ev):
                 return
-            ev.validate_basic()
+            try:
+                ev.validate_basic()
+            except ValueError as exc:
+                raise ErrInvalidEvidence(ev, str(exc)) from exc
             self._verify(ev)
             self._add_pending(ev)
             self.evidence_list.push_back(ev)

@@ -122,11 +122,11 @@ class EvidenceReactor(Reactor):
                 if not ok:
                     time.sleep(PEER_RETRY_MESSAGE_INTERVAL)
                     continue
-            elif not next_elem.removed:
-                # not sendable yet — retry this element after a short sleep
-                # (not the 10s broadcast interval below)
-                time.sleep(PEER_RETRY_MESSAGE_INTERVAL)
-                continue
+            # not-sendable elements are NOT retried in place: advance (or
+            # restart from the front after the broadcast interval) exactly
+            # like the reference's select loop (:159-172) — a permanently
+            # unsendable element (too old for this peer) must never block
+            # newer evidence behind it
 
             nxt = next_elem.next_wait(timeout=BROADCAST_EVIDENCE_INTERVAL)
             if nxt is not None:

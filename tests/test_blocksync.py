@@ -1,0 +1,341 @@
+"""Blocksync: message codec, BlockPool scheduling, and a full fast-sync of a
+multi-hundred-block chain over real TCP.
+
+Model: reference blockchain/v0/pool_test.go + reactor_test.go
+(TestNoBlockResponse, TestFastSyncBasic-style: a fresh node syncs from a
+peer with a prebuilt chain, then switches to consensus).
+"""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.blocksync import (
+    BLOCKSYNC_CHANNEL,
+    BlockPool,
+    BlockRequest,
+    BlockResponse,
+    BlocksyncReactor,
+    NoBlockResponse,
+    StatusRequest,
+    StatusResponse,
+    decode_blocksync_message,
+    encode_blocksync_message,
+)
+from cometbft_tpu.config import test_config as make_test_config
+from cometbft_tpu.consensus.reactor import ConsensusReactor
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.consensus.wal import NilWAL
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.p2p import (
+    MultiplexTransport,
+    NetAddress,
+    NodeInfo,
+    NodeKey,
+    ProtocolVersion,
+    Switch,
+)
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.proxy import AppConnConsensus
+from cometbft_tpu.state import make_genesis_state
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.store import Store
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import test_util
+from cometbft_tpu.types.block import Block, Commit
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+CHAIN_ID = "blocksync-test-chain"
+GENESIS_TIME = Timestamp(1_700_000_000, 0)
+
+
+class TestBlocksyncCodec:
+    def test_all_messages_roundtrip(self):
+        blk = Block()
+        blk.header.height = 7
+        blk.header.chain_id = CHAIN_ID
+        blk.last_commit = Commit(height=6, round=0)
+        msgs = [
+            BlockRequest(12),
+            NoBlockResponse(13),
+            BlockResponse(blk),
+            StatusRequest(),
+            StatusResponse(100, 1),
+        ]
+        for m in msgs:
+            dec = decode_blocksync_message(encode_blocksync_message(m))
+            assert type(dec) is type(m)
+        dec = decode_blocksync_message(
+            encode_blocksync_message(BlockResponse(blk))
+        )
+        assert dec.block.header.height == 7
+        dec = decode_blocksync_message(
+            encode_blocksync_message(StatusResponse(100, 1))
+        )
+        assert (dec.height, dec.base) == (100, 1)
+
+    def test_malformed_raises(self):
+        with pytest.raises(Exception):
+            decode_blocksync_message(b"")
+
+
+class TestBlockPool:
+    def _mk(self, start=1):
+        requests = []
+        errors = []
+        pool = BlockPool(
+            start,
+            lambda h, p: requests.append((h, p)),
+            lambda e, p: errors.append((e, p)),
+        )
+        return pool, requests, errors
+
+    def test_dispatches_requests_to_peers(self):
+        pool, requests, _ = self._mk()
+        pool.start()
+        try:
+            pool.set_peer_range("peerA", 1, 10)
+            deadline = time.monotonic() + 5
+            while len(requests) < 10 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            heights = sorted(h for h, _ in requests)
+            assert heights == list(range(1, 11))
+        finally:
+            pool.stop()
+
+    def test_backpressure_per_peer(self):
+        pool, requests, _ = self._mk()
+        pool.start()
+        try:
+            pool.set_peer_range("peerA", 1, 500)
+            time.sleep(0.3)
+            # only maxPendingRequestsPerPeer in flight on one peer
+            assert len(requests) == 20
+        finally:
+            pool.stop()
+
+    def test_add_block_and_window(self):
+        pool, requests, errors = self._mk()
+        pool.start()
+        try:
+            pool.set_peer_range("peerA", 1, 10)
+            deadline = time.monotonic() + 5
+            while len(requests) < 5 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            blocks = {}
+            for h in range(1, 6):
+                b = Block()
+                b.header.height = h
+                blocks[h] = b
+            # out-of-order arrival
+            for h in (3, 1, 2, 5, 4):
+                pool.add_block("peerA", blocks[h], 100)
+            window = pool.peek_window(10)
+            assert [b.header.height for b in window] == [1, 2, 3, 4, 5]
+            first, second = pool.peek_two_blocks()
+            assert first.header.height == 1 and second.header.height == 2
+            pool.pop_request()
+            assert pool.peek_two_blocks()[0].header.height == 2
+            assert not errors
+        finally:
+            pool.stop()
+
+    def test_block_from_wrong_peer_rejected(self):
+        pool, requests, errors = self._mk()
+        pool.start()
+        try:
+            pool.set_peer_range("peerA", 1, 5)
+            deadline = time.monotonic() + 5
+            while not requests and time.monotonic() < deadline:
+                time.sleep(0.02)
+            b = Block()
+            b.header.height = requests[0][0]
+            pool.add_block("peerB", b, 100)  # not the assigned peer
+            assert errors and errors[0][1] == "peerB"
+            assert pool.peek_two_blocks() == (None, None)
+        finally:
+            pool.stop()
+
+    def test_redo_request_drops_peer_blocks(self):
+        pool, requests, _ = self._mk()
+        pool.start()
+        try:
+            pool.set_peer_range("peerA", 1, 5)
+            deadline = time.monotonic() + 5
+            while len(requests) < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            for h in (1, 2, 3):
+                b = Block()
+                b.header.height = h
+                pool.add_block("peerA", b, 100)
+            assert pool.redo_request(1) == "peerA"
+            # every block from the bad peer is gone
+            assert pool.peek_two_blocks() == (None, None)
+            assert pool.num_peers() == 0
+        finally:
+            pool.stop()
+
+    def test_is_caught_up(self):
+        pool, _, _ = self._mk(start=11)
+        pool.start()
+        try:
+            assert not pool.is_caught_up()  # no peers
+            pool.set_peer_range("peerA", 1, 10)
+            # our 11 >= 10-1: caught up once received-or-waited is true
+            b = Block()
+            b.header.height = 11
+            # received_any is set through add_block only for wanted heights;
+            # instead rely on the 5s grace — simulate by backdating
+            pool._start_time -= 10
+            assert pool.is_caught_up()
+            pool.set_peer_range("peerB", 1, 100)
+            assert not pool.is_caught_up()
+        finally:
+            pool.stop()
+
+
+# -- full TCP fast-sync ------------------------------------------------------
+
+
+def _build_chain_node(doc, privs, n_blocks):
+    """A node whose stores hold n_blocks committed blocks (built through the
+    real executor so app hashes line up)."""
+    state = make_genesis_state(doc)
+    state_store = Store(MemDB())
+    state_store.save(state)
+    block_store = BlockStore(MemDB())
+    client = LocalClient(KVStoreApplication())
+    client.start()
+    executor = BlockExecutor(state_store, AppConnConsensus(client))
+
+    from cometbft_tpu.types.block import BlockID
+
+    last_commit = Commit(height=0, round=0)
+    for h in range(1, n_blocks + 1):
+        proposer = state.validators.validators[h % len(privs)].address
+        block, parts = executor.create_proposal_block(
+            h, state, last_commit, proposer
+        )
+        block_id = BlockID(block.hash(), parts.header())
+        seen_commit = test_util.make_commit(
+            block_id, h, 0, state.validators, privs, doc.chain_id,
+            now=Timestamp(GENESIS_TIME.seconds + h, 0),
+        )
+        block_store.save_block(block, parts, seen_commit)
+        state, _ = executor.apply_block(state, block_id, block)
+        last_commit = seen_commit
+    return state, state_store, block_store, client
+
+
+class _SyncNode:
+    """A node (server or fresh syncer) with blocksync + consensus reactors."""
+
+    def __init__(self, doc, priv_val, state, state_store, block_store, client,
+                 fast_sync, verify_window=16):
+        self.state_store = state_store
+        self.block_store = block_store
+        self.client = client
+        executor = BlockExecutor(state_store, AppConnConsensus(client))
+        cfg = make_test_config().consensus
+        cfg.wal_path = ""
+        self.cons = ConsensusState(
+            cfg, state, executor, block_store, wal=NilWAL()
+        )
+        if priv_val is not None:
+            self.cons.set_priv_validator(priv_val)
+        self.cons_reactor = ConsensusReactor(
+            self.cons, wait_sync=fast_sync
+        )
+        self.bs_reactor = BlocksyncReactor(
+            state, executor, block_store, fast_sync=fast_sync,
+            verify_window=verify_window,
+        )
+        self.node_key = NodeKey(ed.gen_priv_key())
+        info = NodeInfo(
+            protocol_version=ProtocolVersion(),
+            node_id=self.node_key.id(),
+            listen_addr="127.0.0.1:0",
+            network=doc.chain_id,
+            channels=bytes([BLOCKSYNC_CHANNEL, 0x20, 0x21, 0x22, 0x23]),
+            moniker="bs-test",
+        )
+        self.transport = MultiplexTransport(info, self.node_key)
+        self.transport.listen(NetAddress("", "127.0.0.1", 0))
+        info.listen_addr = f"127.0.0.1:{self.transport.listen_addr.port}"
+        self.switch = Switch(self.transport, reconnect_interval=0.2)
+        self.switch.add_reactor("BLOCKSYNC", self.bs_reactor)
+        self.switch.add_reactor("CONSENSUS", self.cons_reactor)
+
+    def start(self):
+        self.switch.start()
+
+    def stop(self):
+        for svc in (self.switch, self.client):
+            try:
+                if svc.is_running():
+                    svc.stop()
+            except Exception:
+                pass
+
+
+def _make_doc(n_vals=4):
+    vals, privs = test_util.deterministic_validator_set(n_vals, 10)
+    doc = GenesisDoc(
+        genesis_time=GENESIS_TIME,
+        chain_id=CHAIN_ID,
+        validators=[
+            GenesisValidator(v.address, v.pub_key, v.voting_power, "")
+            for v in vals.validators
+        ],
+    )
+    return doc, vals, privs
+
+
+@pytest.mark.slow
+class TestFastSyncOverTCP:
+    def test_fresh_node_syncs_500_blocks_and_switches(self):
+        doc, vals, privs = _make_doc()
+        n_blocks = 500
+        state, ss, bs, client = _build_chain_node(doc, privs, n_blocks)
+        server = _SyncNode(doc, None, state, ss, bs, client, fast_sync=False)
+
+        fresh_state = make_genesis_state(doc)
+        fss = Store(MemDB())
+        fss.save(fresh_state)
+        fclient = LocalClient(KVStoreApplication())
+        fclient.start()
+        fresh = _SyncNode(
+            doc, privs[0], fresh_state, fss, BlockStore(MemDB()), fclient,
+            fast_sync=True,
+        )
+        server.start()
+        fresh.start()
+        try:
+            fresh.switch.dial_peer_with_address(server.transport.listen_addr)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if fresh.block_store.height() >= n_blocks - 1:
+                    break
+                time.sleep(0.25)
+            assert fresh.block_store.height() >= n_blocks - 1, (
+                f"synced only to {fresh.block_store.height()}"
+            )
+            # blocks match the server's bit for bit
+            for h in (1, 100, n_blocks // 2, n_blocks - 1):
+                want = server.block_store.load_block_meta(h).block_id.hash
+                got = fresh.block_store.load_block_meta(h).block_id.hash
+                assert want == got, f"height {h} diverged"
+            # and consensus took over
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if not fresh.cons_reactor.wait_sync():
+                    break
+                time.sleep(0.1)
+            assert not fresh.cons_reactor.wait_sync(), "switch_to_consensus never fired"
+        finally:
+            fresh.stop()
+            server.stop()

@@ -105,6 +105,18 @@ class TestEvidencePool:
             pool.add_evidence(ev)
         assert pool.size() == 0
 
+    def test_malformed_evidence_is_invalid_evidence(self):
+        """validate_basic failures are protocol violations (the reactor
+        disconnects the sender), not benign context errors."""
+        from cometbft_tpu.types.evidence import ErrInvalidEvidence
+
+        state, ss, bs, vals, privs = _make_chain()
+        pool = _mk_pool(ss, bs)
+        ev = _dup_vote_ev(state, bs, vals, privs)
+        ev.vote_a = None  # structurally malformed
+        with pytest.raises(ErrInvalidEvidence):
+            pool.add_evidence(ev)
+
     def test_missing_header_is_not_invalid_evidence(self):
         """Context failures must NOT be ErrInvalidEvidence — the reactor
         would disconnect an honest peer over a pruning/height race."""
