@@ -1,0 +1,31 @@
+"""privval — production validator signers.
+
+Reference: privval/ — FilePV (file.go:148) persists the signing key and a
+LastSignState with a CheckHRS double-sign regression guard (file.go:92);
+signatures survive a crash between signing and WAL write because the last
+sign-bytes + signature are persisted atomically before release.
+"""
+
+from cometbft_tpu.privval.file import (
+    STEP_NONE,
+    STEP_PRECOMMIT,
+    STEP_PREVOTE,
+    STEP_PROPOSE,
+    FilePV,
+    FilePVLastSignState,
+    gen_file_pv,
+    load_file_pv,
+    load_or_gen_file_pv,
+)
+
+__all__ = [
+    "STEP_NONE",
+    "STEP_PRECOMMIT",
+    "STEP_PREVOTE",
+    "STEP_PROPOSE",
+    "FilePV",
+    "FilePVLastSignState",
+    "gen_file_pv",
+    "load_file_pv",
+    "load_or_gen_file_pv",
+]

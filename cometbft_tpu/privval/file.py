@@ -1,0 +1,316 @@
+"""FilePV — file-backed validator signer with a double-sign guard.
+
+Reference: privval/file.go — FilePVKey / FilePVLastSignState (:74-123),
+CheckHRS regression check (:92), signVote/signProposal (:304-372) with
+same-HRS signature reuse and the only-differ-by-timestamp crash window,
+atomic saves via tempfile (WriteFileAtomic). Key/state JSON matches the
+reference's priv_validator_key.json / priv_validator_state.json shapes
+(amino type tags, base64 key material, hex sign bytes).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.libs.tempfile import write_file_atomic
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.types.priv_validator import PrivValidator
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.vote import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+    Vote,
+)
+
+STEP_NONE = 0
+STEP_PROPOSE = 1
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
+
+_PUB_KEY_TYPE_TAG = "tendermint/PubKeyEd25519"
+_PRIV_KEY_TYPE_TAG = "tendermint/PrivKeyEd25519"
+
+
+def _vote_to_step(vote: Vote) -> int:
+    if vote.type == SIGNED_MSG_TYPE_PREVOTE:
+        return STEP_PREVOTE
+    if vote.type == SIGNED_MSG_TYPE_PRECOMMIT:
+        return STEP_PRECOMMIT
+    raise ValueError(f"unknown vote type: {vote.type}")
+
+
+class ErrDoubleSign(ValueError):
+    """HRS regression or conflicting data at the same HRS."""
+
+
+@dataclass
+class FilePVLastSignState:
+    """The mutable half of the signer (reference :74-88)."""
+
+    height: int = 0
+    round: int = 0
+    step: int = STEP_NONE
+    signature: bytes = b""
+    sign_bytes: bytes = b""
+    file_path: str = ""
+
+    def check_hrs(self, height: int, round_: int, step: int) -> bool:
+        """Regression guard (reference CheckHRS :92). Returns True when
+        this exact HRS was already signed (signature reuse allowed)."""
+        if self.height > height:
+            raise ErrDoubleSign(
+                f"height regression. Got {height}, last height {self.height}"
+            )
+        if self.height == height:
+            if self.round > round_:
+                raise ErrDoubleSign(
+                    f"round regression at height {height}. Got {round_}, "
+                    f"last round {self.round}"
+                )
+            if self.round == round_:
+                if self.step > step:
+                    raise ErrDoubleSign(
+                        f"step regression at height {height} round {round_}. "
+                        f"Got {step}, last step {self.step}"
+                    )
+                if self.step == step:
+                    if self.sign_bytes:
+                        if not self.signature:
+                            raise RuntimeError(
+                                "pv: Signature is nil but SignBytes is not!"
+                            )
+                        return True
+                    raise ErrDoubleSign("no SignBytes found")
+        return False
+
+    def save(self) -> None:
+        if not self.file_path:
+            raise RuntimeError("cannot save FilePVLastSignState: no file path")
+        doc = {
+            "height": str(self.height),
+            "round": self.round,
+            "step": self.step,
+        }
+        if self.signature:
+            doc["signature"] = base64.b64encode(self.signature).decode()
+        if self.sign_bytes:
+            doc["signbytes"] = self.sign_bytes.hex().upper()
+        write_file_atomic(
+            self.file_path, json.dumps(doc, indent=2).encode(), 0o600
+        )
+
+    @classmethod
+    def load(cls, path: str) -> "FilePVLastSignState":
+        with open(path, "rb") as f:
+            doc = json.load(f)
+        return cls(
+            height=int(doc.get("height", 0)),
+            round=int(doc.get("round", 0)),
+            step=int(doc.get("step", 0)),
+            signature=base64.b64decode(doc["signature"])
+            if doc.get("signature")
+            else b"",
+            sign_bytes=bytes.fromhex(doc["signbytes"])
+            if doc.get("signbytes")
+            else b"",
+            file_path=path,
+        )
+
+
+class FilePV(PrivValidator):
+    """Reference: privval/file.go:148."""
+
+    def __init__(
+        self,
+        priv_key: ed25519.PrivKeyEd25519,
+        key_file_path: str,
+        state_file_path: str,
+    ):
+        self.priv_key = priv_key
+        self.key_file_path = key_file_path
+        self.last_sign_state = FilePVLastSignState(file_path=state_file_path)
+
+    # -- PrivValidator ------------------------------------------------------
+
+    def get_pub_key(self):
+        return self.priv_key.pub_key()
+
+    def get_address(self) -> bytes:
+        return self.get_pub_key().address()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        height, round_, step = vote.height, vote.round, _vote_to_step(vote)
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(height, round_, step)
+        sign_bytes = vote.sign_bytes(chain_id)
+
+        # We might crash between signing and the WAL write: re-signing the
+        # same HRS must reproduce (not produce a second distinct) signature
+        if same_hrs:
+            if sign_bytes == lss.sign_bytes:
+                vote.signature = lss.signature
+                return
+            ts = _only_differ_by_timestamp(lss.sign_bytes, sign_bytes, field_no=5)
+            if ts is not None:
+                vote.timestamp = ts
+                vote.signature = lss.signature
+                return
+            raise ErrDoubleSign("conflicting data")
+
+        sig = self.priv_key.sign(sign_bytes)
+        self._save_signed(height, round_, step, sign_bytes, sig)
+        vote.signature = sig
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        height, round_, step = proposal.height, proposal.round, STEP_PROPOSE
+        lss = self.last_sign_state
+        same_hrs = lss.check_hrs(height, round_, step)
+        sign_bytes = proposal.sign_bytes(chain_id)
+
+        if same_hrs:
+            if sign_bytes == lss.sign_bytes:
+                proposal.signature = lss.signature
+                return
+            ts = _only_differ_by_timestamp(lss.sign_bytes, sign_bytes, field_no=6)
+            if ts is not None:
+                proposal.timestamp = ts
+                proposal.signature = lss.signature
+                return
+            raise ErrDoubleSign("conflicting data")
+
+        sig = self.priv_key.sign(sign_bytes)
+        self._save_signed(height, round_, step, sign_bytes, sig)
+        proposal.signature = sig
+
+    def _save_signed(
+        self, height: int, round_: int, step: int, sign_bytes: bytes, sig: bytes
+    ) -> None:
+        """Persist BEFORE the signature is released (reference saveSigned —
+        the atomic write is the double-sign guard across crashes). The disk
+        write happens before the in-memory state is touched: if it fails,
+        neither memory nor disk knows the signature, so the same-HRS reuse
+        path can never release a signature that was never persisted."""
+        lss = FilePVLastSignState(
+            height=height,
+            round=round_,
+            step=step,
+            signature=sig,
+            sign_bytes=sign_bytes,
+            file_path=self.last_sign_state.file_path,
+        )
+        lss.save()  # raises on IO failure, leaving self.last_sign_state intact
+        self.last_sign_state = lss
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self) -> None:
+        pub = self.get_pub_key()
+        doc = {
+            "address": pub.address().hex().upper(),
+            "pub_key": {
+                "type": _PUB_KEY_TYPE_TAG,
+                "value": base64.b64encode(pub.bytes()).decode(),
+            },
+            "priv_key": {
+                "type": _PRIV_KEY_TYPE_TAG,
+                "value": base64.b64encode(self.priv_key.bytes()).decode(),
+            },
+        }
+        write_file_atomic(
+            self.key_file_path, json.dumps(doc, indent=2).encode(), 0o600
+        )
+        self.last_sign_state.save()
+
+    def reset(self) -> None:
+        """Unsafe: forget the last sign state (reference Reset :276)."""
+        self.last_sign_state = FilePVLastSignState(
+            file_path=self.last_sign_state.file_path
+        )
+        self.save()
+
+    def __str__(self) -> str:
+        lss = self.last_sign_state
+        return (
+            f"PrivValidator{{{self.get_address().hex().upper()[:12]} "
+            f"LH:{lss.height}, LR:{lss.round}, LS:{lss.step}}}"
+        )
+
+
+# -- construction ------------------------------------------------------------
+
+
+def gen_file_pv(key_file_path: str, state_file_path: str) -> FilePV:
+    return FilePV(ed25519.gen_priv_key(), key_file_path, state_file_path)
+
+
+def load_file_pv(
+    key_file_path: str, state_file_path: str, load_state: bool = True
+) -> FilePV:
+    with open(key_file_path, "rb") as f:
+        doc = json.load(f)
+    pk = doc.get("priv_key", {})
+    if pk.get("type") != _PRIV_KEY_TYPE_TAG:
+        raise ValueError(f"unsupported priv key type {pk.get('type')!r}")
+    priv = ed25519.PrivKeyEd25519(base64.b64decode(pk["value"]))
+    pv = FilePV(priv, key_file_path, state_file_path)
+    if load_state:
+        pv.last_sign_state = FilePVLastSignState.load(state_file_path)
+    return pv
+
+
+def load_or_gen_file_pv(key_file_path: str, state_file_path: str) -> FilePV:
+    if os.path.exists(key_file_path):
+        return load_file_pv(key_file_path, state_file_path)
+    pv = gen_file_pv(key_file_path, state_file_path)
+    pv.save()
+    return pv
+
+
+# -- timestamp-only difference ------------------------------------------------
+
+
+def _only_differ_by_timestamp(
+    last_sign_bytes: bytes, new_sign_bytes: bytes, field_no: int
+) -> Optional[Timestamp]:
+    """If the two delimited canonical messages differ only in their
+    timestamp field, return the LAST message's timestamp (to be reused);
+    else None. Reference: checkVotesOnlyDifferByTimestamp (file.go:400) —
+    field 5 in CanonicalVote, field 6 in CanonicalProposal."""
+    try:
+        last_body, last_ts = _split_timestamp(last_sign_bytes, field_no)
+        new_body, _ = _split_timestamp(new_sign_bytes, field_no)
+    except Exception:
+        return None
+    if last_ts is None:
+        return None
+    return last_ts if last_body == new_body else None
+
+
+def _split_timestamp(
+    delimited: bytes, field_no: int
+) -> Tuple[bytes, Optional[Timestamp]]:
+    """Strip the length prefix, remove `field_no` (the timestamp), return
+    (remaining bytes in order, decoded timestamp)."""
+    r = protoio.WireReader(delimited)
+    length = r.read_uvarint()
+    body = delimited[r.pos : r.pos + length]
+    if len(body) != length:
+        raise ValueError("truncated sign bytes")
+    br = protoio.WireReader(body)
+    out = b""
+    ts: Optional[Timestamp] = None
+    while not br.at_end():
+        start = br.pos
+        f, wt = br.read_tag()
+        if f == field_no and wt == protoio.WIRE_BYTES:
+            ts = Timestamp.decode(br.read_bytes())
+            continue
+        br.skip(wt)
+        out += body[start : br.pos]
+    return out, ts

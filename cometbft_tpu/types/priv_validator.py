@@ -2,7 +2,8 @@
 
 Reference: types/priv_validator.go — PrivValidator iface (GetPubKey,
 SignVote, SignProposal) and MockPV for tests. The production file-backed
-and socket-backed signers live in cometbft_tpu.privval.
+signer (FilePV, with the LastSignState double-sign guard) lives in
+cometbft_tpu.privval.
 """
 
 from __future__ import annotations

@@ -133,8 +133,7 @@ class ValidatorSet:
 
     def hash(self) -> bytes:
         """Merkle root over SimpleValidator encodings
-        (validator_set.go:347). The TPU-parallel variant is
-        cometbft_tpu.crypto.tpu.merkle for mega-sets."""
+        (validator_set.go:347)."""
         return merkle.hash_from_byte_slices([v.bytes() for v in self.validators])
 
     # -- proposer selection (validator_set.go:160-345) ---------------------
