@@ -1,0 +1,57 @@
+"""Light client — header verification without executing the chain.
+
+Reference: light/ — pure verifier (verifier.go), bisection client with a
+trusted store and primary/witness providers (client.go), divergence
+detection producing LightClientAttackEvidence (detector.go).
+"""
+
+from cometbft_tpu.light.client import Client, TrustOptions
+from cometbft_tpu.light.errors import (
+    ErrHeightTooHigh,
+    ErrInvalidHeader,
+    ErrLightBlockNotFound,
+    ErrLightClientAttack,
+    ErrNewValSetCantBeTrusted,
+    ErrNoResponse,
+    ErrOldHeaderExpired,
+    ErrVerificationFailed,
+)
+from cometbft_tpu.light.provider import (
+    BlockStoreProvider,
+    MockProvider,
+    Provider,
+)
+from cometbft_tpu.light.store import DBStore
+from cometbft_tpu.light.verifier import (
+    DEFAULT_TRUST_LEVEL,
+    header_expired,
+    validate_trust_level,
+    verify,
+    verify_adjacent,
+    verify_backwards,
+    verify_non_adjacent,
+)
+
+__all__ = [
+    "BlockStoreProvider",
+    "Client",
+    "DBStore",
+    "DEFAULT_TRUST_LEVEL",
+    "ErrHeightTooHigh",
+    "ErrInvalidHeader",
+    "ErrLightBlockNotFound",
+    "ErrLightClientAttack",
+    "ErrNewValSetCantBeTrusted",
+    "ErrNoResponse",
+    "ErrOldHeaderExpired",
+    "ErrVerificationFailed",
+    "MockProvider",
+    "Provider",
+    "TrustOptions",
+    "header_expired",
+    "validate_trust_level",
+    "verify",
+    "verify_adjacent",
+    "verify_backwards",
+    "verify_non_adjacent",
+]

@@ -1,0 +1,522 @@
+"""Light client — trusted store + primary/witness providers + bisection.
+
+Reference: light/client.go — NewClient w/ TrustOptions (:174),
+VerifyLightBlockAtHeight (:474), verifySequential (:613), verifySkipping
+bisection (:706), Update (:436), backwards verification (:933), witness
+cross-checks + divergence detection (light/detector.go:28,116,217) that
+produce LightClientAttackEvidence and report it to both sides.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.light import verifier
+from cometbft_tpu.light.errors import (
+    ErrHeightTooHigh,
+    ErrLightBlockNotFound,
+    ErrLightClientAttack,
+    ErrNewValSetCantBeTrusted,
+    ErrNoResponse,
+    ErrVerificationFailed,
+)
+from cometbft_tpu.light.provider import Provider
+from cometbft_tpu.light.store import DBStore
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.types.evidence import LightClientAttackEvidence
+from cometbft_tpu.types.light_block import LightBlock
+from cometbft_tpu.types.validator_set import Fraction
+
+DEFAULT_PRUNING_SIZE = 1000
+DEFAULT_MAX_CLOCK_DRIFT_NS = 10 * 1_000_000_000
+# pivot = trusted + (new - trusted) * 1/2  (client.go verifySkipping*)
+_SKIP_NUMERATOR, _SKIP_DENOMINATOR = 1, 2
+
+
+@dataclass
+class TrustOptions:
+    """Reference: light.TrustOptions — period + (height, hash) root of trust."""
+
+    period_ns: int
+    height: int
+    hash: bytes
+
+    def validate(self) -> None:
+        if self.period_ns <= 0:
+            raise ValueError("trusting period must be greater than zero")
+        if self.height <= 0:
+            raise ValueError("trusted height must be greater than zero")
+        if len(self.hash) != 32:
+            raise ValueError("expected a 32-byte trusted header hash")
+
+
+class Client:
+    def __init__(
+        self,
+        chain_id: str,
+        trust_options: TrustOptions,
+        primary: Provider,
+        witnesses: List[Provider],
+        trusted_store: DBStore,
+        trust_level: Fraction = verifier.DEFAULT_TRUST_LEVEL,
+        max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
+        pruning_size: int = DEFAULT_PRUNING_SIZE,
+        sequential: bool = False,
+        crypto_backend: Optional[str] = None,
+        logger: Optional[Logger] = None,
+    ):
+        verifier.validate_trust_level(trust_level)
+        trust_options.validate()
+        self.chain_id = chain_id
+        self.trusting_period_ns = trust_options.period_ns
+        self.trust_level = trust_level
+        self.max_clock_drift_ns = max_clock_drift_ns
+        self.pruning_size = pruning_size
+        self.sequential = sequential
+        self.crypto_backend = crypto_backend
+        self.logger = logger or new_nop_logger()
+        self._mtx = threading.Lock()
+        self.primary = primary
+        self.witnesses = list(witnesses)
+        self.store = trusted_store
+        latest = self.store.latest_light_block()
+        if latest is None:
+            self._initialize(trust_options)
+        else:
+            self._check_restored_store(latest, trust_options)
+
+    # -- initialization ------------------------------------------------------
+
+    def _check_restored_store(
+        self, latest: LightBlock, opts: TrustOptions
+    ) -> None:
+        """client.go:303 checkTrustedHeaderUsingOptions — a restored store
+        must be revalidated against the caller's root of trust; a silent
+        skip would keep trusting a chain from a possibly-compromised
+        earlier primary. No interactive confirmation here: mismatches and
+        rollbacks that Go asks the operator about are hard errors."""
+        if opts.height > latest.height:
+            # trust root is ahead of the store: the primary must agree with
+            # what we stored
+            primary_hash = self._light_block_from_primary(
+                latest.height
+            ).signed_header.header.hash()
+        elif opts.height == latest.height:
+            primary_hash = opts.hash
+        else:
+            # trust root below stored latest: roll the store back to it
+            stored = self.store.light_block(opts.height)
+            if stored is not None and (
+                stored.signed_header.header.hash() == opts.hash
+            ):
+                for h in range(opts.height + 1, latest.height + 1):
+                    self.store.delete_light_block(h)
+                return
+            if stored is not None:
+                raise ValueError(
+                    "restored trusted store conflicts with TrustOptions at "
+                    f"height {opts.height}"
+                )
+            # bisection never stored that height: wipe and re-sync from the
+            # caller's root of trust (Go: Cleanup after confirmation)
+            for h in list(
+                range(self.store.first_height(), latest.height + 1)
+            ):
+                self.store.delete_light_block(h)
+            self._initialize(opts)
+            return
+        if primary_hash != latest.signed_header.header.hash():
+            raise ValueError(
+                "restored trusted store hash does not match the root of "
+                "trust; refusing to continue (wipe the store to re-sync)"
+            )
+
+    def _initialize(self, opts: TrustOptions) -> None:
+        """client.go:362 initializeWithTrustOptions — fetch the root-of-trust
+        block from the primary, check the hash, check 2/3 signed it."""
+        lb = self._light_block_from_primary(opts.height)
+        if lb.signed_header.header.hash() != opts.hash:
+            raise ValueError(
+                f"expected header's hash {opts.hash.hex()}, but got "
+                f"{lb.signed_header.header.hash().hex()}"
+            )
+        lb.validator_set.verify_commit_light(
+            self.chain_id,
+            lb.signed_header.commit.block_id,
+            lb.height,
+            lb.signed_header.commit,
+            backend=self.crypto_backend,
+        )
+        # cross-check the root of trust with every witness (detector.go:1131)
+        self._compare_first_header_with_witnesses(lb)
+        self._update_trusted_light_block(lb)
+
+    # -- accessors -----------------------------------------------------------
+
+    def trusted_light_block(self, height: int) -> Optional[LightBlock]:
+        return self.store.light_block(height)
+
+    def last_trusted_height(self) -> int:
+        return self.store.latest_height()
+
+    def first_trusted_height(self) -> int:
+        return self.store.first_height()
+
+    # -- the core API ---------------------------------------------------------
+
+    def update(self, now: Timestamp) -> Optional[LightBlock]:
+        """Fetch + verify the primary's latest block if newer than our
+        latest trusted (client.go:436). Verifies the block it fetched —
+        no second fetch, no TOCTOU against a flapping primary."""
+        with self._mtx:
+            last = self.store.latest_light_block()
+            if last is None:
+                raise RuntimeError("no trusted state")
+            latest = self._light_block_from_primary(0)
+            if latest.height <= last.height:
+                return None
+            self._verify_light_block(latest, now)
+            return latest
+
+    def verify_light_block_at_height(
+        self, height: int, now: Timestamp
+    ) -> LightBlock:
+        """client.go:474 VerifyLightBlockAtHeight."""
+        if height <= 0:
+            raise ValueError("height must be positive")
+        with self._mtx:
+            lb = self.store.light_block(height)
+            if lb is not None:
+                return lb
+            latest = self.store.latest_light_block()
+            if latest is not None and height < latest.height:
+                # below our latest trusted: walk hashes backwards
+                return self._backwards(latest, height)
+            new_block = self._light_block_from_primary(height)
+            self._verify_light_block(new_block, now)
+            return new_block
+
+    def _verify_light_block(self, new_block: LightBlock, now: Timestamp) -> None:
+        """client.go:558 — pick sequential/skipping from the nearest trusted
+        block at a lower height, then run witness cross-checks."""
+        closest = self._closest_trusted_below(new_block.height)
+        if closest is None:
+            raise RuntimeError("no trusted state below requested height")
+        if self.sequential:
+            trace = self._verify_sequential(closest, new_block, now)
+        else:
+            trace = self._verify_skipping_against_primary(closest, new_block, now)
+        # witness cross-examination on the verified header
+        self._detect_divergence(trace, now)
+        self._update_trusted_light_block(new_block)
+
+    def _closest_trusted_below(self, height: int) -> Optional[LightBlock]:
+        return self.store.light_block_before(height)
+
+    # -- verification strategies ----------------------------------------------
+
+    def _verify_sequential(
+        self, trusted: LightBlock, new_block: LightBlock, now: Timestamp
+    ) -> List[LightBlock]:
+        """client.go:613 — verify every height in (trusted, new]."""
+        verified = trusted
+        trace = [trusted]
+        for height in range(trusted.height + 1, new_block.height + 1):
+            inter = (
+                new_block
+                if height == new_block.height
+                else self._light_block_from_primary(height)
+            )
+            verifier.verify_adjacent(
+                verified.signed_header,
+                inter.signed_header,
+                inter.validator_set,
+                self.trusting_period_ns,
+                now,
+                self.max_clock_drift_ns,
+                backend=self.crypto_backend,
+            )
+            verified = inter
+            trace.append(inter)
+        return trace
+
+    def _verify_skipping(
+        self,
+        source: Provider,
+        trusted: LightBlock,
+        new_block: LightBlock,
+        now: Timestamp,
+    ) -> List[LightBlock]:
+        """client.go:706 verifySkipping — bisection."""
+        block_cache = [new_block]
+        depth = 0
+        verified = trusted
+        trace = [trusted]
+        while True:
+            target = block_cache[depth]
+            try:
+                verifier.verify(
+                    verified.signed_header,
+                    verified.validator_set,
+                    target.signed_header,
+                    target.validator_set,
+                    self.trusting_period_ns,
+                    now,
+                    self.max_clock_drift_ns,
+                    self.trust_level,
+                    backend=self.crypto_backend,
+                )
+            except ErrNewValSetCantBeTrusted as exc:
+                # too big a validator power shift — bisect
+                if depth == len(block_cache) - 1:
+                    pivot = (
+                        verified.height
+                        + (target.height - verified.height)
+                        * _SKIP_NUMERATOR
+                        // _SKIP_DENOMINATOR
+                    )
+                    try:
+                        interim = source.light_block(pivot)
+                    except (ErrLightBlockNotFound, ErrNoResponse, ErrHeightTooHigh):
+                        raise exc
+                    except Exception as provider_err:
+                        raise ErrVerificationFailed(
+                            verified.height, pivot, provider_err
+                        ) from provider_err
+                    block_cache.append(interim)
+                depth += 1
+                continue
+            except Exception as exc:
+                raise ErrVerificationFailed(
+                    verified.height, target.height, exc
+                ) from exc
+            # verified
+            if depth == 0:
+                trace.append(new_block)
+                return trace
+            verified = target
+            block_cache = block_cache[:depth]
+            depth = 0
+            trace.append(verified)
+
+    def _verify_skipping_against_primary(
+        self, trusted: LightBlock, new_block: LightBlock, now: Timestamp
+    ) -> List[LightBlock]:
+        return self._verify_skipping(self.primary, trusted, new_block, now)
+
+    def _backwards(self, latest: LightBlock, height: int) -> LightBlock:
+        """client.go:933 — follow LastBlockID hashes down to `height`."""
+        trusted = latest
+        while trusted.height > height:
+            interim = self._light_block_from_primary(trusted.height - 1)
+            verifier.verify_backwards(
+                interim.signed_header.header, trusted.signed_header.header
+            )
+            trusted = interim
+        self.store.save_light_block(trusted)
+        return trusted
+
+    # -- witness cross-checks (light/detector.go) -------------------------------
+
+    def _compare_first_header_with_witnesses(self, lb: LightBlock) -> None:
+        """detector.go:1131 — the root of trust must match every witness."""
+        bad: List[Provider] = []
+        for witness in list(self.witnesses):
+            try:
+                w_lb = witness.light_block(lb.height)
+            except Exception:
+                bad.append(witness)
+                continue
+            if w_lb.signed_header.header.hash() != lb.signed_header.header.hash():
+                raise ErrLightClientAttack(
+                    f"witness {witness.id()} has a different header at the "
+                    f"root-of-trust height {lb.height}"
+                )
+        self._remove_witnesses(bad)
+
+    def _detect_divergence(
+        self, primary_trace: List[LightBlock], now: Timestamp
+    ) -> None:
+        """detector.go:28 detectDivergence — last traced header vs every
+        witness; on conflict, examine and build attack evidence. Witnesses
+        are collected and removed by identity AFTER the sweep — removal
+        inside the loop (or by index) corrupts which witness gets dropped."""
+        if not self.witnesses:
+            return
+        last = primary_trace[-1]
+        bad: List[Provider] = []
+        conflicts: List[Tuple[LightBlock, Provider]] = []
+        for witness in list(self.witnesses):
+            try:
+                w_lb = witness.light_block(last.height)
+            except (ErrLightBlockNotFound, ErrHeightTooHigh, ErrNoResponse):
+                continue  # benign: witness is behind
+            except Exception:
+                bad.append(witness)
+                continue
+            if (
+                w_lb.signed_header.header.hash()
+                == last.signed_header.header.hash()
+            ):
+                continue
+            conflicts.append((w_lb, witness))
+        self._remove_witnesses(bad)
+        for w_lb, witness in conflicts:
+            self._handle_conflicting_headers(primary_trace, w_lb, witness, now)
+
+    def _handle_conflicting_headers(
+        self,
+        primary_trace: List[LightBlock],
+        challenging_block: LightBlock,
+        witness: Provider,
+        now: Timestamp,
+    ) -> None:
+        """detector.go:217 — decide which side is lying by verifying the
+        witness's chain from the common trusted root; if the witness's
+        block verifies, both chains are validly signed → an attack."""
+        common, trusted_block = self._examine_against_trace(
+            primary_trace, challenging_block, witness, now
+        )
+        if trusted_block is None:
+            # witness couldn't prove its chain: drop it
+            self.logger.info(
+                "removing witness that could not prove its chain",
+                witness=witness.id(),
+            )
+            self._remove_witnesses([witness])
+            return
+        # both sides verifiably signed conflicting blocks → evidence
+        ev_against_primary = _new_attack_evidence(
+            conflicted=primary_trace[-1],
+            trusted=trusted_block,
+            common=common,
+        )
+        witness.report_evidence(ev_against_primary)
+        ev_against_witness = _new_attack_evidence(
+            conflicted=challenging_block,
+            trusted=primary_trace[-1],
+            common=common,
+        )
+        self.primary.report_evidence(ev_against_witness)
+        raise ErrLightClientAttack(
+            f"header at height {challenging_block.height} diverges between "
+            f"primary and witness {witness.id()}"
+        )
+
+    def _examine_against_trace(
+        self,
+        primary_trace: List[LightBlock],
+        challenging_block: LightBlock,
+        witness: Provider,
+        now: Timestamp,
+    ) -> Tuple[Optional[LightBlock], Optional[LightBlock]]:
+        """detector.go:290 — find the last common (trusted) block in the
+        trace, then try to verify the witness's conflicting block from it.
+        Returns (common_block, verified_witness_block) or (_, None)."""
+        common = primary_trace[0]
+        for lb in primary_trace:
+            try:
+                w_lb = witness.light_block(lb.height)
+            except Exception:
+                return common, None
+            if w_lb.signed_header.header.hash() == lb.signed_header.header.hash():
+                common = lb
+            else:
+                break
+        try:
+            self._verify_skipping(witness, common, challenging_block, now)
+        except Exception:
+            return common, None
+        return common, challenging_block
+
+    def _remove_witnesses(self, witnesses: List[Provider]) -> None:
+        for w in witnesses:
+            try:
+                self.witnesses.remove(w)
+            except ValueError:
+                pass  # already gone
+
+    # -- store plumbing ---------------------------------------------------------
+
+    def _update_trusted_light_block(self, lb: LightBlock) -> None:
+        self.store.save_light_block(lb)
+        if self.pruning_size and self.store.size() > self.pruning_size:
+            self.store.prune(self.pruning_size)
+
+    def _light_block_from_primary(self, height: int) -> LightBlock:
+        lb = self.primary.light_block(height)
+        lb.validate_basic(self.chain_id)
+        return lb
+
+
+def _new_attack_evidence(
+    conflicted: LightBlock, trusted: LightBlock, common: LightBlock
+) -> LightClientAttackEvidence:
+    """detector.go:408 newLightClientAttackEvidence — lunatic attacks pin
+    the common height; equivocation/amnesia use the conflicting height."""
+    ev = LightClientAttackEvidence(conflicting_block=conflicted)
+    if _conflicting_header_is_invalid(conflicted, trusted):
+        ev.common_height = common.height
+        ev.timestamp = common.signed_header.header.time
+        ev.total_voting_power = common.validator_set.total_voting_power()
+    else:
+        ev.common_height = trusted.height
+        ev.timestamp = trusted.signed_header.header.time
+        ev.total_voting_power = trusted.validator_set.total_voting_power()
+    ev.byzantine_validators = _byzantine_validators(
+        ev, common.validator_set, trusted
+    )
+    return ev
+
+
+def _conflicting_header_is_invalid(
+    conflicted: LightBlock, trusted: LightBlock
+) -> bool:
+    """types/evidence.go ConflictingHeaderIsInvalid — a lunatic attack
+    fabricates header fields that honest validators never produced."""
+    t = trusted.signed_header.header
+    c = conflicted.signed_header.header
+    return not (
+        t.validators_hash == c.validators_hash
+        and t.next_validators_hash == c.next_validators_hash
+        and t.consensus_hash == c.consensus_hash
+        and t.app_hash == c.app_hash
+        and t.last_results_hash == c.last_results_hash
+    )
+
+
+def _byzantine_validators(
+    ev: LightClientAttackEvidence, common_vals, trusted: LightBlock
+):
+    """types/evidence.go GetByzantineValidators — lunatic: common-set
+    validators who signed the conflicting block; equivocation: validators
+    who signed both blocks."""
+    out = []
+    sh = ev.conflicting_block.signed_header
+    if _conflicting_header_is_invalid(ev.conflicting_block, trusted):
+        for i, sig in enumerate(sh.commit.signatures):
+            if not sig.for_block():
+                continue
+            _, val = common_vals.get_by_address(sig.validator_address)
+            if val is not None:
+                out.append(val)
+    elif trusted.signed_header.commit.round == sh.commit.round:
+        trusted_by_addr = {
+            s.validator_address: True
+            for s in trusted.signed_header.commit.signatures
+            if s.for_block()
+        }
+        for sig in sh.commit.signatures:
+            if not sig.for_block():
+                continue
+            if sig.validator_address in trusted_by_addr:
+                _, val = ev.conflicting_block.validator_set.get_by_address(
+                    sig.validator_address
+                )
+                if val is not None:
+                    out.append(val)
+    out.sort(key=lambda v: v.address)
+    return out

@@ -1,0 +1,100 @@
+"""Light block providers.
+
+Reference: light/provider/provider.go (interface) and
+light/provider/mock (deterministic in-memory provider used across the
+reference's client/detector tests). The HTTP provider rides the RPC
+client once cometbft_tpu.rpc exists.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from cometbft_tpu.light.errors import (
+    ErrHeightTooHigh,
+    ErrLightBlockNotFound,
+)
+from cometbft_tpu.types.light_block import LightBlock
+
+
+class Provider:
+    def light_block(self, height: int) -> LightBlock:
+        """Return the light block at `height` (0 = latest). Raises
+        ErrLightBlockNotFound / ErrHeightTooHigh / ErrNoResponse."""
+        raise NotImplementedError
+
+    def report_evidence(self, ev) -> None:
+        raise NotImplementedError
+
+    def id(self) -> str:
+        return repr(self)
+
+
+class MockProvider(Provider):
+    """Serves a fixed map of height → LightBlock (light/provider/mock)."""
+
+    def __init__(self, chain_id: str, blocks: Dict[int, LightBlock]):
+        self.chain_id = chain_id
+        self._blocks = dict(blocks)
+        self.evidence: List[object] = []
+
+    def latest_height(self) -> int:
+        return max(self._blocks) if self._blocks else 0
+
+    def light_block(self, height: int) -> LightBlock:
+        if not self._blocks:
+            raise ErrLightBlockNotFound()
+        if height == 0:
+            height = self.latest_height()
+        if height > self.latest_height():
+            raise ErrHeightTooHigh()
+        lb = self._blocks.get(height)
+        if lb is None:
+            raise ErrLightBlockNotFound()
+        return lb
+
+    def report_evidence(self, ev) -> None:
+        self.evidence.append(ev)
+
+    def add(self, lb: LightBlock) -> None:
+        self._blocks[lb.height] = lb
+
+    def id(self) -> str:
+        return f"mock-{self.chain_id}"
+
+
+class BlockStoreProvider(Provider):
+    """Serves light blocks straight from a node's own stores — used by
+    statesync's state provider and in-process light clients
+    (reference analog: light/provider/http against a local node)."""
+
+    def __init__(self, chain_id: str, block_store, state_store):
+        self.chain_id = chain_id
+        self._block_store = block_store
+        self._state_store = state_store
+
+    def light_block(self, height: int) -> LightBlock:
+        from cometbft_tpu.types.light_block import SignedHeader
+
+        if height == 0:
+            height = self._block_store.height()
+        if height > self._block_store.height():
+            raise ErrHeightTooHigh()
+        meta = self._block_store.load_block_meta(height)
+        commit = self._block_store.load_block_commit(height)
+        if meta is None or commit is None:
+            raise ErrLightBlockNotFound()
+        try:
+            vals = self._state_store.load_validators(height)
+        except Exception as exc:
+            raise ErrLightBlockNotFound() from exc
+        return LightBlock(
+            signed_header=SignedHeader(meta.header, commit),
+            validator_set=vals,
+        )
+
+    def report_evidence(self, ev) -> None:
+        pass  # a local node learns about evidence through its own pool
+
+    def id(self) -> str:
+        return f"blockstore-{self.chain_id}"

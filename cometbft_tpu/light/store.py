@@ -1,0 +1,83 @@
+"""Trusted light block store.
+
+Reference: light/store/db — persisted trusted light blocks keyed by
+height, with first/latest lookups and pruning to a target size.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Optional
+
+from cometbft_tpu.libs.db import DB
+from cometbft_tpu.types.light_block import LightBlock
+
+_PREFIX = b"lb/"
+
+
+def _key(height: int) -> bytes:
+    return _PREFIX + struct.pack(">Q", height)
+
+
+class DBStore:
+    def __init__(self, db: DB):
+        self._db = db
+        self._mtx = threading.Lock()
+
+    def save_light_block(self, lb: LightBlock) -> None:
+        if lb.height <= 0:
+            raise ValueError("lightBlock.Height <= 0")
+        with self._mtx:
+            self._db.set_sync(_key(lb.height), lb.encode())
+
+    def delete_light_block(self, height: int) -> None:
+        with self._mtx:
+            self._db.delete_sync(_key(height))
+
+    def light_block(self, height: int) -> Optional[LightBlock]:
+        if height <= 0:
+            raise ValueError("height <= 0")
+        raw = self._db.get(_key(height))
+        if raw is None:
+            return None
+        return LightBlock.decode(raw)
+
+    def light_block_before(self, height: int) -> Optional[LightBlock]:
+        """The stored block with the greatest height < `height` (the Go
+        store's LightBlockBefore) — one reverse scan, not an O(height)
+        walk of point lookups."""
+        for _, raw in self._db.reverse_iterator(_PREFIX, _key(height)):
+            return LightBlock.decode(raw)
+        return None
+
+    def latest_light_block(self) -> Optional[LightBlock]:
+        for _, raw in self._db.reverse_iterator(
+            _PREFIX, _key(0xFFFFFFFFFFFFFFFF)
+        ):
+            return LightBlock.decode(raw)
+        return None
+
+    def latest_height(self) -> int:
+        for key, _ in self._db.reverse_iterator(
+            _PREFIX, _key(0xFFFFFFFFFFFFFFFF)
+        ):
+            return struct.unpack(">Q", key[len(_PREFIX):])[0]
+        return 0
+
+    def first_height(self) -> int:
+        for key, _ in self._db.prefix_iterator(_PREFIX):
+            return struct.unpack(">Q", key[len(_PREFIX):])[0]
+        return 0
+
+    def size(self) -> int:
+        return sum(1 for _ in self._db.prefix_iterator(_PREFIX))
+
+    def prune(self, target_size: int) -> None:
+        """Remove oldest blocks until `target_size` remain (store/db.go).
+        Keys iterate in ascending height order (big-endian), so the first
+        `excess` keys are exactly the oldest blocks."""
+        with self._mtx:
+            keys = [key for key, _ in self._db.prefix_iterator(_PREFIX)]
+            for key in keys[: max(len(keys) - target_size, 0)]:
+                self._db.delete(key)
