@@ -1,0 +1,8 @@
+"""`python -m cometbft_tpu` entry point (cmd/cometbft/main.go)."""
+
+import sys
+
+from cometbft_tpu.cmd import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,10 @@
+"""CLI — `python -m cometbft_tpu <command>`.
+
+Reference: cmd/cometbft/main.go:16-49 (cobra command tree) and
+cmd/cometbft/commands/*: init, start, testnet, show_node_id,
+show_validator, gen_validator, gen_node_key, version.
+"""
+
+from cometbft_tpu.cmd.commands import main
+
+__all__ = ["main"]

@@ -1,0 +1,275 @@
+"""Command implementations.
+
+Reference: cmd/cometbft/commands/{init,run_node,testnet,show_node_id,
+show_validator,gen_validator,gen_node_key,version}.go — argparse in place
+of cobra, same command surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Optional
+
+from cometbft_tpu.config import (
+    Config,
+    default_config,
+    load_config_file,
+    write_config_file,
+)
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.privval import load_or_gen_file_pv
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.types.genesis import (
+    GenesisDoc,
+    GenesisValidator,
+    pub_key_to_json,
+)
+from cometbft_tpu.types.params import default_consensus_params
+from cometbft_tpu.version import __version__ as VERSION
+
+
+def _load_config(home: str) -> Config:
+    cfg = default_config().set_root(home)
+    toml_path = os.path.join(home, "config", "config.toml")
+    if os.path.exists(toml_path):
+        cfg = load_config_file(toml_path, cfg).set_root(home)
+    return cfg
+
+
+def _ensure_dirs(home: str) -> None:
+    for d in ("config", "data"):
+        os.makedirs(os.path.join(home, d), exist_ok=True)
+
+
+def cmd_init(args) -> int:
+    """commands/init.go — private validator, node key, genesis."""
+    home = args.home
+    _ensure_dirs(home)
+    cfg = default_config().set_root(home)
+
+    pv = load_or_gen_file_pv(
+        cfg.base.priv_validator_key_path(), cfg.base.priv_validator_state_path()
+    )
+    node_key_path = os.path.join(home, cfg.base.node_key_file)
+    NodeKey.load_or_gen(node_key_path)
+
+    genesis_path = cfg.base.genesis_path()
+    if os.path.exists(genesis_path):
+        print(f"Found genesis file {genesis_path}")
+    else:
+        doc = GenesisDoc(
+            genesis_time=Timestamp.now(),
+            chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
+            initial_height=1,
+            consensus_params=default_consensus_params(),
+            validators=[
+                GenesisValidator(
+                    pv.get_address(), pv.get_pub_key(), 10, "validator"
+                )
+            ],
+        )
+        with open(genesis_path, "w") as f:
+            f.write(doc.to_json())
+        print(f"Generated genesis file {genesis_path}")
+
+    toml_path = os.path.join(home, "config", "config.toml")
+    if not os.path.exists(toml_path):
+        write_config_file(toml_path, cfg)
+        print(f"Generated config file {toml_path}")
+    print(f"Initialized node in {home}")
+    return 0
+
+
+def cmd_start(args) -> int:
+    """commands/run_node.go — boot the full node and block."""
+    from cometbft_tpu.libs.log import new_tm_logger
+    from cometbft_tpu.node import default_new_node
+
+    cfg = _load_config(args.home)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+    if args.no_fast_sync:
+        cfg.base.fast_sync_mode = False
+
+    logger = new_tm_logger(level=cfg.base.log_level)
+    node = default_new_node(cfg, logger=logger)
+    node.start()
+    print(
+        f"Node {node.node_key.id()} started "
+        f"(p2p {cfg.p2p.laddr}, rpc {cfg.rpc.laddr})",
+        flush=True,
+    )
+
+    stop = threading.Event()
+
+    def _sig(_signum, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        while not stop.is_set():
+            time.sleep(0.5)
+    finally:
+        node.stop()
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    cfg = _load_config(args.home)
+    nk = NodeKey.load_or_gen(os.path.join(args.home, cfg.base.node_key_file))
+    print(nk.id())
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    cfg = _load_config(args.home)
+    pv = load_or_gen_file_pv(
+        cfg.base.priv_validator_key_path(), cfg.base.priv_validator_state_path()
+    )
+    print(json.dumps(pub_key_to_json(pv.get_pub_key())))
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    """commands/gen_validator.go — print a fresh key pair as JSON."""
+    import base64
+
+    from cometbft_tpu.crypto import ed25519
+
+    priv = ed25519.gen_priv_key()
+    print(
+        json.dumps(
+            {
+                "address": priv.pub_key().address().hex().upper(),
+                "pub_key": pub_key_to_json(priv.pub_key()),
+                "priv_key": {
+                    "type": "tendermint/PrivKeyEd25519",
+                    "value": base64.b64encode(priv.bytes()).decode(),
+                },
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """commands/testnet.go — write N validator home dirs wired together."""
+    n = args.v
+    base_dir = args.output_dir
+    chain_id = args.chain_id or f"chain-{os.urandom(3).hex()}"
+
+    homes = [os.path.join(base_dir, f"node{i}") for i in range(n)]
+    pvs, node_keys = [], []
+    for home in homes:
+        _ensure_dirs(home)
+        cfg = default_config().set_root(home)
+        pvs.append(
+            load_or_gen_file_pv(
+                cfg.base.priv_validator_key_path(),
+                cfg.base.priv_validator_state_path(),
+            )
+        )
+        node_keys.append(
+            NodeKey.load_or_gen(os.path.join(home, cfg.base.node_key_file))
+        )
+
+    doc = GenesisDoc(
+        genesis_time=Timestamp.now(),
+        chain_id=chain_id,
+        initial_height=1,
+        consensus_params=default_consensus_params(),
+        validators=[
+            GenesisValidator(pv.get_address(), pv.get_pub_key(), 10, f"node{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+
+    p2p_base, rpc_base = args.p2p_port, args.rpc_port
+    peers = ",".join(
+        f"{node_keys[i].id()}@127.0.0.1:{p2p_base + i}" for i in range(n)
+    )
+    for i, home in enumerate(homes):
+        cfg = default_config().set_root(home)
+        cfg.base.proxy_app = args.proxy_app
+        cfg.base.moniker = f"node{i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_base + i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_base + i}"
+        cfg.p2p.persistent_peers = ",".join(
+            p for j, p in enumerate(peers.split(",")) if j != i
+        )
+        cfg.p2p.addr_book_strict = False
+        with open(cfg.base.genesis_path(), "w") as f:
+            f.write(doc.to_json())
+        write_config_file(os.path.join(home, "config", "config.toml"), cfg)
+    print(f"Successfully initialized {n} node directories in {base_dir}")
+    return 0
+
+
+def cmd_version(_args) -> int:
+    print(VERSION)
+    return 0
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cometbft_tpu",
+        description="TPU-native BFT state-machine replication node",
+    )
+    parser.add_argument(
+        "--home",
+        default=os.path.expanduser("~/.cometbft_tpu"),
+        help="node home directory",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("init", help="initialize a node home directory")
+    p.add_argument("--chain-id", default="")
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("start", help="run the node")
+    p.add_argument("--proxy_app", default="")
+    p.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
+    p.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    p.add_argument(
+        "--p2p.persistent_peers", dest="persistent_peers", default=""
+    )
+    p.add_argument("--no-fast-sync", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("show-node-id", help="print this node's p2p ID")
+    p.set_defaults(fn=cmd_show_node_id)
+
+    p = sub.add_parser("show-validator", help="print this node's pubkey")
+    p.set_defaults(fn=cmd_show_validator)
+
+    p = sub.add_parser("gen-validator", help="generate a validator keypair")
+    p.set_defaults(fn=cmd_gen_validator)
+
+    p = sub.add_parser("testnet", help="initialize a local multi-node testnet")
+    p.add_argument("--v", type=int, default=4, help="number of validators")
+    p.add_argument("--output-dir", default="./mytestnet")
+    p.add_argument("--chain-id", default="")
+    p.add_argument("--proxy_app", default="kvstore")
+    p.add_argument("--p2p-port", type=int, default=26656)
+    p.add_argument("--rpc-port", type=int, default=26657)
+    p.set_defaults(fn=cmd_testnet)
+
+    p = sub.add_parser("version", help="print the version")
+    p.set_defaults(fn=cmd_version)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)

@@ -1,0 +1,12 @@
+"""Node assembly — wire every subsystem into a runnable node.
+
+Reference: node/node.go — NewNode (:708) builds the stack in dependency
+order: DBs → state → proxy app conns → event bus → handshake (WAL/ABCI
+replay) → mempool → evidence → executor → blocksync → consensus →
+transport/switch/addrbook/PEX → RPC; DefaultNewNode (:100) derives
+everything from a Config.
+"""
+
+from cometbft_tpu.node.node import Node, default_new_node
+
+__all__ = ["Node", "default_new_node"]

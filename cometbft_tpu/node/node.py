@@ -1,0 +1,357 @@
+"""The Node — full dependency-ordered assembly of a running validator.
+
+Reference: node/node.go:708 NewNode / :100 DefaultNewNode / :943 OnStart.
+Every subsystem the tests hand-assemble is wired here from a Config:
+stores, ABCI proxy conns, handshake replay, mempool, evidence, blocksync,
+consensus (with WAL + FilePV), p2p transport/switch/PEX, and the JSON-RPC
+server.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from cometbft_tpu.abci.client import Client, LocalClient, SocketClient
+from cometbft_tpu.abci.kvstore import (
+    KVStoreApplication,
+    PersistentKVStoreApplication,
+)
+from cometbft_tpu.blocksync import BLOCKSYNC_CHANNEL, BlocksyncReactor
+from cometbft_tpu.config import Config
+from cometbft_tpu.consensus.reactor import (
+    DATA_CHANNEL,
+    STATE_CHANNEL,
+    VOTE_CHANNEL,
+    VOTE_SET_BITS_CHANNEL,
+    ConsensusReactor,
+)
+from cometbft_tpu.consensus.replay import Handshaker
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.consensus.wal import WAL, NilWAL
+from cometbft_tpu.evidence.pool import Pool as EvidencePool
+from cometbft_tpu.evidence.reactor import EVIDENCE_CHANNEL, EvidenceReactor
+from cometbft_tpu.libs.db import DB, MemDB, SQLiteDB
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.mempool.clist_mempool import CListMempool
+from cometbft_tpu.mempool.reactor import MEMPOOL_CHANNEL, MempoolReactor
+from cometbft_tpu.p2p import (
+    MultiplexTransport,
+    NetAddress,
+    NodeInfo,
+    NodeKey,
+    ProtocolVersion,
+    Switch,
+)
+from cometbft_tpu.p2p.conn.connection import MConnConfig
+from cometbft_tpu.p2p.pex.addrbook import AddrBook
+from cometbft_tpu.p2p.pex.reactor import PEX_CHANNEL, PEXReactor
+from cometbft_tpu.privval import load_or_gen_file_pv
+from cometbft_tpu.proxy import AppConns, new_app_conns
+from cometbft_tpu.state import State, make_genesis_state
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.store import Store as StateStore
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types.event_bus import EventBus
+from cometbft_tpu.types.genesis import GenesisDoc
+
+
+def _parse_laddr(laddr: str):
+    """tcp://host:port → (host, port)."""
+    addr = laddr.split("://", 1)[-1]
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def default_client_creator(proxy_app: str, app_db: Optional[DB] = None):
+    """Reference: proxy.DefaultClientCreator — builtin names or a socket
+    address. Builtin apps share ONE application instance across the four
+    logical connections (LocalClient takes a shared mutex)."""
+    import threading
+
+    if proxy_app == "kvstore":
+        app = KVStoreApplication(app_db)
+        mtx = threading.Lock()
+        return lambda: LocalClient(app, mtx)
+    if proxy_app == "persistent_kvstore":
+        app = PersistentKVStoreApplication(app_db)
+        mtx = threading.Lock()
+        return lambda: LocalClient(app, mtx)
+    if proxy_app == "noop":
+        from cometbft_tpu.abci.application import BaseApplication
+
+        app = BaseApplication()
+        mtx = threading.Lock()
+        return lambda: LocalClient(app, mtx)
+    addr = proxy_app.split("://", 1)[-1]
+    return lambda: SocketClient(addr, must_connect=False)
+
+
+class Node(BaseService):
+    """node/node.go:708 NewNode."""
+
+    def __init__(
+        self,
+        config: Config,
+        priv_validator,
+        node_key: NodeKey,
+        client_creator,
+        genesis_doc: GenesisDoc,
+        db_provider=None,  # (name, config) -> DB
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("Node", logger or new_nop_logger())
+        self.config = config
+        self.genesis_doc = genesis_doc
+        self.node_key = node_key
+
+        db_provider = db_provider or default_db_provider
+
+        # 1. stores
+        self.block_store = BlockStore(db_provider("blockstore", config))
+        self.state_store = StateStore(db_provider("state", config))
+
+        # 2. state from DB or genesis
+        state = self.state_store.load()
+        if state is None:
+            state = make_genesis_state(genesis_doc)
+            self.state_store.save(state)
+
+        # 3. proxy app + handshake
+        self.proxy_app: AppConns = new_app_conns(client_creator)
+        self.proxy_app.start()
+
+        # 4. event bus (started before replay so indexers see replayed events)
+        self.event_bus = EventBus()
+        self.event_bus.start()
+
+        Handshaker(
+            self.state_store, state, self.block_store, genesis_doc,
+            event_bus=self.event_bus, logger=self.logger,
+        ).handshake(self.proxy_app)
+        state = self.state_store.load() or state
+
+        # 5. privval
+        self.priv_validator = priv_validator
+        pub_key = priv_validator.get_pub_key() if priv_validator else None
+
+        fast_sync = config.base.fast_sync_mode and not _only_validator_is_us(
+            state, pub_key
+        )
+
+        # 6. mempool
+        self.mempool = CListMempool(
+            config.mempool, self.proxy_app.mempool(),
+            height=state.last_block_height,
+        )
+        self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
+
+        # 7. evidence
+        self.evidence_pool = EvidencePool(
+            db_provider("evidence", config), self.state_store,
+            self.block_store,
+        )
+        self.evidence_reactor = EvidenceReactor(self.evidence_pool)
+
+        # 8. executor
+        self.block_executor = BlockExecutor(
+            self.state_store,
+            self.proxy_app.consensus(),
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            event_bus=self.event_bus,
+            logger=self.logger,
+        )
+
+        # 9. blocksync
+        self.blocksync_reactor = BlocksyncReactor(
+            state, self.block_executor, self.block_store,
+            fast_sync=fast_sync,
+            crypto_backend=config.crypto.backend,
+            logger=self.logger,
+        )
+
+        # 10. consensus
+        wal = (
+            WAL(config.consensus.wal_file())
+            if config.consensus.wal_path
+            else NilWAL()
+        )
+        self.consensus_state = ConsensusState(
+            config.consensus, state, self.block_executor, self.block_store,
+            tx_notifier=self.mempool, evpool=self.evidence_pool, wal=wal,
+            event_bus=self.event_bus, logger=self.logger,
+        )
+        if priv_validator is not None:
+            self.consensus_state.set_priv_validator(priv_validator)
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus_state, wait_sync=fast_sync, logger=self.logger
+        )
+
+        # 11. p2p
+        adv_host, adv_port = _parse_laddr(
+            config.p2p.external_address or config.p2p.laddr
+        )
+        node_info = NodeInfo(
+            protocol_version=ProtocolVersion(),
+            node_id=node_key.id(),
+            listen_addr=f"{adv_host}:{adv_port}",
+            network=genesis_doc.chain_id,
+            channels=bytes(
+                [
+                    BLOCKSYNC_CHANNEL,
+                    STATE_CHANNEL,
+                    DATA_CHANNEL,
+                    VOTE_CHANNEL,
+                    VOTE_SET_BITS_CHANNEL,
+                    MEMPOOL_CHANNEL,
+                    EVIDENCE_CHANNEL,
+                ]
+                + ([PEX_CHANNEL] if config.p2p.pex else [])
+            ),
+            moniker=config.base.moniker,
+        )
+        self.transport = MultiplexTransport(
+            node_info, node_key,
+            handshake_timeout=config.p2p.handshake_timeout_ns / 1e9,
+            dial_timeout=config.p2p.dial_timeout_ns / 1e9,
+            logger=self.logger,
+        )
+        mconfig = MConnConfig(
+            send_rate=config.p2p.send_rate,
+            recv_rate=config.p2p.recv_rate,
+            max_packet_msg_payload_size=config.p2p.max_packet_msg_payload_size,
+            flush_throttle=config.p2p.flush_throttle_timeout_ns / 1e9,
+        )
+        self.switch = Switch(
+            self.transport,
+            max_inbound_peers=config.p2p.max_num_inbound_peers,
+            max_outbound_peers=config.p2p.max_num_outbound_peers,
+            mconfig=mconfig,
+            logger=self.logger,
+        )
+        self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
+        self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
+        self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
+        self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+
+        # 12. PEX + addrbook
+        self.pex_reactor = None
+        self.addr_book = None
+        if config.p2p.pex:
+            self.addr_book = AddrBook(
+                file_path=os.path.join(
+                    config.root_dir, config.p2p.addr_book_file
+                )
+                if config.root_dir
+                else "",
+                routability_strict=config.p2p.addr_book_strict,
+            )
+            seeds = [
+                s.strip() for s in config.p2p.seeds.split(",") if s.strip()
+            ]
+            self.pex_reactor = PEXReactor(
+                self.addr_book,
+                seeds=seeds,
+                seed_mode=config.p2p.seed_mode,
+            )
+            self.switch.add_reactor("PEX", self.pex_reactor)
+            self.switch.addr_book = self.addr_book
+
+        # 13. RPC
+        self.rpc_server = None
+        if config.rpc.laddr:
+            from cometbft_tpu.rpc.core import Environment
+            from cometbft_tpu.rpc.server import RPCServer
+
+            env = Environment(self)
+            self.rpc_server = RPCServer(env, logger=self.logger)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def on_start(self) -> None:
+        host, port = _parse_laddr(self.config.p2p.laddr)
+        self.transport.listen(NetAddress(self.node_key.id(), host, port))
+        if self.addr_book is not None:
+            self.addr_book.start()
+        self.switch.start()
+        persistent = [
+            p.strip()
+            for p in self.config.p2p.persistent_peers.split(",")
+            if p.strip()
+        ]
+        if persistent:
+            addrs = self.switch.add_persistent_peers(persistent)
+            self.switch.dial_peers_async(addrs)
+        if self.rpc_server is not None:
+            host, port = _parse_laddr(self.config.rpc.laddr)
+            self.rpc_server.serve(host, port)
+
+    def on_stop(self) -> None:
+        for svc in (
+            self.rpc_server,
+            self.switch,
+            self.addr_book,
+            self.event_bus,
+            self.proxy_app,
+        ):
+            if svc is None:
+                continue
+            try:
+                if hasattr(svc, "is_running") and not svc.is_running():
+                    continue
+                svc.stop()
+            except Exception as exc:
+                self.logger.error("error stopping service", err=str(exc))
+        if self.consensus_state.is_running():
+            self.consensus_state.stop()
+
+    # -- introspection (used by RPC) -----------------------------------------
+
+    def listen_addr(self) -> Optional[NetAddress]:
+        return self.transport.listen_addr
+
+    def is_syncing(self) -> bool:
+        return self.consensus_reactor.wait_sync()
+
+
+def _only_validator_is_us(state: State, pub_key) -> bool:
+    """node.go onlyValidatorIsUs — no point fast-syncing a 1-validator
+    chain where we're the validator."""
+    if pub_key is None:
+        return False
+    if state.validators.size() != 1:
+        return False
+    return state.validators.validators[0].address == pub_key.address()
+
+
+def default_db_provider(name: str, config: Config) -> DB:
+    if config.base.db_backend == "memdb":
+        return MemDB()
+    data_dir = os.path.join(config.root_dir, config.base.db_dir)
+    os.makedirs(data_dir, exist_ok=True)
+    return SQLiteDB(os.path.join(data_dir, f"{name}.db"))
+
+
+def default_new_node(config: Config, logger: Optional[Logger] = None) -> Node:
+    """Reference: node/node.go:100 DefaultNewNode — everything from files
+    under the config root."""
+    node_key = NodeKey.load_or_gen(
+        os.path.join(config.root_dir, config.base.node_key_file)
+    )
+    priv_validator = load_or_gen_file_pv(
+        config.base.priv_validator_key_path(),
+        config.base.priv_validator_state_path(),
+    )
+    with open(config.base.genesis_path()) as f:
+        genesis_doc = GenesisDoc.from_json(f.read())
+    app_db = default_db_provider("app", config)
+    return Node(
+        config,
+        priv_validator,
+        node_key,
+        default_client_creator(config.base.proxy_app, app_db),
+        genesis_doc,
+        logger=logger,
+    )

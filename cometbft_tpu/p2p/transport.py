@@ -196,6 +196,14 @@ class MultiplexTransport:
     def close(self) -> None:
         self._closed = True
         if self._listener is not None:
+            # shutdown first: a thread blocked in accept() holds the open
+            # file description, so close() alone leaves the port in LISTEN
+            # until that accept returns — the address stays "in use" for a
+            # restarting node. shutdown wakes the accept immediately.
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:

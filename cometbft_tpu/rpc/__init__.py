@@ -1,0 +1,10 @@
+"""JSON-RPC external API.
+
+Reference: rpc/ — jsonrpc HTTP/WS server (rpc/jsonrpc/server), ~40 routes
+over a node Environment (rpc/core/routes.go:10-49, rpc/core/env.go).
+"""
+
+from cometbft_tpu.rpc.core import Environment
+from cometbft_tpu.rpc.server import RPCServer
+
+__all__ = ["Environment", "RPCServer"]

@@ -1,0 +1,426 @@
+"""RPC route handlers over a node Environment.
+
+Reference: rpc/core/ — Environment (env.go) + the route set
+(routes.go:10-49). Each handler returns a JSON-ready dict; transport
+(HTTP POST JSON-RPC, GET URI, WS) lives in rpc/server.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import List, Optional
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs.pubsub.pubsub import SubscriptionCancelled
+from cometbft_tpu.mempool import ErrTxInCache
+from cometbft_tpu.rpc.serializers import (
+    b64,
+    block_id_json,
+    block_json,
+    block_meta_json,
+    commit_json,
+    header_json,
+    hex_up,
+    tx_result_json,
+    validator_json,
+)
+from cometbft_tpu.types.event_bus import EVENT_QUERY_TX, TX_HASH_KEY
+from cometbft_tpu.types.tx import Tx
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+class Environment:
+    """rpc/core/env.go — everything the handlers reach into."""
+
+    def __init__(self, node):
+        self.node = node
+
+    # -- info routes ---------------------------------------------------------
+
+    def health(self) -> dict:
+        return {}
+
+    def status(self) -> dict:
+        node = self.node
+        latest_height = node.block_store.height()
+        latest_meta = (
+            node.block_store.load_block_meta(latest_height)
+            if latest_height > 0
+            else None
+        )
+        earliest_height = node.block_store.base()
+        earliest_meta = (
+            node.block_store.load_block_meta(earliest_height)
+            if earliest_height > 0
+            else None
+        )
+        pub_key = (
+            node.priv_validator.get_pub_key()
+            if node.priv_validator is not None
+            else None
+        )
+        la = node.listen_addr()
+        return {
+            "node_info": {
+                "id": node.node_key.id(),
+                "listen_addr": f"{la.ip}:{la.port}" if la else "",
+                "network": node.genesis_doc.chain_id,
+                "moniker": node.config.base.moniker,
+                "channels": node.transport.node_info.channels.hex(),
+            },
+            "sync_info": {
+                "latest_block_hash": hex_up(
+                    latest_meta.block_id.hash if latest_meta else b""
+                ),
+                "latest_app_hash": hex_up(
+                    latest_meta.header.app_hash if latest_meta else b""
+                ),
+                "latest_block_height": str(latest_height),
+                "latest_block_time": (
+                    latest_meta.header.time.to_rfc3339()
+                    if latest_meta
+                    else ""
+                ),
+                "earliest_block_height": str(earliest_height),
+                "earliest_block_hash": hex_up(
+                    earliest_meta.block_id.hash if earliest_meta else b""
+                ),
+                "catching_up": node.is_syncing(),
+            },
+            "validator_info": {
+                "address": hex_up(pub_key.address()) if pub_key else "",
+                "pub_key": {
+                    "type": "tendermint/PubKeyEd25519",
+                    "value": b64(pub_key.bytes()),
+                }
+                if pub_key
+                else None,
+                "voting_power": str(self._our_voting_power(pub_key)),
+            },
+        }
+
+    def _our_voting_power(self, pub_key) -> int:
+        if pub_key is None:
+            return 0
+        state = self.node.consensus_state.state
+        _, val = state.validators.get_by_address(pub_key.address())
+        return val.voting_power if val else 0
+
+    def net_info(self) -> dict:
+        sw = self.node.switch
+        peers = []
+        for p in sw.peers.list():
+            na = p.net_address()
+            peers.append(
+                {
+                    "node_info": {
+                        "id": p.id(),
+                        "moniker": p.node_info.moniker,
+                        "network": p.node_info.network,
+                    },
+                    "is_outbound": p.is_outbound(),
+                    "remote_ip": na.ip if na else "",
+                }
+            )
+        return {
+            "listening": self.node.transport.listen_addr is not None,
+            "listeners": [str(self.node.transport.listen_addr or "")],
+            "n_peers": str(len(peers)),
+            "peers": peers,
+        }
+
+    def genesis(self) -> dict:
+        import json
+
+        return {"genesis": json.loads(self.node.genesis_doc.to_json())}
+
+    # -- blockchain routes ----------------------------------------------------
+
+    def _height_or_latest(self, height: Optional[int]) -> int:
+        store = self.node.block_store
+        if height is None or height <= 0:
+            return store.height()
+        if height > store.height():
+            raise RPCError(
+                -32603,
+                f"height {height} must be less than or equal to the "
+                f"current blockchain height {store.height()}",
+            )
+        if height < store.base():
+            raise RPCError(
+                -32603,
+                f"height {height} is not available, lowest height is "
+                f"{store.base()}",
+            )
+        return height
+
+    def block(self, height: Optional[int] = None) -> dict:
+        h = self._height_or_latest(height)
+        block = self.node.block_store.load_block(h)
+        meta = self.node.block_store.load_block_meta(h)
+        if block is None or meta is None:
+            raise RPCError(-32603, f"block at height {h} not found")
+        return {
+            "block_id": block_id_json(meta.block_id),
+            "block": block_json(block),
+        }
+
+    def block_by_hash(self, hash_: bytes) -> dict:
+        block = self.node.block_store.load_block_by_hash(hash_)
+        if block is None:
+            return {"block_id": None, "block": None}
+        return self.block(block.header.height)
+
+    def blockchain(
+        self, min_height: int = 0, max_height: int = 0
+    ) -> dict:
+        """rpc/core/blocks.go BlockchainInfo — metas for a height range,
+        newest first, capped at 20."""
+        store = self.node.block_store
+        base, height = store.base(), store.height()
+        if max_height <= 0:
+            max_height = height
+        max_height = min(height, max_height)
+        if min_height <= 0:
+            min_height = 1
+        min_height = max(base, min_height, max_height - 19)
+        if min_height > max_height:
+            raise RPCError(
+                -32603,
+                f"min height {min_height} can't be greater than max "
+                f"height {max_height}",
+            )
+        metas = []
+        for h in range(max_height, min_height - 1, -1):
+            meta = store.load_block_meta(h)
+            if meta is not None:
+                metas.append(block_meta_json(meta))
+        return {"last_height": str(height), "block_metas": metas}
+
+    def commit(self, height: Optional[int] = None) -> dict:
+        h = self._height_or_latest(height)
+        store = self.node.block_store
+        meta = store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"block at height {h} not found")
+        if h == store.height():
+            commit = store.load_seen_commit(h)
+            canonical = False
+        else:
+            commit = store.load_block_commit(h)
+            canonical = True
+        return {
+            "signed_header": {
+                "header": header_json(meta.header),
+                "commit": commit_json(commit),
+            },
+            "canonical": canonical,
+        }
+
+    def validators(
+        self,
+        height: Optional[int] = None,
+        page: int = 1,
+        per_page: int = 30,
+    ) -> dict:
+        h = self._height_or_latest(height)
+        vals = self.node.state_store.load_validators(h)
+        total = vals.size()
+        per_page = max(1, min(per_page, 100))
+        pages = max(1, (total + per_page - 1) // per_page)
+        if page < 1 or page > pages:
+            raise RPCError(-32603, f"page should be within [1, {pages}]")
+        start = (page - 1) * per_page
+        return {
+            "block_height": str(h),
+            "validators": [
+                validator_json(v)
+                for v in vals.validators[start : start + per_page]
+            ],
+            "count": str(min(per_page, total - start)),
+            "total": str(total),
+        }
+
+    def consensus_params(self, height: Optional[int] = None) -> dict:
+        h = self._height_or_latest(height)
+        params = self.node.state_store.load_consensus_params(h)
+        return {
+            "block_height": str(h),
+            "consensus_params": params.to_json(),
+        }
+
+    def consensus_state(self) -> dict:
+        rs = self.node.consensus_state.get_round_state()
+        return {
+            "round_state": {
+                "height/round/step": f"{rs.height}/{rs.round}/{int(rs.step)}",
+                "height": str(rs.height),
+                "round": rs.round,
+                "step": int(rs.step),
+                "proposal_block_hash": hex_up(
+                    rs.proposal_block.hash()
+                    if rs.proposal_block is not None
+                    else b""
+                ),
+            }
+        }
+
+    def dump_consensus_state(self) -> dict:
+        out = self.consensus_state()
+        peers = []
+        from cometbft_tpu.types.keys import PEER_STATE_KEY
+
+        for p in self.node.switch.peers.list():
+            ps = p.get(PEER_STATE_KEY)
+            if ps is None:
+                continue
+            prs = ps.get_round_state()
+            peers.append(
+                {
+                    "node_address": p.id(),
+                    "peer_state": {
+                        "height": str(prs.height),
+                        "round": prs.round,
+                        "step": int(prs.step),
+                    },
+                }
+            )
+        out["peers"] = peers
+        return out
+
+    # -- ABCI routes -----------------------------------------------------------
+
+    def abci_info(self) -> dict:
+        res = self.node.proxy_app.query().info_sync(abci.RequestInfo())
+        return {
+            "response": {
+                "data": res.data,
+                "version": res.version,
+                "app_version": str(res.app_version),
+                "last_block_height": str(res.last_block_height),
+                "last_block_app_hash": b64(res.last_block_app_hash),
+            }
+        }
+
+    def abci_query(
+        self,
+        path: str = "",
+        data: bytes = b"",
+        height: int = 0,
+        prove: bool = False,
+    ) -> dict:
+        res = self.node.proxy_app.query().query_sync(
+            abci.RequestQuery(path=path, data=data, height=height, prove=prove)
+        )
+        return {
+            "response": {
+                "code": res.code,
+                "log": res.log,
+                "key": b64(res.key) if res.key else None,
+                "value": b64(res.value) if res.value else None,
+                "height": str(res.height),
+            }
+        }
+
+    # -- mempool routes ----------------------------------------------------------
+
+    def unconfirmed_txs(self, limit: int = 30) -> dict:
+        txs = self.node.mempool.reap_max_txs(max(1, min(limit, 100)))
+        return {
+            "n_txs": str(len(txs)),
+            "total": str(self.node.mempool.size()),
+            "total_bytes": str(self.node.mempool.size_bytes()),
+            "txs": [b64(tx) for tx in txs],
+        }
+
+    def num_unconfirmed_txs(self) -> dict:
+        return {
+            "n_txs": str(self.node.mempool.size()),
+            "total": str(self.node.mempool.size()),
+            "total_bytes": str(self.node.mempool.size_bytes()),
+            "txs": None,
+        }
+
+    def broadcast_tx_async(self, tx: bytes) -> dict:
+        """Fire and forget (rpc/core/mempool.go:22)."""
+        try:
+            self.node.mempool.check_tx(tx, None)
+        except ErrTxInCache:
+            pass
+        except Exception as exc:
+            raise RPCError(-32603, str(exc)) from exc
+        return {
+            "code": 0, "data": "", "log": "", "codespace": "",
+            "hash": hex_up(Tx(tx).hash()),
+        }
+
+    def broadcast_tx_sync(self, tx: bytes) -> dict:
+        """Wait for CheckTx (rpc/core/mempool.go:38)."""
+        done = threading.Event()
+        out = {}
+
+        def cb(res):
+            r = res.value
+            out.update(
+                code=r.code, data=b64(r.data) if r.data else "", log=r.log,
+                codespace=getattr(r, "codespace", ""),
+            )
+            done.set()
+
+        try:
+            self.node.mempool.check_tx(tx, cb)
+        except ErrTxInCache as exc:
+            raise RPCError(-32603, f"tx already exists in cache") from exc
+        except Exception as exc:
+            raise RPCError(-32603, str(exc)) from exc
+        if not done.wait(10.0):
+            raise RPCError(-32603, "timed out waiting for CheckTx")
+        out["hash"] = hex_up(Tx(tx).hash())
+        return out
+
+    def broadcast_tx_commit(self, tx: bytes) -> dict:
+        """CheckTx, then wait for the DeliverTx event
+        (rpc/core/mempool.go:58) — bounded by
+        config.rpc.timeout_broadcast_tx_commit."""
+        bus = self.node.event_bus
+        tx_hash = Tx(tx).hash()
+        subscriber = f"rpc-commit-{uuid.uuid4().hex[:12]}"
+        from cometbft_tpu.libs.pubsub.query import parse_query
+
+        q = parse_query(f"{TX_HASH_KEY}='{tx_hash.hex().upper()}'")
+        sub = bus.subscribe(subscriber, q)
+        try:
+            check = self.broadcast_tx_sync(tx)
+            if check.get("code", 0) != 0:
+                return {
+                    "check_tx": check,
+                    "deliver_tx": None,
+                    "hash": hex_up(tx_hash),
+                    "height": "0",
+                }
+            timeout = (
+                self.node.config.rpc.timeout_broadcast_tx_commit_ns / 1e9
+            )
+            try:
+                msg = sub.next(timeout=timeout)
+            except (TimeoutError, SubscriptionCancelled) as exc:
+                raise RPCError(
+                    -32603, "timed out waiting for tx to be included in a block"
+                ) from exc
+            ev = msg.data
+            return {
+                "check_tx": check,
+                "deliver_tx": tx_result_json(ev.result),
+                "hash": hex_up(tx_hash),
+                "height": str(ev.height),
+            }
+        finally:
+            bus.unsubscribe_all(subscriber)

@@ -1,0 +1,128 @@
+"""JSON shapes for RPC responses.
+
+Reference: the amino-JSON forms served by rpc/core (heights as strings,
+hashes upper-hex, txs/byte-blobs base64, RFC3339 times) — see
+rpc/openapi/openapi.yaml for the documented result shapes.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+
+def b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def hex_up(data: bytes) -> str:
+    return data.hex().upper()
+
+
+def timestamp_json(ts) -> str:
+    return ts.to_rfc3339()
+
+
+def block_id_json(bid) -> dict:
+    return {
+        "hash": hex_up(bid.hash),
+        "parts": {
+            "total": bid.part_set_header.total,
+            "hash": hex_up(bid.part_set_header.hash),
+        },
+    }
+
+
+def header_json(h) -> dict:
+    return {
+        "version": {"block": str(h.version.block), "app": str(h.version.app)},
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": timestamp_json(h.time),
+        "last_block_id": block_id_json(h.last_block_id),
+        "last_commit_hash": hex_up(h.last_commit_hash),
+        "data_hash": hex_up(h.data_hash),
+        "validators_hash": hex_up(h.validators_hash),
+        "next_validators_hash": hex_up(h.next_validators_hash),
+        "consensus_hash": hex_up(h.consensus_hash),
+        "app_hash": hex_up(h.app_hash),
+        "last_results_hash": hex_up(h.last_results_hash),
+        "evidence_hash": hex_up(h.evidence_hash),
+        "proposer_address": hex_up(h.proposer_address),
+    }
+
+
+def commit_sig_json(cs) -> dict:
+    return {
+        "block_id_flag": cs.block_id_flag,
+        "validator_address": hex_up(cs.validator_address),
+        "timestamp": timestamp_json(cs.timestamp),
+        "signature": b64(cs.signature) if cs.signature else None,
+    }
+
+
+def commit_json(c) -> Optional[dict]:
+    if c is None:
+        return None
+    return {
+        "height": str(c.height),
+        "round": c.round,
+        "block_id": block_id_json(c.block_id),
+        "signatures": [commit_sig_json(s) for s in c.signatures],
+    }
+
+
+def block_json(b) -> dict:
+    return {
+        "header": header_json(b.header),
+        "data": {"txs": [b64(tx) for tx in b.data.txs]},
+        "evidence": {"evidence": [b64(ev.bytes()) for ev in b.evidence]},
+        "last_commit": commit_json(b.last_commit),
+    }
+
+
+def block_meta_json(meta) -> dict:
+    return {
+        "block_id": block_id_json(meta.block_id),
+        "block_size": str(meta.block_size),
+        "header": header_json(meta.header),
+        "num_txs": str(meta.num_txs),
+    }
+
+
+def validator_json(v) -> dict:
+    return {
+        "address": hex_up(v.address),
+        "pub_key": {
+            "type": "tendermint/PubKeyEd25519",
+            "value": b64(v.pub_key.bytes()),
+        },
+        "voting_power": str(v.voting_power),
+        "proposer_priority": str(v.proposer_priority),
+    }
+
+
+def tx_result_json(r) -> dict:
+    return {
+        "code": r.code,
+        "data": b64(r.data) if r.data else None,
+        "log": r.log,
+        "info": getattr(r, "info", ""),
+        "gas_wanted": str(getattr(r, "gas_wanted", 0)),
+        "gas_used": str(getattr(r, "gas_used", 0)),
+        "events": [
+            {
+                "type": ev.type,
+                "attributes": [
+                    {
+                        "key": b64(a.key if isinstance(a.key, bytes) else a.key.encode()),
+                        "value": b64(a.value if isinstance(a.value, bytes) else a.value.encode()),
+                        "index": getattr(a, "index", False),
+                    }
+                    for a in ev.attributes
+                ],
+            }
+            for ev in getattr(r, "events", [])
+        ],
+        "codespace": getattr(r, "codespace", ""),
+    }

@@ -1,0 +1,488 @@
+"""JSON-RPC transport: HTTP POST, GET URI routes, and WebSocket subscribe.
+
+Reference: rpc/jsonrpc/server — http_server.go (Serve w/ panic recovery),
+http_json_handler.go (POST JSON-RPC 2.0, single + batch),
+http_uri_handler.go (GET with query params), ws_handler.go (per-conn
+read/write pumps carrying JSON-RPC frames; subscribe/unsubscribe ride the
+event bus). The WebSocket side is a from-scratch RFC6455 server handshake
++ frame codec on the stdlib HTTP machinery — no external deps.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import socket
+import struct
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qsl, urlparse
+
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.libs.pubsub.pubsub import SubscriptionCancelled
+from cometbft_tpu.libs.pubsub.query import parse_query
+from cometbft_tpu.rpc.core import Environment, RPCError
+
+_WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+
+# route name → (method name on Environment, {param: coercer})
+_ROUTES = {
+    "health": ("health", {}),
+    "status": ("status", {}),
+    "net_info": ("net_info", {}),
+    "genesis": ("genesis", {}),
+    "blockchain": (
+        "blockchain",
+        {"minHeight": ("min_height", int), "maxHeight": ("max_height", int)},
+    ),
+    "block": ("block", {"height": ("height", int)}),
+    "block_by_hash": ("block_by_hash", {"hash": ("hash_", "b64bytes")}),
+    "commit": ("commit", {"height": ("height", int)}),
+    "validators": (
+        "validators",
+        {
+            "height": ("height", int),
+            "page": ("page", int),
+            "per_page": ("per_page", int),
+        },
+    ),
+    "consensus_params": ("consensus_params", {"height": ("height", int)}),
+    "consensus_state": ("consensus_state", {}),
+    "dump_consensus_state": ("dump_consensus_state", {}),
+    "abci_info": ("abci_info", {}),
+    "abci_query": (
+        "abci_query",
+        {
+            "path": ("path", str),
+            "data": ("data", "hexbytes"),
+            "height": ("height", int),
+            "prove": ("prove", bool),
+        },
+    ),
+    "unconfirmed_txs": ("unconfirmed_txs", {"limit": ("limit", int)}),
+    "num_unconfirmed_txs": ("num_unconfirmed_txs", {}),
+    "broadcast_tx_async": ("broadcast_tx_async", {"tx": ("tx", "b64bytes")}),
+    "broadcast_tx_sync": ("broadcast_tx_sync", {"tx": ("tx", "b64bytes")}),
+    "broadcast_tx_commit": ("broadcast_tx_commit", {"tx": ("tx", "b64bytes")}),
+}
+
+
+def _coerce(kind, value):
+    if kind is int:
+        return int(value.strip('"')) if isinstance(value, str) else int(value)
+    if kind is bool:
+        if isinstance(value, bool):
+            return value
+        return str(value).lower() in ("true", "1")
+    if kind is str:
+        return str(value)
+    if kind == "b64bytes":
+        # JSON-RPC params carry bytes base64'd; URI params hex with 0x or b64
+        if isinstance(value, (bytes, bytearray)):
+            return bytes(value)
+        s = str(value).strip('"')
+        if s.startswith("0x"):
+            return bytes.fromhex(s[2:])
+        try:
+            return base64.b64decode(s, validate=True)
+        except Exception as exc:
+            raise RPCError(-32602, f"invalid base64 parameter: {exc}") from exc
+    if kind == "hexbytes":
+        if isinstance(value, (bytes, bytearray)):
+            return bytes(value)
+        s = str(value).strip('"')
+        if s.startswith("0x"):
+            s = s[2:]
+        try:
+            return bytes.fromhex(s)
+        except ValueError:
+            return s.encode()
+    raise ValueError(f"unknown coercion {kind}")
+
+
+def _dispatch(env: Environment, method: str, params):
+    route = _ROUTES.get(method)
+    if route is None:
+        raise RPCError(-32601, f"Method not found: {method}")
+    fn_name, spec = route
+    if isinstance(params, (list, tuple)):
+        # positional form: map onto the route's declared parameter order
+        if len(params) > len(spec):
+            raise RPCError(
+                -32602,
+                f"{method} takes at most {len(spec)} parameters",
+            )
+        params = dict(zip(spec.keys(), params))
+    kwargs = {}
+    for wire_name, (py_name, kind) in spec.items():
+        if params and wire_name in params and params[wire_name] is not None:
+            kwargs[py_name] = _coerce(kind, params[wire_name])
+    return getattr(env, fn_name)(**kwargs)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "cometbft-tpu-rpc"
+
+    # injected by RPCServer
+    env: Environment = None
+    logger: Logger = None
+
+    def log_message(self, fmt, *args):  # route http.server noise to our logger
+        self.logger.debug(f"rpc http: {fmt % args}")
+
+    # -- JSON-RPC over POST ---------------------------------------------------
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        try:
+            req = json.loads(body)
+        except ValueError:
+            self._reply_json(
+                _error_obj(None, -32700, "Parse error", "invalid JSON")
+            )
+            return
+        if isinstance(req, list):
+            out = [self._handle_one(r) for r in req]
+            out = [o for o in out if o is not None]
+            self._reply_json(out)
+        else:
+            self._reply_json(self._handle_one(req))
+
+    def _handle_one(self, req: dict):
+        rid = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        try:
+            result = _dispatch(self.env, method, params)
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+        except RPCError as exc:
+            return _error_obj(rid, exc.code, exc.message, exc.data)
+        except Exception as exc:  # panic recovery (http_server.go:161)
+            self.logger.error("rpc handler panic", method=method, err=str(exc))
+            return _error_obj(rid, -32603, "Internal error", str(exc))
+
+    # -- URI routes over GET -----------------------------------------------------
+
+    def do_GET(self):
+        parsed = urlparse(self.path)
+        route = parsed.path.strip("/")
+        if route == "websocket":
+            self._upgrade_websocket()
+            return
+        if route == "":
+            self._reply_text(self._index_page())
+            return
+        params = dict(parse_qsl(parsed.query))
+        try:
+            result = _dispatch(self.env, route, params)
+            self._reply_json(
+                {"jsonrpc": "2.0", "id": -1, "result": result}
+            )
+        except RPCError as exc:
+            self._reply_json(_error_obj(-1, exc.code, exc.message, exc.data))
+        except Exception as exc:
+            self.logger.error("rpc handler panic", route=route, err=str(exc))
+            self._reply_json(_error_obj(-1, -32603, "Internal error", str(exc)))
+
+    def _index_page(self) -> str:
+        lines = ["Available endpoints:"]
+        for name in sorted(_ROUTES):
+            lines.append(f"//{self.headers.get('Host', 'localhost')}/{name}")
+        return "\n".join(lines) + "\n"
+
+    # -- plumbing ----------------------------------------------------------------
+
+    def _reply_json(self, obj) -> None:
+        data = json.dumps(obj).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _reply_text(self, text: str) -> None:
+        data = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    # -- WebSocket (RFC 6455) -----------------------------------------------------
+
+    def _upgrade_websocket(self) -> None:
+        key = self.headers.get("Sec-WebSocket-Key")
+        if (
+            self.headers.get("Upgrade", "").lower() != "websocket"
+            or key is None
+        ):
+            self.send_error(400, "not a websocket handshake")
+            return
+        accept = base64.b64encode(
+            hashlib.sha1((key + _WS_GUID).encode()).digest()
+        ).decode()
+        self.send_response(101, "Switching Protocols")
+        self.send_header("Upgrade", "websocket")
+        self.send_header("Connection", "Upgrade")
+        self.send_header("Sec-WebSocket-Accept", accept)
+        self.end_headers()
+        self.close_connection = True
+        _WSConn(
+            self.connection, self.env, self.logger
+        ).run()  # blocks until the client leaves
+
+    def do_OPTIONS(self):
+        self.send_response(204)
+        self.send_header("Access-Control-Allow-Origin", "*")
+        self.send_header("Access-Control-Allow-Methods", "GET, POST, OPTIONS")
+        self.end_headers()
+
+
+def _error_obj(rid, code, message, data=""):
+    return {
+        "jsonrpc": "2.0",
+        "id": rid,
+        "error": {"code": code, "message": message, "data": data},
+    }
+
+
+class _WSConn:
+    """One WebSocket client: JSON-RPC frames; subscribe/unsubscribe route
+    to the event bus, everything else through the normal dispatcher
+    (ws_handler.go read/write pumps)."""
+
+    def __init__(self, sock: socket.socket, env: Environment, logger: Logger):
+        self._sock = sock
+        self._env = env
+        self._logger = logger
+        self._send_mtx = threading.Lock()
+        self._subscriber = f"ws-{uuid.uuid4().hex[:12]}"
+        self._subs = {}  # query string -> (Subscription, pump thread stop flag)
+        self._alive = True
+
+    # -- frame codec ------------------------------------------------------------
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ws closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def _read_frame(self):
+        b1, b2 = self._read_exact(2)
+        opcode = b1 & 0x0F
+        masked = b2 & 0x80
+        length = b2 & 0x7F
+        if length == 126:
+            (length,) = struct.unpack(">H", self._read_exact(2))
+        elif length == 127:
+            (length,) = struct.unpack(">Q", self._read_exact(8))
+        mask = self._read_exact(4) if masked else b""
+        payload = self._read_exact(length)
+        if mask:
+            payload = bytes(
+                c ^ mask[i % 4] for i, c in enumerate(payload)
+            )
+        return opcode, payload
+
+    def _send_frame(self, opcode: int, payload: bytes) -> None:
+        header = bytes([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            header += bytes([n])
+        elif n < 1 << 16:
+            header += bytes([126]) + struct.pack(">H", n)
+        else:
+            header += bytes([127]) + struct.pack(">Q", n)
+        with self._send_mtx:
+            self._sock.sendall(header + payload)
+
+    def _send_json(self, obj) -> None:
+        self._send_frame(0x1, json.dumps(obj).encode())
+
+    # -- main loop ----------------------------------------------------------------
+
+    def run(self) -> None:
+        try:
+            while self._alive:
+                opcode, payload = self._read_frame()
+                if opcode == 0x8:  # close
+                    break
+                if opcode == 0x9:  # ping
+                    self._send_frame(0xA, payload)
+                    continue
+                if opcode not in (0x1, 0x2):
+                    continue
+                try:
+                    req = json.loads(payload)
+                except ValueError:
+                    self._send_json(
+                        _error_obj(None, -32700, "Parse error", "")
+                    )
+                    continue
+                self._handle(req)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._alive = False
+            try:
+                self._env.node.event_bus.unsubscribe_all(self._subscriber)
+            except Exception:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def _handle(self, req: dict) -> None:
+        rid = req.get("id")
+        method = req.get("method", "")
+        params = req.get("params") or {}
+        try:
+            if method == "subscribe":
+                self._subscribe(rid, params.get("query", ""))
+            elif method == "unsubscribe":
+                self._unsubscribe(rid, params.get("query", ""))
+            elif method == "unsubscribe_all":
+                self._env.node.event_bus.unsubscribe_all(self._subscriber)
+                self._send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
+            else:
+                result = _dispatch(self._env, method, params)
+                self._send_json(
+                    {"jsonrpc": "2.0", "id": rid, "result": result}
+                )
+        except RPCError as exc:
+            self._send_json(_error_obj(rid, exc.code, exc.message, exc.data))
+        except Exception as exc:
+            self._send_json(_error_obj(rid, -32603, "Internal error", str(exc)))
+
+    # -- subscriptions ---------------------------------------------------------------
+
+    def _subscribe(self, rid, query_str: str) -> None:
+        q = parse_query(query_str)
+        bus = self._env.node.event_bus
+        sub = bus.subscribe(self._subscriber, q)
+        self._subs[query_str] = sub
+        self._send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
+
+        def pump():
+            while self._alive:
+                try:
+                    msg = sub.next(timeout=0.5)
+                except TimeoutError:
+                    continue
+                except SubscriptionCancelled as exc:
+                    # tell the client instead of going silent (the bus
+                    # evicts subscribers that fall behind)
+                    try:
+                        self._send_json(
+                            _error_obj(
+                                rid, -32000, "subscription cancelled", str(exc)
+                            )
+                        )
+                    except (ConnectionError, OSError):
+                        pass
+                    self._subs.pop(query_str, None)
+                    return
+                try:
+                    self._send_json(
+                        {
+                            "jsonrpc": "2.0",
+                            "id": rid,
+                            "result": {
+                                "query": query_str,
+                                "data": {
+                                    "type": type(msg.data).__name__,
+                                    "value": _event_value_json(msg.data),
+                                },
+                                "events": {
+                                    k: list(v) for k, v in msg.events.items()
+                                },
+                            },
+                        }
+                    )
+                except (ConnectionError, OSError):
+                    return
+
+        threading.Thread(
+            target=pump, name=f"ws-pump-{self._subscriber}", daemon=True
+        ).start()
+
+    def _unsubscribe(self, rid, query_str: str) -> None:
+        sub = self._subs.pop(query_str, None)
+        if sub is not None:
+            self._env.node.event_bus.unsubscribe(
+                self._subscriber, sub.query
+            )
+        self._send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
+
+
+def _event_value_json(data) -> dict:
+    """Best-effort JSON for event payloads."""
+    from cometbft_tpu.rpc.serializers import block_json, header_json, tx_result_json
+    from cometbft_tpu.types.event_bus import (
+        EventDataNewBlock,
+        EventDataNewBlockHeader,
+        EventDataTx,
+    )
+    from cometbft_tpu.rpc.serializers import b64
+
+    if isinstance(data, EventDataNewBlock):
+        return {"block": block_json(data.block)}
+    if isinstance(data, EventDataNewBlockHeader):
+        return {"header": header_json(data.header)}
+    if isinstance(data, EventDataTx):
+        return {
+            "TxResult": {
+                "height": str(data.height),
+                "index": data.index,
+                "tx": b64(data.tx),
+                "result": tx_result_json(data.result),
+            }
+        }
+    return {"repr": repr(data)}
+
+
+class RPCServer:
+    def __init__(self, env: Environment, logger: Optional[Logger] = None):
+        self.env = env
+        self.logger = logger or new_nop_logger()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.bound_port: Optional[int] = None
+
+    def serve(self, host: str, port: int) -> None:
+        env, logger = self.env, self.logger
+
+        class Handler(_Handler):
+            pass
+
+        Handler.env = env
+        Handler.logger = logger
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.bound_port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="rpc-http",
+            daemon=True,
+        )
+        self._thread.start()
+        self.logger.info("RPC server listening", addr=f"{host}:{self.bound_port}")
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def is_running(self) -> bool:
+        return self._httpd is not None

@@ -1,0 +1,366 @@
+"""Node assembly + CLI + RPC: init/testnet commands, single-node boot with
+RPC smoke, and a 4-process kvstore localnet committing a tx end to end.
+
+Model: reference node/node_test.go (NewNode/OnStart), rpc tests, and the
+networks/local docker-compose localnet driven by `cometbft testnet`.
+"""
+
+import base64
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.cmd.commands import main as cli_main
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _rpc(port, route, timeout=5):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/{route}", timeout=timeout
+    ) as r:
+        return json.load(r)
+
+
+def _rpc_post(port, method, params, timeout=30):
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+class TestCLI:
+    def test_init_creates_node_home(self):
+        with tempfile.TemporaryDirectory() as d:
+            assert cli_main(["--home", d, "init", "--chain-id", "cli-test"]) == 0
+            for f in (
+                "config/genesis.json",
+                "config/config.toml",
+                "config/node_key.json",
+                "config/priv_validator_key.json",
+                "data/priv_validator_state.json",
+            ):
+                assert os.path.exists(os.path.join(d, f)), f
+            with open(os.path.join(d, "config/genesis.json")) as fh:
+                doc = json.load(fh)
+            assert doc["chain_id"] == "cli-test"
+            assert len(doc["validators"]) == 1
+            # idempotent
+            assert cli_main(["--home", d, "init"]) == 0
+
+    def test_testnet_creates_wired_homes(self):
+        with tempfile.TemporaryDirectory() as d:
+            out = os.path.join(d, "net")
+            assert (
+                cli_main(
+                    ["testnet", "--v", "3", "--output-dir", out,
+                     "--chain-id", "net-test"]
+                )
+                == 0
+            )
+            genesis = []
+            for i in range(3):
+                with open(os.path.join(out, f"node{i}", "config/genesis.json")) as fh:
+                    genesis.append(fh.read())
+                with open(os.path.join(out, f"node{i}", "config/config.toml")) as fh:
+                    toml = fh.read()
+                assert "persistent_peers" in toml
+            # same genesis everywhere, 3 validators in it
+            assert len(set(genesis)) == 1
+            assert len(json.loads(genesis[0])["validators"]) == 3
+
+    def test_show_node_id_and_validator(self, capsys):
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init"])
+            capsys.readouterr()
+            assert cli_main(["--home", d, "show-node-id"]) == 0
+            node_id = capsys.readouterr().out.strip()
+            assert len(node_id) == 40  # hex address
+            assert cli_main(["--home", d, "show-validator"]) == 0
+            pk = json.loads(capsys.readouterr().out)
+            assert pk["type"] == "tendermint/PubKeyEd25519"
+
+
+class TestSingleNode:
+    def test_boot_commit_rpc(self):
+        """default_new_node boots from an init'ed home, commits blocks,
+        serves RPC, accepts a tx through broadcast_tx_commit."""
+        from cometbft_tpu.cmd.commands import _load_config
+        from cometbft_tpu.node import default_new_node
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "single-node"])
+            rpc_port, p2p_port = _free_ports(2)
+            cfg = _load_config(d)
+            cfg.base.proxy_app = "kvstore"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            node = default_new_node(cfg)
+            node.start()
+            try:
+                deadline = time.monotonic() + 60
+                height = 0
+                while time.monotonic() < deadline:
+                    try:
+                        st = _rpc(rpc_port, "status")["result"]
+                        height = int(st["sync_info"]["latest_block_height"])
+                        if height >= 2:
+                            break
+                    except Exception:
+                        pass
+                    time.sleep(0.3)
+                assert height >= 2, "single node never committed"
+                tx = base64.b64encode(b"one=1").decode()
+                res = _rpc_post(port=rpc_port, method="broadcast_tx_commit",
+                                params={"tx": tx})["result"]
+                assert res["deliver_tx"]["code"] == 0
+                q = _rpc(
+                    rpc_port,
+                    "abci_query?path=/store&data=0x" + b"one".hex(),
+                )["result"]["response"]
+                assert base64.b64decode(q["value"]) == b"1"
+            finally:
+                node.stop()
+
+    def test_node_restarts_from_disk(self):
+        """Stop the node, boot a second one from the same home: state,
+        blocks, and the privval sign state all survive (handshake replay)."""
+        from cometbft_tpu.cmd.commands import _load_config
+        from cometbft_tpu.node import default_new_node
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "restart-test"])
+            rpc_port, p2p_port = _free_ports(2)
+            cfg = _load_config(d)
+            cfg.base.proxy_app = "kvstore"
+            cfg.rpc.laddr = ""
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            node = default_new_node(cfg)
+            node.start()
+            try:
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if node.block_store.height() >= 3:
+                        break
+                    time.sleep(0.2)
+                h1 = node.block_store.height()
+                assert h1 >= 3
+            finally:
+                node.stop()
+            time.sleep(0.5)
+
+            node2 = default_new_node(cfg)
+            node2.start()
+            try:
+                assert node2.block_store.height() >= h1
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if node2.block_store.height() > h1 + 1:
+                        break
+                    time.sleep(0.2)
+                assert node2.block_store.height() > h1 + 1, (
+                    "restarted node made no progress"
+                )
+            finally:
+                node2.stop()
+
+
+def _ws_recv_frame(sock):
+    hdr = sock.recv(2)
+    if len(hdr) < 2:
+        raise ConnectionError("ws closed")
+    length = hdr[1] & 0x7F
+    if length == 126:
+        import struct
+
+        (length,) = struct.unpack(">H", sock.recv(2))
+    elif length == 127:
+        import struct
+
+        (length,) = struct.unpack(">Q", sock.recv(8))
+    buf = b""
+    while len(buf) < length:
+        chunk = sock.recv(length - len(buf))
+        if not chunk:
+            raise ConnectionError("ws closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def _ws_send_text(sock, text: bytes):
+    import struct
+
+    mask = os.urandom(4)
+    payload = bytes(c ^ mask[i % 4] for i, c in enumerate(text))
+    n = len(text)
+    if n < 126:
+        header = bytes([0x81, 0x80 | n])
+    else:
+        header = bytes([0x81, 0x80 | 126]) + struct.pack(">H", n)
+    sock.sendall(header + mask + payload)
+
+
+@pytest.mark.slow
+class TestLocalnet:
+    def test_four_node_localnet_commits_tx(self):
+        """The VERDICT's done-criterion: `testnet` + 4 × `start` processes,
+        a tx submitted over RPC to node0 is committed and readable on
+        node3; a WS subscriber on node1 sees NewBlock events."""
+        with tempfile.TemporaryDirectory() as d:
+            ports = _free_ports(8)
+            p2p_ports, rpc_ports = ports[:4], ports[4:]
+            out = os.path.join(d, "net")
+            # testnet with explicit port bases won't match random free
+            # ports — generate, then patch each config
+            assert cli_main(
+                ["testnet", "--v", "4", "--output-dir", out,
+                 "--chain-id", "localnet"]
+            ) == 0
+            from cometbft_tpu.cmd.commands import _load_config
+            from cometbft_tpu.config import write_config_file
+            from cometbft_tpu.p2p.key import NodeKey
+
+            ids = [
+                NodeKey.load_or_gen(
+                    os.path.join(out, f"node{i}", "config", "node_key.json")
+                ).id()
+                for i in range(4)
+            ]
+            for i in range(4):
+                home = os.path.join(out, f"node{i}")
+                cfg = _load_config(home)
+                cfg.base.proxy_app = "kvstore"
+                cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_ports[i]}"
+                cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_ports[i]}"
+                cfg.p2p.persistent_peers = ",".join(
+                    f"{ids[j]}@127.0.0.1:{p2p_ports[j]}"
+                    for j in range(4)
+                    if j != i
+                )
+                write_config_file(
+                    os.path.join(home, "config", "config.toml"), cfg
+                )
+
+            procs = []
+            try:
+                for i in range(4):
+                    procs.append(
+                        subprocess.Popen(
+                            [
+                                sys.executable, "-m", "cometbft_tpu",
+                                "--home", os.path.join(out, f"node{i}"),
+                                "start",
+                            ],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT,
+                            text=True,
+                        )
+                    )
+                # all four reach height 2
+                deadline = time.monotonic() + 180
+                heights = [0] * 4
+                while time.monotonic() < deadline:
+                    for i in range(4):
+                        try:
+                            st = _rpc(rpc_ports[i], "status", timeout=2)
+                            heights[i] = int(
+                                st["result"]["sync_info"]["latest_block_height"]
+                            )
+                        except Exception:
+                            pass
+                    if all(h >= 2 for h in heights):
+                        break
+                    time.sleep(0.5)
+                assert all(h >= 2 for h in heights), (
+                    f"localnet stuck at {heights}"
+                )
+
+                # WS subscribe on node1 for NewBlock
+                ws = socket.create_connection(
+                    ("127.0.0.1", rpc_ports[1]), timeout=10
+                )
+                key = base64.b64encode(os.urandom(16)).decode()
+                ws.sendall(
+                    (
+                        f"GET /websocket HTTP/1.1\r\n"
+                        f"Host: 127.0.0.1\r\nUpgrade: websocket\r\n"
+                        f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+                        f"Sec-WebSocket-Version: 13\r\n\r\n"
+                    ).encode()
+                )
+                resp = b""
+                while b"\r\n\r\n" not in resp:
+                    resp += ws.recv(1024)
+                assert b"101" in resp.split(b"\r\n")[0]
+                _ws_send_text(
+                    ws,
+                    json.dumps(
+                        {
+                            "jsonrpc": "2.0", "id": 7, "method": "subscribe",
+                            "params": {"query": "tm.event='NewBlock'"},
+                        }
+                    ).encode(),
+                )
+                ws.settimeout(30)
+                ack = json.loads(_ws_recv_frame(ws))
+                assert ack["id"] == 7 and "result" in ack
+
+                # tx to node0 → committed → readable on node3
+                tx = base64.b64encode(b"lk=lv").decode()
+                res = _rpc_post(
+                    rpc_ports[0], "broadcast_tx_commit", {"tx": tx},
+                    timeout=60,
+                )["result"]
+                assert res["deliver_tx"]["code"] == 0, res
+
+                deadline = time.monotonic() + 60
+                val = None
+                while time.monotonic() < deadline:
+                    q = _rpc(
+                        rpc_ports[3],
+                        "abci_query?path=/store&data=0x" + b"lk".hex(),
+                        timeout=5,
+                    )["result"]["response"]
+                    if q["value"]:
+                        val = base64.b64decode(q["value"])
+                        break
+                    time.sleep(0.5)
+                assert val == b"lv", "tx not visible on node3"
+
+                # the WS subscriber saw at least one NewBlock
+                ev = json.loads(_ws_recv_frame(ws))
+                assert ev["result"]["query"] == "tm.event='NewBlock'"
+                ws.close()
+            finally:
+                for p in procs:
+                    p.send_signal(signal.SIGTERM)
+                for p in procs:
+                    try:
+                        p.communicate(timeout=15)
+                    except subprocess.TimeoutExpired:
+                        p.kill()
+                        p.communicate()
