@@ -1,22 +1,25 @@
-"""Headline benchmark: batched Ed25519 verification throughput on TPU vs the
-reference's serial CPU path.
+"""Headline benchmark: batched Ed25519 verification on TPU vs the
+reference's CPU paths, plus the north-star VerifyCommit latencies.
 
-The reference (dymensionxyz/cometbft) verifies every commit signature one at
-a time on one core (types/validator_set.go:685-707 → ed25519.go:148).
-Baseline here = that same serial loop on this host's CPU (the strongest
-single-core implementation available). Value = sigs/sec through the JAX
-batch kernel on the attached chip.
+The reference (dymensionxyz/cometbft) verifies every commit signature one
+at a time on one core (types/validator_set.go:685-707 → ed25519.go:148).
+BASELINE.md:26-36 demands measurement against BOTH that serial loop and a
+CPU *batch* verifier (64-sig batches through the BatchVerifier boundary —
+the strongest CPU batch implementation available here), plus VerifyCommit
+p50 at 150 and 10k validators on both backends.
 
 Staged preflight (each stage subprocess-isolated with its own timeout so a
 wedged TPU runtime can never take the bench down with it):
   1. device enumerate            (120 s)
   2. jit lower+compile, batch=64 (600 s)
-  3. timed full run              (600 s)
+  3. timed full run + sweep      (600 s)
+  4. VerifyCommit p50s + merkle  (600 s)
 If a TPU stage fails, fall back to the same kernel on the virtual CPU
 platform so a number is ALWAYS produced; every stage's outcome is recorded
 in the "stages" field of the JSON line for diagnosability.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "stages"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"vs_serial", "stages"}.
 """
 
 import json
@@ -27,7 +30,8 @@ import time
 
 import numpy as np
 
-BATCH = 2048
+BATCH = 4096
+SWEEP = (256, 1024, 4096, 8192)
 _STAGE_ENV_TPU = {}  # inherit ambient (axon) platform
 _STAGE_ENV_CPU = {
     "JAX_PLATFORMS": "cpu",
@@ -35,7 +39,7 @@ _STAGE_ENV_CPU = {
 }
 
 
-def _make_batch(n: int):
+def _make_batch(n: int, msg_len: int = 120):
     from cometbft_tpu.crypto import ed25519 as ed
 
     rng = np.random.default_rng(42)
@@ -46,11 +50,24 @@ def _make_batch(n: int):
     pks, msgs, sigs = [], [], []
     for i in range(n):
         k = keys[i % len(keys)]
-        m = rng.bytes(120)  # ~ a canonical vote's sign-bytes size
+        m = rng.bytes(msg_len)  # ~ a canonical vote's sign-bytes size
         pks.append(k.pub_key().bytes())
         msgs.append(m)
         sigs.append(k.sign(m))
     return pks, msgs, sigs
+
+
+def _make_commit(n_vals: int):
+    """A real Commit over n_vals validators + its ValidatorSet."""
+    from cometbft_tpu.proto.gogo import Timestamp
+    from cometbft_tpu.types import test_util
+
+    vals, privs = test_util.deterministic_validator_set(n_vals, 10)
+    bid = test_util.make_block_id()
+    commit = test_util.make_commit(
+        bid, 5, 0, vals, privs, "bench-chain", now=Timestamp(1_700_000_000, 0)
+    )
+    return vals, commit, bid
 
 
 def bench_cpu_serial(n: int = 512) -> float:
@@ -63,6 +80,38 @@ def bench_cpu_serial(n: int = 512) -> float:
         assert k.verify_signature(m, s)
     dt = time.perf_counter() - t0
     return n / dt
+
+
+def bench_cpu_batch(n: int = 1024, batch_size: int = 64) -> float:
+    """The BASELINE.md CPU batch baseline: 64-sig batches through the
+    BatchVerifier boundary (cpu backend)."""
+    from cometbft_tpu.crypto import batch as cryptobatch
+    from cometbft_tpu.crypto import ed25519 as ed
+
+    pks, msgs, sigs = _make_batch(n)
+    keys = [ed.PubKeyEd25519(pk) for pk in pks]
+    t0 = time.perf_counter()
+    for start in range(0, n, batch_size):
+        bv = cryptobatch.new_batch_verifier("cpu")
+        for i in range(start, min(start + batch_size, n)):
+            bv.add(keys[i], msgs[i], sigs[i])
+        ok, _ = bv.verify()
+        assert ok
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def bench_verify_commit_p50(n_vals: int, backend: str, reps: int) -> float:
+    """VerifyCommit wall-time p50 (ms) at n_vals validators."""
+    vals, commit, bid = _make_commit(n_vals)
+    times = []
+    # warmup (compile for the tpu backend)
+    vals.verify_commit("bench-chain", bid, 5, commit, backend=backend)
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        vals.verify_commit("bench-chain", bid, 5, commit, backend=backend)
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2] * 1e3
 
 
 # ---------------------------------------------------------------------------
@@ -89,8 +138,6 @@ def _stage_devices():
 def _stage_compile():
     _maybe_force_cpu()
     _set_cache()
-    import jax.numpy as jnp
-
     from cometbft_tpu.crypto.tpu import ed25519_batch
 
     pks, msgs, sigs = _make_batch(64)
@@ -105,15 +152,52 @@ def _stage_run():
     _set_cache()
     from cometbft_tpu.crypto.tpu import ed25519_batch
 
-    pks, msgs, sigs = _make_batch(BATCH)
-    out = ed25519_batch.verify_batch(pks, msgs, sigs)  # warmup/compile
-    assert all(out), "benchmark batch must verify"
-    best = float("inf")
-    for _ in range(3):
+    out = {}
+    best_overall = 0.0
+    for batch in SWEEP:
+        pks, msgs, sigs = _make_batch(batch)
+        res = ed25519_batch.verify_batch(pks, msgs, sigs)  # warmup/compile
+        assert all(res), "benchmark batch must verify"
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            ed25519_batch.verify_batch(pks, msgs, sigs)
+            best = min(best, time.perf_counter() - t0)
+        rate = batch / best
+        out[str(batch)] = round(rate, 1)
+        best_overall = max(best_overall, rate)
+    print(json.dumps({"sigs_per_sec": best_overall, "sweep": out}))
+
+
+def _stage_p50():
+    _maybe_force_cpu()
+    _set_cache()
+    out = {}
+    backend = "cpu" if os.environ.get("BENCH_FORCE_CPU") == "1" else "tpu"
+    out[f"verify_commit_p50_ms_150_{backend}"] = round(
+        bench_verify_commit_p50(150, backend, reps=9), 2
+    )
+    out[f"verify_commit_p50_ms_10k_{backend}"] = round(
+        bench_verify_commit_p50(10_000, backend, reps=3), 2
+    )
+    # 10k-validator mega-set Merkle root (ValidatorSet.Hash)
+    from cometbft_tpu.types import test_util
+
+    vals, _ = test_util.deterministic_validator_set(10_000, 10)
+    items = [v.bytes() for v in vals.validators]
+    if backend == "tpu":
+        from cometbft_tpu.crypto.tpu import merkle as tpu_merkle
+
+        tpu_merkle.hash_from_byte_slices(items, force_device=True)  # warm
         t0 = time.perf_counter()
-        ed25519_batch.verify_batch(pks, msgs, sigs)
-        best = min(best, time.perf_counter() - t0)
-    print(json.dumps({"sigs_per_sec": len(pks) / best, "batch": len(pks)}))
+        tpu_merkle.hash_from_byte_slices(items, force_device=True)
+        out["merkle_10k_root_ms_tpu"] = round((time.perf_counter() - t0) * 1e3, 2)
+    from cometbft_tpu.crypto import merkle as cpu_merkle
+
+    t0 = time.perf_counter()
+    cpu_merkle.hash_from_byte_slices(items)
+    out["merkle_10k_root_ms_cpu"] = round((time.perf_counter() - t0) * 1e3, 2)
+    print(json.dumps(out))
 
 
 def _set_cache():
@@ -152,6 +236,8 @@ def main():
     stages = {}
     cpu_serial = bench_cpu_serial()
     stages["cpu_serial_sigs_per_sec"] = round(cpu_serial, 1)
+    cpu_batch = bench_cpu_batch()
+    stages["cpu_batch64_sigs_per_sec"] = round(cpu_batch, 1)
 
     backend = "tpu"
     result = None
@@ -162,6 +248,15 @@ def main():
             break
         if name == "run":
             result = parsed["sigs_per_sec"]
+
+    if result is not None:
+        parsed, diag = _run_stage("p50", _STAGE_ENV_TPU, 600)
+        stages["tpu_p50"] = diag if parsed is None else parsed
+
+    # CPU-side p50s always run (serial CPU verifier — no kernel compile):
+    # BASELINE.md's comparison needs both backends from one bench run
+    parsed, diag = _run_stage("p50", _STAGE_ENV_CPU, 600)
+    stages["cpu_p50"] = diag if parsed is None else parsed
 
     if result is None:
         # TPU unavailable — same kernel on the host CPU platform so the
@@ -179,7 +274,9 @@ def main():
                 "metric": f"ed25519_batch_verify_throughput_{backend}",
                 "value": value,
                 "unit": "sigs/sec",
-                "vs_baseline": round(value / cpu_serial, 3) if cpu_serial else 0.0,
+                # the north-star comparison: vs the CPU BATCH baseline
+                "vs_baseline": round(value / cpu_batch, 3) if cpu_batch else 0.0,
+                "vs_serial": round(value / cpu_serial, 3) if cpu_serial else 0.0,
                 "stages": stages,
             }
         )
@@ -192,6 +289,7 @@ if __name__ == "__main__":
             "devices": _stage_devices,
             "compile": _stage_compile,
             "run": _stage_run,
+            "p50": _stage_p50,
         }[sys.argv[2]]()
     else:
         main()

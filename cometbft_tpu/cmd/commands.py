@@ -198,16 +198,18 @@ def cmd_testnet(args) -> int:
         ],
     )
 
+    # stride 2 per node: with the default bases (26656/26657) node i gets
+    # p2p 26656+2i and rpc 26657+2i — no cross-node collisions
     p2p_base, rpc_base = args.p2p_port, args.rpc_port
     peers = ",".join(
-        f"{node_keys[i].id()}@127.0.0.1:{p2p_base + i}" for i in range(n)
+        f"{node_keys[i].id()}@127.0.0.1:{p2p_base + 2 * i}" for i in range(n)
     )
     for i, home in enumerate(homes):
         cfg = default_config().set_root(home)
         cfg.base.proxy_app = args.proxy_app
         cfg.base.moniker = f"node{i}"
-        cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_base + i}"
-        cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_base + i}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_base + 2 * i}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_base + 2 * i}"
         cfg.p2p.persistent_peers = ",".join(
             p for j, p in enumerate(peers.split(",")) if j != i
         )

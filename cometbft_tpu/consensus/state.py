@@ -20,6 +20,7 @@ import time
 from typing import Callable, List, Optional
 
 from cometbft_tpu.config import ConsensusConfig
+from cometbft_tpu.crypto import batch as cryptobatch
 from cometbft_tpu.consensus.messages import (
     BlockPartMessage,
     EndHeightMessage,
@@ -100,6 +101,7 @@ class ConsensusState(BaseService):
 
         self.peer_msg_queue: "queue.Queue[MsgInfo]" = queue.Queue(maxsize=1000)
         self.internal_msg_queue: "queue.Queue[MsgInfo]" = queue.Queue(maxsize=1000)
+        self.n_batch_verify_calls = 0  # observability for the micro-batcher
         self.ticker = TimeoutTicker()
         self.wal = wal if wal is not None else NilWAL()
         self._wal_owned = wal is None
@@ -223,10 +225,87 @@ class ConsensusState(BaseService):
             if internal:
                 # own proposals/votes/parts must hit disk before the network
                 self.wal.write_sync(mi)
-            else:
-                self.wal.write(mi)
-            with self._mtx:
-                self._handle_msg(mi)
+                with self._mtx:
+                    self._handle_msg(mi)
+                continue
+            # micro-batching (north star, SURVEY §7 "latency vs throughput"):
+            # drain whatever else is already queued, batch-verify all the
+            # drained vote signatures in ONE BatchVerifier call (pure
+            # function, no state), then run the exact serial discipline per
+            # message: WAL-write it, process it. Interleaving is preserved —
+            # in particular #ENDHEIGHT lands between the message that
+            # finalized the commit and the next one, exactly as unbatched
+            # (crash replay depends on that ordering).
+            batch = self._drain_peer_queue(mi)
+            self._batch_preverify_votes(batch)
+            for m in batch:
+                if m.msg is None:  # txs-available poke drained mid-batch
+                    with self._mtx:
+                        self._handle_txs_available()
+                    continue
+                self.wal.write(m)
+                with self._mtx:
+                    self._handle_msg(m)
+
+    MAX_QUEUE_DRAIN = 1024
+
+    def _drain_peer_queue(self, first: MsgInfo) -> list:
+        """first + everything already sitting in the peer queue (bounded).
+        Order is preserved exactly — the WAL and the handlers see the same
+        sequence a serial loop would have."""
+        batch = [first]
+        while len(batch) < self.MAX_QUEUE_DRAIN:
+            try:
+                nxt = self.peer_msg_queue.get_nowait()
+            except queue.Empty:
+                break
+            batch.append(nxt)  # txs pokes (msg=None) stay in order
+        return batch
+
+    def _resolve_vote_target(self, vote: Vote):
+        """The VoteSet this vote would land in (mirrors _add_vote's routing)
+        or None when it can't be known without processing."""
+        rs = self.rs
+        if (
+            vote.height + 1 == rs.height
+            and vote.type == SIGNED_MSG_TYPE_PRECOMMIT
+        ):
+            return rs.last_commit
+        if vote.height == rs.height and rs.votes is not None:
+            return rs.votes._get_vote_set(vote.round, vote.type)
+        return None
+
+    def _batch_preverify_votes(self, batch: list) -> None:
+        """One BatchVerifier call covering every drained vote whose target
+        set and validator resolve cleanly; verified votes carry a marker
+        that lets VoteSet._add_vote skip its serial signature check. Any
+        vote that doesn't resolve (or fails) goes through the normal serial
+        path unchanged."""
+        entries = []  # (vote, chain_id, pub_key)
+        with self._mtx:
+            for m in batch:
+                if not isinstance(m.msg, VoteMessage) or m.msg.vote is None:
+                    continue
+                vote = m.msg.vote
+                if vote.validator_index < 0 or not vote.signature:
+                    continue
+                vs = self._resolve_vote_target(vote)
+                if vs is None:
+                    continue
+                addr, val = vs.val_set.get_by_index(vote.validator_index)
+                if val is None or addr != vote.validator_address:
+                    continue
+                entries.append((vote, vs.chain_id, val.pub_key))
+        if len(entries) < 2:
+            return  # nothing to batch; serial path handles singletons
+        bv = cryptobatch.new_batch_verifier()
+        for vote, chain_id, pub_key in entries:
+            bv.add(pub_key, vote.sign_bytes(chain_id), vote.signature)
+        self.n_batch_verify_calls += 1
+        _, mask = bv.verify()
+        for (vote, chain_id, pub_key), ok in zip(entries, mask):
+            if ok:
+                vote.sig_batch_verified = (chain_id, pub_key.bytes())
 
     def _handle_msg(self, mi: MsgInfo) -> None:
         msg, peer_id = mi.msg, mi.peer_id

@@ -48,9 +48,26 @@ def get_split_point(length: int) -> int:
     return bit
 
 
+# When enabled (enable_parallel), roots over >= this many leaves run on
+# the batched device kernel (crypto/tpu/merkle.py) — bit-identical output.
+_parallel_enabled = False
+_PARALLEL_MIN_LEAVES = 128
+
+
+def enable_parallel(enabled: bool = True) -> None:
+    """Route large hash_from_byte_slices calls through the TPU level-
+    parallel kernel (mega validator sets — SURVEY.md §7 stage 10)."""
+    global _parallel_enabled
+    _parallel_enabled = enabled
+
+
 def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     """Reference: crypto/merkle/tree.go:9 HashFromByteSlices."""
     n = len(items)
+    if _parallel_enabled and n >= _PARALLEL_MIN_LEAVES:
+        from cometbft_tpu.crypto.tpu import merkle as tpu_merkle
+
+        return tpu_merkle.hash_from_byte_slices(items)
     if n == 0:
         return empty_hash()
     if n == 1:

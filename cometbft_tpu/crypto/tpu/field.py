@@ -105,15 +105,11 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
     return _carry_round(_FOUR_P_COLS - a)
 
 
-def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook 17×17-limb multiply, all in native int32 lanes.
-
-    Limb products ≤ (2^15+127)^2 < 2^31 are exact in int32. Each product
-    splits into a 15-bit low part and a signed high part before column
-    accumulation, keeping columns ≤ 34·(2^15+2^8) < 2^21; the fold of
-    columns 17..33 (weight 2^255 ≡ 19) brings them to < 2^25 — the
-    _reduce precondition.
-    """
+def _mul_stack(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Outer-product form: materializes a [..., 17, 17] (and a stacked
+    [..., 34, 34]) intermediate per multiply — compact trace, but in a
+    long kernel each mul round-trips ~10 MB through HBM at batch 2048,
+    making every point operation bandwidth-bound."""
     prod = a[..., :, None] * b[..., None, :]  # [..., 17, 17]
     lo = prod & _MASK
     hi = prod >> RADIX
@@ -127,6 +123,41 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     cols = jnp.sum(jnp.stack(rows, axis=-2), axis=-2)
     folded = cols[..., :NUM_LIMBS] + 19 * cols[..., NUM_LIMBS:]
     return _reduce(folded)
+
+
+def _mul_shift_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Shift-accumulate form: 17 × (one [..., 17] vector product padded
+    into a [..., 34] accumulator). Largest live tensor is the accumulator
+    itself — the whole multiply stays fusable in registers/VMEM lanes, no
+    big HBM intermediates."""
+    width = 2 * NUM_LIMBS
+    batch_pad = [(0, 0)] * (a.ndim - 1)
+    acc = None
+    for i in range(NUM_LIMBS):
+        p = a[..., i : i + 1] * b  # [..., 17]
+        term = jnp.pad(p & _MASK, batch_pad + [(i, width - NUM_LIMBS - i)])
+        term = term + jnp.pad(
+            p >> RADIX, batch_pad + [(i + 1, width - NUM_LIMBS - i - 1)]
+        )
+        acc = term if acc is None else acc + term
+    folded = acc[..., :NUM_LIMBS] + 19 * acc[..., NUM_LIMBS:]
+    return _reduce(folded)
+
+
+# Limb products ≤ (2^15+127)^2 < 2^31 are exact in int32. Each product
+# splits into a 15-bit low part and a signed high part before column
+# accumulation, keeping columns ≤ 34·(2^15+2^8) < 2^21; the fold of
+# columns 17..33 (weight 2^255 ≡ 19) brings them to < 2^25 — the
+# _reduce precondition. Both implementations share this bound analysis.
+_MUL_IMPLS = {"stack": _mul_stack, "shift_add": _mul_shift_add}
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook 17×15-bit-limb multiply in native int32 lanes."""
+    import os
+
+    impl = _MUL_IMPLS.get(os.environ.get("CBFT_TPU_MUL", "shift_add"))
+    return (impl or _mul_shift_add)(a, b)
 
 
 def sq(a: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,98 @@
+"""TPU-parallel RFC-6962 Merkle root.
+
+Reference: crypto/merkle/tree.go:9 HashFromByteSlices — recursive,
+one stdlib SHA-256 call per node. Here every tree LEVEL is one batched
+device call: pairwise inner hashing with the odd tail carried up, which
+reproduces the reference's largest-power-of-two-split tree shape exactly
+(proved level-by-level: carrying the unpaired tail is equivalent to the
+recursive split for every n).
+
+Leaves are hashed on the host (variable length, C-speed hashlib); the
+N-1 inner nodes — fixed 65-byte messages — run through the JAX SHA-256
+kernel level by level. Level widths are padded to the next power of two
+so the jit cache holds ~log2(N) specializations total.
+
+Bit-identical to crypto.merkle.hash_from_byte_slices for every n
+(tests/test_tpu_merkle.py parity suite).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Sequence
+
+import numpy as np
+
+from cometbft_tpu.crypto.tpu import sha256 as tpu_sha
+
+_LEAF_PREFIX = b"\x00"
+_INNER_LEN = 65  # 0x01 || left32 || right32
+
+# device becomes worth the round-trip above this many leaves
+MIN_DEVICE_LEAVES = 128
+
+
+def _pad_pow2(n: int) -> int:
+    size = 1
+    while size < n:
+        size *= 2
+    return size
+
+
+def _inner_level_device(nodes: np.ndarray) -> np.ndarray:
+    """uint8[2k, 32] → uint8[k, 32]: one batched device call."""
+    k = nodes.shape[0] // 2
+    msgs = np.zeros((k, _INNER_LEN), np.uint8)
+    msgs[:, 0] = 0x01
+    msgs[:, 1:33] = nodes[0::2]
+    msgs[:, 33:65] = nodes[1::2]
+    padded = _pad_pow2(k)
+    blocks = np.zeros((padded, 2, 16), np.uint32)
+    blocks[:k] = tpu_sha.pad_messages_np(msgs, _INNER_LEN)
+    digests = tpu_sha.sha256_blocks(blocks)
+    return tpu_sha.digests_to_bytes_np(np.asarray(digests)[:k])
+
+
+def _inner_level_host(nodes: np.ndarray) -> np.ndarray:
+    k = nodes.shape[0] // 2
+    out = np.zeros((k, 32), np.uint8)
+    for i in range(k):
+        out[i] = np.frombuffer(
+            hashlib.sha256(
+                b"\x01" + nodes[2 * i].tobytes() + nodes[2 * i + 1].tobytes()
+            ).digest(),
+            np.uint8,
+        )
+    return out
+
+
+def hash_from_byte_slices(
+    items: Sequence[bytes], force_device: bool = False
+) -> bytes:
+    """Drop-in parallel replacement for
+    crypto.merkle.hash_from_byte_slices (tree.go:9)."""
+    n = len(items)
+    if n == 0:
+        return hashlib.sha256(b"").digest()
+    # leaf hashes on host: variable-length inputs, C-speed hashlib
+    level = np.zeros((n, 32), np.uint8)
+    for i, item in enumerate(items):
+        level[i] = np.frombuffer(
+            hashlib.sha256(_LEAF_PREFIX + bytes(item)).digest(), np.uint8
+        )
+    use_device = force_device or n >= MIN_DEVICE_LEAVES
+    while level.shape[0] > 1:
+        m = level.shape[0]
+        pairs = m - (m % 2)
+        hashed = (
+            _inner_level_device(level[:pairs])
+            if use_device and pairs >= 2
+            else _inner_level_host(level[:pairs])
+        )
+        if m % 2:
+            # odd tail carries up unhashed (== the reference's
+            # largest-power-of-two split shape)
+            level = np.concatenate([hashed, level[m - 1 :]], axis=0)
+        else:
+            level = hashed
+    return level[0].tobytes()

@@ -108,6 +108,13 @@ class Node(BaseService):
 
         db_provider = db_provider or default_db_provider
 
+        # [crypto] backend selects the verifier for EVERY default-backend
+        # call site: consensus vote micro-batching, block validation's
+        # VerifyCommit, evidence checks (blocksync gets it explicitly below)
+        from cometbft_tpu.crypto import batch as cryptobatch
+
+        cryptobatch.set_default_backend(config.crypto.backend)
+
         # 1. stores
         self.block_store = BlockStore(db_provider("blockstore", config))
         self.state_store = StateStore(db_provider("state", config))

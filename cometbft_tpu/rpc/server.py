@@ -380,6 +380,8 @@ class _WSConn:
                 except TimeoutError:
                     continue
                 except SubscriptionCancelled as exc:
+                    if query_str not in self._subs:
+                        return  # client unsubscribed deliberately: no error
                     # tell the client instead of going silent (the bus
                     # evicts subscribers that fall behind)
                     try:

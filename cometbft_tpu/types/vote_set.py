@@ -139,12 +139,18 @@ class VoteSet:
                 "non-deterministic signature: same vote signed twice "
                 "with different signatures"
             )
-        # verify signature (types/vote_set.go:205 -> vote.Verify)
+        # verify signature (types/vote_set.go:205 -> vote.Verify). The
+        # consensus receive loop may have batch-verified this signature
+        # already (one TPU call for a whole queue drain); the marker is only
+        # honored when it names EXACTLY the key+chain this set would check
+        # against, so a wrong resolution degrades to a serial verify.
         if verify:
-            try:
-                vote.verify(self.chain_id, val.pub_key)
-            except ValueError as e:
-                return False, f"failed to verify vote with ChainID {self.chain_id} and PubKey {val.pub_key}: {e}"
+            pre = getattr(vote, "sig_batch_verified", None)
+            if pre != (self.chain_id, val.pub_key.bytes()):
+                try:
+                    vote.verify(self.chain_id, val.pub_key)
+                except ValueError as e:
+                    return False, f"failed to verify vote with ChainID {self.chain_id} and PubKey {val.pub_key}: {e}"
         return self._add_verified_vote(vote, block_key, val.voting_power)
 
     def _add_verified_vote(
