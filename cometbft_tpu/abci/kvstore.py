@@ -259,3 +259,141 @@ class PersistentKVStoreApplication(KVStoreApplication):
                 value=abci.ValidatorUpdate(pk, 0).encode(),
             )
         return super().query(req)
+
+
+class SnapshotKVStoreApplication(PersistentKVStoreApplication):
+    """kvstore + state-sync snapshots — the statesync test app.
+
+    Reference model: test/e2e/app/{app.go,snapshots.go} — the app state is
+    serialized to JSON at every `snapshot_interval`-th commit, chunks are
+    fixed-size slices of that JSON, and restore concatenates the chunks and
+    imports them wholesale (app.go:240-257). Snapshot hash = sha256 of the
+    serialized state.
+    """
+
+    def __init__(
+        self,
+        db: Optional[DB] = None,
+        snapshot_interval: int = 0,
+        chunk_size: int = 1_000_000,
+    ):
+        super().__init__(db)
+        self.snapshot_interval = snapshot_interval
+        self.chunk_size = chunk_size
+        self._snapshots: List[abci.Snapshot] = []
+        self._snapshot_data: Dict[int, bytes] = {}  # height → serialized state
+        self._restore_snapshot: Optional[abci.Snapshot] = None
+        self._restore_chunks: List[bytes] = []
+
+    # -- export / import ----------------------------------------------------
+
+    def _export_state(self) -> bytes:
+        pairs = {}
+        for key, value in self.state.db.prefix_iterator(_KV_PREFIX):
+            pairs[base64.b64encode(key[len(_KV_PREFIX):]).decode()] = (
+                base64.b64encode(value).decode()
+            )
+        vals = {}
+        for key, raw in self.state.db.prefix_iterator(_VALIDATOR_PREFIX):
+            vals[key[len(_VALIDATOR_PREFIX):].decode()] = base64.b64encode(
+                raw
+            ).decode()
+        return json.dumps(
+            {
+                "height": self.state.height,
+                "size": self.state.size,
+                "app_hash": base64.b64encode(self.state.app_hash).decode(),
+                "pairs": pairs,
+                "validators": vals,
+            },
+            sort_keys=True,
+        ).encode()
+
+    def _import_state(self, height: int, data: bytes) -> None:
+        doc = json.loads(data)
+        if doc["height"] != height:
+            raise ValueError(
+                f"snapshot height mismatch: {doc['height']} != {height}"
+            )
+        for key, value in doc["pairs"].items():
+            self.state.db.set(
+                _KV_PREFIX + base64.b64decode(key), base64.b64decode(value)
+            )
+        for key, raw in doc["validators"].items():
+            self.state.db.set(
+                _VALIDATOR_PREFIX + key.encode(), base64.b64decode(raw)
+            )
+        self.state.height = doc["height"]
+        self.state.size = doc["size"]
+        self.state.app_hash = base64.b64decode(doc["app_hash"])
+        self.state.save()
+        self._load_validators()
+
+    # -- abci snapshot connection -------------------------------------------
+
+    def commit(self):
+        resp = super().commit()
+        if (
+            self.snapshot_interval > 0
+            and self.state.height % self.snapshot_interval == 0
+        ):
+            import hashlib
+            import math
+
+            data = self._export_state()
+            self._snapshot_data[self.state.height] = data
+            self._snapshots.append(
+                abci.Snapshot(
+                    height=self.state.height,
+                    format=1,
+                    chunks=max(1, math.ceil(len(data) / self.chunk_size)),
+                    hash=hashlib.sha256(data).digest(),
+                )
+            )
+            # only the most recent snapshots are ever advertised
+            # (statesync RECENT_SNAPSHOTS) — prune the rest
+            while len(self._snapshots) > 10:
+                old = self._snapshots.pop(0)
+                self._snapshot_data.pop(old.height, None)
+        return resp
+
+    def list_snapshots(self, req):
+        return abci.ResponseListSnapshots(snapshots=list(self._snapshots))
+
+    def load_snapshot_chunk(self, req):
+        data = self._snapshot_data.get(req.height)
+        if data is None or req.format != 1:
+            return abci.ResponseLoadSnapshotChunk(chunk=b"")
+        start = req.chunk * self.chunk_size
+        return abci.ResponseLoadSnapshotChunk(
+            chunk=data[start : start + self.chunk_size]
+        )
+
+    def offer_snapshot(self, req):
+        if self._restore_snapshot is not None:
+            # an abandoned partial restore (e.g. the syncer timed out on
+            # chunks and moved to another snapshot) must not poison every
+            # future offer — drop the stale attempt and take the new one
+            self._restore_snapshot = None
+            self._restore_chunks = []
+        if req.snapshot is None or req.snapshot.format != 1:
+            return abci.ResponseOfferSnapshot(
+                result=abci.OFFER_SNAPSHOT_REJECT_FORMAT
+            )
+        self._restore_snapshot = req.snapshot
+        self._restore_chunks = []
+        return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT)
+
+    def apply_snapshot_chunk(self, req):
+        if self._restore_snapshot is None:
+            raise RuntimeError("no restore in progress")
+        self._restore_chunks.append(req.chunk)
+        if len(self._restore_chunks) == self._restore_snapshot.chunks:
+            self._import_state(
+                self._restore_snapshot.height, b"".join(self._restore_chunks)
+            )
+            self._restore_snapshot = None
+            self._restore_chunks = []
+        return abci.ResponseApplySnapshotChunk(
+            result=abci.APPLY_CHUNK_ACCEPT
+        )

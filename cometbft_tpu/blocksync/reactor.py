@@ -96,15 +96,26 @@ class BlocksyncReactor(Reactor):
 
     def on_start(self) -> None:
         if self.fast_sync:
-            self.pool.start()
-            self._pool_thread = threading.Thread(
-                target=self._pool_routine, name="blocksync-pool", daemon=True
-            )
-            self._pool_thread.start()
+            self._start_pool()
 
     def on_stop(self) -> None:
         if self.pool.is_running():
             self.pool.stop()
+
+    def _start_pool(self) -> None:
+        self.pool.start()
+        self._pool_thread = threading.Thread(
+            target=self._pool_routine, name="blocksync-pool", daemon=True
+        )
+        self._pool_thread.start()
+
+    def switch_to_fast_sync(self, state) -> None:
+        """Called by the statesync reactor after a snapshot restore: resume
+        fast sync from the bootstrapped height (blockchain/v0/reactor.go:118)."""
+        self.fast_sync = True
+        self.initial_state = state
+        self.pool.height = state.last_block_height + 1
+        self._start_pool()
 
     def add_peer(self, peer: Peer) -> None:
         # tell the peer our range; it adds us to its pool on receipt

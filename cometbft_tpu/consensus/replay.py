@@ -148,6 +148,10 @@ class Handshaker:
             height=app_block_height,
             hash=app_hash.hex(),
         )
+        # only set the app version if there is no existing state
+        # (reference replay.go:263-265)
+        if self._initial_state.last_block_height == 0:
+            self._initial_state.version.consensus_app = res.app_version
         app_hash = self.replay_blocks(
             self._initial_state, app_hash, app_block_height, proxy_app
         )

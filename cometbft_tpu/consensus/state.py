@@ -81,10 +81,12 @@ class ConsensusState(BaseService):
         evpool=None,
         wal=None,
         event_bus=None,
+        crypto_backend: Optional[str] = None,
         logger: Optional[Logger] = None,
     ):
         super().__init__("ConsensusState")
         self.config = config
+        self.crypto_backend = crypto_backend
         self.block_exec = block_exec
         self.block_store = block_store
         self.tx_notifier = tx_notifier
@@ -298,7 +300,7 @@ class ConsensusState(BaseService):
                 entries.append((vote, vs.chain_id, val.pub_key))
         if len(entries) < 2:
             return  # nothing to batch; serial path handles singletons
-        bv = cryptobatch.new_batch_verifier()
+        bv = cryptobatch.new_batch_verifier(self.crypto_backend)
         for vote, chain_id, pub_key in entries:
             bv.add(pub_key, vote.sign_bytes(chain_id), vote.signature)
         self.n_batch_verify_calls += 1

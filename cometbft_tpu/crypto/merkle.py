@@ -48,10 +48,10 @@ def get_split_point(length: int) -> int:
     return bit
 
 
-# When enabled (enable_parallel), roots over >= this many leaves run on
-# the batched device kernel (crypto/tpu/merkle.py) — bit-identical output.
+# When enabled (enable_parallel), roots over >= MIN_DEVICE_LEAVES leaves
+# (the kernel's own threshold) run on the batched device kernel
+# (crypto/tpu/merkle.py) — bit-identical output.
 _parallel_enabled = False
-_PARALLEL_MIN_LEAVES = 128
 
 
 def enable_parallel(enabled: bool = True) -> None:
@@ -64,10 +64,11 @@ def enable_parallel(enabled: bool = True) -> None:
 def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     """Reference: crypto/merkle/tree.go:9 HashFromByteSlices."""
     n = len(items)
-    if _parallel_enabled and n >= _PARALLEL_MIN_LEAVES:
+    if _parallel_enabled:
         from cometbft_tpu.crypto.tpu import merkle as tpu_merkle
 
-        return tpu_merkle.hash_from_byte_slices(items)
+        if n >= tpu_merkle.MIN_DEVICE_LEAVES:
+            return tpu_merkle.hash_from_byte_slices(items)
     if n == 0:
         return empty_hash()
     if n == 1:

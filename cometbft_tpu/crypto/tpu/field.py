@@ -156,8 +156,14 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook 17×15-bit-limb multiply in native int32 lanes."""
     import os
 
-    impl = _MUL_IMPLS.get(os.environ.get("CBFT_TPU_MUL", "shift_add"))
-    return (impl or _mul_shift_add)(a, b)
+    name = os.environ.get("CBFT_TPU_MUL", "shift_add")
+    impl = _MUL_IMPLS.get(name)
+    if impl is None:
+        raise ValueError(
+            f"unknown CBFT_TPU_MUL={name!r}; choose from "
+            f"{sorted(_MUL_IMPLS)}"
+        )
+    return impl(a, b)
 
 
 def sq(a: jnp.ndarray) -> jnp.ndarray:

@@ -80,10 +80,12 @@ def hash_from_byte_slices(
         level[i] = np.frombuffer(
             hashlib.sha256(_LEAF_PREFIX + bytes(item)).digest(), np.uint8
         )
-    use_device = force_device or n >= MIN_DEVICE_LEAVES
     while level.shape[0] > 1:
         m = level.shape[0]
         pairs = m - (m % 2)
+        # per-level choice: the narrow levels near the root are cheaper on
+        # the host than a device dispatch round-trip
+        use_device = force_device or pairs >= MIN_DEVICE_LEAVES
         hashed = (
             _inner_level_device(level[:pairs])
             if use_device and pairs >= 2

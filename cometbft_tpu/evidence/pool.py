@@ -42,11 +42,13 @@ class Pool:
         db: DB,
         state_store,  # state.store.Store
         block_store,
+        crypto_backend: Optional[str] = None,
         logger: Optional[Logger] = None,
     ):
         self._db = db
         self._state_store = state_store
         self._block_store = block_store
+        self._crypto_backend = crypto_backend
         self._logger = logger or new_nop_logger()
 
         state = state_store.load()
@@ -270,7 +272,8 @@ class Pool:
                         )
             try:
                 verify_light_client_attack(
-                    ev, common_header, trusted_header, common_vals
+                    ev, common_header, trusted_header, common_vals,
+                    backend=self._crypto_backend,
                 )
             except ValueError as exc:
                 raise ErrInvalidEvidence(ev, str(exc)) from exc

@@ -69,6 +69,7 @@ def verify_light_client_attack(
     common_header,
     trusted_header,
     common_vals: ValidatorSet,
+    backend=None,
 ) -> None:
     """Reference: VerifyLightClientAttack :113 (trust-period expiry is the
     pool's recency check; not repeated here)."""
@@ -79,6 +80,7 @@ def verify_light_client_attack(
             trusted_header.header.chain_id,
             cb.signed_header.commit,
             DEFAULT_TRUST_LEVEL,
+            backend=backend,
         )
     else:
         if _conflicting_header_is_invalid(ev, trusted_header.header):
@@ -94,6 +96,7 @@ def verify_light_client_attack(
         cb.signed_header.commit.block_id,
         cb.signed_header.header.height,
         cb.signed_header.commit,
+        backend=backend,
     )
 
     if ev.total_voting_power != common_vals.total_voting_power():

@@ -96,5 +96,10 @@ class BlockStoreProvider(Provider):
     def report_evidence(self, ev) -> None:
         pass  # a local node learns about evidence through its own pool
 
+    def consensus_params(self, height: int):
+        """Serve consensus params for statesync's state provider
+        (reference analog: light/rpc/client.go ConsensusParams)."""
+        return self._state_store.load_consensus_params(height)
+
     def id(self) -> str:
         return f"blockstore-{self.chain_id}"

@@ -50,6 +50,8 @@ from cometbft_tpu.p2p.pex.reactor import PEX_CHANNEL, PEXReactor
 from cometbft_tpu.privval import load_or_gen_file_pv
 from cometbft_tpu.proxy import AppConns, new_app_conns
 from cometbft_tpu.state import State, make_genesis_state
+from cometbft_tpu.statesync.messages import CHUNK_CHANNEL, SNAPSHOT_CHANNEL
+from cometbft_tpu.statesync.reactor import StateSyncReactor
 from cometbft_tpu.state.execution import BlockExecutor
 from cometbft_tpu.state.store import Store as StateStore
 from cometbft_tpu.store import BlockStore
@@ -99,6 +101,7 @@ class Node(BaseService):
         client_creator,
         genesis_doc: GenesisDoc,
         db_provider=None,  # (name, config) -> DB
+        state_provider=None,  # statesync.StateProvider (when statesync on)
         logger: Optional[Logger] = None,
     ):
         super().__init__("Node", logger or new_nop_logger())
@@ -108,12 +111,10 @@ class Node(BaseService):
 
         db_provider = db_provider or default_db_provider
 
-        # [crypto] backend selects the verifier for EVERY default-backend
-        # call site: consensus vote micro-batching, block validation's
-        # VerifyCommit, evidence checks (blocksync gets it explicitly below)
-        from cometbft_tpu.crypto import batch as cryptobatch
-
-        cryptobatch.set_default_backend(config.crypto.backend)
+        # [crypto] backend is threaded explicitly to every consumer below —
+        # never set process-globally here, so in-process multi-node setups
+        # (tests, localnet runners) can mix backends. The CLI entrypoint
+        # (default_new_node) additionally sets the process default.
 
         # 1. stores
         self.block_store = BlockStore(db_provider("blockstore", config))
@@ -146,6 +147,11 @@ class Node(BaseService):
         fast_sync = config.base.fast_sync_mode and not _only_validator_is_us(
             state, pub_key
         )
+        # state sync only makes sense from an empty chain (node.go:791-799)
+        self.state_sync_enabled = (
+            config.statesync.enable and state.last_block_height == 0
+        )
+        self.state_provider = state_provider
 
         # 6. mempool
         self.mempool = CListMempool(
@@ -157,7 +163,7 @@ class Node(BaseService):
         # 7. evidence
         self.evidence_pool = EvidencePool(
             db_provider("evidence", config), self.state_store,
-            self.block_store,
+            self.block_store, crypto_backend=config.crypto.backend,
         )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
 
@@ -168,14 +174,26 @@ class Node(BaseService):
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
+            crypto_backend=config.crypto.backend,
             logger=self.logger,
         )
 
-        # 9. blocksync
+        # 9. blocksync — held back when statesync will bootstrap first
+        # (node.go:820: fastSync && !stateSync)
         self.blocksync_reactor = BlocksyncReactor(
             state, self.block_executor, self.block_store,
-            fast_sync=fast_sync,
+            fast_sync=fast_sync and not self.state_sync_enabled,
             crypto_backend=config.crypto.backend,
+            logger=self.logger,
+        )
+        self._fast_sync_after_statesync = fast_sync
+
+        # 9b. statesync (serving side always on; restore when enabled)
+        self.statesync_reactor = StateSyncReactor(
+            config.statesync,
+            self.proxy_app.snapshot(),
+            self.proxy_app.query(),
+            temp_dir=config.statesync.temp_dir or None,
             logger=self.logger,
         )
 
@@ -188,12 +206,15 @@ class Node(BaseService):
         self.consensus_state = ConsensusState(
             config.consensus, state, self.block_executor, self.block_store,
             tx_notifier=self.mempool, evpool=self.evidence_pool, wal=wal,
-            event_bus=self.event_bus, logger=self.logger,
+            event_bus=self.event_bus,
+            crypto_backend=config.crypto.backend, logger=self.logger,
         )
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
         self.consensus_reactor = ConsensusReactor(
-            self.consensus_state, wait_sync=fast_sync, logger=self.logger
+            self.consensus_state,
+            wait_sync=fast_sync or self.state_sync_enabled,
+            logger=self.logger,
         )
 
         # 11. p2p
@@ -214,6 +235,8 @@ class Node(BaseService):
                     VOTE_SET_BITS_CHANNEL,
                     MEMPOOL_CHANNEL,
                     EVIDENCE_CHANNEL,
+                    SNAPSHOT_CHANNEL,
+                    CHUNK_CHANNEL,
                 ]
                 + ([PEX_CHANNEL] if config.p2p.pex else [])
             ),
@@ -242,6 +265,7 @@ class Node(BaseService):
         self.switch.add_reactor("BLOCKSYNC", self.blocksync_reactor)
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
+        self.switch.add_reactor("STATESYNC", self.statesync_reactor)
 
         # 12. PEX + addrbook
         self.pex_reactor = None
@@ -294,6 +318,46 @@ class Node(BaseService):
         if self.rpc_server is not None:
             host, port = _parse_laddr(self.config.rpc.laddr)
             self.rpc_server.serve(host, port)
+        if self.state_sync_enabled:
+            self._start_state_sync()
+
+    def _start_state_sync(self) -> None:
+        """node.go:651 startStateSync — restore a snapshot asynchronously,
+        bootstrap the stores, then hand off to blocksync/consensus."""
+        if self.state_provider is None:
+            raise RuntimeError(
+                "statesync enabled but no state provider given; construct "
+                "the Node with state_provider=LightClientStateProvider(...)"
+            )
+        import threading
+
+        def run():
+            try:
+                state, commit = self.statesync_reactor.sync(
+                    self.state_provider,
+                    self.config.statesync.discovery_time_ns / 1e9,
+                )
+            except Exception as exc:
+                self.logger.error("state sync failed", err=str(exc))
+                return
+            try:
+                self.state_store.bootstrap(state)
+                self.block_store.save_seen_commit(
+                    state.last_block_height, commit
+                )
+            except Exception as exc:
+                self.logger.error(
+                    "failed to bootstrap node with new state", err=str(exc)
+                )
+                return
+            if self._fast_sync_after_statesync:
+                self.blocksync_reactor.switch_to_fast_sync(state)
+            else:
+                self.consensus_reactor.switch_to_consensus(state, True)
+
+        threading.Thread(
+            target=run, name="statesync", daemon=True
+        ).start()
 
     def on_stop(self) -> None:
         for svc in (
@@ -344,6 +408,13 @@ def default_db_provider(name: str, config: Config) -> DB:
 def default_new_node(config: Config, logger: Optional[Logger] = None) -> Node:
     """Reference: node/node.go:100 DefaultNewNode — everything from files
     under the config root."""
+    # one node per process here, so the process-wide default backend can
+    # follow [crypto] — programmatic multi-node embedders get per-node
+    # threading through the constructors instead
+    from cometbft_tpu.crypto import batch as cryptobatch
+
+    cryptobatch.set_default_backend(config.crypto.backend)
+
     node_key = NodeKey.load_or_gen(
         os.path.join(config.root_dir, config.base.node_key_file)
     )

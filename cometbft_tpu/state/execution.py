@@ -78,10 +78,12 @@ class BlockExecutor:
         mempool=None,
         evidence_pool=None,
         event_bus=None,
+        crypto_backend: Optional[str] = None,
         logger: Optional[Logger] = None,
     ):
         self._store = state_store
         self._proxy_app = proxy_app
+        self._crypto_backend = crypto_backend
         self._mempool = mempool if mempool is not None else EmptyMempool()
         self._evpool = (
             evidence_pool if evidence_pool is not None else EmptyEvidencePool()
@@ -115,7 +117,7 @@ class BlockExecutor:
 
     def validate_block(self, state: State, block: Block) -> None:
         """Reference: state/execution.go:117-129 (hashes + evidence pool)."""
-        validate_block(state, block)
+        validate_block(state, block, backend=self._crypto_backend)
         self._evpool.check_evidence(block.evidence)
 
     # -- apply --------------------------------------------------------------

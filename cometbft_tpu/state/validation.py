@@ -12,7 +12,7 @@ from cometbft_tpu.state import State
 from cometbft_tpu.types.block import Block
 
 
-def validate_block(state: State, block: Block) -> None:
+def validate_block(state: State, block: Block, backend=None) -> None:
     """Raises ValueError on the first violation (error strings mirror the
     reference's so tests can assert on them)."""
     block.validate_basic()
@@ -76,6 +76,7 @@ def validate_block(state: State, block: Block) -> None:
             state.last_block_id,
             block.header.height - 1,
             block.last_commit,
+            backend=backend,
         )
 
     if len(h.proposer_address) != 20 or not state.validators.has_address(

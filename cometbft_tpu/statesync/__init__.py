@@ -1,0 +1,69 @@
+"""State sync — bootstrap a fresh node from an application snapshot.
+
+Reference: /root/reference/statesync/. A syncing node discovers snapshots
+from peers (channel 0x60), fetches chunks (channel 0x61), restores them
+into the app via the ABCI snapshot connection, verifies the result against
+light-client-trusted headers, and hands off to blocksync → consensus
+(node/node.go:651-706).
+"""
+
+from cometbft_tpu.statesync.chunks import (
+    Chunk,
+    ChunkQueue,
+    ErrChunkQueueDone,
+    ErrChunkTimeout,
+)
+from cometbft_tpu.statesync.messages import (
+    CHUNK_CHANNEL,
+    SNAPSHOT_CHANNEL,
+    ChunkRequest,
+    ChunkResponse,
+    SnapshotsRequest,
+    SnapshotsResponse,
+    decode_statesync_message,
+    encode_statesync_message,
+)
+from cometbft_tpu.statesync.reactor import StateSyncReactor
+from cometbft_tpu.statesync.snapshots import Snapshot, SnapshotPool
+from cometbft_tpu.statesync.stateprovider import (
+    LightClientStateProvider,
+    StateProvider,
+)
+from cometbft_tpu.statesync.syncer import (
+    ErrAbort,
+    ErrNoSnapshots,
+    ErrRejectFormat,
+    ErrRejectSender,
+    ErrRejectSnapshot,
+    ErrRetrySnapshot,
+    ErrVerifyFailed,
+    Syncer,
+)
+
+__all__ = [
+    "Chunk",
+    "ChunkQueue",
+    "ChunkRequest",
+    "ChunkResponse",
+    "CHUNK_CHANNEL",
+    "ErrAbort",
+    "ErrChunkQueueDone",
+    "ErrChunkTimeout",
+    "ErrNoSnapshots",
+    "ErrRejectFormat",
+    "ErrRejectSender",
+    "ErrRejectSnapshot",
+    "ErrRetrySnapshot",
+    "ErrVerifyFailed",
+    "LightClientStateProvider",
+    "Snapshot",
+    "SnapshotPool",
+    "SnapshotsRequest",
+    "SnapshotsResponse",
+    "SNAPSHOT_CHANNEL",
+    "StateProvider",
+    "StateSyncReactor",
+    "Syncer",
+    "decode_statesync_message",
+    "encode_statesync_message",
+]

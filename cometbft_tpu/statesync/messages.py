@@ -1,0 +1,203 @@
+"""Statesync wire messages — channels 0x60 (snapshots) and 0x61 (chunks).
+
+Reference: statesync/messages.go + proto/tendermint/statesync/types.proto:
+Message{oneof sum: SnapshotsRequest=1, SnapshotsResponse=2, ChunkRequest=3,
+ChunkResponse=4}. Size limits follow statesync/messages.go (snapshotMsgSize /
+chunkMsgSize).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from cometbft_tpu.libs import protoio
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+
+# reference statesync/messages.go:16-21
+SNAPSHOT_MSG_SIZE = 4 * 10**6  # 4MB
+CHUNK_MSG_SIZE = 16 * 10**6  # 16MB
+
+
+@dataclass
+class SnapshotsRequest:
+    def encode(self) -> bytes:
+        return b""
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SnapshotsRequest":
+        return cls()
+
+    def validate(self) -> None:
+        pass
+
+
+@dataclass
+class SnapshotsResponse:
+    height: int = 0
+    format: int = 0
+    chunks: int = 0
+    hash: bytes = b""
+    metadata: bytes = b""
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.height:
+            out += protoio.field_varint(1, self.height)
+        if self.format:
+            out += protoio.field_varint(2, self.format)
+        if self.chunks:
+            out += protoio.field_varint(3, self.chunks)
+        if self.hash:
+            out += protoio.field_bytes(4, self.hash)
+        if self.metadata:
+            out += protoio.field_bytes(5, self.metadata)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SnapshotsResponse":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            elif f == 2:
+                out.format = r.read_varint()
+            elif f == 3:
+                out.chunks = r.read_varint()
+            elif f == 4:
+                out.hash = r.read_bytes()
+            elif f == 5:
+                out.metadata = r.read_bytes()
+            else:
+                r.skip(wt)
+        return out
+
+    def validate(self) -> None:
+        # reference messages.go validateMsg: height > 0, hash non-empty
+        if self.height == 0:
+            raise ValueError("snapshot has no height")
+        if not self.hash:
+            raise ValueError("snapshot has no hash")
+        if self.chunks == 0:
+            raise ValueError("snapshot has no chunks")
+
+
+@dataclass
+class ChunkRequest:
+    height: int = 0
+    format: int = 0
+    index: int = 0
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.height:
+            out += protoio.field_varint(1, self.height)
+        if self.format:
+            out += protoio.field_varint(2, self.format)
+        if self.index:
+            out += protoio.field_varint(3, self.index)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ChunkRequest":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            elif f == 2:
+                out.format = r.read_varint()
+            elif f == 3:
+                out.index = r.read_varint()
+            else:
+                r.skip(wt)
+        return out
+
+    def validate(self) -> None:
+        if self.height == 0:
+            raise ValueError("chunk request has no height")
+
+
+@dataclass
+class ChunkResponse:
+    height: int = 0
+    format: int = 0
+    index: int = 0
+    chunk: bytes = b""
+    missing: bool = False
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.height:
+            out += protoio.field_varint(1, self.height)
+        if self.format:
+            out += protoio.field_varint(2, self.format)
+        if self.index:
+            out += protoio.field_varint(3, self.index)
+        if self.chunk:
+            out += protoio.field_bytes(4, self.chunk)
+        if self.missing:
+            out += protoio.field_varint(5, 1)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ChunkResponse":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.height = r.read_varint()
+            elif f == 2:
+                out.format = r.read_varint()
+            elif f == 3:
+                out.index = r.read_varint()
+            elif f == 4:
+                out.chunk = r.read_bytes()
+            elif f == 5:
+                out.missing = bool(r.read_varint())
+            else:
+                r.skip(wt)
+        return out
+
+    def validate(self) -> None:
+        # reference messages.go: height > 0; missing XOR chunk
+        if self.height == 0:
+            raise ValueError("chunk response has no height")
+        if self.missing and self.chunk:
+            raise ValueError("chunk response cannot be both missing and have a body")
+        if not self.missing and not self.chunk:
+            raise ValueError("chunk response without a chunk body")
+
+
+_BY_FIELD = {
+    1: SnapshotsRequest,
+    2: SnapshotsResponse,
+    3: ChunkRequest,
+    4: ChunkResponse,
+}
+_FIELD_BY_TYPE = {cls: num for num, cls in _BY_FIELD.items()}
+
+
+def encode_statesync_message(msg) -> bytes:
+    num = _FIELD_BY_TYPE.get(type(msg))
+    if num is None:
+        raise ValueError(f"unknown statesync message {type(msg)}")
+    return protoio.field_message(num, msg.encode())
+
+
+def decode_statesync_message(data: bytes):
+    r = protoio.WireReader(data)
+    while not r.at_end():
+        f, wt = r.read_tag()
+        cls = _BY_FIELD.get(f)
+        if cls is not None:
+            msg = cls.decode(r.read_bytes())
+            msg.validate()
+            return msg
+        r.skip(wt)
+    raise ValueError("empty statesync Message")

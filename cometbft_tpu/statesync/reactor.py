@@ -1,0 +1,291 @@
+"""Statesync reactor — serves snapshots to peers and drives local restore.
+
+Reference: statesync/reactor.go — channel 0x60 carries snapshot metadata
+(SnapshotsRequest answered with up to 10 recent snapshots from ABCI
+ListSnapshots, :120-167,246-278), channel 0x61 carries chunk bodies
+(ChunkRequest answered via ABCI LoadSnapshotChunk, :169-221). Sync (:282)
+installs a syncer, broadcasts discovery requests, and returns the trusted
+state + commit for the node to bootstrap with.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs.log import Logger
+from cometbft_tpu.p2p.base_reactor import Reactor
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.peer import Peer
+from cometbft_tpu.state import State
+from cometbft_tpu.statesync.chunks import Chunk
+from cometbft_tpu.statesync.messages import (
+    CHUNK_CHANNEL,
+    CHUNK_MSG_SIZE,
+    SNAPSHOT_CHANNEL,
+    SNAPSHOT_MSG_SIZE,
+    ChunkRequest,
+    ChunkResponse,
+    SnapshotsRequest,
+    SnapshotsResponse,
+    decode_statesync_message,
+    encode_statesync_message,
+)
+from cometbft_tpu.statesync.snapshots import RECENT_SNAPSHOTS, Snapshot
+from cometbft_tpu.statesync.stateprovider import StateProvider
+from cometbft_tpu.statesync.syncer import Syncer
+from cometbft_tpu.types.block import Commit
+
+
+class StateSyncReactor(Reactor):
+    def __init__(
+        self,
+        config,  # config.StateSyncConfig
+        conn,  # proxy.AppConnSnapshot
+        conn_query,  # proxy.AppConnQuery
+        temp_dir: Optional[str] = None,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("StateSync", logger)
+        self.config = config
+        self.conn = conn
+        self.conn_query = conn_query
+        self.temp_dir = temp_dir
+        self._mtx = threading.Lock()
+        self._syncer: Optional[Syncer] = None
+
+    def on_stop(self) -> None:
+        # abort an in-flight restore so the statesync thread exits with the
+        # node instead of broadcasting on a stopped switch forever
+        with self._mtx:
+            syncer = self._syncer
+        if syncer is not None:
+            syncer.stop()
+
+    # -- Reactor interface -----------------------------------------------------
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        return [
+            ChannelDescriptor(
+                id=SNAPSHOT_CHANNEL,
+                priority=5,
+                send_queue_capacity=10,
+                recv_message_capacity=SNAPSHOT_MSG_SIZE,
+            ),
+            ChannelDescriptor(
+                id=CHUNK_CHANNEL,
+                priority=3,
+                send_queue_capacity=10,
+                recv_message_capacity=CHUNK_MSG_SIZE,
+            ),
+        ]
+
+    def add_peer(self, peer: Peer) -> None:
+        with self._mtx:
+            syncing = self._syncer is not None
+        if syncing:
+            # ask every new peer what snapshots it has (syncer.go:125-134)
+            peer.send(
+                SNAPSHOT_CHANNEL,
+                encode_statesync_message(SnapshotsRequest()),
+            )
+
+    def remove_peer(self, peer: Peer, reason) -> None:
+        with self._mtx:
+            syncer = self._syncer
+        if syncer is not None:
+            syncer.remove_peer(peer.id())
+
+    def receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        if not self.is_running():
+            return
+        try:
+            msg = decode_statesync_message(msg_bytes)
+        except Exception as exc:
+            self.logger.error("invalid message", peer=peer.id(), err=str(exc))
+            self.switch.stop_peer_for_error(peer, exc)
+            return
+
+        if ch_id == SNAPSHOT_CHANNEL:
+            if isinstance(msg, SnapshotsRequest):
+                self._serve_snapshots(peer)
+            elif isinstance(msg, SnapshotsResponse):
+                with self._mtx:
+                    syncer = self._syncer
+                if syncer is None:
+                    self.logger.debug(
+                        "received unexpected snapshot, no sync in progress"
+                    )
+                    return
+                try:
+                    syncer.add_snapshot(
+                        peer.id(),
+                        Snapshot(
+                            height=msg.height,
+                            format=msg.format,
+                            chunks=msg.chunks,
+                            hash=msg.hash,
+                            metadata=msg.metadata,
+                        ),
+                    )
+                except Exception as exc:
+                    self.logger.error(
+                        "failed to add snapshot",
+                        height=msg.height,
+                        err=str(exc),
+                    )
+        elif ch_id == CHUNK_CHANNEL:
+            if isinstance(msg, ChunkRequest):
+                self._serve_chunk(peer, msg)
+            elif isinstance(msg, ChunkResponse):
+                if msg.missing:
+                    return
+                with self._mtx:
+                    syncer = self._syncer
+                if syncer is None:
+                    self.logger.debug(
+                        "received unexpected chunk, no sync in progress"
+                    )
+                    return
+                try:
+                    syncer.add_chunk(
+                        Chunk(
+                            height=msg.height,
+                            format=msg.format,
+                            index=msg.index,
+                            chunk=msg.chunk,
+                            sender=peer.id(),
+                        )
+                    )
+                except Exception as exc:
+                    self.logger.error(
+                        "failed to add chunk", chunk=msg.index, err=str(exc)
+                    )
+        else:
+            self.logger.error("received message on invalid channel", ch=ch_id)
+
+    # -- serving side ----------------------------------------------------------
+
+    def _serve_snapshots(self, peer: Peer) -> None:
+        try:
+            snapshots = self.recent_snapshots(RECENT_SNAPSHOTS)
+        except Exception as exc:
+            self.logger.error("failed to fetch snapshots", err=str(exc))
+            return
+        for s in snapshots:
+            self.logger.debug(
+                "advertising snapshot", height=s.height, peer=peer.id()
+            )
+            peer.send(
+                SNAPSHOT_CHANNEL,
+                encode_statesync_message(
+                    SnapshotsResponse(
+                        height=s.height,
+                        format=s.format,
+                        chunks=s.chunks,
+                        hash=s.hash,
+                        metadata=s.metadata,
+                    )
+                ),
+            )
+
+    def _serve_chunk(self, peer: Peer, msg: ChunkRequest) -> None:
+        try:
+            resp = self.conn.load_snapshot_chunk_sync(
+                abci.RequestLoadSnapshotChunk(
+                    height=msg.height, format=msg.format, chunk=msg.index
+                )
+            )
+        except Exception as exc:
+            self.logger.error(
+                "failed to load chunk", chunk=msg.index, err=str(exc)
+            )
+            return
+        peer.send(
+            CHUNK_CHANNEL,
+            encode_statesync_message(
+                ChunkResponse(
+                    height=msg.height,
+                    format=msg.format,
+                    index=msg.index,
+                    chunk=resp.chunk,
+                    missing=not resp.chunk,
+                )
+            ),
+        )
+
+    def recent_snapshots(self, n: int) -> List[Snapshot]:
+        resp = self.conn.list_snapshots_sync(abci.RequestListSnapshots())
+        snapshots = sorted(
+            resp.snapshots, key=lambda s: (s.height, s.format), reverse=True
+        )
+        return [
+            Snapshot(
+                height=s.height,
+                format=s.format,
+                chunks=s.chunks,
+                hash=s.hash,
+                metadata=s.metadata,
+            )
+            for s in snapshots[:n]
+        ]
+
+    # -- local restore ---------------------------------------------------------
+
+    def sync(
+        self, state_provider: StateProvider, discovery_time: float
+    ) -> Tuple[State, Commit]:
+        """Run a state sync, returning the new state and last commit at the
+        snapshot height. The caller must bootstrap the state store and save
+        the commit in the block store."""
+        with self._mtx:
+            if self._syncer is not None:
+                raise RuntimeError("a state sync is already in progress")
+            self._syncer = Syncer(
+                state_provider,
+                self.conn,
+                self.conn_query,
+                temp_dir=self.temp_dir,
+                chunk_fetchers=getattr(self.config, "chunk_fetchers", 4),
+                retry_timeout=getattr(
+                    self.config, "chunk_request_timeout_ns", 10_000_000_000
+                )
+                / 1e9,
+                request_snapshots=self._broadcast_snapshots_request,
+                send_chunk_request=self._send_chunk_request,
+                logger=self.logger,
+            )
+            syncer = self._syncer
+
+        try:
+            self._broadcast_snapshots_request()
+            state, commit, _snapshot = syncer.sync_any(discovery_time)
+            return state, commit
+        finally:
+            with self._mtx:
+                self._syncer = None
+
+    def _broadcast_snapshots_request(self) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(
+                SNAPSHOT_CHANNEL,
+                encode_statesync_message(SnapshotsRequest()),
+            )
+
+    def _send_chunk_request(
+        self, peer_id: str, snapshot: Snapshot, index: int
+    ) -> None:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is None:
+            return
+        peer.send(
+            CHUNK_CHANNEL,
+            encode_statesync_message(
+                ChunkRequest(
+                    height=snapshot.height,
+                    format=snapshot.format,
+                    index=index,
+                )
+            ),
+        )

@@ -1,0 +1,133 @@
+"""Snapshot discovery pool.
+
+Reference: statesync/snapshots.go — snapshots are keyed by the sha256 of
+(height, format, chunks, hash, metadata) so non-deterministic snapshots from
+different peers stay distinct (:30-39); Ranked() prefers greatest height,
+then format, then peer count (:158-188); rejected snapshots/formats/peers are
+blacklisted forever (:190-221).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+RECENT_SNAPSHOTS = 10  # max snapshots advertised/accepted per peer (reactor.go:26)
+
+
+@dataclass
+class Snapshot:
+    height: int = 0
+    format: int = 0
+    chunks: int = 0
+    hash: bytes = b""
+    metadata: bytes = b""
+    trusted_app_hash: bytes = b""  # populated by the light client
+
+    def key(self) -> bytes:
+        h = hashlib.sha256()
+        h.update(f"{self.height}:{self.format}:{self.chunks}".encode())
+        h.update(self.hash)
+        h.update(self.metadata)
+        return h.digest()
+
+
+class SnapshotPool:
+    """Aggregates snapshots across peers, with per-item blacklists."""
+
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self._snapshots: Dict[bytes, Snapshot] = {}
+        self._snapshot_peers: Dict[bytes, Set[str]] = {}
+        self._peer_index: Dict[str, Set[bytes]] = {}
+        self._format_blacklist: Set[int] = set()
+        self._peer_blacklist: Set[str] = set()
+        self._snapshot_blacklist: Set[bytes] = set()
+
+    def add(self, peer_id: str, snapshot: Snapshot) -> bool:
+        key = snapshot.key()
+        with self._mtx:
+            if snapshot.format in self._format_blacklist:
+                return False
+            if peer_id in self._peer_blacklist:
+                return False
+            if key in self._snapshot_blacklist:
+                return False
+            if len(self._peer_index.get(peer_id, ())) >= RECENT_SNAPSHOTS:
+                return False
+            self._snapshot_peers.setdefault(key, set()).add(peer_id)
+            self._peer_index.setdefault(peer_id, set()).add(key)
+            if key in self._snapshots:
+                return False
+            self._snapshots[key] = snapshot
+            return True
+
+    def best(self) -> Optional[Snapshot]:
+        ranked = self.ranked()
+        return ranked[0] if ranked else None
+
+    def ranked(self) -> List[Snapshot]:
+        with self._mtx:
+            candidates = list(self._snapshots.items())
+            candidates.sort(
+                key=lambda kv: (
+                    kv[1].height,
+                    kv[1].format,
+                    len(self._snapshot_peers.get(kv[0], ())),
+                ),
+                reverse=True,
+            )
+            return [s for _, s in candidates]
+
+    def get_peer(self, snapshot: Snapshot) -> Optional[str]:
+        peers = self.get_peers(snapshot)
+        return random.choice(peers) if peers else None
+
+    def get_peers(self, snapshot: Snapshot) -> List[str]:
+        with self._mtx:
+            return sorted(self._snapshot_peers.get(snapshot.key(), ()))
+
+    def reject(self, snapshot: Snapshot) -> None:
+        key = snapshot.key()
+        with self._mtx:
+            self._snapshot_blacklist.add(key)
+            self._remove_snapshot(key)
+
+    def reject_format(self, format: int) -> None:
+        with self._mtx:
+            self._format_blacklist.add(format)
+            for key in [
+                k for k, s in self._snapshots.items() if s.format == format
+            ]:
+                self._remove_snapshot(key)
+
+    def reject_peer(self, peer_id: str) -> None:
+        if not peer_id:
+            return
+        with self._mtx:
+            self._remove_peer(peer_id)
+            self._peer_blacklist.add(peer_id)
+
+    def remove_peer(self, peer_id: str) -> None:
+        with self._mtx:
+            self._remove_peer(peer_id)
+
+    def _remove_peer(self, peer_id: str) -> None:
+        for key in self._peer_index.pop(peer_id, set()):
+            peers = self._snapshot_peers.get(key)
+            if peers is not None:
+                peers.discard(peer_id)
+                if not peers:
+                    self._remove_snapshot(key)
+
+    def _remove_snapshot(self, key: bytes) -> None:
+        snapshot = self._snapshots.pop(key, None)
+        if snapshot is None:
+            return
+        for peer_id in self._snapshot_peers.pop(key, set()):
+            index = self._peer_index.get(peer_id)
+            if index is not None:
+                index.discard(key)

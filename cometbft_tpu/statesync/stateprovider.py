@@ -1,0 +1,139 @@
+"""Trusted state providers for bootstrapping a state-synced node.
+
+Reference: statesync/stateprovider.go — the provider builds the `sm.State`
+object (not the app state) at the snapshot height using light-client
+verification: AppHash(H) comes from the verified header at H+1 (:89-111),
+and State(H) stitches validators from the verified blocks at H/H+1/H+2
+(:125-192). Consensus params ride the primary provider under light-client
+trust (:173-189, via light/rpc); here the Provider interface exposes them
+directly (`consensus_params`).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.light.client import Client as LightClient
+from cometbft_tpu.light.client import TrustOptions
+from cometbft_tpu.light.provider import Provider
+from cometbft_tpu.light.store import DBStore
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.state import State, StateVersion
+from cometbft_tpu.types.block import Commit
+
+
+def _now() -> Timestamp:
+    import time
+
+    ns = time.time_ns()
+    return Timestamp(ns // 1_000_000_000, ns % 1_000_000_000)
+
+
+class StateProvider:
+    """Provider of trusted state data for bootstrapping a node."""
+
+    def app_hash(self, height: int) -> bytes:
+        raise NotImplementedError
+
+    def commit(self, height: int) -> Commit:
+        raise NotImplementedError
+
+    def state(self, height: int) -> State:
+        raise NotImplementedError
+
+
+class LightClientStateProvider(StateProvider):
+    """StateProvider using a light client over ≥2 providers.
+
+    The reference takes RPC server addresses and wraps them in HTTP
+    providers (stateprovider.go:48-86); here any `light.Provider` works —
+    in-process BlockStoreProviders for tests, HTTP providers against a
+    live RPC later. The primary must also implement
+    `consensus_params(height)` (BlockStoreProvider does).
+    """
+
+    def __init__(
+        self,
+        chain_id: str,
+        version: StateVersion,
+        initial_height: int,
+        providers: List[Provider],
+        trust_options: TrustOptions,
+        crypto_backend: Optional[str] = None,
+        logger=None,
+    ):
+        if len(providers) < 2:
+            raise ValueError(
+                f"at least 2 light-client providers are required, "
+                f"got {len(providers)}"
+            )
+        self._mtx = threading.Lock()  # light.Client is not concurrency-safe
+        self._version = version
+        self._initial_height = initial_height or 1
+        self._primary = providers[0]
+        self._lc = LightClient(
+            chain_id,
+            trust_options,
+            providers[0],
+            providers[1:],
+            DBStore(MemDB()),
+            crypto_backend=crypto_backend,
+            logger=logger,
+        )
+
+    def app_hash(self, height: int) -> bytes:
+        with self._mtx:
+            # the header at H+1 contains the app hash after H was committed
+            header = self._lc.verify_light_block_at_height(height + 1, _now())
+            # also pre-verify H and H+2, needed when building State() — this
+            # fails fast if the source chain hasn't grown past H+2 yet
+            # (stateprovider.go:98-109)
+            self._lc.verify_light_block_at_height(height + 2, _now())
+            return header.signed_header.header.app_hash
+
+    def commit(self, height: int) -> Commit:
+        with self._mtx:
+            lb = self._lc.verify_light_block_at_height(height, _now())
+            return lb.signed_header.commit
+
+    def state(self, height: int) -> State:
+        with self._mtx:
+            # snapshot height H = last block; H+1 = first block we'll
+            # process; H+2 carries the validator set that takes effect
+            # two heights after any change at H (stateprovider.go:138-146)
+            last_lb = self._lc.verify_light_block_at_height(height, _now())
+            curr_lb = self._lc.verify_light_block_at_height(height + 1, _now())
+            next_lb = self._lc.verify_light_block_at_height(height + 2, _now())
+
+            state = State()
+            state.chain_id = self._lc.chain_id
+            state.initial_height = self._initial_height
+            curr_header = curr_lb.signed_header.header
+            state.version = StateVersion(
+                consensus_block=curr_header.version.block,
+                consensus_app=curr_header.version.app,
+                software=self._version.software,
+            )
+            last_header = last_lb.signed_header.header
+            state.last_block_height = last_header.height
+            state.last_block_time = last_header.time
+            state.last_block_id = last_lb.signed_header.commit.block_id
+            state.app_hash = curr_header.app_hash
+            state.last_results_hash = curr_header.last_results_hash
+            state.last_validators = last_lb.validator_set
+            state.validators = curr_lb.validator_set
+            state.next_validators = next_lb.validator_set
+            state.last_height_validators_changed = next_lb.height
+
+            if not hasattr(self._primary, "consensus_params"):
+                raise RuntimeError(
+                    "primary light-client provider cannot serve consensus "
+                    "params"
+                )
+            state.consensus_params = self._primary.consensus_params(
+                curr_header.height
+            )
+            state.last_height_consensus_params_changed = curr_header.height
+            return state

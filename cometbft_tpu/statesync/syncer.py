@@ -1,0 +1,406 @@
+"""State-sync syncer — restores an application snapshot fetched from peers.
+
+Reference: statesync/syncer.go. SyncAny (:145) loops over the snapshot
+pool's best candidate, mapping app responses to retry/reject decisions
+(:186-236); Sync (:241) verifies the snapshot against the light client
+(trusted app hash), offers it via ABCI OfferSnapshot (:322), spawns chunk
+fetchers (:415), applies chunks via ApplySnapshotChunk (:358) honoring the
+app's refetch/reject-sender directives, and finally cross-checks the
+restored app's Info against the trusted state (:485).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.state import State
+from cometbft_tpu.statesync.chunks import (
+    Chunk,
+    ChunkQueue,
+    ErrChunkQueueDone,
+    ErrChunkTimeout,
+)
+from cometbft_tpu.statesync.snapshots import Snapshot, SnapshotPool
+from cometbft_tpu.statesync.stateprovider import StateProvider
+from cometbft_tpu.types.block import Commit
+
+MINIMUM_DISCOVERY_TIME = 5.0  # reference syncer.go:28
+
+
+class ErrAbort(Exception):
+    """Snapshot restoration aborted by the app."""
+
+
+class ErrRetrySnapshot(Exception):
+    """The app asked to retry the same snapshot."""
+
+
+class ErrRejectSnapshot(Exception):
+    """The app (or verification) rejected the snapshot."""
+
+
+class ErrRejectFormat(Exception):
+    """The app rejected the snapshot format."""
+
+
+class ErrRejectSender(Exception):
+    """The app rejected the snapshot's senders."""
+
+
+class ErrVerifyFailed(Exception):
+    """App hash or last-height verification failed after restore."""
+
+
+class ErrNoSnapshots(Exception):
+    """No suitable snapshots found and discovery is disabled."""
+
+
+class Syncer:
+    def __init__(
+        self,
+        state_provider: StateProvider,
+        conn,  # proxy.AppConnSnapshot
+        conn_query,  # proxy.AppConnQuery
+        temp_dir: Optional[str] = None,
+        chunk_fetchers: int = 4,
+        retry_timeout: float = 1.0,
+        chunk_timeout: float = 120.0,
+        request_snapshots: Optional[Callable[[], None]] = None,
+        send_chunk_request: Optional[Callable[[str, Snapshot, int], None]] = None,
+        logger: Optional[Logger] = None,
+    ):
+        self.state_provider = state_provider
+        self.conn = conn
+        self.conn_query = conn_query
+        self.snapshots = SnapshotPool()
+        self.temp_dir = temp_dir
+        self.chunk_fetchers = chunk_fetchers
+        self.retry_timeout = retry_timeout
+        self.chunk_timeout = chunk_timeout
+        self._request_snapshots = request_snapshots or (lambda: None)
+        self._send_chunk_request = send_chunk_request or (lambda p, s, i: None)
+        self.logger = logger or new_nop_logger()
+        self._mtx = threading.Lock()
+        self._chunks: Optional[ChunkQueue] = None
+        self._stopped = threading.Event()
+
+    def stop(self) -> None:
+        """Abort a running sync_any loop (node shutdown)."""
+        self._stopped.set()
+        with self._mtx:
+            if self._chunks is not None:
+                self._chunks.close()
+
+    # -- feeding (called by the reactor) ---------------------------------------
+
+    def add_chunk(self, chunk: Chunk) -> bool:
+        with self._mtx:
+            queue = self._chunks
+        if queue is None:
+            raise RuntimeError("no state sync in progress")
+        added = queue.add(chunk)
+        if added:
+            self.logger.debug(
+                "added chunk to queue", height=chunk.height, chunk=chunk.index
+            )
+        return added
+
+    def add_snapshot(self, peer_id: str, snapshot: Snapshot) -> bool:
+        added = self.snapshots.add(peer_id, snapshot)
+        if added:
+            self.logger.info(
+                "discovered new snapshot",
+                height=snapshot.height,
+                format=snapshot.format,
+                hash=snapshot.hash.hex(),
+            )
+        return added
+
+    def add_peer(self, peer_id: str) -> None:
+        # a single snapshots request per new peer (syncer.go:125-134); the
+        # reactor owns the wire so this just records interest
+        pass
+
+    def remove_peer(self, peer_id: str) -> None:
+        self.snapshots.remove_peer(peer_id)
+
+    # -- the sync loop ---------------------------------------------------------
+
+    def sync_any(
+        self, discovery_time: float
+    ) -> Tuple[State, Commit, Snapshot]:
+        """Try snapshots from the pool until one restores, waiting
+        `discovery_time` between empty-pool polls. Returns the trusted
+        state + commit to bootstrap the node with."""
+        if discovery_time != 0 and discovery_time < MINIMUM_DISCOVERY_TIME:
+            discovery_time = MINIMUM_DISCOVERY_TIME
+
+        if discovery_time > 0:
+            self.logger.info(
+                "discovering snapshots", seconds=discovery_time
+            )
+            self._stopped.wait(discovery_time)
+
+        snapshot: Optional[Snapshot] = None
+        chunks: Optional[ChunkQueue] = None
+        try:
+            while True:
+                if self._stopped.is_set():
+                    raise ErrAbort("state sync stopped")
+                if snapshot is None:
+                    snapshot = self.snapshots.best()
+                    chunks = None
+                if snapshot is None:
+                    if discovery_time == 0:
+                        raise ErrNoSnapshots()
+                    self._request_snapshots()
+                    self.logger.info(
+                        "discovering snapshots", seconds=discovery_time
+                    )
+                    self._stopped.wait(discovery_time)
+                    continue
+                if chunks is None:
+                    chunks = ChunkQueue(snapshot, self.temp_dir)
+
+                try:
+                    state, commit = self.sync(snapshot, chunks)
+                    return state, commit, snapshot
+                except ErrAbort:
+                    raise
+                except ErrRetrySnapshot:
+                    chunks.retry_all()
+                    self.logger.info(
+                        "retrying snapshot", height=snapshot.height
+                    )
+                    continue
+                except ErrChunkTimeout:
+                    self.snapshots.reject(snapshot)
+                    self.logger.error(
+                        "timed out waiting for chunks, rejected snapshot",
+                        height=snapshot.height,
+                    )
+                except ErrRejectSnapshot:
+                    self.snapshots.reject(snapshot)
+                    self.logger.info(
+                        "snapshot rejected", height=snapshot.height
+                    )
+                except ErrRejectFormat:
+                    self.snapshots.reject_format(snapshot.format)
+                    self.logger.info(
+                        "snapshot format rejected", format=snapshot.format
+                    )
+                except ErrRejectSender:
+                    self.logger.info(
+                        "snapshot senders rejected", height=snapshot.height
+                    )
+                    for peer_id in self.snapshots.get_peers(snapshot):
+                        self.snapshots.reject_peer(peer_id)
+
+                # discard this snapshot and try the next candidate
+                chunks.close()
+                snapshot = None
+                chunks = None
+        finally:
+            if chunks is not None:
+                chunks.close()
+
+    def sync(
+        self, snapshot: Snapshot, chunks: ChunkQueue
+    ) -> Tuple[State, Commit]:
+        """Restore one specific snapshot."""
+        with self._mtx:
+            if self._chunks is not None:
+                raise RuntimeError("a state sync is already in progress")
+            self._chunks = chunks
+        stop_fetch = threading.Event()
+        fetchers: List[threading.Thread] = []
+        try:
+            # fetch + verify the trusted app hash before touching the app
+            try:
+                snapshot.trusted_app_hash = self.state_provider.app_hash(
+                    snapshot.height
+                )
+            except Exception as exc:
+                self.logger.info(
+                    "failed to fetch and verify app hash", err=str(exc)
+                )
+                raise ErrRejectSnapshot() from exc
+
+            self._offer_snapshot(snapshot)
+
+            for i in range(self.chunk_fetchers):
+                t = threading.Thread(
+                    target=self._fetch_chunks,
+                    args=(stop_fetch, snapshot, chunks),
+                    name=f"statesync-fetch-{i}",
+                    daemon=True,
+                )
+                t.start()
+                fetchers.append(t)
+
+            # optimistically build the new state so light-client failures
+            # surface before the (expensive) restore
+            try:
+                state = self.state_provider.state(snapshot.height)
+                commit = self.state_provider.commit(snapshot.height)
+            except Exception as exc:
+                self.logger.info(
+                    "failed to fetch and verify state/commit", err=str(exc)
+                )
+                raise ErrRejectSnapshot() from exc
+
+            self._apply_chunks(chunks)
+            self._verify_app(snapshot, state.version.consensus_app)
+
+            self.logger.info(
+                "snapshot restored",
+                height=snapshot.height,
+                format=snapshot.format,
+            )
+            return state, commit
+        finally:
+            stop_fetch.set()
+            with self._mtx:
+                self._chunks = None
+
+    # -- ABCI interactions -----------------------------------------------------
+
+    def _offer_snapshot(self, snapshot: Snapshot) -> None:
+        self.logger.info(
+            "offering snapshot to ABCI app",
+            height=snapshot.height,
+            format=snapshot.format,
+        )
+        resp = self.conn.offer_snapshot_sync(
+            abci.RequestOfferSnapshot(
+                snapshot=abci.Snapshot(
+                    height=snapshot.height,
+                    format=snapshot.format,
+                    chunks=snapshot.chunks,
+                    hash=snapshot.hash,
+                    metadata=snapshot.metadata,
+                ),
+                app_hash=snapshot.trusted_app_hash,
+            )
+        )
+        result = resp.result
+        if result == abci.OFFER_SNAPSHOT_ACCEPT:
+            self.logger.info(
+                "snapshot accepted, restoring", height=snapshot.height
+            )
+        elif result == abci.OFFER_SNAPSHOT_ABORT:
+            raise ErrAbort()
+        elif result == abci.OFFER_SNAPSHOT_REJECT:
+            raise ErrRejectSnapshot()
+        elif result == abci.OFFER_SNAPSHOT_REJECT_FORMAT:
+            raise ErrRejectFormat()
+        elif result == abci.OFFER_SNAPSHOT_REJECT_SENDER:
+            raise ErrRejectSender()
+        else:
+            raise ValueError(f"unknown ResponseOfferSnapshot result {result}")
+
+    def _apply_chunks(self, chunks: ChunkQueue) -> None:
+        while True:
+            try:
+                chunk = chunks.next(self.chunk_timeout)
+            except ErrChunkQueueDone:
+                return
+            resp = self.conn.apply_snapshot_chunk_sync(
+                abci.RequestApplySnapshotChunk(
+                    index=chunk.index,
+                    chunk=chunk.chunk,
+                    sender=chunk.sender,
+                )
+            )
+            self.logger.info(
+                "applied snapshot chunk",
+                height=chunk.height,
+                chunk=chunk.index,
+                total=chunks.size(),
+            )
+            for index in resp.refetch_chunks:
+                chunks.discard(index)
+            for sender in resp.reject_senders:
+                if sender:
+                    self.snapshots.reject_peer(sender)
+                    chunks.discard_sender(sender)
+
+            result = resp.result
+            if result == abci.APPLY_CHUNK_ACCEPT:
+                pass
+            elif result == abci.APPLY_CHUNK_ABORT:
+                raise ErrAbort()
+            elif result == abci.APPLY_CHUNK_RETRY:
+                chunks.retry(chunk.index)
+            elif result == abci.APPLY_CHUNK_RETRY_SNAPSHOT:
+                raise ErrRetrySnapshot()
+            elif result == abci.APPLY_CHUNK_REJECT_SNAPSHOT:
+                raise ErrRejectSnapshot()
+            else:
+                raise ValueError(
+                    f"unknown ResponseApplySnapshotChunk result {result}"
+                )
+
+    def _fetch_chunks(
+        self, stop: threading.Event, snapshot: Snapshot, chunks: ChunkQueue
+    ) -> None:
+        """Fetcher thread: allocate a chunk index, request it from a random
+        peer serving this snapshot, re-request on timeout (syncer.go:415)."""
+        next_alloc = True
+        index = 0
+        while not stop.is_set():
+            if next_alloc:
+                try:
+                    index = chunks.allocate()
+                except ErrChunkQueueDone:
+                    # keep checking for refetches until the restore is done
+                    if stop.wait(0.2) or self._stopped.is_set():
+                        return
+                    continue
+            self.logger.debug(
+                "fetching snapshot chunk",
+                chunk=index,
+                total=chunks.size(),
+            )
+            self._request_chunk(snapshot, index)
+            next_alloc = chunks.wait_for(index, self.retry_timeout)
+
+    def _request_chunk(self, snapshot: Snapshot, index: int) -> None:
+        peer_id = self.snapshots.get_peer(snapshot)
+        if peer_id is None:
+            self.logger.error(
+                "no valid peers found for snapshot", height=snapshot.height
+            )
+            return
+        self._send_chunk_request(peer_id, snapshot, index)
+
+    def _verify_app(self, snapshot: Snapshot, app_version: int) -> None:
+        resp = self.conn_query.info_sync(abci.RequestInfo())
+        if resp.app_version != app_version:
+            raise ErrVerifyFailed(
+                f"app version mismatch; expected {app_version}, "
+                f"got {resp.app_version}"
+            )
+        if resp.last_block_app_hash != snapshot.trusted_app_hash:
+            self.logger.error(
+                "appHash verification failed",
+                expected=snapshot.trusted_app_hash.hex(),
+                actual=resp.last_block_app_hash.hex(),
+            )
+            raise ErrVerifyFailed("app hash mismatch")
+        if resp.last_block_height != snapshot.height:
+            self.logger.error(
+                "ABCI app reported unexpected last block height",
+                expected=snapshot.height,
+                actual=resp.last_block_height,
+            )
+            raise ErrVerifyFailed("last block height mismatch")
+        self.logger.info(
+            "verified ABCI app",
+            height=snapshot.height,
+            app_hash=snapshot.trusted_app_hash.hex(),
+        )
