@@ -352,7 +352,9 @@ class SnapshotKVStoreApplication(PersistentKVStoreApplication):
             )
             # only the most recent snapshots are ever advertised
             # (statesync RECENT_SNAPSHOTS) — prune the rest
-            while len(self._snapshots) > 10:
+            from cometbft_tpu.statesync.snapshots import RECENT_SNAPSHOTS
+
+            while len(self._snapshots) > RECENT_SNAPSHOTS:
                 old = self._snapshots.pop(0)
                 self._snapshot_data.pop(old.height, None)
         return resp

@@ -134,6 +134,28 @@ class Node(BaseService):
         self.event_bus = EventBus()
         self.event_bus.start()
 
+        # 4b. indexers + indexer service (node.go:742-747 — started before
+        # the handshake on purpose so replayed blocks get indexed)
+        from cometbft_tpu.state.indexer import (
+            IndexerService,
+            KVBlockIndexer,
+            KVTxIndexer,
+            NullTxIndexer,
+        )
+
+        if config.tx_index.indexer == "kv":
+            self.tx_indexer = KVTxIndexer(db_provider("tx_index", config))
+        else:
+            self.tx_indexer = NullTxIndexer()
+        self.block_indexer = KVBlockIndexer(
+            db_provider("block_index", config)
+        )
+        self.indexer_service = IndexerService(
+            self.tx_indexer, self.block_indexer, self.event_bus,
+            logger=self.logger,
+        )
+        self.indexer_service.start()
+
         Handshaker(
             self.state_store, state, self.block_store, genesis_doc,
             event_bus=self.event_bus, logger=self.logger,
@@ -364,6 +386,7 @@ class Node(BaseService):
             self.rpc_server,
             self.switch,
             self.addr_book,
+            self.indexer_service,
             self.event_bus,
             self.proxy_app,
         ):

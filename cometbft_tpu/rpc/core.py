@@ -424,3 +424,85 @@ class Environment:
             }
         finally:
             bus.unsubscribe_all(subscriber)
+
+    # -- indexer routes (rpc/core/tx.go, blocks.go) ---------------------------
+
+    def _tx_json(self, res) -> dict:
+        from cometbft_tpu.crypto import sha256
+
+        return {
+            "hash": hex_up(sha256(res.tx)),
+            "height": str(res.height),
+            "index": res.index,
+            "tx_result": tx_result_json(res.result),
+            "tx": b64(res.tx),
+        }
+
+    def tx(self, hash_: bytes) -> dict:
+        """rpc/core/tx.go:19 Tx — look one transaction up by hash."""
+        res = self.node.tx_indexer.get(hash_)
+        if res is None:
+            raise RPCError(-32603, f"tx ({hash_.hex()}) not found")
+        return self._tx_json(res)
+
+    @staticmethod
+    def _search(searcher, query: str, page: int, per_page: int, order_by: str):
+        """Shared tx_search/block_search plumbing: parse + validate up
+        front (before paying for the index scan), then paginate. Returns
+        (page of results, total count)."""
+        from cometbft_tpu.libs.pubsub.query import parse_query
+
+        if order_by not in ("asc", "desc", ""):
+            raise RPCError(-32602, "order_by must be 'asc' or 'desc'")
+        try:
+            q = parse_query(query)
+        except Exception as exc:
+            raise RPCError(-32602, f"failed to parse query: {exc}") from exc
+        results = searcher(q)
+        if order_by == "desc":
+            results = list(reversed(results))
+        page = max(1, page)
+        per_page = min(max(1, per_page), 100)
+        start = (page - 1) * per_page
+        return results[start : start + per_page], len(results)
+
+    def tx_search(
+        self,
+        query: str,
+        page: int = 1,
+        per_page: int = 30,
+        order_by: str = "asc",
+    ) -> dict:
+        """rpc/core/tx.go:54 TxSearch."""
+        results, total = self._search(
+            self.node.tx_indexer.search, query, page, per_page, order_by
+        )
+        return {
+            "txs": [self._tx_json(r) for r in results],
+            "total_count": str(total),
+        }
+
+    def block_search(
+        self,
+        query: str,
+        page: int = 1,
+        per_page: int = 30,
+        order_by: str = "asc",
+    ) -> dict:
+        """rpc/core/blocks.go:174 BlockSearch."""
+        heights, total = self._search(
+            self.node.block_indexer.search, query, page, per_page, order_by
+        )
+        blocks = []
+        for h in heights:
+            meta = self.node.block_store.load_block_meta(h)
+            block = self.node.block_store.load_block(h)
+            if meta is None or block is None:
+                continue
+            blocks.append(
+                {
+                    "block_id": block_id_json(meta.block_id),
+                    "block": block_json(block),
+                }
+            )
+        return {"blocks": blocks, "total_count": str(total)}

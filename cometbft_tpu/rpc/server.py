@@ -67,6 +67,25 @@ _ROUTES = {
     "broadcast_tx_async": ("broadcast_tx_async", {"tx": ("tx", "b64bytes")}),
     "broadcast_tx_sync": ("broadcast_tx_sync", {"tx": ("tx", "b64bytes")}),
     "broadcast_tx_commit": ("broadcast_tx_commit", {"tx": ("tx", "b64bytes")}),
+    "tx": ("tx", {"hash": ("hash_", "b64bytes")}),
+    "tx_search": (
+        "tx_search",
+        {
+            "query": ("query", str),
+            "page": ("page", int),
+            "per_page": ("per_page", int),
+            "order_by": ("order_by", str),
+        },
+    ),
+    "block_search": (
+        "block_search",
+        {
+            "query": ("query", str),
+            "page": ("page", int),
+            "per_page": ("per_page", int),
+            "order_by": ("order_by", str),
+        },
+    ),
 }
 
 

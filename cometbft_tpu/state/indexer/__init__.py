@@ -1,0 +1,20 @@
+"""Event indexing — queryable tx + block indexes fed by the EventBus.
+
+Reference: state/txindex/ (TxIndexer interface + kv backend,
+indexer_service.go) and state/indexer/block/kv/. The service subscribes to
+the node's EventBus and persists, per block: every DeliverTx result keyed
+by tx hash plus its indexed ABCI events, and the BeginBlock/EndBlock
+events keyed by height — both searchable with the pubsub query language
+(`tx.height > 5 AND app.creator = '...'`).
+"""
+
+from cometbft_tpu.state.indexer.block import KVBlockIndexer
+from cometbft_tpu.state.indexer.service import IndexerService
+from cometbft_tpu.state.indexer.tx import KVTxIndexer, NullTxIndexer
+
+__all__ = [
+    "IndexerService",
+    "KVBlockIndexer",
+    "KVTxIndexer",
+    "NullTxIndexer",
+]

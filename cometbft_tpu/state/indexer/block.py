@@ -1,0 +1,88 @@
+"""Block (BeginBlock/EndBlock) event indexer.
+
+Reference: state/indexer/block/kv/kv.go — heights are indexed under their
+block events so `block_search` can answer queries like
+``block.height > 10 AND rewards.amount EXISTS``. Key scheme mirrors the
+tx indexer's (tx.py) with heights as the result type:
+
+    bh/<height>                      → b"" (height marker)
+    be/<key>\\x00<value-digest>\\x00<height> → JSON {v: value, h: height}
+
+The implicit ``block.height`` key is always indexed (kv.go:60).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from cometbft_tpu.libs.db import DB
+from cometbft_tpu.libs.pubsub.query import OP_EQ, Condition, Query
+from cometbft_tpu.state.indexer.tx import _value_digest
+
+BLOCK_HEIGHT_KEY = "block.height"
+
+_HEIGHT = b"bh/"
+_EVENT = b"be/"
+
+
+def _event_key(key: str, value: str, height: int) -> bytes:
+    return (
+        _EVENT
+        + key.encode()
+        + b"\x00"
+        + _value_digest(value)
+        + b"\x00"
+        + f"{height:016d}".encode()
+    )
+
+
+class KVBlockIndexer:
+    def __init__(self, db: DB):
+        self._db = db
+
+    def has(self, height: int) -> bool:
+        return self._db.get(_HEIGHT + f"{height:016d}".encode()) is not None
+
+    def index(self, header_events: Dict[str, List[str]], height: int) -> None:
+        """Index one block's merged BeginBlock+EndBlock composite events."""
+        self._db.set(_HEIGHT + f"{height:016d}".encode(), b"1")
+        events = dict(header_events)
+        events.setdefault(BLOCK_HEIGHT_KEY, []).append(str(height))
+        for key, values in events.items():
+            for value in values:
+                payload = json.dumps({"v": value, "h": height}).encode()
+                self._db.set(_event_key(key, value, height), payload)
+
+    def search(self, query: Query) -> List[int]:
+        conditions = query.conditions
+        if not conditions:
+            return []
+        heights: Optional[Dict[int, None]] = None
+        for c in conditions:
+            matches = self._match_condition(c)
+            if heights is None:
+                heights = matches
+            else:
+                heights = {h: None for h in heights if h in matches}
+            if not heights:
+                return []
+        return sorted(heights or {})
+
+    def _match_condition(self, c: Condition) -> Dict[int, None]:
+        matches: Dict[int, None] = {}
+        if c.op == OP_EQ and isinstance(c.operand, str):
+            prefix = (
+                _EVENT
+                + c.tag.encode()
+                + b"\x00"
+                + _value_digest(c.operand)
+                + b"\x00"
+            )
+        else:
+            prefix = _EVENT + c.tag.encode() + b"\x00"
+        for _, raw in self._db.prefix_iterator(prefix):
+            entry = json.loads(raw)
+            if c.matches({c.tag: [entry["v"]]}):
+                matches[entry["h"]] = None
+        return matches

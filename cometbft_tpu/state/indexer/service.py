@@ -1,0 +1,109 @@
+"""IndexerService — EventBus consumer feeding the tx + block indexers.
+
+Reference: state/txindex/indexer_service.go — subscribes to NewBlockHeader
+and Tx events, buffers the block's tx results until `num_txs` have arrived,
+then indexes the whole block atomically (":53-90"). Start it BEFORE the
+consensus handshake so replayed blocks get indexed too (node.go:738-747).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.libs.pubsub import SubscriptionCancelled
+from cometbft_tpu.libs.pubsub.query import parse_query
+from cometbft_tpu.libs.service import BaseService
+from cometbft_tpu.state.indexer.block import KVBlockIndexer
+from cometbft_tpu.state.indexer.tx import TxIndexer
+from cometbft_tpu.types.event_bus import (
+    EVENT_NEW_BLOCK_HEADER,
+    EVENT_TX,
+    _merged_block_events,
+)
+
+SUBSCRIBER = "IndexerService"
+
+
+class IndexerService(BaseService):
+    def __init__(
+        self,
+        tx_indexer: TxIndexer,
+        block_indexer: KVBlockIndexer,
+        event_bus,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("IndexerService")
+        self.tx_indexer = tx_indexer
+        self.block_indexer = block_indexer
+        self.event_bus = event_bus
+        self.logger = logger or new_nop_logger()
+        self._thread: Optional[threading.Thread] = None
+
+    def on_start(self) -> None:
+        self._block_sub = self.event_bus.subscribe(
+            SUBSCRIBER, parse_query(f"tm.event='{EVENT_NEW_BLOCK_HEADER}'")
+        )
+        self._tx_sub = self.event_bus.subscribe(
+            SUBSCRIBER + ".Tx", parse_query(f"tm.event='{EVENT_TX}'")
+        )
+        self._thread = threading.Thread(
+            target=self._run, name="indexer-service", daemon=True
+        )
+        self._thread.start()
+
+    def on_stop(self) -> None:
+        self.event_bus.unsubscribe_all(SUBSCRIBER)
+        self.event_bus.unsubscribe_all(SUBSCRIBER + ".Tx")
+
+    def _run(self) -> None:
+        while self.is_running():
+            try:
+                msg = self._block_sub.next(timeout=0.25)
+            except TimeoutError:
+                continue
+            except SubscriptionCancelled:
+                return
+            header_ev = msg.data  # EventDataNewBlockHeader
+            height = header_ev.header.height
+            try:
+                self.block_indexer.index(
+                    _merged_block_events(header_ev), height
+                )
+            except Exception as exc:
+                self.logger.error(
+                    "failed to index block", height=height, err=str(exc)
+                )
+            # collect exactly num_txs tx events for this block (:66-77)
+            batch = []
+            for _ in range(header_ev.num_txs):
+                try:
+                    tx_msg = self._tx_sub.next(timeout=10.0)
+                except (TimeoutError, SubscriptionCancelled):
+                    self.logger.error(
+                        "missing tx events for block", height=height,
+                        got=len(batch), want=header_ev.num_txs,
+                    )
+                    break
+                tx_ev = tx_msg.data  # EventDataTx
+                batch.append(
+                    abci.TxResult(
+                        height=tx_ev.height,
+                        index=tx_ev.index,
+                        tx=tx_ev.tx,
+                        result=tx_ev.result,
+                    )
+                )
+            if batch:
+                try:
+                    self.tx_indexer.add_batch(batch)
+                except Exception as exc:
+                    self.logger.error(
+                        "failed to index txs", height=height, err=str(exc)
+                    )
+            if header_ev.num_txs and len(batch) == header_ev.num_txs:
+                self.logger.debug(
+                    "indexed block txs", height=height, num_txs=len(batch)
+                )

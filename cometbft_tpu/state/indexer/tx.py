@@ -1,0 +1,196 @@
+"""Transaction indexer with a KV backend.
+
+Reference: state/txindex/indexer.go (interface) + state/txindex/kv/kv.go.
+The design follows the reference's shape — per-condition candidate sets
+over event-keyed index entries, intersected (queries are conjunctions),
+with the hash and height conditions as fast paths (kv.go Search :194) —
+expressed with this store's own key scheme:
+
+    txr/<hash>                         → TxResult (primary record)
+    txm/<height>/<index>               → hash (height iteration)
+    txe/<key>\\x00<value-digest>\\x00<height>/<index> → JSON payload
+        {v: value, h: height, i: index, hash: hex}
+
+Only attributes the app marked `index=true` are indexed (kv.go
+indexEvents), plus the implicit tx.hash / tx.height keys.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Optional, Sequence
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs.db import DB
+from cometbft_tpu.libs.pubsub.query import OP_EQ, Condition, Query
+
+TX_HASH_KEY = "tx.hash"
+TX_HEIGHT_KEY = "tx.height"
+
+_PRIMARY = b"txr/"
+_META = b"txm/"
+_EVENT = b"txe/"
+
+
+def _tx_hash(tx: bytes) -> bytes:
+    return hashlib.sha256(tx).digest()
+
+
+def _meta_key(height: int, index: int) -> bytes:
+    return _META + f"{height:016d}/{index:08d}".encode()
+
+
+def _value_digest(value: str) -> bytes:
+    return hashlib.sha256(value.encode()).digest()[:12].hex().encode()
+
+
+def _event_key(key: str, value: str, height: int, index: int) -> bytes:
+    return (
+        _EVENT
+        + key.encode()
+        + b"\x00"
+        + _value_digest(value)
+        + b"\x00"
+        + f"{height:016d}/{index:08d}".encode()
+    )
+
+
+class TxIndexer:
+    def add_batch(self, results: Sequence[abci.TxResult]) -> None:
+        raise NotImplementedError
+
+    def index(self, result: abci.TxResult) -> None:
+        raise NotImplementedError
+
+    def get(self, tx_hash: bytes) -> Optional[abci.TxResult]:
+        raise NotImplementedError
+
+    def search(self, query: Query) -> List[abci.TxResult]:
+        raise NotImplementedError
+
+
+class NullTxIndexer(TxIndexer):
+    """state/txindex/null — indexing disabled."""
+
+    def add_batch(self, results) -> None:
+        pass
+
+    def index(self, result) -> None:
+        pass
+
+    def get(self, tx_hash: bytes) -> Optional[abci.TxResult]:
+        return None
+
+    def search(self, query: Query) -> List[abci.TxResult]:
+        raise RuntimeError("indexing is disabled")
+
+
+class KVTxIndexer(TxIndexer):
+    def __init__(self, db: DB):
+        self._db = db
+
+    # -- writing -------------------------------------------------------------
+
+    def add_batch(self, results: Sequence[abci.TxResult]) -> None:
+        for result in results:
+            self.index(result)
+
+    def index(self, result: abci.TxResult) -> None:
+        tx_hash = _tx_hash(result.tx)
+        h, i = result.height, result.index
+        self._db.set(_PRIMARY + tx_hash, result.encode())
+        self._db.set(_meta_key(h, i), tx_hash)
+        for key, values in self._indexed_events(result).items():
+            for value in values:
+                payload = json.dumps(
+                    {"v": value, "h": h, "i": i, "hash": tx_hash.hex()}
+                ).encode()
+                self._db.set(_event_key(key, value, h, i), payload)
+
+    @staticmethod
+    def _indexed_events(result: abci.TxResult) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {}
+        res = result.result
+        for ev in getattr(res, "events", None) or []:
+            if not ev.type:
+                continue
+            for attr in ev.attributes:
+                if not attr.index or not attr.key:
+                    continue
+                key = f"{ev.type}.{attr.key.decode('utf-8', 'replace')}"
+                out.setdefault(key, []).append(
+                    attr.value.decode("utf-8", "replace")
+                )
+        # implicit keys (kv.go:93-103)
+        out[TX_HASH_KEY] = [_tx_hash(result.tx).hex().upper()]
+        out[TX_HEIGHT_KEY] = [str(result.height)]
+        return out
+
+    # -- reading -------------------------------------------------------------
+
+    def get(self, tx_hash: bytes) -> Optional[abci.TxResult]:
+        raw = self._db.get(_PRIMARY + tx_hash)
+        if raw is None:
+            return None
+        return abci.TxResult.decode(raw)
+
+    def search(self, query: Query) -> List[abci.TxResult]:
+        conditions = query.conditions
+        if not conditions:
+            return []
+
+        # fast path: tx.hash = '...' is a point lookup (kv.go:210-230)
+        for c in conditions:
+            if c.tag == TX_HASH_KEY and c.op == OP_EQ:
+                try:
+                    res = self.get(bytes.fromhex(str(c.operand)))
+                except ValueError:
+                    return []
+                if res is None:
+                    return []
+                events = self._indexed_events(res)
+                return [res] if query.matches(events) else []
+
+        # per-condition candidate sets over the event index, intersected
+        result_hashes: Optional[Dict[bytes, None]] = None
+        for c in conditions:
+            matches = self._match_condition(c)
+            if result_hashes is None:
+                result_hashes = matches
+            else:
+                result_hashes = {
+                    h: None for h in result_hashes if h in matches
+                }
+            if not result_hashes:
+                return []
+
+        out = []
+        for tx_hash in result_hashes or {}:
+            res = self.get(tx_hash)
+            if res is not None:
+                out.append(res)
+        out.sort(key=lambda r: (r.height, r.index))
+        return out
+
+    def _match_condition(self, c: Condition) -> Dict[bytes, None]:
+        """All tx hashes with ≥1 event value satisfying the condition."""
+        matches: Dict[bytes, None] = {}
+        if c.op == OP_EQ and isinstance(c.operand, str):
+            # string equality narrows the scan to the (key, value-digest)
+            # prefix; numeric equality must compare numerically ("5" vs
+            # "5.0") so it scans the whole key like the range operators
+            prefix = (
+                _EVENT
+                + c.tag.encode()
+                + b"\x00"
+                + _value_digest(c.operand)
+                + b"\x00"
+            )
+        else:
+            prefix = _EVENT + c.tag.encode() + b"\x00"
+        for _, raw in self._db.prefix_iterator(prefix):
+            entry = json.loads(raw)
+            if c.matches({c.tag: [entry["v"]]}):
+                matches[bytes.fromhex(entry["hash"])] = None
+        return matches

@@ -247,11 +247,8 @@ class StateSyncReactor(Reactor):
                 self.conn,
                 self.conn_query,
                 temp_dir=self.temp_dir,
-                chunk_fetchers=getattr(self.config, "chunk_fetchers", 4),
-                retry_timeout=getattr(
-                    self.config, "chunk_request_timeout_ns", 10_000_000_000
-                )
-                / 1e9,
+                chunk_fetchers=self.config.chunk_fetchers,
+                retry_timeout=self.config.chunk_request_timeout_ns / 1e9,
                 request_snapshots=self._broadcast_snapshots_request,
                 send_chunk_request=self._send_chunk_request,
                 logger=self.logger,

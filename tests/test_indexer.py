@@ -1,0 +1,180 @@
+"""Tx + block indexers and the EventBus-fed IndexerService.
+
+Model: reference state/txindex/kv/kv_test.go (index, get-by-hash, search
+by events/height/ranges), state/indexer/block/kv/kv_test.go, and
+state/txindex/indexer_service_test.go.
+"""
+
+import time
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.libs.pubsub.query import parse_query
+from cometbft_tpu.state.indexer import (
+    IndexerService,
+    KVBlockIndexer,
+    KVTxIndexer,
+    NullTxIndexer,
+)
+from cometbft_tpu.state.indexer.tx import _tx_hash
+from cometbft_tpu.types.event_bus import (
+    EventBus,
+    EventDataNewBlockHeader,
+    EventDataTx,
+)
+
+
+def _tx_result(height, index, tx, events=None):
+    return abci.TxResult(
+        height=height,
+        index=index,
+        tx=tx,
+        result=abci.ResponseDeliverTx(code=0, events=events or []),
+    )
+
+
+def _event(type_, **attrs):
+    return abci.Event(
+        type=type_,
+        attributes=[
+            abci.EventAttribute(k.encode(), v.encode(), True)
+            for k, v in attrs.items()
+        ],
+    )
+
+
+def _unindexed_event(type_, **attrs):
+    return abci.Event(
+        type=type_,
+        attributes=[
+            abci.EventAttribute(k.encode(), v.encode(), False)
+            for k, v in attrs.items()
+        ],
+    )
+
+
+class TestKVTxIndexer:
+    def test_index_and_get_by_hash(self):
+        idx = KVTxIndexer(MemDB())
+        res = _tx_result(3, 0, b"hello=world")
+        idx.index(res)
+        got = idx.get(_tx_hash(b"hello=world"))
+        assert got is not None
+        assert (got.height, got.index, got.tx) == (3, 0, b"hello=world")
+        assert idx.get(b"\x00" * 32) is None
+
+    def test_search_by_hash_fast_path(self):
+        idx = KVTxIndexer(MemDB())
+        idx.index(_tx_result(5, 1, b"a=1"))
+        h = _tx_hash(b"a=1").hex().upper()
+        out = idx.search(parse_query(f"tx.hash='{h}'"))
+        assert len(out) == 1 and out[0].height == 5
+
+    def test_search_by_event_and_height(self):
+        idx = KVTxIndexer(MemDB())
+        idx.index(
+            _tx_result(1, 0, b"t1", [_event("app", creator="alice")])
+        )
+        idx.index(
+            _tx_result(2, 0, b"t2", [_event("app", creator="bob")])
+        )
+        idx.index(
+            _tx_result(7, 0, b"t3", [_event("app", creator="alice")])
+        )
+        out = idx.search(parse_query("app.creator='alice'"))
+        assert [r.height for r in out] == [1, 7]
+        # conjunction narrows
+        out = idx.search(parse_query("app.creator='alice' AND tx.height>2"))
+        assert [r.height for r in out] == [7]
+        # ranges
+        out = idx.search(parse_query("tx.height>=2"))
+        assert [r.height for r in out] == [2, 7]
+        out = idx.search(parse_query("tx.height=2"))
+        assert [r.height for r in out] == [2]
+        # no match
+        assert idx.search(parse_query("app.creator='carol'")) == []
+
+    def test_unindexed_attributes_are_not_searchable(self):
+        idx = KVTxIndexer(MemDB())
+        idx.index(
+            _tx_result(1, 0, b"t1", [_unindexed_event("app", creator="x")])
+        )
+        assert idx.search(parse_query("app.creator='x'")) == []
+        # but the tx itself is still retrievable
+        assert idx.get(_tx_hash(b"t1")) is not None
+
+    def test_contains_and_exists(self):
+        idx = KVTxIndexer(MemDB())
+        idx.index(
+            _tx_result(4, 2, b"t", [_event("transfer", addr="cosmos1xyz")])
+        )
+        assert idx.search(parse_query("transfer.addr CONTAINS 'xyz'"))
+        assert idx.search(parse_query("transfer.addr EXISTS"))
+        assert idx.search(parse_query("transfer.other EXISTS")) == []
+
+    def test_null_indexer(self):
+        idx = NullTxIndexer()
+        idx.index(_tx_result(1, 0, b"x"))
+        assert idx.get(_tx_hash(b"x")) is None
+
+
+class TestKVBlockIndexer:
+    def test_index_and_search(self):
+        idx = KVBlockIndexer(MemDB())
+        idx.index({"begin_block.proposer": ["aa"]}, 1)
+        idx.index({"end_block.foo": ["bar"]}, 2)
+        idx.index({"begin_block.proposer": ["aa"]}, 9)
+        assert idx.has(1) and not idx.has(5)
+        assert idx.search(parse_query("begin_block.proposer='aa'")) == [1, 9]
+        assert idx.search(parse_query("block.height>1")) == [2, 9]
+        assert idx.search(
+            parse_query("begin_block.proposer='aa' AND block.height>1")
+        ) == [9]
+        assert idx.search(parse_query("end_block.foo='baz'")) == []
+
+
+class TestIndexerService:
+    def test_indexes_blocks_from_event_bus(self):
+        bus = EventBus()
+        bus.start()
+        tx_idx = KVTxIndexer(MemDB())
+        blk_idx = KVBlockIndexer(MemDB())
+        svc = IndexerService(tx_idx, blk_idx, bus)
+        svc.start()
+        try:
+
+            class _Header:
+                height = 10
+
+            bus.publish_event_new_block_header(
+                EventDataNewBlockHeader(
+                    header=_Header(),
+                    num_txs=2,
+                    result_begin_block=abci.ResponseBeginBlock(
+                        events=[_event("bb", k="v")]
+                    ),
+                    result_end_block=abci.ResponseEndBlock(),
+                )
+            )
+            for i, tx in enumerate((b"x=1", b"y=2")):
+                bus.publish_event_tx(
+                    EventDataTx(
+                        height=10, index=i, tx=tx,
+                        result=abci.ResponseDeliverTx(code=0),
+                    )
+                )
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if tx_idx.get(_tx_hash(b"y=2")) is not None and blk_idx.has(10):
+                    break
+                time.sleep(0.05)
+            assert blk_idx.has(10)
+            assert blk_idx.search(parse_query("bb.k='v'")) == [10]
+            got = tx_idx.get(_tx_hash(b"x=1"))
+            assert got is not None and got.height == 10
+            assert [
+                r.index for r in tx_idx.search(parse_query("tx.height=10"))
+            ] == [0, 1]
+        finally:
+            svc.stop()
+            bus.stop()

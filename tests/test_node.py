@@ -143,6 +143,37 @@ class TestSingleNode:
                     "abci_query?path=/store&data=0x" + b"one".hex(),
                 )["result"]["response"]
                 assert base64.b64decode(q["value"]) == b"1"
+
+                # the indexer service picked the tx up: /tx by hash and
+                # /tx_search by height both find it
+                import hashlib
+
+                tx_hash = hashlib.sha256(b"one=1").digest()
+                tx_height = int(res["height"])
+                deadline = time.monotonic() + 10
+                got = None
+                while time.monotonic() < deadline and got is None:
+                    try:
+                        got = _rpc_post(
+                            port=rpc_port, method="tx",
+                            params={
+                                "hash": base64.b64encode(tx_hash).decode()
+                            },
+                        )["result"]
+                    except Exception:
+                        time.sleep(0.2)
+                assert got is not None and int(got["height"]) == tx_height
+                found = _rpc_post(
+                    port=rpc_port, method="tx_search",
+                    params={"query": f"tx.height={tx_height}"},
+                )["result"]
+                assert found["total_count"] == "1"
+                assert found["txs"][0]["hash"] == tx_hash.hex().upper()
+                blocks = _rpc_post(
+                    port=rpc_port, method="block_search",
+                    params={"query": f"block.height={tx_height}"},
+                )["result"]
+                assert blocks["total_count"] == "1"
             finally:
                 node.stop()
 
