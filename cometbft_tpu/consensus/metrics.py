@@ -1,0 +1,105 @@
+"""Consensus metrics.
+
+Reference: consensus/metrics.go:22-95 — the full gauge/histogram set the
+reference exports under the `cometbft_consensus_*` namespace, fed from
+finalizeCommit/updateToState (record_metrics) and the step machine.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from cometbft_tpu.libs.metrics import Registry
+
+SUBSYSTEM = "consensus"
+
+
+class Metrics:
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry if registry is not None else Registry()
+        self.height = r.gauge(SUBSYSTEM, "height", "Height of the chain.")
+        self.validator_last_signed_height = r.gauge(
+            SUBSYSTEM, "validator_last_signed_height",
+            "Last height the local validator signed.",
+        )
+        self.rounds = r.gauge(SUBSYSTEM, "rounds", "Number of rounds.")
+        self.validators = r.gauge(
+            SUBSYSTEM, "validators", "Number of validators."
+        )
+        self.validators_power = r.gauge(
+            SUBSYSTEM, "validators_power", "Total power of all validators."
+        )
+        self.missing_validators = r.gauge(
+            SUBSYSTEM, "missing_validators",
+            "Number of validators who did not sign.",
+        )
+        self.missing_validators_power = r.gauge(
+            SUBSYSTEM, "missing_validators_power",
+            "Total power of the missing validators.",
+        )
+        self.byzantine_validators = r.gauge(
+            SUBSYSTEM, "byzantine_validators",
+            "Number of validators who tried to double sign.",
+        )
+        self.byzantine_validators_power = r.gauge(
+            SUBSYSTEM, "byzantine_validators_power",
+            "Total power of the byzantine validators.",
+        )
+        self.block_interval_seconds = r.histogram(
+            SUBSYSTEM, "block_interval_seconds",
+            "Time between this and the last block.",
+            buckets=(0.5, 1, 2.5, 5, 10, 30, 60),
+        )
+        self.num_txs = r.gauge(SUBSYSTEM, "num_txs", "Number of transactions.")
+        self.block_size_bytes = r.gauge(
+            SUBSYSTEM, "block_size_bytes", "Size of the block."
+        )
+        self.total_txs = r.gauge(
+            SUBSYSTEM, "total_txs", "Total number of transactions."
+        )
+        self.committed_height = r.gauge(
+            SUBSYSTEM, "latest_block_height", "The latest block height."
+        )
+        self.fast_syncing = r.gauge(
+            SUBSYSTEM, "fast_syncing", "Whether the node is fast syncing."
+        )
+        self.state_syncing = r.gauge(
+            SUBSYSTEM, "state_syncing", "Whether the node is state syncing."
+        )
+        self.block_parts = r.counter(
+            SUBSYSTEM, "block_parts",
+            "Number of block parts transmitted by peer.",
+        )
+        self.step_duration = r.histogram(
+            SUBSYSTEM, "step_duration_seconds",
+            "Histogram of step duration.",
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10),
+        )
+        self.block_gossip_parts_received = r.counter(
+            SUBSYSTEM, "block_gossip_parts_received",
+            "Block parts received, by relevance to the gathering block.",
+        )
+        self.quorum_prevote_delay = r.gauge(
+            SUBSYSTEM, "quorum_prevote_delay",
+            "Seconds from proposal timestamp to the prevote that completed "
+            "+2/3.",
+        )
+        self.full_prevote_delay = r.gauge(
+            SUBSYSTEM, "full_prevote_delay",
+            "Seconds from proposal timestamp to the last prevote in a "
+            "fully-prevoted round.",
+        )
+        self._step_start = time.monotonic()
+
+    @classmethod
+    def nop(cls) -> "Metrics":
+        return cls(None)
+
+    # step-duration helper (metrics.go MarkStep)
+    def mark_step(self, step_name: str) -> None:
+        now = time.monotonic()
+        self.step_duration.with_labels(step=step_name).observe(
+            now - self._step_start
+        )
+        self._step_start = now

@@ -429,6 +429,8 @@ class ConsensusReactor(Reactor):
 
     def switch_to_consensus(self, state, skip_wal: bool = False) -> None:
         """Called by blocksync when caught up (reactor.go:108)."""
+        self.cons.metrics.fast_syncing.set(0)
+        self.cons.metrics.state_syncing.set(0)
         self.cons.update_to_state(state)
         with self._wait_sync_mtx:
             self._wait_sync = False

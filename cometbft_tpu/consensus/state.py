@@ -82,11 +82,15 @@ class ConsensusState(BaseService):
         wal=None,
         event_bus=None,
         crypto_backend: Optional[str] = None,
+        metrics=None,  # consensus.metrics.Metrics
         logger: Optional[Logger] = None,
     ):
         super().__init__("ConsensusState")
+        from cometbft_tpu.consensus.metrics import Metrics
+
         self.config = config
         self.crypto_backend = crypto_backend
+        self.metrics = metrics if metrics is not None else Metrics.nop()
         self.block_exec = block_exec
         self.block_store = block_store
         self.tx_notifier = tx_notifier
@@ -412,6 +416,7 @@ class ConsensusState(BaseService):
         rs.height = height
         rs.round = 0
         rs.step = RoundStepType.NEW_HEIGHT
+        self.metrics.height.set(height)
         if rs.commit_time == 0:
             rs.start_time = time.monotonic() + self.config.commit_time()
         else:
@@ -486,6 +491,7 @@ class ConsensusState(BaseService):
         if rs.round < round_:
             validators = validators.copy()
             validators.increment_proposer_priority(round_ - rs.round)
+        self.metrics.rounds.set(round_)
         rs.round = round_
         rs.step = RoundStepType.NEW_ROUND
         rs.validators = validators
@@ -815,6 +821,8 @@ class ConsensusState(BaseService):
         )
         fail.fail()  # ApplyBlock done
 
+        self._record_metrics(height, block)
+
         if retain_height > 0 and self.block_store is not None:
             try:
                 pruned = self.block_store.prune_blocks(retain_height)
@@ -824,6 +832,56 @@ class ConsensusState(BaseService):
 
         self.update_to_state(state_copy)
         self._schedule_round0(self.rs)
+
+    def _record_metrics(self, height: int, block) -> None:
+        """Reference: recordMetrics (consensus/state.go:1729-1808)."""
+        m = self.metrics
+        state = self.state
+        m.validators.set(state.validators.size())
+        m.validators_power.set(state.validators.total_voting_power())
+
+        if height > state.initial_height and state.last_validators is not None:
+            # absent = no signature at all; a nil vote still counts as
+            # present (recordMetrics uses commitSig.Absent())
+            missing, missing_power = 0, 0
+            vals = state.last_validators.validators
+            sigs = block.last_commit.signatures
+            for i, val in enumerate(vals):
+                if i < len(sigs) and sigs[i].is_absent():
+                    missing += 1
+                    missing_power += val.voting_power
+            m.missing_validators.set(missing)
+            m.missing_validators_power.set(missing_power)
+
+        byz, byz_power = 0, 0
+        for ev in block.evidence:
+            addr = getattr(
+                getattr(ev, "vote_a", None), "validator_address", None
+            )
+            if addr is not None:
+                _, val = state.validators.get_by_address(addr)
+                if val is not None:
+                    byz += 1
+                    byz_power += val.voting_power
+        m.byzantine_validators.set(byz)
+        m.byzantine_validators_power.set(byz_power)
+
+        if height > 1 and self.block_store is not None:
+            prev = self.block_store.load_block_meta(height - 1)
+            if prev is not None:
+                dt = (
+                    block.header.time.seconds - prev.header.time.seconds
+                ) + (block.header.time.nanos - prev.header.time.nanos) / 1e9
+                m.block_interval_seconds.observe(dt)
+
+        num_txs = len(block.data.txs)
+        m.num_txs.set(num_txs)
+        m.total_txs.add(num_txs)
+        if self.block_store is not None:
+            meta = self.block_store.load_block_meta(height)
+            if meta is not None:
+                m.block_size_bytes.set(meta.block_size)
+        m.committed_height.set(height)
 
     # -- proposals -----------------------------------------------------------
 

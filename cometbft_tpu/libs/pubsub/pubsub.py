@@ -45,7 +45,14 @@ class Subscription:
         self.query = q
         # capacity 0 == unbuffered in the reference; we use capacity 1 with
         # non-blocking put + eviction to model "slow client dropped".
-        self._queue: "queue.Queue[Message]" = queue.Queue(maxsize=max(out_capacity, 1))
+        # capacity -1 == unbounded: never full, never evicted — for
+        # must-not-miss internal consumers (the reference's
+        # SubscribeUnbuffered blocks the publisher instead; an unbounded
+        # queue trades memory for the same no-loss guarantee without
+        # holding the publish lock).
+        self._queue: "queue.Queue[Message]" = queue.Queue(
+            maxsize=0 if out_capacity < 0 else max(out_capacity, 1)
+        )
         self._unbuffered = out_capacity == 0
         self._cancelled = threading.Event()
         self.cancel_reason: Optional[str] = None

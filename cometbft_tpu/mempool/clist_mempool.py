@@ -51,8 +51,12 @@ class CListMempool(Mempool):
         config: MempoolConfig,
         proxy_app,  # proxy.AppConnMempool
         height: int = 0,
+        metrics=None,  # mempool.metrics.Metrics
         logger: Optional[Logger] = None,
     ):
+        from cometbft_tpu.mempool.metrics import Metrics
+
+        self.metrics = metrics if metrics is not None else Metrics.nop()
         self.config = config
         self._proxy_app = proxy_app
         self._height = height
@@ -179,9 +183,12 @@ class CListMempool(Mempool):
                 if tx_info.sender_id:
                     mem_tx.senders.add(tx_info.sender_id)
                 self._add_tx(mem_tx)
+                self.metrics.size.set(self.size())
+                self.metrics.tx_size_bytes.observe(len(tx))
                 self._notify_txs_available()
         else:
             # invalid tx
+            self.metrics.failed_txs.add(1)
             if not self.config.keep_invalid_txs_in_cache:
                 self._cache.remove(tx)
         if user_cb is not None:
@@ -277,8 +284,10 @@ class CListMempool(Mempool):
             if elem is not None:
                 self._remove_tx(tx, elem, remove_from_cache=False)
 
+        self.metrics.size.set(self.size())
         if self.size() > 0:
             if self.config.recheck:
+                self.metrics.recheck_times.add(self.size())
                 self._recheck_txs()
             else:
                 self._notify_txs_available()

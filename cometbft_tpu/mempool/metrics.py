@@ -1,0 +1,39 @@
+"""Mempool metrics.
+
+Reference: mempool/metrics.go — size, per-tx sizes, failures, rechecks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from cometbft_tpu.libs.metrics import Registry
+
+SUBSYSTEM = "mempool"
+
+
+class Metrics:
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry if registry is not None else Registry()
+        self.size = r.gauge(
+            SUBSYSTEM, "size", "Number of uncommitted transactions."
+        )
+        self.tx_size_bytes = r.histogram(
+            SUBSYSTEM, "tx_size_bytes", "Transaction sizes in bytes.",
+            buckets=(16, 64, 256, 1024, 4096, 16384, 65536, 262144, 1048576),
+        )
+        self.failed_txs = r.counter(
+            SUBSYSTEM, "failed_txs", "Number of failed transactions."
+        )
+        self.recheck_times = r.counter(
+            SUBSYSTEM, "recheck_times",
+            "Number of times transactions are rechecked in the mempool.",
+        )
+        self.already_received_txs = r.counter(
+            SUBSYSTEM, "already_received_txs",
+            "Number of duplicate transaction receptions.",
+        )
+
+    @classmethod
+    def nop(cls) -> "Metrics":
+        return cls(None)

@@ -116,6 +116,30 @@ class Node(BaseService):
         # (tests, localnet runners) can mix backends. The CLI entrypoint
         # (default_new_node) additionally sets the process default.
 
+        # 0. metrics provider (node.go:122-152 DefaultMetricsProvider —
+        # Prometheus-backed when [instrumentation] enables it, no-ops
+        # otherwise so instrumentation points stay free)
+        from cometbft_tpu.consensus.metrics import Metrics as ConsMetrics
+        from cometbft_tpu.libs.metrics import Registry
+        from cometbft_tpu.mempool.metrics import Metrics as MemMetrics
+        from cometbft_tpu.p2p.metrics import Metrics as P2PMetrics
+        from cometbft_tpu.state.metrics import Metrics as SMMetrics
+
+        if config.instrumentation.prometheus:
+            self.metrics_registry = Registry(
+                namespace=config.instrumentation.namespace
+            )
+            cons_metrics = ConsMetrics(self.metrics_registry)
+            p2p_metrics = P2PMetrics(self.metrics_registry)
+            mem_metrics = MemMetrics(self.metrics_registry)
+            sm_metrics = SMMetrics(self.metrics_registry)
+        else:
+            self.metrics_registry = None
+            cons_metrics = ConsMetrics.nop()
+            p2p_metrics = P2PMetrics.nop()
+            mem_metrics = MemMetrics.nop()
+            sm_metrics = SMMetrics.nop()
+
         # 1. stores
         self.block_store = BlockStore(db_provider("blockstore", config))
         self.state_store = StateStore(db_provider("state", config))
@@ -178,7 +202,7 @@ class Node(BaseService):
         # 6. mempool
         self.mempool = CListMempool(
             config.mempool, self.proxy_app.mempool(),
-            height=state.last_block_height,
+            height=state.last_block_height, metrics=mem_metrics,
         )
         self.mempool_reactor = MempoolReactor(config.mempool, self.mempool)
 
@@ -197,6 +221,7 @@ class Node(BaseService):
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
             crypto_backend=config.crypto.backend,
+            metrics=sm_metrics,
             logger=self.logger,
         )
 
@@ -229,7 +254,8 @@ class Node(BaseService):
             config.consensus, state, self.block_executor, self.block_store,
             tx_notifier=self.mempool, evpool=self.evidence_pool, wal=wal,
             event_bus=self.event_bus,
-            crypto_backend=config.crypto.backend, logger=self.logger,
+            crypto_backend=config.crypto.backend, metrics=cons_metrics,
+            logger=self.logger,
         )
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
@@ -281,6 +307,7 @@ class Node(BaseService):
             max_inbound_peers=config.p2p.max_num_inbound_peers,
             max_outbound_peers=config.p2p.max_num_outbound_peers,
             mconfig=mconfig,
+            metrics=p2p_metrics,
             logger=self.logger,
         )
         self.switch.add_reactor("MEMPOOL", self.mempool_reactor)
@@ -340,6 +367,14 @@ class Node(BaseService):
         if self.rpc_server is not None:
             host, port = _parse_laddr(self.config.rpc.laddr)
             self.rpc_server.serve(host, port)
+        if self.metrics_registry is not None:
+            from cometbft_tpu.libs.metrics import MetricsServer
+
+            host, port = _parse_laddr(
+                self.config.instrumentation.prometheus_listen_addr
+            )
+            self.metrics_server = MetricsServer(self.metrics_registry)
+            self.metrics_server.serve(host, port)
         if self.state_sync_enabled:
             self._start_state_sync()
 
@@ -352,6 +387,9 @@ class Node(BaseService):
                 "the Node with state_provider=LightClientStateProvider(...)"
             )
         import threading
+
+        metrics = self.consensus_state.metrics
+        metrics.state_syncing.set(1)
 
         def run():
             try:
@@ -372,7 +410,9 @@ class Node(BaseService):
                     "failed to bootstrap node with new state", err=str(exc)
                 )
                 return
+            metrics.state_syncing.set(0)
             if self._fast_sync_after_statesync:
+                metrics.fast_syncing.set(1)
                 self.blocksync_reactor.switch_to_fast_sync(state)
             else:
                 self.consensus_reactor.switch_to_consensus(state, True)
@@ -383,6 +423,7 @@ class Node(BaseService):
 
     def on_stop(self) -> None:
         for svc in (
+            getattr(self, "metrics_server", None),
             self.rpc_server,
             self.switch,
             self.addr_book,

@@ -1,0 +1,38 @@
+"""P2P metrics.
+
+Reference: p2p/metrics.go — peer counts and per-channel byte counters,
+fed from the switch (peer add/remove) and MConnection (send/recv).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from cometbft_tpu.libs.metrics import Registry
+
+SUBSYSTEM = "p2p"
+
+
+class Metrics:
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry if registry is not None else Registry()
+        self.peers = r.gauge(SUBSYSTEM, "peers", "Number of peers.")
+        self.peer_receive_bytes_total = r.counter(
+            SUBSYSTEM, "peer_receive_bytes_total",
+            "Number of bytes received from a given peer.",
+        )
+        self.peer_send_bytes_total = r.counter(
+            SUBSYSTEM, "peer_send_bytes_total",
+            "Number of bytes sent to a given peer.",
+        )
+        self.peer_pending_send_bytes = r.gauge(
+            SUBSYSTEM, "peer_pending_send_bytes",
+            "Pending bytes to be sent to a given peer.",
+        )
+        self.num_txs = r.gauge(
+            SUBSYSTEM, "num_txs", "Number of transactions submitted by peer."
+        )
+
+    @classmethod
+    def nop(cls) -> "Metrics":
+        return cls(None)

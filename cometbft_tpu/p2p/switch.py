@@ -75,9 +75,13 @@ class Switch(BaseService):
         max_outbound_peers: int = DEFAULT_MAX_OUTBOUND_PEERS,
         reconnect_interval: float = RECONNECT_INTERVAL,
         mconfig: Optional[MConnConfig] = None,
+        metrics=None,  # p2p.metrics.Metrics
         logger: Optional[Logger] = None,
     ):
         super().__init__("P2P Switch", logger or new_nop_logger())
+        from cometbft_tpu.p2p.metrics import Metrics
+
+        self.metrics = metrics if metrics is not None else Metrics.nop()
         self.transport = transport
         self.reactors: Dict[str, Reactor] = {}
         self.ch_descs: List[ChannelDescriptor] = []
@@ -262,6 +266,7 @@ class Switch(BaseService):
             raise
         for reactor in self.reactors.values():
             reactor.add_peer(peer)
+        self.metrics.peers.set(self.peers.size())
         self.logger.info(
             "added peer", peer=peer.id()[:10], outbound=peer.is_outbound()
         )
@@ -295,6 +300,7 @@ class Switch(BaseService):
 
     def _stop_and_remove_peer(self, peer: Peer, reason) -> None:
         removed = self.peers.remove(peer)
+        self.metrics.peers.set(self.peers.size())
         try:
             if peer.is_running():
                 peer.stop()

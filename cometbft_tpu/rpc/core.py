@@ -428,10 +428,8 @@ class Environment:
     # -- indexer routes (rpc/core/tx.go, blocks.go) ---------------------------
 
     def _tx_json(self, res) -> dict:
-        from cometbft_tpu.crypto import sha256
-
         return {
-            "hash": hex_up(sha256(res.tx)),
+            "hash": hex_up(Tx(res.tx).hash()),
             "height": str(res.height),
             "index": res.index,
             "tx_result": tx_result_json(res.result),
@@ -446,7 +444,14 @@ class Environment:
         return self._tx_json(res)
 
     @staticmethod
-    def _search(searcher, query: str, page: int, per_page: int, order_by: str):
+    def _search(
+        searcher,
+        query: str,
+        page: int,
+        per_page: int,
+        order_by: str,
+        default_order: str = "asc",
+    ):
         """Shared tx_search/block_search plumbing: parse + validate up
         front (before paying for the index scan), then paginate. Returns
         (page of results, total count)."""
@@ -459,7 +464,7 @@ class Environment:
         except Exception as exc:
             raise RPCError(-32602, f"failed to parse query: {exc}") from exc
         results = searcher(q)
-        if order_by == "desc":
+        if (order_by or default_order) == "desc":
             results = list(reversed(results))
         page = max(1, page)
         per_page = min(max(1, per_page), 100)
@@ -489,9 +494,11 @@ class Environment:
         per_page: int = 30,
         order_by: str = "asc",
     ) -> dict:
-        """rpc/core/blocks.go:174 BlockSearch."""
+        """rpc/core/blocks.go:174 BlockSearch — unlike tx_search, the
+        reference defaults to DESCENDING order (blocks.go:202-207)."""
         heights, total = self._search(
-            self.node.block_indexer.search, query, page, per_page, order_by
+            self.node.block_indexer.search, query, page, per_page, order_by,
+            default_order="desc",
         )
         blocks = []
         for h in heights:

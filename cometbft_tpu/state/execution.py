@@ -79,8 +79,12 @@ class BlockExecutor:
         evidence_pool=None,
         event_bus=None,
         crypto_backend: Optional[str] = None,
+        metrics=None,  # state.metrics.Metrics
         logger: Optional[Logger] = None,
     ):
+        from cometbft_tpu.state.metrics import Metrics
+
+        self._metrics = metrics if metrics is not None else Metrics.nop()
         self._store = state_store
         self._proxy_app = proxy_app
         self._crypto_backend = crypto_backend
@@ -129,8 +133,14 @@ class BlockExecutor:
         Reference: state/execution.go:131-208."""
         self.validate_block(state, block)
 
+        import time as _time
+
+        exec_start = _time.monotonic()
         abci_responses = exec_block_on_proxy_app(
             self._proxy_app, block, self._store, state.initial_height, self._logger
+        )
+        self._metrics.block_processing_time.observe(
+            _time.monotonic() - exec_start
         )
 
         fail.fail()  # ABCI_RESPONSES not yet saved

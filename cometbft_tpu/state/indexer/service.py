@@ -43,10 +43,13 @@ class IndexerService(BaseService):
         self._thread: Optional[threading.Thread] = None
 
     def on_start(self) -> None:
-        self._block_sub = self.event_bus.subscribe(
+        # unbuffered/loss-proof subs: a block with many txs must never get
+        # the indexer evicted as a slow client (indexer_service.go:32-43
+        # uses SubscribeUnbuffered for exactly this reason)
+        self._block_sub = self.event_bus.subscribe_unbuffered(
             SUBSCRIBER, parse_query(f"tm.event='{EVENT_NEW_BLOCK_HEADER}'")
         )
-        self._tx_sub = self.event_bus.subscribe(
+        self._tx_sub = self.event_bus.subscribe_unbuffered(
             SUBSCRIBER + ".Tx", parse_query(f"tm.event='{EVENT_TX}'")
         )
         self._thread = threading.Thread(

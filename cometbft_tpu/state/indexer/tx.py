@@ -34,7 +34,9 @@ _EVENT = b"txe/"
 
 
 def _tx_hash(tx: bytes) -> bytes:
-    return hashlib.sha256(tx).digest()
+    from cometbft_tpu.types.tx import Tx
+
+    return Tx(tx).hash()
 
 
 def _meta_key(height: int, index: int) -> bytes:

@@ -154,6 +154,12 @@ class EventBus(BaseService):
     ) -> Subscription:
         return self._pubsub.subscribe(subscriber, q, out_capacity)
 
+    def subscribe_unbuffered(self, subscriber: str, q: Query) -> Subscription:
+        """Loss-proof subscription for internal consumers that must see
+        every event (reference: SubscribeUnbuffered, used by the indexer —
+        event_bus.go). Never evicted as a slow client."""
+        return self._pubsub.subscribe(subscriber, q, -1)
+
     def unsubscribe(self, subscriber: str, q: Query) -> None:
         self._pubsub.unsubscribe(subscriber, q)
 

@@ -178,3 +178,45 @@ class TestIndexerService:
         finally:
             svc.stop()
             bus.stop()
+
+    def test_survives_blocks_with_many_txs(self):
+        """>100 tx events in one burst must not evict the indexer's
+        subscription (the bus's slow-client policy would silently kill
+        indexing forever) — reference uses SubscribeUnbuffered."""
+        bus = EventBus()
+        bus.start()
+        tx_idx = KVTxIndexer(MemDB())
+        blk_idx = KVBlockIndexer(MemDB())
+        svc = IndexerService(tx_idx, blk_idx, bus)
+        svc.start()
+        try:
+
+            class _Header:
+                height = 5
+
+            n = 250
+            bus.publish_event_new_block_header(
+                EventDataNewBlockHeader(
+                    header=_Header(),
+                    num_txs=n,
+                    result_begin_block=abci.ResponseBeginBlock(),
+                    result_end_block=abci.ResponseEndBlock(),
+                )
+            )
+            for i in range(n):
+                bus.publish_event_tx(
+                    EventDataTx(
+                        height=5, index=i, tx=b"tx%d" % i,
+                        result=abci.ResponseDeliverTx(code=0),
+                    )
+                )
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if tx_idx.get(_tx_hash(b"tx%d" % (n - 1))) is not None:
+                    break
+                time.sleep(0.05)
+            assert tx_idx.get(_tx_hash(b"tx0")) is not None
+            assert tx_idx.get(_tx_hash(b"tx%d" % (n - 1))) is not None
+        finally:
+            svc.stop()
+            bus.stop()

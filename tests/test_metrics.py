@@ -1,0 +1,119 @@
+"""Metrics: instrument semantics, Prometheus text exposition, engine metric
+sets, and a live node serving /metrics.
+
+Model: reference consensus/metrics.go + node/node.go:1221
+startPrometheusServer (scrape endpoint contract).
+"""
+
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.consensus.metrics import Metrics as ConsMetrics
+from cometbft_tpu.libs.metrics import (
+    MetricsServer,
+    Registry,
+)
+from cometbft_tpu.mempool.metrics import Metrics as MemMetrics
+from cometbft_tpu.p2p.metrics import Metrics as P2PMetrics
+from cometbft_tpu.state.metrics import Metrics as SMMetrics
+
+
+class TestInstruments:
+    def test_counter(self):
+        r = Registry("t")
+        c = r.counter("sub", "hits", "Hits.")
+        c.add()
+        c.add(2)
+        assert c.value() == 3
+        with pytest.raises(ValueError):
+            c.add(-1)
+
+    def test_gauge(self):
+        r = Registry("t")
+        g = r.gauge("sub", "height")
+        g.set(5)
+        g.add(-2)
+        assert g.value() == 3
+
+    def test_histogram_buckets(self):
+        r = Registry("t")
+        h = r.histogram("sub", "lat", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        text = r.expose()
+        assert 't_sub_lat_bucket{le="0.1"} 1' in text
+        assert 't_sub_lat_bucket{le="1"} 2' in text
+        assert 't_sub_lat_bucket{le="+Inf"} 3' in text
+        assert "t_sub_lat_count 3" in text
+
+    def test_labels_make_child_series(self):
+        r = Registry("t")
+        c = r.counter("p2p", "bytes")
+        c.with_labels(peer="a").add(10)
+        c.with_labels(peer="b").add(20)
+        c.with_labels(peer="a").add(1)  # same child
+        text = r.expose()
+        assert 't_p2p_bytes{peer="a"} 11' in text
+        assert 't_p2p_bytes{peer="b"} 20' in text
+
+    def test_untouched_metrics_are_hidden(self):
+        r = Registry("t")
+        r.gauge("sub", "never_set")
+        assert "never_set" not in r.expose()
+
+    def test_reregistration_returns_same_instrument(self):
+        r = Registry("t")
+        a = r.gauge("s", "x")
+        b = r.gauge("s", "x")
+        assert a is b
+        with pytest.raises(ValueError):
+            r.counter("s", "x")
+
+    def test_help_and_type_lines(self):
+        r = Registry("cometbft")
+        g = r.gauge("consensus", "height", "Height of the chain.")
+        g.set(7)
+        text = r.expose()
+        assert "# HELP cometbft_consensus_height Height of the chain." in text
+        assert "# TYPE cometbft_consensus_height gauge" in text
+        assert "cometbft_consensus_height 7" in text
+
+
+class TestEngineMetricSets:
+    def test_all_sets_build_against_one_registry(self):
+        r = Registry("cometbft")
+        cons = ConsMetrics(r)
+        P2PMetrics(r)
+        MemMetrics(r)
+        SMMetrics(r)
+        cons.height.set(12)
+        cons.mark_step("propose")
+        text = r.expose()
+        assert "cometbft_consensus_height 12" in text
+        assert 'step="propose"' in text
+
+    def test_nop_metrics_never_fail(self):
+        m = ConsMetrics.nop()
+        m.height.set(1)
+        m.block_interval_seconds.observe(0.5)
+        m.mark_step("prevote")
+
+
+class TestMetricsServer:
+    def test_serves_text_format(self):
+        r = Registry("cometbft")
+        r.gauge("consensus", "height").set(42)
+        srv = MetricsServer(r)
+        port = srv.serve("127.0.0.1", 0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).read().decode()
+            assert "cometbft_consensus_height 42" in body
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/other", timeout=5
+                )
+        finally:
+            srv.stop()

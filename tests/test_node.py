@@ -119,6 +119,11 @@ class TestSingleNode:
             cfg.base.proxy_app = "kvstore"
             cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
             cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            (prom_port,) = _free_ports(1)
+            cfg.instrumentation.prometheus = True
+            cfg.instrumentation.prometheus_listen_addr = (
+                f"127.0.0.1:{prom_port}"
+            )
             node = default_new_node(cfg)
             node.start()
             try:
@@ -174,6 +179,17 @@ class TestSingleNode:
                     params={"query": f"block.height={tx_height}"},
                 )["result"]
                 assert blocks["total_count"] == "1"
+
+                # Prometheus endpoint serves live consensus series
+                import urllib.request
+
+                scrape = urllib.request.urlopen(
+                    f"http://127.0.0.1:{prom_port}/metrics", timeout=5
+                ).read().decode()
+                assert "cometbft_consensus_height" in scrape
+                assert "cometbft_consensus_total_txs" in scrape
+                assert "cometbft_mempool_size" in scrape
+                assert "cometbft_state_block_processing_time_count" in scrape
             finally:
                 node.stop()
 
