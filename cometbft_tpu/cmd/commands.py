@@ -226,6 +226,129 @@ def cmd_version(_args) -> int:
     return 0
 
 
+def cmd_gen_node_key(args) -> int:
+    """commands/gen_node_key.go — create (or show) the node p2p key."""
+    cfg = _load_config(args.home)
+    path = cfg.base.node_key_path()
+    existed = os.path.exists(path)
+    nk = NodeKey.load_or_gen(path)
+    print(nk.id() if existed else f"{nk.id()} (generated {path})")
+    return 0
+
+
+def _node_dbs(cfg):
+    from cometbft_tpu.node.node import default_db_provider
+    from cometbft_tpu.state.store import Store as StateStore
+    from cometbft_tpu.store import BlockStore
+
+    block_store = BlockStore(default_db_provider("blockstore", cfg))
+    state_store = StateStore(default_db_provider("state", cfg))
+    return block_store, state_store
+
+
+def cmd_rollback(args) -> int:
+    """commands/rollback.go — undo the latest state height (app state is
+    untouched; roll the app back one height too)."""
+    from cometbft_tpu.state.rollback import rollback
+
+    cfg = _load_config(args.home)
+    block_store, state_store = _node_dbs(cfg)
+    try:
+        height, app_hash = rollback(block_store, state_store)
+    except Exception as exc:
+        print(f"rollback failed: {exc}", file=sys.stderr)
+        return 1
+    print(
+        f"Rolled back state to height {height} and hash "
+        f"{app_hash.hex().upper()}"
+    )
+    return 0
+
+
+def cmd_reset_state(args) -> int:
+    """commands/reset.go ResetState — wipe the data dir (blocks, state,
+    evidence, indexes, WAL) but keep the validator key + address book."""
+    data_dir = os.path.join(args.home, "data")
+    if os.path.isdir(data_dir):
+        import shutil
+
+        shutil.rmtree(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
+    # the priv validator STATE must be reset too or the signer refuses to
+    # sign lower heights on the new chain (reset.go:76-86)
+    from cometbft_tpu.privval.file import FilePVLastSignState
+
+    cfg = _load_config(args.home)
+    state_path = cfg.base.priv_validator_state_path()
+    fresh = FilePVLastSignState(file_path=state_path)
+    fresh.save()
+    print(f"Removed all data in {data_dir}")
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """commands/reset.go UnsafeResetAll — reset-state + fresh addrbook."""
+    rc = cmd_reset_state(args)
+    cfg = _load_config(args.home)
+    addr_book = os.path.join(args.home, cfg.p2p.addr_book_file)
+    if os.path.exists(addr_book):
+        os.remove(addr_book)
+        print(f"Removed {addr_book}")
+    return rc
+
+
+def cmd_reindex_event(args) -> int:
+    """commands/reindex_event.go — rebuild tx + block indexes from the
+    block store and saved ABCI responses."""
+    from cometbft_tpu.abci import types as abci
+    from cometbft_tpu.node.node import default_db_provider
+    from cometbft_tpu.state.indexer import KVBlockIndexer, KVTxIndexer
+    from cometbft_tpu.types.event_bus import _abci_events_to_map
+
+    cfg = _load_config(args.home)
+    block_store, state_store = _node_dbs(cfg)
+    tx_indexer = KVTxIndexer(default_db_provider("tx_index", cfg))
+    block_indexer = KVBlockIndexer(default_db_provider("block_index", cfg))
+
+    base = max(block_store.base(), 1)
+    height = block_store.height()
+    start = args.start_height or base
+    end = args.end_height or height
+    if start < base or end > height or start > end:
+        print(
+            f"invalid range [{start}, {end}]; chain has [{base}, {height}]",
+            file=sys.stderr,
+        )
+        return 1
+    n = 0
+    for h in range(start, end + 1):
+        block = block_store.load_block(h)
+        if block is None:
+            continue
+        try:
+            responses = state_store.load_abci_responses(h)
+        except Exception as exc:
+            print(f"no ABCI responses for height {h}: {exc}", file=sys.stderr)
+            return 1
+        events = _abci_events_to_map(
+            getattr(responses.begin_block, "events", None)
+        )
+        for k, v in _abci_events_to_map(
+            getattr(responses.end_block, "events", None)
+        ).items():
+            events.setdefault(k, []).extend(v)
+        block_indexer.index(events, h)
+        batch = [
+            abci.TxResult(height=h, index=i, tx=tx, result=responses.deliver_txs[i])
+            for i, tx in enumerate(block.data.txs)
+            if i < len(responses.deliver_txs)
+        ]
+        tx_indexer.add_batch(batch)
+        n += 1
+    print(f"Reindexed events for {n} blocks ([{start}, {end}])")
+    return 0
+
+
 def main(argv: Optional[list] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="cometbft_tpu",
@@ -269,6 +392,30 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--p2p-port", type=int, default=26656)
     p.add_argument("--rpc-port", type=int, default=26657)
     p.set_defaults(fn=cmd_testnet)
+
+    p = sub.add_parser("rollback", help="roll the state back one height")
+    p.set_defaults(fn=cmd_rollback)
+
+    p = sub.add_parser(
+        "reset-state", help="remove all data, keep keys and address book"
+    )
+    p.set_defaults(fn=cmd_reset_state)
+
+    p = sub.add_parser(
+        "unsafe-reset-all",
+        help="remove all data and the address book (keeps the validator key)",
+    )
+    p.set_defaults(fn=cmd_unsafe_reset_all)
+
+    p = sub.add_parser("gen-node-key", help="generate or show the node key")
+    p.set_defaults(fn=cmd_gen_node_key)
+
+    p = sub.add_parser(
+        "reindex-event", help="rebuild tx/block indexes from stored blocks"
+    )
+    p.add_argument("--start-height", type=int, default=0)
+    p.add_argument("--end-height", type=int, default=0)
+    p.set_defaults(fn=cmd_reindex_event)
 
     p = sub.add_parser("version", help="print the version")
     p.set_defaults(fn=cmd_version)

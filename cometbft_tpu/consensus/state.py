@@ -462,6 +462,7 @@ class ConsensusState(BaseService):
     def _new_step(self) -> None:
         self.n_steps += 1
         rs = self.rs
+        self.metrics.mark_step(rs.step.short())
         self.event_bus.publish_event_new_round_step(
             EventDataRoundState(rs.height, rs.round, rs.step.short())
         )
@@ -874,6 +875,8 @@ class ConsensusState(BaseService):
                 ) + (block.header.time.nanos - prev.header.time.nanos) / 1e9
                 m.block_interval_seconds.observe(dt)
 
+        self._record_prevote_delays(m)
+
         num_txs = len(block.data.txs)
         m.num_txs.set(num_txs)
         m.total_txs.add(num_txs)
@@ -882,6 +885,43 @@ class ConsensusState(BaseService):
             if meta is not None:
                 m.block_size_bytes.set(meta.block_size)
         m.committed_height.set(height)
+
+    def _record_prevote_delays(self, m) -> None:
+        """Reference: calculatePrevoteMessageDelayMetrics (:2310) — walk
+        the commit round's prevotes in timestamp order; the vote that tips
+        cumulative power over 2/3 sets the quorum delay, and a 100%-
+        prevoted round also sets the full delay."""
+        rs = self.rs
+        if rs.proposal is None or rs.votes is None or rs.commit_round < 0:
+            return
+        prevotes = rs.votes.prevotes(rs.commit_round)
+        if prevotes is None:
+            return
+        cast = []
+        for v in prevotes.list_votes():
+            _, val = rs.validators.get_by_address(v.validator_address)
+            if val is not None:
+                cast.append((v, val.voting_power))
+        if not cast:
+            return
+        cast.sort(key=lambda e: (e[0].timestamp.seconds, e[0].timestamp.nanos))
+        total = rs.validators.total_voting_power()
+        prop_ts = rs.proposal.timestamp
+
+        def delay(ts):
+            return (ts.seconds - prop_ts.seconds) + (
+                ts.nanos - prop_ts.nanos
+            ) / 1e9
+
+        cumulative = 0
+        quorum_set = False
+        for vote, power in cast:
+            cumulative += power
+            if not quorum_set and cumulative * 3 > total * 2:
+                m.quorum_prevote_delay.set(delay(vote.timestamp))
+                quorum_set = True
+        if cumulative == total:
+            m.full_prevote_delay.set(delay(cast[-1][0].timestamp))
 
     # -- proposals -----------------------------------------------------------
 
@@ -918,8 +958,10 @@ class ConsensusState(BaseService):
         if rs.proposal_block_parts is None:
             return False
         added = rs.proposal_block_parts.add_part(msg.part)
+        self.metrics.block_gossip_parts_received.add(1)
         if not added:
             return False
+        self.metrics.block_parts.add(1)
         if rs.proposal_block_parts.is_complete():
             from cometbft_tpu.types.block import Block
 
@@ -1136,4 +1178,5 @@ class ConsensusState(BaseService):
         vote = self._sign_vote(msg_type, hash_, header)
         if vote is not None:
             self.send_internal(VoteMessage(vote))
+            self.metrics.validator_last_signed_height.set(vote.height)
         return vote

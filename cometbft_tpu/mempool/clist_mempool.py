@@ -147,6 +147,7 @@ class CListMempool(Mempool):
                     raise ErrPreCheck(reason)
             if not self._cache.push(tx):
                 # record the sender for dedup tracking, then reject
+                self.metrics.already_received_txs.add(1)
                 elem = self._txs_map.get(tx_key(tx))
                 if elem is not None and tx_info.sender_id:
                     elem.value.senders.add(tx_info.sender_id)

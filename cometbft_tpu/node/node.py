@@ -109,7 +109,13 @@ class Node(BaseService):
         self.genesis_doc = genesis_doc
         self.node_key = node_key
 
-        db_provider = db_provider or default_db_provider
+        _provider = db_provider or default_db_provider
+        self._dbs: List[DB] = []
+
+        def db_provider(name: str, cfg: Config) -> DB:
+            db = _provider(name, cfg)
+            self._dbs.append(db)
+            return db
 
         # [crypto] backend is threaded explicitly to every consumer below —
         # never set process-globally here, so in-process multi-node setups
@@ -234,6 +240,8 @@ class Node(BaseService):
             logger=self.logger,
         )
         self._fast_sync_after_statesync = fast_sync
+        if fast_sync and not self.state_sync_enabled:
+            cons_metrics.fast_syncing.set(1)
 
         # 9b. statesync (serving side always on; restore when enabled)
         self.statesync_reactor = StateSyncReactor(
@@ -441,6 +449,13 @@ class Node(BaseService):
                 self.logger.error("error stopping service", err=str(exc))
         if self.consensus_state.is_running():
             self.consensus_state.stop()
+        # release DB file locks so maintenance commands (rollback,
+        # reindex-event) can open the same files from another process
+        for db in self._dbs:
+            try:
+                db.close()
+            except Exception:
+                pass
 
     # -- introspection (used by RPC) -----------------------------------------
 
@@ -489,7 +504,7 @@ def default_new_node(config: Config, logger: Optional[Logger] = None) -> Node:
     with open(config.base.genesis_path()) as f:
         genesis_doc = GenesisDoc.from_json(f.read())
     app_db = default_db_provider("app", config)
-    return Node(
+    node = Node(
         config,
         priv_validator,
         node_key,
@@ -497,3 +512,7 @@ def default_new_node(config: Config, logger: Optional[Logger] = None) -> Node:
         genesis_doc,
         logger=logger,
     )
+    # the app DB is created outside Node's tracking provider; register it
+    # so on_stop releases its file locks too
+    node._dbs.append(app_db)
+    return node

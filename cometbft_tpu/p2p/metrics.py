@@ -1,7 +1,8 @@
 """P2P metrics.
 
-Reference: p2p/metrics.go — peer counts and per-channel byte counters,
-fed from the switch (peer add/remove) and MConnection (send/recv).
+Reference: p2p/metrics.go — peer counts (fed from the switch on peer
+add/remove) and per-peer/per-channel byte counters (fed from Peer.send /
+the switch's receive dispatch).
 """
 
 from __future__ import annotations
@@ -25,14 +26,6 @@ class Metrics:
             SUBSYSTEM, "peer_send_bytes_total",
             "Number of bytes sent to a given peer.",
         )
-        self.peer_pending_send_bytes = r.gauge(
-            SUBSYSTEM, "peer_pending_send_bytes",
-            "Pending bytes to be sent to a given peer.",
-        )
-        self.num_txs = r.gauge(
-            SUBSYSTEM, "num_txs", "Number of transactions submitted by peer."
-        )
-
     @classmethod
     def nop(cls) -> "Metrics":
         return cls(None)

@@ -42,6 +42,7 @@ class Peer(BaseService):
         self.persistent = persistent
         self.socket_addr = socket_addr
         self.data = CMap()  # reactor scratch space (peer.go Get/Set)
+        self.metrics = None  # p2p.metrics.Metrics, set by the switch
         self._on_peer_receive = on_peer_receive
         self._on_peer_error = on_peer_error
         self.mconn = MConnection(
@@ -96,14 +97,24 @@ class Peer(BaseService):
             return False
         if not self.node_info.has_channel(ch_id) and self.node_info.channels:
             return False
-        return self.mconn.send(ch_id, msg_bytes)
+        ok = self.mconn.send(ch_id, msg_bytes)
+        if ok and self.metrics is not None:
+            self.metrics.peer_send_bytes_total.with_labels(
+                peer_id=self.id(), chID=f"{ch_id:#x}"
+            ).add(len(msg_bytes))
+        return ok
 
     def try_send(self, ch_id: int, msg_bytes: bytes) -> bool:
         if not self.is_running():
             return False
         if not self.node_info.has_channel(ch_id) and self.node_info.channels:
             return False
-        return self.mconn.try_send(ch_id, msg_bytes)
+        ok = self.mconn.try_send(ch_id, msg_bytes)
+        if ok and self.metrics is not None:
+            self.metrics.peer_send_bytes_total.with_labels(
+                peer_id=self.id(), chID=f"{ch_id:#x}"
+            ).add(len(msg_bytes))
+        return ok
 
     def get(self, key: str):
         return self.data.get(key)

@@ -256,6 +256,7 @@ class Switch(BaseService):
         if not self.is_running():
             up.secret_conn.close()
             return
+        peer.metrics = self.metrics
         for reactor in self.reactors.values():
             peer = reactor.init_peer(peer)
         self.peers.add(peer)  # raises on duplicate
@@ -272,6 +273,9 @@ class Switch(BaseService):
         )
 
     def _on_peer_receive(self, ch_id: int, peer: Peer, msg_bytes: bytes) -> None:
+        self.metrics.peer_receive_bytes_total.with_labels(
+            peer_id=peer.id(), chID=f"{ch_id:#x}"
+        ).add(len(msg_bytes))
         reactor = self.reactors_by_ch.get(ch_id)
         if reactor is None:
             self.stop_peer_for_error(

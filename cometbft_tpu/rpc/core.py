@@ -476,7 +476,7 @@ class Environment:
         query: str,
         page: int = 1,
         per_page: int = 30,
-        order_by: str = "asc",
+        order_by: str = "",
     ) -> dict:
         """rpc/core/tx.go:54 TxSearch."""
         results, total = self._search(
@@ -492,7 +492,7 @@ class Environment:
         query: str,
         page: int = 1,
         per_page: int = 30,
-        order_by: str = "asc",
+        order_by: str = "",
     ) -> dict:
         """rpc/core/blocks.go:174 BlockSearch — unlike tx_search, the
         reference defaults to DESCENDING order (blocks.go:202-207)."""

@@ -1,0 +1,81 @@
+"""One-height state rollback.
+
+Reference: state/rollback.go:15 — overwrite the latest state (height n)
+with a state rebuilt from the block at n-1, for recovering from an
+app-hash mismatch without resyncing. Application state is NOT touched;
+the operator must roll the app back one height too (or replay will
+re-apply block n).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from cometbft_tpu.state import State, StateVersion
+from cometbft_tpu.version import BLOCK_PROTOCOL, CMT_SEM_VER
+
+
+def rollback(block_store, state_store) -> Tuple[int, bytes]:
+    """Returns (new_height, app_hash). Raises on invariant violations."""
+    invalid_state = state_store.load()
+    if invalid_state is None or invalid_state.is_empty():
+        raise ValueError("no state found")
+
+    height = block_store.height()
+
+    # state and blocks don't persist atomically: if the node stopped after
+    # the block save but before the state save, nothing needs rolling back
+    if height == invalid_state.last_block_height + 1:
+        return invalid_state.last_block_height, invalid_state.app_hash
+
+    if height != invalid_state.last_block_height:
+        raise ValueError(
+            f"statestore height ({invalid_state.last_block_height}) is not "
+            f"one below or equal to blockstore height ({height})"
+        )
+
+    rollback_height = invalid_state.last_block_height - 1
+    rollback_block = block_store.load_block_meta(rollback_height)
+    if rollback_block is None:
+        raise ValueError(f"block at height {rollback_height} not found")
+    # the app hash and last-results hash for n-1 are only agreed upon in
+    # block n — take them from the latest block's header
+    latest_block = block_store.load_block_meta(invalid_state.last_block_height)
+    if latest_block is None:
+        raise ValueError(
+            f"block at height {invalid_state.last_block_height} not found"
+        )
+
+    previous_last_validator_set = state_store.load_validators(rollback_height)
+    previous_params = state_store.load_consensus_params(rollback_height + 1)
+
+    val_change_height = invalid_state.last_height_validators_changed
+    if val_change_height > rollback_height:
+        val_change_height = rollback_height + 1
+    params_change_height = invalid_state.last_height_consensus_params_changed
+    if params_change_height > rollback_height:
+        params_change_height = rollback_height + 1
+
+    rolled_back = State(
+        version=StateVersion(
+            consensus_block=BLOCK_PROTOCOL,
+            consensus_app=previous_params.version.app_version,
+            software=CMT_SEM_VER,
+        ),
+        chain_id=invalid_state.chain_id,
+        initial_height=invalid_state.initial_height,
+        last_block_height=rollback_block.header.height,
+        last_block_id=rollback_block.block_id,
+        last_block_time=rollback_block.header.time,
+        next_validators=invalid_state.validators,
+        validators=invalid_state.last_validators,
+        last_validators=previous_last_validator_set,
+        last_height_validators_changed=val_change_height,
+        consensus_params=previous_params,
+        last_height_consensus_params_changed=params_change_height,
+        last_results_hash=latest_block.header.last_results_hash,
+        app_hash=latest_block.header.app_hash,
+    )
+
+    state_store.save(rolled_back)
+    return rolled_back.last_block_height, rolled_back.app_hash

@@ -1,0 +1,213 @@
+"""State rollback + maintenance CLI commands (rollback, reset,
+gen-node-key, reindex-event).
+
+Model: reference state/rollback_test.go and cmd/cometbft/commands/
+{rollback,reset,reindex_event}.go.
+"""
+
+import base64
+import json
+import os
+import socket
+import tempfile
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.abci.client import LocalClient
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.cmd.commands import _load_config, main as cli_main
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.proxy import AppConnConsensus
+from cometbft_tpu.state import make_genesis_state
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.rollback import rollback
+from cometbft_tpu.state.store import Store
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import test_util
+from cometbft_tpu.types.block import BlockID, Commit
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+GENESIS_TIME = Timestamp(1_700_000_000, 0)
+
+
+def _build_chain(n_blocks):
+    vals, privs = test_util.deterministic_validator_set(3, 10)
+    doc = GenesisDoc(
+        genesis_time=GENESIS_TIME,
+        chain_id="rollback-chain",
+        validators=[
+            GenesisValidator(v.address, v.pub_key, v.voting_power, "")
+            for v in vals.validators
+        ],
+    )
+    state = make_genesis_state(doc)
+    ss = Store(MemDB())
+    ss.save(state)
+    bs = BlockStore(MemDB())
+    client = LocalClient(KVStoreApplication())
+    client.start()
+    ex = BlockExecutor(ss, AppConnConsensus(client))
+    last_commit = Commit(height=0, round=0)
+    for h in range(1, n_blocks + 1):
+        proposer = state.validators.validators[h % 3].address
+        block, parts = state.make_block(h, [], last_commit, [], proposer)
+        bid = BlockID(block.hash(), parts.header())
+        seen = test_util.make_commit(
+            bid, h, 0, state.validators, privs, doc.chain_id,
+            now=Timestamp(GENESIS_TIME.seconds + h, 0),
+        )
+        bs.save_block(block, parts, seen)
+        state, _ = ex.apply_block(state, bid, block)
+        last_commit = seen
+    client.stop()
+    return state, ss, bs
+
+
+class TestRollback:
+    def test_rolls_back_one_height(self):
+        state, ss, bs = _build_chain(8)
+        assert state.last_block_height == 8
+        height, app_hash = rollback(bs, ss)
+        assert height == 7
+        rolled = ss.load()
+        assert rolled.last_block_height == 7
+        # app hash for height 7 comes from header 8
+        assert app_hash == bs.load_block_meta(8).header.app_hash
+        # validator bookkeeping shifted one height back
+        assert rolled.validators.hash() == state.last_validators.hash()
+
+    def test_early_return_when_block_store_is_ahead(self):
+        """Non-atomic stop: block N+1 saved but state still at N — nothing
+        to roll back (rollback.go:26-31)."""
+        state, ss, bs = _build_chain(5)
+        older = Store(MemDB())
+        # simulate the state store lagging one height
+        state_at_4 = ss.load_validators  # noqa: F841  (store intact)
+        # rebuild: store state for height 4 only
+        s4 = state.copy()
+        s4.last_block_height = 4
+        older.save(s4)
+        height, _ = rollback(bs, older)
+        assert height == 4
+        assert older.load().last_block_height == 4
+
+    def test_errors_without_state(self):
+        with pytest.raises(ValueError):
+            rollback(BlockStore(MemDB()), Store(MemDB()))
+
+
+def _free_ports(n):
+    out = []
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        out.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return out
+
+
+def _rpc_post(port, method, params):
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=10).read())
+
+
+@pytest.mark.slow
+class TestMaintenanceCLI:
+    def test_gen_node_key_and_resets(self, capsys):
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "cli-chain"])
+            rc = cli_main(["--home", d, "gen-node-key"])
+            assert rc == 0
+            out = capsys.readouterr().out.strip().splitlines()[-1]
+            node_id = out.split()[0]
+            assert len(node_id) == 40  # hex address
+
+            # drop a file into data/ then reset-state clears it
+            with open(os.path.join(d, "data", "junk.db"), "w") as f:
+                f.write("x")
+            assert cli_main(["--home", d, "reset-state"]) == 0
+            # only the freshly-reset signer state survives in data/
+            assert os.listdir(os.path.join(d, "data")) == [
+                "priv_validator_state.json"
+            ]
+            # keys survive the reset
+            assert cli_main(["--home", d, "show-node-id"]) == 0
+            assert capsys.readouterr().out.strip().splitlines()[-1] == node_id
+
+            assert cli_main(["--home", d, "unsafe-reset-all"]) == 0
+
+    def test_reindex_and_rollback_on_real_home(self):
+        """Run a node to commit real blocks + a tx, then reindex-event into
+        fresh index DBs and rollback the state by one height."""
+        from cometbft_tpu.node import default_new_node
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "maint-chain"])
+            rpc_port, p2p_port = _free_ports(2)
+            cfg = _load_config(d)
+            cfg.base.proxy_app = "kvstore"
+            cfg.base.db_backend = "sqlite"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            node = default_new_node(cfg)
+            node.start()
+            try:
+                deadline = time.monotonic() + 60
+                committed = None
+                while time.monotonic() < deadline and committed is None:
+                    try:
+                        committed = _rpc_post(
+                            rpc_port, "broadcast_tx_commit",
+                            {"tx": base64.b64encode(b"ri=1").decode()},
+                        )["result"]
+                    except Exception:
+                        time.sleep(0.3)
+                assert committed is not None
+                tx_height = int(committed["height"])
+                # let a couple more blocks commit so rollback has room
+                time.sleep(2.0)
+            finally:
+                node.stop()
+            time.sleep(0.5)
+
+            # wipe the index DBs, then rebuild them from stored blocks
+            data = os.path.join(d, "data")
+            for name in ("tx_index.db", "block_index.db"):
+                # sqlite sidecar files must go with the main db or a fresh
+                # open sees a stale WAL and errors
+                for suffix in ("", "-wal", "-shm"):
+                    path = os.path.join(data, name + suffix)
+                    if os.path.exists(path):
+                        os.remove(path)
+            assert cli_main(["--home", d, "reindex-event"]) == 0
+            from cometbft_tpu.libs.db import SQLiteDB
+            from cometbft_tpu.libs.pubsub.query import parse_query
+            from cometbft_tpu.state.indexer import KVTxIndexer
+
+            idx = KVTxIndexer(SQLiteDB(os.path.join(data, "tx_index.db")))
+            found = idx.search(parse_query(f"tx.height={tx_height}"))
+            assert len(found) == 1 and found[0].tx == b"ri=1"
+
+            # rollback: state height drops by one
+            from cometbft_tpu.state.store import Store as StateStore
+
+            before = StateStore(
+                SQLiteDB(os.path.join(data, "state.db"))
+            ).load().last_block_height
+            assert cli_main(["--home", d, "rollback"]) == 0
+            after = StateStore(
+                SQLiteDB(os.path.join(data, "state.db"))
+            ).load().last_block_height
+            assert after == before - 1
