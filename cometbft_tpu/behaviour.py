@@ -1,0 +1,74 @@
+"""Peer-behaviour reporting.
+
+Reference: behaviour/{peer_behaviour,reporter}.go — a small vocabulary of
+judgements reactors can report about peers, routed either to the Switch
+(good → address-book mark-good, bad → StopPeerForError) or recorded by a
+MockReporter in tests.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List
+
+
+@dataclass(frozen=True)
+class PeerBehaviour:
+    peer_id: str
+    reason: str  # one of the constructors below
+    explanation: str = ""
+
+
+def consensus_vote(peer_id: str, explanation: str = "") -> PeerBehaviour:
+    return PeerBehaviour(peer_id, "consensus_vote", explanation)
+
+
+def block_part(peer_id: str, explanation: str = "") -> PeerBehaviour:
+    return PeerBehaviour(peer_id, "block_part", explanation)
+
+
+def bad_message(peer_id: str, explanation: str) -> PeerBehaviour:
+    return PeerBehaviour(peer_id, "bad_message", explanation)
+
+
+def message_out_of_order(peer_id: str, explanation: str) -> PeerBehaviour:
+    return PeerBehaviour(peer_id, "message_out_of_order", explanation)
+
+
+_GOOD = ("consensus_vote", "block_part")
+_BAD = ("bad_message", "message_out_of_order")
+
+
+class SwitchReporter:
+    """Routes behaviour reports to a p2p Switch (reporter.go:29-47)."""
+
+    def __init__(self, switch):
+        self._switch = switch
+
+    def report(self, behaviour: PeerBehaviour) -> None:
+        peer = self._switch.peers.get(behaviour.peer_id)
+        if peer is None:
+            raise ValueError("peer not found")
+        if behaviour.reason in _GOOD:
+            self._switch.mark_peer_as_good(peer)
+        elif behaviour.reason in _BAD:
+            self._switch.stop_peer_for_error(peer, behaviour.explanation)
+        else:
+            raise ValueError(f"unknown reason {behaviour.reason!r}")
+
+
+class MockReporter:
+    """Records reports for assertion in reactor tests (reporter.go:50)."""
+
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self._by_peer: Dict[str, List[PeerBehaviour]] = {}
+
+    def report(self, behaviour: PeerBehaviour) -> None:
+        with self._mtx:
+            self._by_peer.setdefault(behaviour.peer_id, []).append(behaviour)
+
+    def get_behaviours(self, peer_id: str) -> List[PeerBehaviour]:
+        with self._mtx:
+            return list(self._by_peer.get(peer_id, ()))

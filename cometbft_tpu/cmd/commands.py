@@ -303,7 +303,7 @@ def cmd_reindex_event(args) -> int:
     from cometbft_tpu.abci import types as abci
     from cometbft_tpu.node.node import default_db_provider
     from cometbft_tpu.state.indexer import KVBlockIndexer, KVTxIndexer
-    from cometbft_tpu.types.event_bus import _abci_events_to_map
+    from cometbft_tpu.types.event_bus import merge_block_events
 
     cfg = _load_config(args.home)
     block_store, state_store = _node_dbs(cfg)
@@ -330,13 +330,10 @@ def cmd_reindex_event(args) -> int:
         except Exception as exc:
             print(f"no ABCI responses for height {h}: {exc}", file=sys.stderr)
             return 1
-        events = _abci_events_to_map(
-            getattr(responses.begin_block, "events", None)
+        events = merge_block_events(
+            getattr(responses.begin_block, "events", None),
+            getattr(responses.end_block, "events", None),
         )
-        for k, v in _abci_events_to_map(
-            getattr(responses.end_block, "events", None)
-        ).items():
-            events.setdefault(k, []).extend(v)
         block_indexer.index(events, h)
         batch = [
             abci.TxResult(height=h, index=i, tx=tx, result=responses.deliver_txs[i])

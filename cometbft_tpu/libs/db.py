@@ -153,6 +153,9 @@ class SQLiteDB(DB):
         self._path = path
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._local = threading.local()
+        self._all_conns: list = []  # every thread's conn, for close()
+        self._conns_mtx = threading.Lock()
+        self._closed = False
         conn = self._conn()
         conn.execute(
             "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID"
@@ -162,10 +165,18 @@ class SQLiteDB(DB):
     def _conn(self) -> sqlite3.Connection:
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = sqlite3.connect(self._path, timeout=30.0)
+            if self._closed:
+                raise RuntimeError(f"database {self._path} is closed")
+            # check_same_thread off so close() can reap other threads'
+            # connections; USE stays thread-local by discipline (self._local)
+            conn = sqlite3.connect(
+                self._path, timeout=30.0, check_same_thread=False
+            )
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=NORMAL")
             self._local.conn = conn
+            with self._conns_mtx:
+                self._all_conns.append(conn)
         return conn
 
     def get(self, key: bytes) -> Optional[bytes]:
@@ -224,10 +235,24 @@ class SQLiteDB(DB):
         self._conn().execute("VACUUM")
 
     def close(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+        """Close EVERY thread's connection, checkpointing the WAL so no
+        stale -wal/-shm sidecars or file locks are left for a maintenance
+        command opening the same files from another process. sqlite3
+        connections may only be CLOSED cross-thread, not used — fine here:
+        the owning threads have stopped (or will fail loudly)."""
+        self._closed = True
+        with self._conns_mtx:
+            conns, self._all_conns = self._all_conns, []
+        own = getattr(self._local, "conn", None)
+        for conn in conns:
+            try:
+                if conn is own:
+                    # checkpoint on the one connection this thread may use
+                    conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+                conn.close()
+            except sqlite3.Error:
+                pass
+        self._local.conn = None
 
 
 def new_db(name: str, backend: str, db_dir: str) -> DB:

@@ -1,0 +1,96 @@
+"""Network fault injection: a fuzzing socket wrapper.
+
+Reference: p2p/fuzz.go — FuzzedConnection wraps the raw conn under the
+SecretConnection and, per configured mode, randomly DROPS reads/writes
+(data vanishes), randomly kills the connection, or sleeps up to max_delay
+before each op (config/config.go:663-684). Used by the test harness to
+shake out reactor assumptions about reliable delivery.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+FUZZ_MODE_DROP = 0
+FUZZ_MODE_DELAY = 1
+
+
+@dataclass
+class FuzzConnConfig:
+    mode: int = FUZZ_MODE_DROP
+    max_delay: float = 3.0
+    prob_drop_rw: float = 0.2
+    prob_drop_conn: float = 0.0
+    prob_sleep: float = 0.0
+
+
+class FuzzedSocket:
+    """Wraps a socket-like object (recv/sendall/close — the surface
+    SecretConnection consumes). Fuzzing starts immediately, or after
+    `start_after` seconds (FuzzConnAfter)."""
+
+    def __init__(
+        self,
+        sock,
+        config: FuzzConnConfig = None,
+        start_after: float = 0.0,
+        rng: random.Random = None,
+    ):
+        self._sock = sock
+        self.config = config or FuzzConnConfig()
+        self._rng = rng or random.Random()
+        self._mtx = threading.Lock()
+        self._active = start_after <= 0
+        self._start_at = time.monotonic() + start_after
+        self.dropped_reads = 0
+        self.dropped_writes = 0
+
+    def _fuzz(self) -> bool:
+        """True → the caller should drop this op."""
+        with self._mtx:
+            if not self._active:
+                if time.monotonic() < self._start_at:
+                    return False
+                self._active = True
+            cfg = self.config
+            if cfg.mode == FUZZ_MODE_DROP:
+                r = self._rng.random()
+                if r < cfg.prob_drop_rw:
+                    return True
+                if r < cfg.prob_drop_rw + cfg.prob_drop_conn:
+                    self._sock.close()
+                    return True
+                if r < cfg.prob_drop_rw + cfg.prob_drop_conn + cfg.prob_sleep:
+                    time.sleep(self._rng.random() * cfg.max_delay)
+                return False
+            if cfg.mode == FUZZ_MODE_DELAY:
+                time.sleep(self._rng.random() * cfg.max_delay)
+            return False
+
+    # -- socket surface ------------------------------------------------------
+
+    def recv(self, n: int) -> bytes:
+        if self._fuzz():
+            # Go's fuzzer returns (0, nil) and the reader retries; here the
+            # stream above is AEAD-framed, so losing read bytes ALWAYS
+            # desyncs and kills the connection — surface that immediately
+            # instead of corrupting the cipher stream
+            self.dropped_reads += 1
+            self._sock.close()
+            return b""  # read loops treat empty recv as connection closed
+        return self._sock.recv(n)
+
+    def sendall(self, data: bytes) -> None:
+        if self._fuzz():
+            self.dropped_writes += 1
+            return  # silently swallowed (fuzz.go Write → 0, nil)
+        self._sock.sendall(data)
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)

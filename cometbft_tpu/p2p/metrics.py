@@ -26,6 +26,7 @@ class Metrics:
             SUBSYSTEM, "peer_send_bytes_total",
             "Number of bytes sent to a given peer.",
         )
+
     @classmethod
     def nop(cls) -> "Metrics":
         return cls(None)

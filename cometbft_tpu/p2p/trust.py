@@ -1,0 +1,140 @@
+"""Peer trust metric — EWMA over good/bad interaction history.
+
+Reference: p2p/trust/metric.go (ADR-006). The metric tracks a peer's
+reliability as a weighted mix of the current interval's proportional
+value R and the faded history H:
+
+    trust = weight_r * R + weight_h * H      (R weight 0.8, H weight 0.2)
+
+where R = good / (good + bad) for the current interval, and the history
+value is an exponentially-faded average over the last `max_intervals`
+interval results (most recent weighted highest). `tick()` closes an
+interval; tests drive it directly instead of a background timer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+DEFAULT_INTERVAL_S = 30.0
+MAX_HISTORY = 16
+WEIGHT_R = 0.8
+WEIGHT_H = 0.2
+
+
+class TrustMetric:
+    def __init__(self, max_intervals: int = MAX_HISTORY):
+        self._mtx = threading.Lock()
+        self.max_intervals = max_intervals
+        self._good = 0.0
+        self._bad = 0.0
+        self._history: List[float] = []  # most recent last
+        self._paused = False
+
+    # -- event input ---------------------------------------------------------
+
+    def good_events(self, n: int = 1) -> None:
+        with self._mtx:
+            self._paused = False  # any event resumes (metric.go unpause)
+            self._good += n
+
+    def bad_events(self, n: int = 1) -> None:
+        with self._mtx:
+            self._paused = False
+            self._bad += n
+
+    def pause(self) -> None:
+        """Freeze the metric (peer disconnected); resumes on next event."""
+        with self._mtx:
+            self._paused = True
+
+    # -- interval accounting ---------------------------------------------------
+
+    def tick(self) -> None:
+        """Close the current interval into history. While paused (peer
+        disconnected), intervals don't accumulate."""
+        with self._mtx:
+            if self._paused:
+                return
+            self._history.append(self._interval_value())
+            if len(self._history) > self.max_intervals:
+                self._history.pop(0)
+            self._good = 0.0
+            self._bad = 0.0
+
+    def _interval_value(self) -> float:
+        total = self._good + self._bad
+        if total == 0:
+            # an empty interval is neutral-positive: absence of evidence is
+            # not misbehavior
+            return 1.0
+        return self._good / total
+
+    def _history_value(self) -> float:
+        if not self._history:
+            return 1.0
+        # exponential fade: latest interval weighted 1, previous 1/2, 1/4...
+        num, den = 0.0, 0.0
+        weight = 1.0
+        for v in reversed(self._history):
+            num += v * weight
+            den += weight
+            weight /= 2
+        return num / den
+
+    def trust_value(self) -> float:
+        with self._mtx:
+            return WEIGHT_R * self._interval_value() + WEIGHT_H * self._history_value()
+
+    def trust_score(self) -> int:
+        """0-100 integer form (metric.go TrustScore)."""
+        return int(round(self.trust_value() * 100))
+
+
+class TrustMetricStore:
+    """Per-peer metric registry (p2p/trust/store.go), optionally persisted
+    by the caller via to_json/from_json."""
+
+    def __init__(self, max_intervals: int = MAX_HISTORY):
+        self._mtx = threading.Lock()
+        self._metrics: Dict[str, TrustMetric] = {}
+        self.max_intervals = max_intervals
+
+    def get_peer_trust_metric(self, peer_id: str) -> TrustMetric:
+        with self._mtx:
+            m = self._metrics.get(peer_id)
+            if m is None:
+                m = TrustMetric(self.max_intervals)
+                self._metrics[peer_id] = m
+            return m
+
+    def peer_disconnected(self, peer_id: str) -> None:
+        with self._mtx:
+            m = self._metrics.get(peer_id)
+        if m is not None:
+            m.pause()
+
+    def size(self) -> int:
+        with self._mtx:
+            return len(self._metrics)
+
+    def tick_all(self) -> None:
+        with self._mtx:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.tick()
+
+    def to_json(self) -> dict:
+        with self._mtx:
+            return {
+                pid: {"history": list(m._history)}
+                for pid, m in self._metrics.items()
+            }
+
+    def from_json(self, data: dict) -> None:
+        with self._mtx:
+            for pid, rec in data.items():
+                m = TrustMetric(self.max_intervals)
+                m._history = list(rec.get("history", []))[-self.max_intervals:]
+                self._metrics[pid] = m

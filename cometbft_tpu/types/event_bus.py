@@ -128,14 +128,20 @@ def _abci_events_to_map(events) -> Dict[str, List[str]]:
     return out
 
 
-def _merged_block_events(data) -> Dict[str, List[str]]:
-    """BeginBlock + EndBlock ABCI events merged into one composite map."""
-    events = _abci_events_to_map(getattr(data.result_begin_block, "events", None))
-    for k, v in _abci_events_to_map(
-        getattr(data.result_end_block, "events", None)
-    ).items():
+def merge_block_events(begin_events, end_events) -> Dict[str, List[str]]:
+    """BeginBlock + EndBlock ABCI event lists → one composite map. Shared
+    by live publishing and reindex-event so both index identically."""
+    events = _abci_events_to_map(begin_events)
+    for k, v in _abci_events_to_map(end_events).items():
         events.setdefault(k, []).extend(v)
     return events
+
+
+def _merged_block_events(data) -> Dict[str, List[str]]:
+    return merge_block_events(
+        getattr(data.result_begin_block, "events", None),
+        getattr(data.result_end_block, "events", None),
+    )
 
 
 class EventBus(BaseService):

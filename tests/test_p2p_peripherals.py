@@ -1,0 +1,298 @@
+"""P2P peripherals: FuzzedSocket fault injection, EWMA trust metric,
+behaviour reporter, and the PEX reactor's request/response flow over real
+switches.
+
+Model: reference p2p/fuzz.go, p2p/trust/metric_test.go,
+behaviour/reporter_test.go, p2p/pex/pex_reactor_test.go.
+"""
+
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.behaviour import (
+    MockReporter,
+    SwitchReporter,
+    bad_message,
+    block_part,
+    consensus_vote,
+)
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.p2p import (
+    MultiplexTransport,
+    NetAddress,
+    NodeInfo,
+    NodeKey,
+    ProtocolVersion,
+    Switch,
+)
+from cometbft_tpu.p2p.conn.connection import ChannelDescriptor
+from cometbft_tpu.p2p.conn.secret_connection import SecretConnection
+from cometbft_tpu.p2p.base_reactor import Reactor
+from cometbft_tpu.p2p.fuzz import (
+    FUZZ_MODE_DELAY,
+    FUZZ_MODE_DROP,
+    FuzzConnConfig,
+    FuzzedSocket,
+)
+from cometbft_tpu.p2p.pex.addrbook import AddrBook
+from cometbft_tpu.p2p.pex.reactor import PEX_CHANNEL, PEXReactor
+from cometbft_tpu.p2p.trust import TrustMetric, TrustMetricStore
+
+
+def _wait(cond, timeout=10.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError("condition not met before timeout")
+
+
+class TestFuzzedSocket:
+    def _pair(self):
+        return socket.socketpair()
+
+    def test_write_drops_lose_data(self):
+        a, b = self._pair()
+        fuzz = FuzzedSocket(
+            a,
+            FuzzConnConfig(mode=FUZZ_MODE_DROP, prob_drop_rw=1.0),
+            rng=random.Random(7),
+        )
+        fuzz.sendall(b"vanishes")
+        assert fuzz.dropped_writes == 1
+        b.settimeout(0.2)
+        with pytest.raises(TimeoutError):
+            b.recv(16)
+        a.close()
+        b.close()
+
+    def test_delay_mode_still_delivers(self):
+        a, b = self._pair()
+        fuzz = FuzzedSocket(
+            a,
+            FuzzConnConfig(mode=FUZZ_MODE_DELAY, max_delay=0.05),
+            rng=random.Random(7),
+        )
+        t0 = time.monotonic()
+        for _ in range(5):
+            fuzz.sendall(b"x")
+        assert b.recv(16)  # data arrives despite delays
+        assert time.monotonic() - t0 < 2.0
+        a.close()
+        b.close()
+
+    def test_fuzzing_starts_after_delay(self):
+        a, b = self._pair()
+        fuzz = FuzzedSocket(
+            a,
+            FuzzConnConfig(mode=FUZZ_MODE_DROP, prob_drop_rw=1.0),
+            start_after=30.0,
+            rng=random.Random(7),
+        )
+        fuzz.sendall(b"delivered")  # fuzzing not active yet
+        assert b.recv(16) == b"delivered"
+        assert fuzz.dropped_writes == 0
+        a.close()
+        b.close()
+
+    def test_secret_connection_survives_delay_fuzzing(self):
+        """An encrypted session over a delay-fuzzed wire still works."""
+        a, b = self._pair()
+        fa = FuzzedSocket(
+            a,
+            FuzzConnConfig(mode=FUZZ_MODE_DELAY, max_delay=0.01),
+            rng=random.Random(3),
+        )
+        k1, k2 = ed.gen_priv_key(), ed.gen_priv_key()
+        out = {}
+
+        def side_a():
+            out["a"] = SecretConnection.make(fa, k1)
+
+        t = threading.Thread(target=side_a, daemon=True)
+        t.start()
+        sc_b = SecretConnection.make(b, k2)
+        t.join(10)
+        sc_a = out["a"]
+        msg = b"over the fuzzed wire"
+        sc_a.write(msg)
+        assert sc_b.read_exact(len(msg)) == msg
+        sc_a.close()
+        sc_b.close()
+
+
+class TestTrustMetric:
+    def test_all_good_is_full_trust(self):
+        m = TrustMetric()
+        m.good_events(10)
+        assert m.trust_score() == 100
+
+    def test_bad_events_lower_trust(self):
+        m = TrustMetric()
+        m.good_events(1)
+        m.bad_events(9)
+        assert m.trust_value() < 0.5
+        assert 0 <= m.trust_score() <= 100
+
+    def test_history_fades(self):
+        m = TrustMetric()
+        # a terrible first interval...
+        m.bad_events(10)
+        m.tick()
+        low = m.trust_value()
+        # ...then consistently good intervals recover trust
+        for _ in range(8):
+            m.good_events(10)
+            m.tick()
+        assert m.trust_value() > low
+        assert m.trust_value() > 0.9
+
+    def test_pause_freezes_ticks_until_next_event(self):
+        """Reference metric.go: pause stops interval accounting; ANY
+        event (good or bad) resumes and is itself counted."""
+        m = TrustMetric()
+        m.bad_events(10)
+        m.tick()
+        m.pause()
+        history_len = len(m._history)
+        m.tick()
+        m.tick()
+        assert len(m._history) == history_len  # frozen while paused
+        m.good_events(1)  # resumes AND counts
+        m.tick()
+        assert len(m._history) == history_len + 1
+        assert m._history[-1] == 1.0
+
+    def test_store(self):
+        store = TrustMetricStore()
+        a = store.get_peer_trust_metric("peerA")
+        assert store.get_peer_trust_metric("peerA") is a
+        a.bad_events(5)
+        a.tick()
+        store.tick_all()
+        blob = store.to_json()
+        restored = TrustMetricStore()
+        restored.from_json(blob)
+        assert restored.size() == 1
+        assert restored.get_peer_trust_metric("peerA")._history
+
+
+# -- behaviour reporter over real switches -----------------------------------
+
+
+class _NopReactor(Reactor):
+    def __init__(self, chs):
+        super().__init__("nop")
+        self.chs = chs
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=c, priority=1) for c in self.chs]
+
+    def add_peer(self, peer):
+        pass
+
+    def remove_peer(self, peer, reason):
+        pass
+
+    def receive(self, ch_id, peer, msg_bytes):
+        pass
+
+
+def _make_switch(network="bhv-chain", chs=(0x01,), pex=False,
+                 addr_book=None, seeds=None):
+    nk = NodeKey(ed.gen_priv_key())
+    channels = bytes(list(chs) + ([PEX_CHANNEL] if pex else []))
+    info = NodeInfo(
+        protocol_version=ProtocolVersion(),
+        node_id=nk.id(),
+        listen_addr="127.0.0.1:0",
+        network=network,
+        channels=channels,
+        moniker="peripheral-test",
+    )
+    t = MultiplexTransport(info, nk)
+    t.listen(NetAddress("", "127.0.0.1", 0))
+    info.listen_addr = f"127.0.0.1:{t.listen_addr.port}"
+    sw = Switch(t, reconnect_interval=0.1)
+    sw.add_reactor("nop", _NopReactor(list(chs)))
+    pex_r = None
+    if pex:
+        book = addr_book or AddrBook(file_path="", routability_strict=False)
+        pex_r = PEXReactor(
+            book, seeds=seeds or [], ensure_peers_period=0.2
+        )
+        sw.add_reactor("PEX", pex_r)
+        sw.addr_book = book
+    return sw, pex_r
+
+
+class TestBehaviourReporter:
+    def test_mock_reporter_records(self):
+        r = MockReporter()
+        r.report(consensus_vote("p1"))
+        r.report(bad_message("p1", "garbage"))
+        got = r.get_behaviours("p1")
+        assert [b.reason for b in got] == ["consensus_vote", "bad_message"]
+        assert r.get_behaviours("p2") == []
+
+    def test_switch_reporter_stops_bad_peer(self):
+        sw1, _ = _make_switch()
+        sw2, _ = _make_switch()
+        sw1.start()
+        sw2.start()
+        try:
+            sw2.dial_peer_with_address(sw1.transport.listen_addr)
+            _wait(lambda: sw1.peers.size() == 1)
+            peer_id = sw1.peers.list()[0].id()
+            SwitchReporter(sw1).report(bad_message(peer_id, "bad wire bytes"))
+            _wait(lambda: sw1.peers.size() == 0)
+            with pytest.raises(ValueError):
+                SwitchReporter(sw1).report(block_part("missing-peer"))
+        finally:
+            sw1.stop()
+            sw2.stop()
+
+
+@pytest.mark.slow
+class TestPEXOverRealSwitches:
+    def test_addrs_flow_and_third_node_is_dialed(self):
+        """C knows only B; B knows A. Via PEX request/response C learns A's
+        address and its ensure-peers loop dials A (pex_reactor_test.go
+        TestPEXReactorAbuseAttackPeer-adjacent happy path)."""
+        sw_a, _ = _make_switch(pex=True)
+        sw_b, pex_b = _make_switch(pex=True)
+        sw_a.start()
+        sw_b.start()
+        a_addr = sw_a.transport.listen_addr
+        b_addr = sw_b.transport.listen_addr
+        try:
+            # B dials A so B's book learns A's address
+            sw_b.add_persistent_peers([f"{a_addr.id}@127.0.0.1:{a_addr.port}"])
+            sw_b.dial_peer_with_address(a_addr)
+            _wait(lambda: sw_b.peers.size() == 1)
+            pex_b.book.add_address(a_addr, a_addr)
+
+            # C boots knowing only B as seed
+            sw_c, pex_c = _make_switch(
+                pex=True, seeds=[f"{b_addr.id}@127.0.0.1:{b_addr.port}"]
+            )
+            sw_c.start()
+            try:
+                # C must end up connected to BOTH B (seed) and A (learned
+                # via a PEX addrs response)
+                _wait(
+                    lambda: {p.id() for p in sw_c.peers.list()}
+                    >= {a_addr.id, b_addr.id},
+                    timeout=30.0,
+                )
+                assert pex_c.book.has_address(a_addr)
+            finally:
+                sw_c.stop()
+        finally:
+            sw_b.stop()
+            sw_a.stop()

@@ -84,9 +84,7 @@ class TestRollback:
         to roll back (rollback.go:26-31)."""
         state, ss, bs = _build_chain(5)
         older = Store(MemDB())
-        # simulate the state store lagging one height
-        state_at_4 = ss.load_validators  # noqa: F841  (store intact)
-        # rebuild: store state for height 4 only
+        # simulate the state store lagging one height behind the blockstore
         s4 = state.copy()
         s4.last_block_height = 4
         older.save(s4)
