@@ -165,8 +165,6 @@ class SQLiteDB(DB):
     def _conn(self) -> sqlite3.Connection:
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            if self._closed:
-                raise RuntimeError(f"database {self._path} is closed")
             # check_same_thread off so close() can reap other threads'
             # connections; USE stays thread-local by discipline (self._local)
             conn = sqlite3.connect(
@@ -174,9 +172,15 @@ class SQLiteDB(DB):
             )
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=NORMAL")
-            self._local.conn = conn
+            # register under the lock, re-checking closed INSIDE it — a
+            # thread racing close() must not leave an untracked live
+            # connection holding the file lock
             with self._conns_mtx:
+                if self._closed:
+                    conn.close()
+                    raise RuntimeError(f"database {self._path} is closed")
                 self._all_conns.append(conn)
+            self._local.conn = conn
         return conn
 
     def get(self, key: bytes) -> Optional[bytes]:
@@ -237,18 +241,19 @@ class SQLiteDB(DB):
     def close(self) -> None:
         """Close EVERY thread's connection, checkpointing the WAL so no
         stale -wal/-shm sidecars or file locks are left for a maintenance
-        command opening the same files from another process. sqlite3
-        connections may only be CLOSED cross-thread, not used — fine here:
-        the owning threads have stopped (or will fail loudly)."""
-        self._closed = True
+        command opening the same files from another process. Connections
+        are opened check_same_thread=False, so the closing thread may
+        checkpoint and close them all — safe because by close() time the
+        owning worker threads have stopped using them."""
         with self._conns_mtx:
+            self._closed = True
             conns, self._all_conns = self._all_conns, []
-        own = getattr(self._local, "conn", None)
+        checkpointed = False
         for conn in conns:
             try:
-                if conn is own:
-                    # checkpoint on the one connection this thread may use
+                if not checkpointed:
                     conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+                    checkpointed = True
                 conn.close()
             except sqlite3.Error:
                 pass

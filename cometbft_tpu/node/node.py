@@ -186,13 +186,33 @@ class Node(BaseService):
         )
         self.indexer_service.start()
 
-        Handshaker(
-            self.state_store, state, self.block_store, genesis_doc,
-            event_bus=self.event_bus, logger=self.logger,
-        ).handshake(self.proxy_app)
-        state = self.state_store.load() or state
+        self._privval_endpoint = None
+        try:
+            Handshaker(
+                self.state_store, state, self.block_store, genesis_doc,
+                event_bus=self.event_bus, logger=self.logger,
+            ).handshake(self.proxy_app)
+            state = self.state_store.load() or state
 
-        # 5. privval
+            # 5. privval — a remote signer replaces the file-backed one
+            # when priv_validator_laddr is set (node.go:755-761,1451)
+            if config.base.priv_validator_laddr:
+                from cometbft_tpu.privval.socket import (
+                    SignerClient,
+                    SignerListenerEndpoint,
+                )
+
+                endpoint = SignerListenerEndpoint(
+                    config.base.priv_validator_laddr, logger=self.logger
+                )
+                self._privval_endpoint = endpoint
+                endpoint.wait_for_connection(30.0)
+                priv_validator = SignerClient(endpoint, genesis_doc.chain_id)
+        except Exception:
+            # constructor failure after services started: release threads,
+            # sockets, and DB file locks instead of leaking a half-node
+            self._abort_init()
+            raise
         self.priv_validator = priv_validator
         pub_key = priv_validator.get_pub_key() if priv_validator else None
 
@@ -358,6 +378,28 @@ class Node(BaseService):
 
     # -- lifecycle ----------------------------------------------------------
 
+    def _abort_init(self) -> None:
+        """Best-effort teardown of the services __init__ already started."""
+        for svc in (
+            getattr(self, "_privval_endpoint", None),
+            getattr(self, "indexer_service", None),
+            getattr(self, "event_bus", None),
+            getattr(self, "proxy_app", None),
+        ):
+            if svc is None:
+                continue
+            try:
+                if hasattr(svc, "is_running") and not svc.is_running():
+                    continue
+                (svc.stop if hasattr(svc, "stop") else svc.close)()
+            except Exception:
+                pass
+        for db in getattr(self, "_dbs", ()):
+            try:
+                db.close()
+            except Exception:
+                pass
+
     def on_start(self) -> None:
         host, port = _parse_laddr(self.config.p2p.laddr)
         self.transport.listen(NetAddress(self.node_key.id(), host, port))
@@ -449,6 +491,8 @@ class Node(BaseService):
                 self.logger.error("error stopping service", err=str(exc))
         if self.consensus_state.is_running():
             self.consensus_state.stop()
+        if self._privval_endpoint is not None:
+            self._privval_endpoint.close()
         # release DB file locks so maintenance commands (rollback,
         # reindex-event) can open the same files from another process
         for db in self._dbs:

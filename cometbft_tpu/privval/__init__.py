@@ -17,6 +17,13 @@ from cometbft_tpu.privval.file import (
     load_file_pv,
     load_or_gen_file_pv,
 )
+from cometbft_tpu.privval.socket import (
+    RemoteSignerError,
+    SignerClient,
+    SignerDialerEndpoint,
+    SignerListenerEndpoint,
+    SignerServer,
+)
 
 __all__ = [
     "STEP_NONE",
@@ -25,6 +32,11 @@ __all__ = [
     "STEP_PROPOSE",
     "FilePV",
     "FilePVLastSignState",
+    "RemoteSignerError",
+    "SignerClient",
+    "SignerDialerEndpoint",
+    "SignerListenerEndpoint",
+    "SignerServer",
     "gen_file_pv",
     "load_file_pv",
     "load_or_gen_file_pv",

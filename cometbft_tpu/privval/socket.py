@@ -1,0 +1,686 @@
+"""Remote signing over a socket — the HSM/KMS boundary.
+
+Reference: privval/{signer_client,signer_server,signer_listener_endpoint,
+signer_dialer_endpoint,signer_requestHandler}.go and
+proto/tendermint/privval/types.proto. Two deployment shapes, same wire
+protocol (varint-delimited privval.Message frames):
+
+  * the NODE listens (`priv_validator_laddr`) and the remote signer dials
+    in → SignerListenerEndpoint on the node + SignerServer(DialerEndpoint)
+    on the signer box;
+  * tests/tools may flip who dials — endpoints only own connect/accept.
+
+SignerClient implements the PrivValidator interface over the endpoint, so
+consensus cannot tell a remote signer from a local FilePV. Signing errors
+(double-sign guard!) travel back as RemoteSignerError and surface as
+exceptions.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.proto.keys import PublicKeyProto
+from cometbft_tpu.types.priv_validator import PrivValidator
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.vote import Vote
+
+MAX_MSG_SIZE = 1024 * 10  # generous bound on one privval frame
+
+# privval.Errors enum
+ERR_UNKNOWN = 0
+ERR_UNEXPECTED_RESPONSE = 1
+ERR_NO_CONNECTION = 2
+ERR_CONNECTION_TIMEOUT = 3
+ERR_READ_TIMEOUT = 4
+ERR_WRITE_TIMEOUT = 5
+
+
+class RemoteSignerError(Exception):
+    def __init__(self, code: int, description: str):
+        super().__init__(f"remote signer error (code {code}): {description}")
+        self.code = code
+        self.description = description
+
+
+# --- wire messages (proto/tendermint/privval/types.proto) -------------------
+
+
+@dataclass
+class PubKeyRequest:
+    chain_id: str = ""
+
+    def encode(self) -> bytes:
+        return protoio.field_string(1, self.chain_id) if self.chain_id else b""
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PubKeyRequest":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.chain_id = r.read_string()
+            else:
+                r.skip(wt)
+        return out
+
+
+def _encode_error(err) -> bytes:
+    out = b""
+    if err is None:
+        return out
+    code, desc = err
+    if code:
+        out += protoio.field_varint(1, code)
+    if desc:
+        out += protoio.field_string(2, desc)
+    return out
+
+
+def _decode_error(data: bytes) -> Tuple[int, str]:
+    r = protoio.WireReader(data)
+    code, desc = 0, ""
+    while not r.at_end():
+        f, wt = r.read_tag()
+        if f == 1:
+            code = r.read_varint()
+        elif f == 2:
+            desc = r.read_string()
+        else:
+            r.skip(wt)
+    return code, desc
+
+
+@dataclass
+class PubKeyResponse:
+    pub_key: Optional[PublicKeyProto] = None
+    error: Optional[Tuple[int, str]] = None
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.pub_key is not None:
+            out += protoio.field_message(1, self.pub_key.encode())
+        if self.error is not None:
+            out += protoio.field_message(2, _encode_error(self.error))
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PubKeyResponse":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.pub_key = PublicKeyProto.decode(r.read_bytes())
+            elif f == 2:
+                out.error = _decode_error(r.read_bytes())
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class SignVoteRequest:
+    vote: Optional[Vote] = None
+    chain_id: str = ""
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.vote is not None:
+            out += protoio.field_message(1, self.vote.encode())
+        if self.chain_id:
+            out += protoio.field_string(2, self.chain_id)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignVoteRequest":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.vote = Vote.decode(r.read_bytes())
+            elif f == 2:
+                out.chain_id = r.read_string()
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class SignedVoteResponse:
+    vote: Optional[Vote] = None
+    error: Optional[Tuple[int, str]] = None
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.vote is not None:
+            out += protoio.field_message(1, self.vote.encode())
+        if self.error is not None:
+            out += protoio.field_message(2, _encode_error(self.error))
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignedVoteResponse":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.vote = Vote.decode(r.read_bytes())
+            elif f == 2:
+                out.error = _decode_error(r.read_bytes())
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class SignProposalRequest:
+    proposal: Optional[Proposal] = None
+    chain_id: str = ""
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.proposal is not None:
+            out += protoio.field_message(1, self.proposal.encode())
+        if self.chain_id:
+            out += protoio.field_string(2, self.chain_id)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignProposalRequest":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.proposal = Proposal.decode(r.read_bytes())
+            elif f == 2:
+                out.chain_id = r.read_string()
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class SignedProposalResponse:
+    proposal: Optional[Proposal] = None
+    error: Optional[Tuple[int, str]] = None
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.proposal is not None:
+            out += protoio.field_message(1, self.proposal.encode())
+        if self.error is not None:
+            out += protoio.field_message(2, _encode_error(self.error))
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "SignedProposalResponse":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.proposal = Proposal.decode(r.read_bytes())
+            elif f == 2:
+                out.error = _decode_error(r.read_bytes())
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class PingRequest:
+    def encode(self) -> bytes:
+        return b""
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PingRequest":
+        return cls()
+
+
+@dataclass
+class PingResponse:
+    def encode(self) -> bytes:
+        return b""
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PingResponse":
+        return cls()
+
+
+_BY_FIELD = {
+    1: PubKeyRequest,
+    2: PubKeyResponse,
+    3: SignVoteRequest,
+    4: SignedVoteResponse,
+    5: SignProposalRequest,
+    6: SignedProposalResponse,
+    7: PingRequest,
+    8: PingResponse,
+}
+_FIELD_BY_TYPE = {cls: num for num, cls in _BY_FIELD.items()}
+
+
+def encode_privval_message(msg) -> bytes:
+    num = _FIELD_BY_TYPE.get(type(msg))
+    if num is None:
+        raise ValueError(f"unknown privval message {type(msg)}")
+    return protoio.field_message(num, msg.encode())
+
+
+def decode_privval_message(data: bytes):
+    r = protoio.WireReader(data)
+    while not r.at_end():
+        f, wt = r.read_tag()
+        cls = _BY_FIELD.get(f)
+        if cls is not None:
+            return cls.decode(r.read_bytes())
+        r.skip(wt)
+    raise ValueError("empty privval Message")
+
+
+# --- endpoints --------------------------------------------------------------
+
+
+def _parse_addr(addr: str) -> Tuple[str, object]:
+    """tcp://host:port or unix:///path → (family, target)."""
+    if addr.startswith("unix://"):
+        return "unix", addr[len("unix://"):]
+    hostport = addr.split("://", 1)[-1]
+    host, _, port = hostport.rpartition(":")
+    return "tcp", (host or "127.0.0.1", int(port))
+
+
+class _Endpoint:
+    """One connected signer link: framed send/recv with timeouts."""
+
+    def __init__(self, timeout_read: float = 5.0):
+        self._conn: Optional[socket.socket] = None
+        self._mtx = threading.Lock()
+        self.timeout_read = timeout_read
+        # request/response callers (SignerClient) must tear the conn down
+        # on a read timeout or a late reply desyncs the pairing; a pure
+        # serve loop (SignerServer) times out idly all the time and keeps
+        # the conn
+        self.drop_conn_on_read_timeout = True
+
+    def is_connected(self) -> bool:
+        with self._mtx:
+            return self._conn is not None
+
+    def _set_conn(self, conn: Optional[socket.socket]) -> None:
+        with self._mtx:
+            old, self._conn = self._conn, conn
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+
+    def send_msg(self, msg) -> None:
+        with self._mtx:
+            conn = self._conn
+        if conn is None:
+            raise RemoteSignerError(ERR_NO_CONNECTION, "not connected")
+        data = protoio.marshal_delimited(encode_privval_message(msg))
+        try:
+            conn.sendall(data)
+        except OSError as exc:
+            self._set_conn(None)
+            raise RemoteSignerError(ERR_WRITE_TIMEOUT, str(exc)) from exc
+
+    def recv_msg(self):
+        with self._mtx:
+            conn = self._conn
+        if conn is None:
+            raise RemoteSignerError(ERR_NO_CONNECTION, "not connected")
+        try:
+            conn.settimeout(self.timeout_read)
+            length = 0
+            shift = 0
+            while True:
+                if shift > 63:  # varint64 bound — garbage stream
+                    raise ValueError("malformed frame-length varint")
+                b = conn.recv(1)
+                if not b:
+                    raise ConnectionError("closed")
+                length |= (b[0] & 0x7F) << shift
+                if not b[0] & 0x80:
+                    break
+                shift += 7
+            if length > MAX_MSG_SIZE:
+                raise ValueError(f"privval frame too large: {length}")
+            buf = bytearray()
+            while len(buf) < length:
+                chunk = conn.recv(length - len(buf))
+                if not chunk:
+                    raise ConnectionError("closed mid-frame")
+                buf.extend(chunk)
+            return decode_privval_message(bytes(buf))
+        except socket.timeout as exc:
+            if self.drop_conn_on_read_timeout:
+                self._set_conn(None)
+            raise RemoteSignerError(ERR_READ_TIMEOUT, "read timed out") from exc
+        except ValueError as exc:
+            self._set_conn(None)
+            raise RemoteSignerError(ERR_UNEXPECTED_RESPONSE, str(exc)) from exc
+        except (OSError, ConnectionError) as exc:
+            self._set_conn(None)
+            raise RemoteSignerError(ERR_NO_CONNECTION, str(exc)) from exc
+
+    def close(self) -> None:
+        self._set_conn(None)
+
+
+class _SecretStream:
+    """Adapts SecretConnection to the recv/sendall/settimeout/close
+    surface _Endpoint consumes."""
+
+    def __init__(self, sc):
+        self._sc = sc
+
+    def recv(self, n: int) -> bytes:
+        return self._sc.read(n)
+
+    def sendall(self, data: bytes) -> None:
+        self._sc.write(data)
+
+    def settimeout(self, t) -> None:
+        self._sc._sock.settimeout(t)
+
+    def close(self) -> None:
+        self._sc.close()
+
+
+def _maybe_secure(conn, priv_key, authorized_key: Optional[bytes]):
+    """Wrap a raw socket in an authenticated SecretConnection when a local
+    key is configured (the reference protects this link with
+    SecretConnection — privval/socket_dialers.go). Raises on handshake
+    failure or an unauthorized remote key."""
+    if priv_key is None:
+        return conn
+    from cometbft_tpu.p2p.conn.secret_connection import SecretConnection
+
+    sc = SecretConnection.make(conn, priv_key)
+    if authorized_key is not None and sc.rem_pub_key.bytes() != authorized_key:
+        sc.close()
+        raise RemoteSignerError(
+            ERR_UNKNOWN, "remote signer key is not the authorized key"
+        )
+    return _SecretStream(sc)
+
+
+class SignerListenerEndpoint(_Endpoint):
+    """Node side: listen on priv_validator_laddr, accept the signer's dial
+    (signer_listener_endpoint.go). With `priv_key` set, the link runs
+    through an authenticated SecretConnection and `authorized_key` pins
+    the signer's identity. A new dial never displaces a live, healthy
+    signer connection."""
+
+    def __init__(self, addr: str, timeout_read: float = 5.0,
+                 priv_key=None, authorized_key: Optional[bytes] = None,
+                 logger: Optional[Logger] = None):
+        super().__init__(timeout_read)
+        self.logger = logger or new_nop_logger()
+        self._priv_key = priv_key
+        self._authorized_key = authorized_key
+        fam, target = _parse_addr(addr)
+        if fam == "unix":
+            import os
+
+            try:
+                os.unlink(target)
+            except OSError:
+                pass
+            self._listener = socket.socket(socket.AF_UNIX)
+            self._listener.bind(target)
+        else:
+            self._listener = socket.socket()
+            self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind(target)
+        self._listener.listen(1)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="privval-accept", daemon=True
+        )
+        self._stopped = threading.Event()
+        self._connected_ev = threading.Event()
+        self._accept_thread.start()
+
+    @property
+    def listen_port(self) -> int:
+        try:
+            return self._listener.getsockname()[1]
+        except (OSError, IndexError, TypeError):
+            return 0
+
+    def _accept_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            if self.is_connected() and self._priv_key is None:
+                # on an UNAUTHENTICATED link, never let a new dial displace
+                # the live signer — that would be a trivial signing DoS.
+                # (A dead-but-undetected conn clears on its next IO error,
+                # after which the signer's dial retry lands.)
+                self.logger.error(
+                    "rejecting connection: signer already connected"
+                )
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            try:
+                conn = _maybe_secure(conn, self._priv_key, self._authorized_key)
+            except Exception as exc:
+                # handshake failures never displace the existing conn
+                self.logger.error("signer handshake failed", err=str(exc))
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                continue
+            self.logger.info("remote signer connected")
+            self._set_conn(conn)
+            self._connected_ev.set()
+
+    def wait_for_connection(self, max_wait: float) -> None:
+        if not self._connected_ev.wait(max_wait):
+            raise RemoteSignerError(
+                ERR_CONNECTION_TIMEOUT, "no signer connected"
+            )
+
+    def close(self) -> None:
+        self._stopped.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        super().close()
+
+
+class SignerDialerEndpoint(_Endpoint):
+    """Signer side: dial the node (signer_dialer_endpoint.go), with
+    bounded retries."""
+
+    def __init__(
+        self,
+        addr: str,
+        timeout_read: float = 5.0,
+        max_retries: int = 10,
+        retry_wait: float = 0.2,
+        priv_key=None,
+        authorized_key: Optional[bytes] = None,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__(timeout_read)
+        self.addr = addr
+        self.max_retries = max_retries
+        self.retry_wait = retry_wait
+        self._priv_key = priv_key
+        self._authorized_key = authorized_key
+        self.logger = logger or new_nop_logger()
+
+    def connect(self) -> None:
+        import time
+
+        fam, target = _parse_addr(self.addr)
+        last = None
+        for _ in range(self.max_retries):
+            try:
+                if fam == "unix":
+                    s = socket.socket(socket.AF_UNIX)
+                else:
+                    s = socket.socket()
+                s.connect(target)
+                s = _maybe_secure(s, self._priv_key, self._authorized_key)
+                self._set_conn(s)
+                return
+            except OSError as exc:
+                last = exc
+                time.sleep(self.retry_wait)
+        raise RemoteSignerError(
+            ERR_NO_CONNECTION, f"dial {self.addr} failed: {last}"
+        )
+
+
+# --- client (node side) -----------------------------------------------------
+
+
+class SignerClient(PrivValidator):
+    """PrivValidator over a connected endpoint (signer_client.go)."""
+
+    def __init__(self, endpoint: _Endpoint, chain_id: str):
+        self.endpoint = endpoint
+        self.chain_id = chain_id
+        self._mtx = threading.Lock()  # one request in flight at a time
+
+    def _call(self, req, want_cls):
+        with self._mtx:
+            self.endpoint.send_msg(req)
+            resp = self.endpoint.recv_msg()
+        if not isinstance(resp, want_cls):
+            raise RemoteSignerError(
+                ERR_UNEXPECTED_RESPONSE, f"got {type(resp).__name__}"
+            )
+        if getattr(resp, "error", None) is not None:
+            code, desc = resp.error
+            raise RemoteSignerError(code, desc)
+        return resp
+
+    def ping(self) -> None:
+        self._call(PingRequest(), PingResponse)
+
+    def get_pub_key(self):
+        resp = self._call(PubKeyRequest(self.chain_id), PubKeyResponse)
+        if resp.pub_key is None:
+            raise RemoteSignerError(ERR_UNEXPECTED_RESPONSE, "no pubkey")
+        if resp.pub_key.type != ed25519.KEY_TYPE:
+            raise RemoteSignerError(
+                ERR_UNEXPECTED_RESPONSE,
+                f"unsupported key type {resp.pub_key.type}",
+            )
+        return ed25519.PubKeyEd25519(resp.pub_key.data)
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> None:
+        resp = self._call(
+            SignVoteRequest(vote=vote, chain_id=chain_id), SignedVoteResponse
+        )
+        if resp.vote is None:
+            raise RemoteSignerError(ERR_UNEXPECTED_RESPONSE, "no vote")
+        vote.signature = resp.vote.signature
+        vote.timestamp = resp.vote.timestamp
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
+        resp = self._call(
+            SignProposalRequest(proposal=proposal, chain_id=chain_id),
+            SignedProposalResponse,
+        )
+        if resp.proposal is None:
+            raise RemoteSignerError(ERR_UNEXPECTED_RESPONSE, "no proposal")
+        proposal.signature = resp.proposal.signature
+        proposal.timestamp = resp.proposal.timestamp
+
+
+# --- server (signer side) ---------------------------------------------------
+
+
+class SignerServer:
+    """Serves a PrivValidator (normally a FilePV) over an endpoint
+    (signer_server.go + signer_requestHandler.go)."""
+
+    def __init__(self, endpoint: _Endpoint, chain_id: str, priv_val):
+        self.endpoint = endpoint
+        self.endpoint.drop_conn_on_read_timeout = False  # idle is normal
+        self.chain_id = chain_id
+        self.priv_val = priv_val
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._serve_loop, name="signer-server", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self.endpoint.close()
+
+    def _serve_loop(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                req = self.endpoint.recv_msg()
+            except RemoteSignerError as exc:
+                if exc.code == ERR_READ_TIMEOUT:
+                    continue  # idle; keep serving
+                return  # connection gone
+            try:
+                resp = self._handle(req)
+            except Exception as exc:  # noqa: BLE001 — errors go on the wire
+                resp = self._error_response(req, str(exc))
+            try:
+                self.endpoint.send_msg(resp)
+            except RemoteSignerError:
+                return
+
+    def _handle(self, req):
+        if isinstance(req, PubKeyRequest):
+            pk = self.priv_val.get_pub_key()
+            return PubKeyResponse(
+                pub_key=PublicKeyProto(ed25519.KEY_TYPE, pk.bytes())
+            )
+        if isinstance(req, SignVoteRequest):
+            vote = req.vote
+            self.priv_val.sign_vote(req.chain_id or self.chain_id, vote)
+            return SignedVoteResponse(vote=vote)
+        if isinstance(req, SignProposalRequest):
+            proposal = req.proposal
+            self.priv_val.sign_proposal(
+                req.chain_id or self.chain_id, proposal
+            )
+            return SignedProposalResponse(proposal=proposal)
+        if isinstance(req, PingRequest):
+            return PingResponse()
+        raise ValueError(f"unexpected request {type(req).__name__}")
+
+    @staticmethod
+    def _error_response(req, desc: str):
+        err = (ERR_UNKNOWN, desc)
+        if isinstance(req, SignVoteRequest):
+            return SignedVoteResponse(error=err)
+        if isinstance(req, SignProposalRequest):
+            return SignedProposalResponse(error=err)
+        return PubKeyResponse(error=err)

@@ -1,0 +1,324 @@
+"""Remote signing over sockets: wire codec, client/server round-trips,
+double-sign guard propagation, and a node committing blocks with ONLY a
+remote signer.
+
+Model: reference privval/signer_client_test.go + signer_server tests.
+"""
+
+import os
+import socket
+import tempfile
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.privval import (
+    FilePV,
+    RemoteSignerError,
+    SignerClient,
+    SignerDialerEndpoint,
+    SignerListenerEndpoint,
+    SignerServer,
+    gen_file_pv,
+)
+from cometbft_tpu.privval.socket import (
+    PingRequest,
+    PubKeyRequest,
+    PubKeyResponse,
+    SignedVoteResponse,
+    SignVoteRequest,
+    decode_privval_message,
+    encode_privval_message,
+)
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.types.block import BlockID, PartSetHeader
+from cometbft_tpu.types.vote import (
+    SIGNED_MSG_TYPE_PRECOMMIT as PRECOMMIT_TYPE,
+    SIGNED_MSG_TYPE_PREVOTE as PREVOTE_TYPE,
+    Vote,
+)
+
+CHAIN_ID = "privval-sock-chain"
+
+
+def _vote(height=5, round_=0, type_=PREVOTE_TYPE):
+    return Vote(
+        type=type_,
+        height=height,
+        round=round_,
+        block_id=BlockID(b"\x11" * 32, PartSetHeader(1, b"\x22" * 32)),
+        timestamp=Timestamp(1_700_000_000, 0),
+        validator_address=b"\x01" * 20,
+        validator_index=0,
+    )
+
+
+def _pair(tmp):
+    """A connected (SignerClient, SignerServer, FilePV) over a unix socket."""
+    sock_path = os.path.join(tmp, "signer.sock")
+    listener = SignerListenerEndpoint(f"unix://{sock_path}", timeout_read=1.0)
+    pv = gen_file_pv(
+        os.path.join(tmp, "key.json"), os.path.join(tmp, "state.json")
+    )
+    dialer = SignerDialerEndpoint(f"unix://{sock_path}", timeout_read=1.0)
+    dialer.connect()
+    server = SignerServer(dialer, CHAIN_ID, pv)
+    server.start()
+    listener.wait_for_connection(5.0)
+    client = SignerClient(listener, CHAIN_ID)
+    return client, server, pv, listener
+
+
+class TestCodec:
+    def test_roundtrip(self):
+        msgs = [
+            PubKeyRequest(CHAIN_ID),
+            PubKeyResponse(error=(2, "no key")),
+            SignVoteRequest(vote=_vote(), chain_id=CHAIN_ID),
+            SignedVoteResponse(vote=_vote()),
+            PingRequest(),
+        ]
+        for m in msgs:
+            dec = decode_privval_message(encode_privval_message(m))
+            assert type(dec) is type(m)
+        dec = decode_privval_message(
+            encode_privval_message(SignVoteRequest(vote=_vote(7), chain_id=CHAIN_ID))
+        )
+        assert dec.vote.height == 7 and dec.chain_id == CHAIN_ID
+        with pytest.raises(Exception):
+            decode_privval_message(b"")
+
+
+class TestSignerClientServer:
+    def test_pubkey_ping_and_vote_signing(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            client, server, pv, listener = _pair(tmp)
+            try:
+                client.ping()
+                pk = client.get_pub_key()
+                assert pk.bytes() == pv.get_pub_key().bytes()
+
+                vote = _vote()
+                client.sign_vote(CHAIN_ID, vote)
+                assert vote.signature
+                # the signature is the same one the local FilePV would make,
+                # and it verifies against the canonical sign bytes
+                assert pk.verify_signature(
+                    vote.sign_bytes(CHAIN_ID), vote.signature
+                )
+            finally:
+                server.stop()
+                listener.close()
+
+    def test_double_sign_guard_travels_the_wire(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            client, server, pv, listener = _pair(tmp)
+            try:
+                v1 = _vote(height=10, type_=PRECOMMIT_TYPE)
+                client.sign_vote(CHAIN_ID, v1)
+                # conflicting precommit at the same HRS → RemoteSignerError
+                v2 = _vote(height=10, type_=PRECOMMIT_TYPE)
+                v2.block_id = BlockID(b"\x99" * 32, PartSetHeader(1, b"\x88" * 32))
+                with pytest.raises(RemoteSignerError):
+                    client.sign_vote(CHAIN_ID, v2)
+                # height regression also rejected
+                v3 = _vote(height=9, type_=PRECOMMIT_TYPE)
+                with pytest.raises(RemoteSignerError):
+                    client.sign_vote(CHAIN_ID, v3)
+            finally:
+                server.stop()
+                listener.close()
+
+    def test_proposal_signing(self):
+        from cometbft_tpu.types.proposal import Proposal
+
+        with tempfile.TemporaryDirectory() as tmp:
+            client, server, pv, listener = _pair(tmp)
+            try:
+                prop = Proposal(
+                    height=3,
+                    round=0,
+                    pol_round=-1,
+                    block_id=BlockID(b"\x07" * 32, PartSetHeader(1, b"\x08" * 32)),
+                    timestamp=Timestamp(1_700_000_000, 0),
+                )
+                client.sign_proposal(CHAIN_ID, prop)
+                assert prop.signature
+                assert client.get_pub_key().verify_signature(
+                    prop.sign_bytes(CHAIN_ID), prop.signature
+                )
+            finally:
+                server.stop()
+                listener.close()
+
+    def test_tcp_endpoints(self):
+        listener = SignerListenerEndpoint("tcp://127.0.0.1:0", timeout_read=1.0)
+        port = listener.listen_port
+        with tempfile.TemporaryDirectory() as tmp:
+            pv = gen_file_pv(
+                os.path.join(tmp, "k.json"), os.path.join(tmp, "s.json")
+            )
+            dialer = SignerDialerEndpoint(
+                f"tcp://127.0.0.1:{port}", timeout_read=1.0
+            )
+            dialer.connect()
+            server = SignerServer(dialer, CHAIN_ID, pv)
+            server.start()
+            try:
+                listener.wait_for_connection(5.0)
+                client = SignerClient(listener, CHAIN_ID)
+                assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+            finally:
+                server.stop()
+                listener.close()
+
+    def test_secret_connection_link_with_key_pinning(self):
+        """TCP link wrapped in SecretConnection; the listener pins the
+        signer's key and rejects impostors (socket_dialers.go analog)."""
+        node_key = ed.gen_priv_key()
+        signer_key = ed.gen_priv_key()
+        listener = SignerListenerEndpoint(
+            "tcp://127.0.0.1:0", timeout_read=2.0,
+            priv_key=node_key, authorized_key=signer_key.pub_key().bytes(),
+        )
+        port = listener.listen_port
+        with tempfile.TemporaryDirectory() as tmp:
+            pv = gen_file_pv(
+                os.path.join(tmp, "k.json"), os.path.join(tmp, "s.json")
+            )
+            # an impostor with the wrong key is rejected by the handshake
+            impostor = SignerDialerEndpoint(
+                f"tcp://127.0.0.1:{port}", timeout_read=1.0,
+                priv_key=ed.gen_priv_key(),
+            )
+            impostor.connect()
+            time.sleep(0.3)
+            assert not listener.is_connected()
+
+            # the real signer authenticates and serves
+            dialer = SignerDialerEndpoint(
+                f"tcp://127.0.0.1:{port}", timeout_read=2.0,
+                priv_key=signer_key,
+            )
+            dialer.connect()
+            server = SignerServer(dialer, CHAIN_ID, pv)
+            server.start()
+            try:
+                listener.wait_for_connection(5.0)
+                client = SignerClient(listener, CHAIN_ID)
+                assert client.get_pub_key().bytes() == pv.get_pub_key().bytes()
+
+                # a live signer link is never displaced by a new dial
+                intruder = socket.socket()
+                intruder.connect(("127.0.0.1", port))
+                time.sleep(0.3)
+                client.ping()  # still works
+                intruder.close()
+            finally:
+                server.stop()
+                listener.close()
+
+    def test_client_without_connection_errors(self):
+        listener = SignerListenerEndpoint("tcp://127.0.0.1:0", timeout_read=0.2)
+        try:
+            client = SignerClient(listener, CHAIN_ID)
+            with pytest.raises(RemoteSignerError):
+                client.ping()
+        finally:
+            listener.close()
+
+
+@pytest.mark.slow
+class TestNodeWithRemoteSigner:
+    def test_single_node_commits_with_remote_signer(self):
+        """A node configured with priv_validator_laddr and NO local key
+        commits blocks using only the remote signer (node.go:755,1451)."""
+        import base64
+        import json
+        import urllib.request
+
+        from cometbft_tpu.cmd.commands import _load_config, main as cli_main
+        from cometbft_tpu.node.node import (
+            Node,
+            default_client_creator,
+        )
+        from cometbft_tpu.types.genesis import GenesisDoc
+        from cometbft_tpu.p2p.key import NodeKey
+
+        def free_port():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "remote-pv-chain"])
+            cfg = _load_config(d)
+            rpc_port, p2p_port, pv_port = free_port(), free_port(), free_port()
+            cfg.base.proxy_app = "kvstore"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            cfg.base.priv_validator_laddr = f"tcp://127.0.0.1:{pv_port}"
+
+            # the "HSM box": serves the initialized FilePV over TCP
+            from cometbft_tpu.privval import load_file_pv
+
+            pv = load_file_pv(
+                cfg.base.priv_validator_key_path(),
+                cfg.base.priv_validator_state_path(),
+            )
+            dialer = SignerDialerEndpoint(
+                f"tcp://127.0.0.1:{pv_port}", timeout_read=2.0,
+                max_retries=100, retry_wait=0.2,
+            )
+            server_box = {}
+
+            def run_signer():
+                dialer.connect()
+                server = SignerServer(dialer, "remote-pv-chain", pv)
+                server.start()
+                server_box["server"] = server
+
+            threading.Thread(target=run_signer, daemon=True).start()
+
+            with open(cfg.base.genesis_path()) as f:
+                doc = GenesisDoc.from_json(f.read())
+            node_key = NodeKey.load_or_gen(
+                os.path.join(d, cfg.base.node_key_file)
+            )
+            node = Node(
+                cfg,
+                None,  # NO local priv validator
+                node_key,
+                default_client_creator("kvstore"),
+                doc,
+            )
+            node.start()
+            try:
+                deadline = time.monotonic() + 60
+                height = 0
+                while time.monotonic() < deadline and height < 2:
+                    try:
+                        body = json.dumps(
+                            {"jsonrpc": "2.0", "id": 1, "method": "status",
+                             "params": {}}
+                        ).encode()
+                        req = urllib.request.Request(
+                            f"http://127.0.0.1:{rpc_port}/", data=body,
+                            headers={"Content-Type": "application/json"},
+                        )
+                        st = json.loads(
+                            urllib.request.urlopen(req, timeout=5).read()
+                        )["result"]
+                        height = int(st["sync_info"]["latest_block_height"])
+                    except Exception:
+                        pass
+                    time.sleep(0.3)
+                assert height >= 2, "node with remote signer never committed"
+            finally:
+                node.stop()
+                if "server" in server_box:
+                    server_box["server"].stop()
