@@ -254,9 +254,13 @@ class SQLiteDB(DB):
                 if not checkpointed:
                     conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
                     checkpointed = True
-                conn.close()
             except sqlite3.Error:
                 pass
+            finally:
+                try:
+                    conn.close()
+                except sqlite3.Error:
+                    pass
         self._local.conn = None
 
 

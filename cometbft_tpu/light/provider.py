@@ -1,9 +1,9 @@
 """Light block providers.
 
-Reference: light/provider/provider.go (interface) and
-light/provider/mock (deterministic in-memory provider used across the
-reference's client/detector tests). The HTTP provider rides the RPC
-client once cometbft_tpu.rpc exists.
+Reference: light/provider/provider.go (interface), light/provider/mock
+(deterministic in-memory provider used across the reference's
+client/detector tests), and light/provider/http (RPC-backed LightBlock
+source — HTTPProvider below rides cometbft_tpu.rpc.client).
 """
 
 from __future__ import annotations
@@ -13,6 +13,7 @@ from typing import Dict, List, Optional
 from cometbft_tpu.light.errors import (
     ErrHeightTooHigh,
     ErrLightBlockNotFound,
+    ErrNoResponse,
 )
 from cometbft_tpu.types.light_block import LightBlock
 
@@ -103,3 +104,66 @@ class BlockStoreProvider(Provider):
 
     def id(self) -> str:
         return f"blockstore-{self.chain_id}"
+
+
+class HTTPProvider(Provider):
+    """Light blocks from a full node's JSON-RPC (light/provider/http).
+
+    `server` is a base URL or host:port; light_block stitches /commit and
+    /validators (paged) into a LightBlock."""
+
+    def __init__(self, chain_id: str, server: str, timeout: float = 10.0):
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        self.chain_id = chain_id
+        self._client = HTTPClient(server, timeout=timeout)
+
+    def light_block(self, height: int) -> LightBlock:
+        from cometbft_tpu.rpc.client import (
+            RPCClientError,
+            parse_commit,
+            parse_header,
+            parse_validators,
+        )
+        from cometbft_tpu.types.light_block import SignedHeader
+
+        try:
+            res = self._client.commit(height or None)
+            sh = res["signed_header"]
+            header = parse_header(sh["header"])
+            commit = parse_commit(sh["commit"])
+            h = header.height
+            items = []
+            page = 1
+            while True:
+                vres = self._client.validators(h, page=page, per_page=100)
+                items.extend(vres["validators"])
+                if len(items) >= int(vres["total"]):
+                    break
+                page += 1
+            vals = parse_validators(items)
+        except RPCClientError as exc:
+            # mirror light/provider/http error classification
+            text = exc.message + exc.data
+            if "must be less than or equal" in text:
+                raise ErrHeightTooHigh() from exc
+            if "not found" in text:
+                raise ErrLightBlockNotFound() from exc
+            raise ErrNoResponse(str(exc)) from exc
+        except Exception as exc:  # network-level: URLError, timeout, ...
+            raise ErrNoResponse(str(exc)) from exc
+        return LightBlock(
+            signed_header=SignedHeader(header, commit), validator_set=vals
+        )
+
+    def consensus_params(self, height: int):
+        from cometbft_tpu.types.params import ConsensusParams
+
+        res = self._client.consensus_params(height or None)
+        return ConsensusParams.from_json(res["consensus_params"])
+
+    def report_evidence(self, ev) -> None:
+        pass  # broadcast_evidence route — future work
+
+    def id(self) -> str:
+        return f"http-{self._client.base_url}"

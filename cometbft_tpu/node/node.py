@@ -80,6 +80,12 @@ def default_client_creator(proxy_app: str, app_db: Optional[DB] = None):
         app = PersistentKVStoreApplication(app_db)
         mtx = threading.Lock()
         return lambda: LocalClient(app, mtx)
+    if proxy_app == "snapshot_kvstore":
+        from cometbft_tpu.abci.kvstore import SnapshotKVStoreApplication
+
+        app = SnapshotKVStoreApplication(app_db, snapshot_interval=10)
+        mtx = threading.Lock()
+        return lambda: LocalClient(app, mtx)
     if proxy_app == "noop":
         from cometbft_tpu.abci.application import BaseApplication
 
@@ -108,9 +114,29 @@ class Node(BaseService):
         self.config = config
         self.genesis_doc = genesis_doc
         self.node_key = node_key
-
-        _provider = db_provider or default_db_provider
         self._dbs: List[DB] = []
+        # any failure while assembling must release the services already
+        # started (threads, sockets, DB file locks), not leak a half-node
+        try:
+            self._setup(
+                config, priv_validator, node_key, client_creator,
+                genesis_doc, db_provider, state_provider,
+            )
+        except Exception:
+            self._abort_init()
+            raise
+
+    def _setup(
+        self,
+        config: Config,
+        priv_validator,
+        node_key: NodeKey,
+        client_creator,
+        genesis_doc: GenesisDoc,
+        db_provider,
+        state_provider,
+    ) -> None:
+        _provider = db_provider or default_db_provider
 
         def db_provider(name: str, cfg: Config) -> DB:
             db = _provider(name, cfg)
@@ -187,32 +213,26 @@ class Node(BaseService):
         self.indexer_service.start()
 
         self._privval_endpoint = None
-        try:
-            Handshaker(
-                self.state_store, state, self.block_store, genesis_doc,
-                event_bus=self.event_bus, logger=self.logger,
-            ).handshake(self.proxy_app)
-            state = self.state_store.load() or state
+        Handshaker(
+            self.state_store, state, self.block_store, genesis_doc,
+            event_bus=self.event_bus, logger=self.logger,
+        ).handshake(self.proxy_app)
+        state = self.state_store.load() or state
 
-            # 5. privval — a remote signer replaces the file-backed one
-            # when priv_validator_laddr is set (node.go:755-761,1451)
-            if config.base.priv_validator_laddr:
-                from cometbft_tpu.privval.socket import (
-                    SignerClient,
-                    SignerListenerEndpoint,
-                )
+        # 5. privval — a remote signer replaces the file-backed one
+        # when priv_validator_laddr is set (node.go:755-761,1451)
+        if config.base.priv_validator_laddr:
+            from cometbft_tpu.privval.socket import (
+                SignerClient,
+                SignerListenerEndpoint,
+            )
 
-                endpoint = SignerListenerEndpoint(
-                    config.base.priv_validator_laddr, logger=self.logger
-                )
-                self._privval_endpoint = endpoint
-                endpoint.wait_for_connection(30.0)
-                priv_validator = SignerClient(endpoint, genesis_doc.chain_id)
-        except Exception:
-            # constructor failure after services started: release threads,
-            # sockets, and DB file locks instead of leaking a half-node
-            self._abort_init()
-            raise
+            endpoint = SignerListenerEndpoint(
+                config.base.priv_validator_laddr, logger=self.logger
+            )
+            self._privval_endpoint = endpoint
+            endpoint.wait_for_connection(30.0)
+            priv_validator = SignerClient(endpoint, genesis_doc.chain_id)
         self.priv_validator = priv_validator
         pub_key = priv_validator.get_pub_key() if priv_validator else None
 
@@ -432,10 +452,42 @@ class Node(BaseService):
         """node.go:651 startStateSync — restore a snapshot asynchronously,
         bootstrap the stores, then hand off to blocksync/consensus."""
         if self.state_provider is None:
-            raise RuntimeError(
-                "statesync enabled but no state provider given; construct "
-                "the Node with state_provider=LightClientStateProvider(...)"
-            )
+            ss_cfg = self.config.statesync
+            if len(ss_cfg.rpc_servers) >= 2 and ss_cfg.trust_hash:
+                # build the light-client provider from [statesync]
+                # rpc_servers + trust root (node.go:655-672)
+                from cometbft_tpu.light.client import TrustOptions
+                from cometbft_tpu.light.provider import HTTPProvider
+                from cometbft_tpu.statesync import LightClientStateProvider
+
+                providers = [
+                    HTTPProvider(self.genesis_doc.chain_id, s)
+                    for s in ss_cfg.rpc_servers
+                ]
+                from cometbft_tpu.state import StateVersion
+
+                # only .software is taken from this; the consensus/app
+                # versions come from the verified light-block headers
+                self.state_provider = LightClientStateProvider(
+                    self.genesis_doc.chain_id,
+                    StateVersion(),
+                    self.genesis_doc.initial_height,
+                    providers,
+                    TrustOptions(
+                        period_ns=ss_cfg.trust_period_ns,
+                        height=ss_cfg.trust_height,
+                        hash=bytes.fromhex(ss_cfg.trust_hash),
+                    ),
+                    crypto_backend=self.config.crypto.backend,
+                    logger=self.logger,
+                )
+            else:
+                raise RuntimeError(
+                    "statesync enabled but no state provider: set "
+                    "[statesync] rpc_servers (>=2) + trust_height/"
+                    "trust_hash, or construct the Node with "
+                    "state_provider=LightClientStateProvider(...)"
+                )
         import threading
 
         metrics = self.consensus_state.metrics

@@ -326,6 +326,11 @@ class _Endpoint:
                 old.close()
             except OSError:
                 pass
+        if conn is None:
+            self._on_disconnect()
+
+    def _on_disconnect(self) -> None:
+        """Hook for subclasses (listener clears its connected event)."""
 
     def send_msg(self, msg) -> None:
         with self._mtx:
@@ -483,7 +488,11 @@ class SignerListenerEndpoint(_Endpoint):
                     pass
                 continue
             try:
+                # bound the handshake: a silent dialer must not wedge the
+                # single accept thread (signing DoS)
+                conn.settimeout(10.0)
                 conn = _maybe_secure(conn, self._priv_key, self._authorized_key)
+                conn.settimeout(None)
             except Exception as exc:
                 # handshake failures never displace the existing conn
                 self.logger.error("signer handshake failed", err=str(exc))
@@ -495,6 +504,10 @@ class SignerListenerEndpoint(_Endpoint):
             self.logger.info("remote signer connected")
             self._set_conn(conn)
             self._connected_ev.set()
+
+    def _on_disconnect(self) -> None:
+        # wait_for_connection must block again until the signer re-dials
+        self._connected_ev.clear()
 
     def wait_for_connection(self, max_wait: float) -> None:
         if not self._connected_ev.wait(max_wait):
@@ -646,7 +659,9 @@ class SignerServer:
             except RemoteSignerError as exc:
                 if exc.code == ERR_READ_TIMEOUT:
                     continue  # idle; keep serving
-                return  # connection gone
+                if not self._reconnect():
+                    return
+                continue
             try:
                 resp = self._handle(req)
             except Exception as exc:  # noqa: BLE001 — errors go on the wire
@@ -654,19 +669,51 @@ class SignerServer:
             try:
                 self.endpoint.send_msg(resp)
             except RemoteSignerError:
-                return
+                if not self._reconnect():
+                    return
+
+    def _reconnect(self) -> bool:
+        """After a dropped connection, a dialer endpoint re-dials the node
+        (signer_dialer_endpoint.go retries) — without this, one transient
+        reset would silence the validator's signer forever."""
+        if self._stopped.is_set():
+            return False
+        connect = getattr(self.endpoint, "connect", None)
+        if connect is None:
+            return False  # listener-style endpoint: nothing to redial
+        try:
+            connect()
+            return True
+        except Exception:
+            return not self._stopped.is_set() and self._retry_later()
+
+    def _retry_later(self) -> bool:
+        self._stopped.wait(1.0)
+        return not self._stopped.is_set()
+
+    def _check_chain(self, chain_id: str) -> None:
+        """The signer serves exactly ONE chain; signing for another would
+        let a compromised node harvest cross-chain signatures
+        (signer_requestHandler.go chainID check)."""
+        if chain_id and chain_id != self.chain_id:
+            raise ValueError(
+                f"want chainID {self.chain_id!r}, got {chain_id!r}"
+            )
 
     def _handle(self, req):
         if isinstance(req, PubKeyRequest):
+            self._check_chain(req.chain_id)
             pk = self.priv_val.get_pub_key()
             return PubKeyResponse(
                 pub_key=PublicKeyProto(ed25519.KEY_TYPE, pk.bytes())
             )
         if isinstance(req, SignVoteRequest):
+            self._check_chain(req.chain_id)
             vote = req.vote
             self.priv_val.sign_vote(req.chain_id or self.chain_id, vote)
             return SignedVoteResponse(vote=vote)
         if isinstance(req, SignProposalRequest):
+            self._check_chain(req.chain_id)
             proposal = req.proposal
             self.priv_val.sign_proposal(
                 req.chain_id or self.chain_id, proposal

@@ -1,0 +1,209 @@
+"""JSON-RPC HTTP client.
+
+Reference: rpc/client/http — the Go client used by operators, the light
+client's HTTP provider, and statesync's RPC state providers. Speaks the
+same JSON-RPC-over-HTTP-POST the server in rpc/server.py serves; result
+payloads are returned as parsed dicts (the JSON shapes in
+rpc/serializers.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import urllib.request
+from typing import List, Optional
+
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.types.block import BlockID, Commit, CommitSig, PartSetHeader
+from cometbft_tpu.types.validator import Validator
+from cometbft_tpu.types.validator_set import ValidatorSet
+
+
+class RPCClientError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(f"RPC error {code}: {message} {data}".strip())
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+class HTTPClient:
+    """Minimal blocking JSON-RPC client over HTTP POST."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        if "://" not in base_url:
+            base_url = "http://" + base_url
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._ids = itertools.count(1)
+
+    def call(self, method: str, params: Optional[dict] = None):
+        body = json.dumps(
+            {
+                "jsonrpc": "2.0",
+                "id": next(self._ids),
+                "method": method,
+                "params": params or {},
+            }
+        ).encode()
+        req = urllib.request.Request(
+            self.base_url + "/",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            payload = json.loads(resp.read())
+        if "error" in payload:
+            err = payload["error"]
+            raise RPCClientError(
+                err.get("code", -1), err.get("message", ""), err.get("data", "")
+            )
+        return payload["result"]
+
+    # -- typed convenience wrappers (rpc/client/http verbs) ------------------
+
+    def status(self) -> dict:
+        return self.call("status")
+
+    def block(self, height: Optional[int] = None) -> dict:
+        return self.call("block", {"height": height} if height else {})
+
+    def commit(self, height: Optional[int] = None) -> dict:
+        return self.call("commit", {"height": height} if height else {})
+
+    def validators(
+        self, height: Optional[int] = None, page: int = 1, per_page: int = 100
+    ) -> dict:
+        params = {"page": page, "per_page": per_page}
+        if height:
+            params["height"] = height
+        return self.call("validators", params)
+
+    def consensus_params(self, height: Optional[int] = None) -> dict:
+        return self.call(
+            "consensus_params", {"height": height} if height else {}
+        )
+
+    def broadcast_tx_sync(self, tx: bytes) -> dict:
+        return self.call(
+            "broadcast_tx_sync", {"tx": base64.b64encode(tx).decode()}
+        )
+
+    def broadcast_tx_commit(self, tx: bytes) -> dict:
+        return self.call(
+            "broadcast_tx_commit", {"tx": base64.b64encode(tx).decode()}
+        )
+
+    def tx(self, tx_hash: bytes) -> dict:
+        return self.call("tx", {"hash": base64.b64encode(tx_hash).decode()})
+
+    def tx_search(self, query: str, **kw) -> dict:
+        return self.call("tx_search", {"query": query, **kw})
+
+    def block_search(self, query: str, **kw) -> dict:
+        return self.call("block_search", {"query": query, **kw})
+
+    def abci_query(self, path: str, data: bytes) -> dict:
+        return self.call(
+            "abci_query", {"path": path, "data": data.hex()}
+        )
+
+    def net_info(self) -> dict:
+        return self.call("net_info")
+
+
+# -- JSON → domain type parsing (inverse of rpc/serializers.py) --------------
+
+
+def _b64(s: str) -> bytes:
+    return base64.b64decode(s) if s else b""
+
+
+def _ts(s: str) -> Timestamp:
+    # RFC3339 with nanoseconds
+    if "." in s:
+        base_part, frac = s.rstrip("Z").split(".", 1)
+        nanos = int(frac.ljust(9, "0")[:9])
+    else:
+        base_part, nanos = s.rstrip("Z"), 0
+    import datetime as dt
+
+    d = dt.datetime.strptime(base_part, "%Y-%m-%dT%H:%M:%S").replace(
+        tzinfo=dt.timezone.utc
+    )
+    return Timestamp(int(d.timestamp()), nanos)
+
+
+def parse_block_id(j: dict) -> BlockID:
+    parts = j.get("parts") or j.get("part_set_header") or {}
+    return BlockID(
+        bytes.fromhex(j.get("hash", "")),
+        PartSetHeader(
+            int(parts.get("total", 0)), bytes.fromhex(parts.get("hash", ""))
+        ),
+    )
+
+
+def parse_header(j: dict):
+    from cometbft_tpu.proto.version import ConsensusVersion
+    from cometbft_tpu.types.block import Header
+
+    h = Header()
+    ver = j.get("version", {})
+    h.version = ConsensusVersion(
+        int(ver.get("block", 0)), int(ver.get("app", 0))
+    )
+    h.chain_id = j["chain_id"]
+    h.height = int(j["height"])
+    h.time = _ts(j["time"])
+    h.last_block_id = parse_block_id(j.get("last_block_id") or {})
+    h.last_commit_hash = bytes.fromhex(j.get("last_commit_hash", ""))
+    h.data_hash = bytes.fromhex(j.get("data_hash", ""))
+    h.validators_hash = bytes.fromhex(j.get("validators_hash", ""))
+    h.next_validators_hash = bytes.fromhex(j.get("next_validators_hash", ""))
+    h.consensus_hash = bytes.fromhex(j.get("consensus_hash", ""))
+    h.app_hash = bytes.fromhex(j.get("app_hash", ""))
+    h.last_results_hash = bytes.fromhex(j.get("last_results_hash", ""))
+    h.evidence_hash = bytes.fromhex(j.get("evidence_hash", ""))
+    h.proposer_address = bytes.fromhex(j.get("proposer_address", ""))
+    return h
+
+
+def parse_commit(j: dict) -> Commit:
+    sigs = []
+    for s in j.get("signatures", []):
+        sigs.append(
+            CommitSig(
+                block_id_flag=int(s["block_id_flag"]),
+                validator_address=bytes.fromhex(s.get("validator_address", "")),
+                timestamp=_ts(s["timestamp"])
+                if s.get("timestamp")
+                else Timestamp(0, 0),
+                signature=_b64(s.get("signature") or ""),
+            )
+        )
+    return Commit(
+        height=int(j["height"]),
+        round=int(j["round"]),
+        block_id=parse_block_id(j["block_id"]),
+        signatures=sigs,
+    )
+
+
+def parse_validators(items: List[dict]) -> ValidatorSet:
+    from cometbft_tpu.crypto import ed25519
+
+    vals = []
+    for v in items:
+        pk = v["pub_key"]
+        vals.append(
+            Validator(
+                address=bytes.fromhex(v["address"]),
+                pub_key=ed25519.PubKeyEd25519(_b64(pk["value"])),
+                voting_power=int(v["voting_power"]),
+                proposer_priority=int(v.get("proposer_priority", 0)),
+            )
+        )
+    return ValidatorSet(vals)

@@ -494,6 +494,116 @@ class _SSNode:
 
 
 @pytest.mark.slow
+class TestStateSyncFromConfig:
+    def test_fresh_node_statesyncs_via_rpc_servers(self, monkeypatch):
+        """The reference boot path end to end: a fresh node with
+        [statesync] enable + rpc_servers + trust root restores a snapshot
+        discovered over p2p, verified via HTTP light providers, then
+        blocksyncs and switches to consensus (node.go:651-706)."""
+        import socket as _socket
+        import tempfile
+
+        from cometbft_tpu.cmd.commands import _load_config, main as cli_main
+        from cometbft_tpu.node import default_new_node
+        from cometbft_tpu.rpc.client import HTTPClient
+        from cometbft_tpu.statesync import syncer as syncer_mod_
+
+        monkeypatch.setattr(syncer_mod_, "MINIMUM_DISCOVERY_TIME", 0.5)
+
+        def free_ports(n):
+            out, socks = [], []
+            for _ in range(n):
+                s = _socket.socket()
+                s.bind(("127.0.0.1", 0))
+                socks.append(s)
+                out.append(s.getsockname()[1])
+            for s in socks:
+                s.close()
+            return out
+
+        with tempfile.TemporaryDirectory() as d:
+            # source: a single-validator chain with a snapshotting app
+            src_home = f"{d}/src"
+            cli_main(["--home", src_home, "init", "--chain-id", "ss-cfg"])
+            src_rpc, src_p2p, fresh_rpc, fresh_p2p = free_ports(4)
+            cfg = _load_config(src_home)
+            cfg.base.proxy_app = "snapshot_kvstore"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{src_rpc}"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{src_p2p}"
+            cfg.consensus.timeout_commit_ns = 100_000_000  # fast blocks
+            source = default_new_node(cfg)
+            source.start()
+            try:
+                client = HTTPClient(f"127.0.0.1:{src_rpc}")
+                deadline = time.monotonic() + 120
+                height = 0
+                # wait for a snapshot (taken at height 10) + light blocks
+                # through height 13
+                while time.monotonic() < deadline and height < 13:
+                    try:
+                        height = int(
+                            client.status()["sync_info"]["latest_block_height"]
+                        )
+                    except Exception:
+                        pass
+                    time.sleep(0.3)
+                assert height >= 13, f"source stuck at {height}"
+
+                # fresh node: same genesis, statesync from config
+                fresh_home = f"{d}/fresh"
+                import os
+                import shutil
+
+                cli_main(["--home", fresh_home, "init", "--chain-id", "x"])
+                shutil.copy(
+                    f"{src_home}/config/genesis.json",
+                    f"{fresh_home}/config/genesis.json",
+                )
+                fcfg = _load_config(fresh_home)
+                fcfg.base.proxy_app = "snapshot_kvstore"
+                fcfg.rpc.laddr = f"tcp://127.0.0.1:{fresh_rpc}"
+                fcfg.p2p.laddr = f"tcp://127.0.0.1:{fresh_p2p}"
+                src_id = source.node_key.id()
+                fcfg.p2p.persistent_peers = (
+                    f"{src_id}@127.0.0.1:{src_p2p}"
+                )
+                fcfg.statesync.enable = True
+                fcfg.statesync.rpc_servers = [
+                    f"127.0.0.1:{src_rpc}",
+                    f"127.0.0.1:{src_rpc}",
+                ]
+                fcfg.statesync.trust_height = 1
+                block1 = client.block(1)
+                fcfg.statesync.trust_hash = block1["block_id"]["hash"]
+                fcfg.statesync.discovery_time_ns = 500_000_000
+                fresh = default_new_node(fcfg)
+                fresh.start()
+                try:
+                    fclient = HTTPClient(f"127.0.0.1:{fresh_rpc}")
+                    deadline = time.monotonic() + 120
+                    fheight = 0
+                    while time.monotonic() < deadline and fheight < 11:
+                        try:
+                            fheight = int(
+                                fclient.status()["sync_info"][
+                                    "latest_block_height"
+                                ]
+                            )
+                        except Exception:
+                            pass
+                        time.sleep(0.5)
+                    # restored from the height-10 snapshot and kept going
+                    assert fheight >= 11, (
+                        f"fresh node reached only {fheight}"
+                    )
+                    assert fresh.state_store.load_validators(11) is not None
+                finally:
+                    fresh.stop()
+            finally:
+                source.stop()
+
+
+@pytest.mark.slow
 class TestStateSyncOverTCP:
     def test_fresh_node_statesyncs_then_blocksyncs(self, monkeypatch):
         monkeypatch.setattr(syncer_mod, "MINIMUM_DISCOVERY_TIME", 0.3)
