@@ -1,0 +1,13 @@
+"""End-to-end testnet harness.
+
+Reference: test/e2e/ — a declarative runner that boots a multi-node
+testnet, generates transaction load, perturbs nodes (kill/restart), and
+checks cross-node invariants via RPC. The reference orchestrates Docker
+containers (test/e2e/runner/); here nodes run in-process over real TCP
+sockets, which keeps the same network/protocol surface while staying
+runnable inside one test process.
+"""
+
+from cometbft_tpu.e2e.runner import LoadGenerator, Testnet
+
+__all__ = ["LoadGenerator", "Testnet"]

@@ -1,0 +1,277 @@
+"""In-process multi-node testnet runner with perturbations and load.
+
+Reference: test/e2e/runner/{setup,start,perturb,wait,test,benchmark}.go
+and test/loadtime. The manifest is programmatic (node count, app,
+timeouts); perturbations mirror perturb.go:44-74 (kill/restart — pause/
+disconnect map to stopping the p2p switch); invariants mirror
+test/e2e/tests/*_test.go (app hash agreement, block well-formedness,
+committed txs visible everywhere).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from cometbft_tpu.cmd.commands import _load_config, main as cli_main
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.rpc.client import HTTPClient
+
+
+def _free_ports(n: int) -> List[int]:
+    import socket
+
+    out, socks = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        out.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return out
+
+
+class Testnet:
+    """Boot N validators wired over real TCP, drive them, tear down."""
+
+    __test__ = False  # not a pytest class despite the name
+
+    def __init__(
+        self,
+        n_validators: int = 4,
+        proxy_app: str = "kvstore",
+        chain_id: str = "e2e-chain",
+        timeout_commit_ns: int = 300_000_000,
+        base_dir: Optional[str] = None,
+        logger: Optional[Logger] = None,
+    ):
+        self.n = n_validators
+        self.proxy_app = proxy_app
+        self.chain_id = chain_id
+        self.timeout_commit_ns = timeout_commit_ns
+        self.logger = logger or new_nop_logger()
+        self.base_dir = base_dir or tempfile.mkdtemp(prefix="e2e-net-")
+        self._own_dir = base_dir is None
+        self.nodes: Dict[int, object] = {}  # index → Node (None while down)
+        self.rpc_ports: List[int] = []
+        self.p2p_ports: List[int] = []
+        self._configs = []
+
+    # -- setup ----------------------------------------------------------------
+
+    def setup(self) -> None:
+        """testnet CLI homes + per-node port assignment (setup.go)."""
+        ports = _free_ports(2 * self.n)
+        self.p2p_ports = ports[: self.n]
+        self.rpc_ports = ports[self.n :]
+        cli_main(
+            [
+                "testnet",
+                "--v", str(self.n),
+                "--output-dir", self.base_dir,
+                "--chain-id", self.chain_id,
+                "--proxy_app", self.proxy_app,
+            ]
+        )
+        from cometbft_tpu.p2p.key import NodeKey
+
+        ids = []
+        for i in range(self.n):
+            home = self._home(i)
+            cfg = _load_config(home)
+            ids.append(
+                NodeKey.load_or_gen(
+                    os.path.join(home, cfg.base.node_key_file)
+                ).id()
+            )
+        peers = [
+            f"{ids[i]}@127.0.0.1:{self.p2p_ports[i]}" for i in range(self.n)
+        ]
+        for i in range(self.n):
+            home = self._home(i)
+            cfg = _load_config(home)
+            cfg.base.proxy_app = self.proxy_app
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{self.p2p_ports[i]}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{self.rpc_ports[i]}"
+            cfg.p2p.persistent_peers = ",".join(
+                p for j, p in enumerate(peers) if j != i
+            )
+            cfg.p2p.addr_book_strict = False
+            cfg.consensus.timeout_commit_ns = self.timeout_commit_ns
+            cfg.consensus.create_empty_blocks = True
+            self._configs.append(cfg)
+
+    def _home(self, i: int) -> str:
+        return os.path.join(self.base_dir, f"node{i}")
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        for i in range(self.n):
+            self.start_node(i)
+
+    def start_node(self, i: int) -> None:
+        from cometbft_tpu.node import default_new_node
+
+        node = default_new_node(self._configs[i], logger=self.logger)
+        node.start()
+        self.nodes[i] = node
+
+    def kill_node(self, i: int) -> None:
+        """perturb.go kill: hard-stop the node; its homes stay on disk."""
+        node = self.nodes.get(i)
+        if node is not None:
+            node.stop()
+            self.nodes[i] = None
+
+    def restart_node(self, i: int) -> None:
+        """perturb.go restart: boot again from the on-disk home."""
+        if self.nodes.get(i) is not None:
+            self.kill_node(i)
+        self.start_node(i)
+
+    def stop(self) -> None:
+        for i, node in list(self.nodes.items()):
+            if node is not None:
+                try:
+                    node.stop()
+                except Exception:
+                    pass
+                self.nodes[i] = None
+        if self._own_dir:
+            shutil.rmtree(self.base_dir, ignore_errors=True)
+
+    # -- RPC access ------------------------------------------------------------
+
+    def client(self, i: int) -> HTTPClient:
+        return HTTPClient(f"127.0.0.1:{self.rpc_ports[i]}")
+
+    def live_indexes(self) -> List[int]:
+        return [i for i, n in self.nodes.items() if n is not None]
+
+    def height(self, i: int) -> int:
+        try:
+            st = self.client(i).status()
+            return int(st["sync_info"]["latest_block_height"])
+        except Exception:
+            return 0
+
+    def wait_for_height(
+        self, target: int, timeout: float = 120.0, nodes: Optional[List[int]] = None
+    ) -> None:
+        """wait.go: block until every (live) node reaches `target`."""
+        which = nodes if nodes is not None else None
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            idxs = which if which is not None else self.live_indexes()
+            if idxs and all(self.height(i) >= target for i in idxs):
+                return
+            time.sleep(0.25)
+        heights = {i: self.height(i) for i in (which or self.live_indexes())}
+        raise AssertionError(
+            f"height {target} not reached before timeout: {heights}"
+        )
+
+    # -- invariants (test/e2e/tests/*_test.go) ---------------------------------
+
+    def check_app_hashes_agree(self, height: int) -> None:
+        """All live nodes report the same block (and thus app hash) at
+        `height` (app_test.go TestApp_Hash)."""
+        seen = {}
+        for i in self.live_indexes():
+            blk = self.client(i).block(height)
+            seen[i] = (
+                blk["block_id"]["hash"],
+                blk["block"]["header"]["app_hash"],
+            )
+        values = set(seen.values())
+        assert len(values) == 1, f"nodes disagree at height {height}: {seen}"
+
+    def check_blocks_well_formed(self, upto: int) -> None:
+        """Headers chain correctly (block_test.go TestBlock_Header)."""
+        c = self.client(self.live_indexes()[0])
+        prev_hash = None
+        for h in range(1, upto + 1):
+            blk = c.block(h)
+            header = blk["block"]["header"]
+            assert int(header["height"]) == h
+            if prev_hash is not None:
+                assert header["last_block_id"]["hash"] == prev_hash, (
+                    f"broken hash chain at {h}"
+                )
+            prev_hash = blk["block_id"]["hash"]
+
+    def check_tx_visible_everywhere(self, tx_hash_hex: str) -> None:
+        """A committed tx is indexed and retrievable on every live node."""
+        import base64
+
+        for i in self.live_indexes():
+            got = self.client(i).tx(bytes.fromhex(tx_hash_hex))
+            assert got["hash"].upper() == tx_hash_hex.upper()
+
+
+class LoadGenerator:
+    """Continuous tx load with commit-latency tracking (test/loadtime:
+    the tx carries its send time; latency = commit time - send time)."""
+
+    def __init__(self, testnet: Testnet, rate_per_s: float = 10.0):
+        self.testnet = testnet
+        self.rate = rate_per_s
+        self.sent = 0
+        self.committed = 0
+        self.latencies: List[float] = []
+        self.tx_hashes: List[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="e2e-load", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(30.0)
+
+    def _run(self) -> None:
+        import hashlib
+
+        period = 1.0 / self.rate
+        seq = 0
+        while not self._stop.is_set():
+            idxs = self.testnet.live_indexes()
+            if not idxs:
+                self._stop.wait(period)
+                continue
+            i = idxs[seq % len(idxs)]
+            tx = f"load-{seq}={time.monotonic_ns()}".encode()
+            seq += 1
+            t0 = time.monotonic()
+            try:
+                res = self.testnet.client(i).broadcast_tx_commit(tx)
+                if res.get("deliver_tx", {}).get("code", 1) == 0:
+                    self.committed += 1
+                    self.latencies.append(time.monotonic() - t0)
+                    self.tx_hashes.append(
+                        hashlib.sha256(tx).hexdigest().upper()
+                    )
+            except Exception:
+                pass
+            self.sent += 1
+            self._stop.wait(period)
+
+    def report(self) -> dict:
+        lat = sorted(self.latencies)
+        return {
+            "sent": self.sent,
+            "committed": self.committed,
+            "p50_latency_s": lat[len(lat) // 2] if lat else None,
+            "p95_latency_s": lat[int(len(lat) * 0.95)] if lat else None,
+        }

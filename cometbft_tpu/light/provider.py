@@ -132,16 +132,26 @@ class HTTPProvider(Provider):
             sh = res["signed_header"]
             header = parse_header(sh["header"])
             commit = parse_commit(sh["commit"])
+            if height and header.height != height:
+                # a faulty primary answering with a different (but
+                # self-consistent) height must not slip through
+                # (light/provider/http height check)
+                raise ErrLightBlockNotFound()
             h = header.height
             items = []
-            page = 1
-            while True:
+            for page in range(1, 101):  # reference maxPages = 100
                 vres = self._client.validators(h, page=page, per_page=100)
-                items.extend(vres["validators"])
+                got = vres["validators"]
+                if not got:
+                    break
+                items.extend(got)
                 if len(items) >= int(vres["total"]):
                     break
-                page += 1
+            else:
+                raise ErrNoResponse("validator set exceeds 100 pages")
             vals = parse_validators(items)
+        except (ErrLightBlockNotFound, ErrHeightTooHigh, ErrNoResponse):
+            raise
         except RPCClientError as exc:
             # mirror light/provider/http error classification
             text = exc.message + exc.data

@@ -22,16 +22,7 @@ from cometbft_tpu.proto.gogo import Timestamp
 from cometbft_tpu.rpc.client import HTTPClient, RPCClientError
 
 
-def _free_ports(n):
-    out, socks = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        out.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return out
+from conftest import free_ports as _free_ports
 
 
 def _now() -> Timestamp:

@@ -510,16 +510,7 @@ class TestStateSyncFromConfig:
 
         monkeypatch.setattr(syncer_mod_, "MINIMUM_DISCOVERY_TIME", 0.5)
 
-        def free_ports(n):
-            out, socks = [], []
-            for _ in range(n):
-                s = _socket.socket()
-                s.bind(("127.0.0.1", 0))
-                socks.append(s)
-                out.append(s.getsockname()[1])
-            for s in socks:
-                s.close()
-            return out
+        from conftest import free_ports
 
         with tempfile.TemporaryDirectory() as d:
             # source: a single-validator chain with a snapshotting app
