@@ -145,20 +145,16 @@ def cmd_show_validator(args) -> int:
 
 def cmd_gen_validator(args) -> int:
     """commands/gen_validator.go — print a fresh key pair as JSON."""
-    import base64
-
     from cometbft_tpu.crypto import ed25519
+    from cometbft_tpu.libs import amino_json
 
     priv = ed25519.gen_priv_key()
     print(
-        json.dumps(
+        amino_json.marshal(
             {
                 "address": priv.pub_key().address().hex().upper(),
-                "pub_key": pub_key_to_json(priv.pub_key()),
-                "priv_key": {
-                    "type": "tendermint/PrivKeyEd25519",
-                    "value": base64.b64encode(priv.bytes()).decode(),
-                },
+                "pub_key": priv.pub_key(),
+                "priv_key": priv,
             },
             indent=2,
         )
@@ -223,6 +219,83 @@ def cmd_testnet(args) -> int:
 
 def cmd_version(_args) -> int:
     print(VERSION)
+    return 0
+
+
+def cmd_abci(args) -> int:
+    """abci/cmd/abci-cli — poke an ABCI app over its socket (echo, info,
+    deliver_tx, check_tx, commit, query), or serve the builtin kvstore."""
+    from cometbft_tpu.abci import types as abci_types
+    from cometbft_tpu.abci.client import SocketClient
+
+    sub = args.abci_command
+    if sub == "kvstore":
+        # serve the example app (abci-cli kvstore)
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.abci.server import SocketServer
+
+        server = SocketServer(args.address, KVStoreApplication())
+        server.start()
+        print(f"ABCI kvstore server listening on {args.address}", flush=True)
+        stop = threading.Event()
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        try:
+            while not stop.is_set():
+                time.sleep(0.5)
+        finally:
+            server.stop()
+        return 0
+
+    client = SocketClient(args.address, must_connect=True)
+    client.start()
+    try:
+        if sub == "echo":
+            res = client.echo_sync(args.data or "")
+            print(res.message)
+        elif sub == "info":
+            res = client.info_sync(abci_types.RequestInfo())
+            print(
+                json.dumps(
+                    {
+                        "data": res.data,
+                        "version": res.version,
+                        "app_version": res.app_version,
+                        "last_block_height": res.last_block_height,
+                        "last_block_app_hash": res.last_block_app_hash.hex(),
+                    }
+                )
+            )
+        elif sub == "deliver_tx":
+            res = client.deliver_tx_sync(
+                abci_types.RequestDeliverTx(tx=(args.data or "").encode())
+            )
+            print(json.dumps({"code": res.code, "log": res.log}))
+        elif sub == "check_tx":
+            res = client.check_tx_sync(
+                abci_types.RequestCheckTx(tx=(args.data or "").encode())
+            )
+            print(json.dumps({"code": res.code, "log": res.log}))
+        elif sub == "commit":
+            res = client.commit_sync()
+            print(json.dumps({"data": res.data.hex()}))
+        else:  # "query" — argparse choices guarantee the full set
+            res = client.query_sync(
+                abci_types.RequestQuery(
+                    data=(args.data or "").encode(), path=args.path
+                )
+            )
+            print(
+                json.dumps(
+                    {
+                        "code": res.code,
+                        "log": res.log,
+                        "value": res.value.decode("utf-8", "replace"),
+                    }
+                )
+            )
+    finally:
+        client.stop()
     return 0
 
 
@@ -389,6 +462,19 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--p2p-port", type=int, default=26656)
     p.add_argument("--rpc-port", type=int, default=26657)
     p.set_defaults(fn=cmd_testnet)
+
+    p = sub.add_parser(
+        "abci", help="ABCI console: poke an app socket or serve kvstore"
+    )
+    p.add_argument(
+        "abci_command",
+        choices=["echo", "info", "deliver_tx", "check_tx", "commit",
+                 "query", "kvstore"],
+    )
+    p.add_argument("data", nargs="?", default="")
+    p.add_argument("--address", default="tcp://127.0.0.1:26658")
+    p.add_argument("--path", default="/store")
+    p.set_defaults(fn=cmd_abci)
 
     p = sub.add_parser("rollback", help="roll the state back one height")
     p.set_defaults(fn=cmd_rollback)

@@ -22,18 +22,7 @@ from cometbft_tpu.libs.log import Logger, new_nop_logger
 from cometbft_tpu.rpc.client import HTTPClient
 
 
-def _free_ports(n: int) -> List[int]:
-    import socket
-
-    out, socks = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        out.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return out
+from cometbft_tpu.libs.net import free_ports as _free_ports
 
 
 class Testnet:
@@ -58,6 +47,7 @@ class Testnet:
         self.base_dir = base_dir or tempfile.mkdtemp(prefix="e2e-net-")
         self._own_dir = base_dir is None
         self.nodes: Dict[int, object] = {}  # index → Node (None while down)
+        self._clients: Dict[int, HTTPClient] = {}
         self.rpc_ports: List[int] = []
         self.p2p_ports: List[int] = []
         self._configs = []
@@ -149,7 +139,11 @@ class Testnet:
     # -- RPC access ------------------------------------------------------------
 
     def client(self, i: int) -> HTTPClient:
-        return HTTPClient(f"127.0.0.1:{self.rpc_ports[i]}")
+        c = self._clients.get(i)
+        if c is None:
+            c = HTTPClient(f"127.0.0.1:{self.rpc_ports[i]}")
+            self._clients[i] = c
+        return c
 
     def live_indexes(self) -> List[int]:
         return [i for i, n in self.nodes.items() if n is not None]
@@ -165,14 +159,14 @@ class Testnet:
         self, target: int, timeout: float = 120.0, nodes: Optional[List[int]] = None
     ) -> None:
         """wait.go: block until every (live) node reaches `target`."""
-        which = nodes if nodes is not None else None
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            idxs = which if which is not None else self.live_indexes()
+            idxs = nodes if nodes is not None else self.live_indexes()
             if idxs and all(self.height(i) >= target for i in idxs):
                 return
             time.sleep(0.25)
-        heights = {i: self.height(i) for i in (which or self.live_indexes())}
+        idxs = nodes if nodes is not None else self.live_indexes()
+        heights = {i: self.height(i) for i in idxs}
         raise AssertionError(
             f"height {target} not reached before timeout: {heights}"
         )
@@ -208,8 +202,6 @@ class Testnet:
 
     def check_tx_visible_everywhere(self, tx_hash_hex: str) -> None:
         """A committed tx is indexed and retrievable on every live node."""
-        import base64
-
         for i in self.live_indexes():
             got = self.client(i).tx(bytes.fromhex(tx_hash_hex))
             assert got["hash"].upper() == tx_hash_hex.upper()
@@ -256,7 +248,7 @@ class LoadGenerator:
             t0 = time.monotonic()
             try:
                 res = self.testnet.client(i).broadcast_tx_commit(tx)
-                if res.get("deliver_tx", {}).get("code", 1) == 0:
+                if (res.get("deliver_tx") or {}).get("code", 1) == 0:
                     self.committed += 1
                     self.latencies.append(time.monotonic() - t0)
                     self.tx_hashes.append(

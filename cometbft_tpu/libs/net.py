@@ -1,0 +1,20 @@
+"""Small network helpers (reference: libs/net)."""
+
+from __future__ import annotations
+
+import socket
+from typing import List
+
+
+def free_ports(n: int) -> List[int]:
+    """Reserve n distinct ephemeral TCP ports (bind-then-release). Used by
+    the e2e runner and tests; a small race to re-bind remains inherent."""
+    out, socks = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        out.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return out

@@ -210,17 +210,13 @@ class FilePV(PrivValidator):
     # -- persistence --------------------------------------------------------
 
     def save(self) -> None:
+        from cometbft_tpu.libs import amino_json
+
         pub = self.get_pub_key()
         doc = {
             "address": pub.address().hex().upper(),
-            "pub_key": {
-                "type": _PUB_KEY_TYPE_TAG,
-                "value": base64.b64encode(pub.bytes()).decode(),
-            },
-            "priv_key": {
-                "type": _PRIV_KEY_TYPE_TAG,
-                "value": base64.b64encode(self.priv_key.bytes()).decode(),
-            },
+            "pub_key": amino_json.to_tagged(pub),
+            "priv_key": amino_json.to_tagged(self.priv_key),
         }
         write_file_atomic(
             self.key_file_path, json.dumps(doc, indent=2).encode(), 0o600
@@ -254,10 +250,11 @@ def load_file_pv(
 ) -> FilePV:
     with open(key_file_path, "rb") as f:
         doc = json.load(f)
-    pk = doc.get("priv_key", {})
-    if pk.get("type") != _PRIV_KEY_TYPE_TAG:
-        raise ValueError(f"unsupported priv key type {pk.get('type')!r}")
-    priv = ed25519.PrivKeyEd25519(base64.b64decode(pk["value"]))
+    from cometbft_tpu.libs import amino_json
+
+    priv = amino_json.from_tagged(doc.get("priv_key", {}))
+    if not isinstance(priv, ed25519.PrivKeyEd25519):
+        raise ValueError(f"unsupported priv key type {type(priv).__name__}")
     pv = FilePV(priv, key_file_path, state_file_path)
     if load_state:
         pv.last_sign_state = FilePVLastSignState.load(state_file_path)

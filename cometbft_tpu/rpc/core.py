@@ -12,6 +12,7 @@ import uuid
 from typing import List, Optional
 
 from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs import amino_json
 from cometbft_tpu.libs.pubsub.pubsub import SubscriptionCancelled
 from cometbft_tpu.mempool import ErrTxInCache
 from cometbft_tpu.rpc.serializers import (
@@ -97,10 +98,7 @@ class Environment:
             },
             "validator_info": {
                 "address": hex_up(pub_key.address()) if pub_key else "",
-                "pub_key": {
-                    "type": "tendermint/PubKeyEd25519",
-                    "value": b64(pub_key.bytes()),
-                }
+                "pub_key": amino_json.to_tagged(pub_key)
                 if pub_key
                 else None,
                 "voting_power": str(self._our_voting_power(pub_key)),

@@ -91,12 +91,11 @@ def block_meta_json(meta) -> dict:
 
 
 def validator_json(v) -> dict:
+    from cometbft_tpu.libs import amino_json
+
     return {
         "address": hex_up(v.address),
-        "pub_key": {
-            "type": "tendermint/PubKeyEd25519",
-            "value": b64(v.pub_key.bytes()),
-        },
+        "pub_key": amino_json.to_tagged(v.pub_key),
         "voting_power": str(v.voting_power),
         "proposer_priority": str(v.proposer_priority),
     }

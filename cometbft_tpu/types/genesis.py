@@ -19,28 +19,18 @@ from cometbft_tpu.types.params import ConsensusParams, default_consensus_params
 
 MAX_CHAIN_ID_LEN = 50
 
-_TYPE_TO_CLS = {
-    ed25519.PUB_KEY_NAME: ed25519.PubKeyEd25519,
-    secp256k1.PUB_KEY_NAME: secp256k1.PubKeySecp256k1,
-}
-_KEYTYPE_TO_NAME = {
-    ed25519.KEY_TYPE: ed25519.PUB_KEY_NAME,
-    secp256k1.KEY_TYPE: secp256k1.PUB_KEY_NAME,
-}
-
-
 def pub_key_to_json(pk: PubKey) -> dict:
-    return {
-        "type": _KEYTYPE_TO_NAME[pk.type()],
-        "value": base64.b64encode(pk.bytes()).decode(),
-    }
+    """Amino-tagged key dict — ONE registry for the wire format
+    (libs/amino_json), shared with privval and the RPC serializers."""
+    from cometbft_tpu.libs import amino_json
+
+    return amino_json.to_tagged(pk)
 
 
 def pub_key_from_json(obj: dict) -> PubKey:
-    cls = _TYPE_TO_CLS.get(obj["type"])
-    if cls is None:
-        raise ValueError(f"unknown pubkey type {obj['type']!r}")
-    return cls(base64.b64decode(obj["value"]))
+    from cometbft_tpu.libs import amino_json
+
+    return amino_json.from_tagged(obj)
 
 
 @dataclass

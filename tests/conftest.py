@@ -27,16 +27,4 @@ jax.config.update("jax_compilation_cache_dir", os.path.abspath(_CACHE_DIR))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 
-def free_ports(n: int):
-    """Reserve n distinct ephemeral TCP ports (shared test helper)."""
-    import socket
-
-    out, socks = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        out.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return out
+from cometbft_tpu.libs.net import free_ports  # noqa: E402,F401  (shared test helper)

@@ -1,0 +1,91 @@
+"""abci console command + amino-compatible JSON.
+
+Model: reference abci/tests/test_cli (echo/info/deliver_tx/commit/query
+against a socket app) and libs/json (registered type tags round-trip).
+"""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.abci.server import SocketServer
+from cometbft_tpu.cmd.commands import main as cli_main
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs import amino_json
+
+from conftest import free_ports
+
+
+class TestAbciCLI:
+    def test_console_commands_against_socket_app(self, capsys):
+        (port,) = free_ports(1)
+        addr = f"tcp://127.0.0.1:{port}"
+        server = SocketServer(addr, KVStoreApplication())
+        server.start()
+        time.sleep(0.2)
+        try:
+            assert cli_main(["abci", "echo", "hello", "--address", addr]) == 0
+            assert capsys.readouterr().out.strip() == "hello"
+
+            assert cli_main(
+                ["abci", "deliver_tx", "cli=works", "--address", addr]
+            ) == 0
+            out = capsys.readouterr().out
+            assert '"code": 0' in out
+
+            assert cli_main(["abci", "commit", "--address", addr]) == 0
+            capsys.readouterr()
+
+            assert cli_main(
+                ["abci", "query", "cli", "--address", addr]
+            ) == 0
+            out = capsys.readouterr().out
+            assert '"value": "works"' in out
+
+            assert cli_main(["abci", "info", "--address", addr]) == 0
+            out = capsys.readouterr().out
+            assert '"last_block_height": 1' in out
+        finally:
+            server.stop()
+
+
+class TestAminoJSON:
+    def test_registered_key_roundtrip(self):
+        k = ed25519.gen_priv_key()
+        doc = {"address": k.pub_key().address().hex(), "pub_key": k.pub_key()}
+        s = amino_json.marshal(doc)
+        assert '"type": "tendermint/PubKeyEd25519"' in s
+        back = amino_json.unmarshal(s)
+        assert back["pub_key"].bytes() == k.pub_key().bytes()
+        assert back["address"] == doc["address"]
+
+    def test_nested_structures_and_bytes(self):
+        k = ed25519.gen_priv_key()
+        s = amino_json.marshal(
+            {"vals": [{"pk": k.pub_key(), "power": 3}], "blob": b"\x01\x02"}
+        )
+        back = amino_json.unmarshal(s)
+        assert back["vals"][0]["pk"].bytes() == k.pub_key().bytes()
+        # plain bytes b64-encode without a tag (one-way, like the reference
+        # treats []byte)
+        assert back["blob"] == "AQI="
+
+    def test_privkey_tag(self):
+        k = ed25519.gen_priv_key()
+        back = amino_json.unmarshal(amino_json.marshal(k))
+        assert back.bytes() == k.bytes()
+        assert back.pub_key().bytes() == k.pub_key().bytes()
+
+    def test_unknown_tags_pass_through(self):
+        back = amino_json.unmarshal(
+            '{"type": "unregistered/Thing", "value": 1}'
+        )
+        assert back == {"type": "unregistered/Thing", "value": 1}
+
+    def test_duplicate_registration_rejected(self):
+        with pytest.raises(ValueError):
+            amino_json.register_type(
+                dict, "tendermint/PubKeyEd25519", lambda x: x, lambda x: x
+            )

@@ -6,7 +6,6 @@ client's parsed types must round-trip the server's JSON bit-exactly
 """
 
 import base64
-import socket
 import tempfile
 import time
 

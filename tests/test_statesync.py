@@ -500,7 +500,6 @@ class TestStateSyncFromConfig:
         [statesync] enable + rpc_servers + trust root restores a snapshot
         discovered over p2p, verified via HTTP light providers, then
         blocksyncs and switches to consensus (node.go:651-706)."""
-        import socket as _socket
         import tempfile
 
         from cometbft_tpu.cmd.commands import _load_config, main as cli_main
