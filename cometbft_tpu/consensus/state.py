@@ -1010,7 +1010,7 @@ class ConsensusState(BaseService):
                 )
                 return False
             if self.evpool is not None:
-                self.evpool.report_conflicting_votes(e.existing, e.new)
+                self.evpool.report_conflicting_votes(e.vote_a, e.vote_b)
             self.logger.debug("found and sent conflicting votes to the evidence pool")
             return False
 

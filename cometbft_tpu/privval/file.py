@@ -33,8 +33,6 @@ STEP_PROPOSE = 1
 STEP_PREVOTE = 2
 STEP_PRECOMMIT = 3
 
-_PUB_KEY_TYPE_TAG = "tendermint/PubKeyEd25519"
-_PRIV_KEY_TYPE_TAG = "tendermint/PrivKeyEd25519"
 
 
 def _vote_to_step(vote: Vote) -> int:

@@ -8,12 +8,11 @@ matching crypto/ed25519/ed25519.go:37-40 registration.
 
 from __future__ import annotations
 
-import base64
 import json
 from dataclasses import dataclass, field
 from typing import List, Optional
 
-from cometbft_tpu.crypto import PubKey, ed25519, secp256k1
+from cometbft_tpu.crypto import PubKey
 from cometbft_tpu.proto.gogo import Timestamp
 from cometbft_tpu.types.params import ConsensusParams, default_consensus_params
 

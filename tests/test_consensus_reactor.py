@@ -88,7 +88,8 @@ class Node:
         cfg = test_cfg.consensus
         cfg.wal_path = ""
         self.cons = ConsensusState(
-            cfg, state, executor, self.block_store, wal=NilWAL()
+            cfg, state, executor, self.block_store, evpool=self.evpool,
+            wal=NilWAL(),
         )
         self.cons.set_priv_validator(priv_val)
         self.reactor = ConsensusReactor(self.cons)
@@ -389,6 +390,119 @@ class TestConsensusOverTCP:
                 got = nodes[3].block_store.load_block_meta(h)
                 assert got is not None, f"late node missing block {h}"
                 assert got.block_id.hash == want
+        finally:
+            for n in nodes:
+                n.stop()
+
+
+@pytest.mark.slow
+class TestMaverickDoubleSigner:
+    def test_live_equivocation_is_detected_and_committed(self):
+        """Maverick analog (test/maverick double-prevote/precommit): node 0
+        broadcasts a CONFLICTING precommit for the very vote it just cast.
+        Honest nodes' HeightVoteSets detect the conflict, route it through
+        report_conflicting_votes into their evidence pools, and the
+        DuplicateVoteEvidence ends up inside a committed block everywhere
+        (consensus/state.go tryAddVote ErrVoteConflictingVotes +
+        evidence/pool.go processConsensusBuffer)."""
+        import threading as _threading
+
+        from cometbft_tpu.consensus.messages import (
+            VoteMessage,
+            encode_consensus_message,
+        )
+        from cometbft_tpu.consensus.reactor import VOTE_CHANNEL
+        from cometbft_tpu.types.block import BlockID, PartSetHeader
+        from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+        from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PRECOMMIT, Vote
+
+        nodes, doc, privs = _make_net(4)
+        maverick = nodes[0]
+        pv = privs[0]
+
+        # wrap _sign_add_vote: BEFORE casting the genuine precommit, gossip
+        # a conflicting one for the same H/R — peers then hold both votes
+        # within the live round, exactly like the reference maverick's
+        # double-precommit misbehavior
+        genuine_sign = maverick.cons._sign_add_vote
+        equivocated = _threading.Event()
+
+        def double_sign(msg_type, hash_, header):
+            rs = maverick.cons.rs
+            if (
+                msg_type == SIGNED_MSG_TYPE_PRECOMMIT
+                and rs.height >= 2
+                and hash_  # only equivocate on real (non-nil) precommits
+                and not equivocated.is_set()
+                and maverick.cons.priv_validator_pub_key is not None
+            ):
+                idx, _ = rs.validators.get_by_address(
+                    maverick.cons.priv_validator_pub_key.address()
+                )
+                conflict = Vote(
+                    type=msg_type,
+                    height=rs.height,
+                    round=rs.round,
+                    block_id=BlockID(
+                        b"\xee" * 32, PartSetHeader(1, b"\xdd" * 32)
+                    ),
+                    timestamp=Timestamp(1_700_000_000, 0),
+                    validator_address=(
+                        maverick.cons.priv_validator_pub_key.address()
+                    ),
+                    validator_index=idx,
+                )
+                pv.sign_vote(doc.chain_id, conflict)
+                maverick.switch.broadcast(
+                    VOTE_CHANNEL,
+                    encode_consensus_message(VoteMessage(conflict)),
+                )
+                genuine = genuine_sign(msg_type, hash_, header)
+                if genuine is not None:
+                    # push the genuine vote directly too so both votes hit
+                    # every peer back-to-back within the live round (the
+                    # normal gossip path can lose the race against commit)
+                    maverick.switch.broadcast(
+                        VOTE_CHANNEL,
+                        encode_consensus_message(VoteMessage(genuine)),
+                    )
+                    equivocated.set()
+                return genuine
+            return genuine_sign(msg_type, hash_, header)
+
+        maverick.cons._sign_add_vote = double_sign
+
+        for n in nodes:
+            n.start()
+        try:
+            _connect_all(nodes)
+            _wait(
+                lambda: equivocated.is_set(),
+                timeout=90,
+                desc="maverick equivocating",
+            )
+
+            def evidence_committed(n):
+                for h in range(2, n.height() + 1):
+                    blk = n.block_store.load_block(h)
+                    if blk is None:
+                        continue
+                    for ev in blk.evidence:
+                        if isinstance(ev, DuplicateVoteEvidence) and (
+                            ev.vote_a.validator_address
+                            == pv.get_pub_key().address()
+                        ):
+                            return True
+                return False
+
+            # at least 3 honest nodes commit the equivocation evidence
+            _wait(
+                lambda: sum(
+                    1 for n in nodes[1:] if evidence_committed(n)
+                ) >= 3,
+                timeout=120,
+                desc="evidence committed on honest nodes",
+            )
         finally:
             for n in nodes:
                 n.stop()

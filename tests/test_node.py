@@ -21,16 +21,7 @@ import pytest
 from cometbft_tpu.cmd.commands import main as cli_main
 
 
-def _free_ports(n):
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
+from conftest import free_ports as _free_ports
 
 
 def _rpc(port, route, timeout=5):

@@ -8,7 +8,6 @@ Model: reference state/rollback_test.go and cmd/cometbft/commands/
 import base64
 import json
 import os
-import socket
 import tempfile
 import time
 import urllib.request
@@ -97,17 +96,7 @@ class TestRollback:
             rollback(BlockStore(MemDB()), Store(MemDB()))
 
 
-def _free_ports(n):
-    out = []
-    socks = []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        out.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return out
+from conftest import free_ports as _free_ports
 
 
 def _rpc_post(port, method, params):
