@@ -261,7 +261,7 @@ def verify_kernel(
 # --- host glue -------------------------------------------------------------
 
 _MIN_PAD = 64
-_MAX_CHUNK = 4096
+_MAX_CHUNK = 8192
 
 
 def _pad_size(n: int) -> int:

@@ -1,16 +1,18 @@
-"""TPU-parallel RFC-6962 Merkle root.
+"""TPU-parallel RFC-6962 Merkle root — the whole tree in ONE device call.
 
 Reference: crypto/merkle/tree.go:9 HashFromByteSlices — recursive,
-one stdlib SHA-256 call per node. Here every tree LEVEL is one batched
-device call: pairwise inner hashing with the odd tail carried up, which
-reproduces the reference's largest-power-of-two-split tree shape exactly
-(proved level-by-level: carrying the unpaired tail is equivalent to the
-recursive split for every n).
+one stdlib SHA-256 call per node. Here the full reduction runs as a
+single jitted program: leaves are hashed on the host (variable length,
+C-speed hashlib), then every inner level — pairwise SHA-256 over fixed
+65-byte messages (0x01 ‖ left ‖ right) — happens on-device with no
+host↔device round-trips between levels. Level counts are carried as a
+traced scalar over a fixed log2(P) level loop, with the odd tail carried
+up unhashed, which reproduces the reference's largest-power-of-two-split
+tree shape exactly for every n.
 
-Leaves are hashed on the host (variable length, C-speed hashlib); the
-N-1 inner nodes — fixed 65-byte messages — run through the JAX SHA-256
-kernel level by level. Level widths are padded to the next power of two
-so the jit cache holds ~log2(N) specializations total.
+One compilation per power-of-two padded size; lanes beyond the live
+count compute garbage that is masked out, which costs nothing on the
+VPU's fixed-width lanes.
 
 Bit-identical to crypto.merkle.hash_from_byte_slices for every n
 (tests/test_tpu_merkle.py parity suite).
@@ -19,8 +21,11 @@ Bit-identical to crypto.merkle.hash_from_byte_slices for every n
 from __future__ import annotations
 
 import hashlib
+from functools import partial
 from typing import Sequence
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from cometbft_tpu.crypto.tpu import sha256 as tpu_sha
@@ -39,31 +44,59 @@ def _pad_pow2(n: int) -> int:
     return size
 
 
-def _inner_level_device(nodes: np.ndarray) -> np.ndarray:
-    """uint8[2k, 32] → uint8[k, 32]: one batched device call."""
-    k = nodes.shape[0] // 2
-    msgs = np.zeros((k, _INNER_LEN), np.uint8)
-    msgs[:, 0] = 0x01
-    msgs[:, 1:33] = nodes[0::2]
-    msgs[:, 33:65] = nodes[1::2]
-    padded = _pad_pow2(k)
-    blocks = np.zeros((padded, 2, 16), np.uint32)
-    blocks[:k] = tpu_sha.pad_messages_np(msgs, _INNER_LEN)
-    digests = tpu_sha.sha256_blocks(blocks)
-    return tpu_sha.digests_to_bytes_np(np.asarray(digests)[:k])
+def _inner_blocks(left: jnp.ndarray, right: jnp.ndarray) -> jnp.ndarray:
+    """left/right u32[B,8] digest words → u32[B,2,16] SHA-padded blocks of
+    the 65-byte message 0x01 ‖ left ‖ right (big-endian packing shifted by
+    the single prefix byte)."""
+    u8 = np.uint32(0xFF)
+    words = []
+    # block 0: 0x01 then the first 63 message bytes
+    words.append((jnp.uint32(0x01) << 24) | (left[..., 0] >> 8))
+    for i in range(1, 8):
+        words.append(((left[..., i - 1] & u8) << 24) | (left[..., i] >> 8))
+    words.append(((left[..., 7] & u8) << 24) | (right[..., 0] >> 8))
+    for i in range(1, 8):
+        words.append(((right[..., i - 1] & u8) << 24) | (right[..., i] >> 8))
+    block0 = jnp.stack(words, axis=-1)
+    # block 1: last message byte, 0x80 terminator, zeros, 520-bit length
+    zero = jnp.zeros_like(left[..., 0])
+    w16 = ((right[..., 7] & u8) << 24) | jnp.uint32(0x80 << 16)
+    tail = [w16] + [zero] * 14 + [jnp.full_like(zero, _INNER_LEN * 8)]
+    block1 = jnp.stack(tail, axis=-1)
+    return jnp.stack([block0, block1], axis=-2)
 
 
-def _inner_level_host(nodes: np.ndarray) -> np.ndarray:
-    k = nodes.shape[0] // 2
-    out = np.zeros((k, 32), np.uint8)
-    for i in range(k):
-        out[i] = np.frombuffer(
-            hashlib.sha256(
-                b"\x01" + nodes[2 * i].tobytes() + nodes[2 * i + 1].tobytes()
-            ).digest(),
-            np.uint8,
+@partial(jax.jit, static_argnames=("levels",))
+def _tree_kernel(digests: jnp.ndarray, m0: jnp.ndarray, levels: int):
+    """digests u32[P,8] (first m0 live), P = 2^levels → root u32[8].
+
+    Each iteration halves the live count: hash the even/odd pairs, carry
+    an odd tail unhashed. Runs exactly `levels` iterations; once the live
+    count reaches 1 further iterations are identity (pairs = 0, the
+    single root carries itself), so over-running is harmless."""
+    a = digests
+    m = m0.astype(jnp.int32)
+    for _ in range(levels):
+        # the array SHRINKS each level (static shapes, loop is unrolled):
+        # total SHA work stays O(P) instead of O(P log P). The live count
+        # m never exceeds the current width: m' = ceil(m/2) <= w/2.
+        width = a.shape[0] // 2
+        pairs = m - (m & 1)
+        half = pairs // 2
+        hashed = tpu_sha.sha256_blocks(
+            _inner_blocks(a[0::2], a[1::2])
+        )  # [w/2, 8]
+        carried = jax.lax.dynamic_index_in_dim(
+            a, jnp.maximum(m - 1, 0), axis=0, keepdims=False
         )
-    return out
+        idx = jnp.arange(width, dtype=jnp.int32)[:, None]
+        a = jnp.where(
+            idx < half,
+            hashed,
+            jnp.where(idx == half, carried[None, :], 0),
+        )
+        m = half + (m & 1)
+    return a[0]
 
 
 def hash_from_byte_slices(
@@ -74,27 +107,33 @@ def hash_from_byte_slices(
     n = len(items)
     if n == 0:
         return hashlib.sha256(b"").digest()
-    # leaf hashes on host: variable-length inputs, C-speed hashlib
-    level = np.zeros((n, 32), np.uint8)
-    for i, item in enumerate(items):
-        level[i] = np.frombuffer(
-            hashlib.sha256(_LEAF_PREFIX + bytes(item)).digest(), np.uint8
-        )
-    while level.shape[0] > 1:
-        m = level.shape[0]
-        pairs = m - (m % 2)
-        # per-level choice: the narrow levels near the root are cheaper on
-        # the host than a device dispatch round-trip
-        use_device = force_device or pairs >= MIN_DEVICE_LEAVES
-        hashed = (
-            _inner_level_device(level[:pairs])
-            if use_device and pairs >= 2
-            else _inner_level_host(level[:pairs])
-        )
-        if m % 2:
-            # odd tail carries up unhashed (== the reference's
-            # largest-power-of-two split shape)
-            level = np.concatenate([hashed, level[m - 1 :]], axis=0)
-        else:
-            level = hashed
-    return level[0].tobytes()
+    leaves = [
+        hashlib.sha256(_LEAF_PREFIX + bytes(item)).digest() for item in items
+    ]
+    if n == 1:
+        return leaves[0]
+    if not force_device and n < MIN_DEVICE_LEAVES:
+        return _host_tree(leaves)
+    # pack digests to big-endian u32 words only for the device path
+    raw = np.frombuffer(b"".join(leaves), np.uint8).reshape(n, 8, 4)
+    w = raw.astype(np.uint32)
+    words = (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) | w[..., 3]
+    p = max(2, _pad_pow2(n))
+    levels = p.bit_length() - 1
+    padded = np.zeros((p, 8), np.uint32)
+    padded[:n] = words
+    root = _tree_kernel(padded, np.int32(n), levels)
+    return tpu_sha.digests_to_bytes_np(np.asarray(root)[None, :])[0].tobytes()
+
+
+def _host_tree(level: list) -> bytes:
+    """Small-n fallback: same reduction shape, hashlib on the host."""
+    while len(level) > 1:
+        nxt = [
+            hashlib.sha256(b"\x01" + level[i] + level[i + 1]).digest()
+            for i in range(0, len(level) - 1, 2)
+        ]
+        if len(level) % 2:
+            nxt.append(level[-1])
+        level = nxt
+    return level[0]

@@ -15,7 +15,7 @@ from cometbft_tpu.cmd.commands import main as cli_main
 from cometbft_tpu.crypto import ed25519
 from cometbft_tpu.libs import amino_json
 
-from conftest import free_ports
+from cometbft_tpu.libs.net import free_ports
 
 
 class TestAbciCLI:

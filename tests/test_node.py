@@ -19,9 +19,7 @@ import urllib.request
 import pytest
 
 from cometbft_tpu.cmd.commands import main as cli_main
-
-
-from conftest import free_ports as _free_ports
+from cometbft_tpu.libs.net import free_ports as _free_ports
 
 
 def _rpc(port, route, timeout=5):

@@ -28,6 +28,7 @@ from cometbft_tpu.store import BlockStore
 from cometbft_tpu.types import test_util
 from cometbft_tpu.types.block import BlockID, Commit
 from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.libs.net import free_ports as _free_ports
 
 GENESIS_TIME = Timestamp(1_700_000_000, 0)
 
@@ -94,9 +95,6 @@ class TestRollback:
     def test_errors_without_state(self):
         with pytest.raises(ValueError):
             rollback(BlockStore(MemDB()), Store(MemDB()))
-
-
-from conftest import free_ports as _free_ports
 
 
 def _rpc_post(port, method, params):

@@ -19,9 +19,7 @@ from cometbft_tpu.light.store import DBStore
 from cometbft_tpu.node import default_new_node
 from cometbft_tpu.proto.gogo import Timestamp
 from cometbft_tpu.rpc.client import HTTPClient, RPCClientError
-
-
-from conftest import free_ports as _free_ports
+from cometbft_tpu.libs.net import free_ports as _free_ports
 
 
 def _now() -> Timestamp:

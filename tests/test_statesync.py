@@ -509,7 +509,7 @@ class TestStateSyncFromConfig:
 
         monkeypatch.setattr(syncer_mod_, "MINIMUM_DISCOVERY_TIME", 0.5)
 
-        from conftest import free_ports
+        from cometbft_tpu.libs.net import free_ports
 
         with tempfile.TemporaryDirectory() as d:
             # source: a single-validator chain with a snapshotting app

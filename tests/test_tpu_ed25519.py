@@ -172,6 +172,37 @@ class TestVerifyBatchParity:
 
 
 class TestTPUBatchVerifier:
+    def test_small_batches_route_to_cpu_kernel_above_threshold(self):
+        """The tpu boundary verifies small batches on CPU (measured
+        crossover ~1k sigs) but MUST still drive the device kernel when
+        forced below threshold — guards the hybrid routing both ways."""
+        from cometbft_tpu.crypto.batch import TPUBatchVerifier
+
+        keys = [ed.gen_priv_key_from_secret(bytes([i, 11])) for i in range(4)]
+        bv = TPUBatchVerifier(min_batch=2)  # force the kernel path
+        for i, k in enumerate(keys):
+            msg = b"kernel path %d" % i
+            sig = k.sign(msg) if i != 1 else b"\x11" * 64
+            bv.add(k.pub_key(), msg, sig)
+        ok, mask = bv.verify()
+        assert not ok
+        assert mask == [True, False, True, True]
+
+    def test_default_threshold_keeps_small_batches_off_device(self, monkeypatch):
+        from cometbft_tpu.crypto.tpu import ed25519_batch
+
+        def boom(*a, **k):
+            raise AssertionError("kernel dispatched for a small batch")
+
+        monkeypatch.setattr(ed25519_batch, "verify_batch", boom)
+        bv = cbatch.new_batch_verifier("tpu")  # default min_batch
+        keys = [ed.gen_priv_key_from_secret(bytes([i, 13])) for i in range(6)]
+        for i, k in enumerate(keys):
+            m = b"cpu route %d" % i
+            bv.add(k.pub_key(), m, k.sign(m))
+        ok, mask = bv.verify()
+        assert ok and all(mask)
+
     def test_backend_routing(self):
         bv = cbatch.new_batch_verifier("tpu")
         keys = [ed.gen_priv_key_from_secret(bytes([i, 9])) for i in range(5)]
