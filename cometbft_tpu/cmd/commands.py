@@ -299,6 +299,180 @@ def cmd_abci(args) -> int:
     return 0
 
 
+def cmd_debug(args) -> int:
+    """cmd/cometbft/commands/debug/ — `dump` collects a diagnostic bundle
+    (config, status + consensus state via RPC, pprof stacks/heap, WAL
+    tail) into a tar.gz; `inspect` serves a read-only subset of the RPC
+    over a crashed node's data dirs (no p2p, no consensus)."""
+    sub = args.debug_command
+    if sub == "dump":
+        return _debug_dump(args)
+    if sub == "inspect":
+        return _debug_inspect(args)
+    print(f"unknown debug command {sub!r}", file=sys.stderr)
+    return 1
+
+
+def _debug_dump(args) -> int:
+    import io
+    import tarfile
+    import urllib.request
+
+    cfg = _load_config(args.home)
+    out_path = args.output or os.path.join(
+        args.home, f"debug-bundle-{int(time.time())}.tar.gz"
+    )
+
+    def fetch(url: str, body: bytes = None, headers: dict = None) -> bytes:
+        """Every collection step degrades to an 'unavailable' entry — a
+        half-broken home must still yield a bundle, never a traceback."""
+        try:
+            req = urllib.request.Request(url, data=body, headers=headers or {})
+            return urllib.request.urlopen(req, timeout=5).read()
+        except Exception as exc:  # noqa: BLE001 — a dead node is the point
+            return f"unavailable: {exc}".encode()
+
+    def read_file(path: str) -> bytes:
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError as exc:
+            return f"unavailable: {exc}".encode()
+
+    rpc_base = "http://" + cfg.rpc.laddr.split("://", 1)[-1]
+    entries = {}
+    for name, method in (
+        ("status.json", "status"),
+        ("net_info.json", "net_info"),
+        ("consensus_state.json", "dump_consensus_state"),
+    ):
+        body = json.dumps(
+            {"jsonrpc": "2.0", "id": 1, "method": method, "params": {}}
+        ).encode()
+        entries[name] = fetch(
+            rpc_base + "/", body, {"Content-Type": "application/json"}
+        )
+    if cfg.rpc.pprof_laddr:
+        pprof_base = "http://" + cfg.rpc.pprof_laddr.split("://", 1)[-1]
+        entries["stacks.txt"] = fetch(pprof_base + "/debug/stacks")
+        entries["heap.txt"] = fetch(pprof_base + "/debug/heap")
+    toml_path = os.path.join(args.home, "config", "config.toml")
+    if os.path.exists(toml_path):
+        entries["config.toml"] = read_file(toml_path)
+    # the WAL dir comes from [consensus] wal_path — custom paths included
+    wal_dir = os.path.dirname(cfg.consensus.wal_file())
+    if os.path.isdir(wal_dir):
+        for name in sorted(os.listdir(wal_dir))[-3:]:
+            entries[f"wal/{name}"] = read_file(os.path.join(wal_dir, name))
+
+    with tarfile.open(out_path, "w:gz") as tar:
+        for name, data in entries.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    print(f"Wrote debug bundle {out_path} ({len(entries)} entries)")
+    return 0
+
+
+def _debug_inspect(args) -> int:
+    """Read-only RPC over a crashed node's stores — no p2p/consensus
+    boots, so it is safe on a wedged home (debug/inspect.go)."""
+    from cometbft_tpu.node.node import default_db_provider
+    from cometbft_tpu.rpc.serializers import (
+        block_id_json,
+        block_json,
+        block_meta_json,
+        header_json,
+        validator_json,
+    )
+    from cometbft_tpu.state.store import Store as StateStore
+    from cometbft_tpu.store import BlockStore
+
+    cfg = _load_config(args.home)
+    block_store = BlockStore(default_db_provider("blockstore", cfg))
+    state_store = StateStore(default_db_provider("state", cfg))
+
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802
+            import urllib.parse
+
+            parsed = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(parsed.query)
+            try:
+                if parsed.path == "/status":
+                    state = state_store.load()
+                    out = {
+                        "base": block_store.base(),
+                        "height": block_store.height(),
+                        "state_height": (
+                            state.last_block_height if state else None
+                        ),
+                        "app_hash": state.app_hash.hex().upper()
+                        if state
+                        else "",
+                    }
+                elif parsed.path == "/block":
+                    h = int(q["height"][0])
+                    blk = block_store.load_block(h)
+                    meta = block_store.load_block_meta(h)
+                    if blk is None or meta is None:
+                        raise ValueError(f"no block at height {h}")
+                    out = {
+                        "block_id": block_id_json(meta.block_id),
+                        "block": block_json(blk),
+                    }
+                elif parsed.path == "/validators":
+                    h = int(q["height"][0])
+                    vals = state_store.load_validators(h)
+                    out = {
+                        "validators": [
+                            validator_json(v) for v in vals.validators
+                        ]
+                    }
+                else:
+                    self.send_error(404)
+                    return
+                body = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            except Exception as exc:  # noqa: BLE001
+                body = json.dumps({"error": str(exc)}).encode()
+                self.send_response(500)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    from cometbft_tpu.node.node import _parse_laddr
+
+    host, port = _parse_laddr(args.laddr)
+    httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+    print(
+        f"Inspect server on {args.laddr} "
+        f"(routes: /status, /block?height=H, /validators?height=H)",
+        flush=True,
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        while not stop.is_set():
+            time.sleep(0.3)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    return 0
+
+
 def cmd_gen_node_key(args) -> int:
     """commands/gen_node_key.go — create (or show) the node p2p key."""
     cfg = _load_config(args.home)
@@ -489,6 +663,16 @@ def main(argv: Optional[list] = None) -> int:
         help="remove all data and the address book (keeps the validator key)",
     )
     p.set_defaults(fn=cmd_unsafe_reset_all)
+
+    p = sub.add_parser(
+        "debug", help="diagnostic bundle (dump) / crashed-home RPC (inspect)"
+    )
+    p.add_argument("debug_command", choices=["dump", "inspect"])
+    p.add_argument("--output", default="", help="bundle path (dump)")
+    p.add_argument(
+        "--laddr", default="tcp://127.0.0.1:26669", help="inspect listen addr"
+    )
+    p.set_defaults(fn=cmd_debug)
 
     p = sub.add_parser("gen-node-key", help="generate or show the node key")
     p.set_defaults(fn=cmd_gen_node_key)

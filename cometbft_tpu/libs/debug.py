@@ -1,0 +1,105 @@
+"""Runtime introspection — the pprof analog.
+
+Reference: the Go runtime's pprof HTTP server, exposed when
+RPC.PprofListenAddress is set (node/node.go:896-902), plus the `debug`
+CLI's profile bundles (cmd/cometbft/commands/debug/). Python's
+equivalents: per-thread stack traces (goroutine profile), tracemalloc
+snapshots (heap profile), and GC/object stats.
+"""
+
+from __future__ import annotations
+
+import gc
+import sys
+import threading
+import traceback
+from typing import Optional
+
+
+def thread_stacks() -> str:
+    """Every live thread's stack — the goroutine-dump analog."""
+    frames = sys._current_frames()
+    by_ident = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        t = by_ident.get(ident)
+        name = t.name if t else f"thread-{ident}"
+        daemon = " daemon" if t is not None and t.daemon else ""
+        out.append(f"--- {name} (ident {ident}{daemon}) ---")
+        out.append("".join(traceback.format_stack(frame)))
+    return "\n".join(out)
+
+
+def heap_profile(top: int = 40) -> str:
+    """tracemalloc top allocations (started lazily on first request)."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+        return (
+            "tracemalloc was not running; started now — request again "
+            "after some activity for a populated profile\n"
+        )
+    snapshot = tracemalloc.take_snapshot()
+    stats = snapshot.statistics("lineno")
+    lines = [f"top {top} allocation sites (tracemalloc):"]
+    for s in stats[:top]:
+        lines.append(str(s))
+    total = sum(s.size for s in stats)
+    lines.append(f"total traced: {total / 1024:.1f} KiB")
+    return "\n".join(lines)
+
+
+def gc_stats() -> str:
+    counts = gc.get_count()
+    return (
+        f"gc counts: {counts}\n"
+        f"objects tracked: {len(gc.get_objects())}\n"
+        f"threads: {threading.active_count()}\n"
+    )
+
+
+class PprofServer:
+    """Tiny HTTP server for /debug/stacks, /debug/heap, /debug/gc
+    (node/node.go:896 startPprofServer analog)."""
+
+    def __init__(self):
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    def serve(self, host: str, port: int) -> int:
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                path = self.path.split("?")[0]
+                if path in ("/debug/stacks", "/debug/pprof/goroutine"):
+                    body = thread_stacks().encode()
+                elif path in ("/debug/heap", "/debug/pprof/heap"):
+                    body = heap_profile().encode()
+                elif path == "/debug/gc":
+                    body = gc_stats().encode()
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pprof-http", daemon=True
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

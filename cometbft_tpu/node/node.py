@@ -437,6 +437,12 @@ class Node(BaseService):
         if self.rpc_server is not None:
             host, port = _parse_laddr(self.config.rpc.laddr)
             self.rpc_server.serve(host, port)
+        if self.config.rpc.pprof_laddr:
+            from cometbft_tpu.libs.debug import PprofServer
+
+            host, port = _parse_laddr(self.config.rpc.pprof_laddr)
+            self.pprof_server = PprofServer()
+            self.pprof_server.serve(host, port)
         if self.metrics_registry is not None:
             from cometbft_tpu.libs.metrics import MetricsServer
 
@@ -525,6 +531,7 @@ class Node(BaseService):
 
     def on_stop(self) -> None:
         for svc in (
+            getattr(self, "pprof_server", None),
             getattr(self, "metrics_server", None),
             self.rpc_server,
             self.switch,

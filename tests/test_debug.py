@@ -1,0 +1,169 @@
+"""Debug/profiling tooling: pprof-analog endpoints, debug dump bundle,
+and the read-only inspect server over a crashed home.
+
+Model: reference node/node.go:896 (pprof server) +
+cmd/cometbft/commands/debug/{dump,inspect}.go.
+"""
+
+import base64
+import json
+import subprocess
+import sys
+import tarfile
+import tempfile
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.cmd.commands import _load_config, main as cli_main
+from cometbft_tpu.libs.debug import PprofServer, thread_stacks
+from cometbft_tpu.libs.net import free_ports
+
+
+class TestPprof:
+    def test_thread_stacks_include_current_thread(self):
+        dump = thread_stacks()
+        assert "MainThread" in dump
+        assert "test_thread_stacks_include_current_thread" in dump
+
+    def test_server_routes(self):
+        srv = PprofServer()
+        port = srv.serve("127.0.0.1", 0)
+        try:
+            stacks = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/stacks", timeout=5
+            ).read().decode()
+            assert "MainThread" in stacks
+            gc_out = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/gc", timeout=5
+            ).read().decode()
+            assert "objects tracked" in gc_out
+            heap = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/heap", timeout=5
+            ).read().decode()
+            assert "tracemalloc" in heap
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=5
+                )
+        finally:
+            srv.stop()
+
+
+def _rpc_post(port, method, params):
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=10).read())
+
+
+@pytest.mark.slow
+class TestDebugCLI:
+    def test_dump_and_inspect_on_real_home(self):
+        """Run a node with pprof enabled, dump a bundle while it is live,
+        stop it ('crash'), then inspect the dead home."""
+        from cometbft_tpu.node import default_new_node
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "debug-chain"])
+            rpc_port, p2p_port, pprof_port, inspect_port = free_ports(4)
+            cfg = _load_config(d)
+            cfg.base.proxy_app = "kvstore"
+            cfg.base.db_backend = "sqlite"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            cfg.rpc.pprof_laddr = f"tcp://127.0.0.1:{pprof_port}"
+            # persist the overridden ports so `debug dump` reads them
+            from cometbft_tpu.config import write_config_file
+            import os
+
+            write_config_file(os.path.join(d, "config", "config.toml"), cfg)
+            node = default_new_node(cfg)
+            node.start()
+            try:
+                deadline = time.monotonic() + 60
+                committed = None
+                while time.monotonic() < deadline and committed is None:
+                    try:
+                        committed = _rpc_post(
+                            rpc_port, "broadcast_tx_commit",
+                            {"tx": base64.b64encode(b"dbg=1").decode()},
+                        )["result"]
+                    except Exception:
+                        time.sleep(0.3)
+                assert committed is not None
+
+                # pprof endpoint live on the node
+                stacks = urllib.request.urlopen(
+                    f"http://127.0.0.1:{pprof_port}/debug/stacks", timeout=5
+                ).read().decode()
+                assert "consensus" in stacks or "receive" in stacks
+
+                bundle = os.path.join(d, "bundle.tar.gz")
+                assert cli_main(
+                    ["--home", d, "debug", "dump", "--output", bundle]
+                ) == 0
+                with tarfile.open(bundle) as tar:
+                    names = tar.getnames()
+                    assert "status.json" in names
+                    assert "config.toml" in names
+                    assert "stacks.txt" in names
+                    status = json.loads(
+                        tar.extractfile("status.json").read()
+                    )
+                    assert "result" in status
+            finally:
+                node.stop()
+            time.sleep(0.5)
+
+            # inspect the dead home from a separate process
+            import cometbft_tpu
+
+            repo_root = os.path.dirname(
+                os.path.dirname(os.path.abspath(cometbft_tpu.__file__))
+            )
+            env = dict(os.environ, PYTHONPATH=repo_root)
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "cometbft_tpu", "--home", d,
+                 "debug", "inspect",
+                 "--laddr", f"tcp://127.0.0.1:{inspect_port}"],
+                env=env,
+            )
+            try:
+                deadline = time.monotonic() + 30
+                status = None
+                while time.monotonic() < deadline and status is None:
+                    try:
+                        status = json.loads(urllib.request.urlopen(
+                            f"http://127.0.0.1:{inspect_port}/status",
+                            timeout=3,
+                        ).read())
+                    except Exception:
+                        time.sleep(0.3)
+                assert status is not None and status["height"] >= 1
+                blk = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{inspect_port}/block?height=1",
+                    timeout=5,
+                ).read())
+                assert int(blk["block"]["header"]["height"]) == 1
+                vals = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{inspect_port}/validators?height=1",
+                    timeout=5,
+                ).read())
+                assert len(vals["validators"]) == 1
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{inspect_port}/block?height=99999",
+                        timeout=5,
+                    )
+                    raise AssertionError("missing block served")
+                except urllib.error.HTTPError as e:
+                    assert "error" in json.loads(e.read())
+            finally:
+                proc.kill()
+                proc.wait()
