@@ -1,0 +1,121 @@
+"""ASCII armor for key export.
+
+Reference: crypto/armor — OpenPGP-style armored blocks ("-----BEGIN
+TENDERMINT PRIVATE KEY-----", key/value headers, base64 body, CRC24
+checksum line, END line), used by key export/import tooling.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Dict, Tuple
+
+_CRC24_INIT = 0xB704CE
+_CRC24_POLY = 0x1864CFB
+
+
+def _crc24(data: bytes) -> int:
+    crc = _CRC24_INIT
+    for byte in data:
+        crc ^= byte << 16
+        for _ in range(8):
+            crc <<= 1
+            if crc & 0x1000000:
+                crc ^= _CRC24_POLY
+    return crc & 0xFFFFFF
+
+
+def encode_armor(block_type: str, headers: Dict[str, str], data: bytes) -> str:
+    lines = [f"-----BEGIN {block_type}-----"]
+    for k in sorted(headers):
+        lines.append(f"{k}: {headers[k]}")
+    lines.append("")
+    b64 = base64.b64encode(data).decode()
+    for i in range(0, len(b64), 64):
+        lines.append(b64[i : i + 64])
+    crc = base64.b64encode(_crc24(data).to_bytes(3, "big")).decode()
+    lines.append(f"={crc}")
+    lines.append(f"-----END {block_type}-----")
+    return "\n".join(lines) + "\n"
+
+
+def decode_armor(armor_str: str) -> Tuple[str, Dict[str, str], bytes]:
+    """→ (block_type, headers, data). Raises ValueError on malformed input
+    or checksum mismatch."""
+    lines = [ln.rstrip("\r") for ln in armor_str.strip().splitlines()]
+    if not lines or not lines[0].startswith("-----BEGIN "):
+        raise ValueError("missing armor begin line")
+    if not lines[0].endswith("-----"):
+        raise ValueError("malformed armor begin line")
+    block_type = lines[0][len("-----BEGIN ") : -len("-----")]
+    end_line = f"-----END {block_type}-----"
+    if lines[-1] != end_line:
+        raise ValueError(f"missing armor end line {end_line!r}")
+
+    headers: Dict[str, str] = {}
+    body_start = 1
+    for i, line in enumerate(lines[1:-1], start=1):
+        if not line:
+            body_start = i + 1
+            break
+        if ":" not in line:
+            body_start = i
+            break
+        k, _, v = line.partition(":")
+        headers[k.strip()] = v.strip()
+    else:
+        body_start = len(lines) - 1
+
+    b64_parts = []
+    crc_line = None
+    for line in lines[body_start:-1]:
+        if line.startswith("="):
+            crc_line = line[1:]
+        elif line:
+            b64_parts.append(line)
+    try:
+        data = base64.b64decode("".join(b64_parts), validate=True)
+    except Exception as exc:
+        raise ValueError(f"invalid armor body: {exc}") from exc
+    if crc_line is not None:
+        want = int.from_bytes(base64.b64decode(crc_line), "big")
+        if _crc24(data) != want:
+            raise ValueError("armor checksum mismatch")
+    return block_type, headers, data
+
+
+# the reference's concrete use: armored (encrypted) private keys
+PRIVKEY_BLOCK_TYPE = "TENDERMINT PRIVATE KEY"
+
+
+def encrypt_armor_priv_key(priv_key_bytes: bytes, passphrase: str) -> str:
+    """Armor a private key encrypted under sha256(passphrase ‖ salt)
+    (reference: keys/armor EncryptArmorPrivKey shape)."""
+    import hashlib
+    import os
+
+    from cometbft_tpu.crypto import xsalsa20symmetric as box
+
+    salt = os.urandom(16)
+    secret = hashlib.sha256(salt + passphrase.encode()).digest()
+    blob = box.encrypt_symmetric(priv_key_bytes, secret)
+    return encode_armor(
+        PRIVKEY_BLOCK_TYPE,
+        {"kdf": "sha256-salt", "salt": salt.hex().upper()},
+        blob,
+    )
+
+
+def unarmor_decrypt_priv_key(armor_str: str, passphrase: str) -> bytes:
+    import hashlib
+
+    from cometbft_tpu.crypto import xsalsa20symmetric as box
+
+    block_type, headers, blob = decode_armor(armor_str)
+    if block_type != PRIVKEY_BLOCK_TYPE:
+        raise ValueError(f"unrecognized armor type {block_type!r}")
+    if headers.get("kdf") != "sha256-salt":
+        raise ValueError(f"unrecognized KDF {headers.get('kdf')!r}")
+    salt = bytes.fromhex(headers.get("salt", ""))
+    secret = hashlib.sha256(salt + passphrase.encode()).digest()
+    return box.decrypt_symmetric(blob, secret)

@@ -1,0 +1,152 @@
+"""Symmetric encryption with NaCl secretbox semantics (XSalsa20-Poly1305).
+
+Reference: crypto/xsalsa20symmetric — EncryptSymmetric prepends a random
+24-byte nonce to a secretbox sealing; DecryptSymmetric splits and opens
+(symmetric.go:18-55). The secret must be 32 bytes (e.g.
+Sha256(bcrypt(passphrase)), as the reference advises). Salsa20/HSalsa20
+are implemented here (spec-exact double rounds); the Poly1305 MAC is the
+audited `cryptography` primitive keyed by the first keystream block, per
+the secretbox construction.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+from cryptography.hazmat.primitives import poly1305
+
+NONCE_LEN = 24
+SECRET_LEN = 32
+OVERHEAD = 16  # poly1305 tag
+
+_SIGMA = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+_MASK = 0xFFFFFFFF
+
+
+def _rotl(x: int, n: int) -> int:
+    return ((x << n) | (x >> (32 - n))) & _MASK
+
+
+def _salsa_rounds(x: list) -> None:
+    for _ in range(10):
+        # column round
+        x[4] ^= _rotl((x[0] + x[12]) & _MASK, 7)
+        x[8] ^= _rotl((x[4] + x[0]) & _MASK, 9)
+        x[12] ^= _rotl((x[8] + x[4]) & _MASK, 13)
+        x[0] ^= _rotl((x[12] + x[8]) & _MASK, 18)
+        x[9] ^= _rotl((x[5] + x[1]) & _MASK, 7)
+        x[13] ^= _rotl((x[9] + x[5]) & _MASK, 9)
+        x[1] ^= _rotl((x[13] + x[9]) & _MASK, 13)
+        x[5] ^= _rotl((x[1] + x[13]) & _MASK, 18)
+        x[14] ^= _rotl((x[10] + x[6]) & _MASK, 7)
+        x[2] ^= _rotl((x[14] + x[10]) & _MASK, 9)
+        x[6] ^= _rotl((x[2] + x[14]) & _MASK, 13)
+        x[10] ^= _rotl((x[6] + x[2]) & _MASK, 18)
+        x[3] ^= _rotl((x[15] + x[11]) & _MASK, 7)
+        x[7] ^= _rotl((x[3] + x[15]) & _MASK, 9)
+        x[11] ^= _rotl((x[7] + x[3]) & _MASK, 13)
+        x[15] ^= _rotl((x[11] + x[7]) & _MASK, 18)
+        # row round
+        x[1] ^= _rotl((x[0] + x[3]) & _MASK, 7)
+        x[2] ^= _rotl((x[1] + x[0]) & _MASK, 9)
+        x[3] ^= _rotl((x[2] + x[1]) & _MASK, 13)
+        x[0] ^= _rotl((x[3] + x[2]) & _MASK, 18)
+        x[6] ^= _rotl((x[5] + x[4]) & _MASK, 7)
+        x[7] ^= _rotl((x[6] + x[5]) & _MASK, 9)
+        x[4] ^= _rotl((x[7] + x[6]) & _MASK, 13)
+        x[5] ^= _rotl((x[4] + x[7]) & _MASK, 18)
+        x[11] ^= _rotl((x[10] + x[9]) & _MASK, 7)
+        x[8] ^= _rotl((x[11] + x[10]) & _MASK, 9)
+        x[9] ^= _rotl((x[8] + x[11]) & _MASK, 13)
+        x[10] ^= _rotl((x[9] + x[8]) & _MASK, 18)
+        x[12] ^= _rotl((x[15] + x[14]) & _MASK, 7)
+        x[13] ^= _rotl((x[12] + x[15]) & _MASK, 9)
+        x[14] ^= _rotl((x[13] + x[12]) & _MASK, 13)
+        x[15] ^= _rotl((x[14] + x[13]) & _MASK, 18)
+
+
+def _salsa_block(key: bytes, nonce8: bytes, counter: int) -> bytes:
+    k = struct.unpack("<8I", key)
+    n = struct.unpack("<2I", nonce8)
+    init = [
+        _SIGMA[0], k[0], k[1], k[2],
+        k[3], _SIGMA[1], n[0], n[1],
+        counter & _MASK, (counter >> 32) & _MASK, _SIGMA[2], k[4],
+        k[5], k[6], k[7], _SIGMA[3],
+    ]
+    x = list(init)
+    _salsa_rounds(x)
+    return struct.pack("<16I", *((a + b) & _MASK for a, b in zip(x, init)))
+
+
+def hsalsa20(key: bytes, nonce16: bytes) -> bytes:
+    """32-byte subkey: rounds output words 0,5,10,15,6,7,8,9 (no
+    feedforward) — the XSalsa20 key-derivation core."""
+    k = struct.unpack("<8I", key)
+    n = struct.unpack("<4I", nonce16)
+    x = [
+        _SIGMA[0], k[0], k[1], k[2],
+        k[3], _SIGMA[1], n[0], n[1],
+        n[2], n[3], _SIGMA[2], k[4],
+        k[5], k[6], k[7], _SIGMA[3],
+    ]
+    _salsa_rounds(x)
+    out = (x[0], x[5], x[10], x[15], x[6], x[7], x[8], x[9])
+    return struct.pack("<8I", *out)
+
+
+def _xsalsa20_stream(key: bytes, nonce24: bytes, length: int, skip: int = 0) -> bytes:
+    subkey = hsalsa20(key, nonce24[:16])
+    out = bytearray()
+    counter = skip // 64
+    drop = skip % 64
+    while len(out) < length + drop:
+        out += _salsa_block(subkey, nonce24[16:], counter)
+        counter += 1
+    return bytes(out[drop : drop + length])
+
+
+def seal(plaintext: bytes, nonce: bytes, secret: bytes) -> bytes:
+    """NaCl secretbox: poly1305(key=first 32 keystream bytes) over the
+    ciphertext, tag prepended."""
+    if len(secret) != SECRET_LEN:
+        raise ValueError(f"Secret must be 32 bytes long, got len {len(secret)}")
+    if len(nonce) != NONCE_LEN:
+        raise ValueError("nonce must be 24 bytes")
+    # first keystream block: 32 bytes poly key, rest unused (block 0 tail
+    # is skipped, encryption starts at block 1 like NaCl)
+    poly_key = _xsalsa20_stream(secret, nonce, 32)
+    stream = _xsalsa20_stream(secret, nonce, len(plaintext), skip=64)
+    ct = bytes(p ^ s for p, s in zip(plaintext, stream))
+    mac = poly1305.Poly1305(poly_key)
+    mac.update(ct)
+    return mac.finalize() + ct
+
+
+def open_(box: bytes, nonce: bytes, secret: bytes) -> bytes:
+    if len(secret) != SECRET_LEN:
+        raise ValueError(f"Secret must be 32 bytes long, got len {len(secret)}")
+    if len(box) < OVERHEAD:
+        raise ValueError("ciphertext too short")
+    tag, ct = box[:OVERHEAD], box[OVERHEAD:]
+    poly_key = _xsalsa20_stream(secret, nonce, 32)
+    mac = poly1305.Poly1305(poly_key)
+    mac.update(ct)
+    mac.verify(tag)  # raises InvalidSignature on forgery
+    stream = _xsalsa20_stream(secret, nonce, len(ct), skip=64)
+    return bytes(c ^ s for c, s in zip(ct, stream))
+
+
+def encrypt_symmetric(plaintext: bytes, secret: bytes) -> bytes:
+    """Reference EncryptSymmetric: random nonce ‖ secretbox (symmetric.go:18)."""
+    nonce = os.urandom(NONCE_LEN)
+    return nonce + seal(plaintext, nonce, secret)
+
+
+def decrypt_symmetric(ciphertext: bytes, secret: bytes) -> bytes:
+    """Reference DecryptSymmetric (symmetric.go:37)."""
+    if len(ciphertext) <= NONCE_LEN + OVERHEAD:
+        raise ValueError("ciphertext is too short")
+    nonce, box = ciphertext[:NONCE_LEN], ciphertext[NONCE_LEN:]
+    return open_(box, nonce, secret)

@@ -114,6 +114,14 @@ def _register_defaults() -> None:
         to_value=lambda k: base64.b64encode(k.bytes()).decode(),
         from_value=lambda v: secp256k1.PubKeySecp256k1(base64.b64decode(v)),
     )
+    from cometbft_tpu.crypto import sr25519
+
+    register_type(
+        sr25519.PubKeySr25519,
+        sr25519.PUB_KEY_NAME,
+        to_value=lambda k: base64.b64encode(k.bytes()).decode(),
+        from_value=lambda v: sr25519.PubKeySr25519(base64.b64decode(v)),
+    )
 
 
 _register_defaults()

@@ -1,0 +1,196 @@
+"""Crypto extras: XChaCha20-Poly1305, XSalsa20 secretbox, ASCII armor,
+and sr25519 schnorrkel signatures.
+
+Model: reference crypto/{xchacha20poly1305,xsalsa20symmetric,armor,
+sr25519} test files. HChaCha20 is cross-validated against the audited
+`cryptography` library's ChaCha20 (the rounds output is recoverable from
+a keystream block by subtracting the initial state).
+"""
+
+import struct
+
+import pytest
+
+from cometbft_tpu.crypto import armor, sr25519, xsalsa20symmetric as xsalsa
+from cometbft_tpu.crypto.xchacha20poly1305 import (
+    XChaCha20Poly1305,
+    hchacha20,
+)
+
+
+class TestXChaCha20Poly1305:
+    def test_hchacha20_matches_library_chacha20(self):
+        """Derive the expected HChaCha20 output from cryptography's
+        ChaCha20: keystream block = rounds(state) + state, so
+        rounds-output words = block words - initial words."""
+        from cryptography.hazmat.primitives.ciphers import Cipher, algorithms
+
+        key = bytes(range(32))
+        nonce16 = bytes(range(16, 32))
+        # ChaCha20 nonce in the library = 4-byte counter ‖ 12-byte nonce;
+        # HChaCha's state puts nonce16[0:4] in the counter slot
+        full_nonce = nonce16[:4] + nonce16[4:]
+        algo = algorithms.ChaCha20(key, full_nonce)
+        ks = Cipher(algo, mode=None).encryptor().update(b"\x00" * 64)
+        block = struct.unpack("<16I", ks)
+        sigma = (0x61707865, 0x3320646E, 0x79622D32, 0x6B206574)
+        init = (
+            list(sigma)
+            + list(struct.unpack("<8I", key))
+            + list(struct.unpack("<4I", nonce16))
+        )
+        rounds_out = [(b - i) & 0xFFFFFFFF for b, i in zip(block, init)]
+        want = struct.pack("<8I", *(rounds_out[0:4] + rounds_out[12:16]))
+        assert hchacha20(key, nonce16) == want
+
+    def test_seal_open_roundtrip_and_forgery(self):
+        key = bytes(range(32))
+        aead = XChaCha20Poly1305(key)
+        nonce = bytes(range(24))
+        ct = aead.encrypt(nonce, b"secret payload", b"header")
+        assert aead.decrypt(nonce, ct, b"header") == b"secret payload"
+        from cryptography.exceptions import InvalidTag
+
+        with pytest.raises(InvalidTag):
+            aead.decrypt(nonce, ct[:-1] + bytes([ct[-1] ^ 1]), b"header")
+        with pytest.raises(InvalidTag):
+            aead.decrypt(nonce, ct, b"wrong aad")
+        # different nonces → different ciphertexts
+        assert aead.encrypt(bytes(24), b"x") != aead.encrypt(
+            b"\x01" + bytes(23), b"x"
+        )
+
+    def test_bad_lengths(self):
+        with pytest.raises(ValueError):
+            XChaCha20Poly1305(b"short")
+        with pytest.raises(ValueError):
+            XChaCha20Poly1305(bytes(32)).encrypt(b"short-nonce", b"x")
+
+
+class TestXSalsa20Symmetric:
+    def test_encrypt_decrypt_roundtrip(self):
+        secret = bytes(range(32))
+        for pt in (b"x", b"the quick brown fox" * 20):
+            ct = xsalsa.encrypt_symmetric(pt, secret)
+            assert len(ct) == xsalsa.NONCE_LEN + xsalsa.OVERHEAD + len(pt)
+            assert xsalsa.decrypt_symmetric(ct, secret) == pt
+        # empty plaintext is rejected on decrypt, like the reference's
+        # length guard (symmetric.go:41)
+        with pytest.raises(ValueError):
+            xsalsa.decrypt_symmetric(
+                xsalsa.encrypt_symmetric(b"", secret), secret
+            )
+
+    def test_tamper_detection(self):
+        from cryptography.exceptions import InvalidSignature
+
+        secret = bytes(range(32))
+        ct = bytearray(xsalsa.encrypt_symmetric(b"payload", secret))
+        ct[-1] ^= 1
+        with pytest.raises(InvalidSignature):
+            xsalsa.decrypt_symmetric(bytes(ct), secret)
+
+    def test_wrong_secret_rejected(self):
+        from cryptography.exceptions import InvalidSignature
+
+        ct = xsalsa.encrypt_symmetric(b"payload", bytes(32))
+        with pytest.raises(InvalidSignature):
+            xsalsa.decrypt_symmetric(ct, b"\x01" * 32)
+
+    def test_secret_length_enforced(self):
+        with pytest.raises(ValueError):
+            xsalsa.encrypt_symmetric(b"x", b"short")
+
+
+class TestArmor:
+    def test_roundtrip(self):
+        data = bytes(range(200))
+        s = armor.encode_armor("TEST BLOCK", {"version": "1"}, data)
+        block_type, headers, out = armor.decode_armor(s)
+        assert block_type == "TEST BLOCK"
+        assert headers == {"version": "1"}
+        assert out == data
+
+    def test_checksum_detects_corruption(self):
+        s = armor.encode_armor("T", {}, b"hello armor world")
+        lines = s.splitlines()
+        # corrupt one base64 body char
+        for i, ln in enumerate(lines):
+            if ln and not ln.startswith("-") and ":" not in ln and not ln.startswith("="):
+                lines[i] = ("A" if ln[0] != "A" else "B") + ln[1:]
+                break
+        with pytest.raises(ValueError):
+            armor.decode_armor("\n".join(lines))
+
+    def test_armored_privkey_roundtrip(self):
+        key = bytes(range(32, 64))
+        s = armor.encrypt_armor_priv_key(key, "hunter2")
+        assert "BEGIN TENDERMINT PRIVATE KEY" in s
+        assert armor.unarmor_decrypt_priv_key(s, "hunter2") == key
+        from cryptography.exceptions import InvalidSignature
+
+        with pytest.raises(InvalidSignature):
+            armor.unarmor_decrypt_priv_key(s, "wrong-pass")
+
+    def test_malformed(self):
+        with pytest.raises(ValueError):
+            armor.decode_armor("not armor at all")
+
+
+class TestSr25519:
+    def test_sign_verify(self):
+        k = sr25519.gen_priv_key_from_secret(b"validator-1")
+        pk = k.pub_key()
+        msg = b"vote sign bytes"
+        sig = k.sign(msg)
+        assert len(sig) == sr25519.SIGNATURE_SIZE
+        assert pk.verify_signature(msg, sig)
+        assert not pk.verify_signature(b"other message", sig)
+
+    def test_corrupted_signature_rejected(self):
+        k = sr25519.gen_priv_key_from_secret(b"v")
+        sig = bytearray(k.sign(b"m"))
+        for pos in (0, 31, 33, 62):
+            bad = bytearray(sig)
+            bad[pos] ^= 1
+            assert not k.pub_key().verify_signature(b"m", bytes(bad))
+
+    def test_format_marker_required(self):
+        """schnorrkel 'new' format: the s high bit must be set."""
+        k = sr25519.gen_priv_key_from_secret(b"v")
+        sig = bytearray(k.sign(b"m"))
+        sig[63] &= 0x7F
+        assert not k.pub_key().verify_signature(b"m", bytes(sig))
+
+    def test_wrong_key_rejected(self):
+        k1 = sr25519.gen_priv_key_from_secret(b"a")
+        k2 = sr25519.gen_priv_key_from_secret(b"b")
+        sig = k1.sign(b"m")
+        assert not k2.pub_key().verify_signature(b"m", sig)
+
+    def test_ristretto_roundtrip_and_invalid_encodings(self):
+        k = sr25519.gen_priv_key_from_secret(b"r")
+        pk = k.pub_key().bytes()
+        pt = sr25519._decode(pk)
+        assert pt is not None
+        assert sr25519._encode(pt) == pk
+        # non-canonical (>= p) and negative encodings rejected
+        assert sr25519._decode(b"\xff" * 32) is None
+        assert sr25519._decode(b"\x01" + b"\x00" * 31) is None  # odd = negative
+        # identity encodes to all zeros and decodes
+        assert sr25519._encode(sr25519._ID) == bytes(32)
+
+    def test_address_and_type(self):
+        k = sr25519.gen_priv_key_from_secret(b"t")
+        assert len(k.pub_key().address()) == 20
+        assert k.pub_key().type() == "sr25519"
+        assert k.type() == "sr25519"
+
+    def test_amino_tag(self):
+        from cometbft_tpu.libs import amino_json
+
+        k = sr25519.gen_priv_key_from_secret(b"amino")
+        s = amino_json.marshal(k.pub_key())
+        assert "tendermint/PubKeySr25519" in s
+        back = amino_json.unmarshal(s)
+        assert back.bytes() == k.pub_key().bytes()
