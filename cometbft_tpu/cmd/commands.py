@@ -359,10 +359,25 @@ def _debug_dump(args) -> int:
     toml_path = os.path.join(args.home, "config", "config.toml")
     if os.path.exists(toml_path):
         entries["config.toml"] = read_file(toml_path)
-    # the WAL dir comes from [consensus] wal_path — custom paths included
-    wal_dir = os.path.dirname(cfg.consensus.wal_file())
+    # the WAL dir comes from [consensus] wal_path — custom paths included.
+    # The head file (no numeric suffix) is the NEWEST data and must always
+    # be included; numbered chunks sort numerically, newest last.
+    wal_path = cfg.consensus.wal_file()
+    wal_dir = os.path.dirname(wal_path)
+    head_name = os.path.basename(wal_path)
     if os.path.isdir(wal_dir):
-        for name in sorted(os.listdir(wal_dir))[-3:]:
+        def chunk_index(name: str) -> int:
+            _, _, suffix = name.rpartition(".")
+            return int(suffix) if suffix.isdigit() else -1
+
+        chunks = sorted(
+            (n for n in os.listdir(wal_dir)
+             if n.startswith(head_name) and n != head_name),
+            key=chunk_index,
+        )
+        for name in chunks[-2:] + (
+            [head_name] if os.path.exists(wal_path) else []
+        ):
             entries[f"wal/{name}"] = read_file(os.path.join(wal_dir, name))
 
     with tarfile.open(out_path, "w:gz") as tar:
@@ -392,68 +407,67 @@ def _debug_inspect(args) -> int:
     block_store = BlockStore(default_db_provider("blockstore", cfg))
     state_store = StateStore(default_db_provider("state", cfg))
 
-    import http.server
+    from cometbft_tpu.libs.net import RouteServer
 
-    class Handler(http.server.BaseHTTPRequestHandler):
-        def do_GET(self):  # noqa: N802
-            import urllib.parse
+    _JSON = "application/json"
 
-            parsed = urllib.parse.urlparse(self.path)
-            q = urllib.parse.parse_qs(parsed.query)
+    def _height_param(q: dict) -> int:
+        vals = q.get("height")
+        if not vals:
+            raise _ClientError("missing required query param 'height'")
+        try:
+            return int(vals[0])
+        except ValueError as exc:
+            raise _ClientError(f"invalid height {vals[0]!r}") from exc
+
+    class _ClientError(ValueError):
+        pass
+
+    def _route(fn):
+        def handler(q: dict):
             try:
-                if parsed.path == "/status":
-                    state = state_store.load()
-                    out = {
-                        "base": block_store.base(),
-                        "height": block_store.height(),
-                        "state_height": (
-                            state.last_block_height if state else None
-                        ),
-                        "app_hash": state.app_hash.hex().upper()
-                        if state
-                        else "",
-                    }
-                elif parsed.path == "/block":
-                    h = int(q["height"][0])
-                    blk = block_store.load_block(h)
-                    meta = block_store.load_block_meta(h)
-                    if blk is None or meta is None:
-                        raise ValueError(f"no block at height {h}")
-                    out = {
-                        "block_id": block_id_json(meta.block_id),
-                        "block": block_json(blk),
-                    }
-                elif parsed.path == "/validators":
-                    h = int(q["height"][0])
-                    vals = state_store.load_validators(h)
-                    out = {
-                        "validators": [
-                            validator_json(v) for v in vals.validators
-                        ]
-                    }
-                else:
-                    self.send_error(404)
-                    return
-                body = json.dumps(out).encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-            except Exception as exc:  # noqa: BLE001
-                body = json.dumps({"error": str(exc)}).encode()
-                self.send_response(500)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                return 200, _JSON, json.dumps(fn(q)).encode()
+            except _ClientError as exc:
+                return 400, _JSON, json.dumps({"error": str(exc)}).encode()
+            except Exception as exc:  # noqa: BLE001 — data errors → 500
+                return 500, _JSON, json.dumps({"error": str(exc)}).encode()
+        return handler
 
-        def log_message(self, *a):
-            pass
+    def r_status(_q):
+        state = state_store.load()
+        return {
+            "base": block_store.base(),
+            "height": block_store.height(),
+            "state_height": state.last_block_height if state else None,
+            "app_hash": state.app_hash.hex().upper() if state else "",
+        }
 
+    def r_block(q):
+        h = _height_param(q)
+        blk = block_store.load_block(h)
+        meta = block_store.load_block_meta(h)
+        if blk is None or meta is None:
+            raise ValueError(f"no block at height {h}")
+        return {
+            "block_id": block_id_json(meta.block_id),
+            "block": block_json(blk),
+        }
+
+    def r_validators(q):
+        vals = state_store.load_validators(_height_param(q))
+        return {"validators": [validator_json(v) for v in vals.validators]}
+
+    server = RouteServer(
+        {
+            "/status": _route(r_status),
+            "/block": _route(r_block),
+            "/validators": _route(r_validators),
+        }
+    )
     from cometbft_tpu.node.node import _parse_laddr
 
     host, port = _parse_laddr(args.laddr)
-    httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+    server.serve(host, port)
     print(
         f"Inspect server on {args.laddr} "
         f"(routes: /status, /block?height=H, /validators?height=H)",
@@ -462,14 +476,11 @@ def _debug_inspect(args) -> int:
     stop = threading.Event()
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
-    t = threading.Thread(target=httpd.serve_forever, daemon=True)
-    t.start()
     try:
         while not stop.is_set():
             time.sleep(0.3)
     finally:
-        httpd.shutdown()
-        httpd.server_close()
+        server.stop()
     return 0
 
 

@@ -13,7 +13,8 @@ import gc
 import sys
 import threading
 import traceback
-from typing import Optional
+
+from cometbft_tpu.libs.net import RouteServer
 
 
 def thread_stacks() -> str:
@@ -31,14 +32,16 @@ def thread_stacks() -> str:
 
 
 def heap_profile(top: int = 40) -> str:
-    """tracemalloc top allocations (started lazily on first request)."""
+    """tracemalloc top allocations. Tracing is opt-in via
+    /debug/heap/start — a diagnostic request must never silently leave a
+    permanent per-allocation overhead running on a live validator."""
     import tracemalloc
 
     if not tracemalloc.is_tracing():
-        tracemalloc.start()
         return (
-            "tracemalloc was not running; started now — request again "
-            "after some activity for a populated profile\n"
+            "tracemalloc is not running; GET /debug/heap/start to begin "
+            "tracing (and /debug/heap/stop to end it — tracing has "
+            "per-allocation overhead)\n"
         )
     snapshot = tracemalloc.take_snapshot()
     stats = snapshot.statistics("lineno")
@@ -59,47 +62,40 @@ def gc_stats() -> str:
     )
 
 
-class PprofServer:
-    """Tiny HTTP server for /debug/stacks, /debug/heap, /debug/gc
-    (node/node.go:896 startPprofServer analog)."""
+def _start_heap_tracing(_q) -> tuple:
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start()
+    return 200, "text/plain; charset=utf-8", b"tracemalloc started\n"
+
+
+def _stop_heap_tracing(_q) -> tuple:
+    import tracemalloc
+
+    if tracemalloc.is_tracing():
+        tracemalloc.stop()
+    return 200, "text/plain; charset=utf-8", b"tracemalloc stopped\n"
+
+
+class PprofServer(RouteServer):
+    """HTTP server for /debug/stacks, /debug/heap(+/start,/stop),
+    /debug/gc (node/node.go:896 startPprofServer analog)."""
 
     def __init__(self):
-        self._httpd = None
-        self._thread: Optional[threading.Thread] = None
+        text = "text/plain; charset=utf-8"
 
-    def serve(self, host: str, port: int) -> int:
-        import http.server
+        def t(fn):
+            return lambda _q: (200, text, fn().encode())
 
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802
-                path = self.path.split("?")[0]
-                if path in ("/debug/stacks", "/debug/pprof/goroutine"):
-                    body = thread_stacks().encode()
-                elif path in ("/debug/heap", "/debug/pprof/heap"):
-                    body = heap_profile().encode()
-                elif path == "/debug/gc":
-                    body = gc_stats().encode()
-                else:
-                    self.send_error(404)
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type", "text/plain; charset=utf-8")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):
-                pass
-
-        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="pprof-http", daemon=True
+        super().__init__(
+            {
+                "/debug/stacks": t(thread_stacks),
+                "/debug/pprof/goroutine": t(thread_stacks),
+                "/debug/heap": t(heap_profile),
+                "/debug/pprof/heap": t(heap_profile),
+                "/debug/heap/start": _start_heap_tracing,
+                "/debug/heap/stop": _stop_heap_tracing,
+                "/debug/gc": t(gc_stats),
+            }
         )
-        self._thread.start()
-        return self._httpd.server_address[1]
-
-    def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None

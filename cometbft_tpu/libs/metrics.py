@@ -20,6 +20,8 @@ import threading
 from bisect import bisect_right
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from cometbft_tpu.libs.net import RouteServer
+
 DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
@@ -265,48 +267,19 @@ class Registry:
         return "\n".join(lines) + ("\n" if lines else "")
 
 
-class MetricsServer:
-    """Tiny /metrics HTTP endpoint (node/node.go:1221 startPrometheusServer)."""
+class MetricsServer(RouteServer):
+    """/metrics HTTP endpoint (node/node.go:1221 startPrometheusServer)."""
 
     def __init__(self, registry: Registry):
-        self._registry = registry
-        self._httpd = None
-        self._thread: Optional[threading.Thread] = None
-
-    def serve(self, host: str, port: int) -> int:
-        import http.server
-
-        registry = self._registry
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802
-                if self.path.split("?")[0] != "/metrics":
-                    self.send_error(404)
-                    return
-                body = registry.expose().encode()
-                self.send_response(200)
-                self.send_header(
-                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        super().__init__(
+            {
+                "/metrics": lambda _q: (
+                    200,
+                    "text/plain; version=0.0.4; charset=utf-8",
+                    registry.expose().encode(),
                 )
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, *args):
-                pass
-
-        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="metrics-http", daemon=True
+            }
         )
-        self._thread.start()
-        return self._httpd.server_address[1]
-
-    def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
 
 
 _global_registry: Optional[Registry] = None

@@ -3,7 +3,64 @@
 from __future__ import annotations
 
 import socket
-from typing import List
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+# route → handler(query: dict) → (status, content_type, body)
+RouteHandler = Callable[[dict], Tuple[int, str, bytes]]
+
+
+class RouteServer:
+    """Minimal threaded HTTP GET server over a route table — the shared
+    plumbing under the metrics, pprof, and debug-inspect endpoints."""
+
+    def __init__(self, routes: Dict[str, RouteHandler]):
+        self._routes = routes
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    def serve(self, host: str, port: int) -> int:
+        import http.server
+        import urllib.parse
+
+        routes = self._routes
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                parsed = urllib.parse.urlparse(self.path)
+                handler = routes.get(parsed.path)
+                if handler is None:
+                    self.send_error(404)
+                    return
+                query = urllib.parse.parse_qs(parsed.query)
+                try:
+                    status, ctype, body = handler(query)
+                except Exception as exc:  # noqa: BLE001
+                    status, ctype = 500, "text/plain; charset=utf-8"
+                    body = f"internal error: {exc}".encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="route-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
 
 
 def free_ports(n: int) -> List[int]:
