@@ -1,0 +1,8 @@
+#!/bin/sh
+# Reference: DOCKER/docker-entrypoint.sh — init the home on first boot,
+# then exec the node so signals reach it directly.
+set -e
+if [ ! -f "/cometbft/config/genesis.json" ]; then
+    python -m cometbft_tpu --home /cometbft init --chain-id "${CHAIN_ID:-dockerchain}"
+fi
+exec python -m cometbft_tpu --home /cometbft "$@"

@@ -366,13 +366,16 @@ def _debug_dump(args) -> int:
     wal_dir = os.path.dirname(wal_path)
     head_name = os.path.basename(wal_path)
     if os.path.isdir(wal_dir):
+        import re
+
+        # autofile.Group chunks are exactly "<head>.NNN" (>=3 digits)
+        chunk_re = re.compile(re.escape(head_name) + r"\.(\d{3,})$")
+
         def chunk_index(name: str) -> int:
-            _, _, suffix = name.rpartition(".")
-            return int(suffix) if suffix.isdigit() else -1
+            return int(chunk_re.match(name).group(1))
 
         chunks = sorted(
-            (n for n in os.listdir(wal_dir)
-             if n.startswith(head_name) and n != head_name),
+            (n for n in os.listdir(wal_dir) if chunk_re.match(n)),
             key=chunk_index,
         )
         for name in chunks[-2:] + (
@@ -482,6 +485,102 @@ def _debug_inspect(args) -> int:
     finally:
         server.stop()
     return 0
+
+
+def cmd_wal(args) -> int:
+    """scripts/wal2json + json2wal — inspect/repair consensus WAL files.
+
+    `wal export <wal-file>` prints one JSON object per record (timestamp,
+    message kind, decoded height/round where present, and the lossless
+    hex body); `wal import <json-file> <wal-file>` re-frames those
+    records with fresh CRCs."""
+    import struct
+    import zlib
+
+    from cometbft_tpu.consensus.messages import decode_wal_message
+    from cometbft_tpu.consensus.wal import MAX_MSG_SIZE_BYTES, WALDecodeError
+    from cometbft_tpu.libs import protoio
+    from cometbft_tpu.proto.gogo import Timestamp
+
+    if args.wal_command == "export":
+        out = sys.stdout
+        with open(args.path, "rb") as f:
+            while True:
+                head = f.read(8)
+                if not head:
+                    break
+                if len(head) < 8:
+                    print("warning: truncated record header", file=sys.stderr)
+                    break
+                crc, length = struct.unpack(">II", head)
+                if length > MAX_MSG_SIZE_BYTES:
+                    print(
+                        f"warning: record length {length} exceeds max, "
+                        "stopping", file=sys.stderr,
+                    )
+                    break
+                body = f.read(length)
+                if len(body) < length:
+                    print("warning: truncated record body", file=sys.stderr)
+                    break
+                if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+                    print("warning: CRC mismatch, stopping", file=sys.stderr)
+                    break
+                reader = protoio.WireReader(body)
+                ts, msg_hex = None, ""
+                while not reader.at_end():
+                    fld, wt = reader.read_tag()
+                    if fld == 1:
+                        ts = Timestamp.decode(reader.read_bytes())
+                    elif fld == 2:
+                        msg_hex = reader.read_bytes().hex()
+                    else:
+                        reader.skip(wt)
+                rec = {
+                    "time": ts.to_rfc3339() if ts else None,
+                    "msg": msg_hex,
+                }
+                try:
+                    msg = decode_wal_message(bytes.fromhex(msg_hex))
+                    rec["type"] = type(msg).__name__
+                    for attr in ("height", "round"):
+                        if hasattr(msg, attr):
+                            rec[attr] = getattr(msg, attr)
+                except (WALDecodeError, ValueError) as exc:
+                    rec["type"] = f"undecodable: {exc}"
+                out.write(json.dumps(rec) + "\n")
+        return 0
+
+    if args.wal_command == "import":
+        with open(args.path) as f, open(args.out, "wb") as w:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                ts = (
+                    Timestamp.from_rfc3339(rec["time"])
+                    if rec.get("time")
+                    else Timestamp.now()
+                )
+                msg_bytes = bytes.fromhex(rec["msg"])
+                # validate before writing — a bad record must not produce
+                # a WAL that crashes replay
+                decode_wal_message(msg_bytes)
+                body = protoio.field_message(1, ts.encode())
+                body += protoio.field_message(2, msg_bytes)
+                if len(body) > MAX_MSG_SIZE_BYTES:
+                    raise ValueError(
+                        f"record of {len(body)} bytes exceeds the WAL max "
+                        f"({MAX_MSG_SIZE_BYTES}); replay would reject it"
+                    )
+                crc = zlib.crc32(body) & 0xFFFFFFFF
+                w.write(struct.pack(">II", crc, len(body)) + body)
+        print(f"Wrote {args.out}")
+        return 0
+
+    print(f"unknown wal command {args.wal_command!r}", file=sys.stderr)
+    return 1
 
 
 def cmd_gen_node_key(args) -> int:
@@ -684,6 +783,13 @@ def main(argv: Optional[list] = None) -> int:
         "--laddr", default="tcp://127.0.0.1:26669", help="inspect listen addr"
     )
     p.set_defaults(fn=cmd_debug)
+
+    p = sub.add_parser("wal", help="export/import consensus WAL files as JSON")
+    p.add_argument("wal_command", choices=["export", "import"])
+    p.add_argument("path", help="WAL file (export) or JSON file (import)")
+    p.add_argument("out", nargs="?", default="wal.out",
+                   help="output WAL file (import)")
+    p.set_defaults(fn=cmd_wal)
 
     p = sub.add_parser("gen-node-key", help="generate or show the node key")
     p.set_defaults(fn=cmd_gen_node_key)
