@@ -194,20 +194,35 @@ def cmd_testnet(args) -> int:
         ],
     )
 
-    # stride 2 per node: with the default bases (26656/26657) node i gets
-    # p2p 26656+2i and rpc 26657+2i — no cross-node collisions
     p2p_base, rpc_base = args.p2p_port, args.rpc_port
-    peers = ",".join(
-        f"{node_keys[i].id()}@127.0.0.1:{p2p_base + 2 * i}" for i in range(n)
-    )
+    if args.hostname_template:
+        # container/VM mode (reference --hostname-prefix): every node binds
+        # all interfaces on the SAME ports and peers dial by hostname —
+        # the shape docker-compose/k8s networks need
+        peers = [
+            f"{node_keys[i].id()}@{args.hostname_template.format(i)}:{p2p_base}"
+            for i in range(n)
+        ]
+    else:
+        # single-host mode: stride 2 per node on loopback — with the
+        # default bases (26656/26657) node i gets p2p 26656+2i and rpc
+        # 26657+2i, no cross-node collisions
+        peers = [
+            f"{node_keys[i].id()}@127.0.0.1:{p2p_base + 2 * i}"
+            for i in range(n)
+        ]
     for i, home in enumerate(homes):
         cfg = default_config().set_root(home)
         cfg.base.proxy_app = args.proxy_app
         cfg.base.moniker = f"node{i}"
-        cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_base + 2 * i}"
-        cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_base + 2 * i}"
+        if args.hostname_template:
+            cfg.p2p.laddr = f"tcp://0.0.0.0:{p2p_base}"
+            cfg.rpc.laddr = f"tcp://0.0.0.0:{rpc_base}"
+        else:
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_base + 2 * i}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_base + 2 * i}"
         cfg.p2p.persistent_peers = ",".join(
-            p for j, p in enumerate(peers.split(",")) if j != i
+            p for j, p in enumerate(peers) if j != i
         )
         cfg.p2p.addr_book_strict = False
         with open(cfg.base.genesis_path(), "w") as f:
@@ -363,25 +378,14 @@ def _debug_dump(args) -> int:
     # The head file (no numeric suffix) is the NEWEST data and must always
     # be included; numbered chunks sort numerically, newest last.
     wal_path = cfg.consensus.wal_file()
-    wal_dir = os.path.dirname(wal_path)
-    head_name = os.path.basename(wal_path)
-    if os.path.isdir(wal_dir):
-        import re
+    if os.path.isdir(os.path.dirname(wal_path)):
+        from cometbft_tpu.libs.autofile import list_chunk_files
 
-        # autofile.Group chunks are exactly "<head>.NNN" (>=3 digits)
-        chunk_re = re.compile(re.escape(head_name) + r"\.(\d{3,})$")
-
-        def chunk_index(name: str) -> int:
-            return int(chunk_re.match(name).group(1))
-
-        chunks = sorted(
-            (n for n in os.listdir(wal_dir) if chunk_re.match(n)),
-            key=chunk_index,
-        )
-        for name in chunks[-2:] + (
-            [head_name] if os.path.exists(wal_path) else []
-        ):
-            entries[f"wal/{name}"] = read_file(os.path.join(wal_dir, name))
+        paths = [p for _, p in list_chunk_files(wal_path)][-2:]
+        if os.path.exists(wal_path):
+            paths.append(wal_path)  # the head: newest data, always included
+        for path in paths:
+            entries[f"wal/{os.path.basename(path)}"] = read_file(path)
 
     with tarfile.open(out_path, "w:gz") as tar:
         for name, data in entries.items():
@@ -503,52 +507,26 @@ def cmd_wal(args) -> int:
     from cometbft_tpu.proto.gogo import Timestamp
 
     if args.wal_command == "export":
+        from cometbft_tpu.consensus.wal import read_records_lenient
+
         out = sys.stdout
-        with open(args.path, "rb") as f:
-            while True:
-                head = f.read(8)
-                if not head:
-                    break
-                if len(head) < 8:
-                    print("warning: truncated record header", file=sys.stderr)
-                    break
-                crc, length = struct.unpack(">II", head)
-                if length > MAX_MSG_SIZE_BYTES:
-                    print(
-                        f"warning: record length {length} exceeds max, "
-                        "stopping", file=sys.stderr,
-                    )
-                    break
-                body = f.read(length)
-                if len(body) < length:
-                    print("warning: truncated record body", file=sys.stderr)
-                    break
-                if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
-                    print("warning: CRC mismatch, stopping", file=sys.stderr)
-                    break
-                reader = protoio.WireReader(body)
-                ts, msg_hex = None, ""
-                while not reader.at_end():
-                    fld, wt = reader.read_tag()
-                    if fld == 1:
-                        ts = Timestamp.decode(reader.read_bytes())
-                    elif fld == 2:
-                        msg_hex = reader.read_bytes().hex()
-                    else:
-                        reader.skip(wt)
-                rec = {
-                    "time": ts.to_rfc3339() if ts else None,
-                    "msg": msg_hex,
-                }
-                try:
-                    msg = decode_wal_message(bytes.fromhex(msg_hex))
-                    rec["type"] = type(msg).__name__
-                    for attr in ("height", "round"):
-                        if hasattr(msg, attr):
-                            rec[attr] = getattr(msg, attr)
-                except (WALDecodeError, ValueError) as exc:
-                    rec["type"] = f"undecodable: {exc}"
-                out.write(json.dumps(rec) + "\n")
+        for ts, raw, warning in read_records_lenient(args.path):
+            if warning is not None:
+                print(f"warning: {warning}, stopping", file=sys.stderr)
+                break
+            rec = {
+                "time": ts.to_rfc3339() if ts else None,
+                "msg": raw.hex(),
+            }
+            try:
+                msg = decode_wal_message(raw)
+                rec["type"] = type(msg).__name__
+                for attr in ("height", "round"):
+                    if hasattr(msg, attr):
+                        rec[attr] = getattr(msg, attr)
+            except (WALDecodeError, ValueError) as exc:
+                rec["type"] = f"undecodable: {exc}"
+            out.write(json.dumps(rec) + "\n")
         return 0
 
     if args.wal_command == "import":
@@ -745,6 +723,11 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--proxy_app", default="kvstore")
     p.add_argument("--p2p-port", type=int, default=26656)
     p.add_argument("--rpc-port", type=int, default=26657)
+    p.add_argument(
+        "--hostname-template", default="",
+        help="peer hostname pattern like 'node{}' — containers/VMs mode: "
+        "all nodes bind 0.0.0.0 on the same ports",
+    )
     p.set_defaults(fn=cmd_testnet)
 
     p = sub.add_parser(

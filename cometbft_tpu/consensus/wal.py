@@ -75,6 +75,45 @@ def _decode_record(r) -> Optional[object]:
     return msg
 
 
+def read_records_lenient(path: str):
+    """Yield (timestamp, raw_wal_message_bytes, warning) from a WAL file,
+    degrading at the first corruption instead of raising — the shared
+    reader under `wal export` so tool and replay can never disagree on
+    framing. `warning` is set (and iteration ends) on a bad record."""
+    import io
+
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(8)
+            if not head:
+                return
+            if len(head) < 8:
+                yield None, None, "truncated record header"
+                return
+            crc, length = struct.unpack(">II", head)
+            if length > MAX_MSG_SIZE_BYTES:
+                yield None, None, f"record length {length} exceeds max"
+                return
+            body = f.read(length)
+            if len(body) < length:
+                yield None, None, "truncated record body"
+                return
+            if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+                yield None, None, "CRC mismatch"
+                return
+            reader = protoio.WireReader(body)
+            ts, raw = None, b""
+            while not reader.at_end():
+                fld, wt = reader.read_tag()
+                if fld == 1:
+                    ts = Timestamp.decode(reader.read_bytes())
+                elif fld == 2:
+                    raw = reader.read_bytes()
+                else:
+                    reader.skip(wt)
+            yield ts, raw, None
+
+
 class WAL(BaseService):
     """BaseWAL: group-backed, periodically flushed."""
 

@@ -18,6 +18,25 @@ DEFAULT_HEAD_SIZE_LIMIT = 10 * 1024 * 1024  # 10MB (reference: group.go)
 DEFAULT_GROUP_SIZE_LIMIT = 1024 * 1024 * 1024  # 1GB
 
 
+def list_chunk_files(head_path: str):
+    """Sorted (index, path) of a group's rotated chunks — THE definition
+    of the "<head>.NNN" naming contract, shared with tools (debug dump)."""
+    d = os.path.dirname(os.path.abspath(head_path)) or "."
+    base = os.path.basename(head_path)
+    pat = re.compile(re.escape(base) + r"\.(\d{3,})$")
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    for fn in names:
+        m = pat.match(fn)
+        if m:
+            out.append((int(m.group(1)), os.path.join(d, fn)))
+    out.sort()
+    return out
+
+
 class Group:
     def __init__(
         self,
@@ -104,16 +123,7 @@ class Group:
 
     def _chunk_files(self) -> List[Tuple[int, str]]:
         """Sorted (index, path) for rotated chunks."""
-        d = os.path.dirname(os.path.abspath(self.head_path)) or "."
-        base = os.path.basename(self.head_path)
-        pat = re.compile(re.escape(base) + r"\.(\d{3,})$")
-        out = []
-        for fn in os.listdir(d):
-            m = pat.match(fn)
-            if m:
-                out.append((int(m.group(1)), os.path.join(d, fn)))
-        out.sort()
-        return out
+        return list_chunk_files(self.head_path)
 
     def min_max_index(self) -> Tuple[int, int]:
         chunks = self._chunk_files()

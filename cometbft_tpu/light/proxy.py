@@ -1,0 +1,187 @@
+"""Light-client verifying RPC proxy.
+
+Reference: light/proxy + light/rpc — a JSON-RPC server that fronts an
+untrusted full node: block/commit/validators responses are checked
+against light-client-verified headers before they reach the caller, so a
+lying primary cannot feed a wallet forged data. Routes without
+verifiable content (status, broadcast_tx_*) pass through annotated.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.libs.net import RouteServer
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.rpc.client import (
+    HTTPClient,
+    parse_commit,
+    parse_header,
+    parse_validators,
+)
+
+
+def _now() -> Timestamp:
+    import time
+
+    ns = time.time_ns()
+    return Timestamp(ns // 1_000_000_000, ns % 1_000_000_000)
+
+
+class ErrProxyVerification(Exception):
+    """The primary's response contradicts the verified light block."""
+
+
+class LightProxy:
+    """Wraps a light client + primary RPC into a verifying JSON-RPC server
+    (light/rpc/client.go semantics for the verified routes)."""
+
+    def __init__(
+        self,
+        light_client,  # light.client.Client
+        primary: HTTPClient,
+        logger: Optional[Logger] = None,
+    ):
+        self._lc = light_client
+        self._primary = primary
+        self.logger = logger or new_nop_logger()
+        self._server: Optional[RouteServer] = None
+
+    # -- verified routes -------------------------------------------------------
+
+    def block(self, height: int) -> dict:
+        """Primary's block, cross-checked: its header must hash to the
+        light-client-verified block hash (light/rpc/client.go Block)."""
+        res = self._primary.block(height)
+        verified = self._lc.verify_light_block_at_height(height, _now())
+        got_header = parse_header(res["block"]["header"])
+        want_hash = verified.signed_header.header.hash()
+        if got_header.hash() != want_hash:
+            raise ErrProxyVerification(
+                f"primary's block at height {height} does not match the "
+                f"verified header"
+            )
+        if bytes.fromhex(res["block_id"]["hash"]) != want_hash:
+            raise ErrProxyVerification("primary's block_id hash mismatch")
+        return res
+
+    def commit(self, height: int) -> dict:
+        res = self._primary.commit(height)
+        verified = self._lc.verify_light_block_at_height(height, _now())
+        got = parse_commit(res["signed_header"]["commit"])
+        want = verified.signed_header.commit
+        if got.block_id.hash != want.block_id.hash:
+            raise ErrProxyVerification(
+                f"primary's commit at height {height} is for a different "
+                f"block"
+            )
+        got_header = parse_header(res["signed_header"]["header"])
+        if got_header.hash() != verified.signed_header.header.hash():
+            raise ErrProxyVerification("primary's header mismatch in commit")
+        return res
+
+    def validators(self, height: int) -> dict:
+        res = self._primary.validators(height, per_page=100)
+        verified = self._lc.verify_light_block_at_height(height, _now())
+        got = parse_validators(res["validators"])
+        if got.hash() != verified.validator_set.hash():
+            raise ErrProxyVerification(
+                f"primary's validator set at height {height} does not hash "
+                f"to the verified validators_hash"
+            )
+        return res
+
+    # -- passthrough -----------------------------------------------------------
+
+    def status(self) -> dict:
+        return self._primary.status()
+
+    def broadcast_tx_sync(self, tx: bytes) -> dict:
+        return self._primary.broadcast_tx_sync(tx)
+
+    def broadcast_tx_commit(self, tx: bytes) -> dict:
+        return self._primary.broadcast_tx_commit(tx)
+
+    # -- JSON-RPC surface ------------------------------------------------------
+
+    def _handle(self, payload: dict) -> dict:
+        import base64
+
+        method = payload.get("method", "")
+        params = payload.get("params") or {}
+        rid = payload.get("id", 0)
+        try:
+            if method == "block":
+                result = self.block(int(params["height"]))
+            elif method == "commit":
+                result = self.commit(int(params["height"]))
+            elif method == "validators":
+                result = self.validators(int(params["height"]))
+            elif method == "status":
+                result = self.status()
+            elif method in ("broadcast_tx_sync", "broadcast_tx_commit"):
+                tx = base64.b64decode(params["tx"])
+                result = getattr(self, method)(tx)
+            else:
+                return {
+                    "jsonrpc": "2.0",
+                    "id": rid,
+                    "error": {
+                        "code": -32601,
+                        "message": f"method {method} not available on the "
+                        f"verifying proxy",
+                    },
+                }
+            return {"jsonrpc": "2.0", "id": rid, "result": result}
+        except ErrProxyVerification as exc:
+            return {
+                "jsonrpc": "2.0",
+                "id": rid,
+                "error": {"code": -32100, "message": f"VERIFICATION FAILED: {exc}"},
+            }
+        except Exception as exc:  # noqa: BLE001
+            return {
+                "jsonrpc": "2.0",
+                "id": rid,
+                "error": {"code": -32603, "message": str(exc)},
+            }
+
+    def serve(self, host: str, port: int) -> int:
+        """Serve JSON-RPC over HTTP POST (plus GET with query params)."""
+        import http.server
+        import threading
+
+        proxy = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    payload = json.loads(self.rfile.read(length))
+                except ValueError:
+                    self.send_error(400)
+                    return
+                body = json.dumps(proxy._handle(payload)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="light-proxy", daemon=True
+        )
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if getattr(self, "_httpd", None) is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

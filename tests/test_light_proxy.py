@@ -1,0 +1,121 @@
+"""Light-client verifying RPC proxy against a live node — honest
+responses pass through verified; tampered responses are refused.
+
+Model: reference light/proxy + light/rpc/client_test.go.
+"""
+
+import json
+import tempfile
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.cmd.commands import _load_config, main as cli_main
+from cometbft_tpu.libs.db import MemDB
+from cometbft_tpu.libs.net import free_ports
+from cometbft_tpu.light.client import Client as LightClient, TrustOptions
+from cometbft_tpu.light.provider import HTTPProvider
+from cometbft_tpu.light.proxy import ErrProxyVerification, LightProxy
+from cometbft_tpu.light.store import DBStore
+from cometbft_tpu.node import default_new_node
+from cometbft_tpu.rpc.client import HTTPClient
+
+
+def _rpc(port, method, params):
+    body = json.dumps(
+        {"jsonrpc": "2.0", "id": 1, "method": method, "params": params}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    return json.loads(urllib.request.urlopen(req, timeout=10).read())
+
+
+@pytest.mark.slow
+class TestLightProxy:
+    def test_verified_routes_and_tamper_rejection(self):
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "proxy-chain"])
+            rpc_port, p2p_port, proxy_port = free_ports(3)
+            cfg = _load_config(d)
+            cfg.base.proxy_app = "kvstore"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            node = default_new_node(cfg)
+            node.start()
+            proxy = None
+            try:
+                client = HTTPClient(f"127.0.0.1:{rpc_port}")
+                deadline = time.monotonic() + 60
+                h = 0
+                while time.monotonic() < deadline and h < 4:
+                    try:
+                        h = int(
+                            client.status()["sync_info"]["latest_block_height"]
+                        )
+                    except Exception:
+                        pass
+                    time.sleep(0.3)
+                assert h >= 4
+
+                provider = HTTPProvider(
+                    "proxy-chain", f"127.0.0.1:{rpc_port}"
+                )
+                lb1 = provider.light_block(1)
+                lc = LightClient(
+                    "proxy-chain",
+                    TrustOptions(
+                        period_ns=10**18,
+                        height=1,
+                        hash=lb1.signed_header.header.hash(),
+                    ),
+                    provider,
+                    [HTTPProvider("proxy-chain", f"127.0.0.1:{rpc_port}")],
+                    DBStore(MemDB()),
+                )
+                proxy = LightProxy(lc, client)
+                proxy.serve("127.0.0.1", proxy_port)
+
+                # verified block/commit/validators via the proxy's RPC
+                blk = _rpc(proxy_port, "block", {"height": 2})["result"]
+                assert int(blk["block"]["header"]["height"]) == 2
+                cm = _rpc(proxy_port, "commit", {"height": 2})["result"]
+                assert int(cm["signed_header"]["commit"]["height"]) == 2
+                vals = _rpc(proxy_port, "validators", {"height": 2})["result"]
+                assert len(vals["validators"]) == 1
+                st = _rpc(proxy_port, "status", {})["result"]
+                assert int(st["sync_info"]["latest_block_height"]) >= 4
+                # unknown method → clean JSON-RPC error
+                err = _rpc(proxy_port, "dump_consensus_state", {})
+                assert err["error"]["code"] == -32601
+
+                # a LYING primary: tamper the block response → refused
+                real_block = client.block
+
+                def lying_block(height=None):
+                    res = real_block(height)
+                    res["block"]["header"]["app_hash"] = "CC" * 32
+                    return res
+
+                client.block = lying_block
+                resp = _rpc(proxy_port, "block", {"height": 3})
+                assert "VERIFICATION FAILED" in resp["error"]["message"]
+                client.block = real_block
+
+                # a lying validators response → refused
+                real_vals = client.validators
+
+                def lying_vals(height=None, page=1, per_page=100):
+                    res = real_vals(height, page=page, per_page=per_page)
+                    res["validators"][0]["voting_power"] = "9999"
+                    return res
+
+                client.validators = lying_vals
+                with pytest.raises(ErrProxyVerification):
+                    proxy.validators(3)
+            finally:
+                if proxy is not None:
+                    proxy.stop()
+                node.stop()

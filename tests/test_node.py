@@ -82,6 +82,31 @@ class TestCLI:
             assert len(set(genesis)) == 1
             assert len(json.loads(genesis[0])["validators"]) == 3
 
+    def test_testnet_hostname_template_for_containers(self):
+        """--hostname-template writes 0.0.0.0 binds + hostname peers (the
+        docker-compose/k8s network shape)."""
+        import tempfile
+
+        from cometbft_tpu.cmd.commands import _load_config
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main([
+                "testnet", "--v", "3", "--output-dir", d,
+                "--chain-id", "compose-chain",
+                "--hostname-template", "node{}",
+            ])
+            for i in range(3):
+                cfg = _load_config(os.path.join(d, f"node{i}"))
+                assert cfg.p2p.laddr == "tcp://0.0.0.0:26656"
+                assert cfg.rpc.laddr == "tcp://0.0.0.0:26657"
+                peers = cfg.p2p.persistent_peers.split(",")
+                assert len(peers) == 2
+                for p_ in peers:
+                    host_port = p_.split("@")[1]
+                    assert host_port.endswith(":26656")
+                    assert host_port.startswith("node")
+                    assert f"node{i}:" not in p_  # never dials itself
+
     def test_show_node_id_and_validator(self, capsys):
         with tempfile.TemporaryDirectory() as d:
             cli_main(["--home", d, "init"])
