@@ -491,6 +491,108 @@ def _debug_inspect(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """commands/light.go — run a light client daemon: a verifying RPC
+    proxy over an untrusted primary, trust-rooted at --trust-height/
+    --trust-hash."""
+    from cometbft_tpu.libs.db import MemDB, SQLiteDB
+    from cometbft_tpu.light.client import Client as LightClient, TrustOptions
+    from cometbft_tpu.light.provider import HTTPProvider
+    from cometbft_tpu.light.proxy import LightProxy
+    from cometbft_tpu.light.store import DBStore
+    from cometbft_tpu.node.node import _parse_laddr
+    from cometbft_tpu.rpc.client import HTTPClient
+
+    chain_id = args.chain_id
+    if not chain_id:
+        print("--chain-id is required", file=sys.stderr)
+        return 1
+    try:
+        trust_hash = bytes.fromhex(args.trust_hash)
+    except ValueError:
+        trust_hash = b""
+    if len(trust_hash) != 32:
+        print(
+            "--trust-hash must be the 64-hex-char hash of the trusted "
+            "header", file=sys.stderr,
+        )
+        return 1
+    witnesses = [w.strip() for w in args.witnesses.split(",") if w.strip()]
+    providers = [HTTPProvider(chain_id, args.primary)] + [
+        HTTPProvider(chain_id, w) for w in witnesses
+    ]
+    if len(providers) < 2:
+        # the detector needs at least one witness; fall back to the
+        # primary doubling as its own witness only with --insecure
+        if not args.insecure_no_witnesses:
+            print(
+                "at least one --witnesses address is required "
+                "(or pass --insecure-no-witnesses)",
+                file=sys.stderr,
+            )
+            return 1
+        providers.append(HTTPProvider(chain_id, args.primary))
+
+    store_db = (
+        SQLiteDB(os.path.join(args.home, "data", "light.db"))
+        if os.path.isdir(os.path.join(args.home, "data"))
+        else MemDB()
+    )
+    lc = LightClient(
+        chain_id,
+        TrustOptions(
+            period_ns=args.trust_period_hours * 3600 * 1_000_000_000,
+            height=args.trust_height,
+            hash=trust_hash,
+        ),
+        providers[0],
+        providers[1:],
+        DBStore(store_db),
+    )
+    proxy = LightProxy(lc, HTTPClient(args.primary))
+    host, port = _parse_laddr(args.laddr)
+    proxy.serve(host, port)
+    print(
+        f"Light client proxy for {chain_id} on {args.laddr} "
+        f"(primary {args.primary})",
+        flush=True,
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        while not stop.is_set():
+            time.sleep(0.3)
+    finally:
+        proxy.stop()
+    return 0
+
+
+def cmd_compact(args) -> int:
+    """commands/compact.go — compact the node's databases in place (run
+    only on a STOPPED node)."""
+    from cometbft_tpu.node.node import default_db_provider
+
+    cfg = _load_config(args.home)
+    if cfg.base.db_backend == "memdb":
+        print("memdb backend has nothing to compact")
+        return 0
+    for name in ("blockstore", "state", "evidence", "tx_index",
+                 "block_index", "app"):
+        path = os.path.join(
+            cfg.root_dir, cfg.base.db_dir, f"{name}.db"
+        )
+        if not os.path.exists(path):
+            continue
+        before = os.path.getsize(path)
+        db = default_db_provider(name, cfg)
+        db.compact()
+        db.close()
+        after = os.path.getsize(path)
+        print(f"compacted {name}.db: {before} -> {after} bytes")
+    return 0
+
+
 def cmd_wal(args) -> int:
     """scripts/wal2json + json2wal — inspect/repair consensus WAL files.
 
@@ -766,6 +868,25 @@ def main(argv: Optional[list] = None) -> int:
         "--laddr", default="tcp://127.0.0.1:26669", help="inspect listen addr"
     )
     p.set_defaults(fn=cmd_debug)
+
+    p = sub.add_parser(
+        "light", help="light client daemon: verifying RPC proxy"
+    )
+    p.add_argument("--chain-id", default="")
+    p.add_argument("--primary", default="127.0.0.1:26657")
+    p.add_argument("--witnesses", default="",
+                   help="comma-separated witness RPC addresses")
+    p.add_argument("--trust-height", type=int, default=1)
+    p.add_argument("--trust-hash", default="")
+    p.add_argument("--trust-period-hours", type=int, default=168)
+    p.add_argument("--laddr", default="tcp://127.0.0.1:26648")
+    p.add_argument("--insecure-no-witnesses", action="store_true")
+    p.set_defaults(fn=cmd_light)
+
+    p = sub.add_parser(
+        "compact", help="compact the databases of a stopped node"
+    )
+    p.set_defaults(fn=cmd_compact)
 
     p = sub.add_parser("wal", help="export/import consensus WAL files as JSON")
     p.add_argument("wal_command", choices=["export", "import"])

@@ -80,8 +80,6 @@ def read_records_lenient(path: str):
     degrading at the first corruption instead of raising — the shared
     reader under `wal export` so tool and replay can never disagree on
     framing. `warning` is set (and iteration ends) on a bad record."""
-    import io
-
     with open(path, "rb") as f:
         while True:
             head = f.read(8)

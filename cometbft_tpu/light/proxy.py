@@ -13,7 +13,6 @@ import json
 from typing import Optional
 
 from cometbft_tpu.libs.log import Logger, new_nop_logger
-from cometbft_tpu.libs.net import RouteServer
 from cometbft_tpu.proto.gogo import Timestamp
 from cometbft_tpu.rpc.client import (
     HTTPClient,
@@ -47,13 +46,20 @@ class LightProxy:
         self._lc = light_client
         self._primary = primary
         self.logger = logger or new_nop_logger()
-        self._server: Optional[RouteServer] = None
 
     # -- verified routes -------------------------------------------------------
 
     def block(self, height: int) -> dict:
-        """Primary's block, cross-checked: its header must hash to the
-        light-client-verified block hash (light/rpc/client.go Block)."""
+        """Primary's block, cross-checked against the verified header:
+        the header must hash to the verified block hash AND the body must
+        hash to the header's commitments — txs to data_hash, last_commit
+        to last_commit_hash — so a forged body under a genuine header is
+        also refused (light/rpc/client.go Block + ValidateBasic)."""
+        import base64
+
+        from cometbft_tpu.crypto import merkle
+        from cometbft_tpu.types.tx import Txs
+
         res = self._primary.block(height)
         verified = self._lc.verify_light_block_at_height(height, _now())
         got_header = parse_header(res["block"]["header"])
@@ -65,6 +71,33 @@ class LightProxy:
             )
         if bytes.fromhex(res["block_id"]["hash"]) != want_hash:
             raise ErrProxyVerification("primary's block_id hash mismatch")
+        # body commitments (the verified header pins these hashes)
+        txs = Txs(
+            base64.b64decode(t) for t in res["block"]["data"].get("txs") or []
+        )
+        if txs.hash() != got_header.data_hash:
+            raise ErrProxyVerification(
+                "primary's transactions do not hash to the header's "
+                "data_hash"
+            )
+        last_commit = res["block"].get("last_commit")
+        if last_commit is not None and height > 1:
+            got_commit = parse_commit(last_commit)
+            if got_commit.hash() != got_header.last_commit_hash:
+                raise ErrProxyVerification(
+                    "primary's last_commit does not hash to the header's "
+                    "last_commit_hash"
+                )
+        ev_list = [
+            base64.b64decode(e)
+            for e in (res["block"].get("evidence") or {}).get("evidence")
+            or []
+        ]
+        if merkle.hash_from_byte_slices(ev_list) != got_header.evidence_hash:
+            raise ErrProxyVerification(
+                "primary's evidence does not hash to the header's "
+                "evidence_hash"
+            )
         return res
 
     def commit(self, height: int) -> dict:
@@ -83,15 +116,31 @@ class LightProxy:
         return res
 
     def validators(self, height: int) -> dict:
-        res = self._primary.validators(height, per_page=100)
         verified = self._lc.verify_light_block_at_height(height, _now())
-        got = parse_validators(res["validators"])
+        items = []
+        res = None
+        for page in range(1, 101):  # provider-style page cap
+            res = self._primary.validators(height, page=page, per_page=100)
+            got_page = res["validators"]
+            if not got_page:
+                break
+            items.extend(got_page)
+            if len(items) >= int(res["total"]):
+                break
+        else:
+            raise ErrProxyVerification("validator set exceeds 100 pages")
+        got = parse_validators(items)
         if got.hash() != verified.validator_set.hash():
             raise ErrProxyVerification(
                 f"primary's validator set at height {height} does not hash "
                 f"to the verified validators_hash"
             )
-        return res
+        return {
+            "block_height": str(height),
+            "validators": items,
+            "count": str(len(items)),
+            "total": str(len(items)),
+        }
 
     # -- passthrough -----------------------------------------------------------
 
@@ -149,7 +198,7 @@ class LightProxy:
             }
 
     def serve(self, host: str, port: int) -> int:
-        """Serve JSON-RPC over HTTP POST (plus GET with query params)."""
+        """Serve JSON-RPC over HTTP POST."""
         import http.server
         import threading
 

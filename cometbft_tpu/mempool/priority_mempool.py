@@ -1,0 +1,180 @@
+"""Priority mempool (v1) — reap by app-assigned priority, evict to admit.
+
+Reference: mempool/v1/mempool.go — CheckTx responses carry `priority`
+(+ `sender`); the proposer reaps highest-priority-first (insertion order
+breaks ties, :reapMaxBytesMaxGas), and a full mempool admits a new tx by
+evicting strictly-lower-priority txs when enough bytes can be freed
+(:canAddTx/evict). Gossip keeps the insertion-ordered clist so the v0
+reactor works unchanged; the priority index is only consulted for
+reap and eviction — the same split as the reference's tx store vs
+priority index.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.mempool.clist_mempool import (
+    CListMempool,
+    MempoolTx,
+    TxInfo,
+)
+
+
+class PriorityTx(MempoolTx):
+    priority: int = 0
+    seq: int = 0  # insertion order; ties reap FIFO
+
+
+class PriorityMempool(CListMempool):
+    """Drop-in replacement selected by [mempool] version = "v1"."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._seq = 0
+
+    # -- admission ------------------------------------------------------------
+
+    def check_tx(self, tx: bytes, callback=None, tx_info=None) -> None:
+        """Unlike v0, a full mempool does NOT reject at the door — the
+        priority is only known after the app's CheckTx, so fullness is
+        resolved post-CheckTx via eviction (v1 mempool.go CheckTx)."""
+        from cometbft_tpu.mempool import (
+            ErrPreCheck,
+            ErrTxInCache,
+            ErrTxTooLarge,
+        )
+        from cometbft_tpu.mempool import tx_key as _tx_key
+
+        tx_info = tx_info or TxInfo()
+        with self._update_mtx:
+            if len(tx) > self.config.max_tx_bytes:
+                raise ErrTxTooLarge(self.config.max_tx_bytes, len(tx))
+            if self._pre_check is not None:
+                reason = self._pre_check(tx)
+                if reason is not None:
+                    raise ErrPreCheck(reason)
+            if not self._cache.push(tx):
+                self.metrics.already_received_txs.add(1)
+                elem = self._txs_map.get(_tx_key(tx))
+                if elem is not None and tx_info.sender_id:
+                    elem.value.senders.add(tx_info.sender_id)
+                raise ErrTxInCache()
+            if self._proxy_app.error() is not None:
+                self._cache.remove(tx)
+                raise RuntimeError(str(self._proxy_app.error()))
+            rr = self._proxy_app.check_tx_async(
+                abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW)
+            )
+            rr.set_callback(
+                lambda res: self._res_cb_first_time(tx, tx_info, res, callback)
+            )
+
+    def _res_cb_first_time(self, tx: bytes, tx_info: TxInfo, res, user_cb) -> None:
+        if res.kind != "check_tx":
+            if user_cb is not None:
+                user_cb(res)
+            return
+        r: abci.ResponseCheckTx = res.value
+        post_err = None
+        if self._post_check is not None:
+            post_err = self._post_check(tx, r)
+        if r.code == abci.CODE_TYPE_OK and post_err is None:
+            err = self.is_full(len(tx))
+            if err is not None and not self._try_evict_for(
+                len(tx), r.priority
+            ):
+                self._cache.remove(tx)
+                self.metrics.failed_txs.add(1)
+                self._logger.error(
+                    "rejected valid tx; mempool full and nothing "
+                    "lower-priority to evict",
+                    priority=r.priority,
+                )
+            else:
+                mem_tx = PriorityTx(self._height, r.gas_wanted, tx)
+                mem_tx.priority = r.priority
+                mem_tx.seq = self._next_seq()
+                if tx_info.sender_id:
+                    mem_tx.senders.add(tx_info.sender_id)
+                self._add_tx(mem_tx)
+                self.metrics.size.set(self.size())
+                self.metrics.tx_size_bytes.observe(len(tx))
+                self._notify_txs_available()
+        else:
+            self.metrics.failed_txs.add(1)
+            if not self.config.keep_invalid_txs_in_cache:
+                self._cache.remove(tx)
+        if user_cb is not None:
+            user_cb(res)
+
+    def _next_seq(self) -> int:
+        with self._internal_mtx:
+            self._seq += 1
+            return self._seq
+
+    def _try_evict_for(self, need_bytes: int, priority: int) -> bool:
+        """Evict strictly-lower-priority txs to admit a new tx of
+        `need_bytes` (v1 mempool.go canAddTx: only lower-priority txs may
+        be displaced, and they must free enough space — otherwise the new
+        tx is rejected)."""
+        victims = []
+        freeable = 0
+        for elem in self._txs:
+            mem_tx = elem.value
+            if getattr(mem_tx, "priority", 0) < priority:
+                victims.append((mem_tx, elem))
+                freeable += len(mem_tx.tx)
+        if not victims:
+            return False
+        overflow = max(
+            0, self.size_bytes() + need_bytes - self.config.max_txs_bytes
+        )
+        if freeable < overflow:
+            return False
+        # evict lowest priority first, oldest first, until both the byte
+        # and count limits admit the newcomer
+        victims.sort(key=lambda v: (v[0].priority, v[0].seq))
+        for mem_tx, elem in victims:
+            if self.is_full(need_bytes) is None:
+                break
+            self._remove_tx(mem_tx.tx, elem, remove_from_cache=True)
+            self._logger.debug(
+                "evicted lower-priority tx",
+                evicted_priority=mem_tx.priority,
+                for_priority=priority,
+            )
+        return self.is_full(need_bytes) is None
+
+    # -- reaping --------------------------------------------------------------
+
+    def _priority_order(self) -> List[MempoolTx]:
+        txs = [elem.value for elem in self._txs]
+        txs.sort(key=lambda t: (-getattr(t, "priority", 0), getattr(t, "seq", 0)))
+        return txs
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        """Highest priority first under the byte+gas budget
+        (v1 mempool.go ReapMaxBytesMaxGas)."""
+        with self._update_mtx:
+            out: List[bytes] = []
+            total_bytes = 0
+            total_gas = 0
+            for mem_tx in self._priority_order():
+                tx_sz = len(mem_tx.tx)
+                if max_bytes > -1 and total_bytes + tx_sz > max_bytes:
+                    continue  # a smaller lower-priority tx may still fit
+                new_gas = total_gas + mem_tx.gas_wanted
+                if max_gas > -1 and new_gas > max_gas:
+                    continue
+                total_bytes += tx_sz
+                total_gas = new_gas
+                out.append(mem_tx.tx)
+            return out
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        with self._update_mtx:
+            if n < 0:
+                n = self.size()
+            return [t.tx for t in self._priority_order()[:n]]

@@ -246,7 +246,13 @@ class Node(BaseService):
         self.state_provider = state_provider
 
         # 6. mempool
-        self.mempool = CListMempool(
+        if config.mempool.version == "v1":
+            from cometbft_tpu.mempool.priority_mempool import PriorityMempool
+
+            mempool_cls = PriorityMempool
+        else:
+            mempool_cls = CListMempool
+        self.mempool = mempool_cls(
             config.mempool, self.proxy_app.mempool(),
             height=state.last_block_height, metrics=mem_metrics,
         )
