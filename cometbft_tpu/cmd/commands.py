@@ -495,7 +495,7 @@ def cmd_light(args) -> int:
     """commands/light.go — run a light client daemon: a verifying RPC
     proxy over an untrusted primary, trust-rooted at --trust-height/
     --trust-hash."""
-    from cometbft_tpu.libs.db import MemDB, SQLiteDB
+    from cometbft_tpu.libs.db import SQLiteDB
     from cometbft_tpu.light.client import Client as LightClient, TrustOptions
     from cometbft_tpu.light.provider import HTTPProvider
     from cometbft_tpu.light.proxy import LightProxy
@@ -533,11 +533,10 @@ def cmd_light(args) -> int:
             return 1
         providers.append(HTTPProvider(chain_id, args.primary))
 
-    store_db = (
-        SQLiteDB(os.path.join(args.home, "data", "light.db"))
-        if os.path.isdir(os.path.join(args.home, "data"))
-        else MemDB()
-    )
+    # the persisted trust store is the point of a light DAEMON — losing
+    # it on restart would silently reopen the trust-on-first-use window
+    os.makedirs(os.path.join(args.home, "data"), exist_ok=True)
+    store_db = SQLiteDB(os.path.join(args.home, "data", "light.db"))
     lc = LightClient(
         chain_id,
         TrustOptions(

@@ -81,7 +81,13 @@ class LightProxy:
                 "data_hash"
             )
         last_commit = res["block"].get("last_commit")
-        if last_commit is not None and height > 1:
+        if height > 1:
+            if last_commit is None:
+                # omission is forgery too: the verified header commits to
+                # a real last_commit at every height after the first
+                raise ErrProxyVerification(
+                    "primary omitted last_commit for a height > 1"
+                )
             got_commit = parse_commit(last_commit)
             if got_commit.hash() != got_header.last_commit_hash:
                 raise ErrProxyVerification(

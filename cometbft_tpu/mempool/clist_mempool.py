@@ -138,9 +138,7 @@ class CListMempool(Mempool):
         with self._update_mtx:
             if len(tx) > self.config.max_tx_bytes:
                 raise ErrTxTooLarge(self.config.max_tx_bytes, len(tx))
-            err = self.is_full(len(tx))
-            if err is not None:
-                raise err
+            self._door_full_check(tx)
             if self._pre_check is not None:
                 reason = self._pre_check(tx)
                 if reason is not None:
@@ -165,7 +163,9 @@ class CListMempool(Mempool):
             )
 
     def _res_cb_first_time(self, tx: bytes, tx_info: TxInfo, res, user_cb) -> None:
-        """Reference: resCbFirstTime :372."""
+        """Reference: resCbFirstTime :372. The valid-tx admission step is
+        the `_admit` hook so the priority mempool can swap in
+        evict-to-admit semantics without forking this method."""
         if res.kind != "check_tx":
             if user_cb is not None:
                 user_cb(res)
@@ -175,18 +175,13 @@ class CListMempool(Mempool):
         if self._post_check is not None:
             post_err = self._post_check(tx, r)
         if r.code == abci.CODE_TYPE_OK and post_err is None:
-            err = self.is_full(len(tx))
-            if err is not None:
-                self._cache.remove(tx)
-                self._logger.error("rejected valid tx; mempool full", err=str(err))
-            else:
-                mem_tx = MempoolTx(self._height, r.gas_wanted, tx)
-                if tx_info.sender_id:
-                    mem_tx.senders.add(tx_info.sender_id)
-                self._add_tx(mem_tx)
+            if self._admit(tx, tx_info, r):
                 self.metrics.size.set(self.size())
                 self.metrics.tx_size_bytes.observe(len(tx))
                 self._notify_txs_available()
+            else:
+                self._cache.remove(tx)
+                self.metrics.failed_txs.add(1)
         else:
             # invalid tx
             self.metrics.failed_txs.add(1)
@@ -194,6 +189,25 @@ class CListMempool(Mempool):
                 self._cache.remove(tx)
         if user_cb is not None:
             user_cb(res)
+
+    def _door_full_check(self, tx: bytes) -> None:
+        """v0 rejects a full mempool before CheckTx; v1 overrides to defer
+        (priority is only known afterwards)."""
+        err = self.is_full(len(tx))
+        if err is not None:
+            raise err
+
+    def _admit(self, tx: bytes, tx_info: TxInfo, r) -> bool:
+        """Add a CheckTx-valid tx; False = reject (caller uncaches)."""
+        err = self.is_full(len(tx))
+        if err is not None:
+            self._logger.error("rejected valid tx; mempool full", err=str(err))
+            return False
+        mem_tx = MempoolTx(self._height, r.gas_wanted, tx)
+        if tx_info.sender_id:
+            mem_tx.senders.add(tx_info.sender_id)
+        self._add_tx(mem_tx)
+        return True
 
     def _add_tx(self, mem_tx: MempoolTx) -> None:
         elem = self._txs.push_back(mem_tx)

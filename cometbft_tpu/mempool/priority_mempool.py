@@ -36,78 +36,36 @@ class PriorityMempool(CListMempool):
 
     # -- admission ------------------------------------------------------------
 
-    def check_tx(self, tx: bytes, callback=None, tx_info=None) -> None:
+    def _door_full_check(self, tx: bytes) -> None:
         """Unlike v0, a full mempool does NOT reject at the door — the
         priority is only known after the app's CheckTx, so fullness is
         resolved post-CheckTx via eviction (v1 mempool.go CheckTx)."""
-        from cometbft_tpu.mempool import (
-            ErrPreCheck,
-            ErrTxInCache,
-            ErrTxTooLarge,
-        )
-        from cometbft_tpu.mempool import tx_key as _tx_key
 
-        tx_info = tx_info or TxInfo()
-        with self._update_mtx:
-            if len(tx) > self.config.max_tx_bytes:
-                raise ErrTxTooLarge(self.config.max_tx_bytes, len(tx))
-            if self._pre_check is not None:
-                reason = self._pre_check(tx)
-                if reason is not None:
-                    raise ErrPreCheck(reason)
-            if not self._cache.push(tx):
-                self.metrics.already_received_txs.add(1)
-                elem = self._txs_map.get(_tx_key(tx))
-                if elem is not None and tx_info.sender_id:
-                    elem.value.senders.add(tx_info.sender_id)
-                raise ErrTxInCache()
-            if self._proxy_app.error() is not None:
-                self._cache.remove(tx)
-                raise RuntimeError(str(self._proxy_app.error()))
-            rr = self._proxy_app.check_tx_async(
-                abci.RequestCheckTx(tx=tx, type=abci.CHECK_TX_TYPE_NEW)
+    def _admit(self, tx: bytes, tx_info: TxInfo, r) -> bool:
+        if self.is_full(len(tx)) is not None and not self._try_evict_for(
+            len(tx), r.priority
+        ):
+            self._logger.error(
+                "rejected valid tx; mempool full and nothing "
+                "lower-priority to evict",
+                priority=r.priority,
             )
-            rr.set_callback(
-                lambda res: self._res_cb_first_time(tx, tx_info, res, callback)
-            )
+            return False
+        mem_tx = PriorityTx(self._height, r.gas_wanted, tx)
+        mem_tx.priority = r.priority
+        mem_tx.seq = self._next_seq()
+        if tx_info.sender_id:
+            mem_tx.senders.add(tx_info.sender_id)
+        self._add_tx(mem_tx)
+        return True
 
-    def _res_cb_first_time(self, tx: bytes, tx_info: TxInfo, res, user_cb) -> None:
-        if res.kind != "check_tx":
-            if user_cb is not None:
-                user_cb(res)
-            return
-        r: abci.ResponseCheckTx = res.value
-        post_err = None
-        if self._post_check is not None:
-            post_err = self._post_check(tx, r)
-        if r.code == abci.CODE_TYPE_OK and post_err is None:
-            err = self.is_full(len(tx))
-            if err is not None and not self._try_evict_for(
-                len(tx), r.priority
-            ):
-                self._cache.remove(tx)
-                self.metrics.failed_txs.add(1)
-                self._logger.error(
-                    "rejected valid tx; mempool full and nothing "
-                    "lower-priority to evict",
-                    priority=r.priority,
-                )
-            else:
-                mem_tx = PriorityTx(self._height, r.gas_wanted, tx)
-                mem_tx.priority = r.priority
-                mem_tx.seq = self._next_seq()
-                if tx_info.sender_id:
-                    mem_tx.senders.add(tx_info.sender_id)
-                self._add_tx(mem_tx)
-                self.metrics.size.set(self.size())
-                self.metrics.tx_size_bytes.observe(len(tx))
-                self._notify_txs_available()
-        else:
-            self.metrics.failed_txs.add(1)
-            if not self.config.keep_invalid_txs_in_cache:
-                self._cache.remove(tx)
-        if user_cb is not None:
-            user_cb(res)
+    def _res_cb_recheck(self, tx: bytes, elem, res) -> None:
+        """Priorities can change with app state — refresh from the
+        recheck response before the base invalid-tx handling (v1
+        mempool.go recheck keeps priorities current)."""
+        if res.kind == "check_tx" and res.value.code == 0:
+            elem.value.priority = res.value.priority
+        super()._res_cb_recheck(tx, elem, res)
 
     def _next_seq(self) -> int:
         with self._internal_mtx:

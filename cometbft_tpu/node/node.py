@@ -66,10 +66,13 @@ def _parse_laddr(laddr: str):
     return host or "127.0.0.1", int(port)
 
 
-def default_client_creator(proxy_app: str, app_db: Optional[DB] = None):
-    """Reference: proxy.DefaultClientCreator — builtin names or a socket
-    address. Builtin apps share ONE application instance across the four
-    logical connections (LocalClient takes a shared mutex)."""
+def default_client_creator(
+    proxy_app: str, app_db: Optional[DB] = None, transport: str = "socket"
+):
+    """Reference: proxy.DefaultClientCreator — builtin names or a remote
+    address ([base] abci = "socket" | "grpc" picks the wire). Builtin apps
+    share ONE application instance across the four logical connections
+    (LocalClient takes a shared mutex)."""
     import threading
 
     if proxy_app == "kvstore":
@@ -92,6 +95,10 @@ def default_client_creator(proxy_app: str, app_db: Optional[DB] = None):
         app = BaseApplication()
         mtx = threading.Lock()
         return lambda: LocalClient(app, mtx)
+    if transport == "grpc":
+        from cometbft_tpu.abci.grpc import GRPCClient
+
+        return lambda: GRPCClient(proxy_app)
     addr = proxy_app.split("://", 1)[-1]
     return lambda: SocketClient(addr, must_connect=False)
 
@@ -617,7 +624,9 @@ def default_new_node(config: Config, logger: Optional[Logger] = None) -> Node:
         config,
         priv_validator,
         node_key,
-        default_client_creator(config.base.proxy_app, app_db),
+        default_client_creator(
+            config.base.proxy_app, app_db, transport=config.base.abci
+        ),
         genesis_doc,
         logger=logger,
     )

@@ -9,7 +9,7 @@ import pytest
 from cometbft_tpu.abci import types as abci
 from cometbft_tpu.abci.application import BaseApplication
 from cometbft_tpu.abci.client import LocalClient
-from cometbft_tpu.config import test_config
+from cometbft_tpu.config import test_config as make_test_config
 from cometbft_tpu.mempool.priority_mempool import PriorityMempool
 from cometbft_tpu.proxy import AppConnMempool
 
@@ -28,7 +28,7 @@ class _PriorityApp(BaseApplication):
 
 
 def _mk(size=None, max_bytes=None):
-    cfg = test_config().mempool
+    cfg = make_test_config().mempool
     if size is not None:
         cfg.size = size
     if max_bytes is not None:
@@ -129,9 +129,7 @@ class TestPriorityMempool:
             client.stop()
 
     def test_node_selects_v1_from_config(self):
-        from cometbft_tpu.config import test_config as tc
-
-        cfg = tc()
+        cfg = make_test_config()
         cfg.mempool.version = "v1"
         # structural check only: the Node wiring picks PriorityMempool
         from cometbft_tpu.mempool.priority_mempool import PriorityMempool as PM
