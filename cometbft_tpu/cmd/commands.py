@@ -491,6 +491,71 @@ def _debug_inspect(args) -> int:
     return 0
 
 
+def cmd_replay(args) -> int:
+    """commands/replay.go — re-execute the stored chain against the app
+    (fresh app state) and report the resulting heights/hashes. Run on a
+    STOPPED node; useful after an app-hash mismatch or app upgrade."""
+    from cometbft_tpu.consensus.replay import Handshaker
+    from cometbft_tpu.node.node import (
+        default_client_creator,
+        default_db_provider,
+    )
+    from cometbft_tpu.proxy import new_app_conns
+    from cometbft_tpu.state import make_genesis_state
+    from cometbft_tpu.state.store import Store as StateStore
+    from cometbft_tpu.store import BlockStore
+
+    cfg = _load_config(args.home)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    block_store = BlockStore(default_db_provider("blockstore", cfg))
+    state_store = StateStore(default_db_provider("state", cfg))
+    with open(cfg.base.genesis_path()) as f:
+        doc = GenesisDoc.from_json(f.read())
+    state = state_store.load()
+    if state is None:
+        state = make_genesis_state(doc)
+        state_store.save(state)
+
+    app_db = None
+    if args.fresh_app:
+        # replay against a brand-new app instance (the reference replay's
+        # whole point: rebuild app state from the chain)
+        app_db = default_db_provider("app_replay", cfg)
+    else:
+        app_db = default_db_provider("app", cfg)
+    proxy_app = new_app_conns(
+        default_client_creator(
+            cfg.base.proxy_app, app_db, transport=cfg.base.abci
+        )
+    )
+    proxy_app.start()
+    try:
+        replayed_hash = Handshaker(
+            state_store, state, block_store, doc
+        ).handshake(proxy_app)
+        final = state_store.load()
+        print(
+            f"Replayed chain to height {block_store.height()}; state at "
+            f"{final.last_block_height}, replayed app_hash "
+            f"{replayed_hash.hex().upper()}"
+        )
+        # the whole point of --fresh-app: does re-execution reproduce the
+        # app hash the chain recorded?
+        if replayed_hash != final.app_hash:
+            print(
+                f"APP HASH MISMATCH: chain recorded "
+                f"{final.app_hash.hex().upper()} — the app DIVERGES on "
+                f"replay",
+                file=sys.stderr,
+            )
+            return 1
+        print("App hash matches the stored state.")
+        return 0
+    finally:
+        proxy_app.stop()
+
+
 def cmd_light(args) -> int:
     """commands/light.go — run a light client daemon: a verifying RPC
     proxy over an untrusted primary, trust-rooted at --trust-height/
@@ -867,6 +932,16 @@ def main(argv: Optional[list] = None) -> int:
         "--laddr", default="tcp://127.0.0.1:26669", help="inspect listen addr"
     )
     p.set_defaults(fn=cmd_debug)
+
+    p = sub.add_parser(
+        "replay", help="re-execute the stored chain against the app"
+    )
+    p.add_argument(
+        "--fresh-app", action="store_true",
+        help="replay into a brand-new app DB (app_replay.db)",
+    )
+    p.add_argument("--proxy_app", default="", help="override [base] proxy_app")
+    p.set_defaults(fn=cmd_replay)
 
     p = sub.add_parser(
         "light", help="light client daemon: verifying RPC proxy"

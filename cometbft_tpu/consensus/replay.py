@@ -133,8 +133,9 @@ class Handshaker:
         self._logger = logger or new_nop_logger()
         self.n_blocks = 0
 
-    def handshake(self, proxy_app) -> None:
-        """proxy_app: proxy.AppConns. Reference: Handshake :241."""
+    def handshake(self, proxy_app) -> bytes:
+        """proxy_app: proxy.AppConns. Returns the app hash the app ended
+        at after any replay. Reference: Handshake :241."""
         res = proxy_app.query().info_sync(
             abci.RequestInfo(version="", block_version=BLOCK_PROTOCOL,
                              p2p_version=P2P_PROTOCOL)
@@ -160,6 +161,7 @@ class Handshaker:
             app_height=app_block_height,
             app_hash=app_hash.hex(),
         )
+        return app_hash
 
     def replay_blocks(
         self,

@@ -53,7 +53,9 @@ def _rotr(x: jnp.ndarray, n: int) -> jnp.ndarray:
     return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
 
 
-def _compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
+def _compress(
+    state: jnp.ndarray, block: jnp.ndarray, k_arr: jnp.ndarray = None
+) -> jnp.ndarray:
     """state u32[...,8], block u32[...,16] → u32[...,8].
 
     The message schedule is materialized into one [64, ...] tensor and the
@@ -70,7 +72,8 @@ def _compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
         s1 = _rotr(w[i - 2], 17) ^ _rotr(w[i - 2], 19) ^ (w[i - 2] >> np.uint32(10))
         w.append(w[i - 16] + s0 + w[i - 7] + s1)
     w_arr = jnp.stack(w, axis=0)  # [64, ...]
-    k_arr = jnp.asarray(_K)
+    if k_arr is None:
+        k_arr = jnp.asarray(_K)
 
     def round_fn(i, vals):
         a, b, c, d, e, f, g, h = vals
@@ -95,15 +98,31 @@ def _compress(state: jnp.ndarray, block: jnp.ndarray) -> jnp.ndarray:
 
 
 @jax.jit
-def sha256_blocks(blocks: jnp.ndarray) -> jnp.ndarray:
-    """blocks u32[B, n_blocks, 16] (BE words of pre-padded messages)
-    → digests u32[B, 8]."""
+def _sha256_blocks_xla(blocks: jnp.ndarray) -> jnp.ndarray:
     state = jnp.broadcast_to(
         jnp.asarray(_IV), blocks.shape[:-2] + (8,)
     )
     for i in range(blocks.shape[-2]):  # fixed small count — unrolled
         state = _compress(state, blocks[..., i, :])
     return state
+
+
+def sha256_blocks(blocks: jnp.ndarray) -> jnp.ndarray:
+    """blocks u32[B, n_blocks, 16] (BE words of pre-padded messages)
+    → digests u32[B, 8]. CBFT_TPU_SHA=pallas selects the hand-written
+    Pallas kernel (sha256_pallas.py); default is the fused XLA program."""
+    import os
+
+    impl = os.environ.get("CBFT_TPU_SHA", "xla")
+    if impl == "pallas":
+        from cometbft_tpu.crypto.tpu import sha256_pallas
+
+        return sha256_pallas.sha256_blocks(blocks)
+    if impl != "xla":
+        raise ValueError(
+            f"unknown CBFT_TPU_SHA={impl!r}; choose from ['pallas', 'xla']"
+        )
+    return _sha256_blocks_xla(blocks)
 
 
 def pad_messages_np(msgs: np.ndarray, msg_len: int) -> np.ndarray:

@@ -1,0 +1,88 @@
+"""Pallas SHA-256 compression kernel (opt-in).
+
+SURVEY.md §7 stage 3 calls for Pallas kernels on the hashing hot path.
+The default batched SHA-256 (crypto/tpu/sha256.py) is a plain XLA
+program; this module provides the same `sha256_blocks` contract as a
+hand-written Pallas kernel: the batch is tiled into VMEM blocks of
+(128, …) lanes, each grid step runs the full 64-round compression per
+block of its tile entirely in VMEM uint32 registers — one HBM read of
+the padded message words and one write of the digests per tile, no
+intermediate HBM traffic for the 64-entry message schedule.
+
+Selected with CBFT_TPU_SHA=pallas (see crypto/tpu/sha256.py dispatch);
+parity with hashlib is enforced by tests/test_tpu_merkle.py in Pallas
+interpret mode on CPU and on real hardware when available.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from cometbft_tpu.crypto.tpu.sha256 import _IV, _K, _compress
+
+_TILE = 128  # batch lanes per grid step (VPU lane width)
+
+
+def _kernel(blocks_ref, k_ref, out_ref, *, n_blocks: int):
+    """One grid step: hash a [_TILE, n_blocks, 16] slab to [_TILE, 8].
+
+    The per-block compression is the shared loop-form `_compress` (a
+    lax.fori_loop over the 64 rounds) — the unrolled form makes XLA's
+    passes go super-linear exactly as sha256.py's docstring warns, and
+    that cost applies to the Pallas lowering too."""
+    # IV as scalar constants (array captures are not allowed in kernels)
+    state = jnp.stack(
+        [jnp.full((_TILE,), np.uint32(int(v))) for v in _IV], axis=-1
+    )
+    k_arr = k_ref[:]
+    for i in range(n_blocks):  # fixed small count — unrolled
+        state = _compress(state, blocks_ref[:, i, :], k_arr)
+    out_ref[:, :] = state
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=64)
+def _build_call(padded: int, n_blocks: int, interpret: bool):
+    """One callable per shape — rebuilding a jit wrapper per invocation
+    would retrace and recompile every eager call."""
+    call = pl.pallas_call(
+        partial(_kernel, n_blocks=n_blocks),
+        grid=(padded // _TILE,),
+        in_specs=[
+            pl.BlockSpec(
+                (_TILE, n_blocks, 16), lambda i: (i, 0, 0)
+            ),
+            pl.BlockSpec((64,), lambda i: (0,)),  # the round constants
+        ],
+        out_specs=pl.BlockSpec((_TILE, 8), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((padded, 8), jnp.uint32),
+        interpret=interpret,
+    )
+    if not interpret:
+        # interpret mode must stay eager — jitting it compiles the whole
+        # round-loop interpreter graph, which takes minutes on a CPU host
+        call = jax.jit(call)
+    return call
+
+
+def _run(blocks: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    n, n_blocks, _ = blocks.shape
+    padded = ((n + _TILE - 1) // _TILE) * _TILE
+    if padded != n:
+        blocks = jnp.pad(blocks, ((0, padded - n), (0, 0), (0, 0)))
+    call = _build_call(padded, n_blocks, interpret)
+    return call(blocks, jnp.asarray(_K))[:n]
+
+
+def sha256_blocks(blocks: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    """Drop-in for crypto/tpu/sha256.sha256_blocks via the Pallas path.
+    blocks u32[B, n_blocks, 16] (BE words, pre-padded) → digests u32[B, 8].
+    `interpret=True` runs the kernel in Pallas interpret mode (CPU CI)."""
+    return _run(jnp.asarray(blocks, jnp.uint32), interpret=interpret)

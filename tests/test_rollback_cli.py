@@ -185,6 +185,13 @@ class TestMaintenanceCLI:
             found = idx.search(parse_query(f"tx.height={tx_height}"))
             assert len(found) == 1 and found[0].tx == b"ri=1"
 
+            # replay into a FRESH app: the chain re-executes end to end
+            # and reports the final heights (commands/replay.go analog)
+            assert cli_main([
+                "--home", d, "replay", "--fresh-app",
+                "--proxy_app", "kvstore",
+            ]) == 0
+
             # rollback: state height drops by one
             from cometbft_tpu.state.store import Store as StateStore
 
