@@ -114,10 +114,10 @@ def seal(plaintext: bytes, nonce: bytes, secret: bytes) -> bytes:
         raise ValueError(f"Secret must be 32 bytes long, got len {len(secret)}")
     if len(nonce) != NONCE_LEN:
         raise ValueError("nonce must be 24 bytes")
-    # first keystream block: 32 bytes poly key, rest unused (block 0 tail
-    # is skipped, encryption starts at block 1 like NaCl)
+    # NaCl secretbox keystream split: bytes 0..31 of block 0 key the MAC,
+    # the message is XORed starting at byte 32 (block-0 tail, then block 1+)
     poly_key = _xsalsa20_stream(secret, nonce, 32)
-    stream = _xsalsa20_stream(secret, nonce, len(plaintext), skip=64)
+    stream = _xsalsa20_stream(secret, nonce, len(plaintext), skip=32)
     ct = bytes(p ^ s for p, s in zip(plaintext, stream))
     mac = poly1305.Poly1305(poly_key)
     mac.update(ct)
@@ -134,7 +134,7 @@ def open_(box: bytes, nonce: bytes, secret: bytes) -> bytes:
     mac = poly1305.Poly1305(poly_key)
     mac.update(ct)
     mac.verify(tag)  # raises InvalidSignature on forgery
-    stream = _xsalsa20_stream(secret, nonce, len(ct), skip=64)
+    stream = _xsalsa20_stream(secret, nonce, len(ct), skip=32)
     return bytes(c ^ s for c, s in zip(ct, stream))
 
 

@@ -75,13 +75,13 @@ class Pool:
     def pending_evidence(self, max_bytes: int) -> Tuple[List[Evidence], int]:
         """Reference: PendingEvidence — up to max_bytes of proto size
         including list framing."""
-        from cometbft_tpu.libs.protoio import uvarint_size
+        from cometbft_tpu.types.tx import proto_framed_size
 
         out: List[Evidence] = []
         size = 0
         try:
             for ev, ev_size in self._list_evidence(_PENDING_PREFIX, -1):
-                framed = ev_size + 1 + uvarint_size(ev_size)
+                framed = proto_framed_size(ev_size)
                 if max_bytes != -1 and size + framed > max_bytes:
                     return out, size
                 size += framed

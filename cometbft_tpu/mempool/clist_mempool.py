@@ -236,13 +236,16 @@ class CListMempool(Mempool):
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
         """Reference: ReapMaxBytesMaxGas :521 — FIFO under byte+gas budget."""
+        from cometbft_tpu.types.tx import proto_framed_size
+
         with self._update_mtx:
             txs: List[bytes] = []
             total_bytes = 0
             total_gas = 0
             for elem in self._txs:
                 mem_tx: MempoolTx = elem.value
-                tx_sz = len(mem_tx.tx)
+                # proto-framed size, as ComputeProtoSizeForTxs budgets it
+                tx_sz = proto_framed_size(len(mem_tx.tx))
                 if max_bytes > -1 and total_bytes + tx_sz > max_bytes:
                     break
                 new_gas = total_gas + mem_tx.gas_wanted

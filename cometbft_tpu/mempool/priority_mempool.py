@@ -113,19 +113,24 @@ class PriorityMempool(CListMempool):
         return txs
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
-        """Highest priority first under the byte+gas budget
-        (v1 mempool.go ReapMaxBytesMaxGas)."""
+        """Highest priority first under the byte+gas budget. Stops at the
+        first tx that does not fit — same early-break as the reference v1
+        ReapMaxBytesMaxGas and this repo's v0 reap — and budgets the
+        proto-framed tx size (ComputeProtoSizeForTxs), so a proposal packed
+        here is never larger than the reference would build."""
+        from cometbft_tpu.types.tx import proto_framed_size
+
         with self._update_mtx:
             out: List[bytes] = []
             total_bytes = 0
             total_gas = 0
             for mem_tx in self._priority_order():
-                tx_sz = len(mem_tx.tx)
+                tx_sz = proto_framed_size(len(mem_tx.tx))
                 if max_bytes > -1 and total_bytes + tx_sz > max_bytes:
-                    continue  # a smaller lower-priority tx may still fit
+                    break
                 new_gas = total_gas + mem_tx.gas_wanted
                 if max_gas > -1 and new_gas > max_gas:
-                    continue
+                    break
                 total_bytes += tx_sz
                 total_gas = new_gas
                 out.append(mem_tx.tx)

@@ -512,6 +512,29 @@ class Node(BaseService):
         metrics = self.consensus_state.metrics
         metrics.state_syncing.set(1)
 
+        def fail_over(msg: str, exc: Exception):
+            # A dead statesync must not wedge the node in wait-sync
+            # forever (the reference treats startStateSync failure as
+            # fatal): clear the gauge and fall back to blocksync /
+            # consensus from the untouched pre-sync state, loudly.
+            self.logger.error(
+                msg + " — falling back to block sync", err=str(exc)
+            )
+            metrics.state_syncing.set(0)
+            try:
+                state = self.state_store.load()
+                if self._fast_sync_after_statesync:
+                    metrics.fast_syncing.set(1)
+                    self.blocksync_reactor.switch_to_fast_sync(state)
+                else:
+                    self.consensus_reactor.switch_to_consensus(state, True)
+            except Exception as exc2:  # noqa: BLE001
+                self.logger.error(
+                    "statesync fail-over itself failed — stopping node",
+                    err=str(exc2),
+                )
+                threading.Thread(target=self.stop, daemon=True).start()
+
         def run():
             try:
                 state, commit = self.statesync_reactor.sync(
@@ -519,7 +542,7 @@ class Node(BaseService):
                     self.config.statesync.discovery_time_ns / 1e9,
                 )
             except Exception as exc:
-                self.logger.error("state sync failed", err=str(exc))
+                fail_over("state sync failed", exc)
                 return
             try:
                 self.state_store.bootstrap(state)
@@ -527,9 +550,15 @@ class Node(BaseService):
                     state.last_block_height, commit
                 )
             except Exception as exc:
+                # the stores may be half-bootstrapped; resuming consensus
+                # from them is unsafe — treat as fatal like the reference
                 self.logger.error(
-                    "failed to bootstrap node with new state", err=str(exc)
+                    "FATAL: failed to bootstrap node with new state — "
+                    "stopping node",
+                    err=str(exc),
                 )
+                metrics.state_syncing.set(0)
+                threading.Thread(target=self.stop, daemon=True).start()
                 return
             metrics.state_syncing.set(0)
             if self._fast_sync_after_statesync:

@@ -35,3 +35,20 @@ class Txs(List[Tx]):
         """types/tx.go Txs.Proof — proof for tx i (leaves are tx hashes)."""
         root, proofs = merkle.proofs_from_byte_slices([t.hash() for t in self])
         return root, proofs[i]
+
+
+def proto_framed_size(payload_len: int) -> int:
+    """Marshalled size of one length-delimited proto field with a 1-byte
+    tag: tag + length varint + payload. The framing every repeated-bytes
+    member (a tx in Data, an evidence blob in EvidenceList) costs."""
+    from cometbft_tpu.libs.protoio import uvarint_size
+
+    return 1 + uvarint_size(payload_len) + payload_len
+
+
+def compute_proto_size_for_txs(txs: Iterable[bytes]) -> int:
+    """types/tx.go ComputeProtoSizeForTxs — marshalled size of a
+    tendermint.types.Data{txs} message. Mempool reaping budgets against
+    THIS size, not len(tx), so proposals never overflow the block's byte
+    limit once proto-framed."""
+    return sum(proto_framed_size(len(tx)) for tx in txs)

@@ -101,6 +101,36 @@ class TestXSalsa20Symmetric:
         with pytest.raises(ValueError):
             xsalsa.encrypt_symmetric(b"x", b"short")
 
+    def test_nacl_known_answer(self):
+        """The canonical crypto_secretbox vector (NaCl tests/box.c — the
+        same key/nonce/message triple the reference's
+        golang.org/x/crypto/nacl/secretbox interops with). Passing MAC
+        verification here pins byte-level NaCl compatibility: the Poly1305
+        key and the keystream placement must both be exact."""
+        key = bytes.fromhex(
+            "1b27556473e985d462cd51197a9a46c7"
+            "6009549eac6474f206c4ee0844f68389"
+        )
+        nonce = bytes.fromhex(
+            "69696ee955b62b73cd62bda875fc73d68219e0036b7a0b37"
+        )
+        ct = bytes.fromhex(
+            "f3ffc7703f9400e52a7dfb4b3d3305d9"
+            "8e993b9f48681273c29650ba32fc76ce"
+            "48332ea7164d96a4476fb8c531a1186a"
+            "c0dfc17c98dce87b4da7f011ec48c972"
+            "71d2c20f9b928fe2270d6fb863d51738"
+            "b48eeee314a7cc8ab932164548e526ae"
+            "90224368517acfeabd6bb3732bc0e9da"
+            "99832b61ca01b6de56244a9e88d5f9b3"
+            "7973f622a43d14a6599b1f654cb45a74"
+            "e355a5"
+        )
+        pt = xsalsa.open_(ct, nonce, key)
+        assert len(pt) == 131
+        assert pt.startswith(bytes.fromhex("be075fc53c81f2d5cf141316ebeb0c7b"))
+        assert xsalsa.seal(pt, nonce, key) == ct
+
 
 class TestArmor:
     def test_roundtrip(self):
@@ -126,11 +156,32 @@ class TestArmor:
         key = bytes(range(32, 64))
         s = armor.encrypt_armor_priv_key(key, "hunter2")
         assert "BEGIN TENDERMINT PRIVATE KEY" in s
+        assert "kdf: scrypt" in s
         assert armor.unarmor_decrypt_priv_key(s, "hunter2") == key
         from cryptography.exceptions import InvalidSignature
 
         with pytest.raises(InvalidSignature):
             armor.unarmor_decrypt_priv_key(s, "wrong-pass")
+
+    def test_legacy_and_foreign_kdfs_rejected(self):
+        """Pre-NaCl-fix 'sha256-salt' blobs would MAC-verify but decrypt
+        to garbage under the fixed keystream — they must be refused, not
+        silently corrupted; the reference's 'bcrypt' header is likewise
+        not interoperable."""
+        blob = armor.encode_armor(
+            armor.PRIVKEY_BLOCK_TYPE,
+            {"kdf": "sha256-salt", "salt": "00" * 16},
+            b"whatever",
+        )
+        with pytest.raises(ValueError, match="pre-NaCl-fix"):
+            armor.unarmor_decrypt_priv_key(blob, "pw")
+        blob = armor.encode_armor(
+            armor.PRIVKEY_BLOCK_TYPE,
+            {"kdf": "bcrypt", "salt": "00" * 16},
+            b"whatever",
+        )
+        with pytest.raises(ValueError, match="unrecognized KDF"):
+            armor.unarmor_decrypt_priv_key(blob, "pw")
 
     def test_malformed(self):
         with pytest.raises(ValueError):

@@ -115,8 +115,10 @@ class TestCListMempool:
         for i in range(10, 20):  # 2-byte txs
             _check(mp, str(i).encode())
         assert len(mp.reap_max_bytes_max_gas(-1, -1)) == 10
-        # byte budget: 3 txs of 2 bytes
-        assert len(mp.reap_max_bytes_max_gas(6, -1)) == 3
+        # byte budget counts proto framing (1 tag + 1 len + 2 payload = 4
+        # per tx, as ComputeProtoSizeForTxs does): 12 bytes → 3 txs
+        assert len(mp.reap_max_bytes_max_gas(12, -1)) == 3
+        assert len(mp.reap_max_bytes_max_gas(11, -1)) == 2
         # gas budget: each tx wants 1 gas
         assert len(mp.reap_max_bytes_max_gas(-1, 4)) == 4
         # zero budget

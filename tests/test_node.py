@@ -207,6 +207,50 @@ class TestSingleNode:
             finally:
                 node.stop()
 
+    def test_statesync_failure_falls_back_instead_of_wedging(self):
+        """A dead statesync (no snapshots / provider failure) must not
+        leave the node in wait-sync forever: it falls back to
+        blocksync/consensus with the state_syncing gauge cleared
+        (ADVICE r3; reference treats startStateSync failure as fatal)."""
+        from cometbft_tpu.cmd.commands import _load_config
+        from cometbft_tpu.node import default_new_node
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "ss-fail"])
+            rpc_port, p2p_port = _free_ports(2)
+            cfg = _load_config(d)
+            cfg.base.proxy_app = "kvstore"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            cfg.consensus.timeout_commit_ns = 100_000_000
+            cfg.statesync.enable = True
+            node = default_new_node(cfg)
+
+            def boom(*a, **k):
+                raise RuntimeError("no snapshots anywhere")
+
+            node.statesync_reactor.sync = boom
+            node.state_provider = object()  # skip config-derived provider
+            node.start()
+            try:
+                metrics = node.consensus_state.metrics
+                deadline = time.monotonic() + 60
+                height = 0
+                while time.monotonic() < deadline and height < 2:
+                    try:
+                        height = int(
+                            _rpc(rpc_port, "status")["result"]["sync_info"][
+                                "latest_block_height"
+                            ]
+                        )
+                    except Exception:
+                        pass
+                    time.sleep(0.3)
+                assert height >= 2, "node wedged after statesync failure"
+                assert metrics.state_syncing.value() == 0
+            finally:
+                node.stop()
+
     def test_node_restarts_from_disk(self):
         """Stop the node, boot a second one from the same home: state,
         blocks, and the privval sign state all survive (handshake replay)."""

@@ -61,15 +61,19 @@ class TestPriorityMempool:
         finally:
             client.stop()
 
-    def test_byte_budget_skips_but_keeps_scanning(self):
+    def test_byte_budget_breaks_at_first_misfit(self):
+        """Reference v1 ReapMaxBytesMaxGas (and this repo's v0 reap) stop
+        at the first tx that does not fit — a smaller lower-priority tx is
+        NOT pulled forward past it."""
         mp, client = _mk()
         try:
             mp.check_tx(_tx(9, "A" * 200))  # big, high priority
             mp.check_tx(_tx(5, "b"))  # small, low priority
             mp.flush_app_conn()
-            reaped = mp.reap_max_bytes_max_gas(40, -1)
-            # the big tx does not fit; the small lower-priority one does
-            assert len(reaped) == 1 and reaped[0].endswith(b"b")
+            assert mp.reap_max_bytes_max_gas(40, -1) == []
+            # with room for the big one, both fit (proto-framed sizes)
+            reaped = mp.reap_max_bytes_max_gas(4096, -1)
+            assert len(reaped) == 2 and reaped[0].endswith(b"A")
         finally:
             client.stop()
 

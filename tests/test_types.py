@@ -351,6 +351,21 @@ class TestBlock:
             [Tx(b"a").hash(), Tx(b"b").hash()]
         )
 
+    def test_compute_proto_size_for_txs(self):
+        """types/tx.go ComputeProtoSizeForTxs: per tx one tag byte, a
+        length varint, then the payload — the size mempool reaping and
+        MaxDataBytes budgeting must agree on."""
+        from cometbft_tpu.types.tx import (
+            compute_proto_size_for_txs,
+            proto_framed_size,
+        )
+
+        assert compute_proto_size_for_txs([]) == 0
+        assert compute_proto_size_for_txs([b"ab"]) == 4  # 1 + 1 + 2
+        big = b"x" * 300  # 300 needs a 2-byte varint
+        assert compute_proto_size_for_txs([big, b"ab"]) == (1 + 2 + 300) + 4
+        assert proto_framed_size(300) == 1 + 2 + 300
+
     def test_commit_to_vote_set_roundtrip(self):
         from cometbft_tpu.types.block import commit_to_vote_set
 
