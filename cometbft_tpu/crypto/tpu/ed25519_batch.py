@@ -7,15 +7,28 @@ whole batch is verified at once: every signature is a lane of a fixed-shape
 SPMD computation — point decompression, a joint windowed Straus
 double-scalar multiplication [s]B + [h](-A), and an encode-and-compare
 against R — built from the limb arithmetic in `field`. The batch axis is
-explicit so pjit/shard_map can spread a 10k-validator mega-commit across an
-ICI mesh.
+explicit (and minor-most, i.e. on the TPU vector lanes — see field.py's
+limb-major layout notes) so pjit/shard_map can spread a 10k-validator
+mega-commit across an ICI mesh.
 
 Algorithm: radix-4 joint Straus. Both 253-bit scalars are split into 127
 2-bit digits; one 16-entry table ds·B + dh·(-A) (ds, dh ∈ 0..3) is built
 per signature, entries kept in "cached" form (Y+X, Y−X, 2d·T, 2Z) so the
 main-loop addition costs 8 field muls. Loop: 127 × (2 doublings + 1
-branch-free table lookup + 1 cached add). Everything is uniform across the
+branch-free table select + 1 cached add). The table select is a one-hot
+multiply-accumulate over the 16 entries — a handful of full-width VPU
+ops — rather than a per-lane gather, which XLA lowers to a (slow,
+serializing) dynamic-gather on TPU. Everything is uniform across the
 batch — no data-dependent control flow, ideal for SIMD lanes.
+
+Two hashing modes (CBFT_TPU_HASH):
+  * ``host`` — h = SHA-512(R ‖ A ‖ M) mod L per signature via hashlib (C)
+    on the host while packing; the device runs only the group math.
+  * ``device`` — the full pipeline is ONE dispatch: batched SHA-512
+    (sha512.py, 64-bit lanes in 2×u32), exact mod-L reduction
+    (scalar.sc_reduce — ref10 sc_reduce semantics, required for parity on
+    torsioned keys), 2-bit digit extraction, then the Straus loop. The
+    host's per-signature work drops to pure byte packing.
 
 Semantics contract: accept/reject is bit-identical to the CPU backend
 (OpenSSL via `cryptography`, itself matching ref10):
@@ -35,7 +48,6 @@ multiplication — >99% of the FLOPs — is what the TPU executes.
 from __future__ import annotations
 
 import hashlib
-from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -155,7 +167,7 @@ def add_cached(p: Point, qc: CachedPoint) -> Point:
 
 
 def decompress(y: jnp.ndarray, sign: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """y: fe[batch,17] (low 255 bits), sign: int32[batch].
+    """y: fe[17,B] (low 255 bits), sign: int32[B].
 
     Returns (x, ok). ref10 semantics: y is taken mod p; the candidate root
     x = (u/v)^((p+3)/8) is validated by v·x² ∈ {u, -u}; parity is adjusted
@@ -174,7 +186,7 @@ def decompress(y: jnp.ndarray, sign: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndar
     x = fe.select(ok_flip, fe.mul(x, _SQRT_M1_FE), x)
     ok = ok_direct | ok_flip
     xc = fe.to_canonical(x)
-    flip = (xc[..., 0] & 1) != sign
+    flip = (xc[0] & 1) != sign
     x = fe.select(flip, fe.neg(x), x)
     return x, ok
 
@@ -182,42 +194,40 @@ def decompress(y: jnp.ndarray, sign: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndar
 # --- the verification kernel ----------------------------------------------
 
 
-def _stack_cached(entries: List[CachedPoint], batch) -> CachedPoint:
-    """16 cached points → one [batch, 16, 17] array per coordinate."""
-    limbs = (fe.NUM_LIMBS,)
+def _select_cached(entries: List[CachedPoint], idx: jnp.ndarray) -> CachedPoint:
+    """Branch-free table lookup as one-hot multiply-accumulate:
+    idx int32[B] ∈ [0, 16) → the idx-th cached point per lane.
+
+    A per-lane gather (take_along_axis) lowers to TPU dynamic-gather —
+    slow and serializing. The one-hot form is 16 masked adds per
+    coordinate: plain full-lane VPU work that XLA fuses into the loop."""
+    oh = idx[None, :] == jnp.arange(len(entries), dtype=jnp.int32)[:, None]
     out = []
     for k in range(4):
-        coords = [jnp.broadcast_to(e[k], batch + limbs) for e in entries]
-        out.append(jnp.stack(coords, axis=-2))
+        acc = None
+        for e_i, entry in enumerate(entries):
+            term = jnp.where(oh[e_i][None, :], entry[k], 0)
+            acc = term if acc is None else acc + term
+        out.append(acc)
     return tuple(out)
 
 
-def _take_cached(table: CachedPoint, idx: jnp.ndarray) -> CachedPoint:
-    """Branch-free per-lane table lookup: idx int32[batch] ∈ [0, 16)."""
-    sel = idx[..., None, None]
-    return tuple(
-        jnp.take_along_axis(coord, sel, axis=-2).squeeze(-2) for coord in table
-    )
-
-
-@partial(jax.jit, static_argnames=())
-def verify_kernel(
-    ay: jnp.ndarray,  # int32[B,17]  A's y limbs (low 255 bits)
+def _verify_core(
+    ay: jnp.ndarray,  # int32[17,B]  A's y limbs (low 255 bits)
     a_sign: jnp.ndarray,  # int32[B]  A's sign bit
-    r_y: jnp.ndarray,  # int32[B,17]  R's y limbs (low 255 bits)
+    r_y: jnp.ndarray,  # int32[17,B]  R's y limbs (low 255 bits)
     r_sign: jnp.ndarray,  # int32[B]  R's sign bit
-    s_digits: jnp.ndarray,  # int32[B,127]  s 2-bit digits, MSB first
-    h_digits: jnp.ndarray,  # int32[B,127]  h 2-bit digits, MSB first
+    s_digits: jnp.ndarray,  # int32[127,B]  s 2-bit digits, MSB first
+    h_digits: jnp.ndarray,  # int32[127,B]  h 2-bit digits, MSB first
 ) -> jnp.ndarray:
     """bool[B]: encode([s]B + [h](-A)) == R and A decompressed OK."""
+    batch = ay.shape[1:]
     x, ok = decompress(ay, a_sign)
     nx = fe.neg(x)
     neg_a: Point = (nx, ay, jnp.broadcast_to(_ONE_FE, ay.shape), fe.mul(nx, ay))
 
-    batch = ay.shape[:-1]
-    limbs = (fe.NUM_LIMBS,)
-
-    # Table: entry[ds + 4·dh] = ds·B + dh·(-A), in cached form.
+    # Table: entry[ds + 4·dh] = ds·B + dh·(-A), in cached form. Constant
+    # (dh=0) entries stay [17,1] and broadcast inside the one-hot select.
     a2 = point_dbl(neg_a)
     a3 = point_add(a2, neg_a)
     s_pts = [_ID_POINT, _B_POINT, _B2_POINT, _B3_POINT]
@@ -230,19 +240,17 @@ def verify_kernel(
             elif ds == 0:
                 pt = h_pts[dh]
             else:
-                pt = point_add(
-                    tuple(jnp.broadcast_to(c, batch + limbs) for c in s_pts[ds]),
-                    h_pts[dh],
-                )
+                pt = point_add(s_pts[ds], h_pts[dh])
             entries.append(cache_point(pt))
-    table = _stack_cached(entries, batch)
 
-    ident: Point = tuple(jnp.broadcast_to(c, batch + limbs) for c in _ID_POINT)
+    ident: Point = tuple(
+        jnp.broadcast_to(c, (fe.NUM_LIMBS,) + batch) for c in _ID_POINT
+    )
 
     def body(i, acc: Point) -> Point:
         acc = point_dbl(point_dbl(acc))
-        idx = s_digits[..., i] + 4 * h_digits[..., i]
-        return add_cached(acc, _take_cached(table, idx))
+        idx = s_digits[i] + 4 * h_digits[i]
+        return add_cached(acc, _select_cached(entries, idx))
 
     rx, ry, rz, _ = lax.fori_loop(0, NUM_DIGITS, body, ident)
 
@@ -253,9 +261,34 @@ def verify_kernel(
     # the ref10 byte-compare of the full 32-byte encoding. r_y is compared
     # RAW (not canonicalized): a non-canonical R encoding must never match,
     # exactly as a byte-compare behaves.
-    y_eq = jnp.all(ey == r_y, axis=-1)
-    sign_eq = (ex[..., 0] & 1) == r_sign
+    y_eq = jnp.all(ey == r_y, axis=0)
+    sign_eq = (ex[0] & 1) == r_sign
     return y_eq & sign_eq & ok
+
+
+verify_kernel = jax.jit(_verify_core)
+
+
+@jax.jit
+def verify_full_kernel(
+    ay: jnp.ndarray,  # int32[17,B]
+    a_sign: jnp.ndarray,  # int32[B]
+    r_y: jnp.ndarray,  # int32[17,B]
+    r_sign: jnp.ndarray,  # int32[B]
+    s_digits: jnp.ndarray,  # int32[127,B]
+    msg_hi: jnp.ndarray,  # u32[n_blocks,16,B]  padded R‖A‖M, BE word hi
+    msg_lo: jnp.ndarray,  # u32[n_blocks,16,B]
+    msg_nblocks: jnp.ndarray,  # int32[B]  live block count per lane
+) -> jnp.ndarray:
+    """The whole verification — SHA-512, mod-L, digits, Straus — as one
+    device program: no host work between hash and group math, no extra
+    dispatches (CBFT_TPU_HASH=device path)."""
+    from cometbft_tpu.crypto.tpu import scalar, sha512
+
+    dig_hi, dig_lo = sha512.sha512_blocks(msg_hi, msg_lo, msg_nblocks)
+    h = scalar.sc_reduce(scalar.digest_to_limbs(dig_hi, dig_lo))
+    h_digits = scalar.digits_msb_first(h)
+    return _verify_core(ay, a_sign, r_y, r_sign, s_digits, h_digits)
 
 
 # --- host glue -------------------------------------------------------------
@@ -272,13 +305,52 @@ def _pad_size(n: int) -> int:
 
 
 def _digits_msb_first(le_bytes: np.ndarray) -> np.ndarray:
-    """uint8[B,32] little-endian scalars → int32[B,127] 2-bit digits, MSB first."""
+    """uint8[B,32] little-endian scalars → int32[127,B] 2-bit digits, MSB
+    first (digit axis leading, batch on the minor axis for the kernel)."""
     bits = np.unpackbits(le_bytes, axis=-1, bitorder="little")  # [B,256]
     digits = bits[..., 0 : 2 * NUM_DIGITS : 2] + 2 * bits[..., 1 : 2 * NUM_DIGITS : 2]
-    return digits[..., ::-1].astype(np.int32)
+    return np.ascontiguousarray(digits[..., ::-1].astype(np.int32).T)
 
 
 _L_BYTES_LE = np.frombuffer(L.to_bytes(32, "little"), np.uint8)
+
+
+def _parse_inputs(pub_keys, sigs):
+    """→ (pk_arr u8[B,32], sig_arr u8[B,64], valid) with wrong-length and
+    s ≥ L entries masked out (zero-filled placeholders keep the shapes)."""
+    n = len(pub_keys)
+    valid = np.ones(n, bool)
+    pk_parts, sig_parts = [], []
+    for i in range(n):
+        pk, sig = pub_keys[i], sigs[i]
+        if len(pk) != 32 or len(sig) != 64:
+            valid[i] = False
+            pk_parts.append(b"\x00" * 32)
+            sig_parts.append(b"\x00" * 64)
+        else:
+            pk_parts.append(pk)
+            sig_parts.append(sig)
+    pk_arr = np.frombuffer(b"".join(pk_parts), np.uint8).reshape(n, 32)
+    sig_arr = np.frombuffer(b"".join(sig_parts), np.uint8).reshape(n, 64)
+
+    # s < L, compared little-endian from the most significant byte down
+    s_arr = sig_arr[:, 32:]
+    diff = s_arr.astype(np.int16) - _L_BYTES_LE.astype(np.int16)
+    nz_mask = diff != 0
+    has_diff = nz_mask.any(axis=1)
+    msb_idx = 31 - nz_mask[:, ::-1].argmax(axis=1)
+    valid &= has_diff & (diff[np.arange(n), msb_idx] < 0)
+    return pk_arr, sig_arr, valid
+
+
+def _pack_points(pk_arr, sig_arr):
+    r_arr = sig_arr[:, :32]
+    ay = np.ascontiguousarray(fe.bytes_to_limbs_np(pk_arr).T)
+    a_sign = (pk_arr[:, 31] >> 7).astype(np.int32)
+    r_y = np.ascontiguousarray(fe.bytes_to_limbs_np(r_arr).T)
+    r_sign = (r_arr[:, 31] >> 7).astype(np.int32)
+    s_digits = _digits_msb_first(sig_arr[:, 32:])
+    return ay, a_sign, r_y, r_sign, s_digits
 
 
 def prepare_batch(
@@ -286,53 +358,63 @@ def prepare_batch(
     msgs: Sequence[bytes],
     sigs: Sequence[bytes],
 ):
-    """Host-side packing: parse inputs, run SHA-512 + mod-L, mask the
-    structurally-invalid entries (wrong length, s ≥ L).
-
-    Vectorized: the only per-item Python is the SHA-512 call (hashlib C)
-    and the 512-bit mod-L (CPython big-int, ~1µs); all byte → array
-    packing and the s < L range check are bulk numpy."""
+    """Host-side packing for the host-hash mode: parse inputs, run
+    SHA-512 + mod-L per signature (hashlib C + CPython big-int), mask the
+    structurally-invalid entries (wrong length, s ≥ L)."""
     n = len(pub_keys)
-    valid = np.ones(n, bool)
+    pk_arr, sig_arr, valid = _parse_inputs(pub_keys, sigs)
     h_arr = np.zeros((n, 32), np.uint8)
-    pk_parts, sig_parts = [], []
     sha = hashlib.sha512
     for i in range(n):
-        pk, sig = pub_keys[i], sigs[i]
-        if len(pk) != 32 or len(sig) != 64:
-            valid[i] = False
-            pk_parts.append(b"\x00" * 32)
-            sig_parts.append(b"\x00" * 64)
+        if not valid[i]:
             continue
-        pk_parts.append(pk)
-        sig_parts.append(sig)
         h_int = (
-            int.from_bytes(sha(sig[:32] + pk + bytes(msgs[i])).digest(), "little")
+            int.from_bytes(
+                sha(
+                    sig_arr[i, :32].tobytes()
+                    + pk_arr[i].tobytes()
+                    + bytes(msgs[i])
+                ).digest(),
+                "little",
+            )
             % L
         )
         h_arr[i] = np.frombuffer(h_int.to_bytes(32, "little"), np.uint8)
 
-    pk_arr = np.frombuffer(b"".join(pk_parts), np.uint8).reshape(n, 32)
-    sig_arr = np.frombuffer(b"".join(sig_parts), np.uint8).reshape(n, 64)
-    r_arr = sig_arr[:, :32]
-    s_arr = sig_arr[:, 32:]
-
-    # s < L, compared little-endian from the most significant byte down
-    diff = s_arr.astype(np.int16) - _L_BYTES_LE.astype(np.int16)
-    nz_mask = diff != 0
-    has_diff = nz_mask.any(axis=1)
-    # index of the most significant differing byte
-    msb_idx = 31 - nz_mask[:, ::-1].argmax(axis=1)
-    s_lt_l = has_diff & (diff[np.arange(n), msb_idx] < 0)
-    valid &= s_lt_l
-
-    ay = fe.bytes_to_limbs_np(pk_arr)
-    a_sign = (pk_arr[:, 31] >> 7).astype(np.int32)
-    r_y = fe.bytes_to_limbs_np(r_arr)
-    r_sign = (r_arr[:, 31] >> 7).astype(np.int32)
-    s_digits = _digits_msb_first(s_arr)
+    out = _pack_points(pk_arr, sig_arr)
     h_digits = _digits_msb_first(h_arr)
-    return ay, a_sign, r_y, r_sign, s_digits, h_digits, valid
+    return out + (h_digits, valid)
+
+
+def prepare_batch_device_hash(
+    pub_keys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+):
+    """Host-side packing for the device-hash mode: no hashing at all on
+    the host — R ‖ A ‖ M is padded into SHA-512 blocks (bulk numpy) and
+    the kernel does the rest."""
+    from cometbft_tpu.crypto.tpu import sha512
+
+    pk_arr, sig_arr, valid = _parse_inputs(pub_keys, sigs)
+    hash_msgs = [
+        sig_arr[i, :32].tobytes() + pk_arr[i].tobytes() + bytes(msgs[i])
+        for i in range(len(pub_keys))
+    ]
+    msg_hi, msg_lo, nblocks = sha512.pad_ragged_np(hash_msgs)
+    out = _pack_points(pk_arr, sig_arr)
+    return out + (msg_hi, msg_lo, nblocks, valid)
+
+
+def hash_mode() -> str:
+    import os
+
+    mode = os.environ.get("CBFT_TPU_HASH", "host")
+    if mode not in ("host", "device"):
+        raise ValueError(
+            f"unknown CBFT_TPU_HASH={mode!r}; choose from ['device', 'host']"
+        )
+    return mode
 
 
 def verify_batch(
@@ -344,9 +426,13 @@ def verify_batch(
     n = len(pub_keys)
     if n == 0:
         return []
-    ay, a_sign, r_y, r_sign, s_digits, h_digits, valid = prepare_batch(
-        pub_keys, msgs, sigs
-    )
+    device_hash = hash_mode() == "device"
+    if device_hash:
+        (*packed, valid) = prepare_batch_device_hash(pub_keys, msgs, sigs)
+        kernel = verify_full_kernel
+    else:
+        (*packed, valid) = prepare_batch(pub_keys, msgs, sigs)
+        kernel = verify_kernel
 
     out = np.zeros(n, bool)
     pending = []  # dispatch everything first: device chunks overlap host
@@ -355,13 +441,12 @@ def verify_batch(
         size = _pad_size(end - start)
 
         def pad(a):
-            padded = np.zeros((size,) + a.shape[1:], a.dtype)
-            padded[: end - start] = a[start:end]
+            # batch is the trailing axis for every kernel input
+            padded = np.zeros(a.shape[:-1] + (size,), a.dtype)
+            padded[..., : end - start] = a[..., start:end]
             return padded
 
-        mask = verify_kernel(
-            pad(ay), pad(a_sign), pad(r_y), pad(r_sign), pad(s_digits), pad(h_digits)
-        )
+        mask = kernel(*(pad(a) for a in packed))
         pending.append((start, end, mask))
     for start, end, mask in pending:
         out[start:end] = np.asarray(mask)[: end - start]

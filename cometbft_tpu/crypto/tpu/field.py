@@ -3,19 +3,25 @@
 Design notes (tpu-first, not a port — the reference delegates all field math
 to assembly in golang.org/x/crypto; there is no Go source to mirror):
 
-* A field element is ``int32[..., 17]`` — seventeen little-endian
+* A field element is ``int32[17, B]`` — seventeen little-endian
   radix-2^15 limbs in a *redundant signed* representation: limbs live in
   [-4, 2^15 + 127] rather than strictly [0, 2^15). The slack is what makes
   the representation SIMD-friendly: carries are resolved by 1-3
   *vectorized* rounds over the whole limb axis (`_carry_round`) instead of
-  a sequential 17-step scan, so every op is a handful of wide [batch, 17]
+  a sequential 17-step scan, so every op is a handful of wide [17, B]
   VPU instructions. Exact bounds are proven per-op below; limb products
   (2^15+127)^2 < 2^31 stay inside native int32 multiplies.
+* **Limb-major layout**: the limb axis is axis 0 and the batch axis is
+  the trailing (minor-most) axis. XLA's TPU layout maps the minor-most
+  dimension onto the 128-wide vector lanes — with the batch there, every
+  elementwise op runs at full lane occupancy. (The previous [B, 17]
+  layout put the 17 limbs on the lanes: a ≤13% utilization ceiling on
+  every instruction of the kernel.)
 * 17 × 15 = 255 bits exactly, so the carry out of the top limb has weight
   2^255 ≡ 19 (mod p) — the cheapest possible fold.
-* All ops are batch-aware over leading dimensions: the whole point is to
-  verify thousands of signatures as one SPMD tensor program. The batch
-  dimension is explicit so pjit/shard_map can shard it over an ICI mesh.
+* The batch axis is explicit (and trailing) so pjit/shard_map can shard
+  it over an ICI mesh: the whole point is to verify thousands of
+  signatures as one SPMD tensor program.
 * Only `to_canonical` produces the unique representative mod p, and only
   where encoding/comparison semantics require it (matching the ref10
   fe_frombytes convention the CPU backend's OpenSSL inherits:
@@ -44,6 +50,8 @@ NUM_LIMBS = 17
 RADIX = 15
 _MASK = 0x7FFF
 
+LIMB_AXIS = 0  # documented contract: fe = int32[NUM_LIMBS, *batch]
+
 
 def int_to_limbs(n: int) -> List[int]:
     return [(n >> (RADIX * i)) & _MASK for i in range(NUM_LIMBS)]
@@ -57,13 +65,16 @@ def limbs_to_int(limbs) -> int:
 
 
 def const_fe(n: int) -> jnp.ndarray:
-    """A field-element constant (rank-1; broadcasts against any batch)."""
-    return jnp.array(int_to_limbs(n % P), jnp.int32)
+    """A field-element constant: int32[17, 1] — broadcasts against the
+    trailing batch axis of any [17, B] element."""
+    return jnp.array(int_to_limbs(n % P), jnp.int32)[:, None]
 
 
 # 4p = 2^257 - 76 as signed radix-2^15 columns (2^257 = 2^17 · 2^(15·16)).
-_FOUR_P_COLS = jnp.zeros(NUM_LIMBS, jnp.int32).at[0].add(-76).at[16].add(0x20000)
-_P_LIMBS = jnp.array(int_to_limbs(P), jnp.int32)
+_FOUR_P_COLS = (
+    jnp.zeros(NUM_LIMBS, jnp.int32).at[0].add(-76).at[16].add(0x20000)[:, None]
+)
+_P_LIMBS = jnp.array(int_to_limbs(P), jnp.int32)[:, None]
 
 
 def _carry_round(x: jnp.ndarray) -> jnp.ndarray:
@@ -73,7 +84,7 @@ def _carry_round(x: jnp.ndarray) -> jnp.ndarray:
     """
     c = x >> RADIX
     return (x & _MASK) + jnp.concatenate(
-        [19 * c[..., NUM_LIMBS - 1 :], c[..., : NUM_LIMBS - 1]], axis=-1
+        [19 * c[NUM_LIMBS - 1 :], c[: NUM_LIMBS - 1]], axis=0
     )
 
 
@@ -106,41 +117,88 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def _mul_stack(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Outer-product form: materializes a [..., 17, 17] (and a stacked
-    [..., 34, 34]) intermediate per multiply — compact trace, but in a
-    long kernel each mul round-trips ~10 MB through HBM at batch 2048,
-    making every point operation bandwidth-bound."""
-    prod = a[..., :, None] * b[..., None, :]  # [..., 17, 17]
+    """Outer-product form: materializes a [17, 17, B] (and a stacked
+    [34, B]-column) intermediate per multiply — compact trace; at large
+    batch each mul round-trips the outer product through HBM, making the
+    point operations bandwidth-bound. Kept as a CBFT_TPU_MUL variant for
+    on-chip A/B timing."""
+    prod = a[:, None] * b[None, :]  # [17, 17, B]
     lo = prod & _MASK
     hi = prod >> RADIX
-    batch = prod.shape[:-2]
     width = 2 * NUM_LIMBS  # 34 columns: lo_i spans i..i+16, hi_i spans i+1..i+17
+    tail_pad = [(0, 0)] * (a.ndim - 1)
     rows = []
-    pad_cfg = [(0, 0)] * len(batch)
     for i in range(NUM_LIMBS):
-        rows.append(jnp.pad(lo[..., i, :], pad_cfg + [(i, width - NUM_LIMBS - i)]))
-        rows.append(jnp.pad(hi[..., i, :], pad_cfg + [(i + 1, width - NUM_LIMBS - i - 1)]))
-    cols = jnp.sum(jnp.stack(rows, axis=-2), axis=-2)
-    folded = cols[..., :NUM_LIMBS] + 19 * cols[..., NUM_LIMBS:]
+        rows.append(jnp.pad(lo[i], [(i, width - NUM_LIMBS - i)] + tail_pad))
+        rows.append(
+            jnp.pad(hi[i], [(i + 1, width - NUM_LIMBS - i - 1)] + tail_pad)
+        )
+    cols = jnp.sum(jnp.stack(rows, axis=0), axis=0)
+    folded = cols[:NUM_LIMBS] + 19 * cols[NUM_LIMBS:]
     return _reduce(folded)
 
 
 def _mul_shift_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Shift-accumulate form: 17 × (one [..., 17] vector product padded
-    into a [..., 34] accumulator). Largest live tensor is the accumulator
+    """Shift-accumulate form: 17 × (one [17, B] vector product padded
+    into a [34, B] accumulator). Largest live tensor is the accumulator
     itself — the whole multiply stays fusable in registers/VMEM lanes, no
     big HBM intermediates."""
     width = 2 * NUM_LIMBS
-    batch_pad = [(0, 0)] * (a.ndim - 1)
+    tail_pad = [(0, 0)] * (a.ndim - 1)
     acc = None
     for i in range(NUM_LIMBS):
-        p = a[..., i : i + 1] * b  # [..., 17]
-        term = jnp.pad(p & _MASK, batch_pad + [(i, width - NUM_LIMBS - i)])
+        p = a[i : i + 1] * b  # [17, B]
+        term = jnp.pad(p & _MASK, [(i, width - NUM_LIMBS - i)] + tail_pad)
         term = term + jnp.pad(
-            p >> RADIX, batch_pad + [(i + 1, width - NUM_LIMBS - i - 1)]
+            p >> RADIX, [(i + 1, width - NUM_LIMBS - i - 1)] + tail_pad
         )
         acc = term if acc is None else acc + term
-    folded = acc[..., :NUM_LIMBS] + 19 * acc[..., NUM_LIMBS:]
+    folded = acc[:NUM_LIMBS] + 19 * acc[NUM_LIMBS:]
+    return _reduce(folded)
+
+
+def _fold_matrices():
+    """Constant [17, 289] int32 matrices folding the flattened outer
+    product (lo and hi 15-bit parts) straight into the 17 output columns:
+    entry (k, 17i+j) is the weight of a_i·b_j's part in column k — 1 on
+    its own column c, 19 on c-17 (2^255 ≡ 19). Precomposing the
+    column-fold into the scatter matrix turns the whole schoolbook
+    multiply into two matmuls."""
+    import numpy as np
+
+    m_lo = np.zeros((NUM_LIMBS, NUM_LIMBS * NUM_LIMBS), np.int32)
+    m_hi = np.zeros((NUM_LIMBS, NUM_LIMBS * NUM_LIMBS), np.int32)
+    for i in range(NUM_LIMBS):
+        for j in range(NUM_LIMBS):
+            idx = i * NUM_LIMBS + j
+            for m, c in ((m_lo, i + j), (m_hi, i + j + 1)):
+                if c < NUM_LIMBS:
+                    m[c, idx] = 1
+                else:
+                    m[c - NUM_LIMBS, idx] = 19
+    return m_lo, m_hi
+
+
+_M_LO, _M_HI = _fold_matrices()
+
+
+def _mul_matmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Constant-matrix form: outer product → two [17, 289] × [289, B]
+    int32 matmuls against precomposed fold matrices. ~8× fewer HLO ops
+    than the unrolled forms — the XLA CPU backend compiles the full
+    verify kernel super-linearly in graph size (measured 909 s with
+    shift_add), so this is the compile-friendly variant; on TPU the int32
+    dots bypass the MXU, so runtime there must be A/B-timed on chip
+    (CBFT_TPU_MUL) against shift_add.
+
+    Column bound: per output limb ≤ 17 unit + 17 ×19 contributions of
+    |part| < 2^16 → < 2^25, inside the _reduce precondition and exact in
+    int32 accumulation."""
+    flat = NUM_LIMBS * NUM_LIMBS
+    prod = a[:, None] * b[None, :]  # [17, 17, B]
+    lo = (prod & _MASK).reshape((flat,) + prod.shape[2:])
+    hi = (prod >> RADIX).reshape((flat,) + prod.shape[2:])
+    folded = jnp.asarray(_M_LO) @ lo + jnp.asarray(_M_HI) @ hi
     return _reduce(folded)
 
 
@@ -148,15 +206,32 @@ def _mul_shift_add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 # splits into a 15-bit low part and a signed high part before column
 # accumulation, keeping columns ≤ 34·(2^15+2^8) < 2^21; the fold of
 # columns 17..33 (weight 2^255 ≡ 19) brings them to < 2^25 — the
-# _reduce precondition. Both implementations share this bound analysis.
-_MUL_IMPLS = {"stack": _mul_stack, "shift_add": _mul_shift_add}
+# _reduce precondition. All implementations share this bound analysis.
+_MUL_IMPLS = {
+    "stack": _mul_stack,
+    "shift_add": _mul_shift_add,
+    "matmul": _mul_matmul,
+}
+
+
+def default_mul_impl() -> str:
+    """Platform-sensitive default: the matmul form on CPU (fast XLA
+    compile — the CPU path exists for tests and the bench's wedge
+    fallback), shift_add on TPU until on-chip timing says otherwise."""
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except Exception:  # backend init failure — any form works
+        backend = "cpu"
+    return "matmul" if backend == "cpu" else "shift_add"
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook 17×15-bit-limb multiply in native int32 lanes."""
     import os
 
-    name = os.environ.get("CBFT_TPU_MUL", "shift_add")
+    name = os.environ.get("CBFT_TPU_MUL") or default_mul_impl()
     impl = _MUL_IMPLS.get(name)
     if impl is None:
         raise ValueError(
@@ -179,12 +254,12 @@ def _carry_seq(x: jnp.ndarray):
     """Exact sequential carry pass (only used by to_canonical — the rare
     encode/compare path). Returns (limbs in [0, 2^15), carry_out)."""
     out = []
-    carry = jnp.zeros(x.shape[:-1], jnp.int32)
+    carry = jnp.zeros(x.shape[1:], jnp.int32)
     for i in range(NUM_LIMBS):
-        t = x[..., i] + carry
+        t = x[i] + carry
         out.append(t & _MASK)
         carry = t >> RADIX
-    return jnp.stack(out, axis=-1), carry
+    return jnp.stack(out, axis=0), carry
 
 
 def to_canonical(x: jnp.ndarray) -> jnp.ndarray:
@@ -193,66 +268,80 @@ def to_canonical(x: jnp.ndarray) -> jnp.ndarray:
     # second < 2^255 (the +19 can set bit 255 only for values < 2^255+19).
     for _ in range(2):
         x, c = _carry_seq(x)
-        x = x.at[..., 0].add(19 * c)
+        x = x.at[0].add(19 * c)
         x, _ = _carry_seq(x)
     # Conditionally subtract p (value < 2^255 < 2p ⇒ at most once).
     diff, borrow = _carry_seq(x - _P_LIMBS)
-    return jnp.where((borrow == 0)[..., None], diff, x)
+    return jnp.where((borrow == 0)[None], diff, x)
 
 
 def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Constant-shape equality in the field → bool[batch]."""
-    return jnp.all(to_canonical(a) == to_canonical(b), axis=-1)
+    return jnp.all(to_canonical(a) == to_canonical(b), axis=0)
 
 
 def is_zero(a: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(to_canonical(a) == 0, axis=-1)
+    return jnp.all(to_canonical(a) == 0, axis=0)
 
 
 def select(pred: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """pred: bool[batch] → element-wise fe select (a where pred)."""
-    return jnp.where(pred[..., None], a, b)
+    return jnp.where(pred[None], a, b)
 
 
-def _exp_bits(e: int) -> jnp.ndarray:
-    bits = [int(b) for b in bin(e)[2:]]  # MSB first
-    return jnp.array(bits, jnp.int32)
-
-
-_INV_BITS = _exp_bits(P - 2)
-_P58_BITS = _exp_bits((P - 5) // 8)
-
-
-def _pow_bits(x: jnp.ndarray, bits: jnp.ndarray) -> jnp.ndarray:
-    """Square-and-multiply with a static-length constant exponent.
-
-    Runs as a fori_loop so the (large) exponent chain compiles to one
-    rolled body; the conditional multiply is a where-select, keeping the
-    program free of data-dependent branching.
-    """
-    one = const_fe(1)
-    acc0 = jnp.broadcast_to(one, x.shape)
-
-    def body(i, acc):
-        acc = mul(acc, acc)
-        return jnp.where(bits[i] == 1, mul(acc, x), acc)
-
-    return lax.fori_loop(0, bits.shape[0], body, acc0)
+def _sq_n(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """n squarings in a row. Rolled into a fori_loop so the long runs in
+    the inversion addition chains (up to 100) stay one compiled body."""
+    if n <= 2:
+        for _ in range(n):
+            x = sq(x)
+        return x
+    return lax.fori_loop(0, n, lambda i, v: sq(v), x)
 
 
 def invert(x: jnp.ndarray) -> jnp.ndarray:
-    """x^(p-2). invert(0) = 0 (harmless: used only on Z ≠ 0)."""
-    return _pow_bits(x, _INV_BITS)
+    """x^(p-2) = x^(2^255-21) by the ref10 addition chain: 254 squarings
+    + 11 multiplies — versus ~254 squarings + 254 always-computed
+    conditional multiplies for generic square-and-multiply. invert(0) = 0
+    (harmless: used only on Z ≠ 0)."""
+    t0 = sq(x)  # 2
+    t1 = mul(x, _sq_n(t0, 2))  # 9
+    t2 = mul(t0, t1)  # 11
+    t3 = sq(t2)  # 22
+    t3 = mul(t1, t3)  # 31 = 2^5-1
+    t4 = mul(_sq_n(t3, 5), t3)  # 2^10-1
+    t5 = mul(_sq_n(t4, 10), t4)  # 2^20-1
+    t6 = mul(_sq_n(t5, 20), t5)  # 2^40-1
+    t5 = mul(_sq_n(t6, 10), t4)  # 2^50-1
+    t6 = mul(_sq_n(t5, 50), t5)  # 2^100-1
+    t7 = mul(_sq_n(t6, 100), t6)  # 2^200-1
+    t6 = mul(_sq_n(t7, 50), t5)  # 2^250-1
+    return mul(_sq_n(t6, 5), t2)  # (2^250-1)·2^5 + 11 = 2^255-21
 
 
 def pow_p58(x: jnp.ndarray) -> jnp.ndarray:
-    """x^((p-5)/8) — the square-root-ratio exponent for decompression."""
-    return _pow_bits(x, _P58_BITS)
+    """x^((p-5)/8) = x^(2^252-3) — the square-root-ratio exponent for
+    decompression, by the ref10 fe_pow22523 addition chain."""
+    t0 = sq(x)  # 2
+    t1 = mul(x, _sq_n(t0, 2))  # 9
+    t0 = mul(t0, t1)  # 11
+    t0 = sq(t0)  # 22
+    t0 = mul(t1, t0)  # 31 = 2^5-1
+    t1 = mul(_sq_n(t0, 5), t0)  # 2^10-1
+    t2 = mul(_sq_n(t1, 10), t1)  # 2^20-1
+    t3 = mul(_sq_n(t2, 20), t2)  # 2^40-1
+    t2 = mul(_sq_n(t3, 10), t1)  # 2^50-1
+    t3 = mul(_sq_n(t2, 50), t2)  # 2^100-1
+    t4 = mul(_sq_n(t3, 100), t3)  # 2^200-1
+    t3 = mul(_sq_n(t4, 50), t2)  # 2^250-1
+    return mul(_sq_n(t3, 2), x)  # (2^250-1)·4 + 3 = 2^252-3
 
 
 def bytes_to_limbs_np(data):
     """numpy uint8[..., 32] → int32[..., 17] limbs of the low 255 bits
-    (bit 255 — the ed25519 sign bit — is excluded; handle it separately)."""
+    (bit 255 — the ed25519 sign bit — is excluded; handle it separately).
+    NOTE: host-side helper; the limb axis lands LAST here — transpose to
+    limb-major before feeding the kernel."""
     import numpy as np
 
     b = np.asarray(data, dtype=np.uint8)

@@ -1,0 +1,194 @@
+"""Mod-L scalar reduction on device — the sc_reduce step of Ed25519.
+
+h = SHA-512(R ‖ A ‖ M) is a 512-bit little-endian integer that must be
+reduced mod L = 2^252 + c (c < 2^125) EXACTLY: cofactorless verification
+computes [h](-A) with the canonical residue, and for pubkeys with a
+torsion component h and h + kL give different results — so parity with
+the CPU verifier (ref10 sc_reduce semantics) requires the true mod.
+
+Representation: little-endian radix-2^15 limbs in int32 lanes, batch on
+the trailing (lane) axis — the same layout as field.py. Reduction is
+ref10-style *signed* folding: 2^255 ≡ -8c (mod L), so a 512-bit value
+folds as x0 - 8c·x1 with limb-aligned splits (255 = 17 limbs exactly);
+three folds bring |x| under ~2^256, one +8L offset makes it nonnegative,
+a final fold at 2^252 (2^252 ≡ -c) plus two conditional subtracts lands
+in [0, L). All products split into 15-bit lo / signed hi parts before
+column accumulation, so every intermediate fits int32 (the field.py
+bound argument, reused).
+
+The output feeds the Straus loop directly: `digits_msb_first` turns the
+canonical 17-limb scalar into the kernel's int32[127, B] 2-bit digit
+plane with static shifts only.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+L = 2**252 + 27742317777372353535851937790883648493
+_C = L - 2**252  # 125 bits
+_C8 = 8 * _C  # 128 bits
+
+RADIX = 15
+_MASK = 0x7FFF
+NUM_LIMBS = 17  # of the reduced output (255 bits)
+
+
+def _int_to_limbs(n: int, count: int) -> List[int]:
+    return [(n >> (RADIX * i)) & _MASK for i in range(count)]
+
+
+_C8_LIMBS = _int_to_limbs(_C8, 9)
+_C_LIMBS = _int_to_limbs(_C, 9)
+_L8_LIMBS = _int_to_limbs(8 * L, 18)
+_L_LIMBS = np.array(_int_to_limbs(L, NUM_LIMBS), np.int32)
+
+
+def _mul_const(x: List[jnp.ndarray], k_limbs: List[int]) -> List[jnp.ndarray]:
+    """Signed limb vector × small nonneg constant → signed columns, with
+    each product split into (lo 15 bits, signed hi) before accumulation so
+    columns stay well inside int32: |col| ≤ (len(x)+len(k))·2^15·~2 —
+    < 2^21 for every call here."""
+    out_len = len(x) + len(k_limbs)
+    cols = [None] * out_len
+
+    def acc(idx, v):
+        cols[idx] = v if cols[idx] is None else cols[idx] + v
+
+    for j, k in enumerate(k_limbs):
+        if k == 0:
+            continue
+        kc = jnp.int32(k)
+        for i, xi in enumerate(x):
+            p = xi * kc  # |xi| < 2^16, k < 2^15 → |p| < 2^31 ✓
+            acc(i + j, p & _MASK)
+            acc(i + j + 1, p >> RADIX)
+    zero = jnp.zeros_like(x[0])
+    return [zero if c is None else c for c in cols]
+
+
+def _carry_signed(cols: List[jnp.ndarray]) -> List[jnp.ndarray]:
+    """Sequential signed carry: limbs end in [0, 2^15) except the top,
+    which absorbs the remaining (possibly negative) carry. Value-exact."""
+    out = []
+    carry = jnp.zeros_like(cols[0])
+    for i in range(len(cols) - 1):
+        t = cols[i] + carry
+        out.append(t & _MASK)
+        carry = t >> RADIX
+    out.append(cols[-1] + carry)  # top limb keeps the full signed carry
+    return out
+
+
+def _sub_into(
+    base: List[jnp.ndarray], prod: List[jnp.ndarray]
+) -> List[jnp.ndarray]:
+    n = max(len(base), len(prod))
+    zero = jnp.zeros_like(base[0])
+    return [
+        (base[i] if i < len(base) else zero) - (prod[i] if i < len(prod) else zero)
+        for i in range(n)
+    ]
+
+
+def sc_reduce(limbs: List[jnp.ndarray]) -> jnp.ndarray:
+    """35 nonneg radix-2^15 limbs (a 512-bit value, each limb [B]) →
+    canonical int32[17, B] scalar in [0, L)."""
+    # fold 1: x = x1·2^255 + x0 ≡ x0 - 8c·x1   (x1: 18 limbs < 2^257)
+    x0, x1 = limbs[:17], limbs[17:35]
+    r = _sub_into(x0, _mul_const(x1, _C8_LIMBS))  # 27 cols, |val| < 2^386
+    r = _carry_signed(r)
+
+    # fold 2: |r1| < 2^131 (r[17:27], low limbs canonical + signed top)
+    r = _sub_into(r[:17], _mul_const(r[17:], _C8_LIMBS))
+    r = _carry_signed(r)  # |val| < 2^255 + 2^(131+128) < 2^260
+
+    # fold 3: |r1| < 2^5 (two limbs at most)
+    r = _sub_into(r[:17], _mul_const(r[17:], _C8_LIMBS))
+    r = _carry_signed(r)  # |val| < 2^255 + 2^(5+128+15) < 2^256
+    # make nonnegative: + 8L > 2^255+2^128 > |val|
+    zero = jnp.zeros_like(r[0])
+    r = r + [zero] * (18 - len(r))
+    r = [ri + jnp.int32(l8) for ri, l8 in zip(r, _L8_LIMBS)]
+    r = _carry_signed(r)  # canonical nonneg; value < 2^256 + 8L < 2^257
+
+    # final fold at 2^252 (2^252 ≡ -c): v1 = v >> 252 < 2^5, 252 = 16·15+12
+    top = r[17] if len(r) > 17 else zero
+    v1 = (r[16] >> 12) + (top << 3)
+    r[16] = r[16] & 0x0FFF
+    r = _sub_into(r[:17], _mul_const([v1], _C_LIMBS))[:17]
+    # |val| < 2^252 + 2^(15+125) ; + L ≥ 2^252 + c·2^15 makes it nonneg
+    # and the result < L + 2^252 + 2^140 < 3L
+    r = [ri + jnp.int32(l) for ri, l in zip(r, _int_to_limbs(L, 17))]
+    r = _carry_signed(r)
+
+    v = jnp.stack(r, axis=0)  # int32[17, B] canonical nonneg, < 3L
+    # conditional subtract L (at most twice)
+    l_arr = jnp.asarray(_L_LIMBS)[:, None]
+    for _ in range(2):
+        diff, borrow = _borrow_sub(v, l_arr)
+        v = jnp.where((borrow == 0)[None], diff, v)
+    return v
+
+
+def _borrow_sub(a: jnp.ndarray, b: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Canonical-limb subtract with sequential borrow → (diff, borrow_out)."""
+    out = []
+    borrow = jnp.zeros(a.shape[1:], jnp.int32)
+    for i in range(a.shape[0]):
+        t = a[i] - (b[i] if b.shape[0] > i else 0) - borrow
+        out.append(t & _MASK)
+        borrow = (t >> RADIX) & 1  # t ∈ (-2^16, 2^15): borrow is 0 or 1
+    return jnp.stack(out, axis=0), borrow
+
+
+def digest_to_limbs(dig_hi: jnp.ndarray, dig_lo: jnp.ndarray) -> List[jnp.ndarray]:
+    """SHA-512 digest words (hi u32[8, B], lo u32[8, B], big-endian within
+    each 64-bit word) → 35 little-endian radix-2^15 limbs (int32[B] each)
+    of the digest read as a little-endian 512-bit integer."""
+
+    def bswap(x):
+        return (
+            ((x & 0xFF) << 24)
+            | ((x & 0xFF00) << 8)
+            | ((x >> 8) & 0xFF00)
+            | (x >> 24)
+        )
+
+    # little-endian u32 words of the integer: v[2j] = bswap(hi_j) covers
+    # digest bytes 8j..8j+3, v[2j+1] = bswap(lo_j)
+    v = []
+    for j in range(8):
+        v.append(bswap(dig_hi[j]))
+        v.append(bswap(dig_lo[j]))
+    v.append(jnp.zeros_like(v[0]))  # padding word for the top limb reads
+
+    limbs = []
+    for k in range(35):
+        bit = RADIX * k
+        m, off = bit // 32, bit % 32
+        word = v[m] >> np.uint32(off)
+        if off > 32 - RADIX:
+            word = word | (v[m + 1] << np.uint32(32 - off))
+        limbs.append((word & np.uint32(_MASK)).astype(jnp.int32))
+    return limbs
+
+
+def digits_msb_first(scalar: jnp.ndarray) -> jnp.ndarray:
+    """Canonical int32[17, B] scalar (< 2^253) → int32[127, B] 2-bit
+    digits, most significant digit first — the Straus loop's input plane.
+    Purely static shifts: digit k covers bits (2k, 2k+1)."""
+    rows = []
+    for k in range(127):
+        bit = 2 * k
+        j, off = bit // RADIX, bit % RADIX
+        if off <= RADIX - 2:
+            d = (scalar[j] >> off) & 3
+        else:  # the digit straddles limbs j, j+1 (off == 14)
+            d = ((scalar[j] >> 14) & 1) | ((scalar[j + 1] & 1) << 1)
+        rows.append(d)
+    rows.reverse()  # MSB first
+    return jnp.stack(rows, axis=0)

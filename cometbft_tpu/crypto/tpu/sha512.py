@@ -1,0 +1,254 @@
+"""Batched SHA-512 in JAX — 64-bit lanes emulated as uint32 (hi, lo)
+pairs, no data-dependent control flow; the whole batch is one fused XLA
+program.
+
+Purpose: move the Ed25519 h = SHA-512(R ‖ A ‖ M) hash on-device
+(SURVEY.md §7 stage 3 — "SHA-512 needs 64-bit rotates emulated in
+2×u32"), so the only host work per signature is byte packing. Messages
+are padded host-side (`pad_ragged_np`) into a uniform block count per
+batch; each lane carries its own live block count, so mixed-length
+messages (commit sign-bytes vary by a few bytes across rounds) share one
+compiled kernel.
+
+Reference baseline being replaced: per-signature `crypto/sha512`
+(stdlib, one call at a time) under ed25519's verify —
+crypto/ed25519/ed25519.go:148.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_K64 = [
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F, 0xE9B5DBA58189DBBC,
+    0x3956C25BF348B538, 0x59F111F1B605D019, 0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118,
+    0xD807AA98A3030242, 0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235, 0xC19BF174CF692694,
+    0xE49B69C19EF14AD2, 0xEFBE4786384F25E3, 0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65,
+    0x2DE92C6F592B0275, 0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F, 0xBF597FC7BEEF0EE4,
+    0xC6E00BF33DA88FC2, 0xD5A79147930AA725, 0x06CA6351E003826F, 0x142929670A0E6E70,
+    0x27B70A8546D22FFC, 0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6, 0x92722C851482353B,
+    0xA2BFE8A14CF10364, 0xA81A664BBC423001, 0xC24B8B70D0F89791, 0xC76C51A30654BE30,
+    0xD192E819D6EF5218, 0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99, 0x34B0BCB5E19B48A8,
+    0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB, 0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3,
+    0x748F82EE5DEFB2FC, 0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915, 0xC67178F2E372532B,
+    0xCA273ECEEA26619C, 0xD186B8C721C0C207, 0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178,
+    0x06F067AA72176FBA, 0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC, 0x431D67C49C100D4C,
+    0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A, 0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+]
+_K_HI = np.array([k >> 32 for k in _K64], np.uint32)
+_K_LO = np.array([k & 0xFFFFFFFF for k in _K64], np.uint32)
+
+_IV64 = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+_IV_HI = np.array([v >> 32 for v in _IV64], np.uint32)
+_IV_LO = np.array([v & 0xFFFFFFFF for v in _IV64], np.uint32)
+
+U64 = Tuple[jnp.ndarray, jnp.ndarray]  # (hi, lo) uint32 pair
+
+
+def _add64(a: U64, b: U64) -> U64:
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(jnp.uint32)
+    return (a[0] + b[0] + carry, lo)
+
+
+def _add64n(*xs: U64) -> U64:
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = _add64(acc, x)
+    return acc
+
+
+def _rotr64(x: U64, n: int) -> U64:
+    hi, lo = x
+    if n == 32:
+        return (lo, hi)
+    if n > 32:
+        hi, lo, n = lo, hi, n - 32
+    nl = np.uint32(n)
+    nr = np.uint32(32 - n)
+    return ((hi >> nl) | (lo << nr), (lo >> nl) | (hi << nr))
+
+
+def _shr64(x: U64, n: int) -> U64:
+    hi, lo = x
+    nl = np.uint32(n)
+    nr = np.uint32(32 - n)
+    return (hi >> nl, (lo >> nl) | (hi << nr))
+
+
+def _xor64(*xs: U64) -> U64:
+    hi, lo = xs[0]
+    for x in xs[1:]:
+        hi, lo = hi ^ x[0], lo ^ x[1]
+    return (hi, lo)
+
+
+def _compress(state: List[U64], block_hi: jnp.ndarray, block_lo: jnp.ndarray) -> List[U64]:
+    """state: 8 × (hi[B], lo[B]); block u32[16, B] hi/lo → new state.
+
+    One fori_loop over the 80 rounds with the message schedule computed
+    in-loop from a 16-word circular window. An unrolled schedule (the
+    textbook form) builds a deep×wide 64-bit carry DAG that sends an XLA
+    CPU pass super-linear — measured 1.5s/4.6s/10.2s to compile at
+    24/32/40 schedule entries; the windowed loop compiles in seconds and
+    is the same arithmetic."""
+    from jax import lax
+
+    k_hi = jnp.asarray(_K_HI)
+    k_lo = jnp.asarray(_K_LO)
+
+    def round_fn(i, carry):
+        vals, win_hi, win_lo = carry
+        a, b, c, d, e, f, g, h = [
+            (vals[2 * j], vals[2 * j + 1]) for j in range(8)
+        ]
+        idx = i % 16
+        # schedule word: for i < 16 the window still holds the block word
+        # at idx; for i >= 16 extend the recurrence. Computing both and
+        # selecting keeps the loop branch-free (writing the selected word
+        # back to slot idx is a value-level no-op for i < 16).
+        w16 = (win_hi[idx], win_lo[idx])  # w[i-16] (== w[i] when i < 16)
+        wm15 = (win_hi[(i - 15) % 16], win_lo[(i - 15) % 16])
+        wm7 = (win_hi[(i - 7) % 16], win_lo[(i - 7) % 16])
+        wm2 = (win_hi[(i - 2) % 16], win_lo[(i - 2) % 16])
+        s0 = _xor64(_rotr64(wm15, 1), _rotr64(wm15, 8), _shr64(wm15, 7))
+        s1 = _xor64(_rotr64(wm2, 19), _rotr64(wm2, 61), _shr64(wm2, 6))
+        ext = _add64n(w16, s0, wm7, s1)
+        first16 = i < 16
+        w = (
+            jnp.where(first16, w16[0], ext[0]),
+            jnp.where(first16, w16[1], ext[1]),
+        )
+        win_hi = win_hi.at[idx].set(w[0])
+        win_lo = win_lo.at[idx].set(w[1])
+
+        s1e = _xor64(_rotr64(e, 14), _rotr64(e, 18), _rotr64(e, 41))
+        ch = (
+            (e[0] & f[0]) ^ (~e[0] & g[0]),
+            (e[1] & f[1]) ^ (~e[1] & g[1]),
+        )
+        t1 = _add64n(h, s1e, ch, (k_hi[i], k_lo[i]), w)
+        s0a = _xor64(_rotr64(a, 28), _rotr64(a, 34), _rotr64(a, 39))
+        maj = (
+            (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+            (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+        )
+        t2 = _add64(s0a, maj)
+        na = _add64(t1, t2)
+        ne = _add64(d, t1)
+        out = [na, a, b, c, ne, e, f, g]
+        return (
+            tuple(x for pair in out for x in pair),
+            win_hi,
+            win_lo,
+        )
+
+    flat = tuple(x for pair in state for x in pair)
+    flat, _, _ = lax.fori_loop(0, 80, round_fn, (flat, block_hi, block_lo))
+    new = [(flat[2 * j], flat[2 * j + 1]) for j in range(8)]
+    return [_add64(s, n) for s, n in zip(state, new)]
+
+
+@partial(jax.jit, static_argnames=())
+def sha512_blocks(
+    blocks_hi: jnp.ndarray,  # u32[n_blocks, 16, B] BE word-halves
+    blocks_lo: jnp.ndarray,  # u32[n_blocks, 16, B]
+    n_live: jnp.ndarray,  # int32[B] — live block count per lane
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """→ digests (hi u32[8, B], lo u32[8, B]).
+
+    Every lane runs all n_blocks compressions; lanes whose message has
+    fewer blocks keep their state unchanged past their own count — the
+    branch-free way to batch mixed-length messages in one static shape.
+    """
+    batch = blocks_hi.shape[-1]
+    state: List[U64] = [
+        (
+            jnp.broadcast_to(jnp.uint32(_IV_HI[j]), (batch,)),
+            jnp.broadcast_to(jnp.uint32(_IV_LO[j]), (batch,)),
+        )
+        for j in range(8)
+    ]
+    for i in range(blocks_hi.shape[0]):  # small static count — unrolled
+        new = _compress(state, blocks_hi[i], blocks_lo[i])
+        live = i < n_live  # bool[B]
+        state = [
+            (
+                jnp.where(live, n[0], s[0]),
+                jnp.where(live, n[1], s[1]),
+            )
+            for s, n in zip(state, new)
+        ]
+    return (
+        jnp.stack([s[0] for s in state], axis=0),
+        jnp.stack([s[1] for s in state], axis=0),
+    )
+
+
+def pad_ragged_np(msgs: Sequence[bytes]):
+    """Host packing: variable-length messages → one fixed-shape batch.
+
+    Returns (blocks_hi u32[n_blocks, 16, B], blocks_lo, n_live int32[B])
+    where n_blocks = max over the batch. SHA-512 padding (0x80, zeros,
+    128-bit big-endian bit length) is baked in per message at its own
+    length, so the kernel needs no per-lane length logic beyond the live
+    block count."""
+    n = len(msgs)
+    lens = np.array([len(m) for m in msgs], np.int64)
+    nblocks = np.maximum((lens + 1 + 16 + 127) // 128, 1).astype(np.int32)
+    max_blocks = int(nblocks.max()) if n else 1
+    buf = np.zeros((n, max_blocks * 128), np.uint8)
+    for i, m in enumerate(msgs):
+        ln = lens[i]
+        buf[i, :ln] = np.frombuffer(bytes(m), np.uint8)
+        buf[i, ln] = 0x80
+        end = int(nblocks[i]) * 128
+        bit_len = int(ln) * 8
+        buf[i, end - 16 : end] = np.frombuffer(
+            bit_len.to_bytes(16, "big"), np.uint8
+        )
+    words = buf.reshape(n, max_blocks, 16, 8).astype(np.uint32)
+    hi = (
+        (words[..., 0] << 24) | (words[..., 1] << 16)
+        | (words[..., 2] << 8) | words[..., 3]
+    )
+    lo = (
+        (words[..., 4] << 24) | (words[..., 5] << 16)
+        | (words[..., 6] << 8) | words[..., 7]
+    )
+    # [B, n_blocks, 16] → [n_blocks, 16, B]: batch on the minor (lane) axis
+    return (
+        np.ascontiguousarray(np.moveaxis(hi, 0, -1)),
+        np.ascontiguousarray(np.moveaxis(lo, 0, -1)),
+        nblocks,
+    )
+
+
+def digests_to_bytes_np(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    """(hi u32[8, B], lo u32[8, B]) → uint8[B, 64] big-endian digests."""
+    hi = np.asarray(hi, np.uint32)
+    lo = np.asarray(lo, np.uint32)
+    b = hi.shape[-1]
+    out = np.zeros((b, 64), np.uint8)
+    for j in range(8):
+        for k, word in ((0, hi[j]), (4, lo[j])):
+            base = 8 * j + k
+            out[:, base] = word >> 24
+            out[:, base + 1] = (word >> 16) & 0xFF
+            out[:, base + 2] = (word >> 8) & 0xFF
+            out[:, base + 3] = word & 0xFF
+    return out

@@ -25,34 +25,42 @@ def _assert_parity(pks, msgs, sigs):
     return got
 
 
+def _fe1(n: int):
+    """One field element in the kernel's limb-major [17, 1] layout."""
+    import jax.numpy as jnp
+
+    return jnp.array(fe.int_to_limbs(n), jnp.int32)[:, None]
+
+
+def _fe_int(x) -> int:
+    return fe.limbs_to_int(np.asarray(fe.to_canonical(x))[:, 0])
+
+
 class TestField:
     def test_roundtrip_and_ops(self):
         rng = np.random.default_rng(7)
-        import jax.numpy as jnp
 
         for _ in range(20):
             a = int(rng.integers(0, 2**63)) * int(rng.integers(0, 2**63)) % fe.P
             b = int(rng.integers(0, 2**63)) ** 3 % fe.P
-            fa = jnp.array([fe.int_to_limbs(a)], jnp.int32)
-            fb = jnp.array([fe.int_to_limbs(b)], jnp.int32)
-            assert fe.limbs_to_int(np.asarray(fe.to_canonical(fe.add(fa, fb)))[0]) == (a + b) % fe.P
-            assert fe.limbs_to_int(np.asarray(fe.to_canonical(fe.sub(fa, fb)))[0]) == (a - b) % fe.P
-            assert fe.limbs_to_int(np.asarray(fe.to_canonical(fe.mul(fa, fb)))[0]) == (a * b) % fe.P
+            fa, fb = _fe1(a), _fe1(b)
+            assert _fe_int(fe.add(fa, fb)) == (a + b) % fe.P
+            assert _fe_int(fe.sub(fa, fb)) == (a - b) % fe.P
+            assert _fe_int(fe.mul(fa, fb)) == (a * b) % fe.P
 
     def test_invert(self):
-        import jax.numpy as jnp
-
         a = 0xDEADBEEFCAFEBABE1234567890ABCDEF
-        fa = jnp.array([fe.int_to_limbs(a)], jnp.int32)
-        inv = fe.limbs_to_int(np.asarray(fe.to_canonical(fe.invert(fa)))[0])
+        inv = _fe_int(fe.invert(_fe1(a)))
         assert a * inv % fe.P == 1
 
-    def test_weak_input_canonicalized(self):
-        import jax.numpy as jnp
+    def test_pow_p58(self):
+        a = 0x1234567890ABCDEF ** 3 % fe.P
+        got = _fe_int(fe.pow_p58(_fe1(a)))
+        assert got == pow(a, (fe.P - 5) // 8, fe.P)
 
+    def test_weak_input_canonicalized(self):
         # value p + 5 in limbs (non-canonical but weakly reduced)
-        fa = jnp.array([fe.int_to_limbs(fe.P + 5)], jnp.int32)
-        assert fe.limbs_to_int(np.asarray(fe.to_canonical(fa))[0]) == 5
+        assert _fe_int(_fe1(fe.P + 5)) == 5
 
 
 class TestVerifyBatchParity:
@@ -168,6 +176,49 @@ class TestVerifyBatchParity:
         assert got == [False]
 
     def test_empty_batch(self):
+        assert ed25519_batch.verify_batch([], [], []) == []
+
+
+class TestDeviceHashMode:
+    """CBFT_TPU_HASH=device: SHA-512 + sc_reduce + digits run on-device in
+    the same dispatch as the group math. Accept/reject must stay
+    bit-identical — including on small-order keys, where an inexact mod-L
+    would change [h](-A)."""
+
+    @pytest.fixture(autouse=True)
+    def _device_hash(self, monkeypatch):
+        monkeypatch.setenv("CBFT_TPU_HASH", "device")
+
+    def test_valid_and_corrupted(self):
+        rng = np.random.default_rng(5)
+        pks, msgs, sigs = [], [], []
+        for i in range(9):
+            k = ed.gen_priv_key_from_secret(bytes([i, 21]))
+            m = rng.bytes(int(rng.integers(0, 300)))  # ragged block counts
+            s = bytearray(k.sign(m))
+            if i % 3 == 0:
+                s[rng.integers(0, 64)] ^= 1
+            pks.append(k.pub_key().bytes())
+            msgs.append(bytes(m))
+            sigs.append(bytes(s))
+        _assert_parity(pks, msgs, sigs)
+
+    def test_small_order_pubkey(self):
+        # identity A: [h]A = 0 for h ≡ 0 mod ord(A)=1 — any h works, but
+        # torsion points of order 8 make the result depend on h mod 8·L,
+        # so the device mod-L must be exact. y = -1 has order 4.
+        order4 = ((fe.P - 1) % fe.P).to_bytes(32, "little")
+        k = ed.gen_priv_key_from_secret(b"t")
+        msgs = [b"torsion", b"torsion2"]
+        sigs = [k.sign(msgs[0]), b"\x01" * 64]
+        _assert_parity([order4, order4], msgs, sigs)
+
+    def test_wrong_lengths_and_empty(self):
+        k = ed.gen_priv_key_from_secret(b"l2")
+        got = ed25519_batch.verify_batch(
+            [k.pub_key().bytes(), b"short"], [b"m", b"m"], [b"\x01" * 63, b"\x02" * 64]
+        )
+        assert got == [False, False]
         assert ed25519_batch.verify_batch([], [], []) == []
 
 

@@ -1,0 +1,90 @@
+"""Device SHA-512 + mod-L scalar pipeline — parity with hashlib/CPython.
+
+These are the pieces CBFT_TPU_HASH=device fuses in front of the Straus
+loop (crypto/tpu/{sha512,scalar}.py). Parity must be exact: sc_reduce
+feeds cofactorless verification, where h and h + kL differ on torsioned
+keys. Runs on the virtual CPU platform (conftest.py).
+"""
+
+import hashlib
+
+import numpy as np
+
+from cometbft_tpu.crypto.tpu import scalar, sha512
+
+
+class TestSha512Kernel:
+    def test_ragged_parity_with_hashlib(self):
+        msgs = [
+            b"",
+            b"abc",
+            b"x" * 111,  # 1-block boundary: 111 + 1 + 16 = 128
+            b"y" * 112,  # first length that needs 2 blocks
+            b"z" * 127,
+            b"w" * 128,
+            b"q" * 200,
+            bytes(range(256)) * 2,
+        ]
+        hi, lo, nb = sha512.pad_ragged_np(msgs)
+        dh, dl = sha512.sha512_blocks(hi, lo, nb)
+        got = sha512.digests_to_bytes_np(np.asarray(dh), np.asarray(dl))
+        for i, m in enumerate(msgs):
+            assert got[i].tobytes() == hashlib.sha512(m).digest(), i
+
+    def test_random_lengths(self):
+        rng = np.random.default_rng(23)
+        msgs = [rng.bytes(int(rng.integers(0, 400))) for _ in range(32)]
+        hi, lo, nb = sha512.pad_ragged_np(msgs)
+        dh, dl = sha512.sha512_blocks(hi, lo, nb)
+        got = sha512.digests_to_bytes_np(np.asarray(dh), np.asarray(dl))
+        for i, m in enumerate(msgs):
+            assert got[i].tobytes() == hashlib.sha512(m).digest(), i
+
+
+class TestScReduce:
+    def _reduce_ints(self, vals):
+        import jax.numpy as jnp
+
+        cols = [
+            jnp.array([(v >> (15 * k)) & 0x7FFF for v in vals], jnp.int32)
+            for k in range(35)
+        ]
+        red = np.asarray(scalar.sc_reduce(cols))
+        return [
+            sum(int(red[j, i]) << (15 * j) for j in range(17))
+            for i in range(len(vals))
+        ]
+
+    def test_edge_values(self):
+        L = scalar.L
+        vals = [0, 1, L - 1, L, L + 1, 8 * L, 2**512 - 1, 2**255,
+                2**256 - 1, (L << 260) + 12345, 7 * L - 3]
+        got = self._reduce_ints(vals)
+        assert got == [v % L for v in vals]
+
+    def test_digest_pipeline_matches_python(self):
+        rng = np.random.default_rng(31)
+        msgs = [rng.bytes(int(rng.integers(0, 300))) for _ in range(24)]
+        hi, lo, nb = sha512.pad_ragged_np(msgs)
+        dh, dl = sha512.sha512_blocks(hi, lo, nb)
+        red = np.asarray(scalar.sc_reduce(scalar.digest_to_limbs(dh, dl)))
+        for i, m in enumerate(msgs):
+            want = int.from_bytes(hashlib.sha512(m).digest(), "little") % scalar.L
+            got = sum(int(red[j, i]) << (15 * j) for j in range(17))
+            assert got == want, i
+
+    def test_digit_extraction_matches_host_packer(self):
+        from cometbft_tpu.crypto.tpu import ed25519_batch
+
+        rng = np.random.default_rng(37)
+        msgs = [rng.bytes(40) for _ in range(16)]
+        hi, lo, nb = sha512.pad_ragged_np(msgs)
+        dh, dl = sha512.sha512_blocks(hi, lo, nb)
+        red = scalar.sc_reduce(scalar.digest_to_limbs(dh, dl))
+        got = np.asarray(scalar.digits_msb_first(red))
+        arr = np.zeros((len(msgs), 32), np.uint8)
+        for i, m in enumerate(msgs):
+            h = int.from_bytes(hashlib.sha512(m).digest(), "little") % scalar.L
+            arr[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
+        want = ed25519_batch._digits_msb_first(arr)
+        assert (got == want).all()

@@ -1,0 +1,41 @@
+"""Warm the persistent XLA cache for the CPU-platform kernel shapes the
+test-suite and the bench CPU fallback rely on. Run detached after any
+kernel change; prints per-shape compile+run seconds."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+cache = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+jax.config.update("jax_compilation_cache_dir", cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+from cometbft_tpu.crypto import ed25519 as ed  # noqa: E402
+from cometbft_tpu.crypto.tpu import ed25519_batch  # noqa: E402
+
+
+def batch(n):
+    pks, msgs, sigs = [], [], []
+    for i in range(n):
+        k = ed.gen_priv_key_from_secret(bytes([i & 0xFF, i >> 8]))
+        m = b"warm %d" % i
+        pks.append(k.pub_key().bytes())
+        msgs.append(m)
+        sigs.append(k.sign(m))
+    return pks, msgs, sigs
+
+
+for n in [int(x) for x in (sys.argv[1:] or ["64"])]:
+    t0 = time.time()
+    out = ed25519_batch.verify_batch(*batch(n))
+    assert all(out), f"batch {n} rejected valid sigs"
+    print(f"batch {n}: {time.time() - t0:.1f}s", flush=True)
+print("done")
