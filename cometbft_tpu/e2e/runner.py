@@ -206,6 +206,24 @@ class Testnet:
             got = self.client(i).tx(bytes.fromhex(tx_hash_hex))
             assert got["hash"].upper() == tx_hash_hex.upper()
 
+    def check_block_results_consistent(self, upto: int) -> None:
+        """Every node serves block_results whose DeliverTx count matches
+        the block's tx count, with code 0 for the kvstore app
+        (app_test.go TestApp_Tx reads execution results — this consumes
+        the persisted ABCI responses rather than raw blocks)."""
+        for i in self.live_indexes():
+            c = self.client(i)
+            for h in range(1, upto + 1):
+                blk = c.block(h)
+                n_txs = len(blk["block"]["data"]["txs"] or [])
+                br = c.call("block_results", {"height": h})
+                assert br["height"] == str(h)
+                results = br["txs_results"] or []
+                assert len(results) == n_txs, (
+                    f"node {i} h={h}: {len(results)} results, {n_txs} txs"
+                )
+                assert all(r["code"] == 0 for r in results)
+
 
 class LoadGenerator:
     """Continuous tx load with commit-latency tracking (test/loadtime:

@@ -408,6 +408,13 @@ class Node(BaseService):
 
             env = Environment(self)
             self.rpc_server = RPCServer(env, logger=self.logger)
+        self.grpc_broadcast_server = None
+        if config.rpc.grpc_laddr:
+            from cometbft_tpu.rpc.grpc_api import BroadcastAPIServer
+
+            self.grpc_broadcast_server = BroadcastAPIServer(
+                config.rpc.grpc_laddr, self
+            )
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -450,6 +457,8 @@ class Node(BaseService):
         if self.rpc_server is not None:
             host, port = _parse_laddr(self.config.rpc.laddr)
             self.rpc_server.serve(host, port)
+        if self.grpc_broadcast_server is not None:
+            self.grpc_broadcast_server.start()
         if self.config.rpc.pprof_laddr:
             from cometbft_tpu.libs.debug import PprofServer
 
@@ -575,6 +584,7 @@ class Node(BaseService):
         for svc in (
             getattr(self, "pprof_server", None),
             getattr(self, "metrics_server", None),
+            getattr(self, "grpc_broadcast_server", None),
             self.rpc_server,
             self.switch,
             self.addr_book,

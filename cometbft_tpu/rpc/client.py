@@ -207,3 +207,271 @@ def parse_validators(items: List[dict]) -> ValidatorSet:
             )
         )
     return ValidatorSet(vals)
+
+
+# ---------------------------------------------------------------------------
+# WebSocket client (rpc/client/http/http.go:574 WSEvents)
+# ---------------------------------------------------------------------------
+
+
+class WSClient:
+    """JSON-RPC over WebSocket with event subscriptions — the programmatic
+    consumer of the server's event stream, so tooling can subscribe
+    instead of polling (reference WSEvents).
+
+    Usage::
+
+        ws = WSClient("127.0.0.1:26657")
+        ws.connect()
+        sub = ws.subscribe("tm.event='NewBlock'")
+        msg = sub.next(timeout=10)   # {"query", "data", "events"}
+        ws.close()
+    """
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        import socket as _socket
+
+        self._socket_mod = _socket
+        addr = addr.split("://", 1)[-1].rstrip("/")
+        host, _, port = addr.partition(":")
+        self._host, self._port = host, int(port or 26657)
+        self.timeout = timeout
+        self._sock = None
+        self._send_mtx = None
+        self._ids = itertools.count(1)
+        self._pending = {}  # id -> queue of responses
+        self._subs = {}  # query -> _WSSubscription
+        self._reader = None
+        self._closed = False
+
+    # -- connection -----------------------------------------------------------
+
+    def connect(self) -> None:
+        import hashlib
+        import os
+        import queue
+        import threading
+
+        self._queue_mod = queue
+        sock = self._socket_mod.create_connection(
+            (self._host, self._port), timeout=self.timeout
+        )
+        key = base64.b64encode(os.urandom(16)).decode()
+        sock.sendall(
+            (
+                f"GET /websocket HTTP/1.1\r\n"
+                f"Host: {self._host}:{self._port}\r\n"
+                "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                f"Sec-WebSocket-Key: {key}\r\n"
+                "Sec-WebSocket-Version: 13\r\n\r\n"
+            ).encode()
+        )
+        # read the 101 response headers
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = sock.recv(4096)
+            if not chunk:
+                raise ConnectionError("ws handshake: connection closed")
+            buf += chunk
+        status = buf.split(b"\r\n", 1)[0].decode()
+        if " 101 " not in status + " ":
+            raise ConnectionError(f"ws handshake rejected: {status}")
+        want = base64.b64encode(
+            hashlib.sha1(
+                (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
+            ).digest()
+        ).decode()
+        accept = ""
+        for line in buf.split(b"\r\n"):
+            if line.lower().startswith(b"sec-websocket-accept:"):
+                accept = line.split(b":", 1)[1].strip().decode()
+        if accept != want:
+            raise ConnectionError("ws handshake: bad Sec-WebSocket-Accept")
+        sock.settimeout(None)
+        self._sock = sock
+        import threading as _threading
+
+        self._send_mtx = _threading.Lock()
+        self._reader = _threading.Thread(
+            target=self._read_loop, name="ws-client-reader", daemon=True
+        )
+        self._reader.start()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._sock is not None:
+            try:
+                self._send_frame(0x8, b"")
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    # -- frame codec (client side: payloads MUST be masked) -------------------
+
+    def _send_frame(self, opcode: int, payload: bytes) -> None:
+        import os
+        import struct
+
+        mask = os.urandom(4)
+        header = bytes([0x80 | opcode])
+        n = len(payload)
+        if n < 126:
+            header += bytes([0x80 | n])
+        elif n < 1 << 16:
+            header += bytes([0x80 | 126]) + struct.pack(">H", n)
+        else:
+            header += bytes([0x80 | 127]) + struct.pack(">Q", n)
+        masked = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+        with self._send_mtx:
+            self._sock.sendall(header + mask + masked)
+
+    def _read_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("ws closed")
+            buf.extend(chunk)
+        return bytes(buf)
+
+    def _read_frame(self):
+        import struct
+
+        b1, b2 = self._read_exact(2)
+        opcode = b1 & 0x0F
+        masked = b2 & 0x80
+        length = b2 & 0x7F
+        if length == 126:
+            (length,) = struct.unpack(">H", self._read_exact(2))
+        elif length == 127:
+            (length,) = struct.unpack(">Q", self._read_exact(8))
+        mask = self._read_exact(4) if masked else b""
+        payload = self._read_exact(length)
+        if mask:
+            payload = bytes(c ^ mask[i % 4] for i, c in enumerate(payload))
+        return opcode, payload
+
+    def _read_loop(self) -> None:
+        try:
+            while not self._closed:
+                opcode, payload = self._read_frame()
+                if opcode == 0x8:
+                    break
+                if opcode == 0x9:  # ping
+                    self._send_frame(0xA, payload)
+                    continue
+                if opcode not in (0x1, 0x2):
+                    continue
+                try:
+                    msg = json.loads(payload)
+                except ValueError:
+                    continue
+                self._route(msg)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._closed = True
+            for sub in self._subs.values():
+                sub._push(None)  # wake blocked readers with EOF
+            for q in self._pending.values():
+                q.put(None)
+
+    def _route(self, msg: dict) -> None:
+        rid = msg.get("id")
+        result = msg.get("result")
+        # subscription events carry the subscribe call's id and a query
+        if isinstance(result, dict) and "query" in result and "data" in result:
+            sub = self._subs.get(result["query"])
+            if sub is not None:
+                sub._push(result)
+            return
+        q = self._pending.pop(rid, None)
+        if q is not None:
+            q.put(msg)
+            return
+        if "error" in msg:
+            # an un-requested error frame is the server's async signal
+            # that a subscription died (the bus evicts slow subscribers);
+            # surface it on every live subscription rather than dropping
+            # it — readers get RPCClientError instead of hanging
+            for sub in list(self._subs.values()):
+                sub._push(msg)
+
+    # -- calls ----------------------------------------------------------------
+
+    def call(self, method: str, params: Optional[dict] = None):
+        if self._sock is None:
+            raise ConnectionError("not connected — call connect() first")
+        rid = next(self._ids)
+        q = self._queue_mod.Queue()
+        self._pending[rid] = q
+        self._send_frame(
+            0x1,
+            json.dumps(
+                {
+                    "jsonrpc": "2.0",
+                    "id": rid,
+                    "method": method,
+                    "params": params or {},
+                }
+            ).encode(),
+        )
+        try:
+            msg = q.get(timeout=self.timeout)
+        except self._queue_mod.Empty:
+            self._pending.pop(rid, None)
+            raise TimeoutError(f"ws call {method!r} timed out") from None
+        if msg is None:
+            raise ConnectionError("ws closed while waiting for response")
+        if "error" in msg:
+            err = msg["error"]
+            raise RPCClientError(
+                err.get("code", -1), err.get("message", ""), err.get("data", "")
+            )
+        return msg.get("result")
+
+    def subscribe(self, query: str) -> "_WSSubscription":
+        sub = _WSSubscription(self, query)
+        self._subs[query] = sub
+        try:
+            self.call("subscribe", {"query": query})
+        except Exception:
+            self._subs.pop(query, None)
+            raise
+        return sub
+
+    def unsubscribe(self, query: str) -> None:
+        self._subs.pop(query, None)
+        self.call("unsubscribe", {"query": query})
+
+
+class _WSSubscription:
+    """A stream of event messages for one query."""
+
+    def __init__(self, client: WSClient, query: str):
+        import queue
+
+        self.query = query
+        self._client = client
+        self._q = queue.Queue()
+
+    def _push(self, item) -> None:
+        self._q.put(item)
+
+    def next(self, timeout: Optional[float] = None) -> dict:
+        """Block for the next event ({"query", "data", "events"}).
+        Raises ConnectionError if the socket died, RPCClientError if the
+        server cancelled the subscription (e.g. slow-subscriber
+        eviction)."""
+        item = self._q.get(timeout=timeout)
+        if item is None:
+            raise ConnectionError("ws connection closed")
+        if "error" in item:
+            err = item["error"]
+            raise RPCClientError(
+                err.get("code", -1), err.get("message", ""), err.get("data", "")
+            )
+        return item

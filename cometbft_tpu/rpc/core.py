@@ -384,10 +384,12 @@ class Environment:
         out["hash"] = hex_up(Tx(tx).hash())
         return out
 
-    def broadcast_tx_commit(self, tx: bytes) -> dict:
-        """CheckTx, then wait for the DeliverTx event
-        (rpc/core/mempool.go:58) — bounded by
-        config.rpc.timeout_broadcast_tx_commit."""
+    def broadcast_tx_commit_raw(self, tx: bytes):
+        """CheckTx, then wait for the DeliverTx event — returning the
+        REAL ABCI response objects, for callers that re-serialize to a
+        different wire format (the gRPC BroadcastAPI).
+
+        → (ResponseCheckTx, Optional[ResponseDeliverTx], height)."""
         bus = self.node.event_bus
         tx_hash = Tx(tx).hash()
         subscriber = f"rpc-commit-{uuid.uuid4().hex[:12]}"
@@ -396,14 +398,24 @@ class Environment:
         q = parse_query(f"{TX_HASH_KEY}='{tx_hash.hex().upper()}'")
         sub = bus.subscribe(subscriber, q)
         try:
-            check = self.broadcast_tx_sync(tx)
-            if check.get("code", 0) != 0:
-                return {
-                    "check_tx": check,
-                    "deliver_tx": None,
-                    "hash": hex_up(tx_hash),
-                    "height": "0",
-                }
+            done = threading.Event()
+            check_box = []
+
+            def cb(res):
+                check_box.append(res.value)
+                done.set()
+
+            try:
+                self.node.mempool.check_tx(tx, cb)
+            except ErrTxInCache as exc:
+                raise RPCError(-32603, "tx already exists in cache") from exc
+            except Exception as exc:
+                raise RPCError(-32603, str(exc)) from exc
+            if not done.wait(10.0):
+                raise RPCError(-32603, "timed out waiting for CheckTx")
+            check = check_box[0]
+            if check.code != 0:
+                return check, None, 0
             timeout = (
                 self.node.config.rpc.timeout_broadcast_tx_commit_ns / 1e9
             )
@@ -414,14 +426,22 @@ class Environment:
                     -32603, "timed out waiting for tx to be included in a block"
                 ) from exc
             ev = msg.data
-            return {
-                "check_tx": check,
-                "deliver_tx": tx_result_json(ev.result),
-                "hash": hex_up(tx_hash),
-                "height": str(ev.height),
-            }
+            return check, ev.result, ev.height
         finally:
             bus.unsubscribe_all(subscriber)
+
+    def broadcast_tx_commit(self, tx: bytes) -> dict:
+        """CheckTx, then wait for the DeliverTx event
+        (rpc/core/mempool.go:58) — bounded by
+        config.rpc.timeout_broadcast_tx_commit."""
+        check, deliver, height = self.broadcast_tx_commit_raw(tx)
+        check_json = tx_result_json(check) | {"hash": hex_up(Tx(tx).hash())}
+        return {
+            "check_tx": check_json,
+            "deliver_tx": tx_result_json(deliver) if deliver else None,
+            "hash": hex_up(Tx(tx).hash()),
+            "height": str(height),
+        }
 
     # -- indexer routes (rpc/core/tx.go, blocks.go) ---------------------------
 
@@ -434,12 +454,34 @@ class Environment:
             "tx": b64(res.tx),
         }
 
-    def tx(self, hash_: bytes) -> dict:
-        """rpc/core/tx.go:19 Tx — look one transaction up by hash."""
+    def tx(self, hash_: bytes, prove: bool = False) -> dict:
+        """rpc/core/tx.go:19 Tx — look one transaction up by hash;
+        prove=true attaches the Merkle inclusion proof against the
+        block's DataHash (tx.go:39-47)."""
         res = self.node.tx_indexer.get(hash_)
         if res is None:
             raise RPCError(-32603, f"tx ({hash_.hex()}) not found")
-        return self._tx_json(res)
+        out = self._tx_json(res)
+        if prove:
+            from cometbft_tpu.types.tx import Txs
+
+            block = self.node.block_store.load_block(res.height)
+            if block is None:
+                raise RPCError(
+                    -32603, f"block {res.height} not found for proof"
+                )
+            root, proof = Txs(block.data.txs).proof(res.index)
+            out["proof"] = {
+                "root_hash": hex_up(root),
+                "data": b64(res.tx),
+                "proof": {
+                    "total": str(proof.total),
+                    "index": str(proof.index),
+                    "leaf_hash": b64(proof.leaf_hash),
+                    "aunts": [b64(a) for a in proof.aunts],
+                },
+            }
+        return out
 
     @staticmethod
     def _search(
@@ -484,6 +526,132 @@ class Environment:
             "txs": [self._tx_json(r) for r in results],
             "total_count": str(total),
         }
+
+    def block_results(self, height: Optional[int] = None) -> dict:
+        """rpc/core/blocks.go:149 BlockResults — the persisted ABCI
+        responses for one height: DeliverTx results, BeginBlock/EndBlock
+        events, validator and consensus-param updates. This is the
+        standard surface apps and indexers consume execution results
+        from."""
+        from cometbft_tpu.rpc.serializers import abci_params_json, events_json
+        from cometbft_tpu.state.store import ErrNoABCIResponsesForHeight
+
+        h = self._height_or_latest(height)
+        try:
+            resp = self.node.state_store.load_abci_responses(h)
+        except ErrNoABCIResponsesForHeight as exc:
+            raise RPCError(-32603, str(exc)) from exc
+        end = resp.end_block or abci.ResponseEndBlock()
+        begin = resp.begin_block or abci.ResponseBeginBlock()
+        params = None
+        if end.consensus_param_updates is not None:
+            params = abci_params_json(end.consensus_param_updates)
+        return {
+            "height": str(h),
+            "txs_results": [tx_result_json(d) for d in resp.deliver_txs]
+            or None,
+            "begin_block_events": events_json(begin.events) or None,
+            "end_block_events": events_json(end.events) or None,
+            "validator_updates": [
+                {
+                    "pub_key": {v.pub_key.type: b64(v.pub_key.data)},
+                    "power": str(v.power),
+                }
+                for v in end.validator_updates
+            ]
+            or None,
+            "consensus_param_updates": params,
+        }
+
+    def check_tx(self, tx: bytes) -> dict:
+        """rpc/core/mempool.go:177 CheckTx — run a transaction through
+        the app's mempool-connection CheckTx WITHOUT adding it to the
+        mempool. For probing validity."""
+        res = self.node.proxy_app.mempool().check_tx_sync(
+            abci.RequestCheckTx(tx=bytes(tx))
+        )
+        return tx_result_json(res)
+
+    def broadcast_evidence(self, evidence: bytes) -> dict:
+        """rpc/core/evidence.go:14 BroadcastEvidence. The evidence rides
+        as base64 of its proto encoding (this framework's RPC carries all
+        binary payloads b64, where the reference uses amino JSON)."""
+        from cometbft_tpu.types.evidence import decode_evidence
+
+        try:
+            ev = decode_evidence(bytes(evidence))
+        except Exception as exc:
+            raise RPCError(-32602, f"invalid evidence: {exc}") from exc
+        try:
+            self.node.evidence_pool.add_evidence(ev)
+        except Exception as exc:
+            raise RPCError(-32603, f"failed to add evidence: {exc}") from exc
+        return {"hash": hex_up(ev.hash())}
+
+    _GENESIS_CHUNK_SIZE = 16 * 1024 * 1024
+
+    def genesis_chunked(self, chunk: int = 0) -> dict:
+        """rpc/core/routes.go:22 GenesisChunked — the genesis document
+        b64'd and split into 16 MB chunks, for genesis files too large
+        for one JSON-RPC response."""
+        data = getattr(self, "_genesis_chunks", None)
+        if data is None:
+            raw = b64(self.node.genesis_doc.to_json().encode()).encode()
+            size = self._GENESIS_CHUNK_SIZE
+            data = [
+                raw[i : i + size].decode() for i in range(0, len(raw), size)
+            ] or [""]
+            self._genesis_chunks = data
+        if not 0 <= chunk < len(data):
+            raise RPCError(
+                -32603,
+                f"there are {len(data)} chunks, but specified chunk {chunk}",
+            )
+        return {
+            "chunk": str(chunk),
+            "total": str(len(data)),
+            "data": data[chunk],
+        }
+
+    # -- unsafe routes (routes.go:52-57, registered only with rpc.unsafe) ----
+
+    def _require_unsafe(self):
+        if not self.node.config.rpc.unsafe:
+            raise RPCError(
+                -32601, "unsafe routes are disabled ([rpc] unsafe = false)"
+            )
+
+    def unsafe_dial_seeds(self, seeds: List[str]) -> dict:
+        """rpc/core/net.go UnsafeDialSeeds."""
+        self._require_unsafe()
+        if not seeds:
+            raise RPCError(-32602, "no seeds provided")
+        addrs = self.node.switch.add_persistent_peers(list(seeds))
+        self.node.switch.dial_peers_async(addrs)
+        return {"log": "Dialing seeds in progress. See /net_info for details"}
+
+    def unsafe_dial_peers(
+        self, peers: List[str], persistent: bool = False
+    ) -> dict:
+        """rpc/core/net.go UnsafeDialPeers."""
+        self._require_unsafe()
+        if not peers:
+            raise RPCError(-32602, "no peers provided")
+        peers = list(peers)
+        if persistent:
+            addrs = self.node.switch.add_persistent_peers(peers)
+        else:
+            from cometbft_tpu.p2p.netaddr import NetAddress
+
+            addrs = [NetAddress.from_string(p) for p in peers]
+        self.node.switch.dial_peers_async(addrs)
+        return {"log": "Dialing peers in progress. See /net_info for details"}
+
+    def unsafe_flush_mempool(self) -> dict:
+        """rpc/core/mempool.go UnsafeFlushMempool — drop every pending tx."""
+        self._require_unsafe()
+        self.node.mempool.flush()
+        return {}
 
     def block_search(
         self,

@@ -1,0 +1,180 @@
+"""Minimal broadcast-only gRPC API.
+
+Reference: rpc/grpc/api.go — service tendermint.rpc.grpc.BroadcastAPI
+with Ping and BroadcastTx (types.proto in rpc/grpc). BroadcastTx runs
+CheckTx through the mempool and, on success, waits for the DeliverTx
+result like broadcast_tx_commit. Frames are hand-rolled proto codecs
+driven through gRPC's generic handler API, the same pattern as
+abci/grpc.py — no generated stubs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import grpc
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.libs import protoio
+from cometbft_tpu.libs.service import BaseService
+
+_SERVICE = "tendermint.rpc.grpc.BroadcastAPI"
+
+
+@dataclass
+class RequestPing:
+    def encode(self) -> bytes:
+        return b""
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RequestPing":
+        return cls()
+
+
+@dataclass
+class ResponsePing:
+    def encode(self) -> bytes:
+        return b""
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ResponsePing":
+        return cls()
+
+
+@dataclass
+class RequestBroadcastTx:
+    tx: bytes = b""
+
+    def encode(self) -> bytes:
+        return protoio.field_bytes(1, self.tx) if self.tx else b""
+
+    @classmethod
+    def decode(cls, data: bytes) -> "RequestBroadcastTx":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.tx = r.read_bytes()
+            else:
+                r.skip(wt)
+        return out
+
+
+@dataclass
+class ResponseBroadcastTx:
+    check_tx: Optional[abci.ResponseCheckTx] = field(default=None)
+    deliver_tx: Optional[abci.ResponseDeliverTx] = field(default=None)
+
+    def encode(self) -> bytes:
+        out = b""
+        if self.check_tx is not None:
+            out += protoio.field_message(1, self.check_tx.encode())
+        if self.deliver_tx is not None:
+            out += protoio.field_message(2, self.deliver_tx.encode())
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "ResponseBroadcastTx":
+        r = protoio.WireReader(data)
+        out = cls()
+        while not r.at_end():
+            f, wt = r.read_tag()
+            if f == 1:
+                out.check_tx = abci.ResponseCheckTx.decode(r.read_bytes())
+            elif f == 2:
+                out.deliver_tx = abci.ResponseDeliverTx.decode(r.read_bytes())
+            else:
+                r.skip(wt)
+        return out
+
+
+class BroadcastAPIServer(BaseService):
+    """Serves BroadcastAPI over a node (rpc/grpc/api.go:11)."""
+
+    def __init__(self, addr: str, node):
+        super().__init__("BroadcastAPIServer")
+        self._addr = addr.split("://", 1)[-1]
+        self._node = node
+        self._server: Optional[grpc.Server] = None
+        self._bound_port = 0
+
+    @property
+    def bound_port(self) -> int:
+        return self._bound_port
+
+    def _ping(self, request_bytes: bytes, _ctx) -> bytes:
+        return ResponsePing().encode()
+
+    def _broadcast_tx(self, request_bytes: bytes, _ctx) -> bytes:
+        from cometbft_tpu.rpc.core import Environment, RPCError
+
+        req = RequestBroadcastTx.decode(request_bytes)
+        env = Environment(self._node)
+        try:
+            # the raw ABCI objects, so data/gas/events survive intact
+            check, deliver, _ = env.broadcast_tx_commit_raw(req.tx)
+        except RPCError as exc:
+            raise RuntimeError(exc.message) from exc
+        return ResponseBroadcastTx(check_tx=check, deliver_tx=deliver).encode()
+
+    def on_start(self) -> None:
+        from concurrent import futures
+
+        handlers = {
+            "Ping": grpc.unary_unary_rpc_method_handler(
+                self._ping,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            ),
+            "BroadcastTx": grpc.unary_unary_rpc_method_handler(
+                self._broadcast_tx,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            ),
+        }
+        service = grpc.method_handlers_generic_handler(_SERVICE, handlers)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        self._server.add_generic_rpc_handlers((service,))
+        self._bound_port = self._server.add_insecure_port(self._addr)
+        if self._bound_port == 0:
+            raise RuntimeError(f"gRPC server failed to bind {self._addr}")
+        self._server.start()
+
+    def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+            self._server = None
+
+
+class BroadcastAPIClient:
+    """Client for the BroadcastAPI (rpc/grpc/client_server.go)."""
+
+    def __init__(self, addr: str):
+        self._addr = addr.split("://", 1)[-1]
+        self._channel: Optional[grpc.Channel] = None
+
+    def start(self) -> None:
+        self._channel = grpc.insecure_channel(self._addr)
+
+    def stop(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+            self._channel = None
+
+    def _call(self, method: str, req_bytes: bytes) -> bytes:
+        fn = self._channel.unary_unary(
+            f"/{_SERVICE}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        return fn(req_bytes)
+
+    def ping(self) -> ResponsePing:
+        return ResponsePing.decode(self._call("Ping", RequestPing().encode()))
+
+    def broadcast_tx(self, tx: bytes) -> ResponseBroadcastTx:
+        return ResponseBroadcastTx.decode(
+            self._call("BroadcastTx", RequestBroadcastTx(tx=tx).encode())
+        )

@@ -101,6 +101,23 @@ def validator_json(v) -> dict:
     }
 
 
+def events_json(events) -> list:
+    return [
+        {
+            "type": ev.type,
+            "attributes": [
+                {
+                    "key": b64(a.key if isinstance(a.key, bytes) else a.key.encode()),
+                    "value": b64(a.value if isinstance(a.value, bytes) else a.value.encode()),
+                    "index": getattr(a, "index", False),
+                }
+                for a in ev.attributes
+            ],
+        }
+        for ev in events
+    ]
+
+
 def tx_result_json(r) -> dict:
     return {
         "code": r.code,
@@ -109,19 +126,27 @@ def tx_result_json(r) -> dict:
         "info": getattr(r, "info", ""),
         "gas_wanted": str(getattr(r, "gas_wanted", 0)),
         "gas_used": str(getattr(r, "gas_used", 0)),
-        "events": [
-            {
-                "type": ev.type,
-                "attributes": [
-                    {
-                        "key": b64(a.key if isinstance(a.key, bytes) else a.key.encode()),
-                        "value": b64(a.value if isinstance(a.value, bytes) else a.value.encode()),
-                        "index": getattr(a, "index", False),
-                    }
-                    for a in ev.attributes
-                ],
-            }
-            for ev in getattr(r, "events", [])
-        ],
+        "events": events_json(getattr(r, "events", [])),
         "codespace": getattr(r, "codespace", ""),
     }
+
+
+def abci_params_json(p) -> dict:
+    """abci.ConsensusParams (every section nullable) → RPC JSON."""
+    out = {}
+    if p.block is not None:
+        out["block"] = {
+            "max_bytes": str(p.block.max_bytes),
+            "max_gas": str(p.block.max_gas),
+        }
+    if p.evidence is not None:
+        out["evidence"] = {
+            "max_age_num_blocks": str(p.evidence.max_age_num_blocks),
+            "max_age_duration": str(p.evidence.max_age_duration_ns),
+            "max_bytes": str(p.evidence.max_bytes),
+        }
+    if p.validator is not None:
+        out["validator"] = {"pub_key_types": list(p.validator.pub_key_types)}
+    if p.version is not None:
+        out["version"] = {"app_version": str(p.version.app_version)}
+    return out

@@ -67,7 +67,20 @@ _ROUTES = {
     "broadcast_tx_async": ("broadcast_tx_async", {"tx": ("tx", "b64bytes")}),
     "broadcast_tx_sync": ("broadcast_tx_sync", {"tx": ("tx", "b64bytes")}),
     "broadcast_tx_commit": ("broadcast_tx_commit", {"tx": ("tx", "b64bytes")}),
-    "tx": ("tx", {"hash": ("hash_", "b64bytes")}),
+    "tx": ("tx", {"hash": ("hash_", "b64bytes"), "prove": ("prove", bool)}),
+    "block_results": ("block_results", {"height": ("height", int)}),
+    "check_tx": ("check_tx", {"tx": ("tx", "b64bytes")}),
+    "broadcast_evidence": (
+        "broadcast_evidence",
+        {"evidence": ("evidence", "b64bytes")},
+    ),
+    "genesis_chunked": ("genesis_chunked", {"chunk": ("chunk", int)}),
+    "dial_seeds": ("unsafe_dial_seeds", {"seeds": ("seeds", "strlist")}),
+    "dial_peers": (
+        "unsafe_dial_peers",
+        {"peers": ("peers", "strlist"), "persistent": ("persistent", bool)},
+    ),
+    "unsafe_flush_mempool": ("unsafe_flush_mempool", {}),
     "tx_search": (
         "tx_search",
         {
@@ -109,6 +122,11 @@ def _coerce(kind, value):
             return base64.b64decode(s, validate=True)
         except Exception as exc:
             raise RPCError(-32602, f"invalid base64 parameter: {exc}") from exc
+    if kind == "strlist":
+        if isinstance(value, (list, tuple)):
+            return [str(v) for v in value]
+        s = str(value).strip('"')
+        return [p for p in s.split(",") if p]
     if kind == "hexbytes":
         if isinstance(value, (bytes, bytearray)):
             return bytes(value)

@@ -47,6 +47,7 @@ class TestE2ETestnet:
             assert check_h >= 4
             net.check_app_hashes_agree(check_h)
             net.check_blocks_well_formed(min(check_h, 8))
+            net.check_block_results_consistent(min(check_h, 8))
             assert len(net.live_indexes()) == 4
             # a committed tx is queryable on all nodes (indexers agree)
             if load.tx_hashes:

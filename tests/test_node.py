@@ -207,6 +207,177 @@ class TestSingleNode:
             finally:
                 node.stop()
 
+    def test_new_rpc_routes_end_to_end(self):
+        """block_results / check_tx / genesis_chunked / tx(prove=true)
+        with client-side Merkle verification / WS subscription client /
+        broadcast_evidence / gRPC BroadcastAPI / unsafe-route gating —
+        the round-4 RPC surface, driven against a live node."""
+        from cometbft_tpu.cmd.commands import _load_config
+        from cometbft_tpu.node import default_new_node
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "rpc-routes"])
+            rpc_port, p2p_port, grpc_port = _free_ports(3)
+            cfg = _load_config(d)
+            cfg.base.proxy_app = "kvstore"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+            cfg.rpc.grpc_laddr = f"tcp://127.0.0.1:{grpc_port}"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            cfg.consensus.timeout_commit_ns = 200_000_000
+            node = default_new_node(cfg)
+            node.start()
+            try:
+                # WS subscription: see a NewBlock arrive (no polling)
+                from cometbft_tpu.rpc.client import WSClient
+
+                deadline = time.monotonic() + 60
+                ws = None
+                while time.monotonic() < deadline and ws is None:
+                    try:
+                        ws = WSClient(f"127.0.0.1:{rpc_port}")
+                        ws.connect()
+                    except OSError:
+                        ws = None
+                        time.sleep(0.3)
+                assert ws is not None, "ws never connected"
+                sub = ws.subscribe("tm.event='NewBlock'")
+                ev = sub.next(timeout=60)
+                assert ev["data"]["type"] == "EventDataNewBlock"
+                height = int(ev["data"]["value"]["block"]["header"]["height"])
+                assert height >= 1
+
+                # commit a tx, then block_results serves its DeliverTx
+                tx_b = b"route=42"
+                res = _rpc_post(
+                    port=rpc_port, method="broadcast_tx_commit",
+                    params={"tx": base64.b64encode(tx_b).decode()},
+                )["result"]
+                assert res["deliver_tx"]["code"] == 0
+                txh = int(res["height"])
+                br = _rpc_post(
+                    port=rpc_port, method="block_results",
+                    params={"height": txh},
+                )["result"]
+                assert br["height"] == str(txh)
+                assert len(br["txs_results"]) == 1
+                assert br["txs_results"][0]["code"] == 0
+
+                # check_tx probes without mutating the mempool
+                ct = _rpc_post(
+                    port=rpc_port, method="check_tx",
+                    params={"tx": base64.b64encode(b"probe=1").decode()},
+                )["result"]
+                assert ct["code"] == 0
+                n_un = _rpc(rpc_port, "num_unconfirmed_txs")["result"]
+                assert n_un["total"] == "0"
+
+                # genesis_chunked reassembles to the genesis doc
+                gc = _rpc_post(
+                    port=rpc_port, method="genesis_chunked",
+                    params={"chunk": 0},
+                )["result"]
+                assert gc["total"] == "1"
+                doc = json.loads(base64.b64decode(gc["data"]))
+                assert doc["chain_id"] == "rpc-routes"
+
+                # tx(prove=true): verify the Merkle proof client-side
+                import hashlib as _hl
+
+                from cometbft_tpu.crypto import merkle as merkle_mod
+                from cometbft_tpu.types.tx import Tx
+
+                deadline = time.monotonic() + 10
+                got = None
+                while time.monotonic() < deadline and got is None:
+                    try:
+                        got = _rpc_post(
+                            port=rpc_port, method="tx",
+                            params={
+                                "hash": base64.b64encode(
+                                    _hl.sha256(tx_b).digest()
+                                ).decode(),
+                                "prove": True,
+                            },
+                        )["result"]
+                    except Exception:
+                        time.sleep(0.2)
+                assert got is not None and "proof" in got
+                pj = got["proof"]
+                proof = merkle_mod.Proof(
+                    total=int(pj["proof"]["total"]),
+                    index=int(pj["proof"]["index"]),
+                    leaf_hash=base64.b64decode(pj["proof"]["leaf_hash"]),
+                    aunts=[base64.b64decode(a) for a in pj["proof"]["aunts"]],
+                )
+                root = bytes.fromhex(pj["root_hash"])
+                proof.verify(root, Tx(tx_b).hash())  # raises on mismatch
+                # ... and the root is the block's data_hash
+                blk = _rpc_post(
+                    port=rpc_port, method="block", params={"height": txh}
+                )["result"]
+                assert blk["block"]["header"]["data_hash"] == pj["root_hash"]
+
+                # broadcast_evidence: a real double-vote from the node's
+                # own validator key lands in the pool and commits
+                from cometbft_tpu.types.evidence import (
+                    DuplicateVoteEvidence,
+                    encode_evidence,
+                )
+                from cometbft_tpu.types.test_util import MockPV, make_vote
+                from cometbft_tpu.types.block import BlockID, PartSetHeader
+                from cometbft_tpu.proto.gogo import Timestamp as _Ts
+
+                pv = MockPV(node.priv_validator.priv_key)
+                meta1 = node.block_store.load_block_meta(1)
+                bt = meta1.header.time
+                bid_a = BlockID(b"\xaa" * 32, PartSetHeader(1, b"\xbb" * 32))
+                bid_b = BlockID(b"\xcc" * 32, PartSetHeader(1, b"\xdd" * 32))
+                v1 = make_vote(pv, "rpc-routes", 0, 1, 0, 1, bid_a, bt)
+                v2 = make_vote(pv, "rpc-routes", 0, 1, 0, 1, bid_b, bt)
+                ev_obj = DuplicateVoteEvidence.new(
+                    v1, v2, bt, node.state_store.load_validators(1)
+                )
+                out = _rpc_post(
+                    port=rpc_port, method="broadcast_evidence",
+                    params={
+                        "evidence": base64.b64encode(
+                            encode_evidence(ev_obj)
+                        ).decode()
+                    },
+                )["result"]
+                assert out["hash"] == ev_obj.hash().hex().upper()
+                # garbage evidence is a clean RPC error, not a 500
+                bad = _rpc_post(
+                    port=rpc_port, method="broadcast_evidence",
+                    params={"evidence": base64.b64encode(b"junk").decode()},
+                )
+                assert "error" in bad
+
+                # unsafe routes are refused without [rpc] unsafe
+                flush = _rpc_post(
+                    port=rpc_port, method="unsafe_flush_mempool", params={}
+                )
+                assert "error" in flush
+
+                # gRPC BroadcastAPI: ping + a tx end to end
+                from cometbft_tpu.rpc.grpc_api import BroadcastAPIClient
+
+                gclient = BroadcastAPIClient(f"127.0.0.1:{grpc_port}")
+                gclient.start()
+                try:
+                    gclient.ping()
+                    gres = gclient.broadcast_tx(b"grpc=yes")
+                    assert gres.check_tx is not None
+                    assert gres.check_tx.code == 0
+                    assert gres.deliver_tx is not None
+                    assert gres.deliver_tx.code == 0
+                finally:
+                    gclient.stop()
+
+                ws.close()
+            finally:
+                node.stop()
+
     def test_statesync_failure_falls_back_instead_of_wedging(self):
         """A dead statesync (no snapshots / provider failure) must not
         leave the node in wait-sync forever: it falls back to
