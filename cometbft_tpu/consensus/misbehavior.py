@@ -1,0 +1,115 @@
+"""Pluggable per-height consensus misbehaviors — the maverick node.
+
+Reference: test/maverick/consensus/misbehavior.go:15-17 — a maverick is a
+normal node whose consensus takes a ``height → misbehavior`` schedule
+(e2e manifests: ``misbehaviors = { 1018 = "double-prevote" }``,
+test/e2e/networks/ci.toml:41) and departs from the protocol at exactly
+those heights, so evidence detection/commitment can be tested against a
+live network rather than hand-crafted votes.
+
+Implemented misbehaviors (the reference's vote-equivocation pair):
+  * ``double-prevote``   — alongside the genuine prevote, broadcast a
+    conflicting prevote for a fabricated block.
+  * ``double-precommit`` — same, for precommits.
+
+`install(node, schedule)` wraps the node's ConsensusState vote signing in
+place; honest peers observe both votes in the live round, route the
+conflict through report_conflicting_votes into their evidence pools, and
+the DuplicateVoteEvidence lands in a committed block.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from cometbft_tpu.proto.gogo import Timestamp
+from cometbft_tpu.types.block import BlockID, PartSetHeader
+from cometbft_tpu.types.vote import (
+    SIGNED_MSG_TYPE_PRECOMMIT,
+    SIGNED_MSG_TYPE_PREVOTE,
+    Vote,
+)
+
+MISBEHAVIOR_TYPES = {
+    "double-prevote": SIGNED_MSG_TYPE_PREVOTE,
+    "double-precommit": SIGNED_MSG_TYPE_PRECOMMIT,
+}
+
+
+def install(node, schedule: Dict[int, str]) -> None:
+    """Arm a node with a per-height misbehavior schedule.
+
+    ``node`` is a node.Node (needs .consensus_state, .switch,
+    .priv_validator, .genesis_doc); each scheduled height fires at most
+    once. Unknown misbehavior names raise at install time, like the
+    reference's maverick flag parsing."""
+    for name in schedule.values():
+        if name not in MISBEHAVIOR_TYPES:
+            raise ValueError(
+                f"unknown misbehavior {name!r}; choose from "
+                f"{sorted(MISBEHAVIOR_TYPES)}"
+            )
+
+    from cometbft_tpu.consensus.messages import (
+        VoteMessage,
+        encode_consensus_message,
+    )
+    from cometbft_tpu.consensus.reactor import VOTE_CHANNEL
+
+    cons = node.consensus_state
+    chain_id = node.genesis_doc.chain_id
+    pv = node.priv_validator
+    genuine_sign = cons._sign_add_vote
+    fired: set = set()
+
+    def misbehaving_sign(msg_type, hash_, header):
+        rs = cons.rs
+        name = schedule.get(rs.height)
+        want_type = MISBEHAVIOR_TYPES.get(name) if name else None
+        if (
+            want_type == msg_type
+            and rs.height not in fired
+            and hash_  # equivocate only against a real (non-nil) vote
+            and cons.priv_validator_pub_key is not None
+        ):
+            fired.add(rs.height)
+            idx, _ = rs.validators.get_by_address(
+                cons.priv_validator_pub_key.address()
+            )
+            conflict = Vote(
+                type=msg_type,
+                height=rs.height,
+                round=rs.round,
+                block_id=BlockID(
+                    b"\xee" * 32, PartSetHeader(1, b"\xdd" * 32)
+                ),
+                timestamp=Timestamp(1_700_000_000, 0),
+                validator_address=cons.priv_validator_pub_key.address(),
+                validator_index=idx,
+            )
+            # sign with the raw key: the FilePV double-sign guard
+            # (correctly) refuses conflicting votes at one HRS, and a
+            # byzantine node is exactly the thing that bypasses it
+            if hasattr(pv, "priv_key"):
+                conflict.signature = pv.priv_key.sign(
+                    conflict.sign_bytes(chain_id)
+                )
+            else:
+                pv.sign_vote(chain_id, conflict)
+            node.switch.broadcast(
+                VOTE_CHANNEL,
+                encode_consensus_message(VoteMessage(conflict)),
+            )
+            genuine = genuine_sign(msg_type, hash_, header)
+            if genuine is not None:
+                # push the genuine vote too so both reach every peer
+                # back-to-back within the live round (normal gossip can
+                # lose the race against commit)
+                node.switch.broadcast(
+                    VOTE_CHANNEL,
+                    encode_consensus_message(VoteMessage(genuine)),
+                )
+            return genuine
+        return genuine_sign(msg_type, hash_, header)
+
+    cons._sign_add_vote = misbehaving_sign

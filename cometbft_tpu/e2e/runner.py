@@ -38,6 +38,7 @@ class Testnet:
         timeout_commit_ns: int = 300_000_000,
         base_dir: Optional[str] = None,
         logger: Optional[Logger] = None,
+        misbehaviors: Optional[Dict[int, Dict[int, str]]] = None,
     ):
         self.n = n_validators
         self.proxy_app = proxy_app
@@ -51,6 +52,9 @@ class Testnet:
         self.rpc_ports: List[int] = []
         self.p2p_ports: List[int] = []
         self._configs = []
+        # manifest-style maverick schedule: node index → {height: name}
+        # (test/e2e/networks/ci.toml:41 `misbehaviors = {1018 = "double-prevote"}`)
+        self.misbehaviors = misbehaviors or {}
 
     # -- setup ----------------------------------------------------------------
 
@@ -109,6 +113,10 @@ class Testnet:
         from cometbft_tpu.node import default_new_node
 
         node = default_new_node(self._configs[i], logger=self.logger)
+        if self.misbehaviors.get(i):
+            from cometbft_tpu.consensus import misbehavior
+
+            misbehavior.install(node, self.misbehaviors[i])
         node.start()
         self.nodes[i] = node
 
@@ -205,6 +213,46 @@ class Testnet:
         for i in self.live_indexes():
             got = self.client(i).tx(bytes.fromhex(tx_hash_hex))
             assert got["hash"].upper() == tx_hash_hex.upper()
+
+    def evidence_committed_for(self, node_index: int) -> bool:
+        """True when some live node has committed DuplicateVoteEvidence
+        naming `node_index`'s validator (the maverick schedule's
+        expected outcome — evidence_test.go analog). Scans incrementally
+        from a per-node watermark so a poll loop stays O(new blocks)."""
+        import base64 as _b64
+
+        from cometbft_tpu.types.evidence import (
+            DuplicateVoteEvidence,
+            decode_evidence,
+        )
+
+        if getattr(self, "_evidence_found", None) == node_index:
+            return True
+        target = None
+        node = self.nodes.get(node_index)
+        if node is not None:
+            target = node.priv_validator.get_pub_key().address()
+        marks = getattr(self, "_evidence_scan_marks", None)
+        if marks is None:
+            marks = self._evidence_scan_marks = {}
+        for i in self.live_indexes():
+            c = self.client(i)
+            top = self.height(i)
+            for h in range(marks.get(i, 1) + 1, top + 1):
+                blk = c.block(h)
+                marks[i] = h
+                for raw in blk["block"]["evidence"]["evidence"] or []:
+                    try:
+                        ev = decode_evidence(_b64.b64decode(raw))
+                    except ValueError:
+                        continue
+                    if isinstance(ev, DuplicateVoteEvidence) and (
+                        target is None
+                        or ev.vote_a.validator_address == target
+                    ):
+                        self._evidence_found = node_index
+                        return True
+        return False
 
     def check_block_results_consistent(self, upto: int) -> None:
         """Every node serves block_results whose DeliverTx count matches

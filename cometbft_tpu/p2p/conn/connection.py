@@ -169,6 +169,11 @@ class Channel:
                 return False
         return True
 
+    def has_queued_sends(self) -> bool:
+        """Read-only pending check: safe from ANY thread (is_send_pending
+        pops into `sending` and must only run on the mconn send thread)."""
+        return self.sending is not None or not self.send_queue.empty()
+
     def next_packet_msg(self) -> PacketMsg:
         assert self.sending is not None
         data = self.sending[: self.max_payload]
@@ -261,10 +266,12 @@ class MConnection(BaseService):
             pass
 
     def flush_stop(self) -> None:
-        """Best-effort: drain pending sends before stopping (FlushStop)."""
+        """Best-effort: drain pending sends before stopping (FlushStop).
+        Observes the queues read-only — popping here would race the send
+        thread and silently drop a frame."""
         deadline = time.monotonic() + 1.0
         while time.monotonic() < deadline:
-            if not any(ch.is_send_pending() for ch in self.channels):
+            if not any(ch.has_queued_sends() for ch in self.channels):
                 break
             self._send_signal.set()
             time.sleep(0.01)

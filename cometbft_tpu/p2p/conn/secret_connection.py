@@ -229,7 +229,16 @@ class SecretConnection:
                 )
                 return out
             sealed = _read_exact(self._sock, TOTAL_FRAME_SIZE + AEAD_SIZE_OVERHEAD)
-            frame = self._recv_aead.decrypt(self._recv_nonce.bytes(), sealed, None)
+            try:
+                frame = self._recv_aead.decrypt(
+                    self._recv_nonce.bytes(), sealed, None
+                )
+            except Exception as exc:
+                # forged/corrupted/replayed frame — a transport-level
+                # failure the caller handles like any broken connection
+                # (the reference's Read error → StopPeerForError), not a
+                # third-party crypto exception leaking through
+                raise ConnectionError("frame authentication failed") from exc
             self._recv_nonce.incr()
             (chunk_len,) = struct.unpack_from("<I", frame, 0)
             if chunk_len > DATA_MAX_SIZE:

@@ -5,17 +5,25 @@ Reference: p2p/pex/addrbook.go — addresses live in hashed "new" buckets
 MarkGood promotes new→old, MarkBad bans for a duration, PickAddress biases
 between bucket types, and the whole book is persisted to JSON.
 
-This implementation keeps the new/old split, per-address attempt/ban
-bookkeeping, biased picking and JSON persistence; the 256/64 hashed-bucket
-fan-out (an anti-eclipse measure sized for mainnet-scale books) is collapsed
-to two flat tables with the same external behavior.
+The anti-eclipse design is the reference's, in full: 256 new buckets and
+64 old buckets; an address's bucket index is a two-stage keyed hash
+(params.go, addrbook.go:830-884) over a random per-book key, the /16
+group of the address, and — for new buckets — the /16 group of the
+SOURCE that told us about it. An attacker who controls one netblock can
+therefore poison at most `newBucketsPerGroup` (32) of the 256 buckets,
+and a frequently-readvertised address occupies at most
+`maxNewBucketsPerAddress` (4). Bucket overflow evicts bad-then-oldest
+within the bucket only, so flooding cannot displace the rest of the book.
 """
 
 from __future__ import annotations
 
+import hashlib
+import ipaddress
 import json
 import os
 import random
+import struct
 import threading
 import time
 from dataclasses import dataclass, field
@@ -32,6 +40,15 @@ GET_SELECTION_PERCENT = 23
 MAX_GET_SELECTION = 250
 MIN_GET_SELECTION = 32
 
+# bucket geometry (reference params.go)
+OLD_BUCKET_COUNT = 64
+NEW_BUCKET_COUNT = 256
+OLD_BUCKET_SIZE = 64
+NEW_BUCKET_SIZE = 64
+OLD_BUCKETS_PER_GROUP = 4
+NEW_BUCKETS_PER_GROUP = 32
+MAX_NEW_BUCKETS_PER_ADDRESS = 4
+
 
 @dataclass
 class KnownAddress:
@@ -44,9 +61,15 @@ class KnownAddress:
     last_success: float = 0.0
     banned_until: float = 0.0
     is_old: bool = False  # old = proven good; new = merely heard of
+    buckets: List[int] = field(default_factory=list)  # indexes it lives in
 
     def is_banned(self) -> bool:
         return self.banned_until > time.time()
+
+    def is_bad(self) -> bool:
+        """Eviction preference (known_address.go isBad, simplified to the
+        observable inputs we track)."""
+        return self.is_banned() or (self.attempts >= 3 and not self.last_success)
 
     def to_json(self) -> dict:
         return {
@@ -65,21 +88,53 @@ class KnownAddress:
             "last_success": self.last_success,
             "banned_until": self.banned_until,
             "is_old": self.is_old,
+            "buckets": list(self.buckets),
         }
 
     @classmethod
     def from_json(cls, d: dict) -> "KnownAddress":
+        def parse_addr(obj) -> NetAddress:
+            # persisted files are operator-editable: type-check instead
+            # of letting junk flow into the bucket hashes
+            if (
+                not isinstance(obj, dict)
+                or not isinstance(obj.get("id"), str)
+                or not isinstance(obj.get("ip"), str)
+                or not isinstance(obj.get("port"), int)
+            ):
+                raise ValueError(f"malformed address entry: {obj!r}")
+            return NetAddress(obj["id"], obj["ip"], obj["port"])
+
         a = d["addr"]
         s = d.get("src")
         return cls(
-            addr=NetAddress(a["id"], a["ip"], a["port"]),
-            src=NetAddress(s["id"], s["ip"], s["port"]) if s else None,
+            addr=parse_addr(a),
+            src=parse_addr(s) if s else None,
             attempts=d.get("attempts", 0),
             last_attempt=d.get("last_attempt", 0.0),
             last_success=d.get("last_success", 0.0),
             banned_until=d.get("banned_until", 0.0),
             is_old=d.get("is_old", False),
+            buckets=[int(b) for b in d.get("buckets", [])],
         )
+
+
+def group_key_for(addr: NetAddress, routability_strict: bool) -> bytes:
+    """addrbook.go:890 groupKeyFor — the netblock an address belongs to:
+    'local'/'unroutable' sentinels, /16 for IPv4, /32 for IPv6."""
+    try:
+        ip = ipaddress.ip_address(addr.ip)
+    except ValueError:
+        return addr.ip.encode()  # hostname — group by name
+    if routability_strict and (ip.is_loopback or ip.is_private):
+        return b"local"
+    if routability_strict and not addr.routable():
+        return b"unroutable"
+    if ip.version == 4:
+        net = ipaddress.ip_network(f"{ip}/16", strict=False)
+        return str(net.network_address).encode()
+    net = ipaddress.ip_network(f"{ip}/32", strict=False)
+    return str(net.network_address).encode()
 
 
 class AddrBook(BaseService):
@@ -88,12 +143,21 @@ class AddrBook(BaseService):
         file_path: str = "",
         routability_strict: bool = True,
         logger: Optional[Logger] = None,
+        key: Optional[bytes] = None,
     ):
         super().__init__("AddrBook", logger or new_nop_logger())
         self.file_path = file_path
         self.routability_strict = routability_strict
         self._mtx = threading.RLock()
-        self._addrs: Dict[str, KnownAddress] = {}  # by node ID
+        self._addrs: Dict[str, KnownAddress] = {}  # by node ID (addrLookup)
+        self._new_buckets: List[Dict[str, KnownAddress]] = [
+            {} for _ in range(NEW_BUCKET_COUNT)
+        ]
+        self._old_buckets: List[Dict[str, KnownAddress]] = [
+            {} for _ in range(OLD_BUCKET_COUNT)
+        ]
+        self._key = key if key is not None else os.urandom(24)
+        self._banned: Dict[str, KnownAddress] = {}  # off-bucket tombstones
         self._our_addrs: set = set()
         self._private_ids: set = set()
 
@@ -105,6 +169,33 @@ class AddrBook(BaseService):
 
     def on_stop(self) -> None:
         self.save()
+
+    # -- bucket math (addrbook.go:830-884) ----------------------------------
+
+    def _group_key(self, addr: NetAddress) -> bytes:
+        return group_key_for(addr, self.routability_strict)
+
+    def _hash64(self, data: bytes) -> int:
+        return struct.unpack(
+            ">Q", hashlib.sha256(data).digest()[:8]
+        )[0]
+
+    def calc_new_bucket(self, addr: NetAddress, src: Optional[NetAddress]) -> int:
+        """Two-stage keyed hash: the source group picks a 32-bucket slice,
+        the (addr group, src group) pair picks the slot within it."""
+        src_group = self._group_key(src) if src else b""
+        h1 = self._hash64(self._key + self._group_key(addr) + src_group)
+        h1 %= NEW_BUCKETS_PER_GROUP
+        h2 = self._hash64(self._key + src_group + struct.pack(">Q", h1))
+        return h2 % NEW_BUCKET_COUNT
+
+    def calc_old_bucket(self, addr: NetAddress) -> int:
+        h1 = self._hash64(self._key + str(addr).encode())
+        h1 %= OLD_BUCKETS_PER_GROUP
+        h2 = self._hash64(
+            self._key + self._group_key(addr) + struct.pack(">Q", h1)
+        )
+        return h2 % OLD_BUCKET_COUNT
 
     # -- our own identity ---------------------------------------------------
 
@@ -120,10 +211,53 @@ class AddrBook(BaseService):
         with self._mtx:
             self._private_ids.update(ids)
 
+    # -- bucket plumbing -----------------------------------------------------
+
+    def _add_to_new_bucket(self, ka: KnownAddress, bucket_idx: int) -> None:
+        bucket = self._new_buckets[bucket_idx]
+        if ka.addr.id in bucket:
+            return
+        if len(bucket) >= NEW_BUCKET_SIZE:
+            self._expire_new(bucket_idx)
+        bucket[ka.addr.id] = ka
+        if bucket_idx not in ka.buckets:
+            ka.buckets.append(bucket_idx)
+        self._addrs[ka.addr.id] = ka
+
+    def _expire_new(self, bucket_idx: int) -> None:
+        """addrbook.go expireNew: drop a bad address if any, else the
+        oldest — eviction stays WITHIN the bucket (anti-flooding)."""
+        bucket = self._new_buckets[bucket_idx]
+        victim = None
+        for ka in bucket.values():
+            if ka.is_bad():
+                victim = ka
+                break
+        if victim is None:
+            victim = min(
+                bucket.values(), key=lambda k: k.last_attempt or k.last_success
+            )
+        self._remove_from_new_bucket(victim, bucket_idx)
+
+    def _remove_from_new_bucket(self, ka: KnownAddress, bucket_idx: int) -> None:
+        self._new_buckets[bucket_idx].pop(ka.addr.id, None)
+        if bucket_idx in ka.buckets:
+            ka.buckets.remove(bucket_idx)
+        if not ka.buckets:
+            self._addrs.pop(ka.addr.id, None)
+
+    def _remove_from_all_buckets(self, ka: KnownAddress) -> None:
+        table = self._old_buckets if ka.is_old else self._new_buckets
+        for b in ka.buckets:
+            table[b].pop(ka.addr.id, None)
+        ka.buckets = []
+        self._addrs.pop(ka.addr.id, None)
+
     # -- core ops -----------------------------------------------------------
 
     def add_address(self, addr: NetAddress, src: Optional[NetAddress]) -> None:
-        """addrbook.go:213 AddAddress — new addresses land in 'new'."""
+        """addrbook.go:213 AddAddress — new addresses land in a hashed
+        'new' bucket chosen by (addr group, src group)."""
         with self._mtx:
             if addr.valid() is not None:
                 raise ValueError(f"invalid address {addr}: {addr.valid()}")
@@ -131,24 +265,32 @@ class AddrBook(BaseService):
                 raise ValueError(f"non-routable address {addr}")
             if str(addr) in self._our_addrs or addr.id in self._private_ids:
                 return
+            banned = self._banned.get(addr.id)
+            if banned is not None:
+                if banned.is_banned():
+                    return
+                self._banned.pop(addr.id, None)
             ka = self._addrs.get(addr.id)
             if ka is not None:
-                if ka.is_banned():
-                    return
                 if ka.is_old:
                     return  # already proven; keep old record
+                if len(ka.buckets) >= MAX_NEW_BUCKETS_PER_ADDRESS:
+                    return
                 ka.addr = addr
                 ka.src = src or ka.src
-                return
-            self._addrs[addr.id] = KnownAddress(addr=addr, src=src)
+            else:
+                ka = KnownAddress(addr=addr, src=src)
+            self._add_to_new_bucket(ka, self.calc_new_bucket(addr, src))
 
     def remove_address(self, addr: NetAddress) -> None:
         with self._mtx:
-            self._addrs.pop(addr.id, None)
+            ka = self._addrs.get(addr.id)
+            if ka is not None:
+                self._remove_from_all_buckets(ka)
 
     def has_address(self, addr: NetAddress) -> bool:
         with self._mtx:
-            return addr.id in self._addrs
+            return addr.id in self._addrs or addr.id in self._banned
 
     def is_good(self, addr: NetAddress) -> bool:
         with self._mtx:
@@ -157,18 +299,42 @@ class AddrBook(BaseService):
 
     def is_banned(self, addr: NetAddress) -> bool:
         with self._mtx:
-            ka = self._addrs.get(addr.id)
+            ka = self._banned.get(addr.id)
             return ka is not None and ka.is_banned()
 
     def mark_good(self, node_id: str) -> None:
-        """addrbook.go:322 — promote to 'old' on successful connection."""
+        """addrbook.go:322 — promote to 'old' on successful connection
+        (moveToOld: leave every new bucket, enter one old bucket)."""
         with self._mtx:
             ka = self._addrs.get(node_id)
             if ka is None:
                 return
             ka.last_success = time.time()
             ka.attempts = 0
+            if ka.is_old:
+                return
+            # leave all new buckets
+            for b in list(ka.buckets):
+                self._new_buckets[b].pop(ka.addr.id, None)
+            ka.buckets = []
             ka.is_old = True
+            old_idx = self.calc_old_bucket(ka.addr)
+            bucket = self._old_buckets[old_idx]
+            if len(bucket) >= OLD_BUCKET_SIZE:
+                # displace the oldest old-entry back into a new bucket
+                demoted = min(
+                    bucket.values(),
+                    key=lambda k: k.last_success,
+                )
+                bucket.pop(demoted.addr.id, None)
+                demoted.buckets = []
+                demoted.is_old = False
+                self._add_to_new_bucket(
+                    demoted, self.calc_new_bucket(demoted.addr, demoted.src)
+                )
+            bucket[ka.addr.id] = ka
+            ka.buckets = [old_idx]
+            self._addrs[ka.addr.id] = ka
 
     def mark_attempt(self, addr: NetAddress) -> None:
         with self._mtx:
@@ -179,25 +345,37 @@ class AddrBook(BaseService):
             ka.last_attempt = time.time()
 
     def mark_bad(self, addr: NetAddress, ban_time: float = DEFAULT_BAN_TIME) -> None:
+        """addrbook.go MarkBad — the address leaves the tables entirely
+        (a banned entry must not occupy a bucket slot a live candidate
+        could use) and sits in a tombstone map until reinstated."""
         with self._mtx:
             ka = self._addrs.get(addr.id)
             if ka is None:
                 return
+            self._remove_from_all_buckets(ka)
             ka.banned_until = time.time() + ban_time
             ka.is_old = False
+            self._banned[ka.addr.id] = ka
 
     def reinstate_bad_peers(self) -> None:
+        """addrbook.go ReinstateBadPeers — expired bans re-enter the new
+        table."""
         with self._mtx:
             now = time.time()
-            for ka in self._addrs.values():
-                if ka.banned_until and ka.banned_until <= now:
+            for node_id in list(self._banned):
+                ka = self._banned[node_id]
+                if ka.banned_until <= now:
+                    del self._banned[node_id]
                     ka.banned_until = 0.0
+                    self._add_to_new_bucket(
+                        ka, self.calc_new_bucket(ka.addr, ka.src)
+                    )
 
     # -- queries ------------------------------------------------------------
 
     def size(self) -> int:
         with self._mtx:
-            return sum(1 for k in self._addrs.values() if not k.is_banned())
+            return len(self._addrs)  # banned entries live off-table
 
     def empty(self) -> bool:
         return self.size() == 0
@@ -206,25 +384,25 @@ class AddrBook(BaseService):
         return self.size() < NEED_ADDRESS_THRESHOLD
 
     def pick_address(self, bias_towards_new: int) -> Optional[NetAddress]:
-        """addrbook.go:272 — pick random, biased between old/new (0..100)."""
+        """addrbook.go:272 PickAddress — choose the table by bias, then a
+        random non-empty BUCKET, then a random entry within it (bucket-
+        uniform, so one flooded netblock does not dominate the draw)."""
         bias = max(0, min(100, bias_towards_new))
         with self._mtx:
-            news = [
-                k for k in self._addrs.values()
-                if not k.is_old and not k.is_banned()
-            ]
-            olds = [
-                k for k in self._addrs.values()
-                if k.is_old and not k.is_banned()
-            ]
-            if not news and not olds:
-                return None
-            pick_new = (
-                bool(news)
-                and (not olds or random.random() * 100 < bias)
-            )
-            pool = news if pick_new else olds
-            return random.choice(pool).addr
+            pick_new = random.random() * 100 < bias
+            for attempt_new in (pick_new, not pick_new):
+                table = self._new_buckets if attempt_new else self._old_buckets
+                buckets = [
+                    b
+                    for b in table
+                    if any(not k.is_banned() for k in b.values())
+                ]
+                if not buckets:
+                    continue
+                bucket = random.choice(buckets)
+                cands = [k for k in bucket.values() if not k.is_banned()]
+                return random.choice(cands).addr
+            return None
 
     def get_selection(self) -> List[NetAddress]:
         """Random ~23% (bounded) of the book for a PEX reply."""
@@ -262,15 +440,47 @@ class AddrBook(BaseService):
             return
         with self._mtx:
             doc = {
-                "key": "addrbook",
-                "addrs": [k.to_json() for k in self._addrs.values()],
+                "key": self._key.hex(),
+                "addrs": [
+                    k.to_json()
+                    for k in list(self._addrs.values())
+                    + list(self._banned.values())
+                ],
             }
         write_file_atomic(self.file_path, json.dumps(doc, indent=1).encode())
 
     def _load(self) -> None:
         with open(self.file_path) as f:
             doc = json.load(f)
+        if not isinstance(doc, dict):
+            raise ValueError(
+                f"malformed addrbook file {self.file_path}: not an object"
+            )
         with self._mtx:
+            key = doc.get("key", "")
+            try:
+                self._key = bytes.fromhex(key) if key else self._key
+            except ValueError:
+                pass  # legacy/corrupt key — keep the fresh one
             for d in doc.get("addrs", []):
                 ka = KnownAddress.from_json(d)
+                if ka.is_banned():
+                    self._banned[ka.addr.id] = ka
+                    continue
+                ka.banned_until = 0.0
+                # placement is RECOMPUTED from the persisted key — the
+                # file's bucket list is operator-editable and must not be
+                # able to spread one address over arbitrary buckets
+                ka.buckets = []
+                if ka.is_old:
+                    idx = self.calc_old_bucket(ka.addr)
+                    if len(self._old_buckets[idx]) >= OLD_BUCKET_SIZE:
+                        continue
+                    self._old_buckets[idx][ka.addr.id] = ka
+                else:
+                    idx = self.calc_new_bucket(ka.addr, ka.src)
+                    if len(self._new_buckets[idx]) >= NEW_BUCKET_SIZE:
+                        continue
+                    self._new_buckets[idx][ka.addr.id] = ka
+                ka.buckets = [idx]
                 self._addrs[ka.addr.id] = ka

@@ -300,6 +300,13 @@ class Switch(BaseService):
                 ).start()
 
     def stop_peer_gracefully(self, peer: Peer) -> None:
+        # graceful = let queued frames drain first (the reference's
+        # FlushStop) — a seed that answers a PEX request and hangs up
+        # must not lose the answer in the close race
+        try:
+            peer.flush_stop()
+        except Exception:
+            pass
         self._stop_and_remove_peer(peer, None)
 
     def _stop_and_remove_peer(self, peer: Peer, reason) -> None:

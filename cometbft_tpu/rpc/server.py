@@ -190,7 +190,11 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._reply_json(self._handle_one(req))
 
-    def _handle_one(self, req: dict):
+    def _handle_one(self, req):
+        if not isinstance(req, dict):
+            # a JSON scalar/array member is not a request object — answer
+            # Invalid Request instead of crashing the connection
+            return _error_obj(None, -32600, "Invalid Request", "")
         rid = req.get("id")
         method = req.get("method", "")
         params = req.get("params") or {}

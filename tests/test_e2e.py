@@ -65,3 +65,32 @@ class TestE2ETestnet:
         finally:
             load.stop()
             net.stop()
+
+    def test_scheduled_misbehavior_commits_evidence(self):
+        """Maverick via the runner API (test/maverick +
+        test/e2e/networks/ci.toml `misbehaviors`): node 0 is scheduled to
+        double-precommit at heights 3-5; the honest majority detects the
+        equivocation and commits DuplicateVoteEvidence naming node 0."""
+        net = Testnet(
+            n_validators=4,
+            timeout_commit_ns=200_000_000,
+            misbehaviors={0: {3: "double-precommit",
+                              4: "double-precommit",
+                              5: "double-precommit"}},
+        )
+        net.setup()
+        net.start()
+        try:
+            deadline = time.time() + 150
+            while time.time() < deadline:
+                if net.evidence_committed_for(0):
+                    break
+                time.sleep(1.0)
+            assert net.evidence_committed_for(0), (
+                "evidence for the scheduled misbehavior never committed"
+            )
+            # the net keeps making progress with the maverick aboard
+            h = max(net.height(i) for i in net.live_indexes())
+            net.wait_for_height(h + 2, timeout=60)
+        finally:
+            net.stop()
