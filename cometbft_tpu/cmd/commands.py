@@ -232,6 +232,114 @@ def cmd_testnet(args) -> int:
     return 0
 
 
+def cmd_loadtime(args) -> int:
+    """Standalone load generator + latency report (test/loadtime): txs
+    carry their send timestamp; latency = commit ack - send. Drives
+    broadcast_tx_commit over `--connections` concurrent workers against
+    one or more node RPC endpoints and prints one JSON report."""
+    import json as _json
+    import threading as _threading
+    import time as _time
+
+    from cometbft_tpu.rpc.client import HTTPClient
+
+    endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    if not endpoints:
+        print("no --endpoints given", file=sys.stderr)
+        return 1
+    stop = _threading.Event()
+    mtx = _threading.Lock()
+    stats = {"sent": 0, "committed": 0, "latencies": []}
+
+    def worker(wid: int):
+        client = HTTPClient(endpoints[wid % len(endpoints)], timeout=30)
+        period = args.connections / args.rate if args.rate > 0 else 0.0
+        seq = 0
+        while not stop.is_set():
+            tx = (
+                f"load-c{wid}-{seq}={_time.monotonic_ns()}"
+                + "x" * max(0, args.size - 24)
+            ).encode()[: max(args.size, 16)]
+            seq += 1
+            t0 = _time.monotonic()
+            ok = False
+            try:
+                res = client.broadcast_tx_commit(tx)
+                ok = (res.get("deliver_tx") or {}).get("code", 1) == 0
+            except Exception:
+                pass
+            with mtx:
+                # commits landing after the window closes are drained,
+                # not measured — throughput divides by the WINDOW
+                if not stop.is_set():
+                    stats["sent"] += 1
+                    if ok:
+                        stats["committed"] += 1
+                        stats["latencies"].append(_time.monotonic() - t0)
+            stop.wait(period)
+
+    threads = [
+        _threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(args.connections)
+    ]
+    t_start = _time.monotonic()
+    for t in threads:
+        t.start()
+    try:
+        _time.sleep(args.duration)
+    except KeyboardInterrupt:
+        pass
+    stop.set()
+    wall = _time.monotonic() - t_start  # the measurement window
+    for t in threads:
+        t.join(35.0)
+    lat = sorted(stats["latencies"])
+
+    def pct(p: float):
+        return round(lat[min(int(len(lat) * p), len(lat) - 1)], 4) if lat else None
+
+    print(
+        _json.dumps(
+            {
+                "duration_s": round(wall, 2),
+                "connections": args.connections,
+                "target_rate_tx_s": args.rate,
+                "sent": stats["sent"],
+                "committed": stats["committed"],
+                "throughput_tx_s": round(stats["committed"] / wall, 2),
+                "latency_s": {
+                    "min": round(lat[0], 4) if lat else None,
+                    "p50": pct(0.50),
+                    "p90": pct(0.90),
+                    "p99": pct(0.99),
+                    "max": round(lat[-1], 4) if lat else None,
+                },
+            }
+        )
+    )
+    return 0
+
+
+def cmd_probe_upnp(args) -> int:
+    """probe_upnp.go — report the NAT's UPnP capabilities as JSON."""
+    import json as _json
+
+    from cometbft_tpu.p2p import upnp
+
+    try:
+        caps = upnp.probe(internal_port=args.port)
+    except (upnp.UPnPError, OSError) as exc:
+        # no gateway / unbindable probe port is a finding, not a crash
+        print(_json.dumps({"error": str(exc)}))
+        return 0
+    print(
+        _json.dumps(
+            {"port_mapping": caps.port_mapping, "hairpin": caps.hairpin}
+        )
+    )
+    return 0
+
+
 def cmd_version(_args) -> int:
     print(VERSION)
     return 0
@@ -981,6 +1089,25 @@ def main(argv: Optional[list] = None) -> int:
 
     p = sub.add_parser("version", help="print the version")
     p.set_defaults(fn=cmd_version)
+
+    p = sub.add_parser(
+        "probe-upnp", help="probe the local NAT for UPnP port-mapping"
+    )
+    p.add_argument("--port", type=int, default=8001)
+    p.set_defaults(fn=cmd_probe_upnp)
+
+    p = sub.add_parser(
+        "loadtime", help="generate tx load and report commit latency"
+    )
+    p.add_argument(
+        "--endpoints", required=True,
+        help="comma-separated node RPC host:port list",
+    )
+    p.add_argument("--rate", type=float, default=10.0, help="total tx/s")
+    p.add_argument("--connections", type=int, default=1)
+    p.add_argument("--duration", type=float, default=10.0, help="seconds")
+    p.add_argument("--size", type=int, default=64, help="tx bytes")
+    p.set_defaults(fn=cmd_loadtime)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -2,17 +2,18 @@
 
 Reference: crypto/merkle/tree.go:9 HashFromByteSlices — recursive,
 one stdlib SHA-256 call per node. Here the full reduction runs as a
-single jitted program: leaves are hashed on the host (variable length,
-C-speed hashlib), then every inner level — pairwise SHA-256 over fixed
-65-byte messages (0x01 ‖ left ‖ right) — happens on-device with no
-host↔device round-trips between levels. Level counts are carried as a
-traced scalar over a fixed log2(P) level loop, with the odd tail carried
-up unhashed, which reproduces the reference's largest-power-of-two-split
-tree shape exactly for every n.
+single jitted program: leaf hashing (0x00 ‖ item, ragged lengths padded
+host-side into per-lane block counts) AND every inner level — pairwise
+SHA-256 over fixed 65-byte messages (0x01 ‖ left ‖ right) — happen
+on-device with no host↔device round-trips anywhere. Level counts are
+carried as a traced scalar over a fixed log2(P) level loop, with the odd
+tail carried up unhashed, which reproduces the reference's
+largest-power-of-two-split tree shape exactly for every n.
 
-One compilation per power-of-two padded size; lanes beyond the live
-count compute garbage that is masked out, which costs nothing on the
-VPU's fixed-width lanes.
+One compilation per (power-of-two padded size, leaf block count); lanes
+beyond the live count compute garbage that is masked out, which costs
+nothing on the VPU's fixed-width lanes. CBFT_TPU_MERKLE_LEAVES=host
+falls back to hashlib leaf hashing (the round-3 design) for A/B timing.
 
 Bit-identical to crypto.merkle.hash_from_byte_slices for every n
 (tests/test_tpu_merkle.py parity suite).
@@ -35,6 +36,9 @@ _INNER_LEN = 65  # 0x01 || left32 || right32
 
 # device becomes worth the round-trip above this many leaves
 MIN_DEVICE_LEAVES = 128
+# device leaf hashing caps the per-item size (16 SHA blocks ≈ 1 KiB);
+# larger items fall back to host-hashed leaves + device tree
+_MAX_DEVICE_LEAF_BYTES = 16 * 64 - 9
 
 
 def _pad_pow2(n: int) -> int:
@@ -66,15 +70,13 @@ def _inner_blocks(left: jnp.ndarray, right: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack([block0, block1], axis=-2)
 
 
-@partial(jax.jit, static_argnames=("levels",))
-def _tree_kernel(digests: jnp.ndarray, m0: jnp.ndarray, levels: int):
-    """digests u32[P,8] (first m0 live), P = 2^levels → root u32[8].
+def _tree_reduce(a: jnp.ndarray, m0: jnp.ndarray, levels: int):
+    """a u32[P,8] leaf digests (first m0 live), P = 2^levels → root u32[8].
 
     Each iteration halves the live count: hash the even/odd pairs, carry
     an odd tail unhashed. Runs exactly `levels` iterations; once the live
     count reaches 1 further iterations are identity (pairs = 0, the
     single root carries itself), so over-running is harmless."""
-    a = digests
     m = m0.astype(jnp.int32)
     for _ in range(levels):
         # the array SHRINKS each level (static shapes, loop is unrolled):
@@ -99,30 +101,74 @@ def _tree_kernel(digests: jnp.ndarray, m0: jnp.ndarray, levels: int):
     return a[0]
 
 
+@partial(jax.jit, static_argnames=("levels",))
+def _tree_kernel(digests: jnp.ndarray, m0: jnp.ndarray, levels: int):
+    """Host-hashed-leaves path: digests u32[P,8] → root u32[8]."""
+    return _tree_reduce(digests, m0, levels)
+
+
+@partial(jax.jit, static_argnames=("levels",))
+def _leaves_and_tree_kernel(
+    blocks: jnp.ndarray,  # u32[P, n_blocks, 16] — padded 0x00‖item messages
+    n_live: jnp.ndarray,  # int32[P] — per-lane live block counts
+    m0: jnp.ndarray,
+    levels: int,
+):
+    """The full root in one dispatch: ragged leaf SHA-256, then the
+    tree reduction, with no host round-trip between them."""
+    digests = tpu_sha.sha256_blocks_ragged(blocks, n_live)  # [P, 8]
+    return _tree_reduce(digests, m0, levels)
+
+
 def hash_from_byte_slices(
     items: Sequence[bytes], force_device: bool = False
 ) -> bytes:
     """Drop-in parallel replacement for
     crypto.merkle.hash_from_byte_slices (tree.go:9)."""
+    import os
+
     n = len(items)
     if n == 0:
         return hashlib.sha256(b"").digest()
-    leaves = [
-        hashlib.sha256(_LEAF_PREFIX + bytes(item)).digest() for item in items
-    ]
     if n == 1:
-        return leaves[0]
+        return hashlib.sha256(_LEAF_PREFIX + bytes(items[0])).digest()
     if not force_device and n < MIN_DEVICE_LEAVES:
-        return _host_tree(leaves)
-    # pack digests to big-endian u32 words only for the device path
-    raw = np.frombuffer(b"".join(leaves), np.uint8).reshape(n, 8, 4)
-    w = raw.astype(np.uint32)
-    words = (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) | w[..., 3]
+        return _host_tree(
+            [
+                hashlib.sha256(_LEAF_PREFIX + bytes(item)).digest()
+                for item in items
+            ]
+        )
     p = max(2, _pad_pow2(n))
     levels = p.bit_length() - 1
-    padded = np.zeros((p, 8), np.uint32)
-    padded[:n] = words
-    root = _tree_kernel(padded, np.int32(n), levels)
+    device_leaves = (
+        os.environ.get("CBFT_TPU_MERKLE_LEAVES", "device") == "device"
+        # one oversized item would pad EVERY lane to its block count
+        # (O(n·max_len) buffers + a fresh compile per max_blocks): leave
+        # rare big-item sets — app-controlled DeliverTx results, say —
+        # on the fixed-cost host-leaf path
+        and max(len(it) for it in items) <= _MAX_DEVICE_LEAF_BYTES
+    )
+    if device_leaves:
+        blocks, n_live = tpu_sha.pad_ragged_np(items, prefix=_LEAF_PREFIX)
+        padded = np.zeros((p,) + blocks.shape[1:], np.uint32)
+        padded[:n] = blocks
+        live = np.zeros(p, np.int32)
+        live[:n] = n_live
+        root = _leaves_and_tree_kernel(padded, live, np.int32(n), levels)
+    else:
+        leaves = [
+            hashlib.sha256(_LEAF_PREFIX + bytes(item)).digest()
+            for item in items
+        ]
+        raw = np.frombuffer(b"".join(leaves), np.uint8).reshape(n, 8, 4)
+        w = raw.astype(np.uint32)
+        words = (
+            (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) | w[..., 3]
+        )
+        padded = np.zeros((p, 8), np.uint32)
+        padded[:n] = words
+        root = _tree_kernel(padded, np.int32(n), levels)
     return tpu_sha.digests_to_bytes_np(np.asarray(root)[None, :])[0].tobytes()
 
 

@@ -1,0 +1,138 @@
+"""Opt-in deadlock detection for the ~20-threads-per-node runtime.
+
+Reference: libs/sync/deadlock.go — under the `deadlock` build tag every
+cmtsync.Mutex becomes a go-deadlock mutex that reports lock-order
+inversions and acquisitions stuck longer than a threshold. The Python
+analog: ``enable()`` (or env ``CBFT_DEADLOCK=1`` at import) swaps
+``threading.Lock``/``threading.RLock`` for wrappers whose blocking
+acquires poll with a timeout; an acquire stuck past the threshold dumps
+every thread's stack — the would-be holder included — to stderr and
+keeps waiting, so a wedged node self-diagnoses instead of hanging
+silently. CI can run any suite under the env flag the way the reference
+runs `-tags deadlock` builds.
+
+Zero overhead when disabled: nothing is patched until enable() runs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+DEFAULT_TIMEOUT_S = 30.0
+
+_enabled = False
+_orig_lock = threading.Lock
+_orig_rlock = threading.RLock
+
+
+def _dump_all_stacks(reason: str) -> None:
+    out = [f"\n==== POTENTIAL DEADLOCK: {reason} ====\n"]
+    frames = sys._current_frames()
+    for t in threading.enumerate():
+        frame = frames.get(t.ident)
+        out.append(f"--- thread {t.name} (daemon={t.daemon}) ---\n")
+        if frame is not None:
+            out.extend(traceback.format_stack(frame))
+    out.append("==== end deadlock dump ====\n")
+    sys.stderr.write("".join(out))
+    sys.stderr.flush()
+
+
+class _DetectingLockMixin:
+    """Blocking acquire → bounded polls + an all-stacks dump on timeout."""
+
+    _factory = None  # set per subclass
+
+    def __init__(self):
+        self._inner = self._factory()
+        self.timeout = DEFAULT_TIMEOUT_S
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if not blocking or timeout >= 0:
+            return self._inner.acquire(blocking, timeout)
+        deadline = time.monotonic() + self.timeout
+        while True:
+            if self._inner.acquire(True, min(1.0, self.timeout)):
+                return True
+            if time.monotonic() >= deadline:
+                _dump_all_stacks(
+                    f"lock held > {self.timeout:.0f}s, "
+                    f"waiter: {threading.current_thread().name}"
+                )
+                deadline = time.monotonic() + self.timeout  # keep waiting
+
+    def release(self):
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class _DetectingLock(_DetectingLockMixin):
+    _factory = staticmethod(_orig_lock)
+
+
+class _DetectingRLock(_DetectingLockMixin):
+    _factory = staticmethod(_orig_rlock)
+
+    def locked(self):  # RLock has no locked() pre-3.12-compatible way
+        got = self._inner.acquire(False)
+        if got:
+            self._inner.release()
+        return not got
+
+    # threading.Condition probes these on its lock; without them it falls
+    # back to an acquire(False) ownership test that misreports a held
+    # RLock (recursive acquire succeeds) and breaks every cond.wait()
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        return self._inner._acquire_restore(state)
+
+
+def enable(timeout_s: Optional[float] = None) -> None:
+    """Swap threading.Lock/RLock for detecting variants, process-wide.
+    Affects locks created AFTER this call — call it before node
+    assembly (conftest/bootstrap), as the reference's build tag does."""
+    global _enabled, DEFAULT_TIMEOUT_S
+    if timeout_s is not None:
+        DEFAULT_TIMEOUT_S = timeout_s
+    if _enabled:
+        return
+    threading.Lock = _DetectingLock  # type: ignore[misc]
+    threading.RLock = _DetectingRLock  # type: ignore[misc]
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    threading.Lock = _orig_lock  # type: ignore[misc]
+    threading.RLock = _orig_rlock  # type: ignore[misc]
+    _enabled = False
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+if os.environ.get("CBFT_DEADLOCK") == "1":  # build-tag analog
+    enable(
+        float(os.environ.get("CBFT_DEADLOCK_TIMEOUT", DEFAULT_TIMEOUT_S))
+    )

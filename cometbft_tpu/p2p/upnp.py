@@ -1,0 +1,221 @@
+"""UPnP NAT discovery and port mapping.
+
+Reference: p2p/upnp/{upnp,probe}.go — SSDP M-SEARCH multicast discovery
+of an InternetGatewayDevice, device-description fetch to find the
+WANIPConnection control URL, SOAP calls for GetExternalIPAddress /
+AddPortMapping / DeletePortMapping, and a Probe() that reports
+(PortMapping, Hairpin) capabilities. Used by the `probe-upnp` CLI
+command for operators behind consumer NATs.
+
+Pure stdlib (sockets + minimal XML/SOAP); discovery is bounded by
+timeouts and degrades to a clean UPnPError when no gateway answers —
+the normal case in datacenters and CI.
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+import urllib.request
+from dataclasses import dataclass
+from typing import Optional, Tuple
+from xml.etree import ElementTree
+
+SSDP_ADDR = ("239.255.255.250", 1900)
+_MSEARCH = (
+    "M-SEARCH * HTTP/1.1\r\n"
+    "HOST: 239.255.255.250:1900\r\n"
+    "ST: ssdp:all\r\n"
+    'MAN: "ssdp:discover"\r\n'
+    "MX: 2\r\n\r\n"
+).encode()
+
+_IGD_MARKERS = ("InternetGatewayDevice", "WANIPConnection", "WANPPPConnection")
+_SERVICE_TYPES = (
+    "urn:schemas-upnp-org:service:WANIPConnection:1",
+    "urn:schemas-upnp-org:service:WANPPPConnection:1",
+)
+
+
+class UPnPError(Exception):
+    pass
+
+
+@dataclass
+class NAT:
+    """A discovered gateway (upnp.go upnpNAT)."""
+
+    control_url: str
+    service_type: str
+    our_ip: str
+
+    # -- SOAP ----------------------------------------------------------------
+
+    def _soap(self, action: str, body_args: str) -> str:
+        envelope = (
+            '<?xml version="1.0"?>'
+            '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/soap/envelope/" '
+            's:encodingStyle="http://schemas.xmlsoap.org/soap/encoding/">'
+            "<s:Body>"
+            f'<u:{action} xmlns:u="{self.service_type}">{body_args}</u:{action}>'
+            "</s:Body></s:Envelope>"
+        )
+        req = urllib.request.Request(
+            self.control_url,
+            data=envelope.encode(),
+            headers={
+                "Content-Type": 'text/xml; charset="utf-8"',
+                "SOAPAction": f'"{self.service_type}#{action}"',
+            },
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.read().decode(errors="replace")
+        except Exception as exc:
+            raise UPnPError(f"SOAP {action} failed: {exc}") from exc
+
+    def external_ip(self) -> str:
+        """upnp.go GetExternalAddress."""
+        out = self._soap("GetExternalIPAddress", "")
+        m = re.search(
+            r"<NewExternalIPAddress>([^<]+)</NewExternalIPAddress>", out
+        )
+        if not m:
+            raise UPnPError("gateway returned no external IP")
+        return m.group(1).strip()
+
+    def add_port_mapping(
+        self,
+        protocol: str,
+        external_port: int,
+        internal_port: int,
+        description: str = "cometbft-tpu",
+        lease_seconds: int = 0,
+    ) -> int:
+        """upnp.go AddPortMapping → mapped external port."""
+        self._soap(
+            "AddPortMapping",
+            f"<NewRemoteHost></NewRemoteHost>"
+            f"<NewExternalPort>{external_port}</NewExternalPort>"
+            f"<NewProtocol>{protocol.upper()}</NewProtocol>"
+            f"<NewInternalPort>{internal_port}</NewInternalPort>"
+            f"<NewInternalClient>{self.our_ip}</NewInternalClient>"
+            f"<NewEnabled>1</NewEnabled>"
+            f"<NewPortMappingDescription>{description}</NewPortMappingDescription>"
+            f"<NewLeaseDuration>{lease_seconds}</NewLeaseDuration>",
+        )
+        return external_port
+
+    def delete_port_mapping(self, protocol: str, external_port: int) -> None:
+        self._soap(
+            "DeletePortMapping",
+            f"<NewRemoteHost></NewRemoteHost>"
+            f"<NewExternalPort>{external_port}</NewExternalPort>"
+            f"<NewProtocol>{protocol.upper()}</NewProtocol>",
+        )
+
+
+def _parse_ssdp_location(answer: str) -> Optional[str]:
+    if not any(marker in answer for marker in _IGD_MARKERS):
+        return None
+    for line in answer.split("\r\n"):
+        if line.lower().startswith("location:"):
+            return line.split(":", 1)[1].strip()
+    return None
+
+
+def _control_url_from_description(location: str) -> Tuple[str, str]:
+    """Fetch the device description XML; → (control URL, service type)."""
+    try:
+        with urllib.request.urlopen(location, timeout=5) as resp:
+            tree = ElementTree.fromstring(resp.read())
+    except Exception as exc:
+        raise UPnPError(f"device description fetch failed: {exc}") from exc
+    ns = {"d": "urn:schemas-upnp-org:device-1-0"}
+    for svc in tree.iter("{urn:schemas-upnp-org:device-1-0}service"):
+        st = svc.findtext("d:serviceType", default="", namespaces=ns)
+        if st in _SERVICE_TYPES:
+            control = svc.findtext("d:controlURL", default="", namespaces=ns)
+            if control:
+                if control.startswith("http"):
+                    return control, st
+                base = location.split("/", 3)
+                return f"{base[0]}//{base[2]}{control}", st
+    raise UPnPError("no WANIPConnection/WANPPPConnection service on gateway")
+
+
+def discover(timeout: float = 3.0) -> NAT:
+    """upnp.go:39 Discover — SSDP multicast search for a gateway."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.settimeout(timeout)
+    try:
+        for _ in range(3):
+            try:
+                sock.sendto(_MSEARCH, SSDP_ADDR)
+            except OSError as exc:
+                raise UPnPError(f"SSDP send failed: {exc}") from exc
+            try:
+                while True:
+                    data, _ = sock.recvfrom(1500)
+                    location = _parse_ssdp_location(
+                        data.decode(errors="replace")
+                    )
+                    if location is None:
+                        continue
+                    control, st = _control_url_from_description(location)
+                    our_ip = sock.getsockname()[0]
+                    if our_ip in ("0.0.0.0", ""):
+                        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                        try:
+                            probe.connect(SSDP_ADDR)
+                            our_ip = probe.getsockname()[0]
+                        finally:
+                            probe.close()
+                    return NAT(control, st, our_ip)
+            except socket.timeout:
+                continue
+        raise UPnPError("no UPnP gateway answered the SSDP search")
+    finally:
+        sock.close()
+
+
+@dataclass
+class Capabilities:
+    port_mapping: bool = False
+    hairpin: bool = False
+
+
+def probe(logger=None, internal_port: int = 8001) -> Capabilities:
+    """probe.go:90 Probe — discover a gateway, map a port, try to dial
+    ourselves through the external address (hairpin), clean up."""
+
+    def log(msg):
+        if logger is not None:
+            logger.info(msg)
+
+    caps = Capabilities()
+    log("Probing for UPnP!")
+    nat = discover()
+    ext_ip = nat.external_ip()
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        listener.bind(("0.0.0.0", internal_port))
+        listener.listen(1)
+        nat.add_port_mapping("tcp", internal_port, internal_port, "cometbft-probe", 1200)
+        caps.port_mapping = True
+        log(f"mapped external {ext_ip}:{internal_port}")
+        try:
+            probe_sock = socket.create_connection(
+                (ext_ip, internal_port), timeout=3
+            )
+            probe_sock.close()
+            caps.hairpin = True
+        except OSError:
+            pass
+    finally:
+        try:
+            nat.delete_port_mapping("tcp", internal_port)
+        except UPnPError:
+            pass
+        listener.close()
+    return caps

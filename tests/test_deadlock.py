@@ -1,0 +1,74 @@
+"""Deadlock-detection watchdog (libs/sync deadlock.go analog)."""
+
+import io
+import sys
+import threading
+import time
+
+from cometbft_tpu.libs import deadlock
+
+
+class TestDeadlockDetector:
+    def test_disabled_by_default_and_reversible(self):
+        assert not deadlock.is_enabled()
+        orig = threading.Lock
+        deadlock.enable(timeout_s=0.5)
+        try:
+            assert deadlock.is_enabled()
+            assert threading.Lock is not orig
+        finally:
+            deadlock.disable()
+        assert threading.Lock is orig
+
+    def test_wrapped_locks_behave_normally(self):
+        deadlock.enable(timeout_s=5.0)
+        try:
+            lk = threading.Lock()
+            with lk:
+                assert lk.locked()
+            assert not lk.locked()
+            assert lk.acquire(False)
+            lk.release()
+            rlk = threading.RLock()
+            with rlk:
+                with rlk:  # reentrant
+                    pass
+        finally:
+            deadlock.disable()
+
+    def test_stuck_acquire_dumps_stacks(self):
+        deadlock.enable(timeout_s=0.4)
+        try:
+            lk = threading.Lock()
+            lk.acquire()
+            captured = io.StringIO()
+            orig_err = sys.stderr
+            sys.stderr = captured
+
+            def waiter():
+                lk.acquire(True, 1.2)  # bounded so the thread exits
+
+            got = {}
+
+            def blocking_waiter():
+                # the unbounded acquire path is the detecting one
+                t0 = time.monotonic()
+                deadline_dump = None
+                # run acquire in this thread; release after the dump fires
+                lk.acquire()
+                got["waited"] = time.monotonic() - t0
+                lk.release()
+
+            t = threading.Thread(target=blocking_waiter, daemon=True)
+            t.start()
+            time.sleep(1.0)  # > timeout: the dump must have fired
+            sys.stderr = orig_err
+            lk.release()
+            t.join(5.0)
+            out = captured.getvalue()
+            assert "POTENTIAL DEADLOCK" in out
+            assert "blocking_waiter" in out or "Thread-" in out
+            assert got["waited"] >= 0.4
+        finally:
+            sys.stderr = orig_err
+            deadlock.disable()

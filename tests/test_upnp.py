@@ -1,0 +1,151 @@
+"""UPnP discovery/mapping against a fake in-process gateway.
+
+Model: reference p2p/upnp — SSDP search, device-description fetch, SOAP
+GetExternalIPAddress/AddPortMapping/DeletePortMapping, and the Probe
+capability report. A real gateway never exists in CI, so this spins a
+loopback SSDP responder + HTTP IGD and points discovery at it.
+"""
+
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from cometbft_tpu.p2p import upnp
+
+_DESCRIPTION = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+ <device>
+  <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+  <serviceList>
+   <service>
+    <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+    <controlURL>/control</controlURL>
+   </service>
+  </serviceList>
+ </device>
+</root>"""
+
+
+class _FakeIGD(BaseHTTPRequestHandler):
+    mappings = {}
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        body = _DESCRIPTION.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length).decode()
+        action = (self.headers.get("SOAPAction") or "").strip('"').split("#")[-1]
+        if action == "GetExternalIPAddress":
+            payload = (
+                "<NewExternalIPAddress>127.0.0.1</NewExternalIPAddress>"
+            )
+        elif action == "AddPortMapping":
+            import re
+
+            port = re.search(r"<NewExternalPort>(\d+)</NewExternalPort>", body)
+            _FakeIGD.mappings[int(port.group(1))] = True
+            payload = ""
+        elif action == "DeletePortMapping":
+            import re
+
+            port = re.search(r"<NewExternalPort>(\d+)</NewExternalPort>", body)
+            _FakeIGD.mappings.pop(int(port.group(1)), None)
+            payload = ""
+        else:
+            self.send_response(500)
+            self.end_headers()
+            return
+        out = f"<s:Envelope><s:Body>{payload}</s:Body></s:Envelope>".encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+@pytest.fixture
+def gateway(monkeypatch):
+    httpd = HTTPServer(("127.0.0.1", 0), _FakeIGD)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    http_port = httpd.server_address[1]
+
+    ssdp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    ssdp.bind(("127.0.0.1", 0))
+    ssdp_port = ssdp.getsockname()[1]
+    stop = threading.Event()
+
+    def responder():
+        ssdp.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                data, addr = ssdp.recvfrom(1500)
+            except socket.timeout:
+                continue
+            if b"M-SEARCH" in data:
+                answer = (
+                    "HTTP/1.1 200 OK\r\n"
+                    "ST: urn:schemas-upnp-org:device:InternetGatewayDevice:1\r\n"
+                    f"LOCATION: http://127.0.0.1:{http_port}/desc.xml\r\n\r\n"
+                ).encode()
+                ssdp.sendto(answer, addr)
+
+    threading.Thread(target=responder, daemon=True).start()
+    monkeypatch.setattr(upnp, "SSDP_ADDR", ("127.0.0.1", ssdp_port))
+    _FakeIGD.mappings.clear()
+    yield
+    stop.set()
+    httpd.shutdown()
+
+
+class TestUPnP:
+    def test_discover_and_map(self, gateway):
+        nat = upnp.discover(timeout=2.0)
+        assert nat.service_type.endswith("WANIPConnection:1")
+        assert nat.external_ip() == "127.0.0.1"
+        nat.add_port_mapping("tcp", 18123, 18123)
+        assert 18123 in _FakeIGD.mappings
+        nat.delete_port_mapping("tcp", 18123)
+        assert 18123 not in _FakeIGD.mappings
+
+    def test_probe_reports_capabilities(self, gateway):
+        from cometbft_tpu.libs.net import free_ports
+
+        (port,) = free_ports(1)
+        caps = upnp.probe(internal_port=port)
+        assert caps.port_mapping
+        assert caps.hairpin  # ext ip is 127.0.0.1 → we dial our own listener
+        assert port not in _FakeIGD.mappings  # cleaned up
+
+    def test_no_gateway_is_clean_error(self, monkeypatch):
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        silent_port = sock.getsockname()[1]
+        monkeypatch.setattr(upnp, "SSDP_ADDR", ("127.0.0.1", silent_port))
+        with pytest.raises(upnp.UPnPError):
+            upnp.discover(timeout=0.3)
+        sock.close()
+
+    def test_cli_probe_without_gateway(self, capsys, monkeypatch):
+        import json
+
+        from cometbft_tpu.cmd.commands import main as cli_main
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        monkeypatch.setattr(
+            upnp, "SSDP_ADDR", ("127.0.0.1", sock.getsockname()[1])
+        )
+        monkeypatch.setattr(upnp, "discover", lambda timeout=0.3: (_ for _ in ()).throw(upnp.UPnPError("none")))
+        assert cli_main(["probe-upnp"]) == 0
+        out = json.loads(capsys.readouterr().out.strip())
+        assert "error" in out
+        sock.close()
