@@ -95,9 +95,14 @@ class PEXReactor(Reactor):
     def on_start(self) -> None:
         if not self.book.is_running():
             self.book.start()
-        threading.Thread(
-            target=self._ensure_peers_routine, name="pex-ensure", daemon=True
-        ).start()
+        # a seed CRAWLS (dial → exchange addrs → hang up) instead of
+        # maintaining outbound peers (pex_reactor.go crawlPeersRoutine vs
+        # ensurePeersRoutine) — a seed that held its dials open would
+        # defeat its own answer-and-disconnect policy
+        routine = (
+            self._crawl_routine if self.seed_mode else self._ensure_peers_routine
+        )
+        threading.Thread(target=routine, name="pex-ensure", daemon=True).start()
 
     def on_stop(self) -> None:
         if self.book.is_running():
@@ -107,8 +112,9 @@ class PEXReactor(Reactor):
 
     def add_peer(self, peer: Peer) -> None:
         if peer.is_outbound():
-            # ask for more addresses if the book is low (pex_reactor.go:205)
-            if self.book.need_more_addrs():
+            # ask for more addresses if the book is low (pex_reactor.go:205);
+            # a crawling seed always asks — the answer ends the visit
+            if self.seed_mode or self.book.need_more_addrs():
                 self._request_addrs(peer)
         else:
             addr = peer.net_address()
@@ -155,6 +161,10 @@ class PEXReactor(Reactor):
                     self.book.add_address(addr, src)
                 except ValueError:
                     continue
+            if self.seed_mode and peer.is_outbound():
+                # crawl visit complete: addresses harvested, hang up
+                assert self.switch is not None
+                self.switch.stop_peer_gracefully(peer)
 
     def _receive_request_ok(self, peer: Peer) -> bool:
         now = time.monotonic()
@@ -171,6 +181,38 @@ class PEXReactor(Reactor):
                 return
             self._requests_sent.add(peer.id())
         peer.send(PEX_CHANNEL, encode_pex_request())
+
+    # -- seed crawl loop -----------------------------------------------------
+
+    def _crawl_routine(self) -> None:
+        """pex_reactor.go crawlPeersRoutine: periodically visit known
+        addresses — dial, request their addrs (add_peer fires it), and
+        hang up when the answer arrives (receive handles it). Keeps the
+        book fresh without the seed accumulating outbound peers."""
+        time.sleep(self.ensure_peers_period * 0.1)
+        while self.is_running():
+            self._crawl_once()
+            time.sleep(self.ensure_peers_period)
+
+    def _crawl_once(self, max_visits: int = 4) -> None:
+        assert self.switch is not None
+        sw = self.switch
+        self.book.reinstate_bad_peers()
+        visited = 0
+        for _ in range(max_visits * 3):
+            if visited >= max_visits:
+                break
+            addr = self.book.pick_address(bias_towards_new=60)
+            if addr is None:
+                break
+            if sw.peers.has(addr.id) or self.book.is_banned(addr):
+                continue
+            try:
+                sw.dial_peer_with_address(addr)
+                self.book.mark_attempt(addr)
+                visited += 1
+            except Exception:
+                self.book.mark_attempt(addr)
 
     # -- ensure-peers loop --------------------------------------------------
 
