@@ -81,6 +81,7 @@ class PEXReactor(Reactor):
         self.seed_mode = seed_mode
         self.ensure_peers_period = ensure_peers_period
         self._requests_sent: set = set()  # peer ids we await addrs from
+        self._crawl_visits: Dict[str, float] = {}  # seed crawl: id → dial time
         self._last_received_request: Dict[str, float] = {}
         self._attempts: Dict[str, int] = {}  # dial attempts per addr id
         self._mtx = threading.Lock()
@@ -128,6 +129,7 @@ class PEXReactor(Reactor):
         with self._mtx:
             self._requests_sent.discard(peer.id())
             self._last_received_request.pop(peer.id(), None)
+            self._crawl_visits.pop(peer.id(), None)
 
     # -- receive ------------------------------------------------------------
 
@@ -163,6 +165,8 @@ class PEXReactor(Reactor):
                     continue
             if self.seed_mode and peer.is_outbound():
                 # crawl visit complete: addresses harvested, hang up
+                with self._mtx:
+                    self._crawl_visits.pop(peer.id(), None)
                 assert self.switch is not None
                 self.switch.stop_peer_gracefully(peer)
 
@@ -198,21 +202,49 @@ class PEXReactor(Reactor):
         assert self.switch is not None
         sw = self.switch
         self.book.reinstate_bad_peers()
-        visited = 0
+        # attemptDisconnects analog: a visited peer that never answered
+        # the request must not occupy an outbound slot forever
+        cutoff = time.monotonic() - max(2 * self.ensure_peers_period, 8.0)
+        with self._mtx:
+            stale = {
+                pid
+                for pid, t0 in self._crawl_visits.items()
+                if t0 < cutoff
+            }
+            for pid in stale:
+                self._crawl_visits.pop(pid, None)
+        for pid in stale:
+            peer = sw.peers.get(pid)
+            if peer is not None and peer.is_outbound():
+                sw.stop_peer_gracefully(peer)
+        to_visit: Dict[str, NetAddress] = {}
         for _ in range(max_visits * 3):
-            if visited >= max_visits:
+            if len(to_visit) >= max_visits:
                 break
             addr = self.book.pick_address(bias_towards_new=60)
             if addr is None:
                 break
-            if sw.peers.has(addr.id) or self.book.is_banned(addr):
+            if addr.id in to_visit or sw.peers.has(addr.id):
                 continue
-            try:
-                sw.dial_peer_with_address(addr)
-                self.book.mark_attempt(addr)
-                visited += 1
-            except Exception:
-                self.book.mark_attempt(addr)
+            with self._mtx:
+                if self._attempts.get(addr.id, 0) > MAX_ATTEMPTS_TO_DIAL:
+                    self.book.mark_bad(addr)
+                    continue
+            to_visit[addr.id] = addr
+        for addr in to_visit.values():
+            threading.Thread(
+                target=self._crawl_dial, args=(addr,), daemon=True
+            ).start()
+        # a fresh seed has an empty book: bootstrap from configured seeds
+        if not to_visit and self.book.empty() and self.seeds:
+            self._dial_seeds()
+
+    def _crawl_dial(self, addr: NetAddress) -> None:
+        self._dial(addr)  # shared attempt/mark bookkeeping
+        assert self.switch is not None
+        if self.switch.peers.has(addr.id):
+            with self._mtx:
+                self._crawl_visits[addr.id] = time.monotonic()
 
     # -- ensure-peers loop --------------------------------------------------
 
