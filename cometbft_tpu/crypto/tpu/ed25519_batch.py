@@ -434,11 +434,18 @@ def verify_batch(
         (*packed, valid) = prepare_batch(pub_keys, msgs, sigs)
         kernel = verify_kernel
 
+    from cometbft_tpu.crypto.tpu import mesh as mesh_mod
+
+    ndev = mesh_mod.n_devices()
+
     out = np.zeros(n, bool)
     pending = []  # dispatch everything first: device chunks overlap host
     for start in range(0, n, _MAX_CHUNK):
         end = min(start + _MAX_CHUNK, n)
         size = _pad_size(end - start)
+        if ndev > 1:
+            # equal shards per device (non-power-of-two counts included)
+            size = -(-size // ndev) * ndev
 
         def pad(a):
             # batch is the trailing axis for every kernel input
@@ -446,7 +453,13 @@ def verify_batch(
             padded[..., : end - start] = a[..., start:end]
             return padded
 
-        mask = kernel(*(pad(a) for a in packed))
+        padded_args = [pad(a) for a in packed]
+        if ndev > 1:
+            # multi-chip: shard the batch (lane) axis over the mesh —
+            # ICI within a host, DCN across hosts (crypto/tpu/mesh.py)
+            mask = mesh_mod.sharded_verify(kernel, padded_args)
+        else:
+            mask = kernel(*padded_args)
         pending.append((start, end, mask))
     for start, end, mask in pending:
         out[start:end] = np.asarray(mask)[: end - start]

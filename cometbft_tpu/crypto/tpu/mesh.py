@@ -1,0 +1,131 @@
+"""Device-mesh plumbing for the crypto plane — batch parallelism over
+signatures as a first-class component (SURVEY.md §2.16).
+
+The gossip network stays on CPU/TCP; the DEVICE plane scales by
+sharding the signature batch (the trailing lane axis of every kernel
+input) across whatever devices are visible:
+
+* single host, multiple chips — one mesh axis ("batch") over ICI;
+* multiple hosts — initialize `jax.distributed` first
+  (`maybe_init_distributed`, driven by the standard JAX env vars or
+  [crypto] coordinator config), then the SAME mesh spans all hosts'
+  devices and XLA routes the all-gather of the verdict mask over
+  ICI within a host and DCN across hosts. No NCCL/MPI: collectives are
+  compiled into the program.
+
+`sharded_verify` is used by TPUBatchVerifier automatically whenever
+more than one device is visible; on one device it is jit-identical to
+the plain kernel.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_mtx = threading.Lock()
+_cached = None
+
+
+def maybe_init_distributed() -> bool:
+    """Initialize jax.distributed for a multi-host verification plane
+    when the operator configured one. Runs automatically on first mesh
+    construction (batch_mesh), before any device set is cached.
+
+    Config: either the standard JAX env (JAX_COORDINATOR_ADDRESS +
+    JAX_NUM_PROCESSES/JAX_PROCESS_ID, auto-detected by
+    jax.distributed.initialize()) or the explicit CBFT_TPU_COORDINATOR /
+    CBFT_TPU_NUM_PROCESSES / CBFT_TPU_PROCESS_ID trio — the CBFT vars
+    are only passed when set, so they never override the JAX ones.
+    Single-host runs (no coordinator configured) skip this entirely.
+    → True if a multi-process runtime is active."""
+    addr_cbft = os.environ.get("CBFT_TPU_COORDINATOR")
+    addr_jax = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not addr_cbft and not addr_jax:
+        return False
+    import jax
+
+    kwargs = {}
+    if addr_cbft:
+        kwargs["coordinator_address"] = addr_cbft
+        if os.environ.get("CBFT_TPU_NUM_PROCESSES"):
+            kwargs["num_processes"] = int(os.environ["CBFT_TPU_NUM_PROCESSES"])
+        if os.environ.get("CBFT_TPU_PROCESS_ID"):
+            kwargs["process_id"] = int(os.environ["CBFT_TPU_PROCESS_ID"])
+    try:
+        jax.distributed.initialize(**kwargs)
+    except Exception as exc:
+        # already initialized (idempotent restart) is fine; a real
+        # misconfiguration must be LOUD — a silently split cluster
+        # would verify on disjoint single-host planes
+        if jax.process_count() <= 1:
+            import sys
+
+            print(
+                f"cometbft-tpu: jax.distributed.initialize failed: {exc}",
+                file=sys.stderr,
+            )
+            return False
+    return jax.process_count() > 1
+
+
+def batch_mesh():
+    """One 1-D mesh over every visible device, cached. The batch axis is
+    the only parallel axis the crypto plane needs — signatures are
+    embarrassingly parallel; collectives appear only for the output
+    gather."""
+    global _cached
+    with _mtx:
+        if _cached is not None:
+            return _cached
+        maybe_init_distributed()  # must run before the device set is read
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices())
+        _cached = Mesh(devs, ("batch",))
+        return _cached
+
+
+def n_devices() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+_sharded_kernels = {}
+
+
+def sharded_verify(kernel, args):
+    """Run a verify kernel with every input's trailing (batch) axis
+    sharded over the mesh. args are numpy arrays whose trailing dim is
+    the (padded) batch — the caller pads to a multiple of the device
+    count × lane tile already."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    mesh = batch_mesh()
+    key = (id(kernel), tuple(a.ndim for a in args))
+    step = _sharded_kernels.get(key)
+    shardings = tuple(
+        NamedSharding(mesh, PS(*([None] * (a.ndim - 1) + ["batch"])))
+        for a in args
+    )
+    if step is None:
+        inner = getattr(kernel, "_fun", None) or getattr(
+            kernel, "__wrapped__", kernel
+        )
+        step = jax.jit(
+            inner,
+            in_shardings=shardings,
+            out_shardings=NamedSharding(mesh, PS("batch")),
+        )
+        _sharded_kernels[key] = step
+    placed = [
+        jax.device_put(jnp.asarray(a), s) for a, s in zip(args, shardings)
+    ]
+    with mesh:
+        return step(*placed)

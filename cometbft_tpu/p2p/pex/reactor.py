@@ -224,7 +224,11 @@ class PEXReactor(Reactor):
             addr = self.book.pick_address(bias_towards_new=60)
             if addr is None:
                 break
-            if addr.id in to_visit or sw.peers.has(addr.id):
+            if (
+                addr.id in to_visit
+                or sw.peers.has(addr.id)
+                or sw.dialing.get(addr.id)  # a dial is already in flight
+            ):
                 continue
             with self._mtx:
                 if self._attempts.get(addr.id, 0) > MAX_ATTEMPTS_TO_DIAL:

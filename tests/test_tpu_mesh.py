@@ -1,0 +1,56 @@
+"""Multi-device dispatch of the crypto plane (crypto/tpu/mesh.py).
+
+Runs on the virtual 8-device CPU mesh (conftest's
+xla_force_host_platform_device_count=8): verify_batch must route
+through the sharded program automatically and stay bit-identical.
+"""
+
+import numpy as np
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto.tpu import ed25519_batch, mesh
+
+
+class TestMeshDispatch:
+    def test_eight_virtual_devices_visible(self):
+        assert mesh.n_devices() == 8
+        m = mesh.batch_mesh()
+        assert m.devices.shape == (8,)
+        assert m.axis_names == ("batch",)
+
+    def test_verify_batch_shards_and_matches_serial(self):
+        keys = [ed.gen_priv_key_from_secret(bytes([i, 55])) for i in range(40)]
+        pks, msgs, sigs = [], [], []
+        for i, k in enumerate(keys):
+            m = b"mesh vote %d" % i
+            s = bytearray(k.sign(m))
+            if i % 5 == 0:
+                s[7] ^= 1
+            pks.append(k.pub_key().bytes())
+            msgs.append(m)
+            sigs.append(bytes(s))
+        got = ed25519_batch.verify_batch(pks, msgs, sigs)  # 40 → pad 64 = 8×8
+        want = [
+            ed.PubKeyEd25519(p).verify_signature(m, s)
+            for p, m, s in zip(pks, msgs, sigs)
+        ]
+        assert got == want
+
+    def test_sharded_kernel_cache_reused(self):
+        before = dict(mesh._sharded_kernels)
+        pks, msgs, sigs = [], [], []
+        for i in range(8):
+            k = ed.gen_priv_key_from_secret(bytes([i, 66]))
+            m = b"again %d" % i
+            pks.append(k.pub_key().bytes())
+            msgs.append(m)
+            sigs.append(k.sign(m))
+        assert all(ed25519_batch.verify_batch(pks, msgs, sigs))
+        assert all(ed25519_batch.verify_batch(pks, msgs, sigs))
+        # at most one new compiled sharded program per (kernel, arity)
+        assert len(mesh._sharded_kernels) <= len(before) + 1
+
+    def test_maybe_init_distributed_noop_without_config(self, monkeypatch):
+        monkeypatch.delenv("CBFT_TPU_COORDINATOR", raising=False)
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        assert mesh.maybe_init_distributed() is False
