@@ -1,0 +1,205 @@
+"""Micro-benchmark harnesses mirroring the reference's in-repo Go
+benchmarks (values are machine-dependent; none are stored — the harness
+IS the parity surface):
+
+  ed25519        — crypto/ed25519/bench_test.go:11-26 Sign/Verify, plus
+                   the 64-sig batch through the BatchVerifier boundary
+  validator_set  — types/validator_set_test.go:167,1685 copy/update
+  light          — light/client_benchmark_test.go:29-84 sequential vs
+                   bisection verification
+  mempool        — mempool/v0/bench_test.go:13-82 CheckTx + Reap
+  wal            — consensus/wal_test.go write throughput
+
+Run: python bench_micro.py [section ...]   (default: all, one JSON line
+per section). The headline TPU-vs-CPU bench stays in bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _rate(n: int, fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return round(n / (time.perf_counter() - t0), 1)
+
+
+def bench_ed25519() -> dict:
+    from cometbft_tpu.crypto import batch as cryptobatch
+    from cometbft_tpu.crypto import ed25519 as ed
+
+    n = 400
+    key = ed.gen_priv_key()
+    msg = b"x" * 128
+    sign_rate = _rate(n, lambda: [key.sign(msg) for _ in range(n)])
+    sig = key.sign(msg)
+    pub = key.pub_key()
+    verify_rate = _rate(
+        n, lambda: [pub.verify_signature(msg, sig) for _ in range(n)]
+    )
+
+    def batch64():
+        for start in range(0, n, 64):
+            bv = cryptobatch.new_batch_verifier("cpu")
+            for _ in range(min(64, n - start)):
+                bv.add(pub, msg, sig)
+            ok, _ = bv.verify()
+            assert ok
+
+    return {
+        "sign_per_sec": sign_rate,
+        "verify_per_sec": verify_rate,
+        "batch64_verify_per_sec": _rate(n, batch64),
+    }
+
+
+def bench_validator_set() -> dict:
+    from cometbft_tpu.types.test_util import deterministic_validator_set
+
+    vals, _ = deterministic_validator_set(100, 10)
+    n = 200
+    copy_rate = _rate(n, lambda: [vals.copy() for _ in range(n)])
+
+    def updates():
+        for i in range(n):
+            v = vals.copy()
+            v.increment_proposer_priority(1)
+
+    return {
+        "copy_100vals_per_sec": copy_rate,
+        "increment_priority_per_sec": _rate(n, updates),
+        "hash_100vals_ms": round(
+            _ms(lambda: vals.hash()), 3
+        ),
+    }
+
+
+def _ms(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return (time.perf_counter() - t0) * 1e3
+
+
+def bench_light() -> dict:
+    """Sequential vs bisection verification over a 64-block chain
+    (light/client_benchmark_test.go:29-84 shape, in-memory provider).
+    Reuses the test suite's chain fixture — the bench is the harness,
+    not a second implementation of header signing."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "light_fixtures",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "tests", "test_light.py"),
+    )
+    fx = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fx)
+
+    from cometbft_tpu.libs.db import MemDB
+    from cometbft_tpu.light import Client, TrustOptions
+    from cometbft_tpu.light.provider import MockProvider
+    from cometbft_tpu.light.store import DBStore
+
+    blocks, _, _ = fx._light_chain(64, n_vals=10)
+    out = {}
+    for mode in ("sequential", "bisection"):
+        opts = TrustOptions(
+            period_ns=fx.WEEK_NS,
+            height=1,
+            hash=blocks[1].signed_header.header.hash(),
+        )
+        client = Client(
+            fx.CHAIN_ID,
+            opts,
+            MockProvider(fx.CHAIN_ID, blocks),
+            [],
+            DBStore(MemDB()),
+            sequential=(mode == "sequential"),
+        )
+        t0 = time.perf_counter()
+        lb = client.verify_light_block_at_height(64, fx._ts(65))
+        assert lb.height == 64
+        out[f"{mode}_to_h64_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    return out
+
+
+def bench_mempool() -> dict:
+    from cometbft_tpu.abci.client import LocalClient
+    from cometbft_tpu.abci.kvstore import KVStoreApplication
+    from cometbft_tpu.config import MempoolConfig
+    from cometbft_tpu.mempool.clist_mempool import CListMempool
+
+    client = LocalClient(KVStoreApplication())
+    client.start()
+    try:
+        mp = CListMempool(MempoolConfig(), client, height=0)
+        n = 2000
+
+        def checks():
+            for i in range(n):
+                mp.check_tx(b"k%d=v" % i)
+            mp.flush_app_conn()
+
+        check_rate = _rate(n, checks)
+        reap_ms = _ms(lambda: mp.reap_max_bytes_max_gas(-1, -1))
+        return {
+            "check_tx_per_sec": check_rate,
+            "reap_2000_ms": round(reap_ms, 2),
+        }
+    finally:
+        client.stop()
+
+
+def bench_wal() -> dict:
+    import tempfile
+
+    from cometbft_tpu.consensus.wal import WAL, EndHeightMessage
+
+    n = 500
+    with tempfile.TemporaryDirectory() as d:
+        wal = WAL(d + "/wal")
+        wal.start()
+        t0 = time.perf_counter()
+        for i in range(n):
+            wal.write(EndHeightMessage(i + 1))
+        wal.flush_and_sync()
+        rate = n / (time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        for i in range(100):
+            wal.write_sync(EndHeightMessage(n + i + 1))
+        sync_rate = 100 / (time.perf_counter() - t0)
+        wal.stop()
+    return {
+        "writes_per_sec": round(rate, 1),
+        "write_syncs_per_sec": round(sync_rate, 1),
+    }
+
+
+SECTIONS = {
+    "ed25519": bench_ed25519,
+    "validator_set": bench_validator_set,
+    "light": bench_light,
+    "mempool": bench_mempool,
+    "wal": bench_wal,
+}
+
+
+def main(argv):
+    names = argv or sorted(SECTIONS)
+    for name in names:
+        fn = SECTIONS.get(name)
+        if fn is None:
+            print(json.dumps({"section": name, "error": "unknown section"}))
+            continue
+        try:
+            print(json.dumps({"section": name, **fn()}))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps({"section": name, "error": str(exc)[:200]}))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -60,19 +60,23 @@ class TPUBatchVerifier(BatchVerifier):
     type falls back to serial CPU verification in place (mixed batches are
     partitioned by curve — SURVEY.md §7 stage 10)."""
 
-    def __init__(self, min_batch: int = 1024):
+    def __init__(self, min_batch: Optional[int] = None):
         # fail fast if the kernel module is unavailable rather than erroring
         # mid-verify after add() calls succeeded
         from cometbft_tpu.crypto.tpu import ed25519_batch  # noqa: F401
 
         self._items: List[Tuple[PubKey, bytes, bytes]] = []
         # Below min_batch the device dispatch + host packing dominates and
-        # the CPU path is simply faster: measured on-chip crossover is
-        # ~1k signatures (BENCH sweep: device 2.8k sigs/s at batch 256 vs
-        # 4.1k/s CPU serial; parity near 1024; 2x at 8k+). Small commits
-        # (150 validators) therefore verify on CPU even under the "tpu"
-        # backend — the hybrid IS the design, the device earns its
-        # round-trip only at scale.
+        # the CPU path is simply faster: the measured on-chip crossover
+        # was ~1k signatures with the round-3 kernel (device 2.8k sigs/s
+        # at batch 256 vs 4.1k/s CPU serial; parity near 1024). Small
+        # commits (150 validators) therefore verify on CPU even under the
+        # "tpu" backend — the hybrid IS the design, the device earns its
+        # round-trip only at scale. CBFT_TPU_MIN_BATCH retunes the
+        # routing from config when a kernel change moves the crossover,
+        # without a code change.
+        if min_batch is None:
+            min_batch = int(os.environ.get("CBFT_TPU_MIN_BATCH", "1024"))
         self._min_batch = min_batch
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:

@@ -56,17 +56,25 @@ def maybe_init_distributed() -> bool:
     try:
         jax.distributed.initialize(**kwargs)
     except Exception as exc:
-        # already initialized (idempotent restart) is fine; a real
-        # misconfiguration must be LOUD — a silently split cluster
-        # would verify on disjoint single-host planes
-        if jax.process_count() <= 1:
-            import sys
+        if jax.process_count() > 1:
+            return True  # already initialized (idempotent restart)
+        if addr_cbft:
+            # the operator EXPLICITLY configured a multi-host plane:
+            # failing to form it must stop the node, not degrade into a
+            # silently split cluster verifying on disjoint hosts
+            raise RuntimeError(
+                f"CBFT_TPU_COORDINATOR={addr_cbft!r} is set but "
+                f"jax.distributed.initialize failed: {exc}"
+            ) from exc
+        import sys
 
-            print(
-                f"cometbft-tpu: jax.distributed.initialize failed: {exc}",
-                file=sys.stderr,
-            )
-            return False
+        print(
+            "cometbft-tpu: ambient JAX_COORDINATOR_ADDRESS present but "
+            f"jax.distributed.initialize failed ({exc}); continuing "
+            "single-host",
+            file=sys.stderr,
+        )
+        return False
     return jax.process_count() > 1
 
 
@@ -90,9 +98,10 @@ def batch_mesh():
 
 
 def n_devices() -> int:
-    import jax
-
-    return len(jax.devices())
+    # via batch_mesh so maybe_init_distributed runs BEFORE the first
+    # jax.devices() call — initialize() refuses to run once any backend
+    # is up, and verify_batch's device-count probe is the first touch
+    return int(batch_mesh().devices.size)
 
 
 _sharded_kernels = {}
