@@ -252,6 +252,76 @@ def _stage_variants():
     print(json.dumps(out), flush=True)
 
 
+def _stage_breakdown():
+    """Where a batch-4096 verify spends its time: host packing (incl.
+    SHA-512 in host-hash mode), host→device transfer, and device compute
+    split into decompress+table vs the Straus loop (jitted separately).
+    The separated pieces don't add exactly to the fused kernel (fusion
+    across the split is lost) but bound each phase honestly."""
+    _maybe_force_cpu()
+    _set_cache()
+    import jax
+    import jax.numpy as jnp
+
+    from cometbft_tpu.crypto.tpu import ed25519_batch as eb
+
+    out = {}
+    pks, msgs, sigs = _make_batch(4096)
+
+    t0 = time.perf_counter()
+    (*packed, valid) = eb.prepare_batch(pks, msgs, sigs)
+    out["host_prepare_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    print(json.dumps(out), flush=True)
+
+    t0 = time.perf_counter()
+    dev = [jax.device_put(jnp.asarray(a)) for a in packed]
+    jax.block_until_ready(dev)
+    out["transfer_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    print(json.dumps(out), flush=True)
+
+    @jax.jit
+    def decompress_and_table(ay, a_sign):
+        x, ok = eb.decompress(ay, a_sign)
+        nx = eb.fe.neg(x)
+        neg_a = (nx, ay, jnp.broadcast_to(eb._ONE_FE, ay.shape), eb.fe.mul(nx, ay))
+        a2 = eb.point_dbl(neg_a)
+        a3 = eb.point_add(a2, neg_a)
+        return ok, a2[0], a3[0]
+
+    ay, a_sign, r_y, r_sign, s_digits, h_digits = dev
+    jax.block_until_ready(decompress_and_table(ay, a_sign))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(decompress_and_table(ay, a_sign))
+    out["device_decompress_table_ms"] = round(
+        (time.perf_counter() - t0) * 1e3, 2
+    )
+    print(json.dumps(out), flush=True)
+
+    jax.block_until_ready(eb.verify_kernel(*dev))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(eb.verify_kernel(*dev))
+    out["device_full_kernel_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+    out["device_straus_loop_ms_approx"] = round(
+        out["device_full_kernel_ms"] - out["device_decompress_table_ms"], 2
+    )
+    print(json.dumps(out), flush=True)
+
+    # device-hash pipeline, called explicitly (no env gating needed)
+    t0 = time.perf_counter()
+    (*packed_dh, valid) = eb.prepare_batch_device_hash(pks, msgs, sigs)
+    out["host_prepare_devicehash_ms"] = round(
+        (time.perf_counter() - t0) * 1e3, 2
+    )
+    dev_dh = [jax.device_put(jnp.asarray(a)) for a in packed_dh]
+    jax.block_until_ready(eb.verify_full_kernel(*dev_dh))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(eb.verify_full_kernel(*dev_dh))
+    out["device_full_kernel_devicehash_ms"] = round(
+        (time.perf_counter() - t0) * 1e3, 2
+    )
+    print(json.dumps(out), flush=True)
+
+
 def _sharded_mega_commit():
     """10k-signature commit verification sharded over every available
     device via explicit NamedSharding on the batch (lane) axis — the
@@ -375,7 +445,7 @@ def main():
             result = parsed["sigs_per_sec"]
 
     if result is not None:
-        for name, timeout in (("p50", 600), ("variants", 600)):
+        for name, timeout in (("p50", 600), ("variants", 600), ("breakdown", 600)):
             parsed, diag = _run_stage(name, _STAGE_ENV_TPU, timeout)
             stages[f"tpu_{name}"] = parsed if parsed is not None else diag
 
@@ -423,6 +493,7 @@ if __name__ == "__main__":
             "run": _stage_run,
             "p50": _stage_p50,
             "variants": _stage_variants,
+            "breakdown": _stage_breakdown,
         }[sys.argv[2]]()
     else:
         main()

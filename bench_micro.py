@@ -28,7 +28,7 @@ def _rate(n: int, fn) -> float:
 
 
 def bench_ed25519() -> dict:
-    from cometbft_tpu.crypto import batch as cryptobatch
+    from bench import bench_cpu_batch  # the shared 64-sig boundary bench
     from cometbft_tpu.crypto import ed25519 as ed
 
     n = 400
@@ -40,19 +40,10 @@ def bench_ed25519() -> dict:
     verify_rate = _rate(
         n, lambda: [pub.verify_signature(msg, sig) for _ in range(n)]
     )
-
-    def batch64():
-        for start in range(0, n, 64):
-            bv = cryptobatch.new_batch_verifier("cpu")
-            for _ in range(min(64, n - start)):
-                bv.add(pub, msg, sig)
-            ok, _ = bv.verify()
-            assert ok
-
     return {
         "sign_per_sec": sign_rate,
         "verify_per_sec": verify_rate,
-        "batch64_verify_per_sec": _rate(n, batch64),
+        "batch64_verify_per_sec": round(bench_cpu_batch(n=n), 1),
     }
 
 

@@ -6,4 +6,13 @@ blockchain/v0/reactor.go:366, light/verifier.go:58-126). This package is the
 TPU-native replacement: one SPMD tensor program verifies the whole batch.
 """
 
-from cometbft_tpu.crypto.tpu import ed25519_batch, field  # noqa: F401
+# Multi-host init MUST precede any module that builds device arrays at
+# import time (field.py's limb constants bring the XLA backend up, and
+# jax.distributed.initialize refuses to run after that). The hook is
+# zero-cost single-host: it only touches jax when a coordinator is
+# configured (CBFT_TPU_COORDINATOR / JAX_COORDINATOR_ADDRESS).
+from cometbft_tpu.crypto.tpu import mesh as _mesh
+
+_mesh.maybe_init_distributed()
+
+from cometbft_tpu.crypto.tpu import ed25519_batch, field  # noqa: E402,F401
