@@ -56,14 +56,23 @@ class CPUBatchVerifier(BatchVerifier):
 
 
 class TPUBatchVerifier(BatchVerifier):
-    """Routes ed25519 entries to the JAX/TPU batched kernel; any other key
-    type falls back to serial CPU verification in place (mixed batches are
-    partitioned by curve — SURVEY.md §7 stage 10)."""
+    """Partitions the batch by curve (SURVEY.md §7 stage 10): ed25519
+    entries go to the ed25519 batch kernel, secp256k1 entries to the
+    secp256k1 batch kernel, anything else falls back to serial CPU
+    verification in place. Each partition applies the min_batch routing
+    independently."""
 
-    def __init__(self, min_batch: Optional[int] = None):
-        # fail fast if the kernel module is unavailable rather than erroring
+    def __init__(
+        self,
+        min_batch: Optional[int] = None,
+        secp_min_batch: Optional[int] = None,
+    ):
+        # fail fast if a kernel module is unavailable rather than erroring
         # mid-verify after add() calls succeeded
-        from cometbft_tpu.crypto.tpu import ed25519_batch  # noqa: F401
+        from cometbft_tpu.crypto.tpu import (  # noqa: F401
+            ed25519_batch,
+            secp256k1_batch,
+        )
 
         self._items: List[Tuple[PubKey, bytes, bytes]] = []
         # Below min_batch the device dispatch + host packing dominates and
@@ -78,6 +87,12 @@ class TPUBatchVerifier(BatchVerifier):
         if min_batch is None:
             min_batch = int(os.environ.get("CBFT_TPU_MIN_BATCH", "1024"))
         self._min_batch = min_batch
+        # The secp crossover is a different animal: its CPU fallback is
+        # pure-Python big-int ECDSA (~ms/sig), so the device wins almost
+        # immediately — route even small batches to the kernel.
+        if secp_min_batch is None:
+            secp_min_batch = int(os.environ.get("CBFT_TPU_SECP_MIN_BATCH", "4"))
+        self._secp_min_batch = secp_min_batch
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
         if pub_key is None:
@@ -88,30 +103,41 @@ class TPUBatchVerifier(BatchVerifier):
         return len(self._items)
 
     def verify(self) -> Tuple[bool, List[bool]]:
+        from cometbft_tpu.crypto import secp256k1 as secp
+
         items, self._items = self._items, []
         if not items:
             return False, []
         mask: List[Optional[bool]] = [None] * len(items)
-        ed_idx: List[int] = []
+        by_curve: Dict[str, List[int]] = {ed.KEY_TYPE: [], secp.KEY_TYPE: []}
         for i, (pk, msg, sig) in enumerate(items):
-            if pk.type() == ed.KEY_TYPE and len(sig) == ed.SIGNATURE_SIZE:
-                ed_idx.append(i)
+            idxs = by_curve.get(pk.type())
+            if idxs is not None:
+                idxs.append(i)
             else:
                 mask[i] = pk.verify_signature(msg, sig)
-        if ed_idx:
-            if len(ed_idx) < self._min_batch:
-                for i in ed_idx:
+        for curve, idxs in by_curve.items():
+            if not idxs:
+                continue
+            threshold = (
+                self._min_batch if curve == ed.KEY_TYPE else self._secp_min_batch
+            )
+            if len(idxs) < threshold:
+                for i in idxs:
                     pk, msg, sig = items[i]
                     mask[i] = pk.verify_signature(msg, sig)
+                continue
+            if curve == ed.KEY_TYPE:
+                from cometbft_tpu.crypto.tpu import ed25519_batch as kernel
             else:
-                from cometbft_tpu.crypto.tpu import ed25519_batch
-
-                pks = [items[i][0].bytes() for i in ed_idx]
-                msgs = [items[i][1] for i in ed_idx]
-                sigs = [items[i][2] for i in ed_idx]
-                ok = ed25519_batch.verify_batch(pks, msgs, sigs)
-                for j, i in enumerate(ed_idx):
-                    mask[i] = bool(ok[j])
+                from cometbft_tpu.crypto.tpu import secp256k1_batch as kernel
+            ok = kernel.verify_batch(
+                [items[i][0].bytes() for i in idxs],
+                [items[i][1] for i in idxs],
+                [items[i][2] for i in idxs],
+            )
+            for j, i in enumerate(idxs):
+                mask[i] = bool(ok[j])
         final = [bool(m) for m in mask]
         return all(final), final
 
@@ -155,4 +181,6 @@ def new_batch_verifier(backend: Optional[str] = None) -> BatchVerifier:
 
 
 def supports_batch_verification(pub_key: PubKey) -> bool:
-    return pub_key.type() == ed.KEY_TYPE
+    from cometbft_tpu.crypto import secp256k1 as secp
+
+    return pub_key.type() in (ed.KEY_TYPE, secp.KEY_TYPE)

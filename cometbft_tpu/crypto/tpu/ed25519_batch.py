@@ -297,11 +297,6 @@ _MIN_PAD = 64
 _MAX_CHUNK = 8192
 
 
-def _pad_size(n: int) -> int:
-    size = _MIN_PAD
-    while size < n:
-        size *= 2
-    return size
 
 
 def _digits_msb_first(le_bytes: np.ndarray) -> np.ndarray:
@@ -436,31 +431,5 @@ def verify_batch(
 
     from cometbft_tpu.crypto.tpu import mesh as mesh_mod
 
-    ndev = mesh_mod.n_devices()
-
-    out = np.zeros(n, bool)
-    pending = []  # dispatch everything first: device chunks overlap host
-    for start in range(0, n, _MAX_CHUNK):
-        end = min(start + _MAX_CHUNK, n)
-        size = _pad_size(end - start)
-        if ndev > 1:
-            # equal shards per device (non-power-of-two counts included)
-            size = -(-size // ndev) * ndev
-
-        def pad(a):
-            # batch is the trailing axis for every kernel input
-            padded = np.zeros(a.shape[:-1] + (size,), a.dtype)
-            padded[..., : end - start] = a[..., start:end]
-            return padded
-
-        padded_args = [pad(a) for a in packed]
-        if ndev > 1:
-            # multi-chip: shard the batch (lane) axis over the mesh —
-            # ICI within a host, DCN across hosts (crypto/tpu/mesh.py)
-            mask = mesh_mod.sharded_verify(kernel, padded_args)
-        else:
-            mask = kernel(*padded_args)
-        pending.append((start, end, mask))
-    for start, end, mask in pending:
-        out[start:end] = np.asarray(mask)[: end - start]
+    out = mesh_mod.dispatch_batch(kernel, packed, n, _MAX_CHUNK, _MIN_PAD)
     return list(out & valid)

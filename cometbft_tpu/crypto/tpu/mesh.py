@@ -107,6 +107,42 @@ def n_devices() -> int:
 _sharded_kernels = {}
 
 
+def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
+    """Shared chunk-pad-dispatch loop for batch verify kernels (used by
+    both the ed25519 and secp256k1 entries): pads each chunk's trailing
+    batch axis to a power of two (rounded to equal per-device shards),
+    shards over the mesh when >1 device is visible, and gathers the
+    boolean masks. Dispatches every chunk before collecting any, so
+    device work overlaps host packing."""
+    import numpy as np
+
+    ndev = n_devices()
+    out = np.zeros(n, bool)
+    pending = []
+    for start in range(0, n, max_chunk):
+        end = min(start + max_chunk, n)
+        size = min_pad
+        while size < end - start:
+            size *= 2
+        if ndev > 1:
+            size = -(-size // ndev) * ndev
+
+        def pad(a):
+            padded = np.zeros(a.shape[:-1] + (size,), a.dtype)
+            padded[..., : end - start] = a[..., start:end]
+            return padded
+
+        padded_args = [pad(a) for a in packed]
+        if ndev > 1:
+            mask = sharded_verify(kernel, padded_args)
+        else:
+            mask = kernel(*padded_args)
+        pending.append((start, end, mask))
+    for start, end, mask in pending:
+        out[start:end] = np.asarray(mask)[: end - start]
+    return out
+
+
 def sharded_verify(kernel, args):
     """Run a verify kernel with every input's trailing (batch) axis
     sharded over the mesh. args are numpy arrays whose trailing dim is

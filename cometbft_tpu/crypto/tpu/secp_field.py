@@ -1,0 +1,282 @@
+"""GF(p) arithmetic for secp256k1 on TPU limb vectors,
+p = 2^256 - 2^32 - 977.
+
+Same design language as field.py (the ed25519 field): little-endian
+radix-2^14 limbs in int32 lanes, limb axis 0, batch on the trailing
+(lane) axis, no data-dependent control flow. Differences forced by the
+prime: 19 limbs × 14 bits (266 ≥ 256), and the top-carry fold constant is
+V = 2^266 mod p = 2^42 + 977·2^10 whose radix-2^14 limbs are
+[1024, 61, 0, 1] — all tiny, which is what keeps fold-back carries from
+inflating limbs past the int32 product bound (a radix-15 layout was
+tried first: its fold limb 16384 is HALF the radix, and identity-heavy
+op chains overflowed). A multiply reduces in two stages: {0,1}-matrix
+scatter of the outer product into 38 columns (exact in int32 — unit
+weights), then two V-folds with lo/hi product splits (the scalar.py
+sc_reduce pattern).
+
+Verification-only: no constant-time requirements. Exactness is pinned
+by randomized chained-composition parity tests against CPython big-int
+(tests/test_tpu_secp.py) — every op keeps limbs inside the invariant
+|limb| small enough that limb products stay in int32.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+from jax import lax
+
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+B3 = 21  # 3·b for the complete-addition formulas (b = 7)
+
+NUM_LIMBS = 19
+RADIX = 14
+_MASK = 0x3FFF
+
+_V = (1 << (RADIX * NUM_LIMBS)) % P  # 2^266 mod p = 2^42 + 977·2^10
+_V_LIMBS = [(_V >> (RADIX * i)) & _MASK for i in range(4)]
+
+
+def int_to_limbs(n: int) -> List[int]:
+    return [(n >> (RADIX * i)) & _MASK for i in range(NUM_LIMBS)]
+
+
+def limbs_to_int(limbs) -> int:
+    total = 0
+    for i, limb in enumerate(limbs):
+        total += int(limb) << (RADIX * i)
+    return total
+
+
+def const_fe(n: int) -> jnp.ndarray:
+    return jnp.array(int_to_limbs(n % P), jnp.int32)[:, None]
+
+
+_P_LIMBS = jnp.array(int_to_limbs(P), jnp.int32)[:, None]
+
+
+def _cols_of(n: int) -> jnp.ndarray:
+    cols = [(n >> (RADIX * i)) & _MASK for i in range(NUM_LIMBS - 1)]
+    cols.append(n >> (RADIX * (NUM_LIMBS - 1)))  # top keeps the rest
+    return jnp.array(cols, jnp.int32)[:, None]
+
+
+_FOUR_P_COLS = _cols_of(4 * P)  # top column < 2^18
+
+
+def _carry_round(x: jnp.ndarray) -> jnp.ndarray:
+    """One vectorized carry round; the top carry (callers keep it
+    < 2^14) folds back through V's limbs [1024, 61, 0, 1] — products
+    < 2^24."""
+    c = x >> RADIX
+    kept = x & _MASK
+    shifted = jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+    out = kept + shifted
+    top = c[NUM_LIMBS - 1]
+    for i, v in enumerate(_V_LIMBS):
+        if v:
+            out = out.at[i].add(top * jnp.int32(v))
+    return out
+
+
+def _reduce(cols: jnp.ndarray) -> jnp.ndarray:
+    """Signed columns |col| < 2^25 → invariant limbs, value mod p.
+
+    Round 1: carries ≤ 2^11, V-fold adds < 2^21 to limbs 0..3.
+    Round 2: carries ≤ 2^7, top carry ≤ 2 → fold < 2^12. Rounds 3-4
+    converge: limbs end in [-4, 2^14 + small] — products of two
+    invariant limbs stay far inside int32 (< 2^29)."""
+    for _ in range(4):
+        cols = _carry_round(cols)
+    return cols
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry_round(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry_round(_carry_round(a - b + _FOUR_P_COLS))
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _carry_round(_carry_round(_FOUR_P_COLS - a))
+
+
+def mul_small(a: jnp.ndarray, c: int) -> jnp.ndarray:
+    return _reduce(a * c)
+
+
+def _scatter_matrices():
+    """{0,1} matrices [38, 361]: position of each outer-product part."""
+    import numpy as np
+
+    width = 2 * NUM_LIMBS
+    m_lo = np.zeros((width, NUM_LIMBS * NUM_LIMBS), np.int32)
+    m_hi = np.zeros((width, NUM_LIMBS * NUM_LIMBS), np.int32)
+    for i in range(NUM_LIMBS):
+        for j in range(NUM_LIMBS):
+            idx = i * NUM_LIMBS + j
+            m_lo[i + j, idx] = 1
+            if i + j + 1 < width:
+                m_hi[i + j + 1, idx] = 1
+    return m_lo, m_hi
+
+
+_M_LO, _M_HI = _scatter_matrices()
+
+
+def _carry_signed_list(cols: List[jnp.ndarray]) -> List[jnp.ndarray]:
+    out = []
+    carry = jnp.zeros_like(cols[0])
+    for c in cols[:-1]:
+        t = c + carry
+        out.append(t & _MASK)
+        carry = t >> RADIX
+    out.append(cols[-1] + carry)  # top keeps the signed remainder
+    return out
+
+
+def _fold_v(cols36: jnp.ndarray) -> jnp.ndarray:
+    """38 signed columns (|col| < 2^22) → 19 columns, value mod p.
+
+    hi := columns 19..37 normalized to 14-bit limbs (+ signed top);
+    acc := lo + hi·V with every product split into 14-bit lo / signed
+    hi parts (products < 2^26, column sums < 2^24). The fold spills
+    into a few extra columns — one second, tiny fold brings those
+    home."""
+    lo = [cols36[i] for i in range(NUM_LIMBS)]
+    hi = _carry_signed_list([cols36[NUM_LIMBS + i] for i in range(NUM_LIMBS)])
+    acc = lo + [jnp.zeros_like(lo[0]) for _ in range(5)]
+
+    def fold_into(acc, limbs):
+        for i, h in enumerate(limbs):
+            for j, v in enumerate(_V_LIMBS):
+                if v:
+                    p = h * jnp.int32(v)  # |h| ≤ 2^15ish → |p| < 2^30
+                    acc[i + j] = acc[i + j] + (p & _MASK)
+                    acc[i + j + 1] = acc[i + j + 1] + (p >> RADIX)
+        return acc
+
+    acc = fold_into(acc, hi)  # spills into acc[19..23]
+    spill = _carry_signed_list(acc[NUM_LIMBS:])
+    acc = acc[:NUM_LIMBS] + [jnp.zeros_like(lo[0])] * 5
+    acc = fold_into(acc, spill)
+    # second spill lands inside: spill ≤ 6 limbs → i+j+1 ≤ 6+3 < 19 ✓
+    return jnp.stack(acc[:NUM_LIMBS], axis=0)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    flat = NUM_LIMBS * NUM_LIMBS
+    prod = a[:, None] * b[None, :]  # [19, 19, B]
+    lo = (prod & _MASK).reshape((flat,) + prod.shape[2:])
+    hi = (prod >> RADIX).reshape((flat,) + prod.shape[2:])
+    cols36 = jnp.asarray(_M_LO) @ lo + jnp.asarray(_M_HI) @ hi
+    return _reduce(_fold_v(cols36))
+
+
+def sq(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def _carry_seq(x: jnp.ndarray):
+    out = []
+    carry = jnp.zeros(x.shape[1:], jnp.int32)
+    for i in range(NUM_LIMBS):
+        t = x[i] + carry
+        out.append(t & _MASK)
+        carry = t >> RADIX
+    return jnp.stack(out, axis=0), carry
+
+
+def to_canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Invariant fe → unique representative in [0, p).
+
+    Unlike the ed25519 field (17 limbs = 255 bits ≈ log2 p), the 19-limb
+    span holds values up to ~2^10·p, so canonicalization folds at bit
+    256 (2^256 ≡ 2^32 + 977, i.e. hi·16 into limb 2 + hi·977 into limb
+    0), twice, before the final conditional subtracts."""
+    # resolve carries; the 2^270 overflow folds through V
+    for _ in range(2):
+        x, c = _carry_seq(x)
+        for i, v in enumerate(_V_LIMBS):
+            if v:
+                x = x.at[i].add(c * jnp.int32(v))
+    x, _ = _carry_seq(x)
+    # fold bits ≥ 256: 256 = 18·14 + 4 → hi = limb18 >> 4 (< 2^10)
+    for _ in range(2):
+        hi = x[18] >> 4
+        x = x.at[18].set(x[18] & 0xF)
+        x = x.at[2].add(hi * 16)  # 2^32 = 2^(2·14+4)
+        x = x.at[0].add(hi * 977)
+        x, _ = _carry_seq(x)  # no 2^270 overflow: value < 2^257
+    for _ in range(2):  # value < 2p after the folds
+        diff, borrow = _borrow_sub(x, _P_LIMBS)
+        x = jnp.where((borrow == 0)[None], diff, x)
+    return x
+
+
+def _borrow_sub(a: jnp.ndarray, b: jnp.ndarray):
+    out = []
+    borrow = jnp.zeros(a.shape[1:], jnp.int32)
+    for i in range(NUM_LIMBS):
+        t = a[i] - b[i] - borrow
+        out.append(t & _MASK)
+        borrow = (t >> RADIX) & 1
+    return jnp.stack(out, axis=0), borrow
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(to_canonical(a) == to_canonical(b), axis=0)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(to_canonical(a) == 0, axis=0)
+
+
+def select(pred: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(pred[None], a, b)
+
+
+def _pow_const(x: jnp.ndarray, e: int) -> jnp.ndarray:
+    """Fixed-exponent pow: square-and-multiply over the constant bit
+    string under a fori_loop (~2 muls/bit — only used outside the main
+    Straus loop, for decompression and the final inversion)."""
+    bits = jnp.array([int(b) for b in bin(e)[2:]], jnp.int32)
+    one = const_fe(1)
+    acc0 = jnp.broadcast_to(one, x.shape)
+
+    def body(i, acc):
+        acc = sq(acc)
+        return jnp.where(bits[i] == 1, mul(acc, x), acc)
+
+    return lax.fori_loop(0, bits.shape[0], body, acc0)
+
+
+def invert(x: jnp.ndarray) -> jnp.ndarray:
+    """x^(p-2); invert(0) = 0."""
+    return _pow_const(x, P - 2)
+
+
+def sqrt_candidate(x: jnp.ndarray) -> jnp.ndarray:
+    """x^((p+1)/4) — a square root when x is a QR (p ≡ 3 mod 4);
+    callers must verify candidate² == x."""
+    return _pow_const(x, (P + 1) // 4)
+
+
+def bytes_be_to_limbs_np(data):
+    """numpy uint8[..., 32] BIG-endian field elements → int32[..., 19]
+    limbs. Host-side; transpose to limb-major before the kernel."""
+    import numpy as np
+
+    b = np.asarray(data, dtype=np.uint8)[..., ::-1]  # → little-endian
+    bits = np.unpackbits(b, axis=-1, bitorder="little")
+    pad = NUM_LIMBS * RADIX - 256
+    bits = np.concatenate(
+        [bits, np.zeros(bits.shape[:-1] + (pad,), bits.dtype)], axis=-1
+    )
+    weights = (1 << np.arange(RADIX, dtype=np.int32)).astype(np.int32)
+    shaped = bits.reshape(b.shape[:-1] + (NUM_LIMBS, RADIX)).astype(np.int32)
+    return shaped @ weights
