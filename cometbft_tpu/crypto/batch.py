@@ -56,22 +56,24 @@ class CPUBatchVerifier(BatchVerifier):
 
 
 class TPUBatchVerifier(BatchVerifier):
-    """Partitions the batch by curve (SURVEY.md §7 stage 10): ed25519
-    entries go to the ed25519 batch kernel, secp256k1 entries to the
-    secp256k1 batch kernel, anything else falls back to serial CPU
-    verification in place. Each partition applies the min_batch routing
-    independently."""
+    """Partitions the batch by curve (SURVEY.md §7 stage 10): ed25519,
+    secp256k1, and sr25519 entries each go to their own batch kernel;
+    anything else falls back to serial CPU verification in place. Each
+    partition applies its routing threshold independently (the non-ed
+    curves' CPU fallbacks are pure-Python big-int, so their threshold is
+    tiny)."""
 
     def __init__(
         self,
         min_batch: Optional[int] = None,
-        secp_min_batch: Optional[int] = None,
+        slow_curve_min_batch: Optional[int] = None,
     ):
         # fail fast if a kernel module is unavailable rather than erroring
         # mid-verify after add() calls succeeded
         from cometbft_tpu.crypto.tpu import (  # noqa: F401
             ed25519_batch,
             secp256k1_batch,
+            sr25519_batch,
         )
 
         self._items: List[Tuple[PubKey, bytes, bytes]] = []
@@ -87,12 +89,15 @@ class TPUBatchVerifier(BatchVerifier):
         if min_batch is None:
             min_batch = int(os.environ.get("CBFT_TPU_MIN_BATCH", "1024"))
         self._min_batch = min_batch
-        # The secp crossover is a different animal: its CPU fallback is
-        # pure-Python big-int ECDSA (~ms/sig), so the device wins almost
-        # immediately — route even small batches to the kernel.
-        if secp_min_batch is None:
-            secp_min_batch = int(os.environ.get("CBFT_TPU_SECP_MIN_BATCH", "4"))
-        self._secp_min_batch = secp_min_batch
+        # The non-ed curves (secp256k1, sr25519) are a different animal:
+        # their CPU fallbacks are pure-Python big-int (~ms/sig), so the
+        # device wins almost immediately — route even small batches to
+        # the kernels. One shared knob governs both.
+        if slow_curve_min_batch is None:
+            slow_curve_min_batch = int(
+                os.environ.get("CBFT_TPU_SLOW_CURVE_MIN_BATCH", "4")
+            )
+        self._slow_curve_min_batch = slow_curve_min_batch
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
         if pub_key is None:
@@ -104,12 +109,17 @@ class TPUBatchVerifier(BatchVerifier):
 
     def verify(self) -> Tuple[bool, List[bool]]:
         from cometbft_tpu.crypto import secp256k1 as secp
+        from cometbft_tpu.crypto import sr25519 as sr
 
         items, self._items = self._items, []
         if not items:
             return False, []
         mask: List[Optional[bool]] = [None] * len(items)
-        by_curve: Dict[str, List[int]] = {ed.KEY_TYPE: [], secp.KEY_TYPE: []}
+        by_curve: Dict[str, List[int]] = {
+            ed.KEY_TYPE: [],
+            secp.KEY_TYPE: [],
+            sr.KEY_TYPE: [],
+        }
         for i, (pk, msg, sig) in enumerate(items):
             idxs = by_curve.get(pk.type())
             if idxs is not None:
@@ -120,7 +130,9 @@ class TPUBatchVerifier(BatchVerifier):
             if not idxs:
                 continue
             threshold = (
-                self._min_batch if curve == ed.KEY_TYPE else self._secp_min_batch
+                self._min_batch
+                if curve == ed.KEY_TYPE
+                else self._slow_curve_min_batch
             )
             if len(idxs) < threshold:
                 for i in idxs:
@@ -129,8 +141,10 @@ class TPUBatchVerifier(BatchVerifier):
                 continue
             if curve == ed.KEY_TYPE:
                 from cometbft_tpu.crypto.tpu import ed25519_batch as kernel
-            else:
+            elif curve == secp.KEY_TYPE:
                 from cometbft_tpu.crypto.tpu import secp256k1_batch as kernel
+            else:
+                from cometbft_tpu.crypto.tpu import sr25519_batch as kernel
             ok = kernel.verify_batch(
                 [items[i][0].bytes() for i in idxs],
                 [items[i][1] for i in idxs],
@@ -182,5 +196,6 @@ def new_batch_verifier(backend: Optional[str] = None) -> BatchVerifier:
 
 def supports_batch_verification(pub_key: PubKey) -> bool:
     from cometbft_tpu.crypto import secp256k1 as secp
+    from cometbft_tpu.crypto import sr25519 as sr
 
-    return pub_key.type() in (ed.KEY_TYPE, secp.KEY_TYPE)
+    return pub_key.type() in (ed.KEY_TYPE, secp.KEY_TYPE, sr.KEY_TYPE)

@@ -1,0 +1,127 @@
+"""TPU batched sr25519 — bit-identical parity with the CPU verifier.
+
+The third curve kernel (crypto/tpu/sr25519_batch.py): ristretto decode,
+joint Straus s·B + k·(−A) on the shared ed25519 machinery, ristretto
+equality. Accept/reject must match crypto/sr25519.py exactly. Runs on
+the virtual CPU platform (conftest.py).
+"""
+
+import numpy as np
+
+from cometbft_tpu.crypto import sr25519 as sr
+from cometbft_tpu.crypto.tpu import sr25519_batch
+
+
+def _cpu_verify(pk: bytes, msg: bytes, sig: bytes) -> bool:
+    return sr.PubKeySr25519(pk).verify_signature(msg, sig)
+
+
+def _assert_parity(pks, msgs, sigs):
+    got = sr25519_batch.verify_batch(pks, msgs, sigs)
+    want = [_cpu_verify(p, m, s) for p, m, s in zip(pks, msgs, sigs)]
+    assert got == want, f"mismatch: tpu={got} cpu={want}"
+    return got
+
+
+class TestSr25519Parity:
+    def test_valid_corrupted_and_cross(self):
+        keys = [sr.PrivKeySr25519(bytes([i]) * 32) for i in range(1, 6)]
+        pks, msgs, sigs = [], [], []
+        for i, k in enumerate(keys):
+            m = b"sr vote %d" % i
+            s = bytearray(k.sign(m))
+            if i == 1:
+                s[8] ^= 1  # corrupt R
+            if i == 3:
+                s[40] ^= 1  # corrupt s
+            pks.append(k.pub_key().bytes())
+            msgs.append(m)
+            sigs.append(bytes(s))
+        # wrong key for a valid signature
+        pks.append(keys[0].pub_key().bytes())
+        msgs.append(b"sr vote 4")
+        sigs.append(keys[4].sign(b"sr vote 4"))
+        got = _assert_parity(pks, msgs, sigs)
+        assert got[0] and not got[1] and not got[3] and not got[5]
+
+    def test_format_bit_and_scalar_range(self):
+        k = sr.PrivKeySr25519(b"\x07" * 32)
+        m = b"fmt"
+        sig = k.sign(m)
+        # clearing the schnorrkel format bit must reject
+        old_fmt = sig[:63] + bytes([sig[63] & 0x7F])
+        # s >= L (set high bits below the format bit)
+        fat_s = sig[:32] + b"\xff" * 31 + bytes([0xFF])
+        got = _assert_parity(
+            [k.pub_key().bytes()] * 3, [m] * 3, [sig, old_fmt, fat_s]
+        )
+        assert got == [True, False, False]
+
+    def test_non_canonical_and_odd_encodings(self):
+        k = sr.PrivKeySr25519(b"\x09" * 32)
+        m = b"enc"
+        sig = k.sign(m)
+        odd_pk = bytearray(k.pub_key().bytes())
+        odd_pk[0] |= 1  # "negative" ristretto encoding
+        non_canon = b"\xff" * 32  # >= p
+        odd_r = bytearray(sig)
+        odd_r[0] |= 1  # "negative" R encoding
+        fat_r = b"\xff" * 32 + sig[32:]  # non-canonical R (>= p)
+        got = _assert_parity(
+            [bytes(odd_pk), non_canon] + [k.pub_key().bytes()] * 2,
+            [m] * 4,
+            [sig, sig, bytes(odd_r), fat_r],
+        )
+        assert got[2] is False or got[2] == False  # odd R rejected
+        assert not got[3]
+
+    def test_empty_and_wrong_lengths(self):
+        k = sr.PrivKeySr25519(b"\x0b" * 32)
+        got = sr25519_batch.verify_batch(
+            [b"short", k.pub_key().bytes()],
+            [b"m", b"m"],
+            [b"\x80" * 64, b"\x01" * 63],
+        )
+        assert got == [False, False]
+        assert sr25519_batch.verify_batch([], [], []) == []
+
+
+class TestThreeCurveBoundary:
+    def test_all_three_kernels_in_one_batch(self):
+        from cometbft_tpu.crypto import ed25519 as ed
+        from cometbft_tpu.crypto import secp256k1 as secp
+        from cometbft_tpu.crypto.batch import (
+            TPUBatchVerifier,
+            supports_batch_verification,
+        )
+
+        bv = TPUBatchVerifier(min_batch=1, slow_curve_min_batch=1)
+        expect = []
+        for i in range(2):
+            k = ed.gen_priv_key_from_secret(bytes([i, 41]))
+            m = b"ed %d" % i
+            bv.add(k.pub_key(), m, k.sign(m))
+            expect.append(True)
+            assert supports_batch_verification(k.pub_key())
+        for i in range(2):
+            k = secp.gen_priv_key()
+            m = b"secp %d" % i
+            s = bytearray(k.sign(m))
+            if i == 0:
+                s[3] ^= 1
+            bv.add(k.pub_key(), m, bytes(s))
+            expect.append(
+                secp.PubKeySecp256k1(k.pub_key().bytes()).verify_signature(
+                    m, bytes(s)
+                )
+            )
+            assert supports_batch_verification(k.pub_key())
+        for i in range(2):
+            k = sr.PrivKeySr25519(bytes([i + 1, 43] * 16))
+            m = b"sr %d" % i
+            sig = k.sign(m) if i else b"\x80" * 64
+            bv.add(k.pub_key(), m, sig)
+            expect.append(_cpu_verify(k.pub_key().bytes(), m, sig))
+            assert supports_batch_verification(k.pub_key())
+        ok, mask = bv.verify()
+        assert mask == expect, (mask, expect)
