@@ -202,15 +202,76 @@ def _mul_matmul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return _reduce(folded)
 
 
+def _f32_matrices():
+    """Constant {0,1} f32 matrices [3·34, 1156]: row (q·34 + c) collects
+    the half-limb products a_m1·b_m2 with limb-sum i1+i2 = c and
+    sub-shift k1+k2 = q (halves: m = 2i+k, k=0 → low 7 bits, k=1 → the
+    ≤8-bit top; weight 2^(15i + 7k))."""
+    import numpy as np
+
+    h = 2 * NUM_LIMBS
+    m = np.zeros((3 * h, h * h), np.float32)
+    for m1 in range(h):
+        for m2 in range(h):
+            i_sum = m1 // 2 + m2 // 2
+            q = m1 % 2 + m2 % 2
+            m[q * h + i_sum, m1 * h + m2] = 1.0
+    return m
+
+
+_F32_SCATTER = _f32_matrices()
+
+
+def _mul_f32(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Exact-float form: each 15-bit limb splits into (≤8-bit hi, 7-bit
+    lo) halves; the 34×34 half-limb products run in f32 and fold through
+    one constant {0,1} matmul. Every product (≤ 2^16) and every matmul
+    row sum (≤ 34·2^16 < 2^21.1) stays inside the 24-bit mantissa —
+    bit-exact by construction, pinned by the same parity suite as the
+    int32 forms.
+
+    Why it exists: TPU VPUs issue f32 FMAs at full rate while int32
+    multiplies decompose into multi-op sequences, and the f32 constant
+    matmul can ride the MXU outright. Whether that beats shift_add is an
+    on-chip CBFT_TPU_MUL A/B question, not a paper one."""
+    h = 2 * NUM_LIMBS
+    # interleaved halves [34, B]: row 2i = a_i & 0x7F, row 2i+1 = a_i >> 7
+    # (arithmetic shift keeps the identity for the invariant's small
+    # negative limbs; f32 exactness bounds are on magnitudes)
+    ha = jnp.stack([a & 0x7F, a >> 7], axis=1).reshape((h,) + a.shape[1:])
+    hb = jnp.stack([b & 0x7F, b >> 7], axis=1).reshape((h,) + b.shape[1:])
+    prod = ha.astype(jnp.float32)[:, None] * hb.astype(jnp.float32)[None, :]
+    prod = prod.reshape((h * h,) + prod.shape[2:])
+    grouped = jnp.asarray(_F32_SCATTER) @ prod  # [3·34, B], exact
+    gi = grouped.astype(jnp.int32)
+    c0, c1, c2 = gi[:h], gi[h : 2 * h], gi[2 * h :]
+    # recombine the three sub-shift groups into radix-2^15 columns:
+    # col[i] += c0[i] + (c1[i] low 8)·2^7 + (c2[i] bit 0)·2^14,
+    # col[i+1] += c1[i] >> 8 + c2[i] >> 1 — every piece < 2^21
+    cols = (
+        c0
+        + ((c1 & 0xFF) << 7)
+        + ((c2 & 1) << 14)
+    )
+    spill = (c1 >> 8) + (c2 >> 1)
+    cols = cols.at[1:].add(spill[:-1])
+    top_spill = spill[h - 1]  # weight 2^(15·34) ≡ 19·19
+    folded = cols[:NUM_LIMBS] + 19 * cols[NUM_LIMBS:]
+    folded = folded.at[0].add(361 * top_spill)
+    return _reduce(folded)
+
+
 # Limb products ≤ (2^15+127)^2 < 2^31 are exact in int32. Each product
 # splits into a 15-bit low part and a signed high part before column
 # accumulation, keeping columns ≤ 34·(2^15+2^8) < 2^21; the fold of
 # columns 17..33 (weight 2^255 ≡ 19) brings them to < 2^25 — the
-# _reduce precondition. All implementations share this bound analysis.
+# _reduce precondition. All implementations share this bound analysis
+# (the f32 form documents its own).
 _MUL_IMPLS = {
     "stack": _mul_stack,
     "shift_add": _mul_shift_add,
     "matmul": _mul_matmul,
+    "f32": _mul_f32,
 }
 
 
