@@ -87,6 +87,22 @@ def bench_cpu_serial(n: int = 512) -> float:
     return n / dt
 
 
+def bench_cpu_parallel(n: int = 4096) -> float:
+    """The upgraded CPU plane: ed25519.verify_many — one native
+    multi-threaded call on multicore hosts, cached-handle tight loop on
+    one core. This is the node's real fallback when the TPU tunnel is
+    down (it wedged rounds 3 and 4)."""
+    from cometbft_tpu.crypto import ed25519 as ed
+
+    pks, msgs, sigs = _make_batch(n)
+    items = [(ed.PubKeyEd25519(pk), m, s) for pk, m, s in zip(pks, msgs, sigs)]
+    assert all(ed.verify_many(items))  # warm native build + key handles
+    t0 = time.perf_counter()
+    assert all(ed.verify_many(items))
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
 def bench_cpu_batch(n: int = 1024, batch_size: int = 64) -> float:
     """The BASELINE.md CPU batch baseline: 64-sig batches through the
     BatchVerifier boundary (cpu backend — a serial loop inside)."""
@@ -433,6 +449,8 @@ def main():
     stages["cpu_serial_sigs_per_sec"] = round(cpu_serial, 1)
     cpu_batch = bench_cpu_batch()
     stages["cpu_batch64_sigs_per_sec"] = round(cpu_batch, 1)
+    stages["cpu_parallel_sigs_per_sec"] = round(bench_cpu_parallel(), 1)
+    stages["cpu_ncores"] = os.cpu_count() or 1
 
     backend = "tpu"
     result = None

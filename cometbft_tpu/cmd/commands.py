@@ -261,6 +261,12 @@ def cmd_loadtime(args) -> int:
                 + "x" * max(0, args.size - 24)
             ).encode()[: max(args.size, 16)]
             seq += 1
+            # `sent` counts at SEND time: a commit ack landing after the
+            # window closes must not erase that its tx was sent inside it
+            with mtx:
+                if stop.is_set():
+                    break
+                stats["sent"] += 1
             t0 = _time.monotonic()
             ok = False
             try:
@@ -271,11 +277,9 @@ def cmd_loadtime(args) -> int:
             with mtx:
                 # commits landing after the window closes are drained,
                 # not measured — throughput divides by the WINDOW
-                if not stop.is_set():
-                    stats["sent"] += 1
-                    if ok:
-                        stats["committed"] += 1
-                        stats["latencies"].append(_time.monotonic() - t0)
+                if ok and not stop.is_set():
+                    stats["committed"] += 1
+                    stats["latencies"].append(_time.monotonic() - t0)
             stop.wait(period)
 
     threads = [

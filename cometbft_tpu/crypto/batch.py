@@ -36,7 +36,14 @@ class BatchVerifier:
 
 
 class CPUBatchVerifier(BatchVerifier):
-    """Serial CPU fallback — semantics ground truth."""
+    """CPU fallback — semantics ground truth.
+
+    Ed25519 entries go through ed25519.verify_many, which uses one
+    native multi-threaded call on multicore hosts (the `cryptography`
+    wheel holds the GIL during verify, so Python threads cannot scale
+    this loop — measured; see cometbft_tpu/native/ed25519_batch.c) and a
+    cached-handle tight loop otherwise. Other key types verify serially.
+    """
 
     def __init__(self):
         self._items: List[Tuple[PubKey, bytes, bytes]] = []
@@ -50,9 +57,23 @@ class CPUBatchVerifier(BatchVerifier):
         return len(self._items)
 
     def verify(self) -> Tuple[bool, List[bool]]:
-        mask = [pk.verify_signature(msg, sig) for pk, msg, sig in self._items]
-        self._items = []
-        return all(mask) if mask else False, mask
+        items, self._items = self._items, []
+        if not items:
+            return False, []
+        mask: List[Optional[bool]] = [None] * len(items)
+        ed_idxs = [
+            i for i, (pk, _, _) in enumerate(items)
+            if isinstance(pk, ed.PubKeyEd25519)
+        ]
+        if ed_idxs:
+            ed_mask = ed.verify_many([items[i] for i in ed_idxs])
+            for j, i in enumerate(ed_idxs):
+                mask[i] = ed_mask[j]
+        for i, (pk, msg, sig) in enumerate(items):
+            if mask[i] is None:
+                mask[i] = pk.verify_signature(msg, sig)
+        final = [bool(m) for m in mask]
+        return all(final), final
 
 
 class TPUBatchVerifier(BatchVerifier):
@@ -135,9 +156,14 @@ class TPUBatchVerifier(BatchVerifier):
                 else self._slow_curve_min_batch
             )
             if len(idxs) < threshold:
-                for i in idxs:
-                    pk, msg, sig = items[i]
-                    mask[i] = pk.verify_signature(msg, sig)
+                if curve == ed.KEY_TYPE:
+                    sub_mask = ed.verify_many([items[i] for i in idxs])
+                    for j, i in enumerate(idxs):
+                        mask[i] = sub_mask[j]
+                else:
+                    for i in idxs:
+                        pk, msg, sig = items[i]
+                        mask[i] = pk.verify_signature(msg, sig)
                 continue
             if curve == ed.KEY_TYPE:
                 from cometbft_tpu.crypto.tpu import ed25519_batch as kernel

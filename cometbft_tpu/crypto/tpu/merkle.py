@@ -37,8 +37,10 @@ _INNER_LEN = 65  # 0x01 || left32 || right32
 # device becomes worth the round-trip above this many leaves
 MIN_DEVICE_LEAVES = 128
 # device leaf hashing caps the per-item size (16 SHA blocks ≈ 1 KiB);
-# larger items fall back to host-hashed leaves + device tree
-_MAX_DEVICE_LEAF_BYTES = 16 * 64 - 9
+# larger items fall back to host-hashed leaves + device tree. The SHA
+# message is prefix ‖ item ‖ 0x80-pad ‖ 8-byte length, so the prefix
+# byte counts against the 16-block budget too
+_MAX_DEVICE_LEAF_BYTES = 16 * 64 - 9 - len(_LEAF_PREFIX)
 
 
 def _pad_pow2(n: int) -> int:

@@ -1,0 +1,146 @@
+"""Native (C) runtime pieces of the framework.
+
+The compute plane is JAX/XLA/Pallas (cometbft_tpu.crypto.tpu); this
+package holds the native CPU runtime the reference implements in Go +
+assembly — today the batched ed25519 fallback verifier
+(`ed25519_batch.c`), built on demand with the system toolchain and
+loaded via ctypes (which releases the GIL around calls).
+
+Everything here degrades gracefully: if the toolchain or libcrypto is
+unavailable the loader returns None and callers use the pure-Python
+path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ed25519_batch.c")
+_SO = os.path.join(_HERE, "build", "libcbft_ed25519.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        return _build_inner()
+    except OSError:
+        # read-only package dir, missing source, fs races — all mean
+        # "no native path"; the caller degrades to pure Python
+        return False
+
+
+def _build_inner() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    # rebuild only when the source is newer than the cached .so
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return True
+    cc = os.environ.get("CC", "cc")
+    # the image has libcrypto.so.3 but no dev symlink/headers: try the
+    # dev-style -lcrypto first, then link the runtime .so by path
+    candidates = [
+        ["-lcrypto"],
+        ["/usr/lib/x86_64-linux-gnu/libcrypto.so.3"],
+        ["/lib/x86_64-linux-gnu/libcrypto.so.3"],
+    ]
+    for libargs in candidates:
+        cmd = [
+            cc, "-O2", "-shared", "-fPIC", "-o", _SO + ".tmp", _SRC,
+            "-pthread", *libargs,
+        ]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=120
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+        if proc.returncode == 0:
+            os.replace(_SO + ".tmp", _SO)
+            return True
+    return False
+
+
+def load_ed25519() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the native verifier; None on failure."""
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed:
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("CBFT_NATIVE_ED25519", "1") == "0" or not _build():
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.cbft_ed25519_verify_batch.restype = ctypes.c_int
+        lib.cbft_ed25519_verify_batch.argtypes = [
+            ctypes.c_char_p,                  # pubs
+            ctypes.c_char_p,                  # msgs
+            ctypes.POINTER(ctypes.c_size_t),  # msg_off
+            ctypes.POINTER(ctypes.c_size_t),  # msg_len
+            ctypes.c_char_p,                  # sigs
+            ctypes.POINTER(ctypes.c_ubyte),   # out
+            ctypes.c_size_t,                  # n
+            ctypes.c_int,                     # nthreads
+        ]
+        _lib = lib
+        return _lib
+
+
+def ed25519_verify_batch(
+    pubs: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+    nthreads: Optional[int] = None,
+) -> Optional[List[bool]]:
+    """One native call for the whole batch; None if the lib is unavailable.
+
+    Entries with malformed lengths are rejected (False) without being
+    passed to OpenSSL, matching PubKeyEd25519.verify_signature.
+    """
+    lib = load_ed25519()
+    if lib is None:
+        return None
+    n = len(pubs)
+    if n == 0:
+        return []
+    ok_shape = [
+        len(pubs[i]) == 32 and len(sigs[i]) == 64 for i in range(n)
+    ]
+    # malformed entries get zeroed slots so indices stay aligned
+    pub_buf = b"".join(
+        pubs[i] if ok_shape[i] else b"\x00" * 32 for i in range(n)
+    )
+    sig_buf = b"".join(
+        sigs[i] if ok_shape[i] else b"\x00" * 64 for i in range(n)
+    )
+    msg_buf = b"".join(msgs)
+    offs = (ctypes.c_size_t * n)()
+    lens = (ctypes.c_size_t * n)()
+    pos = 0
+    for i, m in enumerate(msgs):
+        offs[i] = pos
+        lens[i] = len(m)
+        pos += len(m)
+    out = (ctypes.c_ubyte * n)()
+    if nthreads is None:
+        nthreads = min(os.cpu_count() or 1, 16)
+    rc = lib.cbft_ed25519_verify_batch(
+        pub_buf, msg_buf, offs, lens, sig_buf, out, n, nthreads
+    )
+    if rc != 0:
+        return None
+    return [bool(out[i]) and ok_shape[i] for i in range(n)]

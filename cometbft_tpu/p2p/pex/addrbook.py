@@ -474,13 +474,35 @@ class AddrBook(BaseService):
                 ka.buckets = []
                 if ka.is_old:
                     idx = self.calc_old_bucket(ka.addr)
-                    if len(self._old_buckets[idx]) >= OLD_BUCKET_SIZE:
-                        continue
-                    self._old_buckets[idx][ka.addr.id] = ka
+                    bucket = self._old_buckets[idx]
+                    if len(bucket) >= OLD_BUCKET_SIZE:
+                        # proven-good addresses must survive a restart:
+                        # on a full old bucket, keep the BETTER peer old
+                        # (mark_good's rule — displace the stalest
+                        # resident by last_success into a new bucket)
+                        stalest = min(
+                            bucket.values(), key=lambda k: k.last_success
+                        )
+                        if stalest.last_success >= ka.last_success:
+                            # the loaded entry is the stalest: demote it
+                            ka.is_old = False
+                            self._add_to_new_bucket(
+                                ka, self.calc_new_bucket(ka.addr, ka.src)
+                            )
+                            continue
+                        bucket.pop(stalest.addr.id, None)
+                        stalest.buckets = []
+                        stalest.is_old = False
+                        self._add_to_new_bucket(
+                            stalest,
+                            self.calc_new_bucket(stalest.addr, stalest.src),
+                        )
+                    bucket[ka.addr.id] = ka
+                    ka.buckets = [idx]
+                    self._addrs[ka.addr.id] = ka
                 else:
-                    idx = self.calc_new_bucket(ka.addr, ka.src)
-                    if len(self._new_buckets[idx]) >= NEW_BUCKET_SIZE:
-                        continue
-                    self._new_buckets[idx][ka.addr.id] = ka
-                ka.buckets = [idx]
-                self._addrs[ka.addr.id] = ka
+                    # _add_to_new_bucket applies expireNew eviction on a
+                    # full bucket instead of silently dropping the load
+                    self._add_to_new_bucket(
+                        ka, self.calc_new_bucket(ka.addr, ka.src)
+                    )

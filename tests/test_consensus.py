@@ -380,3 +380,249 @@ class TestCrashRecovery:
             )
             assert info.last_block_height == bstore.height()
             conns.stop()
+
+
+# --- POL / lock-unlock state machine ---------------------------------------
+
+
+class _RecordingBus:
+    """NopEventBus that records which round-state events fired, in order."""
+
+    def __init__(self):
+        self.events = []
+
+    def __getattr__(self, name):
+        if name.startswith("publish_event_"):
+            kind = name[len("publish_event_"):]
+            return lambda *a, **k: self.events.append(kind)
+        raise AttributeError(name)
+
+    def count(self, kind):
+        return self.events.count(kind)
+
+
+class TestPOLLocking:
+    """Direct walks of _enter_precommit's lock/relock/unlock decisions —
+    the reference's TestStateLockNoPOL / TestStateLockPOLRelock /
+    TestStateLockPOLUnlock family (consensus/state_test.go), driven as
+    unit tests on one ConsensusState with votes injected from the other
+    three validators (3-of-4 × power 10 = 30 > 2/3 of 40)."""
+
+    CHAIN = "pol-chain"
+
+    def _make_cs(self):
+        from cometbft_tpu.consensus.round_state import RoundStepType
+        from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PRECOMMIT
+
+        vals, privs = test_util.deterministic_validator_set(4, 10)
+        doc = GenesisDoc(
+            genesis_time=Timestamp(1_700_000_000, 0),
+            chain_id=self.CHAIN,
+            validators=[
+                GenesisValidator(v.address, v.pub_key, v.voting_power, "")
+                for v in vals.validators
+            ],
+        )
+        cfg = make_test_config().consensus
+        cfg.wal_path = ""
+        state = make_genesis_state(doc)
+        store = Store(MemDB())
+        store.save(state)
+        client = LocalClient(KVStoreApplication())
+        client.start()
+        executor = BlockExecutor(store, AppConnConsensus(client))
+        bus = _RecordingBus()
+        cs = ConsensusState(
+            cfg, state, executor, BlockStore(MemDB()),
+            wal=NilWAL(), event_bus=bus,
+        )
+        cs.set_priv_validator(privs[0])
+        return cs, privs, bus
+
+    def _proposal_block(self, cs, privs, round_=0):
+        """A real height-1 proposal block + Proposal, installed in rs."""
+        from cometbft_tpu.types.block import Commit
+
+        block, parts = cs.block_exec.create_proposal_block(
+            1, cs.state, Commit(0, 0, BlockID(), []),
+            privs[0].get_pub_key().address(),
+        )
+        bid = BlockID(block.hash(), parts.header())
+        cs.rs.proposal = Proposal(
+            height=1, round=round_, pol_round=-1, block_id=bid
+        )
+        cs.rs.proposal_block = block
+        cs.rs.proposal_block_parts = parts
+        return bid
+
+    def _prevote(self, cs, privs, idxs, round_, bid):
+        from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PREVOTE
+
+        for i in idxs:
+            v = test_util.make_vote(
+                privs[i], self.CHAIN, i, 1, round_,
+                SIGNED_MSG_TYPE_PREVOTE, bid,
+            )
+            assert cs._add_vote(v, f"peer{i}")
+
+    def _own_votes(self, cs):
+        """Drain the internal queue; return this node's signed votes."""
+        out = []
+        while not cs.internal_msg_queue.empty():
+            mi = cs.internal_msg_queue.get_nowait()
+            msg = mi.msg if isinstance(mi, MsgInfo) else mi
+            if isinstance(msg, VoteMessage):
+                out.append(msg.vote)
+        return out
+
+    def _at_prevote(self, cs, round_=0):
+        from cometbft_tpu.consensus.round_state import RoundStepType
+
+        cs.rs.round = round_
+        cs.rs.step = RoundStepType.PREVOTE
+
+    def test_lock_on_polka(self):
+        from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PRECOMMIT
+
+        cs, privs, bus = self._make_cs()
+        bid = self._proposal_block(cs, privs)
+        self._at_prevote(cs)
+        self._prevote(cs, privs, (1, 2, 3), 0, bid)  # 30/40 > 2/3 → polka
+        assert cs.rs.locked_block is not None
+        assert cs.rs.locked_block.hash() == bid.hash
+        assert cs.rs.locked_round == 0
+        assert "polka" in bus.events and "lock" in bus.events
+        precommits = [
+            v for v in self._own_votes(cs)
+            if v.type == SIGNED_MSG_TYPE_PRECOMMIT
+        ]
+        assert precommits and precommits[-1].block_id.hash == bid.hash
+
+    def test_relock_same_block_later_round(self):
+        from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PRECOMMIT
+
+        cs, privs, bus = self._make_cs()
+        bid = self._proposal_block(cs, privs)
+        self._at_prevote(cs)
+        self._prevote(cs, privs, (1, 2, 3), 0, bid)
+        assert cs.rs.locked_round == 0
+        # round 1: polka for the SAME block → relock, not unlock
+        self._at_prevote(cs, round_=1)
+        self._prevote(cs, privs, (1, 2, 3), 1, bid)
+        assert cs.rs.locked_block is not None
+        assert cs.rs.locked_round == 1
+        assert bus.count("relock") == 1
+        assert bus.count("unlock") == 0
+        precommits = [
+            v for v in self._own_votes(cs)
+            if v.type == SIGNED_MSG_TYPE_PRECOMMIT and v.round == 1
+        ]
+        assert precommits and precommits[-1].block_id.hash == bid.hash
+
+    def test_unlock_on_nil_polka(self):
+        from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PRECOMMIT
+
+        cs, privs, bus = self._make_cs()
+        bid = self._proposal_block(cs, privs)
+        self._at_prevote(cs)
+        self._prevote(cs, privs, (1, 2, 3), 0, bid)
+        assert cs.rs.locked_block is not None
+        # round 1: +2/3 prevote nil → unlock, precommit nil
+        self._at_prevote(cs, round_=1)
+        self._prevote(cs, privs, (1, 2, 3), 1, BlockID())
+        assert cs.rs.locked_block is None
+        assert cs.rs.locked_round == -1
+        assert bus.count("unlock") >= 1
+        precommits = [
+            v for v in self._own_votes(cs)
+            if v.type == SIGNED_MSG_TYPE_PRECOMMIT and v.round == 1
+        ]
+        assert precommits and precommits[-1].block_id.is_zero()
+
+    def test_unlock_on_polka_for_unseen_block(self):
+        from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PRECOMMIT
+
+        cs, privs, bus = self._make_cs()
+        bid = self._proposal_block(cs, privs)
+        self._at_prevote(cs)
+        self._prevote(cs, privs, (1, 2, 3), 0, bid)
+        assert cs.rs.locked_block is not None
+        # round 1: polka for a block this node has never seen
+        unseen = test_util.make_block_id(b"\xaa" * 32, 7, b"\xbb" * 32)
+        self._at_prevote(cs, round_=1)
+        self._prevote(cs, privs, (1, 2, 3), 1, unseen)
+        # the later-round-different-block rule unlocks immediately
+        assert cs.rs.locked_block is None
+        assert bus.count("unlock") >= 1
+        # and the part-set has been re-primed to fetch the unseen block
+        assert cs.rs.proposal_block is None
+        assert cs.rs.proposal_block_parts.has_header(unseen.part_set_header)
+        # entering precommit without the block precommits nil
+        cs._enter_precommit(1, 1)
+        precommits = [
+            v for v in self._own_votes(cs)
+            if v.type == SIGNED_MSG_TYPE_PRECOMMIT and v.round == 1
+        ]
+        assert precommits and precommits[-1].block_id.is_zero()
+
+    def test_prevote_follows_lock(self):
+        from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PREVOTE
+
+        cs, privs, bus = self._make_cs()
+        bid = self._proposal_block(cs, privs)
+        self._at_prevote(cs)
+        self._prevote(cs, privs, (1, 2, 3), 0, bid)
+        assert cs.rs.locked_block is not None
+        self._own_votes(cs)  # drain
+        # round 1 arrives with a DIFFERENT proposal; locked node must
+        # still prevote its locked block (defaultDoPrevote rule)
+        cs.rs.round = 1
+        cs.rs.proposal_block = None
+        cs.rs.proposal_block_parts = None
+        cs._do_prevote(1, 1)
+        prevotes = [
+            v for v in self._own_votes(cs)
+            if v.type == SIGNED_MSG_TYPE_PREVOTE
+        ]
+        assert prevotes and prevotes[-1].block_id.hash == bid.hash
+
+    def test_precommit_nil_without_polka(self):
+        from cometbft_tpu.types.vote import SIGNED_MSG_TYPE_PRECOMMIT
+
+        cs, privs, bus = self._make_cs()
+        self._proposal_block(cs, privs)
+        self._at_prevote(cs)
+        # no prevotes at all → precommit nil, no lock, no polka event
+        cs._enter_precommit(1, 0)
+        assert cs.rs.locked_block is None
+        assert "polka" not in bus.events
+        precommits = [
+            v for v in self._own_votes(cs)
+            if v.type == SIGNED_MSG_TYPE_PRECOMMIT
+        ]
+        assert precommits and precommits[-1].block_id.is_zero()
+
+    def test_polka_below_two_thirds_does_not_lock(self):
+        cs, privs, bus = self._make_cs()
+        bid = self._proposal_block(cs, privs)
+        self._at_prevote(cs)
+        self._prevote(cs, privs, (1, 2), 0, bid)  # 20/40 — no polka
+        assert cs.rs.locked_block is None
+        assert "lock" not in bus.events
+
+    def test_unlock_only_for_later_round_polka(self):
+        """A polka from an EARLIER round must not unlock (the :2074 rule
+        requires locked_round < vote.round)."""
+        cs, privs, bus = self._make_cs()
+        bid = self._proposal_block(cs, privs)
+        self._at_prevote(cs)
+        self._prevote(cs, privs, (1, 2, 3), 0, bid)
+        assert cs.rs.locked_round == 0
+        # move to round 2 and lock there via relock
+        self._at_prevote(cs, round_=2)
+        self._prevote(cs, privs, (1, 2, 3), 2, bid)
+        assert cs.rs.locked_round == 2
+        # now a late nil polka for round 1 (< locked_round) arrives
+        self._prevote(cs, privs, (1, 2, 3), 1, BlockID())
+        assert cs.rs.locked_block is not None, "early-round polka must not unlock"
+        assert cs.rs.locked_round == 2

@@ -193,6 +193,31 @@ class TestBatchVerifier:
         with pytest.raises(ValueError):
             new_batch_verifier("quantum")
 
+    def test_verify_many_parity_with_serial(self):
+        # fast loop / native call must be bit-identical to verify_signature,
+        # including malformed sig and pubkey shapes
+        triples = self._mk(100, bad={3, 71})
+        pk0, m0, s0 = triples[0]
+        triples[10] = (pk0, m0, s0[:40])           # short sig
+        triples[11] = (ed25519.PubKeyEd25519(b"\xff" * 32), m0, s0)
+        expected = [pk.verify_signature(m, s) for pk, m, s in triples]
+        assert ed25519.verify_many(triples) == expected
+
+    def test_native_verify_batch_parity(self):
+        from cometbft_tpu import native
+
+        triples = self._mk(80, bad={1, 40})
+        mask = native.ed25519_verify_batch(
+            [pk.bytes() for pk, _, _ in triples],
+            [m for _, m, _ in triples],
+            [s for _, _, s in triples],
+            nthreads=4,
+        )
+        if mask is None:
+            pytest.skip("native verifier unavailable (no toolchain/libcrypto)")
+        expected = [pk.verify_signature(m, s) for pk, m, s in triples]
+        assert mask == expected
+
 
 class TestHashers:
     def test_tmhash(self):
