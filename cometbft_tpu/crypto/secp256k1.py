@@ -4,19 +4,43 @@ Reference: crypto/secp256k1/secp256k1.go — deterministic (RFC 6979) ECDSA
 signing producing compact 64-byte r||s signatures with low-S normalization;
 Bitcoin-style address RIPEMD160(SHA256(compressed_pubkey)).
 
-Pure-Python big-int curve arithmetic (off the consensus hot path; the batch
-hot path is ed25519 on TPU — a secp256k1 kernel is a stretch goal, SURVEY.md
-§7 stage 10).
+Verification routes through OpenSSL (the `cryptography` package) after
+the structural/low-S checks; the pure-Python big-int path remains as the
+parity oracle (CBFT_SECP_IMPL=python) and the fallback when OpenSSL lacks
+the curve. Signing stays pure-Python: RFC 6979 determinism is part of the
+reference's contract and OpenSSL's ECDSA sign draws random k.
 """
 
 from __future__ import annotations
 
 import hashlib
 import hmac
+import os
 import secrets
 
 from cometbft_tpu.crypto import PrivKey, PubKey, sha256
 from cometbft_tpu.crypto.ripemd160 import ripemd160
+
+try:
+    from cryptography.exceptions import InvalidSignature as _InvalidSignature
+    from cryptography.hazmat.primitives import hashes as _hashes
+    from cryptography.hazmat.primitives import serialization as _ser
+    from cryptography.hazmat.primitives.asymmetric import ec as _ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        encode_dss_signature as _encode_dss,
+    )
+
+    _OPENSSL = os.environ.get("CBFT_SECP_IMPL", "openssl") != "python"
+    if _OPENSSL:
+        # probe curve support ONCE: falling back per-call would pay a
+        # failed OpenSSL attempt plus the 55x-slower pure-Python path on
+        # every verify, silently
+        try:
+            _ec.derive_private_key(1, _ec.SECP256K1())
+        except Exception:  # noqa: BLE001 - curve unavailable in this build
+            _OPENSSL = False
+except ImportError:  # pragma: no cover - cryptography is baked in
+    _OPENSSL = False
 
 KEY_TYPE = "secp256k1"
 PUB_KEY_SIZE = 33  # compressed
@@ -108,6 +132,7 @@ class PubKeySecp256k1(PubKey):
         if len(key_bytes) != PUB_KEY_SIZE:
             raise ValueError(f"secp256k1 pubkey must be {PUB_KEY_SIZE} bytes")
         self._bytes = bytes(key_bytes)
+        self._pk = None  # lazily-parsed OpenSSL handle
 
     def address(self) -> bytes:
         """RIPEMD160(SHA256(compressed)) — secp256k1.go:1-25 header."""
@@ -122,10 +147,6 @@ class PubKeySecp256k1(PubKey):
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != SIG_SIZE:
             return False
-        try:
-            pt = _decompress(self._bytes)
-        except ValueError:
-            return False
         r = int.from_bytes(sig[:32], "big")
         s = int.from_bytes(sig[32:], "big")
         if not (1 <= r < _N and 1 <= s < _N):
@@ -133,6 +154,24 @@ class PubKeySecp256k1(PubKey):
         # reject high-S (malleability rule, as btcec's Signature.Verify
         # combined with the reference's serialization which always low-S)
         if s > _N // 2:
+            return False
+        if _OPENSSL:
+            try:
+                if self._pk is None:
+                    self._pk = _ec.EllipticCurvePublicKey.from_encoded_point(
+                        _ec.SECP256K1(), self._bytes
+                    )
+                self._pk.verify(
+                    _encode_dss(r, s), msg, _ec.ECDSA(_hashes.SHA256())
+                )
+                return True
+            except _InvalidSignature:
+                return False
+            except ValueError:
+                return False  # not a curve point — _decompress parity
+        try:
+            pt = _decompress(self._bytes)
+        except ValueError:
             return False
         e = int.from_bytes(sha256(msg), "big") % _N
         w = _inv(s, _N)
@@ -177,6 +216,13 @@ class PrivKeySecp256k1(PrivKey):
             return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     def pub_key(self) -> PubKeySecp256k1:
+        if _OPENSSL:
+            pub = _ec.derive_private_key(self._d, _ec.SECP256K1()).public_key()
+            return PubKeySecp256k1(
+                pub.public_bytes(
+                    _ser.Encoding.X962, _ser.PublicFormat.CompressedPoint
+                )
+            )
         return PubKeySecp256k1(_compress(_point_mul(self._d, (_GX, _GY))))
 
     def type(self) -> str:

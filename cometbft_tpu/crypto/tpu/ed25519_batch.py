@@ -412,6 +412,25 @@ def hash_mode() -> str:
     return mode
 
 
+def warmup(sizes: Sequence[int] = (64, 128, 256, 512, 1024)) -> None:
+    """Pre-compile the dispatch-size buckets so the FIRST commit a node
+    verifies on device doesn't pay a multi-second XLA compile (VERDICT
+    r4 item 2: small-batch dispatch overhead). dispatch_batch pads every
+    chunk to a power of two ≥ _MIN_PAD, so compiling each pow-2 bucket
+    once covers every runtime batch size up to max(sizes); the jax
+    persistent compilation cache (configured at node start) makes this a
+    disk read after the first boot. Inputs are synthetic — the kernel's
+    cost is shape-dependent only, and a parse-reject still exercises the
+    full program with valid=False lanes."""
+    pk = bytes(32)
+    sig = bytes(64)
+    msg = b"warmup"
+    for size in sizes:
+        # one entry is enough: dispatch pads the lane axis to `size`
+        # only when the batch is that large, so fill the bucket
+        verify_batch([pk] * size, [msg] * size, [sig] * size)
+
+
 def verify_batch(
     pub_keys: Sequence[bytes],
     msgs: Sequence[bytes],

@@ -22,7 +22,16 @@ from __future__ import annotations
 
 import os
 import threading
+import warnings
 from typing import Optional
+
+# the CPU fallback platform can't honor buffer donation and warns on
+# every dispatch; install the filter ONCE here — per-dispatch
+# warnings.catch_warnings() would mutate process-global filter state
+# from multiple threads (warmup + consensus both dispatch)
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
 
 _mtx = threading.Lock()
 _cached = None
@@ -167,6 +176,10 @@ def sharded_verify(kernel, args):
             inner,
             in_shardings=shardings,
             out_shardings=NamedSharding(mesh, PS("batch")),
+            # inputs are single-use staging buffers: donating them lets
+            # XLA reuse the space instead of holding input + workspace
+            # live together (matters at the 8k-lane chunks)
+            donate_argnums=tuple(range(len(args))),
         )
         _sharded_kernels[key] = step
     placed = [

@@ -1,0 +1,76 @@
+"""Shared RPC observation + invariants for testnet runners.
+
+Both the in-process Testnet (runner.py) and the subprocess-per-node
+ProcessTestnet (process_runner.py) observe their nets identically:
+cached HTTP clients, height polling, and the app-hash-agreement
+invariant (test/e2e/tests/app_test.go TestApp_Hash). One mixin so a
+fix to the polling/invariant logic can't silently miss one runner.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from cometbft_tpu.rpc.client import HTTPClient
+
+
+class NetObserver:
+    """Mixin; the host class provides `rpc_ports` and `live_indexes()`."""
+
+    rpc_ports: List[int]
+    _clients: Dict[int, HTTPClient]
+    _client_timeout: Optional[int] = None  # None = HTTPClient default
+
+    def live_indexes(self) -> List[int]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def client(self, i: int) -> HTTPClient:
+        c = self._clients.get(i)
+        if c is None:
+            addr = f"127.0.0.1:{self.rpc_ports[i]}"
+            if self._client_timeout is None:
+                c = HTTPClient(addr)
+            else:
+                c = HTTPClient(addr, timeout=self._client_timeout)
+            self._clients[i] = c
+        return c
+
+    def height(self, i: int) -> int:
+        try:
+            st = self.client(i).status()
+            return int(st["sync_info"]["latest_block_height"])
+        except Exception:
+            return 0
+
+    def wait_for_height(
+        self,
+        target: int,
+        timeout: float = 120.0,
+        nodes: Optional[List[int]] = None,
+    ) -> None:
+        """wait.go: block until every (live) node reaches `target`."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            idxs = nodes if nodes is not None else self.live_indexes()
+            if idxs and all(self.height(i) >= target for i in idxs):
+                return
+            time.sleep(0.25)
+        idxs = nodes if nodes is not None else self.live_indexes()
+        heights = {i: self.height(i) for i in idxs}
+        raise AssertionError(
+            f"height {target} not reached before timeout: {heights}"
+        )
+
+    def check_app_hashes_agree(self, height: int) -> None:
+        """All live nodes report the same block (and thus app hash) at
+        `height` (app_test.go TestApp_Hash)."""
+        seen = {}
+        for i in self.live_indexes():
+            blk = self.client(i).block(height)
+            seen[i] = (
+                blk["block_id"]["hash"],
+                blk["block"]["header"]["app_hash"],
+            )
+        values = set(seen.values())
+        assert len(values) == 1, f"nodes disagree at height {height}: {seen}"

@@ -25,7 +25,10 @@ from cometbft_tpu.rpc.client import HTTPClient
 from cometbft_tpu.libs.net import free_ports as _free_ports
 
 
-class Testnet:
+from cometbft_tpu.e2e.observe import NetObserver
+
+
+class Testnet(NetObserver):
     """Boot N validators wired over real TCP, drive them, tear down."""
 
     __test__ = False  # not a pytest class despite the name
@@ -103,6 +106,61 @@ class Testnet:
     def _home(self, i: int) -> str:
         return os.path.join(self.base_dir, f"node{i}")
 
+    def add_node(self, statesync: bool = False) -> int:
+        """Join a NEW full node (non-validator) to the live net — the
+        reference's mid-run joiners (test/e2e/networks/ci.toml nodes
+        with start_at > 0 and state_sync=true; generator at
+        test/e2e/generator/generate.go). With statesync=True the node
+        bootstraps from an app snapshot behind a light-client-verified
+        state, then hands off to blocksync/consensus."""
+        import shutil as _shutil
+
+        from cometbft_tpu.p2p.key import NodeKey
+
+        i = self.n + len([k for k in self.nodes if k >= self.n])
+        home = self._home(i)
+        cli_main(["--home", home, "init"])
+        # same chain: share genesis from node 0
+        _shutil.copyfile(
+            os.path.join(self._home(0), "config", "genesis.json"),
+            os.path.join(home, "config", "genesis.json"),
+        )
+        p2p_port, rpc_port = _free_ports(2)
+        self.p2p_ports.append(p2p_port)
+        self.rpc_ports.append(rpc_port)
+        cfg = _load_config(home)
+        cfg.base.proxy_app = self.proxy_app
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+        peer_ids = [
+            NodeKey.load_or_gen(
+                os.path.join(
+                    self._home(j), _load_config(self._home(j)).base.node_key_file
+                )
+            ).id()
+            for j in range(self.n)
+        ]
+        cfg.p2p.persistent_peers = ",".join(
+            f"{peer_ids[j]}@127.0.0.1:{self.p2p_ports[j]}"
+            for j in range(self.n)
+        )
+        cfg.p2p.addr_book_strict = False
+        cfg.consensus.timeout_commit_ns = self.timeout_commit_ns
+        if statesync:
+            # trust anchor: a recent header from a live node (the
+            # operator flow — `curl :26657/block` → trust_height/hash)
+            blk = self.client(0).block()
+            cfg.statesync.enable = True
+            cfg.statesync.rpc_servers = [
+                f"http://127.0.0.1:{self.rpc_ports[j]}" for j in (0, 1)
+            ]
+            cfg.statesync.trust_height = int(blk["block"]["header"]["height"])
+            cfg.statesync.trust_hash = blk["block_id"]["hash"]
+            cfg.statesync.discovery_time_ns = 1_000_000_000
+        self._configs.append(cfg)
+        self.start_node(i)
+        return i
+
     # -- lifecycle -------------------------------------------------------------
 
     def start(self) -> None:
@@ -144,55 +202,11 @@ class Testnet:
         if self._own_dir:
             shutil.rmtree(self.base_dir, ignore_errors=True)
 
-    # -- RPC access ------------------------------------------------------------
-
-    def client(self, i: int) -> HTTPClient:
-        c = self._clients.get(i)
-        if c is None:
-            c = HTTPClient(f"127.0.0.1:{self.rpc_ports[i]}")
-            self._clients[i] = c
-        return c
+    # -- RPC access / invariants: NetObserver (shared with the
+    # process-isolated runner) -------------------------------------------------
 
     def live_indexes(self) -> List[int]:
         return [i for i, n in self.nodes.items() if n is not None]
-
-    def height(self, i: int) -> int:
-        try:
-            st = self.client(i).status()
-            return int(st["sync_info"]["latest_block_height"])
-        except Exception:
-            return 0
-
-    def wait_for_height(
-        self, target: int, timeout: float = 120.0, nodes: Optional[List[int]] = None
-    ) -> None:
-        """wait.go: block until every (live) node reaches `target`."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            idxs = nodes if nodes is not None else self.live_indexes()
-            if idxs and all(self.height(i) >= target for i in idxs):
-                return
-            time.sleep(0.25)
-        idxs = nodes if nodes is not None else self.live_indexes()
-        heights = {i: self.height(i) for i in idxs}
-        raise AssertionError(
-            f"height {target} not reached before timeout: {heights}"
-        )
-
-    # -- invariants (test/e2e/tests/*_test.go) ---------------------------------
-
-    def check_app_hashes_agree(self, height: int) -> None:
-        """All live nodes report the same block (and thus app hash) at
-        `height` (app_test.go TestApp_Hash)."""
-        seen = {}
-        for i in self.live_indexes():
-            blk = self.client(i).block(height)
-            seen[i] = (
-                blk["block_id"]["hash"],
-                blk["block"]["header"]["app_hash"],
-            )
-        values = set(seen.values())
-        assert len(values) == 1, f"nodes disagree at height {height}: {seen}"
 
     def check_blocks_well_formed(self, upto: int) -> None:
         """Headers chain correctly (block_test.go TestBlock_Header)."""

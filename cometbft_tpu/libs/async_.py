@@ -1,0 +1,53 @@
+"""Parallel task runner — reference: libs/async/async.go.
+
+The reference's Parallel runs N tasks in goroutines and collects a
+TaskResultSet, recording per-task values, errors, and panics; callers
+use it where both halves of a network exchange must run concurrently
+(p2p/conn/secret_connection.go shareEphPubKey / shareAuthSignature —
+each side must write AND read, or two synchronous peers deadlock).
+
+Python version: threads (the tasks are IO-bound socket ops), exceptions
+captured per task, never raised across the boundary.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Tuple
+
+
+@dataclass
+class TaskResult:
+    value: Any = None
+    error: Optional[BaseException] = None
+
+
+def parallel(*tasks: Callable[[], Any]) -> Tuple[List[TaskResult], bool]:
+    """Run every task concurrently; wait for all. Returns (results in
+    task order, all_ok). A task's exception lands in its TaskResult —
+    nothing propagates, mirroring the reference's panic capture."""
+    results = [TaskResult() for _ in tasks]
+
+    def run(i: int, task: Callable[[], Any]) -> None:
+        try:
+            results[i].value = task()
+        except BaseException as exc:  # noqa: BLE001 - captured, not handled
+            results[i].error = exc
+
+    threads = [
+        threading.Thread(target=run, args=(i, t), daemon=True)
+        for i, t in enumerate(tasks)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, all(r.error is None for r in results)
+
+
+def first_error(results: List[TaskResult]) -> Optional[BaseException]:
+    for r in results:
+        if r.error is not None:
+            return r.error
+    return None

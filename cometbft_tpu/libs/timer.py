@@ -1,0 +1,57 @@
+"""Throttle timer — reference: libs/timer/throttle_timer.go.
+
+Fires a callback at most once per `interval` no matter how often Set()
+is called; Unset() cancels a pending fire. The reference drives
+MConnection's flush throttle with this shape.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class ThrottleTimer:
+    def __init__(self, name: str, interval_s: float, callback: Callable[[], None]):
+        self.name = name
+        self.interval_s = interval_s
+        self._callback = callback
+        self._mtx = threading.Lock()
+        self._timer: threading.Timer | None = None
+        self._last_fire = 0.0
+        self._stopped = False
+
+    def set(self) -> None:
+        """Request a fire: immediately if the interval has elapsed since
+        the last one, else coalesced into one pending fire at the
+        interval boundary."""
+        with self._mtx:
+            if self._stopped or self._timer is not None:
+                return
+            wait = self._last_fire + self.interval_s - time.monotonic()
+            t = threading.Timer(max(wait, 0.0), self._fire)
+            t.daemon = True
+            self._timer = t
+            t.start()
+
+    def unset(self) -> None:
+        with self._mtx:
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+
+    def _fire(self) -> None:
+        with self._mtx:
+            if self._stopped:
+                return
+            self._timer = None
+            self._last_fire = time.monotonic()
+        self._callback()
+
+    def stop(self) -> None:
+        with self._mtx:
+            self._stopped = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None

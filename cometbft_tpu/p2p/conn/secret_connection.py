@@ -127,11 +127,24 @@ class SecretConnection:
         eph_priv = X25519PrivateKey.generate()
         loc_eph_pub = eph_priv.public_key().public_bytes_raw()
 
-        # exchange ephemeral pubkeys as delimited BytesValue (field 1)
-        sock.sendall(
-            protoio.marshal_delimited(protoio.field_bytes(1, loc_eph_pub))
+        # exchange ephemeral pubkeys as delimited BytesValue (field 1);
+        # send and receive run CONCURRENTLY (secret_connection.go
+        # shareEphPubKey over libs/async.Parallel): two synchronous
+        # peers that both write-then-read would deadlock if either
+        # side's write blocked
+        from cometbft_tpu.libs.async_ import first_error, parallel
+
+        results, ok = parallel(
+            lambda: sock.sendall(
+                protoio.marshal_delimited(protoio.field_bytes(1, loc_eph_pub))
+            ),
+            lambda: _read_delimited_from_sock(sock, 1024 * 1024),
         )
-        msg = _read_delimited_from_sock(sock, 1024 * 1024)
+        if not ok:
+            raise HandshakeError(
+                f"ephemeral key exchange failed: {first_error(results)}"
+            )
+        msg = results[1].value
         r = protoio.WireReader(msg)
         rem_eph_pub = b""
         while not r.at_end():
@@ -176,9 +189,16 @@ class SecretConnection:
         auth = protoio.field_message(
             1, pub_key_to_proto(loc_priv_key.pub_key()).encode()
         ) + protoio.field_bytes(2, loc_sig)
-        sc.write(protoio.marshal_delimited(auth))
-
-        rem_auth = sc._read_delimited(1024 * 1024)
+        # shareAuthSignature: same concurrent write/read rule as above
+        results, ok = parallel(
+            lambda: sc.write(protoio.marshal_delimited(auth)),
+            lambda: sc._read_delimited(1024 * 1024),
+        )
+        if not ok:
+            raise HandshakeError(
+                f"auth signature exchange failed: {first_error(results)}"
+            )
+        rem_auth = results[1].value
         rr = protoio.WireReader(rem_auth)
         rem_pub = None
         rem_sig = b""

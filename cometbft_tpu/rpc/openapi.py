@@ -1,0 +1,144 @@
+"""OpenAPI description of the RPC surface.
+
+Reference: rpc/openapi/openapi.yaml (a hand-maintained 3k-line YAML
+served to dredd and docs tooling). Here the spec is GENERATED from the
+live route table (`rpc.server._ROUTES`), so it can never drift from the
+implementation — `python -m cometbft_tpu.rpc.openapi` prints it, and
+the committed `openapi.yaml` is refreshed by the same command.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+_TYPE_MAP = {
+    int: ("integer", None),
+    str: ("string", None),
+    bool: ("boolean", None),
+    "b64bytes": ("string", "byte"),
+    "hexbytes": ("string", "hex"),
+    "strlist": ("array", None),
+}
+
+_SUMMARIES: Dict[str, str] = {
+    "health": "Node heartbeat — empty result when up",
+    "status": "Node status: sync info, validator info, node info",
+    "net_info": "Network info: listeners, peer list",
+    "genesis": "Full genesis document",
+    "genesis_chunked": "Genesis served in base64 chunks",
+    "blockchain": "Block metas for a height range (newest first)",
+    "block": "Block at height (latest when omitted)",
+    "block_by_hash": "Block by hash",
+    "commit": "Commit (signatures) at height",
+    "validators": "Validator set at height, paginated",
+    "consensus_params": "Consensus parameters at height",
+    "consensus_state": "Compact live consensus round state",
+    "dump_consensus_state": "Full live consensus state incl. peers",
+    "abci_info": "ABCI application info",
+    "abci_query": "Query the application, optionally with proof",
+    "unconfirmed_txs": "Mempool transactions, bounded by limit",
+    "num_unconfirmed_txs": "Mempool size counters",
+    "broadcast_tx_async": "Submit tx, return immediately",
+    "broadcast_tx_sync": "Submit tx, wait for CheckTx",
+    "broadcast_tx_commit": "Submit tx, wait for a commit (dev only)",
+    "tx": "Committed transaction by hash, optional inclusion proof",
+    "tx_search": "Search committed txs by event query",
+    "block_search": "Search blocks by event query",
+    "block_results": "ABCI results (DeliverTx/Begin/EndBlock) at height",
+    "check_tx": "Run CheckTx without adding to the mempool",
+    "broadcast_evidence": "Submit committed-misbehavior evidence",
+    "dial_seeds": "UNSAFE: dial the given seed nodes",
+    "dial_peers": "UNSAFE: dial the given peers",
+    "unsafe_flush_mempool": "UNSAFE: clear the mempool",
+}
+
+
+def spec() -> dict:
+    from cometbft_tpu.rpc.server import _ROUTES
+
+    paths = {}
+    for method, (_handler, params) in sorted(_ROUTES.items()):
+        parameters = []
+        for wire_name, (_py_name, kind) in params.items():
+            typ, fmt = _TYPE_MAP.get(kind, ("string", None))
+            schema = {"type": typ}
+            if fmt:
+                schema["format"] = fmt
+            if typ == "array":
+                schema["items"] = {"type": "string"}
+            parameters.append(
+                {
+                    "name": wire_name,
+                    "in": "query",
+                    "required": False,
+                    "schema": schema,
+                }
+            )
+        op = {
+            "operationId": method,
+            "summary": _SUMMARIES.get(method, method),
+            "tags": ["unsafe"] if "unsafe" in _handler else ["info"],
+            "responses": {
+                "200": {"description": "JSON-RPC response envelope"}
+            },
+        }
+        if parameters:
+            op["parameters"] = parameters
+        paths[f"/{method}"] = {"get": op}
+    return {
+        "openapi": "3.0.0",
+        "info": {
+            "title": "cometbft_tpu RPC",
+            "version": "v0.34-compat",
+            "description": (
+                "JSON-RPC 2.0 over HTTP GET/POST and WebSocket; every "
+                "method is also callable as a URI route (reference: "
+                "rpc/openapi/openapi.yaml)."
+            ),
+        },
+        "paths": paths,
+    }
+
+
+def to_yaml() -> str:
+    """Minimal YAML emitter (no external deps) — the spec is plain
+    dicts/lists/scalars."""
+
+    def emit(obj, indent=0):
+        pad = "  " * indent
+        out = []
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                if isinstance(v, (dict, list)) and v:
+                    out.append(f"{pad}{k}:")
+                    out.extend(emit(v, indent + 1))
+                else:
+                    out.append(f"{pad}{k}: {_scalar(v)}")
+        elif isinstance(obj, list):
+            for item in obj:
+                if isinstance(item, (dict, list)) and item:
+                    lines = emit(item, indent + 1)
+                    first = lines[0].lstrip()
+                    out.append(f"{pad}- {first}")
+                    out.extend(lines[1:])
+                else:
+                    out.append(f"{pad}- {_scalar(item)}")
+        return out
+
+    def _scalar(v):
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if v is None or v == {} or v == []:
+            return "{}" if isinstance(v, dict) else "null"
+        if isinstance(v, (int, float)):
+            return str(v)
+        s = str(v)
+        if any(c in s for c in ":#{}[]") or s != s.strip():
+            return '"' + s.replace('"', '\\"') + '"'
+        return s
+
+    return "\n".join(emit(spec())) + "\n"
+
+
+if __name__ == "__main__":
+    print(to_yaml(), end="")

@@ -280,3 +280,78 @@ class TestDB:
         db2 = SQLiteDB(path)
         assert db2.get(b"k") == b"v"
         db2.close()
+
+
+class TestAsyncParallel:
+    def test_results_in_order_and_concurrent(self):
+        import threading
+        import time as _time
+
+        from cometbft_tpu.libs.async_ import first_error, parallel
+
+        barrier = threading.Barrier(2, timeout=5)
+
+        def a():
+            barrier.wait()  # deadlocks unless b runs CONCURRENTLY
+            return "a"
+
+        def b():
+            barrier.wait()
+            return "b"
+
+        t0 = _time.monotonic()
+        results, ok = parallel(a, b)
+        assert ok
+        assert [r.value for r in results] == ["a", "b"]
+        assert first_error(results) is None
+        assert _time.monotonic() - t0 < 5
+
+    def test_exception_captured_not_raised(self):
+        from cometbft_tpu.libs.async_ import first_error, parallel
+
+        def boom():
+            raise RuntimeError("x")
+
+        results, ok = parallel(lambda: 1, boom)
+        assert not ok
+        assert results[0].value == 1
+        assert isinstance(results[1].error, RuntimeError)
+        assert isinstance(first_error(results), RuntimeError)
+
+
+class TestThrottleTimer:
+    def test_coalesces_and_throttles(self):
+        import time as _time
+
+        from cometbft_tpu.libs.timer import ThrottleTimer
+
+        fires = []
+        t = ThrottleTimer("t", 0.15, lambda: fires.append(_time.monotonic()))
+        try:
+            for _ in range(20):
+                t.set()  # storm of sets → coalesced
+            _time.sleep(0.1)
+            assert len(fires) == 1  # first fire is immediate
+            for _ in range(20):
+                t.set()
+            _time.sleep(0.3)
+            assert len(fires) == 2  # second waits out the interval
+        finally:
+            t.stop()
+
+    def test_unset_cancels(self):
+        import time as _time
+
+        from cometbft_tpu.libs.timer import ThrottleTimer
+
+        fires = []
+        t = ThrottleTimer("t", 10.0, lambda: fires.append(1))
+        try:
+            t.set()          # fires immediately (no prior fire)
+            _time.sleep(0.1)
+            t.set()          # pending for +10s
+            t.unset()        # cancelled
+            _time.sleep(0.2)
+            assert len(fires) == 1
+        finally:
+            t.stop()

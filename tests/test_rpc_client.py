@@ -89,3 +89,25 @@ class TestHTTPClientAgainstLiveNode:
                 assert params.block.max_bytes > 0
             finally:
                 node.stop()
+
+
+class TestOpenAPISpec:
+    def test_spec_covers_every_route(self):
+        from cometbft_tpu.rpc.openapi import spec, to_yaml
+        from cometbft_tpu.rpc.server import _ROUTES
+
+        doc = spec()
+        assert set(doc["paths"]) == {f"/{m}" for m in _ROUTES}
+        for path, item in doc["paths"].items():
+            op = item["get"]
+            assert op["summary"], path
+            assert "200" in op["responses"]
+        # the committed YAML is the generator's output (no drift)
+        import os
+
+        committed = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "..", "cometbft_tpu", "rpc", "openapi.yaml",
+        )
+        with open(committed) as f:
+            assert f.read() == to_yaml()
