@@ -37,7 +37,10 @@ import numpy as np
 
 BATCH = 4096
 SWEEP = (1024, 4096, 8192, 16384)
-_STAGE_ENV_TPU = {}  # inherit ambient (axon) platform
+# inherit ambient (axon) platform; stage 1 (devices) already proves the
+# tunnel answers, so the batch boundary's own subprocess probe is
+# redundant inside later stages and would pollute p50 timings
+_STAGE_ENV_TPU = {"CBFT_TPU_PROBE": "0"}
 _STAGE_ENV_CPU = {
     "JAX_PLATFORMS": "cpu",
     "BENCH_FORCE_CPU": "1",

@@ -76,6 +76,70 @@ class CPUBatchVerifier(BatchVerifier):
         return all(final), final
 
 
+# --- device-plane liveness probe -------------------------------------------
+# The TPU tunnel can wedge for hours (observed rounds 3 and 4), and ANY
+# in-process jax device touch then hangs with no timeout — on the
+# consensus thread, that is a liveness failure of the node. Every
+# device-eligible dispatch is therefore gated on a ONE-TIME probe that
+# enumerates devices in a bounded SUBPROCESS: healthy → device routing;
+# wedged/timeout → the batch plane permanently (per-process) routes to
+# the CPU fallback. start_device_probe() is called at node start so the
+# verdict is usually in before the first commit.
+
+_probe_lock = threading.Lock()
+_probe_done = threading.Event()
+_probe_ok: Optional[bool] = None
+
+
+def start_device_probe() -> None:
+    """Kick the bounded device probe (idempotent, non-blocking)."""
+    global _probe_ok
+    if os.environ.get("CBFT_TPU_PROBE", "1") == "0":
+        return  # operator override: no probe subprocess at all
+    with _probe_lock:
+        if _probe_done.is_set() or getattr(start_device_probe, "_started", False):
+            return
+        start_device_probe._started = True
+
+    def run():
+        global _probe_ok
+        import subprocess
+        import sys
+
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; assert jax.devices()"],
+                timeout=int(os.environ.get("CBFT_TPU_PROBE_TIMEOUT", "120")),
+                capture_output=True,
+            )
+            _probe_ok = proc.returncode == 0
+        except Exception:  # noqa: BLE001 - incl. TimeoutExpired
+            _probe_ok = False
+        _probe_done.set()
+
+    threading.Thread(target=run, daemon=True, name="tpu-probe").start()
+
+
+def device_plane_ok(wait: bool = True) -> bool:
+    """True when the device plane answered the bounded probe. With
+    wait=True, blocks until the probe resolves (itself bounded by
+    CBFT_TPU_PROBE_TIMEOUT + slack), so the worst case under a wedged
+    tunnel is ONE bounded stall, after which everything is CPU-routed."""
+    global _probe_ok
+    if os.environ.get("CBFT_TPU_PROBE", "1") == "0":
+        return True  # operator override: trust the platform
+    start_device_probe()
+    if wait and not _probe_done.wait(
+        int(os.environ.get("CBFT_TPU_PROBE_TIMEOUT", "120")) + 30
+    ):
+        # the probe thread itself is stuck (a child in uninterruptible
+        # kernel wait can survive subprocess.run's kill): latch DOWN so
+        # the one-bounded-stall guarantee holds for every later caller
+        _probe_ok = False
+        _probe_done.set()
+    return bool(_probe_ok)
+
+
 class TPUBatchVerifier(BatchVerifier):
     """Partitions the batch by curve (SURVEY.md §7 stage 10): ed25519,
     secp256k1, and sr25519 entries each go to their own batch kernel;
@@ -90,12 +154,15 @@ class TPUBatchVerifier(BatchVerifier):
         slow_curve_min_batch: Optional[int] = None,
     ):
         # fail fast if a kernel module is unavailable rather than erroring
-        # mid-verify after add() calls succeeded
+        # mid-verify after add() calls succeeded (imports are host-only:
+        # no backend init — see field.const_fe)
         from cometbft_tpu.crypto.tpu import (  # noqa: F401
             ed25519_batch,
             secp256k1_batch,
             sr25519_batch,
         )
+
+        start_device_probe()  # resolve the device-plane verdict early
 
         self._items: List[Tuple[PubKey, bytes, bytes]] = []
         # Below min_batch the device dispatch + host packing dominates and
@@ -155,7 +222,7 @@ class TPUBatchVerifier(BatchVerifier):
                 if curve == ed.KEY_TYPE
                 else self._slow_curve_min_batch
             )
-            if len(idxs) < threshold:
+            if len(idxs) < threshold or not device_plane_ok():
                 if curve == ed.KEY_TYPE:
                     sub_mask = ed.verify_many([items[i] for i in idxs])
                     for j, i in enumerate(idxs):

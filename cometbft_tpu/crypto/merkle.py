@@ -65,9 +65,12 @@ def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     """Reference: crypto/merkle/tree.go:9 HashFromByteSlices."""
     n = len(items)
     if _parallel_enabled:
+        from cometbft_tpu.crypto import batch as cryptobatch
         from cometbft_tpu.crypto.tpu import merkle as tpu_merkle
 
-        if n >= tpu_merkle.MIN_DEVICE_LEAVES:
+        # same bounded-probe gate as the batch verifier: a wedged TPU
+        # tunnel must degrade to the host tree, not hang the caller
+        if n >= tpu_merkle.MIN_DEVICE_LEAVES and cryptobatch.device_plane_ok():
             return tpu_merkle.hash_from_byte_slices(items)
     if n == 0:
         return empty_hash()

@@ -37,6 +37,7 @@ from __future__ import annotations
 from typing import List
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 P = 2**255 - 19
@@ -64,17 +65,23 @@ def limbs_to_int(limbs) -> int:
     return total
 
 
-def const_fe(n: int) -> jnp.ndarray:
+def const_fe(n: int) -> np.ndarray:
     """A field-element constant: int32[17, 1] — broadcasts against the
-    trailing batch axis of any [17, B] element."""
-    return jnp.array(int_to_limbs(n % P), jnp.int32)[:, None]
+    trailing batch axis of any [17, B] element. Returned as a HOST
+    (numpy) array: jax lifts it to a device constant at trace time, and
+    building it must not initialize a backend — kernel modules are
+    imported by TPUBatchVerifier.__init__ on the consensus thread, and a
+    wedged TPU tunnel would otherwise hang the import itself."""
+    return np.array(int_to_limbs(n % P), np.int32)[:, None]
 
 
 # 4p = 2^257 - 76 as signed radix-2^15 columns (2^257 = 2^17 · 2^(15·16)).
-_FOUR_P_COLS = (
-    jnp.zeros(NUM_LIMBS, jnp.int32).at[0].add(-76).at[16].add(0x20000)[:, None]
-)
-_P_LIMBS = jnp.array(int_to_limbs(P), jnp.int32)[:, None]
+# Host arrays (see const_fe): module import must not init a jax backend.
+_FOUR_P_COLS = np.zeros(NUM_LIMBS, np.int32)
+_FOUR_P_COLS[0] = -76
+_FOUR_P_COLS[16] = 0x20000
+_FOUR_P_COLS = _FOUR_P_COLS[:, None]
+_P_LIMBS = np.array(int_to_limbs(P), np.int32)[:, None]
 
 
 def _carry_round(x: jnp.ndarray) -> jnp.ndarray:

@@ -25,6 +25,7 @@ from __future__ import annotations
 from typing import List
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 P = 2**256 - 2**32 - 977
@@ -50,17 +51,19 @@ def limbs_to_int(limbs) -> int:
     return total
 
 
-def const_fe(n: int) -> jnp.ndarray:
-    return jnp.array(int_to_limbs(n % P), jnp.int32)[:, None]
+def const_fe(n: int) -> np.ndarray:
+    # host array: importing this module must not init a jax backend
+    # (see field.const_fe)
+    return np.array(int_to_limbs(n % P), np.int32)[:, None]
 
 
-_P_LIMBS = jnp.array(int_to_limbs(P), jnp.int32)[:, None]
+_P_LIMBS = np.array(int_to_limbs(P), np.int32)[:, None]
 
 
-def _cols_of(n: int) -> jnp.ndarray:
+def _cols_of(n: int) -> np.ndarray:
     cols = [(n >> (RADIX * i)) & _MASK for i in range(NUM_LIMBS - 1)]
     cols.append(n >> (RADIX * (NUM_LIMBS - 1)))  # top keeps the rest
-    return jnp.array(cols, jnp.int32)[:, None]
+    return np.array(cols, np.int32)[:, None]
 
 
 _FOUR_P_COLS = _cols_of(4 * P)  # top column < 2^18

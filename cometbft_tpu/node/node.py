@@ -649,45 +649,58 @@ def _warm_tpu_kernels(config: Config) -> None:
       compile. Failures are non-fatal — the batch boundary degrades to
       CPU per its routing thresholds.
 
-    The device plane is probed in a BOUNDED SUBPROCESS first: the TPU
-    tunnel can wedge for hours, and in-process jax init would then hang
-    holding jax's process-global init lock — stalling the consensus
-    thread the moment a batch crosses the routing threshold. A wedged
-    probe means no warmup is attempted (and the operator should expect
-    the CPU fallback plane)."""
+    The whole warmup runs in a BOUNDED SUBPROCESS: the TPU tunnel can
+    wedge for hours, and in-process jax init would then hang holding
+    jax's process-global init lock — stalling the consensus thread the
+    moment a batch crosses the routing threshold. The subprocess fills
+    the DISK cache; the node's own first dispatch then loads warm
+    executables. In-process jax only gets its cache-dir config set (no
+    device touch)."""
     import subprocess
     import sys
     import threading
 
+    cache_dir = os.path.join(config.root_dir, "data", "jax_cache")
+
     def warm():
         try:
-            probe = subprocess.run(
+            from cometbft_tpu.crypto import batch as _batch
+
+            # the probe (kicked below, before this thread starts) must
+            # say the tunnel answers — otherwise the warmup subprocess
+            # would hang against the wedged device for its full timeout
+            if not _batch.device_plane_ok(wait=True):
+                return
+            subprocess.run(
                 [
                     sys.executable,
                     "-c",
-                    "import jax; jax.devices()",
+                    "import jax\n"
+                    f"jax.config.update('jax_compilation_cache_dir', {cache_dir!r})\n"
+                    "jax.config.update("
+                    "'jax_persistent_cache_min_compile_time_secs', 5.0)\n"
+                    "from cometbft_tpu.crypto.tpu import ed25519_batch\n"
+                    "ed25519_batch.warmup()\n",
                 ],
-                timeout=int(os.environ.get("CBFT_TPU_PROBE_TIMEOUT", "120")),
+                timeout=int(os.environ.get("CBFT_TPU_WARMUP_TIMEOUT", "900")),
                 capture_output=True,
             )
-            if probe.returncode != 0:
-                return
-            import jax
-
-            jax.config.update(
-                "jax_compilation_cache_dir",
-                os.path.join(config.root_dir, "data", "jax_cache"),
-            )
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 5.0
-            )
-            from cometbft_tpu.crypto.tpu import ed25519_batch
-
-            ed25519_batch.warmup()
         except Exception:  # noqa: BLE001 - warming is best-effort
             pass
 
+    from cometbft_tpu.crypto import batch as cryptobatch
+
+    cryptobatch.start_device_probe()  # verdict ready before first commit
     if os.environ.get("CBFT_TPU_WARMUP", "1") != "0":
+        try:
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 5.0
+            )
+        except Exception:  # noqa: BLE001
+            pass
         threading.Thread(target=warm, daemon=True, name="tpu-warmup").start()
 
 

@@ -218,9 +218,34 @@ class TestBatchVerifier:
         expected = [pk.verify_signature(m, s) for pk, m, s in triples]
         assert mask == expected
 
+    def test_device_plane_down_routes_to_cpu(self, monkeypatch):
+        """A wedged TPU tunnel must degrade the tpu backend to CPU
+        routing (bounded probe verdict), never hang or change results."""
+        import threading
+
+        from cometbft_tpu.crypto import batch as cryptobatch
+
+        # stub the probe machinery BEFORE constructing the verifier:
+        # the real probe thread would race the forced verdict (and a
+        # successful cpu-env probe would flip it back to True mid-test)
+        monkeypatch.setattr(
+            cryptobatch, "start_device_probe", lambda: None
+        )
+        done = threading.Event()
+        done.set()
+        monkeypatch.setattr(cryptobatch, "_probe_done", done)
+        monkeypatch.setattr(cryptobatch, "_probe_ok", False)
+        bv = cryptobatch.TPUBatchVerifier(min_batch=1, slow_curve_min_batch=1)
+        for pk, m, s in self._mk(8, bad={2}):
+            bv.add(pk, m, s)
+        ok, mask = bv.verify()
+        assert not ok
+        assert [i for i, v in enumerate(mask) if not v] == [2]
+
 
 class TestHashers:
     def test_tmhash(self):
         assert tmhash.sum(b"x") == hashlib.sha256(b"x").digest()
         assert tmhash.sum_truncated(b"x") == hashlib.sha256(b"x").digest()[:20]
         assert sha256(b"") == hashlib.sha256(b"").digest()
+

@@ -79,3 +79,57 @@ class TestProcessPerturbations:
         h1 = _net_height(net, [0, 2, 3])
         net.wait_for_height(h1, timeout=120, nodes=[victim])
         net.check_app_hashes_agree(h0 + 1)
+
+
+class TestRelay:
+    """The partition primitive itself: a cut must sever LIVE pipes (the
+    shutdown-before-close rule — a bare close leaves recv()-blocked
+    pipe threads holding the kernel socket, and peers never see FIN)."""
+
+    def test_cut_severs_and_heal_restores(self):
+        import socket
+        import threading
+
+        from cometbft_tpu.e2e.process_runner import _Relay
+        from cometbft_tpu.libs.net import free_ports
+
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+
+        def echo():
+            while True:
+                try:
+                    c, _ = srv.accept()
+                except OSError:
+                    return
+
+                def pump(c=c):
+                    try:
+                        while True:
+                            d = c.recv(4096)
+                            if not d:
+                                break
+                            c.sendall(d)
+                    except OSError:
+                        pass
+
+                threading.Thread(target=pump, daemon=True).start()
+
+        threading.Thread(target=echo, daemon=True).start()
+        r = _Relay(free_ports(1)[0], srv.getsockname()[1])
+        try:
+            c = socket.create_connection(("127.0.0.1", r.listen_port))
+            c.sendall(b"ping")
+            assert c.recv(4) == b"ping"
+            r.set_enabled(False)
+            c.settimeout(3)
+            assert c.recv(4) == b"", "cut did not sever the live pipe"
+            r.set_enabled(True)
+            c2 = socket.create_connection(("127.0.0.1", r.listen_port))
+            c2.sendall(b"heal")
+            assert c2.recv(4) == b"heal"
+        finally:
+            r.stop()
+            srv.close()
