@@ -35,6 +35,12 @@ MISBEHAVIOR_TYPES = {
     "double-precommit": SIGNED_MSG_TYPE_PRECOMMIT,
 }
 
+# proposer-side equivocation (consensus/byzantine_test.go: the byzantine
+# proposer sends DIFFERENT proposals to different peers; v0.34 has no
+# proposal-equivocation evidence, so the assertion is LIVENESS — the
+# first valid proposal wins per peer and the chain keeps committing)
+PROPOSER_MISBEHAVIORS = {"double-proposal"}
+
 
 def install(node, schedule: Dict[int, str]) -> None:
     """Arm a node with a per-height misbehavior schedule.
@@ -44,10 +50,10 @@ def install(node, schedule: Dict[int, str]) -> None:
     once. Unknown misbehavior names raise at install time, like the
     reference's maverick flag parsing."""
     for name in schedule.values():
-        if name not in MISBEHAVIOR_TYPES:
+        if name not in MISBEHAVIOR_TYPES and name not in PROPOSER_MISBEHAVIORS:
             raise ValueError(
                 f"unknown misbehavior {name!r}; choose from "
-                f"{sorted(MISBEHAVIOR_TYPES)}"
+                f"{sorted(MISBEHAVIOR_TYPES) + sorted(PROPOSER_MISBEHAVIORS)}"
             )
 
     from cometbft_tpu.consensus.messages import (
@@ -113,3 +119,73 @@ def install(node, schedule: Dict[int, str]) -> None:
         return genuine_sign(msg_type, hash_, header)
 
     cons._sign_add_vote = misbehaving_sign
+
+    from cometbft_tpu.consensus.messages import (
+        BlockPartMessage,
+        ProposalMessage,
+    )
+    from cometbft_tpu.consensus.reactor import DATA_CHANNEL
+    from cometbft_tpu.types.proposal import Proposal
+
+    genuine_decide = cons._decide_proposal
+    node.maverick_fired = fired  # observability for tests/operators
+
+    def misbehaving_decide(height, round_):
+        genuine_decide(height, round_)
+        rs = cons.rs
+        if (
+            schedule.get(height) != "double-proposal"
+            or (height, "prop") in fired
+            or cons.priv_validator_pub_key is None
+        ):
+            return
+        # Build the SECOND block independently: the genuine one only
+        # exists in _decide_proposal's locals (rs.proposal_block is not
+        # assigned until the internal queue delivers the parts back to
+        # the receive thread — state.py:969), so replay the same
+        # construction and flip the header-time nanosecond → a distinct
+        # hash and part set for the same (height, round).
+        from cometbft_tpu.types.block import Commit as _Commit
+
+        if height == (cons.state.initial_height if cons.state else 1):
+            commit = _Commit(0, 0, BlockID(), [])
+        elif (
+            rs.last_commit is not None
+            and rs.last_commit.has_two_thirds_majority()
+        ):
+            commit = rs.last_commit.make_commit()
+        else:
+            return
+        fired.add((height, "prop"))
+        alt, _ = cons.block_exec.create_proposal_block(
+            height, cons.state, commit,
+            cons.priv_validator_pub_key.address(),
+        )
+        alt.header.time = Timestamp(
+            alt.header.time.seconds, alt.header.time.nanos ^ 1
+        )
+        alt_parts = alt.make_part_set(65536)
+        alt_bid = BlockID(alt.hash(), alt_parts.header())
+        prop = Proposal(
+            height=height,
+            round=round_,
+            pol_round=rs.valid_round,
+            block_id=alt_bid,
+            timestamp=Timestamp.now(),
+        )
+        if hasattr(pv, "priv_key"):
+            prop.signature = pv.priv_key.sign(prop.sign_bytes(chain_id))
+        else:
+            pv.sign_proposal(chain_id, prop)
+        node.switch.broadcast(
+            DATA_CHANNEL, encode_consensus_message(ProposalMessage(prop))
+        )
+        for i in range(alt_parts.total()):
+            node.switch.broadcast(
+                DATA_CHANNEL,
+                encode_consensus_message(
+                    BlockPartMessage(height, round_, alt_parts.get_part(i))
+                ),
+            )
+
+    cons._decide_proposal = misbehaving_decide

@@ -671,6 +671,15 @@ def _warm_tpu_kernels(config: Config) -> None:
             # would hang against the wedged device for its full timeout
             if not _batch.device_plane_ok(wait=True):
                 return
+            # in-process cache config for the pre-imported-jax case
+            # (sitecustomize may import jax before the env vars above
+            # are set); off the start path, so the import cost is free
+            import jax
+
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 5.0
+            )
             subprocess.run(
                 [
                     sys.executable,
@@ -691,16 +700,12 @@ def _warm_tpu_kernels(config: Config) -> None:
     from cometbft_tpu.crypto import batch as cryptobatch
 
     cryptobatch.start_device_probe()  # verdict ready before first commit
+    # cache config via env (read by jax at import) — and, in the warm
+    # thread below, via config.update for the pre-imported-jax case.
+    # Importing jax HERE would add seconds of blocking start-up work.
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
     if os.environ.get("CBFT_TPU_WARMUP", "1") != "0":
-        try:
-            import jax
-
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-            jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 5.0
-            )
-        except Exception:  # noqa: BLE001
-            pass
         threading.Thread(target=warm, daemon=True, name="tpu-warmup").start()
 
 

@@ -626,3 +626,55 @@ class TestPOLLocking:
         self._prevote(cs, privs, (1, 2, 3), 1, BlockID())
         assert cs.rs.locked_block is not None, "early-round polka must not unlock"
         assert cs.rs.locked_round == 2
+
+
+class TestInvalidBlockParts:
+    """Reference: consensus/invalid_test.go — a byzantine peer floods
+    corrupted block parts; honest nodes must reject them (merkle proof
+    check in PartSet.AddPart) and keep committing."""
+
+    def test_corrupt_parts_rejected_and_chain_advances(self):
+        from cometbft_tpu.types.part_set import PartSet
+
+        nodes = _make_network(4)
+        for cs in nodes:
+            cs.start()
+        try:
+            assert _wait_for_height(nodes, 1, timeout=60)
+            evil = PartSet.from_data(b"not the real block" * 100)
+            # keep spraying until corrupt parts were PROVABLY delivered
+            # at nodes that had a live proposal part set (a vacuous run
+            # — every node mid-gap with no part set — must not pass)
+            delivered = 0
+            deadline = time.monotonic() + 30
+            while delivered < 8 and time.monotonic() < deadline:
+                for cs in nodes:
+                    rs = cs.rs
+                    if rs.proposal_block_parts is None:
+                        continue
+                    for i in range(evil.total()):
+                        part = evil.get_part(i)
+                        part.index = min(
+                            i, rs.proposal_block_parts.total() - 1
+                        )
+                        cs.send_peer_message(
+                            BlockPartMessage(rs.height, rs.round, part),
+                            "evil-peer",
+                        )
+                        delivered += 1
+                time.sleep(0.05)
+            assert delivered >= 8, "no corrupt parts ever delivered"
+            # the merkle-proof check must discard every corrupt part and
+            # consensus keeps committing
+            target = max(cs.height() for cs in nodes) + 2
+            assert _wait_for_height(nodes, target, timeout=90), [
+                cs.height() for cs in nodes
+            ]
+            hashes = {
+                cs.block_store.load_block_meta(target).block_id.hash
+                for cs in nodes
+            }
+            assert len(hashes) == 1
+        finally:
+            for cs in nodes:
+                cs.stop()

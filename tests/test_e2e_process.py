@@ -133,3 +133,70 @@ class TestRelay:
         finally:
             r.stop()
             srv.close()
+
+
+class TestSocketABCI:
+    """The e2e matrix's 'builtin vs socket ABCI' axis (ci.toml
+    `abci_protocol`): a validator whose app runs OUT of process behind
+    the ABCI socket server (`abci kvstore` = abci-cli kvstore), txs
+    committed through the pipelined SocketClient."""
+
+    def test_single_validator_over_socket_app(self):
+        import base64
+        import os
+        import subprocess
+        import sys
+        import tempfile
+
+        from cometbft_tpu.cmd.commands import main as cli_main, _load_config
+        from cometbft_tpu.config import write_config_file
+        from cometbft_tpu.libs.net import free_ports
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        d = tempfile.mkdtemp(prefix="abci-sock-")
+        cli_main(["--home", d, "init", "--chain-id", "sock-chain"])
+        abci_port, rpc_port, p2p_port = free_ports(3)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        app = subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu", "abci", "kvstore",
+             "--address", f"tcp://127.0.0.1:{abci_port}"],
+            cwd=repo, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        node = None
+        try:
+            cfg = _load_config(d)
+            cfg.base.proxy_app = f"tcp://127.0.0.1:{abci_port}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            cfg.consensus.timeout_commit_ns = 200_000_000
+            write_config_file(os.path.join(d, "config", "config.toml"), cfg)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["CMT_CRYPTO_BACKEND"] = "cpu"
+            node = subprocess.Popen(
+                [sys.executable, "-m", "cometbft_tpu", "--home", d, "start"],
+                cwd=repo, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            c = HTTPClient(f"127.0.0.1:{rpc_port}", timeout=5)
+            deadline = time.monotonic() + 60
+            h = 0
+            while time.monotonic() < deadline and h < 2:
+                try:
+                    h = int(c.status()["sync_info"]["latest_block_height"])
+                except Exception:
+                    pass
+                time.sleep(0.3)
+            assert h >= 2, "chain did not advance over the socket app"
+            res = c.broadcast_tx_commit(b"sock=works")
+            assert (res.get("deliver_tx") or {}).get("code", 1) == 0, res
+            q = c.abci_query("/store", b"sock")
+            assert base64.b64decode(
+                (q["response"] or {}).get("value") or ""
+            ) == b"works"
+        finally:
+            if node is not None:
+                node.terminate()
+                node.wait(15)
+            app.terminate()
+            app.wait(15)
