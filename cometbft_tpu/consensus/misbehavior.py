@@ -142,29 +142,21 @@ def install(node, schedule: Dict[int, str]) -> None:
         # Build the SECOND block independently: the genuine one only
         # exists in _decide_proposal's locals (rs.proposal_block is not
         # assigned until the internal queue delivers the parts back to
-        # the receive thread — state.py:969), so replay the same
-        # construction and flip the header-time nanosecond → a distinct
-        # hash and part set for the same (height, round).
-        from cometbft_tpu.types.block import Commit as _Commit
-
-        if height == (cons.state.initial_height if cons.state else 1):
-            commit = _Commit(0, 0, BlockID(), [])
-        elif (
-            rs.last_commit is not None
-            and rs.last_commit.has_two_thirds_majority()
-        ):
-            commit = rs.last_commit.make_commit()
-        else:
+        # the receive thread — state.py:969). Same make_block path as
+        # honest proposals — valid header time included (validation.py
+        # checks block time EXACTLY, so a time-tweaked block would be
+        # rejected outright and peers would never face two VALID
+        # proposals) — but with different DATA → different hash.
+        commit = cons._proposal_commit(height)
+        if commit is None:
             return
-        fired.add((height, "prop"))
-        alt, _ = cons.block_exec.create_proposal_block(
-            height, cons.state, commit,
+        alt, alt_parts = cons.state.make_block(
+            height,
+            [b"maverick-equivocation"],
+            commit,
+            [],
             cons.priv_validator_pub_key.address(),
         )
-        alt.header.time = Timestamp(
-            alt.header.time.seconds, alt.header.time.nanos ^ 1
-        )
-        alt_parts = alt.make_part_set(65536)
         alt_bid = BlockID(alt.hash(), alt_parts.header())
         prop = Proposal(
             height=height,
@@ -187,5 +179,8 @@ def install(node, schedule: Dict[int, str]) -> None:
                     BlockPartMessage(height, round_, alt_parts.get_part(i))
                 ),
             )
+        # recorded only AFTER the equivocation is fully broadcast — the
+        # e2e's anti-vacuous assertion reads this
+        fired.add((height, "prop"))
 
     cons._decide_proposal = misbehaving_decide

@@ -560,18 +560,25 @@ class ConsensusState(BaseService):
         if self._is_proposal_complete():
             self._enter_prevote(height, rs.round)
 
+    def _proposal_commit(self, height: int):
+        """The last-commit a proposal at `height` must carry, or None
+        when it cannot be formed yet (:1131's selection; shared with the
+        maverick's equivocating proposal builder in misbehavior.py)."""
+        if height == (self.state.initial_height if self.state else 1):
+            return Commit(0, 0, BlockID(), [])
+        rs = self.rs
+        if rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
+            return rs.last_commit.make_commit()
+        return None
+
     def _decide_proposal(self, height: int, round_: int) -> None:
         """Reference: defaultDecideProposal :1131."""
         rs = self.rs
         if rs.valid_block is not None:
             block, block_parts = rs.valid_block, rs.valid_block_parts
         else:
-            commit = None
-            if height == (self.state.initial_height if self.state else 1):
-                commit = Commit(0, 0, BlockID(), [])
-            elif rs.last_commit is not None and rs.last_commit.has_two_thirds_majority():
-                commit = rs.last_commit.make_commit()
-            else:
+            commit = self._proposal_commit(height)
+            if commit is None:
                 self.logger.error("propose step; cannot propose without commit")
                 return
             proposer_addr = self.priv_validator_pub_key.address()

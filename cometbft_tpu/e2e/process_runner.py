@@ -306,29 +306,41 @@ class ProcessTestnet(NetObserver):
         for r in self.inbound_relays.values():
             r.set_enabled(False)
 
-    def connect_node(self, i: int) -> None:
+    def connect_node(self, i: int, reconnect_timeout: float = 45.0) -> None:
         for (a, b), r in self.relays.items():
             if a == i or b == i:
                 r.set_enabled(True)
         for r in self.inbound_relays.values():
             r.set_enabled(True)
-        # nudge re-dials: the switch's persistent reconnect budget is
-        # finite (~20 attempts), so a long partition window can exhaust
-        # it before healing — mirror the operator's `dial_peers` move
-        for a in range(self.n):
-            if a == i:
-                continue
-            for src, dst in ((a, i), (i, a)):
-                addr = (
-                    f"{self.node_ids[dst]}"
-                    f"@127.0.0.1:{self.relays[(src, dst)].listen_port}"
-                )
-                try:
-                    self.client(src).call(
-                        "dial_peers", {"peers": [addr], "persistent": True}
+        # nudge re-dials until the healed node actually HAS peers: the
+        # switch's persistent reconnect budget is finite (~20 attempts),
+        # so a long partition window can exhaust it, and a single
+        # dial_peers burst can race a busy RPC on a loaded host —
+        # mirror the operator's repeated `dial_peers` move
+        deadline = time.monotonic() + reconnect_timeout
+        while time.monotonic() < deadline:
+            for a in range(self.n):
+                if a == i:
+                    continue
+                for src, dst in ((a, i), (i, a)):
+                    addr = (
+                        f"{self.node_ids[dst]}"
+                        f"@127.0.0.1:{self.relays[(src, dst)].listen_port}"
                     )
-                except Exception:  # noqa: BLE001 - best-effort nudge
-                    pass
+                    try:
+                        self.client(src).call(
+                            "dial_peers",
+                            {"peers": [addr], "persistent": True},
+                        )
+                    except Exception:  # noqa: BLE001 - best-effort nudge
+                        pass
+            try:
+                ni = self.client(i).call("net_info", {})
+                if int(ni.get("n_peers") or 0) > 0:
+                    return
+            except Exception:  # noqa: BLE001 - node busy; retry
+                pass
+            time.sleep(1.0)
 
     def terminate_node(self, i: int) -> None:
         """Graceful SIGTERM stop (not a perturbation — teardown)."""

@@ -24,6 +24,21 @@ def net():
     n.stop()
 
 
+def _stop_proc(p):
+    """SIGTERM, escalate to SIGKILL — a wedged subprocess must not turn
+    teardown into TimeoutExpired masking the real failure."""
+    import subprocess
+
+    if p is None:
+        return
+    p.terminate()
+    try:
+        p.wait(15)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        p.wait(10)
+
+
 def _net_height(net, idxs):
     return max(net.height(i) for i in idxs)
 
@@ -195,8 +210,144 @@ class TestSocketABCI:
                 (q["response"] or {}).get("value") or ""
             ) == b"works"
         finally:
-            if node is not None:
-                node.terminate()
-                node.wait(15)
-            app.terminate()
-            app.wait(15)
+            _stop_proc(node)
+            _stop_proc(app)
+
+
+class TestGRPCABCI:
+    """The matrix's gRPC ABCI transport axis: the app behind the
+    ABCIApplication gRPC service, node configured with [base] abci =
+    "grpc" (node/node.py routes the client through GRPCClient)."""
+
+    def test_single_validator_over_grpc_app(self):
+        import os
+        import subprocess
+        import sys
+        import tempfile
+
+        from cometbft_tpu.abci.grpc import GRPCServer
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.cmd.commands import main as cli_main, _load_config
+        from cometbft_tpu.config import write_config_file
+        from cometbft_tpu.libs.net import free_ports
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        d = tempfile.mkdtemp(prefix="abci-grpc-")
+        cli_main(["--home", d, "init", "--chain-id", "grpc-chain"])
+        abci_port, rpc_port, p2p_port = free_ports(3)
+        server = GRPCServer(f"127.0.0.1:{abci_port}", KVStoreApplication())
+        server.start()
+        node = None
+        try:
+            cfg = _load_config(d)
+            cfg.base.abci = "grpc"
+            cfg.base.proxy_app = f"tcp://127.0.0.1:{abci_port}"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            cfg.consensus.timeout_commit_ns = 200_000_000
+            write_config_file(os.path.join(d, "config", "config.toml"), cfg)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["CMT_CRYPTO_BACKEND"] = "cpu"
+            repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            node = subprocess.Popen(
+                [sys.executable, "-m", "cometbft_tpu", "--home", d, "start"],
+                cwd=repo, env=env,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            c = HTTPClient(f"127.0.0.1:{rpc_port}", timeout=5)
+            deadline = time.monotonic() + 60
+            h = 0
+            while time.monotonic() < deadline and h < 2:
+                try:
+                    h = int(c.status()["sync_info"]["latest_block_height"])
+                except Exception:
+                    pass
+                time.sleep(0.3)
+            assert h >= 2, "chain did not advance over the gRPC app"
+            res = c.broadcast_tx_commit(b"grpc=works")
+            assert (res.get("deliver_tx") or {}).get("code", 1) == 0, res
+        finally:
+            _stop_proc(node)
+            server.stop()
+
+
+class TestRemotePrivval:
+    """The matrix's privval axis (ci.toml privval_protocol=tcp): the
+    node holds NO signing key in-process — priv_validator_laddr makes it
+    listen for a remote signer, and the SignerServer (holding the real
+    FilePV) dials in over the authenticated socket. A single validator
+    can only commit if remote signing round-trips work."""
+
+    def test_single_validator_with_remote_signer(self):
+        import os
+        import subprocess
+        import sys
+        import tempfile
+
+        from cometbft_tpu.cmd.commands import main as cli_main, _load_config
+        from cometbft_tpu.config import write_config_file
+        from cometbft_tpu.libs.net import free_ports
+        from cometbft_tpu.privval.file import load_file_pv
+        from cometbft_tpu.privval.socket import (
+            SignerDialerEndpoint,
+            SignerServer,
+        )
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        d = tempfile.mkdtemp(prefix="privval-tcp-")
+        cli_main(["--home", d, "init", "--chain-id", "pv-chain"])
+        pv_port, rpc_port, p2p_port = free_ports(3)
+        cfg = _load_config(d)
+        # the signer process owns the key; load it BEFORE the node (the
+        # node must not touch priv_validator_key.json in this mode)
+        pv = load_file_pv(
+            cfg.base.priv_validator_key_path(),
+            cfg.base.priv_validator_state_path(),
+        )
+        cfg.base.proxy_app = "kvstore"
+        cfg.base.priv_validator_laddr = f"tcp://127.0.0.1:{pv_port}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+        cfg.consensus.timeout_commit_ns = 200_000_000
+        write_config_file(os.path.join(d, "config", "config.toml"), cfg)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["CMT_CRYPTO_BACKEND"] = "cpu"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        node = subprocess.Popen(
+            [sys.executable, "-m", "cometbft_tpu", "--home", d, "start"],
+            cwd=repo, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        server = None
+        try:
+            # dial the node until its privval listener is up
+            deadline = time.monotonic() + 30
+            last = None
+            while time.monotonic() < deadline and server is None:
+                try:
+                    dialer = SignerDialerEndpoint(
+                        f"tcp://127.0.0.1:{pv_port}", timeout_read=5.0
+                    )
+                    dialer.connect()
+                    server = SignerServer(dialer, "pv-chain", pv)
+                    server.start()
+                except Exception as exc:  # noqa: BLE001 - node still booting
+                    last = exc
+                    time.sleep(0.3)
+            assert server is not None, f"signer never connected: {last}"
+            c = HTTPClient(f"127.0.0.1:{rpc_port}", timeout=5)
+            deadline = time.monotonic() + 60
+            h = 0
+            while time.monotonic() < deadline and h < 2:
+                try:
+                    h = int(c.status()["sync_info"]["latest_block_height"])
+                except Exception:
+                    pass
+                time.sleep(0.3)
+            assert h >= 2, "chain did not advance with a remote signer"
+        finally:
+            _stop_proc(node)
+            if server is not None:
+                server.stop()

@@ -640,3 +640,46 @@ class TestLocalnet:
                     except subprocess.TimeoutExpired:
                         p.kill()
                         p.communicate()
+
+
+class TestThreadHygiene:
+    """leaktest analog (the reference wraps tests in leaktest.Check):
+    a stopped node must not leave non-daemon threads behind — a leaked
+    thread means stop() misses a service and shutdown would hang."""
+
+    def test_node_start_stop_leaves_no_nondaemon_threads(self, tmp_path):
+        import threading
+        import time
+
+        from cometbft_tpu.cmd.commands import main as cli_main, _load_config
+        from cometbft_tpu.libs.net import free_ports
+        from cometbft_tpu.node import default_new_node
+
+        def nondaemon():
+            return {
+                t for t in threading.enumerate()
+                if not t.daemon and t.is_alive()
+            }
+
+        home = str(tmp_path / "leaknode")
+        cli_main(["--home", home, "init", "--chain-id", "leak-chain"])
+        cfg = _load_config(home)
+        p2p, rpc = free_ports(2)
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc}"
+        cfg.base.proxy_app = "kvstore"
+        baseline = nondaemon()
+        for _ in range(2):  # twice: catches leaks that survive restart
+            node = default_new_node(cfg)
+            node.start()
+            time.sleep(1.0)
+            node.stop()
+            deadline = time.monotonic() + 20
+            leaked = nondaemon() - baseline
+            while leaked and time.monotonic() < deadline:
+                time.sleep(0.25)
+                leaked = nondaemon() - baseline
+            assert not leaked, (
+                f"non-daemon threads leaked after stop: "
+                f"{[t.name for t in leaked]}"
+            )
