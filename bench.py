@@ -245,7 +245,7 @@ def _stage_variants():
 
     out = {}
     batch = _make_batch(4096)
-    for mul in ("shift_add", "matmul", "stack"):
+    for mul in ("shift_add", "matmul", "stack", "f32"):
         os.environ["CBFT_TPU_MUL"] = mul
         # fe.mul reads the env var at TRACE time; without this the later
         # variants would silently reuse the first variant's executable
@@ -299,7 +299,8 @@ def _stage_breakdown():
     print(json.dumps(out), flush=True)
 
     @jax.jit
-    def decompress_and_table(ay, a_sign):
+    def decompress_and_table(wire):
+        ay, a_sign, _r_y, _r_sign, _s, _h = eb.unpack_wire(wire)
         x, ok = eb.decompress(ay, a_sign)
         nx = eb.fe.neg(x)
         neg_a = (nx, ay, jnp.broadcast_to(eb._ONE_FE, ay.shape), eb.fe.mul(nx, ay))
@@ -307,10 +308,10 @@ def _stage_breakdown():
         a3 = eb.point_add(a2, neg_a)
         return ok, a2[0], a3[0]
 
-    ay, a_sign, r_y, r_sign, s_digits, h_digits = dev
-    jax.block_until_ready(decompress_and_table(ay, a_sign))  # compile
+    (wire,) = dev
+    jax.block_until_ready(decompress_and_table(wire))  # compile
     t0 = time.perf_counter()
-    jax.block_until_ready(decompress_and_table(ay, a_sign))
+    jax.block_until_ready(decompress_and_table(wire))
     out["device_decompress_table_ms"] = round(
         (time.perf_counter() - t0) * 1e3, 2
     )

@@ -166,16 +166,19 @@ class TPUBatchVerifier(BatchVerifier):
 
         self._items: List[Tuple[PubKey, bytes, bytes]] = []
         # Below min_batch the device dispatch + host packing dominates and
-        # the CPU path is simply faster: the measured on-chip crossover
-        # was ~1k signatures with the round-3 kernel (device 2.8k sigs/s
-        # at batch 256 vs 4.1k/s CPU serial; parity near 1024). Small
-        # commits (150 validators) therefore verify on CPU even under the
-        # "tpu" backend — the hybrid IS the design, the device earns its
+        # the CPU path is simply faster. Round-5 on-chip measurement
+        # (tools/tpu_smallbatch.py, TPU v5e tunnel, stack mul + device
+        # hash): device 39.1 ms vs CPU 31.2 ms at 256 sigs, 54.1 ms vs
+        # 62.3 ms at 512 — crossover 512, set by the tunnel's ~40 ms
+        # per-dispatch round-trip floor, not by compute (the kernel
+        # itself runs 4096 sigs in 0.22 ms). Small commits (150
+        # validators) therefore verify on CPU even under the "tpu"
+        # backend — the hybrid IS the design, the device earns its
         # round-trip only at scale. CBFT_TPU_MIN_BATCH retunes the
         # routing from config when a kernel change moves the crossover,
         # without a code change.
         if min_batch is None:
-            min_batch = int(os.environ.get("CBFT_TPU_MIN_BATCH", "1024"))
+            min_batch = int(os.environ.get("CBFT_TPU_MIN_BATCH", "512"))
         self._min_batch = min_batch
         # The non-ed curves (secp256k1, sr25519) are a different animal:
         # their CPU fallbacks are pure-Python big-int (~ms/sig), so the

@@ -212,7 +212,56 @@ def _select_cached(entries: List[CachedPoint], idx: jnp.ndarray) -> CachedPoint:
     return tuple(out)
 
 
-def _verify_core(
+def unpack_fe_limbs(words: jnp.ndarray) -> jnp.ndarray:
+    """u32[8,B] little-endian words → int32[17,B] 15-bit limbs of the low
+    255 bits (bit 255 — the sign bit — is naturally excluded: limb 16
+    covers bits 240..254). Runs ON DEVICE: the wire format ships the raw
+    32-byte encodings and pays a few shifts per limb instead of 68 bytes
+    of pre-split limbs per field element (the tunnel link is
+    bandwidth-bound — BENCH_onchip_probe.json: 299 ms transfer vs 0.22 ms
+    compute at batch 4096)."""
+    limbs = []
+    for i in range(fe.NUM_LIMBS):
+        bit = 15 * i
+        j, k = bit // 32, bit % 32
+        w = words[j] >> k
+        if k > 17 and j + 1 < 8:  # limb spans into the next word
+            w = w | (words[j + 1] << (32 - k))
+        limbs.append((w & jnp.uint32(0x7FFF)).astype(jnp.int32))
+    return jnp.stack(limbs, axis=0)
+
+
+def unpack_digits(words: jnp.ndarray) -> jnp.ndarray:
+    """u32[8,B] little-endian scalar words → int32[127,B] radix-4 digits,
+    MSB first (device-side equivalent of the old host _digits_msb_first;
+    a 2-bit digit at even bit offset never crosses a word boundary)."""
+    digs = []
+    for d in range(NUM_DIGITS):
+        bit = 2 * (NUM_DIGITS - 1 - d)
+        j, k = bit // 32, bit % 32
+        digs.append(((words[j] >> k) & jnp.uint32(3)).astype(jnp.int32))
+    return jnp.stack(digs, axis=0)
+
+
+def _unpack_points_scalar(wire: jnp.ndarray):
+    """Rows 0:24 of the wire (A, R, S — shared between the host-hash and
+    device-hash layouts) → (ay, a_sign, r_y, r_sign, s_digits)."""
+    pk_w, r_w = wire[0:8], wire[8:16]
+    ay = unpack_fe_limbs(pk_w)
+    a_sign = (pk_w[7] >> 31).astype(jnp.int32)
+    r_y = unpack_fe_limbs(r_w)
+    r_sign = (r_w[7] >> 31).astype(jnp.int32)
+    s_digits = unpack_digits(wire[16:24])
+    return ay, a_sign, r_y, r_sign, s_digits
+
+
+def unpack_wire(wire: jnp.ndarray):
+    """u32[32,B] wire (rows 0:8 A, 8:16 R, 16:24 S, 24:32 h, all LE
+    words) → the six unpacked kernel inputs."""
+    return _unpack_points_scalar(wire) + (unpack_digits(wire[24:32]),)
+
+
+def _verify_unpacked(
     ay: jnp.ndarray,  # int32[17,B]  A's y limbs (low 255 bits)
     a_sign: jnp.ndarray,  # int32[B]  A's sign bit
     r_y: jnp.ndarray,  # int32[17,B]  R's y limbs (low 255 bits)
@@ -266,16 +315,19 @@ def _verify_core(
     return y_eq & sign_eq & ok
 
 
+def _verify_core(wire: jnp.ndarray) -> jnp.ndarray:
+    """bool[B] from the u32[32,B] wire buffer (host-hash mode). ONE input
+    array per dispatch: 128 bytes/sig on the link instead of the 1,160
+    bytes/sig the pre-split limb+digit arrays cost."""
+    return _verify_unpacked(*unpack_wire(wire))
+
+
 verify_kernel = jax.jit(_verify_core)
 
 
 @jax.jit
 def verify_full_kernel(
-    ay: jnp.ndarray,  # int32[17,B]
-    a_sign: jnp.ndarray,  # int32[B]
-    r_y: jnp.ndarray,  # int32[17,B]
-    r_sign: jnp.ndarray,  # int32[B]
-    s_digits: jnp.ndarray,  # int32[127,B]
+    wire: jnp.ndarray,  # u32[24,B]  rows 0:8 A, 8:16 R, 16:24 S (LE words)
     msg_hi: jnp.ndarray,  # u32[n_blocks,16,B]  padded R‖A‖M, BE word hi
     msg_lo: jnp.ndarray,  # u32[n_blocks,16,B]
     msg_nblocks: jnp.ndarray,  # int32[B]  live block count per lane
@@ -285,10 +337,11 @@ def verify_full_kernel(
     dispatches (CBFT_TPU_HASH=device path)."""
     from cometbft_tpu.crypto.tpu import scalar, sha512
 
+    ay, a_sign, r_y, r_sign, s_digits = _unpack_points_scalar(wire)
     dig_hi, dig_lo = sha512.sha512_blocks(msg_hi, msg_lo, msg_nblocks)
     h = scalar.sc_reduce(scalar.digest_to_limbs(dig_hi, dig_lo))
     h_digits = scalar.digits_msb_first(h)
-    return _verify_core(ay, a_sign, r_y, r_sign, s_digits, h_digits)
+    return _verify_unpacked(ay, a_sign, r_y, r_sign, s_digits, h_digits)
 
 
 # --- host glue -------------------------------------------------------------
@@ -299,12 +352,9 @@ _MAX_CHUNK = 8192
 
 
 
-def _digits_msb_first(le_bytes: np.ndarray) -> np.ndarray:
-    """uint8[B,32] little-endian scalars → int32[127,B] 2-bit digits, MSB
-    first (digit axis leading, batch on the minor axis for the kernel)."""
-    bits = np.unpackbits(le_bytes, axis=-1, bitorder="little")  # [B,256]
-    digits = bits[..., 0 : 2 * NUM_DIGITS : 2] + 2 * bits[..., 1 : 2 * NUM_DIGITS : 2]
-    return np.ascontiguousarray(digits[..., ::-1].astype(np.int32).T)
+def _le_words(arr_u8: np.ndarray) -> np.ndarray:
+    """u8[B,32] → u32[8,B] little-endian words."""
+    return np.ascontiguousarray(np.ascontiguousarray(arr_u8).view("<u4").T)
 
 
 _L_BYTES_LE = np.frombuffer(L.to_bytes(32, "little"), np.uint8)
@@ -338,24 +388,17 @@ def _parse_inputs(pub_keys, sigs):
     return pk_arr, sig_arr, valid
 
 
-def _pack_points(pk_arr, sig_arr):
-    r_arr = sig_arr[:, :32]
-    ay = np.ascontiguousarray(fe.bytes_to_limbs_np(pk_arr).T)
-    a_sign = (pk_arr[:, 31] >> 7).astype(np.int32)
-    r_y = np.ascontiguousarray(fe.bytes_to_limbs_np(r_arr).T)
-    r_sign = (r_arr[:, 31] >> 7).astype(np.int32)
-    s_digits = _digits_msb_first(sig_arr[:, 32:])
-    return ay, a_sign, r_y, r_sign, s_digits
-
-
 def prepare_batch(
     pub_keys: Sequence[bytes],
     msgs: Sequence[bytes],
     sigs: Sequence[bytes],
 ):
-    """Host-side packing for the host-hash mode: parse inputs, run
-    SHA-512 + mod-L per signature (hashlib C + CPython big-int), mask the
-    structurally-invalid entries (wrong length, s ≥ L)."""
+    """Host-side packing for the host-hash mode → (wire u32[32,B], valid).
+
+    The wire buffer carries the raw little-endian words of A, R, S and
+    h = SHA-512(R ‖ A ‖ M) mod L (hashlib C + CPython big-int on the
+    host); limb splitting and digit extraction moved on-device
+    (unpack_wire) so the link carries 128 bytes/sig, not 1,160."""
     n = len(pub_keys)
     pk_arr, sig_arr, valid = _parse_inputs(pub_keys, sigs)
     h_arr = np.zeros((n, 32), np.uint8)
@@ -376,9 +419,16 @@ def prepare_batch(
         )
         h_arr[i] = np.frombuffer(h_int.to_bytes(32, "little"), np.uint8)
 
-    out = _pack_points(pk_arr, sig_arr)
-    h_digits = _digits_msb_first(h_arr)
-    return out + (h_digits, valid)
+    wire = np.concatenate(
+        [
+            _le_words(pk_arr),
+            _le_words(sig_arr[:, :32]),
+            _le_words(sig_arr[:, 32:]),
+            _le_words(h_arr),
+        ],
+        axis=0,
+    )
+    return wire, valid
 
 
 def prepare_batch_device_hash(
@@ -388,7 +438,8 @@ def prepare_batch_device_hash(
 ):
     """Host-side packing for the device-hash mode: no hashing at all on
     the host — R ‖ A ‖ M is padded into SHA-512 blocks (bulk numpy) and
-    the kernel does the rest."""
+    the kernel does the rest. → (wire u32[24,B], msg_hi, msg_lo,
+    nblocks, valid)."""
     from cometbft_tpu.crypto.tpu import sha512
 
     pk_arr, sig_arr, valid = _parse_inputs(pub_keys, sigs)
@@ -397,8 +448,15 @@ def prepare_batch_device_hash(
         for i in range(len(pub_keys))
     ]
     msg_hi, msg_lo, nblocks = sha512.pad_ragged_np(hash_msgs)
-    out = _pack_points(pk_arr, sig_arr)
-    return out + (msg_hi, msg_lo, nblocks, valid)
+    wire = np.concatenate(
+        [
+            _le_words(pk_arr),
+            _le_words(sig_arr[:, :32]),
+            _le_words(sig_arr[:, 32:]),
+        ],
+        axis=0,
+    )
+    return wire, msg_hi, msg_lo, nblocks, valid
 
 
 def hash_mode() -> str:
@@ -412,7 +470,7 @@ def hash_mode() -> str:
     return mode
 
 
-def warmup(sizes: Sequence[int] = (64, 128, 256, 512, 1024)) -> None:
+def warmup(sizes: Optional[Sequence[int]] = None) -> None:
     """Pre-compile the dispatch-size buckets so the FIRST commit a node
     verifies on device doesn't pay a multi-second XLA compile (VERDICT
     r4 item 2: small-batch dispatch overhead). dispatch_batch pads every
@@ -421,7 +479,25 @@ def warmup(sizes: Sequence[int] = (64, 128, 256, 512, 1024)) -> None:
     persistent compilation cache (configured at node start) makes this a
     disk read after the first boot. Inputs are synthetic — the kernel's
     cost is shape-dependent only, and a parse-reject still exercises the
-    full program with valid=False lanes."""
+    full program with valid=False lanes.
+
+    Default sizes span the buckets the LIVE routing can actually
+    dispatch: from the pow-2 bucket that CBFT_TPU_MIN_BATCH (the
+    measured tunnel crossover — crypto/batch.py) routes into, up to the
+    _MAX_CHUNK cap (mega commits and blocksync windows chunk into the
+    top bucket). Deriving the floor from the knob keeps a retuned
+    threshold covered without touching this code."""
+    if sizes is None:
+        import os
+
+        floor = int(os.environ.get("CBFT_TPU_MIN_BATCH", "512"))
+        lo = _MIN_PAD
+        while lo < min(floor, _MAX_CHUNK):
+            lo *= 2
+        sizes, size = [], lo
+        while size <= _MAX_CHUNK:
+            sizes.append(size)
+            size *= 2
     pk = bytes(32)
     sig = bytes(64)
     msg = b"warmup"

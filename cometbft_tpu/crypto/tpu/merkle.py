@@ -22,6 +22,7 @@ Bit-identical to crypto.merkle.hash_from_byte_slices for every n
 from __future__ import annotations
 
 import hashlib
+import os
 from functools import partial
 from typing import Sequence
 
@@ -34,8 +35,14 @@ from cometbft_tpu.crypto.tpu import sha256 as tpu_sha
 _LEAF_PREFIX = b"\x00"
 _INNER_LEN = 65  # 0x01 || left32 || right32
 
-# device becomes worth the round-trip above this many leaves
-MIN_DEVICE_LEAVES = 128
+# device becomes worth the round-trip above this many leaves. Round-5
+# on-chip measurement: on the TUNNELED single chip the device tree
+# LOSES at every size tried (10k leaves: 93.2 ms device vs 17.3 ms
+# host — BENCH_onchip_probe.json tpu_p50) because the link's transfer
+# cost dwarfs the compute; the routing stays opt-in
+# (crypto.merkle.enable_parallel) and this floor is env-tunable for
+# locally-attached TPUs where the round-trip is microseconds.
+MIN_DEVICE_LEAVES = int(os.environ.get("CBFT_TPU_MERKLE_MIN_LEAVES", "128"))
 # device leaf hashing caps the per-item size (16 SHA blocks ≈ 1 KiB);
 # larger items fall back to host-hashed leaves + device tree. The SHA
 # message is prefix ‖ item ‖ 0x80-pad ‖ 8-byte length, so the prefix

@@ -85,14 +85,17 @@ def ristretto_decode(
     return x, y, ok
 
 
-@jax.jit
-def verify_kernel(
-    a_s: jnp.ndarray,  # int32[17,B]  A's ristretto encoding as limbs
-    r_s: jnp.ndarray,  # int32[17,B]  R's ristretto encoding as limbs
-    s_digits: jnp.ndarray,  # int32[127,B]  s 2-bit digits, MSB first
-    k_digits: jnp.ndarray,  # int32[127,B]  challenge 2-bit digits
-) -> jnp.ndarray:
-    """bool[B]: s·B + k·(−A) ≟ R (ristretto equality), decodes valid."""
+def _verify_core(wire: jnp.ndarray) -> jnp.ndarray:
+    """bool[B] from the u32[32,B] wire (rows 0:8 A, 8:16 R, 16:24 S,
+    24:32 merlin challenge k, LE words): s·B + k·(−A) ≟ R (ristretto
+    equality), decodes valid. Raw encodings on the link + device unpack,
+    same rationale as ed25519_batch.unpack_wire (ristretto encodings are
+    canonical < p with bit 255 clear, so the low-255-bit limb unpack is
+    lossless)."""
+    a_s = eb.unpack_fe_limbs(wire[0:8])
+    r_s = eb.unpack_fe_limbs(wire[8:16])
+    s_digits = eb.unpack_digits(wire[16:24])
+    k_digits = eb.unpack_digits(wire[24:32])
     ax, ay, ok_a = ristretto_decode(a_s)
     rx, ry, ok_r = ristretto_decode(r_s)
 
@@ -132,6 +135,9 @@ def verify_kernel(
     eq1 = fe.eq(fe.mul(px, ry), fe.mul(py, rx))
     eq2 = fe.eq(fe.mul(py, ry), fe.mul(px, rx))
     return (eq1 | eq2) & ok_a & ok_r
+
+
+verify_kernel = jax.jit(_verify_core)
 
 
 # --- host glue -------------------------------------------------------------
@@ -191,11 +197,16 @@ def prepare_batch(
         s_arr[i] = np.frombuffer(s.to_bytes(32, "little"), np.uint8)
         k_arr[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
 
-    a_limbs = np.ascontiguousarray(fe.bytes_to_limbs_np(a_b).T)
-    r_limbs = np.ascontiguousarray(fe.bytes_to_limbs_np(r_b).T)
-    s_digits = eb._digits_msb_first(s_arr)
-    k_digits = eb._digits_msb_first(k_arr)
-    return a_limbs, r_limbs, s_digits, k_digits, valid
+    wire = np.concatenate(
+        [
+            eb._le_words(a_b),
+            eb._le_words(r_b),
+            eb._le_words(s_arr),
+            eb._le_words(k_arr),
+        ],
+        axis=0,
+    )
+    return wire, valid
 
 
 def verify_batch(

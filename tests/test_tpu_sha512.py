@@ -73,9 +73,7 @@ class TestScReduce:
             got = sum(int(red[j, i]) << (15 * j) for j in range(17))
             assert got == want, i
 
-    def test_digit_extraction_matches_host_packer(self):
-        from cometbft_tpu.crypto.tpu import ed25519_batch
-
+    def test_digit_extraction_matches_host_oracle(self):
         rng = np.random.default_rng(37)
         msgs = [rng.bytes(40) for _ in range(16)]
         hi, lo, nb = sha512.pad_ragged_np(msgs)
@@ -86,5 +84,8 @@ class TestScReduce:
         for i, m in enumerate(msgs):
             h = int.from_bytes(hashlib.sha512(m).digest(), "little") % scalar.L
             arr[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
-        want = ed25519_batch._digits_msb_first(arr)
+        # independent numpy oracle: 2-bit LE digit pairs, MSB first
+        bits = np.unpackbits(arr, axis=-1, bitorder="little")
+        digits = bits[:, 0:254:2] + 2 * bits[:, 1:254:2]
+        want = np.ascontiguousarray(digits[:, ::-1].astype(np.int32).T)
         assert (got == want).all()

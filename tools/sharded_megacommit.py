@@ -101,7 +101,7 @@ out = {
     "n_devices": len(devs),
     "mesh": "Mesh(8, axis='batch')",
     "per_device_lane_shard": PAD // len(devs),
-    "example_per_device_shard_shapes_ay": shard_shapes,
+    "example_per_device_shard_shapes_wire": shard_shapes,
     "host_prepare_s": round(t_prep, 2),
     "compile_plus_first_run_s": round(t_compile_and_first, 2),
     "steady_state_s": round(best, 3),
