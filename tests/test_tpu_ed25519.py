@@ -63,6 +63,57 @@ class TestField:
         assert _fe_int(_fe1(fe.P + 5)) == 5
 
 
+class TestWireUnpack:
+    """Device-side unpack of the compact u32 wire vs independent numpy
+    oracles — the wire format is the dispatch ABI, so a silent bit-slip
+    here would corrupt every lane."""
+
+    def test_fe_limbs_match_int_oracle(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(11)
+        raw = rng.integers(0, 256, size=(9, 32)).astype(np.uint8)
+        words = jnp.asarray(ed25519_batch._le_words(raw))
+        got = np.asarray(ed25519_batch.unpack_fe_limbs(words))
+        for b in range(raw.shape[0]):
+            val = int.from_bytes(raw[b].tobytes(), "little") & ((1 << 255) - 1)
+            assert fe.limbs_to_int(got[:, b]) == val, b
+            assert all(0 <= int(v) < 2**15 for v in got[:, b])
+
+    def test_digits_match_bit_oracle(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(13)
+        raw = rng.integers(0, 256, size=(7, 32)).astype(np.uint8)
+        words = jnp.asarray(ed25519_batch._le_words(raw))
+        got = np.asarray(ed25519_batch.unpack_digits(words))
+        bits = np.unpackbits(raw, axis=-1, bitorder="little")
+        digits = bits[:, 0:254:2] + 2 * bits[:, 1:254:2]
+        want = np.ascontiguousarray(digits[:, ::-1].astype(np.int32).T)
+        assert (got == want).all()
+
+    def test_sign_bits_through_production_unpack(self):
+        import jax.numpy as jnp
+
+        pk = np.zeros((2, 32), np.uint8)
+        pk[1, 31] = 0x80  # A sign bit set on lane 1
+        r = np.zeros((2, 32), np.uint8)
+        r[0, 31] = 0x80  # R sign bit set on lane 0
+        zero = np.zeros((2, 32), np.uint8)
+        wire = jnp.asarray(
+            np.concatenate(
+                [ed25519_batch._le_words(a) for a in (pk, r, zero, zero)],
+                axis=0,
+            )
+        )
+        ay, a_sign, r_y, r_sign, s_dig, h_dig = ed25519_batch.unpack_wire(wire)
+        assert list(np.asarray(a_sign)) == [0, 1]
+        assert list(np.asarray(r_sign)) == [1, 0]
+        # and the sign bit never leaks into the limbs
+        assert fe.limbs_to_int(np.asarray(ay)[:, 1]) == 0
+        assert fe.limbs_to_int(np.asarray(r_y)[:, 0]) == 0
+
+
 class TestVerifyBatchParity:
     def test_valid_signatures(self):
         keys = [ed.gen_priv_key_from_secret(bytes([i])) for i in range(8)]
