@@ -144,14 +144,16 @@ class TPUBatchVerifier(BatchVerifier):
     """Partitions the batch by curve (SURVEY.md §7 stage 10): ed25519,
     secp256k1, and sr25519 entries each go to their own batch kernel;
     anything else falls back to serial CPU verification in place. Each
-    partition applies its routing threshold independently (the non-ed
-    curves' CPU fallbacks are pure-Python big-int, so their threshold is
-    tiny)."""
+    partition applies its own routing floor, scaled to its CPU
+    fallback's speed: ed25519 512 (measured tunnel crossover),
+    secp256k1 128 (OpenSSL ECDSA fallback), sr25519 4 (pure-Python
+    fallback, ~ms/sig — the device wins almost immediately)."""
 
     def __init__(
         self,
         min_batch: Optional[int] = None,
         slow_curve_min_batch: Optional[int] = None,
+        secp_min_batch: Optional[int] = None,
     ):
         # fail fast if a kernel module is unavailable rather than erroring
         # mid-verify after add() calls succeeded (imports are host-only:
@@ -180,15 +182,22 @@ class TPUBatchVerifier(BatchVerifier):
         if min_batch is None:
             min_batch = int(os.environ.get("CBFT_TPU_MIN_BATCH", "512"))
         self._min_batch = min_batch
-        # The non-ed curves (secp256k1, sr25519) are a different animal:
-        # their CPU fallbacks are pure-Python big-int (~ms/sig), so the
-        # device wins almost immediately — route even small batches to
-        # the kernels. One shared knob governs both.
+        # The non-ed curves split by the speed of their CPU fallback:
+        # sr25519's is pure-Python big-int (~ms/sig) so the device wins
+        # almost immediately (floor 4); secp256k1 routes through OpenSSL
+        # ECDSA (~3.7k sigs/s measured) so the tunnel's ~40 ms dispatch
+        # floor prices the device out below ~128 sigs — estimated from
+        # the ed25519 crossover measurement, overridable per curve.
         if slow_curve_min_batch is None:
             slow_curve_min_batch = int(
                 os.environ.get("CBFT_TPU_SLOW_CURVE_MIN_BATCH", "4")
             )
         self._slow_curve_min_batch = slow_curve_min_batch
+        if secp_min_batch is None:
+            secp_min_batch = int(
+                os.environ.get("CBFT_TPU_SECP_MIN_BATCH", "128")
+            )
+        self._secp_min_batch = secp_min_batch
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
         if pub_key is None:
@@ -220,11 +229,12 @@ class TPUBatchVerifier(BatchVerifier):
         for curve, idxs in by_curve.items():
             if not idxs:
                 continue
-            threshold = (
-                self._min_batch
-                if curve == ed.KEY_TYPE
-                else self._slow_curve_min_batch
-            )
+            if curve == ed.KEY_TYPE:
+                threshold = self._min_batch
+            elif curve == secp.KEY_TYPE:
+                threshold = self._secp_min_batch
+            else:
+                threshold = self._slow_curve_min_batch
             if len(idxs) < threshold or not device_plane_ok():
                 if curve == ed.KEY_TYPE:
                     sub_mask = ed.verify_many([items[i] for i in idxs])

@@ -235,7 +235,7 @@ class TestBatchVerifier:
         done.set()
         monkeypatch.setattr(cryptobatch, "_probe_done", done)
         monkeypatch.setattr(cryptobatch, "_probe_ok", False)
-        bv = cryptobatch.TPUBatchVerifier(min_batch=1, slow_curve_min_batch=1)
+        bv = cryptobatch.TPUBatchVerifier(min_batch=1, slow_curve_min_batch=1, secp_min_batch=1)
         for pk, m, s in self._mk(8, bad={2}):
             bv.add(pk, m, s)
         ok, mask = bv.verify()

@@ -158,7 +158,7 @@ class TestMixedCurveBatch:
         from cometbft_tpu.crypto import ed25519 as ed
         from cometbft_tpu.crypto.batch import TPUBatchVerifier
 
-        bv = TPUBatchVerifier(min_batch=1)
+        bv = TPUBatchVerifier(min_batch=1, secp_min_batch=1)
         expect = []
         for i in range(4):
             k = ed.gen_priv_key_from_secret(bytes([i, 31]))

@@ -95,7 +95,7 @@ class TestThreeCurveBoundary:
             supports_batch_verification,
         )
 
-        bv = TPUBatchVerifier(min_batch=1, slow_curve_min_batch=1)
+        bv = TPUBatchVerifier(min_batch=1, slow_curve_min_batch=1, secp_min_batch=1)
         expect = []
         for i in range(2):
             k = ed.gen_priv_key_from_secret(bytes([i, 41]))
