@@ -347,6 +347,14 @@ def verify_full_kernel(
 # --- host glue -------------------------------------------------------------
 
 _MIN_PAD = 64
+# Per-curve default; CBFT_TPU_MAX_CHUNK overrides it for ALL curve
+# kernels at the shared dispatch layer (mesh.chunk_cap) — the optimum is
+# link-dependent: the round-5 sweep measured 16384 as two 8192 chunks
+# SLOWER than one 8192 dispatch (9,156 vs 10,256 sigs/s), i.e. the
+# tunnel's per-dispatch cost dominates the extra bytes, so a deployment
+# may win by raising the cap to put a mega-commit in one dispatch.
+# Device-memory bound: a 16384-lane chunk's Straus tables are ~70 MB —
+# comfortable in 16 GB HBM.
 _MAX_CHUNK = 8192
 
 
@@ -490,12 +498,15 @@ def warmup(sizes: Optional[Sequence[int]] = None) -> None:
     if sizes is None:
         import os
 
+        from cometbft_tpu.crypto.tpu import mesh as mesh_mod
+
         floor = int(os.environ.get("CBFT_TPU_MIN_BATCH", "512"))
+        cap = mesh_mod.chunk_cap(_MAX_CHUNK, _MIN_PAD)
         lo = _MIN_PAD
-        while lo < min(floor, _MAX_CHUNK):
+        while lo < min(floor, cap):
             lo *= 2
         sizes, size = [], lo
-        while size <= _MAX_CHUNK:
+        while size <= cap:
             sizes.append(size)
             size *= 2
     pk = bytes(32)

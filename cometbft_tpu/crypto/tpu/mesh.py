@@ -116,6 +116,32 @@ def n_devices() -> int:
 _sharded_kernels = {}
 
 
+def chunk_cap(default: int, min_pad: int) -> int:
+    """Resolve the dispatch chunk cap: CBFT_TPU_MAX_CHUNK (validated and
+    rounded UP to a power of two, so the dispatched bucket always equals
+    a padded shape and warmup covers it) overrides the caller's
+    per-curve default. One knob governs every curve kernel — the cap
+    tunes a property of the LINK (per-dispatch cost vs bytes), not of a
+    curve."""
+    raw = os.environ.get("CBFT_TPU_MAX_CHUNK")
+    if raw is None:
+        return default
+    try:
+        cap = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"CBFT_TPU_MAX_CHUNK={raw!r} is not an integer"
+        ) from None
+    if cap < min_pad:
+        raise ValueError(
+            f"CBFT_TPU_MAX_CHUNK={cap} is below the minimum pad {min_pad}"
+        )
+    size = min_pad
+    while size < cap:
+        size *= 2
+    return size
+
+
 def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
     """Shared chunk-pad-dispatch loop for batch verify kernels (used by
     both the ed25519 and secp256k1 entries): pads each chunk's trailing
@@ -125,6 +151,7 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
     device work overlaps host packing."""
     import numpy as np
 
+    max_chunk = chunk_cap(max_chunk, min_pad)
     ndev = n_devices()
     out = np.zeros(n, bool)
     pending = []
