@@ -82,7 +82,10 @@ class TestProcessPerturbations:
         h0 = _net_height(net, [0, 2, 3])
         net.disconnect_node(victim)
         try:
-            net.wait_for_height(h0 + 2, timeout=60, nodes=[0, 2, 3])
+            # generous timeouts: 4 subprocess nodes share one core on the
+            # CI box, and concurrent load (e.g. a parallel compile) can
+            # stretch a commit round several-fold
+            net.wait_for_height(h0 + 2, timeout=120, nodes=[0, 2, 3])
             # the victim must NOT advance while cut off
             stalled = net.height(victim)
             time.sleep(3)
@@ -92,7 +95,7 @@ class TestProcessPerturbations:
         finally:
             net.connect_node(victim)
         h1 = _net_height(net, [0, 2, 3])
-        net.wait_for_height(h1, timeout=120, nodes=[victim])
+        net.wait_for_height(h1, timeout=240, nodes=[victim])
         net.check_app_hashes_agree(h0 + 1)
 
 
