@@ -1,9 +1,16 @@
 #!/bin/bash
 # TPU tunnel watcher: probe until the tunnel answers, then immediately run
-# the full bench (subprocess-staged, wedge-safe) and save the artifact.
+# the full measurement session and save each artifact as it lands.
 # The tunnel serves one chip and can wedge for hours (a killed client can
 # leave it stuck); this watcher exists so on-chip numbers are captured the
 # moment it recovers, without a human (or the main session) polling.
+#
+# Session order (most important first, in case the tunnel wedges again
+# mid-session):
+#   1. bench.py               -> BENCH_onchip_probe.json   (judged headline)
+#   2. tools/tpu_link_probe   -> LINK_PROBE.json           (latency vs bandwidth)
+#   3. tools/tpu_smallbatch   -> SMALLBATCH_onchip.jsonl   (crossover, compact wire)
+#   4. CBFT_TPU_MAX_CHUNK=16384 sweep -> MAXCHUNK16K.jsonl (single-dispatch A/B)
 cd /root/repo
 LOG=/root/repo/.tpu_watch.log
 OUT=/root/repo/BENCH_onchip_probe.json
@@ -13,6 +20,14 @@ while true; do
     echo "[watch] tunnel UP $(date -u +%H:%M:%S) — running bench" >> "$LOG"
     timeout 3000 python3 bench.py > "$OUT.tmp" 2>> "$LOG" && mv "$OUT.tmp" "$OUT"
     echo "[watch] bench done $(date -u +%H:%M:%S) rc=$?" >> "$LOG"
+    timeout 600 python3 tools/tpu_link_probe.py > LINK_PROBE.json.tmp 2>> "$LOG" \
+      && mv LINK_PROBE.json.tmp LINK_PROBE.json
+    echo "[watch] link probe done $(date -u +%H:%M:%S) rc=$?" >> "$LOG"
+    timeout 2400 python3 tools/tpu_smallbatch.py > SMALLBATCH_onchip.jsonl 2>> "$LOG"
+    echo "[watch] smallbatch done $(date -u +%H:%M:%S) rc=$?" >> "$LOG"
+    CBFT_TPU_MAX_CHUNK=16384 CBFT_TPU_PROBE=0 timeout 1200 \
+      python3 bench.py --stage run > MAXCHUNK16K.jsonl 2>> "$LOG"
+    echo "[watch] maxchunk A/B done $(date -u +%H:%M:%S) rc=$?" >> "$LOG"
     exit 0
   fi
   echo "[watch] tunnel down $(date -u +%H:%M:%S); retry in 600s" >> "$LOG"
