@@ -62,6 +62,25 @@ class TestField:
         # value p + 5 in limbs (non-canonical but weakly reduced)
         assert _fe_int(_fe1(fe.P + 5)) == 5
 
+    @pytest.mark.parametrize("impl", sorted(fe._MUL_IMPLS))
+    def test_every_mul_impl_matches_oracle(self, impl):
+        """All CBFT_TPU_MUL forms must agree with the big-int oracle —
+        the TPU default (stack) and the f32 form otherwise run only on
+        hardware, never under CI's CPU-platform default (matmul)."""
+        mul = fe._MUL_IMPLS[impl]
+        rng = np.random.default_rng(impl.encode()[0])
+        for _ in range(8):
+            a = int(rng.integers(0, 2**63)) ** 5 % fe.P
+            b = int(rng.integers(0, 2**63)) ** 7 % fe.P
+            got = _fe_int(mul(_fe1(a), _fe1(b)))
+            assert got == a * b % fe.P, impl
+        # chained squarings push the weakly-reduced (non-canonical)
+        # intermediate representation through each impl's bound analysis
+        x = _fe1(fe.P - 2)
+        for _ in range(6):
+            x = mul(x, x)
+        assert _fe_int(x) == pow(fe.P - 2, 2**6, fe.P), impl
+
 
 class TestWireUnpack:
     """Device-side unpack of the compact u32 wire vs independent numpy
