@@ -145,9 +145,10 @@ class TPUBatchVerifier(BatchVerifier):
     secp256k1, and sr25519 entries each go to their own batch kernel;
     anything else falls back to serial CPU verification in place. Each
     partition applies its own routing floor, scaled to its CPU
-    fallback's speed: ed25519 512 (measured tunnel crossover),
-    secp256k1 128 (OpenSSL ECDSA fallback), sr25519 4 (pure-Python
-    fallback, ~ms/sig — the device wins almost immediately)."""
+    fallback's speed: ed25519 1024 (measured tunnel crossover under the
+    slower observed link floor), secp256k1 128 (OpenSSL ECDSA
+    fallback), sr25519 4 (pure-Python fallback, ~ms/sig — the device
+    wins almost immediately)."""
 
     def __init__(
         self,
@@ -168,26 +169,34 @@ class TPUBatchVerifier(BatchVerifier):
 
         self._items: List[Tuple[PubKey, bytes, bytes]] = []
         # Below min_batch the device dispatch + host packing dominates and
-        # the CPU path is simply faster. Round-5 on-chip measurement
-        # (tools/tpu_smallbatch.py, TPU v5e tunnel, stack mul + device
-        # hash): device 39.1 ms vs CPU 31.2 ms at 256 sigs, 54.1 ms vs
-        # 62.3 ms at 512 — crossover 512, set by the tunnel's ~40 ms
-        # per-dispatch round-trip floor, not by compute (the kernel
-        # itself runs 4096 sigs in 0.22 ms). Small commits (150
-        # validators) therefore verify on CPU even under the "tpu"
-        # backend — the hybrid IS the design, the device earns its
-        # round-trip only at scale. CBFT_TPU_MIN_BATCH retunes the
-        # routing from config when a kernel change moves the crossover,
-        # without a code change.
+        # the CPU path is simply faster. Round-5 on-chip measurements
+        # (tools/tpu_smallbatch.py, TPU v5e tunnel, compact wire): the
+        # tunnel's per-dispatch round-trip floor jitters between
+        # sessions (~40 ms one session, ~65-75 ms the next —
+        # LINK_PROBE.json), putting the measured crossover at 512 in
+        # the fast session and 1024 in the slow one (512: 72.7 ms
+        # device vs 65.1 ms CPU; 1024: 64.7 vs 113.8 —
+        # SMALLBATCH_onchip.jsonl). Default to the conservative 1024:
+        # batches the device might lose stay on CPU, and the cost of
+        # routing a 512-sig batch to CPU under a fast link is a few ms.
+        # Compute is never the limit (the kernel runs 4096 sigs in
+        # 0.12 ms). Small commits (150 validators) therefore verify on
+        # CPU even under the "tpu" backend — the hybrid IS the design,
+        # the device earns its round-trip only at scale.
+        # CBFT_TPU_MIN_BATCH retunes the routing from config when the
+        # link or a kernel change moves the crossover, without a code
+        # change.
         if min_batch is None:
-            min_batch = int(os.environ.get("CBFT_TPU_MIN_BATCH", "512"))
+            min_batch = int(os.environ.get("CBFT_TPU_MIN_BATCH", "1024"))
         self._min_batch = min_batch
         # The non-ed curves split by the speed of their CPU fallback:
         # sr25519's is pure-Python big-int (~ms/sig) so the device wins
         # almost immediately (floor 4); secp256k1 routes through OpenSSL
-        # ECDSA (~3.7k sigs/s measured) so the tunnel's ~40 ms dispatch
-        # floor prices the device out below ~128 sigs — estimated from
-        # the ed25519 crossover measurement, overridable per curve.
+        # ECDSA (~3.7k sigs/s measured) so the dispatch floor prices the
+        # device out for small batches — estimated from the ed25519
+        # crossover scaled by the CPU rates, under the SLOW observed
+        # link floor (~70 ms × 3.7k/s ≈ 260 sigs), matching the
+        # conservative ed25519 default above; overridable per curve.
         if slow_curve_min_batch is None:
             slow_curve_min_batch = int(
                 os.environ.get("CBFT_TPU_SLOW_CURVE_MIN_BATCH", "4")
@@ -195,7 +204,7 @@ class TPUBatchVerifier(BatchVerifier):
         self._slow_curve_min_batch = slow_curve_min_batch
         if secp_min_batch is None:
             secp_min_batch = int(
-                os.environ.get("CBFT_TPU_SECP_MIN_BATCH", "128")
+                os.environ.get("CBFT_TPU_SECP_MIN_BATCH", "256")
             )
         self._secp_min_batch = secp_min_batch
 

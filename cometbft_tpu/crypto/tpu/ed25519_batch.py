@@ -500,7 +500,7 @@ def warmup(sizes: Optional[Sequence[int]] = None) -> None:
 
         from cometbft_tpu.crypto.tpu import mesh as mesh_mod
 
-        floor = int(os.environ.get("CBFT_TPU_MIN_BATCH", "512"))
+        floor = int(os.environ.get("CBFT_TPU_MIN_BATCH", "1024"))
         cap = mesh_mod.chunk_cap(_MAX_CHUNK, _MIN_PAD)
         lo = _MIN_PAD
         while lo < min(floor, cap):
@@ -523,19 +523,25 @@ def verify_batch(
     msgs: Sequence[bytes],
     sigs: Sequence[bytes],
 ) -> List[bool]:
-    """Public entry used by crypto.batch.TPUBatchVerifier."""
+    """Public entry used by crypto.batch.TPUBatchVerifier. Packing runs
+    per dispatch chunk (the callable form of dispatch_batch) so the host
+    hashing of chunk i+1 overlaps the device's work on chunk i."""
     n = len(pub_keys)
     if n == 0:
         return []
     device_hash = hash_mode() == "device"
-    if device_hash:
-        (*packed, valid) = prepare_batch_device_hash(pub_keys, msgs, sigs)
-        kernel = verify_full_kernel
-    else:
-        (*packed, valid) = prepare_batch(pub_keys, msgs, sigs)
-        kernel = verify_kernel
+    prepare = prepare_batch_device_hash if device_hash else prepare_batch
+    kernel = verify_full_kernel if device_hash else verify_kernel
+    valid_full = np.ones(n, bool)
+
+    def chunk_pack(start: int, end: int):
+        (*packed, valid) = prepare(
+            pub_keys[start:end], msgs[start:end], sigs[start:end]
+        )
+        valid_full[start:end] = valid
+        return packed
 
     from cometbft_tpu.crypto.tpu import mesh as mesh_mod
 
-    out = mesh_mod.dispatch_batch(kernel, packed, n, _MAX_CHUNK, _MIN_PAD)
-    return list(out & valid)
+    out = mesh_mod.dispatch_batch(kernel, chunk_pack, n, _MAX_CHUNK, _MIN_PAD)
+    return list(out & valid_full)

@@ -249,7 +249,17 @@ def _mul_f32(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     hb = jnp.stack([b & 0x7F, b >> 7], axis=1).reshape((h,) + b.shape[1:])
     prod = ha.astype(jnp.float32)[:, None] * hb.astype(jnp.float32)[None, :]
     prod = prod.reshape((h * h,) + prod.shape[2:])
-    grouped = jnp.asarray(_F32_SCATTER) @ prod  # [3·34, B], exact
+    # precision=HIGHEST is load-bearing: the TPU MXU's default f32
+    # matmul truncates inputs to bf16 (8-bit mantissa), which silently
+    # breaks the ≤2^21 exactness bound — caught on chip by the r5 bench
+    # ("benchmark batch must verify" under CBFT_TPU_MUL=f32). HIGHEST
+    # selects the multi-pass f32 algorithm, restoring the full 24-bit
+    # mantissa the proof needs.
+    grouped = jnp.matmul(
+        jnp.asarray(_F32_SCATTER),
+        prod,
+        precision=lax.Precision.HIGHEST,
+    )  # [3·34, B], exact
     gi = grouped.astype(jnp.int32)
     c0, c1, c2 = gi[:h], gi[h : 2 * h], gi[2 * h :]
     # recombine the three sub-shift groups into radix-2^15 columns:

@@ -144,11 +144,18 @@ def chunk_cap(default: int, min_pad: int) -> int:
 
 def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
     """Shared chunk-pad-dispatch loop for batch verify kernels (used by
-    both the ed25519 and secp256k1 entries): pads each chunk's trailing
-    batch axis to a power of two (rounded to equal per-device shards),
-    shards over the mesh when >1 device is visible, and gathers the
-    boolean masks. Dispatches every chunk before collecting any, so
-    device work overlaps host packing."""
+    all three curve entries): pads each chunk's trailing batch axis to a
+    power of two (rounded to equal per-device shards), shards over the
+    mesh when >1 device is visible, and gathers the boolean masks.
+    Dispatches every chunk before collecting any, so device work
+    overlaps host packing.
+
+    `packed` is either a list of pre-packed arrays (trailing axis = the
+    full batch) or a callable ``(start, end) -> list`` producing one
+    chunk's arrays on demand — the callable form lets the caller's host
+    packing (SHA-512 hashing, merlin transcripts, scalar inversions) for
+    chunk i+1 overlap the device's transfer+compute of chunk i, since
+    jax dispatch returns before the result is ready."""
     import numpy as np
 
     max_chunk = chunk_cap(max_chunk, min_pad)
@@ -157,6 +164,10 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
     pending = []
     for start in range(0, n, max_chunk):
         end = min(start + max_chunk, n)
+        if callable(packed):
+            chunk = packed(start, end)
+        else:
+            chunk = [a[..., start:end] for a in packed]
         size = min_pad
         while size < end - start:
             size *= 2
@@ -165,10 +176,10 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
 
         def pad(a):
             padded = np.zeros(a.shape[:-1] + (size,), a.dtype)
-            padded[..., : end - start] = a[..., start:end]
+            padded[..., : end - start] = a
             return padded
 
-        padded_args = [pad(a) for a in packed]
+        padded_args = [pad(a) for a in chunk]
         if ndev > 1:
             mask = sharded_verify(kernel, padded_args)
         else:

@@ -6,7 +6,9 @@ partitioned by curve. Same architecture as ed25519_batch: every
 signature is a lane, limb-major [19, B] field elements (secp_field), a
 joint radix-4 Straus double-scalar multiplication u1·G + u2·Q over 128
 2-bit digit rows, one-hot table selection, no data-dependent control
-flow.
+flow. The wire format is compact (one u32[32,B] buffer of raw LE words
+plus an int32[B] flag vector — 132 bytes/sig); limb splitting and digit
+extraction run on device, mirroring ed25519_batch.unpack_wire.
 
 Point arithmetic uses the Renes–Costello–Batina COMPLETE addition
 formulas for a = 0 curves (Algorithm 7; b3 = 3·7 = 21) in homogeneous
@@ -143,8 +145,60 @@ def _select_point(entries: List[Point], idx: jnp.ndarray) -> Point:
     return tuple(out)
 
 
-@jax.jit
-def verify_kernel(
+def unpack_fe_limbs(words: jnp.ndarray) -> jnp.ndarray:
+    """u32[8,B] little-endian words → int32[19,B] radix-14 limbs of the
+    full 256-bit value (limb 18 holds bits 252..255). Device-side
+    equivalent of fe.bytes_be_to_limbs_np so the wire ships 32 raw bytes
+    per field element instead of 76 bytes of pre-split limbs (same
+    link-bandwidth rationale as ed25519_batch.unpack_fe_limbs)."""
+    limbs = []
+    for i in range(fe.NUM_LIMBS):
+        bit = fe.RADIX * i
+        j, k = bit // 32, bit % 32
+        w = words[j] >> k
+        if k > 32 - fe.RADIX and j + 1 < 8:  # limb spans into next word
+            w = w | (words[j + 1] << (32 - k))
+        limbs.append((w & jnp.uint32(0x3FFF)).astype(jnp.int32))
+    return jnp.stack(limbs, axis=0)
+
+
+def unpack_digits(words: jnp.ndarray) -> jnp.ndarray:
+    """u32[8,B] little-endian scalar words → int32[128,B] 2-bit digits,
+    MSB first (a digit at an even bit offset never crosses a word)."""
+    digs = []
+    for d in range(NUM_DIGITS):
+        bit = 2 * (NUM_DIGITS - 1 - d)
+        j, k = bit // 32, bit % 32
+        digs.append(((words[j] >> k) & jnp.uint32(3)).astype(jnp.int32))
+    return jnp.stack(digs, axis=0)
+
+
+_N_FE = fe.const_fe(N)
+
+
+def _verify_core(wire: jnp.ndarray, flags: jnp.ndarray) -> jnp.ndarray:
+    """bool[B] from the compact wire — u32[32,B] (rows 0:8 qx, 8:16 r,
+    16:24 u1, 24:32 u2, all LE words) + int32[B] flags (bit 0 = pubkey
+    prefix parity, bit 1 = r + n < p). 132 bytes/sig on the link instead
+    of the ~1,257 bytes/sig the pre-split limb+digit arrays cost; limb
+    split, digit extraction, and the r + n second x-candidate all happen
+    on device."""
+    qx = unpack_fe_limbs(wire[0:8])
+    r_fe = unpack_fe_limbs(wire[8:16])
+    rn_fe = fe.add(r_fe, jnp.asarray(_N_FE))
+    u1_digits = unpack_digits(wire[16:24])
+    u2_digits = unpack_digits(wire[24:32])
+    q_parity = (flags & 1).astype(jnp.int32)
+    rn_ok = (flags & 2) != 0
+    return _verify_math(
+        qx, q_parity, r_fe, rn_fe, rn_ok, u1_digits, u2_digits
+    )
+
+
+verify_kernel = jax.jit(_verify_core)
+
+
+def _verify_math(
     qx: jnp.ndarray,  # int32[19,B]  pubkey x limbs
     q_parity: jnp.ndarray,  # int32[B]  compressed-prefix parity
     r_fe: jnp.ndarray,  # int32[19,B]  r as a field element
@@ -197,12 +251,9 @@ _MAX_CHUNK = 4096
 
 
 
-def _digits_msb_first_be(scalars: np.ndarray) -> np.ndarray:
-    """uint8[B,32] BIG-endian scalars → int32[128,B] 2-bit digits, MSB
-    first."""
-    bits = np.unpackbits(scalars, axis=-1)  # [B,256] MSB first
-    digits = 2 * bits[..., 0::2] + bits[..., 1::2]  # [B,128] MSB first
-    return np.ascontiguousarray(digits.astype(np.int32).T)
+def _le_words(arr_u8: np.ndarray) -> np.ndarray:
+    """u8[B,32] → u32[8,B] little-endian words."""
+    return np.ascontiguousarray(np.ascontiguousarray(arr_u8).view("<u4").T)
 
 
 def prepare_batch(
@@ -211,16 +262,18 @@ def prepare_batch(
     sigs: Sequence[bytes],
 ):
     """Host packing + the structural checks the CPU verifier applies
-    before any curve math (lengths, prefix, x < p, r/s ranges, low-S)."""
+    before any curve math (lengths, prefix, x < p, r/s ranges, low-S).
+    → (wire u32[32,B], flags int32[B], valid): raw little-endian words
+    of qx, r, u1, u2 — the limb/digit splits run on device
+    (unpack_fe_limbs / unpack_digits), so the link carries 132 bytes/sig
+    instead of ~1,257."""
     n = len(pub_keys)
     valid = np.ones(n, bool)
     qx_b = np.zeros((n, 32), np.uint8)
-    parity = np.zeros(n, np.int32)
     r_b = np.zeros((n, 32), np.uint8)
-    rn_b = np.zeros((n, 32), np.uint8)
-    rn_ok = np.zeros(n, bool)
     u1_b = np.zeros((n, 32), np.uint8)
     u2_b = np.zeros((n, 32), np.uint8)
+    flags = np.zeros(n, np.int32)
     for i in range(n):
         pk, sig = pub_keys[i], sigs[i]
         if len(pk) != 33 or pk[0] not in (2, 3) or len(sig) != 64:
@@ -234,23 +287,22 @@ def prepare_batch(
             continue
         e = int.from_bytes(hashlib.sha256(bytes(msgs[i])).digest(), "big") % N
         w = pow(s, -1, N)
-        u1_b[i] = np.frombuffer((e * w % N).to_bytes(32, "big"), np.uint8)
-        u2_b[i] = np.frombuffer((r * w % N).to_bytes(32, "big"), np.uint8)
-        qx_b[i] = np.frombuffer(bytes(pk[1:]), np.uint8)
-        parity[i] = pk[0] & 1
-        r_b[i] = np.frombuffer(bytes(sig[:32]), np.uint8)
-        if r + N < P:
-            rn_ok[i] = True
-            rn_b[i] = np.frombuffer((r + N).to_bytes(32, "big"), np.uint8)
+        u1_b[i] = np.frombuffer((e * w % N).to_bytes(32, "little"), np.uint8)
+        u2_b[i] = np.frombuffer((r * w % N).to_bytes(32, "little"), np.uint8)
+        qx_b[i] = np.frombuffer(x.to_bytes(32, "little"), np.uint8)
+        r_b[i] = np.frombuffer(r.to_bytes(32, "little"), np.uint8)
+        flags[i] = (pk[0] & 1) | (2 if r + N < P else 0)
 
-    qx = np.ascontiguousarray(fe.bytes_be_to_limbs_np(qx_b).T)
-    r_fe_arr = np.ascontiguousarray(fe.bytes_be_to_limbs_np(r_b).T)
-    rn_fe_arr = np.ascontiguousarray(fe.bytes_be_to_limbs_np(rn_b).T)
-    u1_digits = _digits_msb_first_be(u1_b)
-    u2_digits = _digits_msb_first_be(u2_b)
-    return (
-        qx, parity, r_fe_arr, rn_fe_arr, rn_ok, u1_digits, u2_digits, valid
+    wire = np.concatenate(
+        [
+            _le_words(qx_b),
+            _le_words(r_b),
+            _le_words(u1_b),
+            _le_words(u2_b),
+        ],
+        axis=0,
     )
+    return wire, flags, valid
 
 
 def verify_batch(
@@ -264,8 +316,18 @@ def verify_batch(
     n = len(pub_keys)
     if n == 0:
         return []
-    (*packed, valid) = prepare_batch(pub_keys, msgs, sigs)
+    valid_full = np.ones(n, bool)
+
+    def chunk_pack(start: int, end: int):
+        # per-chunk packing: the host's scalar inversions for chunk i+1
+        # overlap the device's work on chunk i (dispatch is async)
+        (*packed, valid) = prepare_batch(
+            pub_keys[start:end], msgs[start:end], sigs[start:end]
+        )
+        valid_full[start:end] = valid
+        return packed
+
     out = mesh_mod.dispatch_batch(
-        verify_kernel, packed, n, _MAX_CHUNK, _MIN_PAD
+        verify_kernel, chunk_pack, n, _MAX_CHUNK, _MIN_PAD
     )
-    return list(out & valid)
+    return list(out & valid_full)

@@ -220,8 +220,19 @@ def verify_batch(
     n = len(pub_keys)
     if n == 0:
         return []
-    (*packed, valid) = prepare_batch(pub_keys, msgs, sigs)
+    valid_full = np.ones(n, bool)
+
+    def chunk_pack(start: int, end: int):
+        # per-chunk packing: the merlin transcripts (the expensive host
+        # step — pure-Python STROBE) for chunk i+1 overlap the device's
+        # work on chunk i (dispatch is async)
+        (*packed, valid) = prepare_batch(
+            pub_keys[start:end], msgs[start:end], sigs[start:end]
+        )
+        valid_full[start:end] = valid
+        return packed
+
     out = mesh_mod.dispatch_batch(
-        verify_kernel, packed, n, _MAX_CHUNK, _MIN_PAD
+        verify_kernel, chunk_pack, n, _MAX_CHUNK, _MIN_PAD
     )
-    return list(out & valid)
+    return list(out & valid_full)

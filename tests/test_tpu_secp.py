@@ -181,3 +181,59 @@ class TestMixedCurveBatch:
         ok, mask = bv.verify()
         assert mask == expect
         assert not ok
+
+
+class TestCompactWireUnpack:
+    """Device-side unpack of the compact secp wire vs independent
+    oracles — the wire is the dispatch ABI; a bit-slip corrupts every
+    lane (same contract as the ed25519 unpack tests)."""
+
+    def test_fe_limbs_match_int_oracle(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(17)
+        raw = rng.integers(0, 256, size=(9, 32)).astype(np.uint8)
+        words = jnp.asarray(secp256k1_batch._le_words(raw))
+        got = np.asarray(secp256k1_batch.unpack_fe_limbs(words))
+        for b in range(raw.shape[0]):
+            val = int.from_bytes(raw[b].tobytes(), "little")
+            assert F.limbs_to_int(got[:, b]) == val, b
+            assert all(0 <= int(v) < 2**F.RADIX for v in got[:, b])
+        # cross-check against the host limb oracle (expects BE bytes)
+        want = F.bytes_be_to_limbs_np(raw[:, ::-1]).T
+        assert (got == want).all()
+
+    def test_digits_match_bit_oracle(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(19)
+        raw = rng.integers(0, 256, size=(7, 32)).astype(np.uint8)
+        words = jnp.asarray(secp256k1_batch._le_words(raw))
+        got = np.asarray(secp256k1_batch.unpack_digits(words))
+        bits = np.unpackbits(raw, axis=-1, bitorder="little")
+        digits = bits[:, 0:256:2] + 2 * bits[:, 1:256:2]  # LSB-first pairs
+        want = np.ascontiguousarray(digits[:, ::-1].astype(np.int32).T)
+        assert (got == want).all()
+
+    def test_flags_encode_parity_and_rn(self):
+        k = secp.gen_priv_key()
+        m = b"wire flags"
+        sig = k.sign(m)
+        pk = k.pub_key().bytes()
+        wire, flags, valid = secp256k1_batch.prepare_batch(
+            [pk], [m], [sig]
+        )
+        assert valid[0]
+        assert wire.shape == (32, 1) and wire.dtype == np.uint32
+        assert int(flags[0]) & 1 == pk[0] & 1
+        r = int.from_bytes(sig[:32], "big")
+        assert bool(int(flags[0]) & 2) == (r + F.N < F.P)
+        # wire rows carry qx, r, u1, u2 as raw LE words
+        qx = int.from_bytes(
+            np.asarray(wire[0:8, 0]).astype("<u4").tobytes(), "little"
+        )
+        assert qx == int.from_bytes(pk[1:], "big")
+        r_w = int.from_bytes(
+            np.asarray(wire[8:16, 0]).astype("<u4").tobytes(), "little"
+        )
+        assert r_w == r
