@@ -190,16 +190,32 @@ def _stage_run():
     out = {}
     best_overall = 0.0
     sweep = SWEEP
+    passes = 2
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         # the fallback exists to guarantee A number: one modest shape,
         # compiled with the fast matmul mul form (field.default_mul_impl)
         sweep = (1024,)
-    for batch in sweep:
-        rate = _time_verify_batch(*_make_batch(batch))
-        out[str(batch)] = round(rate, 1)
-        best_overall = max(best_overall, rate)
-        # emit incrementally: a timeout mid-sweep still leaves numbers
-        print(json.dumps({"sigs_per_sec": best_overall, "sweep": out}), flush=True)
+        passes = 1
+    # Two sweep passes with a gap, per-size max: the tunneled link's
+    # throughput varies ~15x between minute-scale windows (measured
+    # 4.5k vs 69k sigs/s within one session), and min-of-3 reps inside
+    # one window cannot see past it. The inter-pass pause pushes pass 2
+    # into a different window; a slow window must now last the whole
+    # stage to poison the headline. If the pause+pass 2 overruns the
+    # stage timeout, the incremental emits preserve pass 1's numbers.
+    batches = {batch: _make_batch(batch) for batch in sweep}
+    for pass_idx in range(passes):
+        if pass_idx:
+            time.sleep(45)
+        for batch in sweep:
+            rate = _time_verify_batch(*batches[batch])
+            out[str(batch)] = max(out.get(str(batch), 0.0), round(rate, 1))
+            best_overall = max(best_overall, rate)
+            # emit incrementally: a timeout mid-sweep still leaves numbers
+            print(
+                json.dumps({"sigs_per_sec": best_overall, "sweep": out}),
+                flush=True,
+            )
 
 
 def _stage_p50():

@@ -313,10 +313,10 @@ class ProcessTestnet(NetObserver):
         for r in self.inbound_relays.values():
             r.set_enabled(True)
         # nudge re-dials until the healed node actually HAS peers: the
-        # switch's persistent reconnect budget is finite (~20 attempts),
-        # so a long partition window can exhaust it, and a single
-        # dial_peers burst can race a busy RPC on a loaded host —
-        # mirror the operator's repeated `dial_peers` move
+        # switch's own reconnect (quick attempts + exponential backoff)
+        # heals organically, but on a starved CI host its sleeps stretch
+        # and a single dial_peers burst can race a busy RPC — mirror the
+        # operator's repeated `dial_peers` move as belt-and-braces
         deadline = time.monotonic() + reconnect_timeout
         while time.monotonic() < deadline:
             for a in range(self.n):

@@ -220,12 +220,30 @@ class Switch(BaseService):
                 return
             self.reconnecting[addr.id] = True
         try:
-            for attempt in range(RECONNECT_ATTEMPTS):
-                if not self.is_running():
-                    return
-                time.sleep(
-                    self.reconnect_interval * (1 + random.random() * 0.2)
+            # delay schedule: RECONNECT_ATTEMPTS quick constant intervals,
+            # then an exponential phase (reference: p2p/switch.go
+            # reconnectToPeer's second loop) — a persistent peer cut off
+            # longer than the quick window (a real partition, not a blip)
+            # keeps getting re-dialed on a growing interval instead of
+            # being abandoned to the PEX ensure-peers cycle
+            delays = [self.reconnect_interval] * RECONNECT_ATTEMPTS
+            backoff = RECONNECT_BACK_OFF_BASE * self.reconnect_interval
+            for _ in range(RECONNECT_BACK_OFF_ATTEMPTS):
+                delays.append(min(backoff, 30.0))
+                backoff *= 1.7
+            for delay in delays:
+                # sleep in short slices so stop() releases this thread
+                # promptly even mid-backoff (late sleeps reach 30s)
+                deadline = time.monotonic() + delay * (
+                    1 + random.random() * 0.2
                 )
+                while True:
+                    if not self.is_running():
+                        return
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    time.sleep(min(0.25, left))
                 try:
                     self.dial_peer_with_address(addr)
                     return

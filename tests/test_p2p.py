@@ -428,6 +428,47 @@ class TestSwitch:
             sw1.stop()
             sw2.stop()
 
+    def test_persistent_peer_reconnects_after_quick_window(self):
+        """An outage longer than the quick reconnect window must still
+        heal via the exponential backoff phase (reference: the second
+        loop of p2p/switch.go reconnectToPeer) — this is the partition
+        case, where every quick attempt fails before the link returns."""
+        nk, info = _node()
+        t1 = MultiplexTransport(info, nk)
+        t1.listen(NetAddress("", "127.0.0.1", 0))
+        port = t1.listen_addr.port
+        info.listen_addr = f"127.0.0.1:{port}"
+        sw1 = Switch(t1, reconnect_interval=0.1)
+        sw1.add_reactor("echo", EchoReactor([0x01, 0x02]))
+        sw2, _ = _make_switch()
+        sw1.start()
+        sw2.start()
+        sw1b = None
+        try:
+            addr = sw1.transport.listen_addr
+            sw2.add_persistent_peers([str(addr)])
+            sw2.dial_peers_async([addr])
+            _wait(lambda: sw2.peers.size() == 1)
+            sw1.stop()  # outage: listener gone, every dial fails
+            _wait(lambda: sw2.peers.size() == 0, timeout=10)
+            # outlast the quick window (20 x 0.1s x 1.2 jitter + dial
+            # overhead < 4s) so only the backoff phase can heal this;
+            # then PROVE the quick phase is spent before resurrecting
+            time.sleep(5.0)
+            assert sw2.peers.size() == 0, "reconnected with no listener?"
+            # resurrect the peer on the SAME identity and port
+            t1b = MultiplexTransport(info, nk)
+            t1b.listen(NetAddress("", "127.0.0.1", port))
+            sw1b = Switch(t1b, reconnect_interval=0.1)
+            sw1b.add_reactor("echo", EchoReactor([0x01, 0x02]))
+            sw1b.start()
+            _wait(lambda: sw2.peers.size() == 1, timeout=25, interval=0.1)
+        finally:
+            _safe_stop(sw1)
+            if sw1b is not None:
+                _safe_stop(sw1b)
+            _safe_stop(sw2)
+
 
 def _safe_stop(svc):
     """Stop tolerating the race where the error path already stopped it."""
