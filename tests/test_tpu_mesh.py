@@ -54,3 +54,51 @@ class TestMeshDispatch:
         monkeypatch.delenv("CBFT_TPU_COORDINATOR", raising=False)
         monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
         assert mesh.maybe_init_distributed() is False
+
+
+class TestDispatchChunking:
+    """The callable `packed` form (per-chunk packing for host/device
+    overlap) must chunk, pad, and reassemble identically to the
+    pre-packed array form, including the multi-chunk path."""
+
+    def _toy_kernel(self):
+        import jax
+
+        @jax.jit
+        def parity_kernel(rows):
+            # bool[B]: even column sums — shape-preserving stand-in for a
+            # verify kernel ([R, B] in, bool[B] out)
+            return (rows.sum(axis=0) % 2) == 0
+
+        return parity_kernel
+
+    def test_callable_matches_array_form_across_chunks(self, monkeypatch):
+        monkeypatch.delenv("CBFT_TPU_MAX_CHUNK", raising=False)
+        kernel = self._toy_kernel()
+        rng = np.random.default_rng(23)
+        n = 50  # > max_chunk=16 → 4 chunks, last one ragged
+        full = rng.integers(0, 100, size=(3, n)).astype(np.int32)
+
+        got_arrays = mesh.dispatch_batch(kernel, [full], n, 16, 8)
+
+        calls = []
+
+        def chunk_pack(start, end):
+            calls.append((start, end))
+            return [full[:, start:end]]
+
+        got_callable = mesh.dispatch_batch(kernel, chunk_pack, n, 16, 8)
+        want = (full.sum(axis=0) % 2) == 0
+        assert (got_arrays == want).all()
+        assert (got_callable == want).all()
+        assert calls == [(0, 16), (16, 32), (32, 48), (48, 50)]
+
+    def test_padding_never_leaks_into_results(self, monkeypatch):
+        # padded lanes compute kernel(0-columns) = True here; the slice
+        # back to [start:end) must drop them even when the final chunk is
+        # a single lane
+        monkeypatch.delenv("CBFT_TPU_MAX_CHUNK", raising=False)
+        kernel = self._toy_kernel()
+        ones = np.ones((2, 17), np.int32)  # column sum 2 → even → True
+        out = mesh.dispatch_batch(kernel, [ones], 17, 16, 8)
+        assert out.shape == (17,) and out.all()

@@ -24,12 +24,31 @@ echo "[watch] start $(date -u +%H:%M:%S)" >> "$LOG"
 while true; do
   if timeout 90 python3 -c "import jax; d=jax.devices(); assert d[0].platform=='tpu', d" >> "$LOG" 2>&1; then
     echo "[watch] tunnel UP $(date -u +%H:%M:%S) — running bench" >> "$LOG"
-    if timeout 3000 python3 bench.py > "$OUT.tmp" 2>> "$LOG"; then
-      cat "$OUT.tmp" >> BENCH_onchip_history.jsonl
-      python3 - "$OUT" "$OUT.tmp" <<'PYEOF' >> "$LOG" 2>&1
+    timeout 3000 python3 bench.py > "$OUT.tmp" 2>> "$LOG"
+    BENCH_RC=$?
+    echo "[watch] bench done $(date -u +%H:%M:%S) rc=$BENCH_RC" >> "$LOG"
+    python3 - "$OUT" "$OUT.tmp" "$BENCH_RC" <<'PYEOF' >> "$LOG" 2>&1
 import json, os, shutil, sys
-cur, new = sys.argv[1], sys.argv[2]
-new_v = json.load(open(new)).get("value", 0) or 0
+cur, new, rc = sys.argv[1], sys.argv[2], int(sys.argv[3])
+try:
+    doc = json.load(open(new))
+except Exception as exc:
+    doc = None
+    print(f"[watch] bench output unparseable ({exc}); tmp discarded")
+if rc != 0 or doc is None:
+    os.path.exists(new) and os.remove(new)
+    sys.exit(0)
+# only genuinely on-chip results enter the on-chip history / headline:
+# bench.py renames the metric to ..._cpu-fallback / ..._cpu-serial-floor
+# when the device plane never engaged
+if not str(doc.get("metric", "")).endswith("_tpu"):
+    os.remove(new)
+    print(f"[watch] bench fell back ({doc.get('metric')}); not on-chip, "
+          "tmp discarded")
+    sys.exit(0)
+with open("BENCH_onchip_history.jsonl", "a") as f:
+    f.write(json.dumps(doc) + "\n")
+new_v = doc.get("value", 0) or 0
 cur_v = 0
 if os.path.exists(cur):
     try:
@@ -44,8 +63,6 @@ else:
     print(f"[watch] slow window ({new_v} < {cur_v}); probe kept, "
           "full result in history")
 PYEOF
-    fi
-    echo "[watch] bench done $(date -u +%H:%M:%S) rc=$?" >> "$LOG"
     timeout 600 python3 tools/tpu_link_probe.py > LINK_PROBE.json.tmp 2>> "$LOG" \
       && mv LINK_PROBE.json.tmp LINK_PROBE.json
     echo "[watch] link probe done $(date -u +%H:%M:%S) rc=$?" >> "$LOG"
