@@ -150,6 +150,17 @@ class ConsensusState(BaseService):
 
     def on_stop(self) -> None:
         self.ticker.stop()
+        # The WAL must outlive the receive routine (the reference stops
+        # the WAL from receiveRoutine's exit path): a finalize in flight
+        # still needs write_sync(#ENDHEIGHT) to LAND on disk — stopping
+        # the WAL first silently drops the marker while apply_block goes
+        # on to persist state, leaving durable state AHEAD of the WAL,
+        # and the next start refuses catchup_replay ("WAL has no
+        # #ENDHEIGHT h-1"). is_running() is already False here (service
+        # stop order), so the routine exits within one iteration.
+        t = getattr(self, "_receive_thread", None)
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=60.0)
         if not isinstance(self.wal, NilWAL):
             try:
                 self.wal.stop()

@@ -271,6 +271,55 @@ class TPUBatchVerifier(BatchVerifier):
         return all(final), final
 
 
+def resident_commit_eligible(
+    n_present: int, backend: Optional[str] = None
+) -> bool:
+    """Cheap pre-check for the resident commit path, so callers on the
+    cpu backend (or below the floor) never pay the O(n_validators)
+    key-type scan and pk-bytes build that verify_commit_valset needs."""
+    name = backend or _default_backend
+    if name != "tpu":
+        return False
+    if n_present < int(os.environ.get("CBFT_TPU_MIN_BATCH", "1024")):
+        return False
+    return device_plane_ok()
+
+
+def verify_commit_valset(
+    pub_keys: List[bytes],
+    msgs: List[Optional[bytes]],
+    sigs: List[Optional[bytes]],
+    backend: Optional[str] = None,
+) -> Optional[List[bool]]:
+    """Device-resident full-lane commit verification (the valset's
+    pubkey rows live on device across heights — ed25519_batch's
+    verify_valset_resident). Returns a per-lane mask, or None when the
+    shape is ineligible and the caller should fall back to the
+    add()/verify() protocol.
+
+    Eligibility: the tpu backend is selected, the device plane answers,
+    and the PRESENT lane count clears the ed25519 routing floor (below
+    it the CPU wins the round trip regardless — crypto/batch.py
+    min_batch rationale). Callers guarantee every pub_key is an ed25519
+    key (32 bytes); msgs[i]/sigs[i] None marks an absent lane, reported
+    False and skipped by the caller."""
+    name = backend or _default_backend
+    if name != "tpu":
+        return None
+    present = sum(1 for m in msgs if m is not None)
+    floor = int(os.environ.get("CBFT_TPU_MIN_BATCH", "1024"))
+    if present < floor:
+        return None
+    if not device_plane_ok():
+        return None
+    import hashlib
+
+    from cometbft_tpu.crypto.tpu import ed25519_batch
+
+    valset_id = hashlib.sha256(b"".join(pub_keys)).digest()
+    return ed25519_batch.verify_valset_resident(valset_id, pub_keys, msgs, sigs)
+
+
 # ---------------------------------------------------------------------------
 # Backend registry + default selection (config [crypto] backend)
 # ---------------------------------------------------------------------------

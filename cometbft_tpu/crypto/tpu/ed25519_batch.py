@@ -48,6 +48,7 @@ multiplication — >99% of the FLOPs — is what the TPU executes.
 from __future__ import annotations
 
 import hashlib
+from collections import OrderedDict
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -368,6 +369,17 @@ def _le_words(arr_u8: np.ndarray) -> np.ndarray:
 _L_BYTES_LE = np.frombuffer(L.to_bytes(32, "little"), np.uint8)
 
 
+def _s_below_l(s_arr: np.ndarray) -> np.ndarray:
+    """bool[B]: s < L, compared little-endian from the most significant
+    byte down (u8[B,32] in)."""
+    n = s_arr.shape[0]
+    diff = s_arr.astype(np.int16) - _L_BYTES_LE.astype(np.int16)
+    nz_mask = diff != 0
+    has_diff = nz_mask.any(axis=1)
+    msb_idx = 31 - nz_mask[:, ::-1].argmax(axis=1)
+    return has_diff & (diff[np.arange(n), msb_idx] < 0)
+
+
 def _parse_inputs(pub_keys, sigs):
     """→ (pk_arr u8[B,32], sig_arr u8[B,64], valid) with wrong-length and
     s ≥ L entries masked out (zero-filled placeholders keep the shapes)."""
@@ -385,30 +397,16 @@ def _parse_inputs(pub_keys, sigs):
             sig_parts.append(sig)
     pk_arr = np.frombuffer(b"".join(pk_parts), np.uint8).reshape(n, 32)
     sig_arr = np.frombuffer(b"".join(sig_parts), np.uint8).reshape(n, 64)
-
-    # s < L, compared little-endian from the most significant byte down
-    s_arr = sig_arr[:, 32:]
-    diff = s_arr.astype(np.int16) - _L_BYTES_LE.astype(np.int16)
-    nz_mask = diff != 0
-    has_diff = nz_mask.any(axis=1)
-    msb_idx = 31 - nz_mask[:, ::-1].argmax(axis=1)
-    valid &= has_diff & (diff[np.arange(n), msb_idx] < 0)
+    valid &= _s_below_l(sig_arr[:, 32:])
     return pk_arr, sig_arr, valid
 
 
-def prepare_batch(
-    pub_keys: Sequence[bytes],
-    msgs: Sequence[bytes],
-    sigs: Sequence[bytes],
-):
-    """Host-side packing for the host-hash mode → (wire u32[32,B], valid).
-
-    The wire buffer carries the raw little-endian words of A, R, S and
-    h = SHA-512(R ‖ A ‖ M) mod L (hashlib C + CPython big-int on the
-    host); limb splitting and digit extraction moved on-device
-    (unpack_wire) so the link carries 128 bytes/sig, not 1,160."""
-    n = len(pub_keys)
-    pk_arr, sig_arr, valid = _parse_inputs(pub_keys, sigs)
+def _challenge_scalars(
+    pk_arr: np.ndarray, sig_arr: np.ndarray, msgs, valid: np.ndarray
+) -> np.ndarray:
+    """h = SHA-512(R ‖ A ‖ M) mod L per valid lane (hashlib C + CPython
+    big-int on the host) → u8[B,32] little-endian."""
+    n = len(msgs)
     h_arr = np.zeros((n, 32), np.uint8)
     sha = hashlib.sha512
     for i in range(n):
@@ -426,6 +424,22 @@ def prepare_batch(
             % L
         )
         h_arr[i] = np.frombuffer(h_int.to_bytes(32, "little"), np.uint8)
+    return h_arr
+
+
+def prepare_batch(
+    pub_keys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+):
+    """Host-side packing for the host-hash mode → (wire u32[32,B], valid).
+
+    The wire buffer carries the raw little-endian words of A, R, S and
+    h = SHA-512(R ‖ A ‖ M) mod L; limb splitting and digit extraction
+    moved on-device (unpack_wire) so the link carries 128 bytes/sig,
+    not 1,160."""
+    pk_arr, sig_arr, valid = _parse_inputs(pub_keys, sigs)
+    h_arr = _challenge_scalars(pk_arr, sig_arr, msgs, valid)
 
     wire = np.concatenate(
         [
@@ -516,6 +530,15 @@ def warmup(sizes: Optional[Sequence[int]] = None) -> None:
         # one entry is enough: dispatch pads the lane axis to `size`
         # only when the batch is that large, so fill the bucket
         verify_batch([pk] * size, [msg] * size, [sig] * size)
+        # same buckets for the valset-resident commit kernel, so the
+        # first real commit under the resident path also loads a warm
+        # executable (the persistent cache keeps it across restarts)
+        vid = hashlib.sha256(b"warmup-valset-%d" % size).digest()
+        verify_valset_resident(
+            vid, [pk] * size, [msg] * size, [sig] * size
+        )
+    # warmup valsets are synthetic: don't hold their rows in HBM/LRU
+    _resident_cache.clear()
 
 
 def verify_batch(
@@ -545,3 +568,165 @@ def verify_batch(
 
     out = mesh_mod.dispatch_batch(kernel, chunk_pack, n, _MAX_CHUNK, _MIN_PAD)
     return list(out & valid_full)
+
+
+# --- valset-resident commit verification ------------------------------------
+# The validator set's pubkeys are identical height after height (the
+# reference re-verifies the SAME valset every commit —
+# types/validator_set.go:685-707), so their wire rows live on device
+# across calls: the per-commit link traffic drops to R ‖ S ‖ h
+# (96 B/sig, 25% less than the full wire) and every height dispatches
+# the same fixed shapes, hitting the same compiled executable. Absent
+# lanes (nil/missing votes) ship zeros and are masked out host-side —
+# full-lane dispatch is what keeps the resident layout stable while
+# the set of signers varies per commit.
+
+
+class _ResidentValset:
+    __slots__ = ("chunks", "pk_arr", "pk_ok")
+
+
+_resident_cache: "OrderedDict[bytes, _ResidentValset]" = OrderedDict()
+_RESIDENT_CACHE_MAX = 4  # ~10k vals x 256B x 4 = 10 MB of HBM at most
+
+
+def _verify_core_resident(a_words: jnp.ndarray, rsh: jnp.ndarray) -> jnp.ndarray:
+    """bool[B] from resident pubkey rows (u32[8,B]) + the per-commit
+    wire (u32[24,B]: rows 0:8 R, 8:16 S, 16:24 h, LE words)."""
+    ay = unpack_fe_limbs(a_words)
+    a_sign = (a_words[7] >> 31).astype(jnp.int32)
+    r_w = rsh[0:8]
+    r_y = unpack_fe_limbs(r_w)
+    r_sign = (r_w[7] >> 31).astype(jnp.int32)
+    s_digits = unpack_digits(rsh[8:16])
+    h_digits = unpack_digits(rsh[16:24])
+    return _verify_unpacked(ay, a_sign, r_y, r_sign, s_digits, h_digits)
+
+
+verify_kernel_resident = jax.jit(_verify_core_resident)
+
+
+def _build_resident(pub_keys: Sequence[bytes]) -> _ResidentValset:
+    """Pad the valset's pubkey rows into the dispatch chunk layout and
+    place them on device (sharded over the mesh when >1 device)."""
+    from cometbft_tpu.crypto.tpu import mesh as mesh_mod
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    n = len(pub_keys)
+    pk_ok = np.ones(n, bool)
+    parts = []
+    for i, pk in enumerate(pub_keys):
+        if len(pk) != 32:
+            pk_ok[i] = False
+            parts.append(b"\x00" * 32)
+        else:
+            parts.append(bytes(pk))
+    pk_arr = np.frombuffer(b"".join(parts), np.uint8).reshape(n, 32)
+
+    max_chunk = mesh_mod.chunk_cap(_MAX_CHUNK, _MIN_PAD)
+    ndev = mesh_mod.n_devices()
+    chunks = []
+    for start in range(0, n, max_chunk):
+        end = min(start + max_chunk, n)
+        size = _MIN_PAD
+        while size < end - start:
+            size *= 2
+        if ndev > 1:
+            size = -(-size // ndev) * ndev
+        a_words = np.zeros((8, size), np.uint32)
+        a_words[:, : end - start] = _le_words(pk_arr[start:end])
+        if ndev > 1:
+            sh = NamedSharding(mesh_mod.batch_mesh(), PS(None, "batch"))
+            a_dev = jax.device_put(jnp.asarray(a_words), sh)
+        else:
+            a_dev = jax.device_put(jnp.asarray(a_words))
+        chunks.append((start, end, size, a_dev))
+
+    rv = _ResidentValset()
+    rv.chunks = chunks
+    rv.pk_arr = pk_arr
+    rv.pk_ok = pk_ok
+    return rv
+
+
+def _prepare_rsh(pk_arr: np.ndarray, msgs, sigs):
+    """Per-commit host packing for one resident chunk: msgs[i]/sigs[i]
+    None = absent lane (zeros, masked). → (rsh u32[24,B], valid)."""
+    n = len(msgs)
+    valid = np.ones(n, bool)
+    sig_parts = []
+    for i in range(n):
+        s = sigs[i]
+        if s is None or msgs[i] is None or len(s) != 64:
+            valid[i] = False
+            sig_parts.append(b"\x00" * 64)
+        else:
+            sig_parts.append(bytes(s))
+    sig_arr = np.frombuffer(b"".join(sig_parts), np.uint8).reshape(n, 64)
+    valid &= _s_below_l(sig_arr[:, 32:])
+    h_arr = _challenge_scalars(pk_arr, sig_arr, msgs, valid)
+
+    rsh = np.concatenate(
+        [
+            _le_words(sig_arr[:, :32]),
+            _le_words(sig_arr[:, 32:]),
+            _le_words(h_arr),
+        ],
+        axis=0,
+    )
+    return rsh, valid
+
+
+def verify_valset_resident(
+    valset_id: bytes,
+    pub_keys: Sequence[bytes],
+    msgs: Sequence[Optional[bytes]],
+    sigs: Sequence[Optional[bytes]],
+) -> List[bool]:
+    """Full-lane commit verification against a device-resident valset.
+
+    pub_keys: EVERY validator key, in valset order; msgs/sigs: one entry
+    per validator, None = absent (False in the result — callers skip
+    absent lanes). valset_id must be a collision-resistant digest of the
+    ordered pub_keys (the caller computes sha256 over their
+    concatenation); the resident rows are trusted to match it.
+    Accept/reject per present lane is bit-identical to verify_batch."""
+    n = len(pub_keys)
+    if n == 0:
+        return []
+    if len(msgs) != n or len(sigs) != n:
+        raise ValueError("msgs/sigs must have one entry per validator")
+    rv = _resident_cache.get(valset_id)
+    if rv is None:
+        rv = _build_resident(pub_keys)
+        _resident_cache[valset_id] = rv
+        while len(_resident_cache) > _RESIDENT_CACHE_MAX:
+            _resident_cache.popitem(last=False)
+    else:
+        _resident_cache.move_to_end(valset_id)
+
+    from cometbft_tpu.crypto.tpu import mesh as mesh_mod
+
+    ndev = mesh_mod.n_devices()
+    out = np.zeros(n, bool)
+    pending = []
+    # per-chunk packing: the SHA-512 hashing of chunk i+1 overlaps the
+    # device's work on chunk i, same as dispatch_batch's callable form
+    for start, end, size, a_dev in rv.chunks:
+        rsh, valid = _prepare_rsh(
+            rv.pk_arr[start:end], msgs[start:end], sigs[start:end]
+        )
+        rsh_pad = np.zeros((24, size), np.uint32)
+        rsh_pad[:, : end - start] = rsh
+        if ndev > 1:
+            mask = mesh_mod.sharded_verify(
+                verify_kernel_resident, [a_dev, rsh_pad], donate_from=1
+            )
+        else:
+            mask = verify_kernel_resident(a_dev, rsh_pad)
+        pending.append((start, end, mask, valid))
+    for start, end, mask, valid in pending:
+        out[start:end] = (
+            np.asarray(mask)[: end - start] & valid & rv.pk_ok[start:end]
+        )
+    return list(out)

@@ -190,17 +190,24 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
     return out
 
 
-def sharded_verify(kernel, args):
+def sharded_verify(kernel, args, donate_from: int = 0):
     """Run a verify kernel with every input's trailing (batch) axis
-    sharded over the mesh. args are numpy arrays whose trailing dim is
-    the (padded) batch — the caller pads to a multiple of the device
-    count × lane tile already."""
+    sharded over the mesh. args are numpy arrays (or already-placed jax
+    arrays) whose trailing dim is the (padded) batch — the caller pads
+    to a multiple of the device count × lane tile already.
+
+    donate_from: index of the first argument eligible for buffer
+    donation. Single-use staging buffers are donated so XLA reuses the
+    space instead of holding input + workspace live together (matters
+    at the 8k-lane chunks); RESIDENT buffers (the valset pubkey rows
+    that live across commits) must come before donate_from or donation
+    would free them after one dispatch."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as PS
 
     mesh = batch_mesh()
-    key = (id(kernel), tuple(a.ndim for a in args))
+    key = (id(kernel), tuple(a.ndim for a in args), donate_from)
     step = _sharded_kernels.get(key)
     shardings = tuple(
         NamedSharding(mesh, PS(*([None] * (a.ndim - 1) + ["batch"])))
@@ -214,10 +221,7 @@ def sharded_verify(kernel, args):
             inner,
             in_shardings=shardings,
             out_shardings=NamedSharding(mesh, PS("batch")),
-            # inputs are single-use staging buffers: donating them lets
-            # XLA reuse the space instead of holding input + workspace
-            # live together (matters at the 8k-lane chunks)
-            donate_argnums=tuple(range(len(args))),
+            donate_argnums=tuple(range(donate_from, len(args))),
         )
         _sharded_kernels[key] = step
     placed = [

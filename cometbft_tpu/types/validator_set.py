@@ -275,6 +275,37 @@ class ValidatorSet:
 
     # -- commit verification through the batch boundary --------------------
 
+    def _verify_lanes(self, lane_msgs, lane_sigs, entries, backend):
+        """Batch-verify the present lanes; returns one bool per entry
+        (entry order). Routes through the device-resident full-lane path
+        (crypto/batch.py verify_commit_valset — the valset's pubkey rows
+        stay on device across heights) when the whole set is ed25519 and
+        the backend/shape is eligible; otherwise the add()/verify()
+        protocol. Accept/reject is identical either way."""
+        if not entries:
+            return []
+        from cometbft_tpu.crypto import ed25519 as ed
+
+        if cryptobatch.resident_commit_eligible(len(entries), backend) and all(
+            isinstance(v.pub_key, ed.PubKeyEd25519) for v in self.validators
+        ):
+            full = cryptobatch.verify_commit_valset(
+                [v.pub_key.bytes() for v in self.validators],
+                lane_msgs,
+                lane_sigs,
+                backend,
+            )
+            if full is not None:
+                return [bool(full[e[0]]) for e in entries]
+        bv = cryptobatch.new_batch_verifier(backend)
+        for e in entries:
+            idx = e[0]
+            bv.add(
+                self.validators[idx].pub_key, lane_msgs[idx], lane_sigs[idx]
+            )
+        _, mask = bv.verify()
+        return mask
+
     def verify_commit(
         self,
         chain_id: str,
@@ -293,15 +324,17 @@ class ValidatorSet:
             raise ValueError(
                 f"invalid commit -- wrong block ID: want {block_id}, got {commit.block_id}"
             )
-        bv = cryptobatch.new_batch_verifier(backend)
         entries = []  # (idx, val, for_block)
+        lane_msgs: list = [None] * self.size()
+        lane_sigs: list = [None] * self.size()
         for idx, cs in enumerate(commit.signatures):
             if cs.is_absent():
                 continue
             val = self.validators[idx]
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
+            lane_msgs[idx] = commit.vote_sign_bytes(chain_id, idx)
+            lane_sigs[idx] = cs.signature
             entries.append((idx, val, cs.for_block()))
-        _, mask = bv.verify() if entries else (True, [])
+        mask = self._verify_lanes(lane_msgs, lane_sigs, entries, backend)
         tallied = 0
         needed = self.total_voting_power() * 2 // 3
         for (idx, val, for_block), ok in zip(entries, mask):
@@ -337,18 +370,21 @@ class ValidatorSet:
         # speculative prefix: assume sigs valid, stop once quorum crossed
         entries = []
         speculative = 0
+        lane_msgs: list = [None] * self.size()
+        lane_sigs: list = [None] * self.size()
         for idx, cs in enumerate(commit.signatures):
             if not cs.for_block():
                 continue
             val = self.validators[idx]
             entries.append((idx, val))
+            lane_msgs[idx] = commit.vote_sign_bytes(chain_id, idx)
+            lane_sigs[idx] = cs_sig(commit, idx)
             speculative += val.voting_power
             if speculative > needed:
                 break
-        bv = cryptobatch.new_batch_verifier(backend)
-        for idx, val in entries:
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs_sig(commit, idx))
-        _, mask = bv.verify() if entries else (True, [])
+        mask = self._verify_lanes(
+            lane_msgs, lane_sigs, [(i, v, True) for i, v in entries], backend
+        )
         tallied = 0
         for (idx, val), ok in zip(entries, mask):
             if not ok:

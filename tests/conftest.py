@@ -7,6 +7,12 @@ compile and execute without TPU hardware. Must be set before JAX import.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env pins "axon"
+# Trust the (virtual CPU) platform instead of probing: the probe
+# SUBPROCESS inherits the ambient axon platform (sitecustomize overrides
+# env), so a busy/wedged tunnel would latch the device plane DOWN and
+# silently reroute every device-path test to the CPU fallback — masks
+# agree, so nothing would fail, but the kernels under test never run.
+os.environ["CBFT_TPU_PROBE"] = "0"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

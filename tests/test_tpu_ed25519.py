@@ -352,3 +352,111 @@ class TestTPUBatchVerifier:
                 bv.add(pk, msg, sig)
             results.append(bv.verify())
         assert results[0] == results[1]
+
+
+class TestValsetResident:
+    """Device-resident valset verification (verify_valset_resident):
+    per-lane accept/reject must be bit-identical to verify_batch, with
+    absent lanes masked False, across multiple resident chunks, and the
+    cache must be reused by valset_id."""
+
+    def _valset(self, n, tag=77):
+        keys = [ed.gen_priv_key_from_secret(bytes([i, tag])) for i in range(n)]
+        return keys, [k.pub_key().bytes() for k in keys]
+
+    def test_parity_with_absent_and_invalid_lanes(self, monkeypatch):
+        # chunk cap 64 (= the kernel's min pad) + 100 lanes → 2 resident
+        # chunks, with absent/corrupt lanes in BOTH chunks
+        monkeypatch.setenv("CBFT_TPU_MAX_CHUNK", "64")
+        ed25519_batch._resident_cache.clear()
+        n = 100
+        keys, pks = self._valset(n)
+        msgs, sigs = [], []
+        for i, k in enumerate(keys):
+            if i in (3, 70):  # absent lanes (nil votes)
+                msgs.append(None)
+                sigs.append(None)
+                continue
+            m = b"resident vote %d" % i
+            s = bytearray(k.sign(m))
+            if i in (5, 90):
+                s[9] ^= 1  # corrupt
+            if i == 65:
+                s[32:] = ed25519_batch.L.to_bytes(32, "little")  # s = L
+            msgs.append(m)
+            sigs.append(bytes(s))
+        import hashlib as h
+
+        vid = h.sha256(b"".join(pks)).digest()
+        got = ed25519_batch.verify_valset_resident(vid, pks, msgs, sigs)
+        assert len(ed25519_batch._resident_cache[vid].chunks) == 2
+        want = []
+        for i in range(n):
+            if msgs[i] is None:
+                want.append(False)
+            else:
+                want.append(
+                    ed.PubKeyEd25519(pks[i]).verify_signature(
+                        msgs[i], sigs[i]
+                    )
+                )
+        assert got == want
+        for i in (3, 5, 65, 70, 90):
+            assert not got[i]
+        assert sum(got) == n - 5
+
+    def test_cache_reused_across_commits_and_evicted_by_lru(self, monkeypatch):
+        monkeypatch.delenv("CBFT_TPU_MAX_CHUNK", raising=False)
+        ed25519_batch._resident_cache.clear()
+        import hashlib as h
+
+        keys, pks = self._valset(8, tag=78)
+        vid = h.sha256(b"".join(pks)).digest()
+        for height in range(2):
+            msgs = [b"h%d vote %d" % (height, i) for i in range(8)]
+            sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+            assert all(
+                ed25519_batch.verify_valset_resident(vid, pks, msgs, sigs)
+            )
+        assert len(ed25519_batch._resident_cache) == 1  # one set, reused
+        # rotate through >MAX distinct valsets: LRU bounds the cache
+        for tag in range(100, 100 + ed25519_batch._RESIDENT_CACHE_MAX + 2):
+            ks, ps = self._valset(4, tag=tag)
+            v = h.sha256(b"".join(ps)).digest()
+            m = [b"x"] * 4
+            s = [k.sign(b"x") for k in ks]
+            assert all(ed25519_batch.verify_valset_resident(v, ps, m, s))
+        assert (
+            len(ed25519_batch._resident_cache)
+            == ed25519_batch._RESIDENT_CACHE_MAX
+        )
+
+    def test_verify_commit_routes_resident(self, monkeypatch):
+        """End-to-end: ValidatorSet.verify_commit under the tpu backend
+        takes the resident path when the floor allows, with behavior
+        identical to the cpu backend."""
+        monkeypatch.setenv("CBFT_TPU_MIN_BATCH", "1")
+        monkeypatch.delenv("CBFT_TPU_MAX_CHUNK", raising=False)
+        ed25519_batch._resident_cache.clear()
+        from cometbft_tpu.types.test_util import (
+            deterministic_validator_set,
+            make_block_id,
+            make_commit,
+        )
+
+        vset, privs = deterministic_validator_set(6)
+        bid = make_block_id()
+        commit = make_commit(bid, 5, 1, vset, privs, "res-chain")
+        vset.verify_commit("res-chain", bid, 5, commit, backend="cpu")
+        vset.verify_commit("res-chain", bid, 5, commit, backend="tpu")
+        assert len(ed25519_batch._resident_cache) == 1  # resident path ran
+        # corrupt one signature: both backends must reject identically
+        bad = bytearray(commit.signatures[2].signature)
+        bad[6] ^= 1
+        commit.signatures[2].signature = bytes(bad)
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            vset.verify_commit("res-chain", bid, 5, commit, backend="cpu")
+        with _pytest.raises(ValueError):
+            vset.verify_commit("res-chain", bid, 5, commit, backend="tpu")
