@@ -285,6 +285,30 @@ def _stage_variants():
     except Exception as exc:  # noqa: BLE001
         out["sharded_10k_commit"] = f"error: {exc}"[:160]
     print(json.dumps(out), flush=True)
+    # resident valset rows vs the per-batch wire, same 8192 lanes: the
+    # resident path ships 96 B/sig (R|S|h) against 128 B/sig and reuses
+    # one fixed executable — the per-height commit shape
+    # (cometbft_tpu/crypto/tpu/ed25519_batch.py verify_valset_resident)
+    try:
+        import hashlib as _hl
+
+        from cometbft_tpu.crypto.tpu import ed25519_batch as _eb
+
+        pks, msgs, sigs = _make_batch(8192)
+        t_batch = _time_verify_batch(pks, msgs, sigs)
+        vid = _hl.sha256(b"".join(pks)).digest()
+        res = _eb.verify_valset_resident(vid, pks, msgs, sigs)  # build+compile
+        assert all(res), "resident benchmark batch must verify"
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _eb.verify_valset_resident(vid, pks, msgs, sigs)
+            best = min(best, time.perf_counter() - t0)
+        out["resident_8192_sigs_per_sec"] = round(len(pks) / best, 1)
+        out["perbatch_8192_sigs_per_sec"] = round(t_batch, 1)
+    except Exception as exc:  # noqa: BLE001
+        out["resident_8192_sigs_per_sec"] = f"error: {exc}"[:120]
+    print(json.dumps(out), flush=True)
 
 
 def _stage_breakdown():

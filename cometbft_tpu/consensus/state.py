@@ -160,7 +160,19 @@ class ConsensusState(BaseService):
         # stop order), so the routine exits within one iteration.
         t = getattr(self, "_receive_thread", None)
         if t is not None and t is not threading.current_thread():
-            t.join(timeout=60.0)
+            # 180 s: must outlast the longest bounded stall a finalize
+            # can hit (the one-time device probe is capped at
+            # CBFT_TPU_PROBE_TIMEOUT 120 s + 30 s slack)
+            t.join(timeout=180.0)
+            if t.is_alive():
+                # stopping the WAL now would reintroduce the dropped-
+                # #ENDHEIGHT bug; leave it running (its flush thread is
+                # a daemon — a late write_sync still lands) and say so
+                self.logger.error(
+                    "receive routine did not exit before stop timeout; "
+                    "leaving WAL running so in-flight writes land"
+                )
+                return
         if not isinstance(self.wal, NilWAL):
             try:
                 self.wal.stop()

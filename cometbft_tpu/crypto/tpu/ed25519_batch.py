@@ -537,8 +537,9 @@ def warmup(sizes: Optional[Sequence[int]] = None) -> None:
         verify_valset_resident(
             vid, [pk] * size, [msg] * size, [sig] * size
         )
-    # warmup valsets are synthetic: don't hold their rows in HBM/LRU
-    _resident_cache.clear()
+        # synthetic warmup rows must not occupy HBM/LRU slots — but only
+        # evict OUR key: a real valset may already be resident in-process
+        _resident_cache.pop(vid, None)
 
 
 def verify_batch(

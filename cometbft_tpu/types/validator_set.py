@@ -382,9 +382,7 @@ class ValidatorSet:
             speculative += val.voting_power
             if speculative > needed:
                 break
-        mask = self._verify_lanes(
-            lane_msgs, lane_sigs, [(i, v, True) for i, v in entries], backend
-        )
+        mask = self._verify_lanes(lane_msgs, lane_sigs, entries, backend)
         tallied = 0
         for (idx, val), ok in zip(entries, mask):
             if not ok:
