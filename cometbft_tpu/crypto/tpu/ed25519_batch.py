@@ -404,9 +404,28 @@ def _parse_inputs(pub_keys, sigs):
 def _challenge_scalars(
     pk_arr: np.ndarray, sig_arr: np.ndarray, msgs, valid: np.ndarray
 ) -> np.ndarray:
-    """h = SHA-512(R ‖ A ‖ M) mod L per valid lane (hashlib C + CPython
-    big-int on the host) → u8[B,32] little-endian."""
+    """h = SHA-512(R ‖ A ‖ M) mod L per valid lane → u8[B,32]
+    little-endian. On multicore hosts one native C call chunks the
+    batch across threads (native/ed25519_batch.c
+    cbft_ed25519_challenges); on one core the hashlib +
+    CPython-big-int loop below is measured marginally FASTER (1.5 vs
+    1.8 µs/lane — both are C underneath, and the native wrapper pays
+    ctypes marshalling), so the native path gates on cpu_count like
+    ed25519.verify_many. The Python loop stays the parity oracle."""
+    import os as _os
+
     n = len(msgs)
+    if (_os.cpu_count() or 1) > 1 and n >= 256:
+        from cometbft_tpu import native
+
+        raw = native.ed25519_challenges(
+            pk_arr.tobytes(),
+            sig_arr[:, :32].tobytes(),
+            msgs,
+            [bool(v) for v in valid],
+        )
+        if raw is not None:
+            return np.frombuffer(raw, np.uint8).reshape(n, 32).copy()
     h_arr = np.zeros((n, 32), np.uint8)
     sha = hashlib.sha512
     for i in range(n):

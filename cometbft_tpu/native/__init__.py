@@ -100,6 +100,24 @@ def load_ed25519() -> Optional[ctypes.CDLL]:
         return _lib
 
 
+def _pack_msgs(msgs: Sequence[bytes]):
+    """Concatenate variable-length messages into one buffer with
+    per-entry (offset, length) arrays — the shared ctypes marshalling
+    for both batch entry points."""
+    n = len(msgs)
+    offs = (ctypes.c_size_t * n)()
+    lens = (ctypes.c_size_t * n)()
+    parts = []
+    pos = 0
+    for i, m in enumerate(msgs):
+        b = bytes(m)
+        parts.append(b)
+        offs[i] = pos
+        lens[i] = len(b)
+        pos += len(b)
+    return b"".join(parts), offs, lens
+
+
 def ed25519_verify_batch(
     pubs: Sequence[bytes],
     msgs: Sequence[bytes],
@@ -127,14 +145,7 @@ def ed25519_verify_batch(
     sig_buf = b"".join(
         sigs[i] if ok_shape[i] else b"\x00" * 64 for i in range(n)
     )
-    msg_buf = b"".join(msgs)
-    offs = (ctypes.c_size_t * n)()
-    lens = (ctypes.c_size_t * n)()
-    pos = 0
-    for i, m in enumerate(msgs):
-        offs[i] = pos
-        lens[i] = len(m)
-        pos += len(m)
+    msg_buf, offs, lens = _pack_msgs(msgs)
     out = (ctypes.c_ubyte * n)()
     if nthreads is None:
         nthreads = min(os.cpu_count() or 1, 16)
@@ -144,3 +155,70 @@ def ed25519_verify_batch(
     if rc != 0:
         return None
     return [bool(out[i]) and ok_shape[i] for i in range(n)]
+
+
+def load_challenges():
+    """ctypes binding for cbft_ed25519_challenges (same .so); None on
+    any load failure."""
+    lib = load_ed25519()
+    if lib is None:
+        return None
+    fn = getattr(lib, "cbft_ed25519_challenges", None)
+    if fn is None:
+        return None
+    if not getattr(fn, "_cbft_typed", False):
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            ctypes.c_char_p,                  # pubs (A)
+            ctypes.c_char_p,                  # rs (R)
+            ctypes.c_char_p,                  # msgs
+            ctypes.POINTER(ctypes.c_size_t),  # msg_off
+            ctypes.POINTER(ctypes.c_size_t),  # msg_len
+            ctypes.POINTER(ctypes.c_ubyte),   # valid
+            ctypes.c_char_p,                  # out (n*32 LE)
+            ctypes.c_size_t,                  # n
+            ctypes.c_int,                     # nthreads
+        ]
+        fn._cbft_typed = True
+    return fn
+
+
+def ed25519_challenges(
+    pubs: bytes,
+    rs: bytes,
+    msgs: Sequence[Optional[bytes]],
+    valid: Sequence[bool],
+    nthreads: Optional[int] = None,
+) -> Optional[bytes]:
+    """h = SHA-512(R ‖ A ‖ M) mod L per valid lane, one native call.
+
+    pubs/rs are the concatenated n*32-byte A and R rows; lanes with
+    valid[i] False are skipped (zeros in the output). A valid lane with
+    msgs[i] None is a caller bug and returns None (the Python oracle
+    would raise — silent empty-message hashing would be a parity
+    break). Returns the n*32 little-endian output buffer, or None when
+    the native path is unavailable (callers fall back to the Python
+    loop)."""
+    fn = load_challenges()
+    if fn is None:
+        return None
+    n = len(valid)
+    if n == 0:
+        return b""
+    if len(pubs) != 32 * n or len(rs) != 32 * n:
+        return None  # shape mismatch must not reach the C reader
+    if any(valid[i] and msgs[i] is None for i in range(n)):
+        return None
+    vbuf = (ctypes.c_ubyte * n)()
+    for i in range(n):
+        vbuf[i] = 1 if valid[i] else 0
+    msg_buf, offs, lens = _pack_msgs(
+        [msgs[i] if valid[i] else b"" for i in range(n)]
+    )
+    out = ctypes.create_string_buffer(32 * n)
+    if nthreads is None:
+        nthreads = min(os.cpu_count() or 1, 16)
+    rc = fn(pubs, rs, msg_buf, offs, lens, vbuf, out, n, nthreads)
+    if rc != 0:
+        return None
+    return out.raw

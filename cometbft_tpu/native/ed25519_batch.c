@@ -113,3 +113,133 @@ int cbft_ed25519_verify_batch(const unsigned char *pubs,
         pthread_join(tids[t], NULL);
     return 0;
 }
+
+/* --- batch challenge scalars: h = SHA-512(R ‖ A ‖ M) mod L ------------
+ *
+ * Host-side packing cost of the TPU batch/resident verify paths
+ * (crypto/tpu/ed25519_batch.py _challenge_scalars): the pure-Python
+ * loop pays ~6 us/sig (hashlib call + 512-bit int mod); this native
+ * loop is one call per batch with the same pthread chunking as the
+ * verifier above. Output is 32 little-endian bytes per lane; lanes
+ * with valid[i] == 0 are skipped (left zeroed). */
+
+typedef struct bignum_st BIGNUM;
+typedef struct bignum_ctx BN_CTX;
+BIGNUM *BN_lebin2bn(const unsigned char *s, size_t len, BIGNUM *ret);
+int BN_bn2lebinpad(const BIGNUM *a, unsigned char *to, size_t tolen);
+int BN_div(BIGNUM *dv, BIGNUM *rem, const BIGNUM *m, const BIGNUM *d,
+           BN_CTX *ctx);
+BIGNUM *BN_new(void);
+void BN_free(BIGNUM *a);
+BN_CTX *BN_CTX_new(void);
+void BN_CTX_free(BN_CTX *c);
+const EVP_MD *EVP_sha512(void);
+int EVP_DigestInit_ex(EVP_MD_CTX *ctx, const EVP_MD *type, ENGINE *impl);
+int EVP_DigestUpdate(EVP_MD_CTX *ctx, const void *d, size_t cnt);
+int EVP_DigestFinal_ex(EVP_MD_CTX *ctx, unsigned char *md, unsigned int *s);
+
+/* L = 2^252 + 27742317777372353535851937790883648493, little-endian */
+static const unsigned char CBFT_L_LE[32] = {
+    0xed, 0xd3, 0xf5, 0x5c, 0x1a, 0x63, 0x12, 0x58,
+    0xd6, 0x9c, 0xf7, 0xa2, 0xde, 0xf9, 0xde, 0x14,
+    0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
+    0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x10,
+};
+
+typedef struct {
+    const unsigned char *pubs;   /* n * 32 (A) */
+    const unsigned char *rs;     /* n * 32 (R) */
+    const unsigned char *msgs;   /* concatenated */
+    const size_t *msg_off;
+    const size_t *msg_len;
+    const unsigned char *valid;  /* n: 0 = skip lane */
+    unsigned char *out;          /* n * 32 LE */
+    size_t begin, end;
+    int rc;
+} hchunk_t;
+
+static void *challenge_chunk(void *arg)
+{
+    hchunk_t *c = (hchunk_t *)arg;
+    EVP_MD_CTX *ctx = EVP_MD_CTX_new();
+    BIGNUM *L = BN_lebin2bn(CBFT_L_LE, 32, NULL);
+    BIGNUM *h = BN_new();
+    BIGNUM *rem = BN_new();
+    BN_CTX *bctx = BN_CTX_new();
+    if (ctx == NULL || L == NULL || h == NULL || rem == NULL ||
+        bctx == NULL) {
+        c->rc = 1;
+        goto done;
+    }
+    for (size_t i = c->begin; i < c->end; i++) {
+        unsigned char digest[64];
+        unsigned int dlen = 0;
+        if (!c->valid[i])
+            continue;
+        if (EVP_DigestInit_ex(ctx, EVP_sha512(), NULL) != 1 ||
+            EVP_DigestUpdate(ctx, c->rs + 32 * i, 32) != 1 ||
+            EVP_DigestUpdate(ctx, c->pubs + 32 * i, 32) != 1 ||
+            EVP_DigestUpdate(ctx, c->msgs + c->msg_off[i],
+                             c->msg_len[i]) != 1 ||
+            EVP_DigestFinal_ex(ctx, digest, &dlen) != 1 || dlen != 64 ||
+            BN_lebin2bn(digest, 64, h) == NULL ||
+            BN_div(NULL, rem, h, L, bctx) != 1 ||
+            BN_bn2lebinpad(rem, c->out + 32 * i, 32) != 32) {
+            c->rc = 1;
+            goto done;
+        }
+    }
+done:
+    if (ctx) EVP_MD_CTX_free(ctx);
+    if (L) BN_free(L);
+    if (h) BN_free(h);
+    if (rem) BN_free(rem);
+    if (bctx) BN_CTX_free(bctx);
+    return NULL;
+}
+
+/* Returns 0 on success (any lane failure poisons the call — callers
+ * fall back to the Python path rather than trust partial output). */
+int cbft_ed25519_challenges(const unsigned char *pubs,
+                            const unsigned char *rs,
+                            const unsigned char *msgs,
+                            const size_t *msg_off, const size_t *msg_len,
+                            const unsigned char *valid, unsigned char *out,
+                            size_t n, int nthreads)
+{
+    if (n == 0)
+        return 0;
+    if (nthreads <= 1 || (size_t)nthreads > n) {
+        hchunk_t c = {pubs, rs, msgs, msg_off, msg_len,
+                      valid, out, 0, n, 0};
+        challenge_chunk(&c);
+        return c.rc;
+    }
+    enum { MAX_THREADS = 64 };
+    if (nthreads > MAX_THREADS)
+        nthreads = MAX_THREADS;
+    pthread_t tids[MAX_THREADS];
+    hchunk_t chunks[MAX_THREADS];
+    size_t per = n / nthreads, rem = n % nthreads, pos = 0;
+    int spawned = 0;
+    for (int t = 0; t < nthreads; t++) {
+        size_t take = per + (t < (int)rem ? 1 : 0);
+        chunks[t] = (hchunk_t){pubs, rs, msgs, msg_off, msg_len,
+                               valid, out, pos, pos + take, 0};
+        pos += take;
+        if (t == nthreads - 1) {
+            challenge_chunk(&chunks[t]);
+        } else if (pthread_create(&tids[spawned], NULL, challenge_chunk,
+                                  &chunks[t]) == 0) {
+            spawned++;
+        } else {
+            challenge_chunk(&chunks[t]);
+        }
+    }
+    for (int t = 0; t < spawned; t++)
+        pthread_join(tids[t], NULL);
+    int rc = 0;
+    for (int t = 0; t < nthreads; t++)
+        rc |= chunks[t].rc;
+    return rc;
+}

@@ -218,6 +218,53 @@ class TestBatchVerifier:
         expected = [pk.verify_signature(m, s) for pk, m, s in triples]
         assert mask == expected
 
+
+
+    def test_native_challenges_parity(self):
+        """cbft_ed25519_challenges vs the hashlib + big-int oracle,
+        including skipped (absent) lanes and empty messages."""
+        import hashlib
+        import random
+
+        import numpy as np
+
+        from cometbft_tpu import native
+
+        L = 2**252 + 27742317777372353535851937790883648493
+        rng = random.Random(5)
+        n = 120
+        pk = np.frombuffer(
+            bytes(rng.randrange(256) for _ in range(n * 32)), np.uint8
+        ).reshape(n, 32)
+        r = np.frombuffer(
+            bytes(rng.randrange(256) for _ in range(n * 32)), np.uint8
+        ).reshape(n, 32)
+        valid = [rng.random() > 0.15 for _ in range(n)]
+        msgs = [
+            bytes(rng.randrange(256) for _ in range(rng.randrange(0, 150)))
+            if v
+            else None
+            for v in valid
+        ]
+        raw = native.ed25519_challenges(pk.tobytes(), r.tobytes(), msgs, valid)
+        if raw is None:
+            pytest.skip("native challenges unavailable")
+        got = np.frombuffer(raw, np.uint8).reshape(n, 32)
+        for i in range(n):
+            if not valid[i]:
+                assert not got[i].any()
+                continue
+            h = (
+                int.from_bytes(
+                    hashlib.sha512(
+                        r[i].tobytes() + pk[i].tobytes() + msgs[i]
+                    ).digest(),
+                    "little",
+                )
+                % L
+            )
+            assert got[i].tobytes() == h.to_bytes(32, "little"), i
+
     def test_device_plane_down_routes_to_cpu(self, monkeypatch):
         """A wedged TPU tunnel must degrade the tpu backend to CPU
         routing (bounded probe verdict), never hang or change results."""
