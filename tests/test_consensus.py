@@ -308,6 +308,67 @@ class TestCrashRecovery:
         cs = ConsensusState(cfg, state, executor, bstore, wal=wal)
         return cs, state_store, bstore, client
 
+    def test_stop_waits_for_inflight_finalize_wal_write(self):
+        """Stop-order guarantee: after stop() returns, every message of
+        the batch the receive routine was processing has fully handled
+        AND its durable WAL writes landed. The old order (wal.stop()
+        without joining the routine) violated this whenever stop()'s
+        flag-flip won the state mutex between two batch messages — a
+        later message could then finalize a commit whose
+        write_sync(#ENDHEIGHT) the stopped WAL silently dropped while
+        apply_block persisted state (the load-only restart flake:
+        "WAL has no #ENDHEIGHT h-1"). Lock-acquisition fairness makes
+        that loss probabilistic, so this test pins the guarantee the
+        join provides rather than re-rolling the race."""
+        from cometbft_tpu.consensus.messages import EndHeightMessage
+        from cometbft_tpu.consensus.state import MsgInfo
+
+        vals, privs = test_util.deterministic_validator_set(1, 10)
+        doc = GenesisDoc(
+            genesis_time=Timestamp(1_700_000_000, 0),
+            chain_id="stop-chain",
+            validators=[
+                GenesisValidator(v.address, v.pub_key, v.voting_power, "")
+                for v in vals.validators
+            ],
+        )
+        with tempfile.TemporaryDirectory() as d:
+            cs, state_store, bstore, client = self._build_node(d, doc)
+            wrote = threading.Event()
+            m1_entered = threading.Event()
+
+            def handler(mi):
+                if mi.msg == "m1":
+                    m1_entered.set()
+                    time.sleep(1.0)  # stop() arrives while this holds _mtx
+                elif mi.msg == "m2":
+                    # the race window: by now the old stop order has
+                    # already stopped the WAL; give wal.stop a head
+                    # start so the old code loses deterministically
+                    time.sleep(0.3)
+                    cs.wal.write_sync(EndHeightMessage(4242))
+                    wrote.set()
+
+            cs._handle_msg = handler
+            cs._batch_preverify_votes = lambda batch: None
+            # the pre-handler message log would try to proto-encode the
+            # string fixtures; neutralize it — the assertion is about
+            # the handler's own write_sync landing, not the message log
+            cs.wal.write = lambda mi: None
+            # both messages must land in ONE drained batch
+            cs.peer_msg_queue.put(MsgInfo("m1", "peer"))
+            cs.peer_msg_queue.put(MsgInfo("m2", "peer"))
+            cs.start()
+            # deterministic in both directions: stop() must land while
+            # m1's handler is mid-sleep (batch in flight), not before
+            # the batch started nor after it drained
+            assert m1_entered.wait(10.0), "receive routine never ran m1"
+            cs.stop()  # must join the routine, THEN stop the WAL
+            client.stop()
+            assert wrote.is_set(), "stop() did not wait for the batch tail"
+            _, found = cs.wal.search_for_end_height(4242)
+            assert found, "in-flight #ENDHEIGHT was dropped by stop()"
+
     def test_restart_continues_chain(self):
         from cometbft_tpu.consensus.replay import Handshaker, catchup_replay
 
