@@ -149,6 +149,7 @@ class WAL(BaseService):
         return total
 
     def _flush_loop(self) -> None:
+        ticks = 0
         while self.is_running():
             time.sleep(_FLUSH_INTERVAL_S)
             if not self.is_running():
@@ -156,8 +157,24 @@ class WAL(BaseService):
             try:
                 with self._mtx:
                     self._group.flush_and_sync()
-            except (OSError, ValueError):
-                return
+                ticks += 1
+                if ticks % 5 == 0:
+                    # ~10 s: rotate an oversized head + enforce the
+                    # total-size bound (reference: the autofile group's
+                    # own processTicks, group.go — without this the head
+                    # file grows unboundedly on a long-running node)
+                    with self._mtx:
+                        self._group.check_head_size_limit()
+            except (OSError, ValueError) as exc:
+                if not self.is_running():
+                    return  # shutdown race: head closed under us
+                # a transient fs error must not kill flushing, but a
+                # node whose WAL is not landing must be VISIBLE
+                # (reference: "Periodic WAL flush failed" log)
+                self.logger.error(
+                    "periodic WAL flush failed", err=str(exc)
+                )
+                continue
 
     def write(self, msg) -> None:
         """Log before processing (reference: Write — no fsync)."""

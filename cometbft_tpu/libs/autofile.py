@@ -100,10 +100,22 @@ class Group:
             self._head.flush()
             os.fsync(self._head.fileno())
             self._head.close()
-            _, max_idx = self.min_max_index()
-            dst = f"{self.head_path}.{max_idx + 1:03d}"
-            os.rename(self.head_path, dst)
-            self._open_head()
+            try:
+                _, max_idx = self.min_max_index()
+                dst = f"{self.head_path}.{max_idx + 1:03d}"
+                os.rename(self.head_path, dst)
+                self._open_head()
+            except OSError:
+                # a failed rename/reopen must not leave the group with a
+                # permanently-closed head (every write would then raise
+                # into consensus threads); reopen in append mode — the
+                # un-renamed head keeps accepting writes, and the caller
+                # sees the error to log it
+                try:
+                    self._open_head()
+                except OSError:
+                    self._head = None
+                raise
 
     def _check_total_size_limit(self) -> None:
         if self.group_size_limit <= 0:
@@ -145,10 +157,22 @@ class Group:
 
 
 class GroupReader:
-    """Sequential reader across all files of a group."""
+    """Sequential reader across all files of a group.
+
+    Every file is opened EAGERLY at construction: a concurrent rotation
+    renames the head to a .NNN path mid-read, and a lazy open-by-name
+    would then land on the fresh empty head and silently skip every
+    record the old head held (a WAL replay reading a truncated tail).
+    Open fds survive the rename (the inode lives on), so the eager
+    snapshot reads exactly the content that existed at reader()."""
 
     def __init__(self, paths: List[str]):
-        self._paths = paths
+        self._files: List[BinaryIO] = []
+        for p in paths:
+            try:
+                self._files.append(open(p, "rb"))
+            except FileNotFoundError:
+                continue
         self._idx = 0
         self._f: Optional[BinaryIO] = None
         self._advance()
@@ -157,12 +181,9 @@ class GroupReader:
         if self._f:
             self._f.close()
             self._f = None
-        while self._idx < len(self._paths):
-            p = self._paths[self._idx]
+        if self._idx < len(self._files):
+            self._f = self._files[self._idx]
             self._idx += 1
-            if os.path.exists(p):
-                self._f = open(p, "rb")
-                return
 
     def read(self, n: int = -1) -> bytes:
         out = bytearray()
@@ -179,6 +200,15 @@ class GroupReader:
         if self._f:
             self._f.close()
             self._f = None
+        # an early close (e.g. search stops at its marker) must release
+        # the eagerly-opened fds of files never advanced into
+        for f in self._files[self._idx :]:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._files = []
+        self._idx = 0
 
     def __enter__(self) -> "GroupReader":
         return self

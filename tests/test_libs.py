@@ -222,6 +222,27 @@ class TestAutofile:
         assert g.min_max_index() == (1, 1)
         g.close()
 
+    def test_reader_snapshot_survives_concurrent_rotation(self, tmp_path):
+        """A reader opened before a rotation must see the group's content
+        as of the snapshot — the rename must not swap the (new, empty)
+        head in under it. This is the WAL-replay-during-rotation race:
+        the flush loop now rotates in production, and replay reads the
+        group while it runs."""
+        head = str(tmp_path / "wal")
+        g = Group(head, head_size_limit=10_000)
+        g.write(b"A" * 100)
+        g.flush()
+        r = g.reader()
+        assert r.read(10) == b"A" * 10  # reader is mid-head
+        g.rotate_file()  # head renamed; fresh empty head created
+        g.write(b"B" * 50)
+        g.flush()
+        assert r.read() == b"A" * 90  # snapshot complete, no Bs, no loss
+        r.close()
+        with g.reader() as r2:  # a fresh reader sees everything
+            assert r2.read() == b"A" * 100 + b"B" * 50
+        g.close()
+
     def test_group_size_limit_prunes(self, tmp_path):
         head = str(tmp_path / "wal")
         g = Group(head, head_size_limit=50, group_size_limit=120)
