@@ -52,9 +52,11 @@ def catchup_replay(cs, cs_height: int) -> None:
                 f"cannot replay height {cs_height}: WAL has no #ENDHEIGHT "
                 f"{cs_height - 1}"
             )
+        cs._wal_catchup_done = True
         return
     for msg in tail or []:
         _replay_one(cs, msg)
+    cs._wal_catchup_done = True  # on_start must not replay a second time
     cs.logger.info("replay: done", height=cs_height, messages=len(tail or []))
 
 

@@ -141,12 +141,50 @@ class ConsensusState(BaseService):
             wal = WAL(self.config.wal_file())
             wal.start()
             self.wal = wal
+        self._wal_catchup()
         self.ticker.start()
         self._receive_thread = threading.Thread(
             target=self._receive_routine, daemon=True, name="cs-receive"
         )
         self._receive_thread.start()
         self._schedule_round0(self.rs)
+
+    def _wal_catchup(self) -> None:
+        """Reference State.OnStart's doWALCatchup loop: we may have lost
+        in-flight votes/locks if the process crashed — replay the WAL
+        tail before the receive routine starts. Corruption gets ONE
+        repair attempt (truncate after the last valid record —
+        reference repairWalFile, state.go:2359); any other replay error
+        is logged and consensus proceeds (reference behavior — e.g. a
+        statesync jump leaves no marker for the new height)."""
+        from cometbft_tpu.consensus.replay import catchup_replay
+        from cometbft_tpu.consensus.wal import WALDecodeError, repair_wal_tail
+
+        if isinstance(self.wal, NilWAL):
+            return
+        if getattr(self, "_wal_catchup_done", False):
+            return  # an external catchup_replay already ran (tests, tools)
+        repaired = False
+        while True:
+            try:
+                catchup_replay(self, self.rs.height)
+                return
+            except WALDecodeError as exc:
+                if repaired:
+                    raise
+                self.logger.error(
+                    "WAL corrupted; repairing tail", err=str(exc)
+                )
+                if not repair_wal_tail(self.wal):
+                    raise
+                repaired = True
+            except Exception as exc:  # noqa: BLE001 - reference logs all
+                self.logger.error(
+                    "WAL replay failed; proceeding to consensus",
+                    err=str(exc),
+                )
+                self._wal_catchup_done = True  # attempted; never re-run
+                return
 
     def on_stop(self) -> None:
         self.ticker.stop()

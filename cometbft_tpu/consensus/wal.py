@@ -46,33 +46,54 @@ class WALDecodeError(ValueError):
     DataCorruptionError)."""
 
 
-def _decode_record(r) -> Optional[object]:
-    """Read one framed record from a binary reader; None at clean EOF."""
-    head = r.read(8)
-    if len(head) == 0:
-        return None
+def _next_frame(read):
+    """THE framing rule, shared by replay, the lenient tool reader, and
+    repair — three readers that must never disagree on what a valid
+    record is. → (body, None) on success, (None, None) at clean EOF,
+    (None, reason) on a framing violation."""
+    head = read(8)
+    if not head:
+        return None, None
     if len(head) < 8:
-        raise WALDecodeError("truncated record header")
+        return None, "truncated record header"
     crc, length = struct.unpack(">II", head)
     if length > MAX_MSG_SIZE_BYTES:
-        raise WALDecodeError(f"length {length} exceeds max msg size")
-    body = r.read(length)
+        return None, f"record length {length} exceeds max"
+    body = read(length)
     if len(body) < length:
-        raise WALDecodeError("truncated record body")
+        return None, "truncated record body"
     if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
-        raise WALDecodeError("CRC mismatch")
-    # TimedWALMessage {Timestamp time=1, WALMessage msg=2}
+        return None, "CRC mismatch"
+    return body, None
+
+
+def _split_body(body: bytes):
+    """TimedWALMessage {Timestamp time=1, WALMessage msg=2} → the raw
+    field bytes (ts_bytes may be None; raw_msg None = missing field)."""
     reader = protoio.WireReader(body)
-    msg = None
+    ts_bytes, raw = None, None
     while not reader.at_end():
-        f, wt = reader.read_tag()
-        if f == 2:
-            msg = decode_wal_message(reader.read_bytes())
+        fld, wt = reader.read_tag()
+        if fld == 1:
+            ts_bytes = reader.read_bytes()
+        elif fld == 2:
+            raw = reader.read_bytes()
         else:
             reader.skip(wt)
-    if msg is None:
+    return ts_bytes, raw
+
+
+def _decode_record(r) -> Optional[object]:
+    """Read one framed record from a binary reader; None at clean EOF."""
+    body, err = _next_frame(r.read)
+    if body is None:
+        if err is None:
+            return None
+        raise WALDecodeError(err)
+    _, raw = _split_body(body)
+    if raw is None:
         raise WALDecodeError("record without WALMessage")
-    return msg
+    return decode_wal_message(raw)
 
 
 def read_records_lenient(path: str):
@@ -82,34 +103,14 @@ def read_records_lenient(path: str):
     framing. `warning` is set (and iteration ends) on a bad record."""
     with open(path, "rb") as f:
         while True:
-            head = f.read(8)
-            if not head:
+            body, err = _next_frame(f.read)
+            if body is None:
+                if err is not None:
+                    yield None, None, err
                 return
-            if len(head) < 8:
-                yield None, None, "truncated record header"
-                return
-            crc, length = struct.unpack(">II", head)
-            if length > MAX_MSG_SIZE_BYTES:
-                yield None, None, f"record length {length} exceeds max"
-                return
-            body = f.read(length)
-            if len(body) < length:
-                yield None, None, "truncated record body"
-                return
-            if (zlib.crc32(body) & 0xFFFFFFFF) != crc:
-                yield None, None, "CRC mismatch"
-                return
-            reader = protoio.WireReader(body)
-            ts, raw = None, b""
-            while not reader.at_end():
-                fld, wt = reader.read_tag()
-                if fld == 1:
-                    ts = Timestamp.decode(reader.read_bytes())
-                elif fld == 2:
-                    raw = reader.read_bytes()
-                else:
-                    reader.skip(wt)
-            yield ts, raw, None
+            ts_bytes, raw = _split_body(body)
+            ts = Timestamp.decode(ts_bytes) if ts_bytes is not None else None
+            yield ts, raw if raw is not None else b"", None
 
 
 class WAL(BaseService):
@@ -258,3 +259,45 @@ class NilWAL:
 
     def is_running(self) -> bool:
         return True
+
+
+def _scan_valid_prefix(path: str):
+    """→ (end offset of the last fully-valid record, clean). clean is
+    False when corruption/truncation follows the prefix. Validity =
+    the shared framing rule (_next_frame) plus EXACTLY the decode
+    replay applies (_split_body field 2 → decode_wal_message — the
+    timestamp field is not decoded, matching _decode_record): repair
+    must never truncate a record replay would have accepted."""
+    good = 0
+    with open(path, "rb") as f:
+        while True:
+            body, err = _next_frame(f.read)
+            if body is None:
+                return good, err is None
+            try:
+                _, raw = _split_body(body)
+                if raw is None:
+                    return good, False
+                decode_wal_message(raw)
+            except Exception:  # noqa: BLE001 - any decode failure ends it
+                return good, False
+            good += 8 + len(body)
+
+
+def repair_wal_tail(wal: "WAL") -> bool:
+    """Drop everything after the last valid record (reference:
+    repairWalFile, consensus/state.go:2359 — copy-the-valid-prefix on a
+    single file; the group form truncates the corrupt file and removes
+    every later file, since their records postdate the corruption).
+    → True when something was repaired."""
+    group = wal.group()
+    with wal._mtx:
+        group.flush_and_sync()
+        paths = group.all_paths()
+        for i, p in enumerate(paths):
+            good, clean = _scan_valid_prefix(p)
+            if clean:
+                continue
+            group.truncate_tail(p, good, drop_after=paths[i + 1 :])
+            return True
+    return False

@@ -117,6 +117,33 @@ class Group:
                     self._head = None
                 raise
 
+    def truncate_tail(self, path: str, offset: int, drop_after=()) -> None:
+        """Repair support (consensus WAL): truncate `path` at `offset`
+        and remove every file in `drop_after` (records that postdate a
+        corruption). The head may be either the truncated file or among
+        the dropped ones — in both cases its open append fd is closed
+        first and reopened (recreated) after, so later writes can never
+        land on a truncated-past or unlinked inode."""
+        with self._mtx:
+            head_touched = path == self.head_path or self.head_path in (
+                tuple(drop_after)
+            )
+            if head_touched and self._head is not None:
+                self._head.flush()
+                self._head.close()
+                self._head = None
+            with open(path, "r+b") as f:
+                f.truncate(offset)
+                f.flush()
+                os.fsync(f.fileno())
+            for q in drop_after:
+                try:
+                    os.remove(q)
+                except FileNotFoundError:
+                    pass
+            if head_touched:
+                self._open_head()
+
     def _check_total_size_limit(self) -> None:
         if self.group_size_limit <= 0:
             return
