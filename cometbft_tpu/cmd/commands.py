@@ -786,25 +786,47 @@ def cmd_wal(args) -> int:
 
     if args.wal_command == "export":
         from cometbft_tpu.consensus.wal import read_records_lenient
+        from cometbft_tpu.libs.autofile import list_chunk_files
+
+        # the WAL rotates (head + .NNN chunks); given the head path,
+        # export the WHOLE group oldest-first so operators see exactly
+        # the record sequence replay would (chunk naming comes from the
+        # shared autofile contract, not a re-derived pattern)
+        paths = [p for _, p in list_chunk_files(args.path)] + [args.path]
 
         out = sys.stdout
-        for ts, raw, warning in read_records_lenient(args.path):
-            if warning is not None:
-                print(f"warning: {warning}, stopping", file=sys.stderr)
-                break
-            rec = {
-                "time": ts.to_rfc3339() if ts else None,
-                "msg": raw.hex(),
-            }
-            try:
-                msg = decode_wal_message(raw)
-                rec["type"] = type(msg).__name__
-                for attr in ("height", "round"):
-                    if hasattr(msg, attr):
-                        rec[attr] = getattr(msg, attr)
-            except (WALDecodeError, ValueError) as exc:
-                rec["type"] = f"undecodable: {exc}"
-            out.write(json.dumps(rec) + "\n")
+        stop = False
+        for p in paths:
+            if stop:
+                continue
+            if not os.path.exists(p):
+                if p == args.path and not paths[:-1]:
+                    # a missing HEAD with no chunks is a wrong path, not
+                    # an empty WAL — fail loudly, don't print nothing
+                    raise FileNotFoundError(args.path)
+                continue
+            for ts, raw, warning in read_records_lenient(p):
+                if warning is not None:
+                    print(
+                        f"warning: {warning} in {os.path.basename(p)}, "
+                        "stopping",
+                        file=sys.stderr,
+                    )
+                    stop = True
+                    break
+                rec = {
+                    "time": ts.to_rfc3339() if ts else None,
+                    "msg": raw.hex(),
+                }
+                try:
+                    msg = decode_wal_message(raw)
+                    rec["type"] = type(msg).__name__
+                    for attr in ("height", "round"):
+                        if hasattr(msg, attr):
+                            rec[attr] = getattr(msg, attr)
+                except (WALDecodeError, ValueError) as exc:
+                    rec["type"] = f"undecodable: {exc}"
+                out.write(json.dumps(rec) + "\n")
         return 0
 
     if args.wal_command == "import":

@@ -81,3 +81,32 @@ class TestWalTools:
         assert cli_main(["wal", "export", wal_file]) == 0
         err = capsys.readouterr().err
         assert "warning" in err
+
+
+class TestRotatedGroupExport:
+    def test_export_covers_rotated_chunks_oldest_first(
+        self, tmp_path, capsys
+    ):
+        """Given the head path, export must emit the WHOLE rotated group
+        in replay order — the head alone misses every record that
+        rotated into .NNN chunks."""
+        path = str(tmp_path / "wal")
+        wal = WAL(path, group_head_size=100)
+        wal.start()
+        try:
+            for h in range(1, 9):
+                wal.write_sync(EndHeightMessage(h))
+                wal.group().check_head_size_limit()
+            assert len(wal.group().all_paths()) > 1, "never rotated"
+        finally:
+            wal.stop()
+        assert cli_main(["wal", "export", path]) == 0
+        lines = [
+            json.loads(ln)
+            for ln in capsys.readouterr().out.strip().splitlines()
+        ]
+        heights = [
+            r["height"] for r in lines if r["type"] == "EndHeightMessage"
+        ]
+        assert heights == sorted(heights), "not in replay order"
+        assert set(range(1, 9)) <= set(heights), heights
