@@ -223,9 +223,13 @@ class CryptoConfig:
     (SURVEY.md §7; no reference counterpart — v0.34 has no batch plane)."""
 
     backend: str = "cpu"  # "cpu" | "tpu"
-    # Below min_batch signatures, a batch falls back to the serial CPU
-    # path (kernel launch overhead dominates tiny batches).
-    min_batch: int = 2
+    # Below min_batch ed25519 signatures, a batch routes to the CPU
+    # plane (the device dispatch round-trip dominates small batches).
+    # Default = the measured on-chip crossover under the slower
+    # observed link floor (SMALLBATCH_onchip.jsonl; crypto/batch.py).
+    # Applied at node start as the CBFT_TPU_MIN_BATCH default — an
+    # explicitly-set env var still wins for operator A/B overrides.
+    min_batch: int = 1024
 
 
 @dataclass
@@ -259,6 +263,17 @@ class Config:
             raise ValueError("consensus.timeout_propose can't be negative")
         if self.crypto.backend not in ("cpu", "tpu"):
             raise ValueError(f"unknown crypto backend {self.crypto.backend!r}")
+        # min_batch is load-bearing (it becomes CBFT_TPU_MIN_BATCH):
+        # reject malformed TOML at startup, not at the first commit
+        if (
+            not isinstance(self.crypto.min_batch, int)
+            or isinstance(self.crypto.min_batch, bool)
+            or self.crypto.min_batch < 1
+        ):
+            raise ValueError(
+                f"crypto.min_batch must be a positive integer, got "
+                f"{self.crypto.min_batch!r}"
+            )
 
 
 def default_config() -> Config:

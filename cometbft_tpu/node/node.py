@@ -718,6 +718,11 @@ def default_new_node(config: Config, logger: Optional[Logger] = None) -> Node:
     from cometbft_tpu.crypto import batch as cryptobatch
 
     cryptobatch.set_default_backend(config.crypto.backend)
+    # [crypto] min_batch reaches the batch plane through the same knob
+    # the kernels/bench read; an operator-set env var keeps precedence
+    os.environ.setdefault(
+        "CBFT_TPU_MIN_BATCH", str(config.crypto.min_batch)
+    )
     if config.crypto.backend == "tpu":
         _warm_tpu_kernels(config)
 
