@@ -894,8 +894,15 @@ class ConsensusState(BaseService):
 
         if retain_height > 0 and self.block_store is not None:
             try:
+                base = self.block_store.base()
                 pruned = self.block_store.prune_blocks(retain_height)
                 self.logger.info("pruned blocks", pruned=pruned, retain_height=retain_height)
+                # the reference prunes the state artifacts over the same
+                # span (consensus/state.go:1717 PruneStates) — without
+                # this the per-height validators/params/ABCI-responses
+                # grow forever on a pruning chain
+                if 0 < base < retain_height:
+                    self.block_exec.store().prune_states(base, retain_height)
             except Exception as e:
                 self.logger.error("failed to prune blocks", err=str(e))
 

@@ -349,13 +349,15 @@ class TestCrashRecovery:
     """Reference: consensus/replay_test.go — kill a node, restart from
     WAL + stores, verify it continues producing blocks."""
 
-    def _build_node(self, d, doc):
+    def _build_node(self, d, doc, retain_blocks: int = 0):
         from cometbft_tpu.libs.db import SQLiteDB
 
         state_store = Store(SQLiteDB(os.path.join(d, "state.db")))
         bstore = BlockStore(SQLiteDB(os.path.join(d, "blocks.db")))
         app_db = SQLiteDB(os.path.join(d, "app.db"))
-        client = LocalClient(KVStoreApplication(app_db))
+        app = KVStoreApplication(app_db)
+        app.retain_blocks = retain_blocks
+        client = LocalClient(app)
         client.start()
 
         state = state_store.load()
@@ -368,6 +370,43 @@ class TestCrashRecovery:
         wal.start()
         cs = ConsensusState(cfg, state, executor, bstore, wal=wal)
         return cs, state_store, bstore, client
+
+    def test_retain_height_prunes_blocks_and_states(self):
+        """App-driven pruning (ResponseCommit.retain_height) must prune
+        BOTH the block store and the state store's per-height artifacts
+        (reference consensus/state.go:1708-1717 — pruneBlocks then
+        PruneStates over the same span); without the latter, validators/
+        params/responses grow forever on a pruning chain."""
+        vals, privs = test_util.deterministic_validator_set(1, 10)
+        doc = GenesisDoc(
+            genesis_time=Timestamp(1_700_000_000, 0),
+            chain_id="prune-chain",
+            validators=[
+                GenesisValidator(v.address, v.pub_key, v.voting_power, "")
+                for v in vals.validators
+            ],
+        )
+        with tempfile.TemporaryDirectory() as d:
+            # retain only the last two heights — the app requests pruning
+            cs, state_store, bstore, client = self._build_node(
+                d, doc, retain_blocks=2
+            )
+            cs.set_priv_validator(privs[0])
+            cs.start()
+            assert _wait_for_height([cs], 5, timeout=60), cs.height()
+            cs.stop()
+            client.stop()
+            base = bstore.base()
+            assert base > 1, "blocks were never pruned"
+            # pruned heights lost their state artifacts...
+            from cometbft_tpu.state.store import ErrNoABCIResponsesForHeight
+
+            with pytest.raises(ErrNoABCIResponsesForHeight):
+                state_store.load_abci_responses(1)
+            # ...while surviving heights still resolve fully
+            h = bstore.height()
+            assert state_store.load_validators(h) is not None
+            assert state_store.load_consensus_params(h) is not None
 
     def test_start_replays_wal_automatically(self):
         """The production path: cs.start() alone must run the WAL
