@@ -256,8 +256,19 @@ class ConsensusState(BaseService):
             ).start()
 
     def notify_txs_available(self) -> None:
-        """Mempool → consensus: txs exist (for CreateEmptyBlocks=false)."""
-        self.peer_msg_queue.put(MsgInfo(None, "@txs"))
+        """Mempool → consensus: txs exist (for CreateEmptyBlocks=false).
+
+        Never block: with the builtin app this fires ON the consensus
+        thread itself (commit → mempool update/recheck callbacks), whose
+        queue has no other consumer — a blocking put on a full queue
+        would deadlock the node (same hazard send_internal documents)."""
+        mi = MsgInfo(None, "@txs")
+        try:
+            self.peer_msg_queue.put_nowait(mi)
+        except queue.Full:
+            threading.Thread(
+                target=self.peer_msg_queue.put, args=(mi,), daemon=True
+            ).start()
 
     # -- the serialized event loop ------------------------------------------
 
@@ -572,8 +583,13 @@ class ConsensusState(BaseService):
         )
         self._new_step()
 
+        # reference config.WaitForTxs(): empty blocks off OR rate-limited
+        # by the interval knob (which is otherwise a no-op)
         wait_for_txs = (
-            not self.config.create_empty_blocks
+            (
+                not self.config.create_empty_blocks
+                or self.config.create_empty_blocks_interval_ns > 0
+            )
             and round_ == 0
             and not self._need_proof_block(height)
         )

@@ -42,6 +42,7 @@ class Testnet(NetObserver):
         base_dir: Optional[str] = None,
         logger: Optional[Logger] = None,
         misbehaviors: Optional[Dict[int, Dict[int, str]]] = None,
+        create_empty_blocks: bool = True,
     ):
         self.n = n_validators
         self.proxy_app = proxy_app
@@ -58,6 +59,7 @@ class Testnet(NetObserver):
         # manifest-style maverick schedule: node index → {height: name}
         # (test/e2e/networks/ci.toml:41 `misbehaviors = {1018 = "double-prevote"}`)
         self.misbehaviors = misbehaviors or {}
+        self.create_empty_blocks = create_empty_blocks
 
     # -- setup ----------------------------------------------------------------
 
@@ -100,7 +102,7 @@ class Testnet(NetObserver):
             )
             cfg.p2p.addr_book_strict = False
             cfg.consensus.timeout_commit_ns = self.timeout_commit_ns
-            cfg.consensus.create_empty_blocks = True
+            cfg.consensus.create_empty_blocks = self.create_empty_blocks
             self._configs.append(cfg)
 
     def _home(self, i: int) -> str:

@@ -312,7 +312,14 @@ class CListMempool(Mempool):
 
     def _recheck_txs(self) -> None:
         """Re-run CheckTx on surviving txs (reference: recheckTxs :641)."""
-        for elem in list(self._txs):
+        elems = list(self._txs)
+        # reference resCbRecheck notifies only once the recheck CURSOR
+        # reaches the end — notifying per-response can poke consensus
+        # while later rechecks are about to empty the mempool, yielding
+        # a spurious empty block under create_empty_blocks=false
+        self._recheck_cursor = 0
+        self._recheck_end = len(elems)
+        for elem in elems:
             mem_tx: MempoolTx = elem.value
             rr = self._proxy_app.check_tx_async(
                 abci.RequestCheckTx(
@@ -338,7 +345,12 @@ class CListMempool(Mempool):
                     tx, elem,
                     remove_from_cache=not self.config.keep_invalid_txs_in_cache,
                 )
-        self._notify_txs_available()
+        if self._recheck_end is not None:
+            self._recheck_cursor += 1
+            if self._recheck_cursor >= self._recheck_end:
+                self._recheck_cursor = None
+                self._recheck_end = None
+                self._notify_txs_available()
 
     # -- app conn plumbing ---------------------------------------------------
 

@@ -320,6 +320,21 @@ class Node(BaseService):
         )
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
+        if (
+            not config.consensus.create_empty_blocks
+            or config.consensus.create_empty_blocks_interval_ns > 0
+        ):
+            # reference node.go WaitForTxs(): TxsAvailable is enabled
+            # when empty blocks are off OR rate-limited by interval,
+            # plus the push side the reference implements as consensus's
+            # TxsAvailable-channel goroutine — without BOTH,
+            # enterNewRound waits for a poke that never comes and the
+            # chain stalls until the interval timeout (or forever, when
+            # none is configured)
+            self.mempool.enable_txs_available()
+            self.mempool.on_txs_available = (
+                self.consensus_state.notify_txs_available
+            )
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state,
             wait_sync=fast_sync or self.state_sync_enabled,

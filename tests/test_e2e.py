@@ -213,3 +213,37 @@ class TestE2ETestnet:
                 net.check_app_hashes_agree(h)
         finally:
             net.stop()
+
+
+@pytest.mark.slow
+class TestNoEmptyBlocks:
+    def test_chain_waits_for_txs_then_advances(self):
+        """create_empty_blocks = false: the chain must HOLD with an empty
+        mempool and advance once a tx arrives — which requires the node
+        to wire mempool.enable_txs_available + the push notification
+        into consensus (reference node.go + the TxsAvailable goroutine);
+        without that wiring the poke never fires and the chain stalls
+        forever."""
+        net = Testnet(
+            n_validators=2,
+            timeout_commit_ns=200_000_000,
+            create_empty_blocks=False,
+        )
+        net.setup()
+        net.start()
+        try:
+            # the first heights are proof blocks (_need_proof_block:
+            # app hash changes after genesis) — wait for them, then the
+            # chain must hold. Without suppression the 200ms commit
+            # timeout would gain dozens of heights over these samples.
+            net.wait_for_height(2, timeout=60)
+            time.sleep(5.0)
+            h0 = max(net.height(i) for i in net.live_indexes())
+            time.sleep(5.0)
+            h1 = max(net.height(i) for i in net.live_indexes())
+            assert h1 <= h0 + 1, f"chain advanced without txs: {h0} -> {h1}"
+            # one tx unblocks the next height
+            net.client(0).broadcast_tx_sync(b"wake=up")
+            net.wait_for_height(h1 + 1, timeout=60)
+        finally:
+            net.stop()
