@@ -407,6 +407,34 @@ class Node(BaseService):
             seeds = [
                 s.strip() for s in config.p2p.seeds.split(",") if s.strip()
             ]
+            # reference node.go createAddrBookAndSetOnSwitch: our own
+            # advertised address never re-enters the book (self-dial /
+            # self-gossip guard), and operator-marked private peers are
+            # excluded from PEX gossip — without these the
+            # private_peer_ids knob is inert and sentry-protected
+            # validators leak
+            # BOTH the advertised (external) and listen addresses are
+            # ours, resolved the way peers would record them — a
+            # hostname external_address re-gossiped in resolved-IP form
+            # must still match the guard
+            for raw_addr in {config.p2p.external_address, config.p2p.laddr}:
+                if not raw_addr:
+                    continue
+                own_host, own_port = _parse_laddr(raw_addr)
+                try:
+                    own = NetAddress.from_string(
+                        f"{node_key.id()}@{own_host}:{own_port}"
+                    )
+                except (ValueError, OSError):
+                    own = NetAddress(node_key.id(), own_host, own_port)
+                self.addr_book.add_our_address(own)
+            private_ids = [
+                p.strip()
+                for p in config.p2p.private_peer_ids.split(",")
+                if p.strip()
+            ]
+            if private_ids:
+                self.addr_book.add_private_ids(private_ids)
             self.pex_reactor = PEXReactor(
                 self.addr_book,
                 seeds=seeds,

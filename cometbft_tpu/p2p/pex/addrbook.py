@@ -265,6 +265,12 @@ class AddrBook(BaseService):
                 raise ValueError(f"non-routable address {addr}")
             if str(addr) in self._our_addrs or addr.id in self._private_ids:
                 return
+            if src is not None and src.id in self._private_ids:
+                # reference ErrAddrBookPrivateSrc: addresses learned FROM
+                # a private peer must not enter the book either
+                raise ValueError(
+                    f"address {addr} learned from private peer {src.id}"
+                )
             banned = self._banned.get(addr.id)
             if banned is not None:
                 if banned.is_banned():

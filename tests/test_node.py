@@ -683,3 +683,50 @@ class TestThreadHygiene:
                 f"non-daemon threads leaked after stop: "
                 f"{[t.name for t in leaked]}"
             )
+
+
+class TestAddrBookWiring:
+    def test_our_address_and_private_ids_excluded(self):
+        """Reference createAddrBookAndSetOnSwitch: the node's own
+        advertised address and operator-marked private peers must never
+        enter the address book (self-dial guard; sentry privacy —
+        without the wiring the private_peer_ids knob is inert)."""
+        from cometbft_tpu.cmd.commands import _load_config
+        from cometbft_tpu.node import default_new_node
+        from cometbft_tpu.p2p.netaddr import NetAddress
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "ab-wire"])
+            rpc_port, p2p_port = _free_ports(2)
+            cfg = _load_config(d)
+            cfg.base.proxy_app = "kvstore"
+            cfg.rpc.laddr = ""
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            cfg.p2p.addr_book_strict = False
+            private_id = "ab" * 20
+            cfg.p2p.private_peer_ids = private_id
+            node = default_new_node(cfg)
+            book = node.addr_book
+            assert book is not None
+            src = NetAddress("cd" * 20, "127.0.0.1", 40001)
+            # a peer gossiping our own address back: silently dropped
+            ours = NetAddress(node.node_key.id(), "127.0.0.1", p2p_port)
+            book.add_address(ours, src)
+            assert not book.has_address(ours)
+            # a private peer's address: never enters the book
+            priv = NetAddress(private_id, "127.0.0.1", 40002)
+            book.add_address(priv, src)
+            assert not book.has_address(priv)
+            # an ordinary peer still lands
+            ok = NetAddress("ef" * 20, "127.0.0.1", 40003)
+            book.add_address(ok, src)
+            assert book.has_address(ok)
+            # addresses learned FROM a private peer are rejected too
+            # (reference ErrAddrBookPrivateSrc)
+            priv_src = NetAddress(private_id, "127.0.0.1", 40002)
+            import pytest as _pytest
+
+            with _pytest.raises(ValueError):
+                book.add_address(
+                    NetAddress("12" * 20, "127.0.0.1", 40004), priv_src
+                )
