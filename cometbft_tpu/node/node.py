@@ -116,6 +116,7 @@ class Node(BaseService):
         db_provider=None,  # (name, config) -> DB
         state_provider=None,  # statesync.StateProvider (when statesync on)
         logger: Optional[Logger] = None,
+        genesis_hash: Optional[bytes] = None,  # sha256 of the RAW file
     ):
         super().__init__("Node", logger or new_nop_logger())
         self.config = config
@@ -127,7 +128,7 @@ class Node(BaseService):
         try:
             self._setup(
                 config, priv_validator, node_key, client_creator,
-                genesis_doc, db_provider, state_provider,
+                genesis_doc, db_provider, state_provider, genesis_hash,
             )
         except Exception:
             self._abort_init()
@@ -142,6 +143,7 @@ class Node(BaseService):
         genesis_doc: GenesisDoc,
         db_provider,
         state_provider,
+        genesis_hash: Optional[bytes] = None,
     ) -> None:
         _provider = db_provider or default_db_provider
 
@@ -183,7 +185,22 @@ class Node(BaseService):
         self.block_store = BlockStore(db_provider("blockstore", config))
         self.state_store = StateStore(db_provider("state", config))
 
-        # 2. state from DB or genesis
+        # 2. state from DB or genesis — with the genesis doc's hash
+        # pinned in the state DB on first boot (node.go:1394-1449
+        # LoadStateFromDBOrGenesisDocProvider): booting existing data
+        # against a DIFFERENT genesis must fail loudly up front, not
+        # surface later as app-hash divergence. File-based boots pin the
+        # RAW file hash (stable even for zero-genesis-time files, whose
+        # completed form re-stamps the time on every load); direct
+        # embedders fall back to the doc's canonical-JSON hash.
+        gen_hash = genesis_hash or genesis_doc.sha256()
+        stored = self.state_store.load_genesis_doc_hash()
+        if stored is None:
+            self.state_store.save_genesis_doc_hash(gen_hash)
+        elif stored != gen_hash:
+            raise ValueError(
+                "genesis doc hash in db does not match loaded genesis doc"
+            )
         state = self.state_store.load()
         if state is None:
             state = make_genesis_state(genesis_doc)
@@ -776,19 +793,32 @@ def default_new_node(config: Config, logger: Optional[Logger] = None) -> Node:
         config.base.priv_validator_key_path(),
         config.base.priv_validator_state_path(),
     )
-    with open(config.base.genesis_path()) as f:
-        genesis_doc = GenesisDoc.from_json(f.read())
+    with open(config.base.genesis_path(), "rb") as f:
+        raw_genesis = f.read()
+    import hashlib as _hashlib
+
+    genesis_doc = GenesisDoc.from_json(raw_genesis.decode())
     app_db = default_db_provider("app", config)
-    node = Node(
-        config,
-        priv_validator,
-        node_key,
-        default_client_creator(
-            config.base.proxy_app, app_db, transport=config.base.abci
-        ),
-        genesis_doc,
-        logger=logger,
-    )
+    try:
+        node = Node(
+            config,
+            priv_validator,
+            node_key,
+            default_client_creator(
+                config.base.proxy_app, app_db, transport=config.base.abci
+            ),
+            genesis_doc,
+            logger=logger,
+            genesis_hash=_hashlib.sha256(raw_genesis).digest(),
+        )
+    except Exception:
+        # Node's own abort path closes provider-tracked DBs; the app DB
+        # opened above is ours to release
+        try:
+            app_db.close()
+        except Exception:
+            pass
+        raise
     # the app DB is created outside Node's tracking provider; register it
     # so on_stop releases its file locks too
     node._dbs.append(app_db)

@@ -287,6 +287,17 @@ class Store:
 
     # -- pruning ------------------------------------------------------------
 
+    # -- genesis pin (node.go:1394-1449) ------------------------------------
+
+    _GENESIS_HASH_KEY = b"genesisDocHash"
+
+    def load_genesis_doc_hash(self):
+        """The genesis hash pinned at first boot, or None."""
+        return self._db.get(self._GENESIS_HASH_KEY)
+
+    def save_genesis_doc_hash(self, h: bytes) -> None:
+        self._db.set_sync(self._GENESIS_HASH_KEY, h)
+
     def prune_states(self, from_height: int, to_height: int) -> None:
         """Delete state artifacts in [from, to), keeping back-pointer
         targets and checkpoints (store.go:243-330)."""

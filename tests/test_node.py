@@ -730,3 +730,31 @@ class TestAddrBookWiring:
                 book.add_address(
                     NetAddress("12" * 20, "127.0.0.1", 40004), priv_src
                 )
+
+
+class TestGenesisHashPinning:
+    def test_changed_genesis_refuses_existing_data(self):
+        """node.go:1394-1449: the genesis doc's hash is pinned in the
+        state DB on first boot; booting the same home against a DIFFERENT
+        genesis must fail up front instead of diverging on app hashes."""
+        from cometbft_tpu.cmd.commands import _load_config
+        from cometbft_tpu.node import default_new_node
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "gen-pin"])
+            (p2p_port,) = _free_ports(1)
+            cfg = _load_config(d)
+            cfg.base.proxy_app = "kvstore"
+            cfg.rpc.laddr = ""
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            node = default_new_node(cfg)  # first boot pins the hash
+            node._abort_init()  # constructed-but-unstarted teardown
+            # same genesis: boots fine (raw-file hash is stable)
+            node2 = default_new_node(cfg)
+            node2._abort_init()
+            # tamper with genesis (different chain id)
+            gp = os.path.join(d, "config", "genesis.json")
+            raw = open(gp).read().replace("gen-pin", "gen-pin-2")
+            open(gp, "w").write(raw)
+            with pytest.raises(ValueError, match="genesis doc hash"):
+                default_new_node(cfg)
