@@ -189,18 +189,20 @@ class Node(BaseService):
         # pinned in the state DB on first boot (node.go:1394-1449
         # LoadStateFromDBOrGenesisDocProvider): booting existing data
         # against a DIFFERENT genesis must fail loudly up front, not
-        # surface later as app-hash divergence. File-based boots pin the
-        # RAW file hash (stable even for zero-genesis-time files, whose
-        # completed form re-stamps the time on every load); direct
-        # embedders fall back to the doc's canonical-JSON hash.
-        gen_hash = genesis_hash or genesis_doc.sha256()
-        stored = self.state_store.load_genesis_doc_hash()
-        if stored is None:
-            self.state_store.save_genesis_doc_hash(gen_hash)
-        elif stored != gen_hash:
-            raise ValueError(
-                "genesis doc hash in db does not match loaded genesis doc"
-            )
+        # surface later as app-hash divergence. Only file-based boots
+        # (default_new_node) pin: they hash the RAW file, which is
+        # stable across boots. Direct embedders pass no hash and skip
+        # the guard — the completed doc re-stamps a zero genesis_time
+        # on every load, so a canonical-JSON fallback would refuse
+        # perfectly valid reboots.
+        if genesis_hash is not None:
+            stored = self.state_store.load_genesis_doc_hash()
+            if stored is None:
+                self.state_store.save_genesis_doc_hash(genesis_hash)
+            elif stored != genesis_hash:
+                raise ValueError(
+                    "genesis doc hash in db does not match loaded genesis doc"
+                )
         state = self.state_store.load()
         if state is None:
             state = make_genesis_state(genesis_doc)
@@ -408,6 +410,16 @@ class Node(BaseService):
         self.switch.add_reactor("CONSENSUS", self.consensus_reactor)
         self.switch.add_reactor("EVIDENCE", self.evidence_reactor)
         self.switch.add_reactor("STATESYNC", self.statesync_reactor)
+        from cometbft_tpu.p2p.key import validate_id as _validate_id
+
+        uncond = set()
+        for p in config.p2p.unconditional_peer_ids.split(","):
+            p = p.strip().lower()
+            if not p:
+                continue
+            _validate_id(p)  # a malformed ID must fail config, not be inert
+            uncond.add(p)
+        self.switch.unconditional_peer_ids = uncond
 
         # 12. PEX + addrbook
         self.pex_reactor = None

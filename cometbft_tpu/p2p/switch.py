@@ -91,6 +91,10 @@ class Switch(BaseService):
         self.reconnecting: Dict[str, bool] = {}
         self._dialing_mtx = threading.Lock()
         self.persistent_peer_ids: set = set()
+        # operator-listed peers exempt from the connection limits even
+        # when not persistent (reference: p2p.unconditional_peer_ids —
+        # e.g. a sentry's validator)
+        self.unconditional_peer_ids: set = set()
         self.max_inbound_peers = max_inbound_peers
         self.max_outbound_peers = max_outbound_peers
         self.reconnect_interval = reconnect_interval
@@ -170,7 +174,10 @@ class Switch(BaseService):
         return sum(1 for p in self.peers.list() if not p.is_outbound())
 
     def _is_unconditional(self, peer_id: str) -> bool:
-        return peer_id in self.persistent_peer_ids
+        return (
+            peer_id in self.persistent_peer_ids
+            or peer_id in self.unconditional_peer_ids
+        )
 
     # -- outbound -----------------------------------------------------------
 

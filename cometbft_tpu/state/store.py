@@ -285,8 +285,6 @@ class Store:
             raise ErrNoABCIResponsesForHeight(height)
         return ABCIResponses.decode(raw)
 
-    # -- pruning ------------------------------------------------------------
-
     # -- genesis pin (node.go:1394-1449) ------------------------------------
 
     _GENESIS_HASH_KEY = b"genesisDocHash"
@@ -297,6 +295,8 @@ class Store:
 
     def save_genesis_doc_hash(self, h: bytes) -> None:
         self._db.set_sync(self._GENESIS_HASH_KEY, h)
+
+    # -- pruning ------------------------------------------------------------
 
     def prune_states(self, from_height: int, to_height: int) -> None:
         """Delete state artifacts in [from, to), keeping back-pointer

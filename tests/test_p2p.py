@@ -519,3 +519,41 @@ class TestNetAddress:
         assert not NetAddress("", "127.0.0.1", 80).routable()
         assert not NetAddress("", "192.168.1.1", 80).routable()
         assert NetAddress("", "8.8.8.8", 80).routable()
+
+
+class TestUnconditionalPeers:
+    def test_exempt_from_inbound_cap(self):
+        """p2p.unconditional_peer_ids: listed peers connect past the
+        inbound limit even when not persistent (reference switch.go —
+        the knob was previously inert; only persistent peers were
+        exempt)."""
+        t1 = _make_transport()
+        sw1 = Switch(t1, max_inbound_peers=0)  # zero cap: everyone refused
+        sw1.add_reactor("echo", EchoReactor([0x01, 0x02]))
+        sw2, _ = _make_switch()
+        sw1.start()
+        sw2.start()
+        try:
+            addr = sw1.transport.listen_addr
+            # over-cap and not listed: the inbound side never admits it
+            try:
+                sw2.dial_peer_with_address(addr)
+            except Exception:
+                pass
+            time.sleep(0.5)
+            assert sw1.peers.size() == 0
+            # listed as unconditional: admitted despite the zero cap.
+            # Retry inside the wait — the first refused dial may linger
+            # briefly on sw2's side as a dead duplicate
+            sw1.unconditional_peer_ids.add(sw2.transport.node_key.id())
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and sw1.peers.size() != 1:
+                try:
+                    sw2.dial_peer_with_address(addr)
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            assert sw1.peers.size() == 1
+        finally:
+            _safe_stop(sw1)
+            _safe_stop(sw2)
