@@ -429,11 +429,26 @@ def cmd_abci(args) -> int:
 def cmd_debug(args) -> int:
     """cmd/cometbft/commands/debug/ — `dump` collects a diagnostic bundle
     (config, status + consensus state via RPC, pprof stacks/heap, WAL
-    tail) into a tar.gz; `inspect` serves a read-only subset of the RPC
-    over a crashed node's data dirs (no p2p, no consensus)."""
+    tail) into a tar.gz; `kill` collects the same bundle then SIGABRTs
+    the node (debug/kill.go); `inspect` serves a read-only subset of the
+    RPC over a crashed node's data dirs (no p2p, no consensus)."""
     sub = args.debug_command
     if sub == "dump":
         return _debug_dump(args)
+    if sub == "kill":
+        # reference debug/kill.go: collect the bundle FIRST (the node is
+        # about to die), then SIGABRT so the runtime dumps stacks to the
+        # node's own stderr for the post-mortem
+        if args.pid <= 0:
+            print("debug kill requires --pid", file=sys.stderr)
+            return 1
+        rc = _debug_dump(args)
+        try:
+            os.kill(args.pid, signal.SIGABRT)
+        except OSError as exc:
+            print(f"failed to signal pid {args.pid}: {exc}", file=sys.stderr)
+            return 1
+        return rc
     if sub == "inspect":
         return _debug_inspect(args)
     print(f"unknown debug command {sub!r}", file=sys.stderr)
@@ -1058,10 +1073,15 @@ def main(argv: Optional[list] = None) -> int:
     p.set_defaults(fn=cmd_unsafe_reset_all)
 
     p = sub.add_parser(
-        "debug", help="diagnostic bundle (dump) / crashed-home RPC (inspect)"
+        "debug",
+        help="diagnostic bundle (dump) / crashed-home RPC (inspect) / "
+        "bundle-then-SIGABRT a live node (kill)",
     )
-    p.add_argument("debug_command", choices=["dump", "inspect"])
-    p.add_argument("--output", default="", help="bundle path (dump)")
+    p.add_argument("debug_command", choices=["dump", "inspect", "kill"])
+    p.add_argument(
+        "--pid", type=int, default=0, help="node process id (kill)"
+    )
+    p.add_argument("--output", default="", help="bundle path (dump/kill)")
     p.add_argument(
         "--laddr", default="tcp://127.0.0.1:26669", help="inspect listen addr"
     )

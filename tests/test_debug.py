@@ -167,3 +167,35 @@ class TestDebugCLI:
             finally:
                 proc.kill()
                 proc.wait()
+
+
+class TestDebugKill:
+    def test_kill_bundles_then_signals(self, tmp_path):
+        """`debug kill --pid N`: the bundle lands BEFORE the SIGABRT
+        (reference debug/kill.go order — the node is about to die)."""
+        import signal
+        import subprocess
+        import sys as _sys
+        import tarfile
+
+        from cometbft_tpu.cmd.commands import main as cli_main
+
+        home = str(tmp_path / "home")
+        cli_main(["--home", home, "init", "--chain-id", "dbg-kill"])
+        victim = subprocess.Popen(
+            [_sys.executable, "-c", "import time; time.sleep(60)"]
+        )
+        out = str(tmp_path / "bundle.tar.gz")
+        try:
+            rc = cli_main(
+                ["--home", home, "debug", "kill",
+                 "--pid", str(victim.pid), "--output", out]
+            )
+            assert rc == 0
+            assert tarfile.is_tarfile(out)
+            assert victim.wait(timeout=10) == -signal.SIGABRT
+        finally:
+            if victim.poll() is None:
+                victim.kill()
+        # missing pid is a usage error, not a signal to pid 0
+        assert cli_main(["--home", home, "debug", "kill"]) == 1
