@@ -142,6 +142,7 @@ class ConsensusState(BaseService):
             wal.start()
             self.wal = wal
         self._wal_catchup()
+        self._check_double_signing_risk()
         self.ticker.start()
         self._receive_thread = threading.Thread(
             target=self._receive_routine, daemon=True, name="cs-receive"
@@ -185,6 +186,37 @@ class ConsensusState(BaseService):
                 )
                 self._wal_catchup_done = True  # attempted; never re-run
                 return
+
+    def _check_double_signing_risk(self) -> None:
+        """Reference consensus/state.go:2286 checkDoubleSigningRisk
+        (called from OnStart): with double_sign_check_height > 0, refuse
+        to start if our key already signed a commit within the last N
+        heights — the operator likely restored the sign state from an
+        old backup, and signing fresh votes from it risks equivocation.
+        Off by default, like the reference."""
+        n = self.config.double_sign_check_height
+        height = self.rs.height
+        if (
+            n <= 0
+            or height <= 0
+            or self.priv_validator is None
+            or self.priv_validator_pub_key is None
+            or self.block_store is None
+        ):
+            return
+        val_addr = self.priv_validator_pub_key.address()
+        for i in range(1, min(n, height)):
+            commit = self.block_store.load_seen_commit(height - i)
+            if commit is None:
+                continue
+            for sig in commit.signatures:
+                if sig.for_block() and sig.validator_address == val_addr:
+                    raise RuntimeError(
+                        f"found signature from our key at height "
+                        f"{height - i} within double_sign_check_height="
+                        f"{n}; the sign state may be restored from an "
+                        "old backup — refusing to start"
+                    )
 
     def on_stop(self) -> None:
         self.ticker.stop()

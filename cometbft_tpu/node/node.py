@@ -357,6 +357,10 @@ class Node(BaseService):
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state,
             wait_sync=fast_sync or self.state_sync_enabled,
+            gossip_sleep=config.consensus.peer_gossip_sleep_duration_ns / 1e9,
+            query_maj23_sleep=(
+                config.consensus.peer_query_maj23_sleep_duration_ns / 1e9
+            ),
             logger=self.logger,
         )
 

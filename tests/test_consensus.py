@@ -371,6 +371,36 @@ class TestCrashRecovery:
         cs = ConsensusState(cfg, state, executor, bstore, wal=wal)
         return cs, state_store, bstore, client
 
+    def test_double_sign_check_refuses_stale_sign_state(self):
+        """consensus/state.go:2286 checkDoubleSigningRisk: with
+        double_sign_check_height set, a restart whose recent commits
+        carry OUR signature refuses to start (stale/backup sign state →
+        equivocation risk). Off by default."""
+        vals, privs = test_util.deterministic_validator_set(1, 10)
+        doc = GenesisDoc(
+            genesis_time=Timestamp(1_700_000_000, 0),
+            chain_id="dsc-chain",
+            validators=[
+                GenesisValidator(v.address, v.pub_key, v.voting_power, "")
+                for v in vals.validators
+            ],
+        )
+        with tempfile.TemporaryDirectory() as d:
+            cs, state_store, bstore, client = self._build_node(d, doc)
+            cs.set_priv_validator(privs[0])
+            cs.start()
+            assert _wait_for_height([cs], 3), cs.height()
+            cs.stop()
+            client.stop()
+            time.sleep(0.1)
+            # restart with the guard ON: the last commits carry our sig
+            cs2, _, _, client2 = self._build_node(d, doc)
+            cs2.config.double_sign_check_height = 10
+            cs2.set_priv_validator(privs[0])
+            with pytest.raises(Exception, match="double_sign_check"):
+                cs2.start()
+            client2.stop()
+
     def test_retain_height_prunes_blocks_and_states(self):
         """App-driven pruning (ResponseCommit.retain_height) must prune
         BOTH the block store and the state store's per-height artifacts
