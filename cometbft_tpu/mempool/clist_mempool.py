@@ -302,6 +302,12 @@ class CListMempool(Mempool):
             if elem is not None:
                 self._remove_tx(tx, elem, remove_from_cache=False)
 
+        # v1 hook: TTL-expired txs leave BEFORE metrics/recheck/notify
+        # (reference v1 Update order: purgeExpiredTxs, then recheck) —
+        # purging after would recheck doomed txs, overstate the size
+        # metric, and let recheck completion wake consensus for a pool
+        # the purge is about to empty
+        self._purge_expired(height)
         self.metrics.size.set(self.size())
         if self.size() > 0:
             if self.config.recheck:
@@ -309,6 +315,9 @@ class CListMempool(Mempool):
                 self._recheck_txs()
             else:
                 self._notify_txs_available()
+
+    def _purge_expired(self, height: int) -> None:
+        """v0 has no TTLs; the v1 priority mempool overrides."""
 
     def _recheck_txs(self) -> None:
         """Re-run CheckTx on surviving txs (reference: recheckTxs :641)."""

@@ -12,6 +12,7 @@ priority index.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 from cometbft_tpu.abci import types as abci
@@ -25,6 +26,7 @@ from cometbft_tpu.mempool.clist_mempool import (
 class PriorityTx(MempoolTx):
     priority: int = 0
     seq: int = 0  # insertion order; ties reap FIFO
+    timestamp: float = 0.0  # admission wall time, for ttl_duration
 
 
 class PriorityMempool(CListMempool):
@@ -54,6 +56,7 @@ class PriorityMempool(CListMempool):
         mem_tx = PriorityTx(self._height, r.gas_wanted, tx)
         mem_tx.priority = r.priority
         mem_tx.seq = self._next_seq()
+        mem_tx.timestamp = time.time()
         if tx_info.sender_id:
             mem_tx.senders.add(tx_info.sender_id)
         self._add_tx(mem_tx)
@@ -66,6 +69,29 @@ class PriorityMempool(CListMempool):
         if res.kind == "check_tx" and res.value.code == 0:
             elem.value.priority = res.value.priority
         super()._res_cb_recheck(tx, elem, res)
+
+    def _purge_expired(self, height: int) -> None:
+        """v1 mempool.go Update → purgeExpiredTxs. Runs inside the base
+        update BEFORE metrics/recheck/notify (the reference's order):
+        purging after would recheck doomed txs and fire a spurious
+        txs-available wakeup. [mempool] ttl_num_blocks / ttl_duration
+        were previously inert."""
+        ttl_blocks = self.config.ttl_num_blocks
+        ttl_s = self.config.ttl_duration_ns / 1e9
+        if ttl_blocks <= 0 and ttl_s <= 0:
+            return
+        now = time.time()
+        for elem in list(self._txs):
+            mem_tx = elem.value
+            expired = (
+                ttl_blocks > 0 and height - mem_tx.height > ttl_blocks
+            ) or (
+                ttl_s > 0
+                and getattr(mem_tx, "timestamp", 0.0) > 0
+                and now - mem_tx.timestamp > ttl_s
+            )
+            if expired:
+                self._remove_tx(mem_tx.tx, elem, remove_from_cache=True)
 
     def _next_seq(self) -> int:
         with self._internal_mtx:

@@ -394,6 +394,7 @@ class _WSConn:
                 self._unsubscribe(rid, params.get("query", ""))
             elif method == "unsubscribe_all":
                 self._env.node.event_bus.unsubscribe_all(self._subscriber)
+                self._subs.clear()  # stale entries would count toward caps
                 self._send_json({"jsonrpc": "2.0", "id": rid, "result": {}})
             else:
                 result = _dispatch(self._env, method, params)
@@ -410,6 +411,25 @@ class _WSConn:
     def _subscribe(self, rid, query_str: str) -> None:
         q = parse_query(query_str)
         bus = self._env.node.event_bus
+        # reference rpc/core/events.go Subscribe: both limits enforced at
+        # subscribe time — the config knobs were previously inert
+        rpc_cfg = self._env.node.config.rpc
+        max_clients = rpc_cfg.max_subscription_clients
+        max_per_client = rpc_cfg.max_subscriptions_per_client
+        if (
+            max_clients > 0
+            and not self._subs
+            and bus.num_clients() >= max_clients
+        ):
+            raise RPCError(
+                -32000,
+                f"max_subscription_clients {max_clients} reached",
+            )
+        if max_per_client > 0 and len(self._subs) >= max_per_client:
+            raise RPCError(
+                -32000,
+                f"max_subscriptions_per_client {max_per_client} reached",
+            )
         sub = bus.subscribe(self._subscriber, q)
         self._subs[query_str] = sub
         self._send_json({"jsonrpc": "2.0", "id": rid, "result": {}})

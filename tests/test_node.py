@@ -758,3 +758,46 @@ class TestGenesisHashPinning:
             open(gp, "w").write(raw)
             with pytest.raises(ValueError, match="genesis doc hash"):
                 default_new_node(cfg)
+
+
+class TestSubscriptionLimits:
+    def test_per_client_subscription_cap(self):
+        """rpc/core/events.go Subscribe: max_subscriptions_per_client is
+        enforced at subscribe time (the knob was previously inert)."""
+        from cometbft_tpu.cmd.commands import _load_config
+        from cometbft_tpu.node import default_new_node
+        from cometbft_tpu.rpc.client import RPCClientError, WSClient
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "sub-cap"])
+            rpc_port, p2p_port = _free_ports(2)
+            cfg = _load_config(d)
+            cfg.base.proxy_app = "kvstore"
+            cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+            cfg.rpc.max_subscriptions_per_client = 2
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            cfg.consensus.timeout_commit_ns = 200_000_000
+            node = default_new_node(cfg)
+            node.start()
+            ws = None
+            try:
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline and ws is None:
+                    try:
+                        ws = WSClient(f"127.0.0.1:{rpc_port}")
+                        ws.connect()
+                    except OSError:
+                        ws = None
+                        time.sleep(0.3)
+                assert ws is not None
+                ws.subscribe("tm.event='NewBlock'")
+                ws.subscribe("tm.event='Tx'")
+                with pytest.raises(RPCClientError, match="per_client"):
+                    ws.subscribe("tm.event='NewBlockHeader'")
+            finally:
+                if ws is not None:
+                    try:
+                        ws.close()
+                    except Exception:
+                        pass
+                node.stop()

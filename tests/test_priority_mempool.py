@@ -141,3 +141,51 @@ class TestPriorityMempool:
 
         assert issubclass(PM, CL)
         assert cfg.mempool.version == "v1"
+
+
+class TestTTLEviction:
+    def test_ttl_num_blocks_purges_on_update(self):
+        """[mempool] ttl_num_blocks: txs older than N heights are purged
+        at commit (v1 mempool.go purgeExpiredTxs — the knob was inert)."""
+        mp, client = _mk()
+        mp.config.ttl_num_blocks = 2
+        mp.check_tx(_tx(5, "old"), None)
+        mp.flush_app_conn()
+        assert mp.size() == 1
+        mp.lock()
+        try:
+            for h in (1, 2, 3, 4):
+                mp.update(h, [], [])
+        finally:
+            mp.unlock()
+        assert mp.size() == 0, "expired tx survived"
+        client.stop()
+
+    def test_ttl_duration_purges_on_update(self):
+        import time as _t
+
+        mp, client = _mk()
+        mp.config.ttl_duration_ns = int(0.05 * 1e9)  # 50 ms
+        mp.check_tx(_tx(5, "stale"), None)
+        mp.flush_app_conn()
+        assert mp.size() == 1
+        _t.sleep(0.1)
+        mp.lock()
+        try:
+            mp.update(1, [], [])
+        finally:
+            mp.unlock()
+        assert mp.size() == 0
+        client.stop()
+
+    def test_no_ttl_keeps_txs(self):
+        mp, client = _mk()
+        mp.check_tx(_tx(5, "keep"), None)
+        mp.flush_app_conn()
+        mp.lock()
+        try:
+            mp.update(1, [], [])
+        finally:
+            mp.unlock()
+        assert mp.size() == 1
+        client.stop()
