@@ -425,6 +425,21 @@ class Node(BaseService):
             uncond.add(p)
         self.switch.unconditional_peer_ids = uncond
 
+        if config.p2p.test_fuzz:
+            # fault injection for nets (reference p2p/fuzz.go + config
+            # :663-684): every raw conn gets random delay/drop under the
+            # secret connection — the knob was previously inert
+            from cometbft_tpu.p2p.fuzz import FuzzConnConfig, FuzzedSocket
+
+            fuzz_cfg = FuzzConnConfig()
+            # grace period before fuzzing starts, "so we have time to do
+            # peer handshakes and get set up" (reference testPeerConn
+            # uses FuzzConnAfter with 10s) — fuzzing from byte 0 would
+            # kill nearly every handshake and degenerate into no peering
+            self.transport.conn_wrapper = (
+                lambda c: FuzzedSocket(c, fuzz_cfg, start_after=10.0)
+            )
+
         # 12. PEX + addrbook
         self.pex_reactor = None
         self.addr_book = None

@@ -84,6 +84,10 @@ class MultiplexTransport:
         self.listen_addr: Optional[NetAddress] = None
         # conn filters, e.g. the switch's duplicate-IP guard
         self.conn_filters: List[Callable[[socket.socket], None]] = []
+        # optional raw-socket wrapper applied before the secret-connection
+        # upgrade — the fault-injection hook ([p2p] test_fuzz wraps conns
+        # in FuzzedSocket, reference p2p/fuzz.go)
+        self.conn_wrapper: Optional[Callable] = None
         self._closed = False
 
     # -- listening ----------------------------------------------------------
@@ -127,6 +131,8 @@ class MultiplexTransport:
         dialed_addr: Optional[NetAddress],
         socket_addr: NetAddress,
     ) -> UpgradedConn:
+        if self.conn_wrapper is not None:
+            c = self.conn_wrapper(c)
         c.settimeout(self.handshake_timeout)
         try:
             sc = SecretConnection.make(c, self.node_key.priv_key)

@@ -296,3 +296,36 @@ class TestPEXOverRealSwitches:
         finally:
             sw_b.stop()
             sw_a.stop()
+
+
+class TestFuzzWiring:
+    def test_test_fuzz_wraps_transport_conns(self):
+        """[p2p] test_fuzz was inert: the FuzzedSocket existed but no
+        transport ever applied it. A node built with the knob on must
+        wrap raw conns before the secret-connection upgrade."""
+        import tempfile
+
+        from cometbft_tpu.cmd.commands import main as cli_main, _load_config
+        from cometbft_tpu.libs.net import free_ports
+        from cometbft_tpu.node import default_new_node
+        from cometbft_tpu.p2p.fuzz import FuzzedSocket
+
+        with tempfile.TemporaryDirectory() as d:
+            cli_main(["--home", d, "init", "--chain-id", "fuzz-wire"])
+            (p2p_port,) = free_ports(1)
+            cfg = _load_config(d)
+            cfg.base.proxy_app = "kvstore"
+            cfg.rpc.laddr = ""
+            cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+            cfg.p2p.test_fuzz = True
+            node = default_new_node(cfg)
+            try:
+                assert node.transport.conn_wrapper is not None
+
+                class _Sock:
+                    pass
+
+                wrapped = node.transport.conn_wrapper(_Sock())
+                assert isinstance(wrapped, FuzzedSocket)
+            finally:
+                node._abort_init()
