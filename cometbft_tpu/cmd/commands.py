@@ -225,6 +225,9 @@ def cmd_testnet(args) -> int:
             p for j, p in enumerate(peers) if j != i
         )
         cfg.p2p.addr_book_strict = False
+        # every node shares one host IP in a localnet (testnet.go sets
+        # this alongside addr_book_strict=false)
+        cfg.p2p.allow_duplicate_ip = True
         with open(cfg.base.genesis_path(), "w") as f:
             f.write(doc.to_json())
         write_config_file(os.path.join(home, "config", "config.toml"), cfg)

@@ -425,6 +425,54 @@ class Node(BaseService):
             uncond.add(p)
         self.switch.unconditional_peer_ids = uncond
 
+        if not config.p2p.allow_duplicate_ip:
+            # reference ConnDuplicateIPFilter: a second inbound conn from
+            # an IP we already hold a peer on is refused at accept
+            def _dup_ip_filter(sock) -> None:
+                rip = sock.getpeername()[0]
+                for p in self.switch.peers.list():
+                    sa = p.socket_addr
+                    if sa is not None and sa.ip == rip:
+                        raise ValueError(f"duplicate IP {rip}")
+
+            self.transport.conn_filters.append(_dup_ip_filter)
+
+        if config.base.filter_peers:
+            # reference createTransport (node.go:500): vet every conn by
+            # address and every peer by ID through the app's Query conn;
+            # non-OK code rejects — the knob was previously inert
+            import concurrent.futures as _futures
+
+            from cometbft_tpu.abci import types as _abci
+
+            _query_conn = self.proxy_app.query()
+            _filter_pool = _futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="abci-peer-filter"
+            )
+
+            def _bounded_query(path: str) -> None:
+                # reference filterTimeout (5s): a hung app Query must
+                # drop ONE conn, not wedge the accept loop forever
+                fut = _filter_pool.submit(
+                    _query_conn.query_sync, _abci.RequestQuery(path=path)
+                )
+                try:
+                    res = fut.result(timeout=5.0)
+                except _futures.TimeoutError:
+                    raise ValueError("abci peer filter timed out") from None
+                if res.code != _abci.CODE_TYPE_OK:
+                    raise ValueError(f"rejected by app: {res.code}")
+
+            def _abci_addr_filter(sock) -> None:
+                host, port = sock.getpeername()[:2]
+                _bounded_query(f"/p2p/filter/addr/{host}:{port}")
+
+            def _abci_id_filter(peer_id: str) -> None:
+                _bounded_query(f"/p2p/filter/id/{peer_id}")
+
+            self.transport.conn_filters.append(_abci_addr_filter)
+            self.switch.peer_filters.append(_abci_id_filter)
+
         if config.p2p.test_fuzz:
             # fault injection for nets (reference p2p/fuzz.go + config
             # :663-684): every raw conn gets random delay/drop under the

@@ -95,6 +95,10 @@ class Switch(BaseService):
         # when not persistent (reference: p2p.unconditional_peer_ids —
         # e.g. a sentry's validator)
         self.unconditional_peer_ids: set = set()
+        # ID-level peer filters (reference PeerFilterFunc, e.g. the ABCI
+        # /p2p/filter/id/<id> query under [base] filter_peers); raising
+        # rejects the peer after the handshake, before admission
+        self.peer_filters: List = []
         self.max_inbound_peers = max_inbound_peers
         self.max_outbound_peers = max_outbound_peers
         self.reconnect_interval = reconnect_interval
@@ -266,6 +270,14 @@ class Switch(BaseService):
     # -- peer add/remove ----------------------------------------------------
 
     def _add_peer(self, up: UpgradedConn) -> None:
+        for pf in self.peer_filters:
+            try:
+                pf(up.node_info.id())
+            except Exception as exc:
+                up.secret_conn.close()
+                raise RejectedError(
+                    f"peer filtered: {exc}", is_filtered=True
+                ) from exc
         peer = Peer(
             up.secret_conn,
             up.node_info,

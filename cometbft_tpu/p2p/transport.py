@@ -121,6 +121,15 @@ class MultiplexTransport:
             (addr.ip, addr.port), timeout=self.dial_timeout
         )
         c.settimeout(None)
+        # reference transport.go filterConn runs on BOTH accept and dial
+        # — an app-banned or duplicate-IP address must not be admitted
+        # just because we initiated the connection
+        for f in self.conn_filters:
+            try:
+                f(c)
+            except Exception as exc:
+                c.close()
+                raise RejectedError(str(exc), is_filtered=True) from exc
         return self._upgrade(c, addr, addr)
 
     # -- upgrade ------------------------------------------------------------

@@ -557,3 +557,41 @@ class TestUnconditionalPeers:
         finally:
             _safe_stop(sw1)
             _safe_stop(sw2)
+
+
+class TestPeerFilters:
+    def test_peer_filter_rejects_by_id(self):
+        """Switch-level PeerFilterFunc (reference createTransport peer
+        filters): a filter raising for the peer's ID rejects it after
+        the handshake, before admission."""
+        sw1, _ = _make_switch()
+        sw2, _ = _make_switch()
+        banned = sw2.transport.node_key.id()
+
+        def id_filter(peer_id: str) -> None:
+            if peer_id == banned:
+                raise ValueError("filtered by app")
+
+        sw1.peer_filters.append(id_filter)
+        sw1.start()
+        sw2.start()
+        try:
+            # the filter runs on the ACCEPTOR: the dialer's side may
+            # briefly hold the conn, but sw1 never admits the peer
+            try:
+                sw2.dial_peer_with_address(sw1.transport.listen_addr)
+            except Exception:
+                pass
+            time.sleep(0.5)
+            assert sw1.peers.size() == 0
+            # a different peer passes the same filter
+            sw3, _ = _make_switch()
+            sw3.start()
+            try:
+                sw3.dial_peer_with_address(sw1.transport.listen_addr)
+                _wait(lambda: sw1.peers.size() == 1)
+            finally:
+                _safe_stop(sw3)
+        finally:
+            _safe_stop(sw1)
+            _safe_stop(sw2)
