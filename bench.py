@@ -487,6 +487,53 @@ def _run_stage(stage: str, env_extra: dict, timeout: float):
     return last, "ok"
 
 
+def _retry_stage(stage: str, env_extra: dict, timeout: float, budget_s: float):
+    """Retry a failing stage with doubling backoff until `budget_s` of
+    wall clock is spent (first attempt always runs). A wedged TPU tunnel
+    often recovers within minutes; one cheap enumerate attempt per bench
+    run threw away whole sessions that a later retry would have saved.
+    → (parsed, diag, attempts)."""
+    deadline = time.monotonic() + max(budget_s, 0.0)
+    delay = 5.0
+    attempts = 0
+    while True:
+        attempts += 1
+        parsed, diag = _run_stage(stage, env_extra, timeout)
+        if parsed is not None:
+            return parsed, diag, attempts
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None, diag, attempts
+        time.sleep(min(delay, remaining))
+        delay = min(delay * 2, 120.0)
+
+
+_HISTORY_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_onchip_history.jsonl"
+)
+
+
+def _last_onchip_session():
+    """Most recent BENCH_onchip_history.jsonl record with a real on-chip
+    run (stages.tpu_run.sigs_per_sec present), or None. Embedded in the
+    output when the tunnel is wedged so a CPU-fallback run still carries
+    the latest measured on-chip numbers instead of a bare CPU headline."""
+    try:
+        with open(_HISTORY_PATH, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            rec = json.loads(line)
+        except Exception:  # noqa: BLE001
+            continue
+        run = (rec.get("stages") or {}).get("tpu_run")
+        if isinstance(run, dict) and run.get("sigs_per_sec"):
+            return rec
+    return None
+
+
 def main():
     stages = {}
     cpu_serial = bench_cpu_serial()
@@ -498,8 +545,16 @@ def main():
 
     backend = "tpu"
     result = None
+    retry_budget = float(os.environ.get("BENCH_DEVICE_RETRY_BUDGET_S", "600"))
     for name, timeout in (("devices", 120), ("compile", 600), ("run", 600)):
-        parsed, diag = _run_stage(name, _STAGE_ENV_TPU, timeout)
+        if name == "devices":
+            parsed, diag, attempts = _retry_stage(
+                name, _STAGE_ENV_TPU, timeout, retry_budget
+            )
+            if attempts > 1:
+                stages["tpu_devices_attempts"] = attempts
+        else:
+            parsed, diag = _run_stage(name, _STAGE_ENV_TPU, timeout)
         stages[f"tpu_{name}"] = parsed if parsed is not None else diag
         if parsed is None:
             break
@@ -516,6 +571,7 @@ def main():
     parsed, diag = _run_stage("p50", _STAGE_ENV_CPU, 600)
     stages["cpu_p50"] = parsed if parsed is not None else diag
 
+    last_onchip = None
     if result is None:
         # TPU unavailable — same kernel on the host CPU platform so the
         # pipeline still yields a measured number + full diagnostics.
@@ -524,6 +580,15 @@ def main():
         stages["cpu_fallback_run"] = parsed if parsed is not None else diag
         if parsed is not None and "sigs_per_sec" in parsed:
             result = parsed["sigs_per_sec"]
+        prior = _last_onchip_session()
+        if prior is not None:
+            last_onchip = {
+                "label": "latest recorded on-chip session "
+                         "(TPU tunnel unavailable this run)",
+                "value": prior.get("value"),
+                "unit": prior.get("unit"),
+                "tpu_run": (prior.get("stages") or {}).get("tpu_run"),
+            }
 
     if result is None:
         # last resort: the serial number measured above — the bench's
@@ -535,22 +600,21 @@ def main():
     best_cpu = max(
         cpu_serial, cpu_batch, stages["cpu_parallel_sigs_per_sec"]
     )
-    print(
-        json.dumps(
-            {
-                "metric": f"ed25519_batch_verify_throughput_{backend}",
-                "value": value,
-                "unit": "sigs/sec",
-                # the north-star comparison: vs the CPU BATCH baseline
-                "vs_baseline": round(value / cpu_batch, 3) if cpu_batch else 0.0,
-                "vs_serial": round(value / cpu_serial, 3) if cpu_serial else 0.0,
-                # the honest >=20x denominator (docstring): the BEST
-                # CPU number measured this run, whichever path wins
-                "vs_best_cpu": round(value / best_cpu, 3) if best_cpu else 0.0,
-                "stages": stages,
-            }
-        )
-    )
+    out = {
+        "metric": f"ed25519_batch_verify_throughput_{backend}",
+        "value": value,
+        "unit": "sigs/sec",
+        # the north-star comparison: vs the CPU BATCH baseline
+        "vs_baseline": round(value / cpu_batch, 3) if cpu_batch else 0.0,
+        "vs_serial": round(value / cpu_serial, 3) if cpu_serial else 0.0,
+        # the honest >=20x denominator (docstring): the BEST
+        # CPU number measured this run, whichever path wins
+        "vs_best_cpu": round(value / best_cpu, 3) if best_cpu else 0.0,
+        "stages": stages,
+    }
+    if last_onchip is not None:
+        out["last_onchip"] = last_onchip
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -170,11 +170,112 @@ def bench_wal() -> dict:
     }
 
 
+def bench_routing() -> dict:
+    """Measurement-driven routing regressions, asserted on CPU-only CI:
+
+    - verify_commit with a tpu BackendSpec whose floor admits the commit
+      must route through the RESIDENT fixed-executable path (the p50
+      path — crypto/tpu/ed25519_batch.py verify_valset_resident);
+    - 10k merkle leaves must stay on the host tree when no calibration
+      table proved the device wins (round 5: device loses 4.5× there);
+    - a synthetic crossover table must flip both verdicts, proving
+      routing reads the table rather than a constant.
+
+    Keys are positive counts/values so the harness's ">0" invariant
+    doubles as the assertion surface.
+    """
+    import os
+    import tempfile
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["CBFT_TPU_PROBE"] = "0"  # trust the (virtual) platform
+    os.environ.pop("CBFT_TPU_MIN_BATCH", None)
+    os.environ.pop("CBFT_TPU_MERKLE_MIN_LEAVES", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    cache = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+    )
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+    from cometbft_tpu.crypto import batch as cryptobatch
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.tpu import calibrate, ed25519_batch
+    from cometbft_tpu.crypto.tpu import merkle as tpu_merkle
+    from cometbft_tpu.types import test_util
+
+    out = {}
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            # no table: no device claim proven → merkle stays host and
+            # the ed floor falls back to the conservative constant
+            calibrate.set_table_path(os.path.join(d, "absent.json"))
+            if tpu_merkle.device_wins(10_000):
+                raise AssertionError("10k leaves routed to device w/o table")
+            out["merkle_10k_on_host"] = 1
+            out["ed25519_floor_default"] = cryptobatch.ed25519_routing_floor()
+
+            # synthetic table: both crossover verdicts must be read back
+            path = os.path.join(d, "cal.json")
+            calibrate.save_table(
+                {
+                    "version": calibrate.TABLE_VERSION,
+                    "merkle_min_leaves": 512,
+                    "ed25519_min_batch": 256,
+                },
+                path,
+            )
+            calibrate.set_table_path(path)
+            if not tpu_merkle.device_wins(10_000):
+                raise AssertionError("calibrated merkle crossover ignored")
+            if cryptobatch.ed25519_routing_floor() != 256:
+                raise AssertionError("calibrated ed25519 floor ignored")
+            out["merkle_crossover_respected"] = 1
+            out["ed25519_floor_calibrated"] = (
+                cryptobatch.ed25519_routing_floor()
+            )
+    finally:
+        calibrate.set_table_path(None)
+
+    # resident p50 routing: small valset, floor lowered via BackendSpec
+    # (not env) — the exact plumbing node._setup threads per node
+    chain_id = "bench-routing"
+    vals, privs = test_util.deterministic_validator_set(4, 10)
+    bid = test_util.make_block_id()
+    commit = test_util.make_commit(bid, 5, 0, vals, privs, chain_id)
+    calls = {"n": 0}
+    real = ed25519_batch.verify_valset_resident
+
+    def spy(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    ed25519_batch.verify_valset_resident = spy
+    try:
+        t0 = time.perf_counter()
+        vals.verify_commit(
+            chain_id, bid, 5, commit, backend=BackendSpec("tpu", min_batch=1)
+        )
+        ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        ed25519_batch.verify_valset_resident = real
+    if calls["n"] != 1:
+        raise AssertionError(
+            f"verify_commit made {calls['n']} resident calls, wanted 1"
+        )
+    out["resident_route_hits"] = calls["n"]
+    out["verify_commit_resident_ms"] = round(ms, 2)
+    return out
+
+
 SECTIONS = {
     "ed25519": bench_ed25519,
     "validator_set": bench_validator_set,
     "light": bench_light,
     "mempool": bench_mempool,
+    "routing": bench_routing,
     "wal": bench_wal,
 }
 

@@ -261,7 +261,8 @@ class BlocksyncReactor(Reactor):
         block_ids: List[BlockID] = []
         part_sets: List[object] = []
         per_block: List[List[Tuple[int, object]]] = []
-        bv = cryptobatch.new_batch_verifier(self.crypto_backend)
+        lanes_per_block: List[Tuple[list, list]] = []
+        n_lanes = len(state.validators.validators)
         needed = state.validators.total_voting_power() * 2 // 3
         for i, first in enumerate(firsts):
             parts = first.make_part_set(BLOCK_PART_SIZE_BYTES)
@@ -271,6 +272,8 @@ class BlocksyncReactor(Reactor):
             second = window[i + 1]
             commit = second.last_commit
             entries = []
+            lane_msgs: list = [None] * n_lanes
+            lane_sigs: list = [None] * n_lanes
             try:
                 self._check_commit_shape(
                     state, block_id, first.header.height, commit
@@ -281,11 +284,8 @@ class BlocksyncReactor(Reactor):
                         continue
                     val = state.validators.validators[idx]
                     entries.append((idx, val))
-                    bv.add(
-                        val.pub_key,
-                        commit.vote_sign_bytes(chain_id, idx),
-                        cs_sig(commit, idx),
-                    )
+                    lane_msgs[idx] = commit.vote_sign_bytes(chain_id, idx)
+                    lane_sigs[idx] = cs_sig(commit, idx)
                     speculative += val.voting_power
                     if speculative > needed:
                         break
@@ -294,9 +294,10 @@ class BlocksyncReactor(Reactor):
                 # attribute and redo it
                 return self._sync_one(chain_id, state)
             per_block.append(entries)
+            lanes_per_block.append((lane_msgs, lane_sigs))
 
-        ok, mask = bv.verify() if bv.count() else (True, [])
-        if not ok:
+        mask = self._verify_window_lanes(per_block, lanes_per_block, state)
+        if not all(mask):
             return self._sync_one(chain_id, state)
 
         # all signatures verified: check quorum per block, then apply
@@ -325,6 +326,48 @@ class BlocksyncReactor(Reactor):
                 window[i + 1].last_commit,
             )
         return state
+
+    def _verify_window_lanes(self, per_block, lanes_per_block, state):
+        """Verify every window block's quorum prefix → one flat bool per
+        entry, in block order (the caller's quorum loop consumes it
+        positionally).
+
+        Resident fast path: every batchable block re-verifies the SAME
+        validator set, so under the tpu backend its pubkey rows stay on
+        device across the window and each block dispatches the resident
+        fixed executable (crypto/batch.py verify_commit_valset — 96 B/sig
+        on the link instead of 128, one compiled program per chunk
+        shape). Any ineligibility (backend, routing floor, non-ed25519
+        keys, dead device plane) falls back to ONE BatchVerifier over
+        the whole window. Accept/reject is identical either way."""
+        from cometbft_tpu.crypto import ed25519 as ed
+
+        vals = state.validators.validators
+        if all(
+            cryptobatch.resident_commit_eligible(
+                len(entries), self.crypto_backend
+            )
+            for entries in per_block
+        ) and all(isinstance(v.pub_key, ed.PubKeyEd25519) for v in vals):
+            pub_keys = [v.pub_key.bytes() for v in vals]
+            flat: List[bool] = []
+            for entries, (lane_msgs, lane_sigs) in zip(
+                per_block, lanes_per_block
+            ):
+                full = cryptobatch.verify_commit_valset(
+                    pub_keys, lane_msgs, lane_sigs, self.crypto_backend
+                )
+                if full is None:
+                    break  # shape rejected after all — take the bv path
+                flat.extend(bool(full[idx]) for idx, _ in entries)
+            else:
+                return flat
+        bv = cryptobatch.new_batch_verifier(self.crypto_backend)
+        for entries, (lane_msgs, lane_sigs) in zip(per_block, lanes_per_block):
+            for idx, val in entries:
+                bv.add(val.pub_key, lane_msgs[idx], lane_sigs[idx])
+        _, mask = bv.verify() if bv.count() else (True, [])
+        return mask
 
     def _sync_one(self, chain_id: str, state):
         """The reference's exact PeekTwoBlocks path (:348-404): verify one

@@ -227,9 +227,18 @@ class CryptoConfig:
     # plane (the device dispatch round-trip dominates small batches).
     # Default = the measured on-chip crossover under the slower
     # observed link floor (SMALLBATCH_onchip.jsonl; crypto/batch.py).
-    # Applied at node start as the CBFT_TPU_MIN_BATCH default — an
-    # explicitly-set env var still wins for operator A/B overrides.
+    # Threaded per-node via BackendSpec (crypto/batch.py) — an
+    # explicitly-set CBFT_TPU_MIN_BATCH env var still wins for
+    # operator A/B overrides.
     min_batch: int = 1024
+    # Dispatch chunk cap for the double-buffered pipeline (crypto/tpu/
+    # mesh.py): batches larger than this split into chunks whose host
+    # packing + async H2D overlaps the previous chunk's device compute.
+    # Default = the measured 8k sweet spot (two pipelined 8k chunks beat
+    # one 16k dispatch ~1.8× on the tunneled link — MAXCHUNK16K.jsonl).
+    # Rounded up to a power of two at the dispatch layer; an
+    # explicitly-set CBFT_TPU_MAX_CHUNK env var wins.
+    max_chunk: int = 8192
 
 
 @dataclass
@@ -263,17 +272,15 @@ class Config:
             raise ValueError("consensus.timeout_propose can't be negative")
         if self.crypto.backend not in ("cpu", "tpu"):
             raise ValueError(f"unknown crypto backend {self.crypto.backend!r}")
-        # min_batch is load-bearing (it becomes CBFT_TPU_MIN_BATCH):
-        # reject malformed TOML at startup, not at the first commit
-        if (
-            not isinstance(self.crypto.min_batch, int)
-            or isinstance(self.crypto.min_batch, bool)
-            or self.crypto.min_batch < 1
-        ):
-            raise ValueError(
-                f"crypto.min_batch must be a positive integer, got "
-                f"{self.crypto.min_batch!r}"
-            )
+        # min_batch/max_chunk are load-bearing (they drive the batch
+        # plane's routing and chunking): reject malformed TOML at
+        # startup, not at the first commit
+        for knob in ("min_batch", "max_chunk"):
+            v = getattr(self.crypto, knob)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"crypto.{knob} must be a positive integer, got {v!r}"
+                )
 
 
 def default_config() -> Config:
@@ -345,11 +352,45 @@ def write_config_file(path: str, cfg: Config) -> None:
         f.write("\n".join(lines))
 
 
-def load_config_file(path: str, cfg: Optional[Config] = None) -> Config:
-    import tomllib
+def _parse_toml_min(text: str) -> dict:
+    """Minimal TOML-subset reader for the dialect save_config_file
+    emits (flat [section] tables; string / bool / int / string-list
+    values, all JSON-compatible tokens) — the fallback on Python 3.10
+    where stdlib tomllib (3.11+) does not exist."""
+    import json as _json
 
-    with open(path, "rb") as f:
-        data = tomllib.load(f)
+    root: dict = {}
+    cur = root
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            cur = root.setdefault(line[1:-1].strip(), {})
+            continue
+        if "=" not in line:
+            raise ValueError(f"unparseable config line: {raw!r}")
+        key, tok = (s.strip() for s in line.split("=", 1))
+        try:
+            cur[key] = _json.loads(tok)
+        except ValueError:
+            # trailing comment after the value, then one more try
+            tok = tok.split("#", 1)[0].strip()
+            cur[key] = _json.loads(tok)
+    return root
+
+
+def load_config_file(path: str, cfg: Optional[Config] = None) -> Config:
+    try:
+        import tomllib
+    except ImportError:
+        tomllib = None
+    if tomllib is not None:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+    else:
+        with open(path, "r", encoding="utf-8") as f:
+            data = _parse_toml_min(f.read())
     cfg = cfg or Config()
     for section, attr in _SECTIONS:
         obj = getattr(cfg, attr)

@@ -293,14 +293,19 @@ class ConsensusState(BaseService):
         Never block: with the builtin app this fires ON the consensus
         thread itself (commit → mempool update/recheck callbacks), whose
         queue has no other consumer — a blocking put on a full queue
-        would deadlock the node (same hazard send_internal documents)."""
+        would deadlock the node (same hazard send_internal documents).
+
+        A full queue DROPS the notification instead of parking a thread
+        on it: the signal is level-triggered (the mempool still holds
+        txs, so the next height's mempool update re-fires it), and a
+        queue already packed with peer messages will wake the consensus
+        loop anyway. send_internal keeps its goroutine-mirroring thread
+        fallback — votes and proposals are edge-triggered and MUST land."""
         mi = MsgInfo(None, "@txs")
         try:
             self.peer_msg_queue.put_nowait(mi)
         except queue.Full:
-            threading.Thread(
-                target=self.peer_msg_queue.put, args=(mi,), daemon=True
-            ).start()
+            pass
 
     # -- the serialized event loop ------------------------------------------
 

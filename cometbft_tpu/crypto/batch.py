@@ -15,10 +15,60 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Callable, Dict, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 from cometbft_tpu.crypto import PubKey
 from cometbft_tpu.crypto import ed25519 as ed
+
+
+@dataclass(frozen=True)
+class BackendSpec:
+    """A backend selection PLUS its per-node [crypto] tuning, threaded
+    through the same parameter the bare backend name travels (reactors
+    and verifiers pass it opaquely; only this module resolves it).
+    Replaces the round-5 os.environ.setdefault plumbing, which made
+    in-process multi-node setups share the FIRST node's min_batch.
+
+    min_batch/max_chunk of None mean "not configured" — resolution
+    falls through to env → calibration → built-in default."""
+
+    name: str
+    min_batch: Optional[int] = None
+    max_chunk: Optional[int] = None
+
+
+# what every verify path accepts where a backend used to be a str
+Backend = Union[str, BackendSpec, None]
+
+
+def backend_name(backend: Backend) -> str:
+    if isinstance(backend, BackendSpec):
+        return backend.name
+    return backend or _default_backend
+
+
+def ed25519_routing_floor(config_min_batch: Optional[int] = None) -> int:
+    """THE resolution of the ed25519 CPU↔device crossover, shared by
+    every eligibility check (TPUBatchVerifier partitioning, the resident
+    commit path, warmup bucket selection) so they can never diverge:
+
+      CBFT_TPU_MIN_BATCH env (operator A/B override)
+      > configured [crypto] min_batch (via BackendSpec)
+      > measured crossover recorded at warmup (tpu/calibrate.py)
+      > 1024 (the conservative constant from the round-5 on-chip sweep)
+    """
+    raw = os.environ.get("CBFT_TPU_MIN_BATCH")
+    if raw is not None:
+        return int(raw)
+    if config_min_batch is not None:
+        return config_min_batch
+    from cometbft_tpu.crypto.tpu import calibrate
+
+    measured = calibrate.ed25519_min_batch()
+    if measured is not None:
+        return measured
+    return 1024
 
 
 class BatchVerifier:
@@ -185,9 +235,10 @@ class TPUBatchVerifier(BatchVerifier):
         # the device earns its round-trip only at scale.
         # CBFT_TPU_MIN_BATCH retunes the routing from config when the
         # link or a kernel change moves the crossover, without a code
-        # change.
+        # change; with neither env nor config set, the crossover
+        # MEASURED at warmup (tpu/calibrate.py) beats the constant.
         if min_batch is None:
-            min_batch = int(os.environ.get("CBFT_TPU_MIN_BATCH", "1024"))
+            min_batch = ed25519_routing_floor()
         self._min_batch = min_batch
         # The non-ed curves split by the speed of their CPU fallback:
         # sr25519's is pure-Python big-int (~ms/sig) so the device wins
@@ -272,15 +323,17 @@ class TPUBatchVerifier(BatchVerifier):
 
 
 def resident_commit_eligible(
-    n_present: int, backend: Optional[str] = None
+    n_present: int, backend: Backend = None
 ) -> bool:
     """Cheap pre-check for the resident commit path, so callers on the
     cpu backend (or below the floor) never pay the O(n_validators)
     key-type scan and pk-bytes build that verify_commit_valset needs."""
-    name = backend or _default_backend
-    if name != "tpu":
+    if backend_name(backend) != "tpu":
         return False
-    if n_present < int(os.environ.get("CBFT_TPU_MIN_BATCH", "1024")):
+    spec_floor = (
+        backend.min_batch if isinstance(backend, BackendSpec) else None
+    )
+    if n_present < ed25519_routing_floor(spec_floor):
         return False
     return device_plane_ok()
 
@@ -289,7 +342,7 @@ def verify_commit_valset(
     pub_keys: List[bytes],
     msgs: List[Optional[bytes]],
     sigs: List[Optional[bytes]],
-    backend: Optional[str] = None,
+    backend: Backend = None,
 ) -> Optional[List[bool]]:
     """Device-resident full-lane commit verification (the valset's
     pubkey rows live on device across heights — ed25519_batch's
@@ -303,12 +356,13 @@ def verify_commit_valset(
     min_batch rationale). Callers guarantee every pub_key is an ed25519
     key (32 bytes); msgs[i]/sigs[i] None marks an absent lane, reported
     False and skipped by the caller."""
-    name = backend or _default_backend
-    if name != "tpu":
+    if backend_name(backend) != "tpu":
         return None
     present = sum(1 for m in msgs if m is not None)
-    floor = int(os.environ.get("CBFT_TPU_MIN_BATCH", "1024"))
-    if present < floor:
+    spec_floor = (
+        backend.min_batch if isinstance(backend, BackendSpec) else None
+    )
+    if present < ed25519_routing_floor(spec_floor):
         return None
     if not device_plane_ok():
         return None
@@ -349,12 +403,19 @@ def default_backend() -> str:
     return _default_backend
 
 
-def new_batch_verifier(backend: Optional[str] = None) -> BatchVerifier:
+def new_batch_verifier(backend: Backend = None) -> BatchVerifier:
     with _mtx:
-        name = backend or _default_backend
+        name = backend_name(backend)
         factory = _registry.get(name)
     if factory is None:
         raise ValueError(f"unknown crypto backend {name!r}")
+    if isinstance(backend, BackendSpec) and factory is TPUBatchVerifier:
+        # per-node config reaches the verifier through the spec, not a
+        # process-global env default (env still wins inside the floor
+        # resolution for operator overrides)
+        return TPUBatchVerifier(
+            min_batch=ed25519_routing_floor(backend.min_batch)
+        )
     return factory()
 
 

@@ -14,12 +14,22 @@ from __future__ import annotations
 import secrets
 from typing import Optional
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives import serialization
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives import serialization
+
+    _HAVE_OPENSSL_WHEEL = True
+except ImportError:  # slim image without the wheel: same OpenSSL
+    # semantics via the native ctypes .so (cometbft_tpu.native), pure
+    # Python (crypto/purepy.py) as the last rung
+    from cometbft_tpu.crypto.purepy import InvalidSignature
+
+    Ed25519PrivateKey = Ed25519PublicKey = serialization = None
+    _HAVE_OPENSSL_WHEEL = False
 
 from cometbft_tpu.crypto import PrivKey, PubKey, address_hash, sha256
 
@@ -32,6 +42,49 @@ SEED_SIZE = 32
 # amino-compatible JSON type tags (crypto/ed25519/ed25519.go:37-40)
 PUB_KEY_NAME = "tendermint/PubKeyEd25519"
 PRIV_KEY_NAME = "tendermint/PrivKeyEd25519"
+
+
+def _fallback_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """No-wheel verify ladder: native OpenSSL ctypes, then pure Python.
+    Accept/reject semantics are identical on every rung."""
+    from cometbft_tpu import native
+
+    mask = native.ed25519_verify_batch([pub], [msg], [sig], nthreads=1)
+    if mask is not None:
+        return mask[0]
+    from cometbft_tpu.crypto import purepy
+
+    return purepy.ed25519_verify(pub, msg, sig)
+
+
+def _fallback_sign(seed: bytes, pub: bytes, msg: bytes) -> bytes:
+    from cometbft_tpu import native
+
+    sig = native.ed25519_sign(seed, msg)
+    if sig is not None:
+        return sig
+    from cometbft_tpu.crypto import purepy
+
+    return purepy.ed25519_sign(seed, pub, msg)
+
+
+def _pub_from_seed(seed: bytes) -> bytes:
+    if _HAVE_OPENSSL_WHEEL:
+        return (
+            Ed25519PrivateKey.from_private_bytes(seed)
+            .public_key()
+            .public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            )
+        )
+    from cometbft_tpu import native
+
+    pub = native.ed25519_pub_from_seed(seed)
+    if pub is not None:
+        return pub
+    from cometbft_tpu.crypto import purepy
+
+    return purepy.ed25519_public_from_seed(seed)
 
 
 class PubKeyEd25519(PubKey):
@@ -53,6 +106,8 @@ class PubKeyEd25519(PubKey):
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != SIGNATURE_SIZE:
             return False
+        if not _HAVE_OPENSSL_WHEEL:
+            return _fallback_verify(self._bytes, msg, sig)
         try:
             if self._pk is None:
                 self._pk = Ed25519PublicKey.from_public_bytes(self._bytes)
@@ -70,25 +125,26 @@ class PrivKeyEd25519(PrivKey):
         # accept 64-byte Go-style (seed||pub) or 32-byte seed
         if len(key_bytes) == SEED_SIZE:
             seed = bytes(key_bytes)
-            pub = (
-                Ed25519PrivateKey.from_private_bytes(seed)
-                .public_key()
-                .public_bytes(
-                    serialization.Encoding.Raw, serialization.PublicFormat.Raw
-                )
-            )
-            key_bytes = seed + pub
+            key_bytes = seed + _pub_from_seed(seed)
         if len(key_bytes) != PRIVATE_KEY_SIZE:
             raise ValueError(f"ed25519 privkey must be {PRIVATE_KEY_SIZE} bytes")
         self._bytes = bytes(key_bytes)
-        self._sk = Ed25519PrivateKey.from_private_bytes(self._bytes[:SEED_SIZE])
+        self._sk = (
+            Ed25519PrivateKey.from_private_bytes(self._bytes[:SEED_SIZE])
+            if _HAVE_OPENSSL_WHEEL
+            else None
+        )
 
     def bytes(self) -> bytes:
         return self._bytes
 
     def sign(self, msg: bytes) -> bytes:
         """Reference: crypto/ed25519/ed25519.go:57."""
-        return self._sk.sign(msg)
+        if self._sk is not None:
+            return self._sk.sign(msg)
+        return _fallback_sign(
+            self._bytes[:SEED_SIZE], self._bytes[SEED_SIZE:], msg
+        )
 
     def pub_key(self) -> PubKeyEd25519:
         return PubKeyEd25519(self._bytes[SEED_SIZE:])
@@ -117,7 +173,9 @@ def verify_many(items) -> list:
     if n == 0:
         return []
     ncpu = _os.cpu_count() or 1
-    if ncpu > 1 and n >= 64:
+    # without the wheel the native call is the ONLY fast rung — take it
+    # at any batch size before paying the pure-Python scalar path
+    if (not _HAVE_OPENSSL_WHEEL) or (ncpu > 1 and n >= 64):
         from cometbft_tpu import native
 
         mask = native.ed25519_verify_batch(
@@ -128,6 +186,14 @@ def verify_many(items) -> list:
         )
         if mask is not None:
             return mask
+    if not _HAVE_OPENSSL_WHEEL:
+        from cometbft_tpu.crypto import purepy
+
+        return [
+            len(s) == SIGNATURE_SIZE
+            and purepy.ed25519_verify(pk.bytes(), m, s)
+            for pk, m, s in items
+        ]
     out = []
     append = out.append
     for pk, msg, sig in items:

@@ -48,15 +48,21 @@ def get_split_point(length: int) -> int:
     return bit
 
 
-# When enabled (enable_parallel), roots over >= MIN_DEVICE_LEAVES leaves
-# (the kernel's own threshold) run on the batched device kernel
-# (crypto/tpu/merkle.py) — bit-identical output.
+# When enabled (enable_parallel), roots run on the batched device
+# kernel (crypto/tpu/merkle.py — bit-identical output) only at sizes
+# where the calibrated crossover table PROVED the device wins on this
+# link (tpu_merkle.device_wins). Round-5 measurement: at 10k leaves the
+# tunneled device loses 4.5× to the host tree (81 ms vs 18 ms), so the
+# by-construction "n >= 128" gate this replaces routed the
+# ValidatorSet.Hash mega-set onto the slow path.
 _parallel_enabled = False
 
 
 def enable_parallel(enabled: bool = True) -> None:
-    """Route large hash_from_byte_slices calls through the TPU level-
-    parallel kernel (mega validator sets — SURVEY.md §7 stage 10)."""
+    """Make large hash_from_byte_slices calls ELIGIBLE for the TPU
+    level-parallel kernel (mega validator sets — SURVEY.md §7 stage
+    10); actual routing additionally requires the measured crossover
+    verdict (tpu_merkle.device_wins)."""
     global _parallel_enabled
     _parallel_enabled = enabled
 
@@ -70,7 +76,7 @@ def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
 
         # same bounded-probe gate as the batch verifier: a wedged TPU
         # tunnel must degrade to the host tree, not hang the caller
-        if n >= tpu_merkle.MIN_DEVICE_LEAVES and cryptobatch.device_plane_ok():
+        if tpu_merkle.device_wins(n) and cryptobatch.device_plane_ok():
             return tpu_merkle.hash_from_byte_slices(items)
     if n == 0:
         return empty_hash()

@@ -1,0 +1,411 @@
+"""Pure-Python stand-ins for the `cryptography` package primitives.
+
+The framework's CPU crypto plane wraps the OpenSSL-backed `cryptography`
+wheel, but slim build images may ship without it. Import sites gate on
+ImportError and fall back here:
+
+- ed25519 sign/verify/keygen (RFC 8032 with Go-compatible semantics:
+  cofactorless verify, reject s >= L, reject non-canonical A, encoded
+  byte-compare of R' — matching crypto/ed25519/ed25519.go). The hot
+  verify path still prefers the native OpenSSL ctypes .so
+  (cometbft_tpu.native); this module is the last rung of the ladder.
+- ChaCha20-Poly1305 AEAD (RFC 8439) and one-shot Poly1305, API-shaped
+  like cryptography.hazmat.primitives.ciphers.aead / .poly1305.
+- X25519 (RFC 7748) and HKDF-SHA256 (RFC 5869) shims with the exact
+  call surface p2p/conn/secret_connection.py uses.
+
+Exception classes mirror cryptography.exceptions so callers' except
+clauses keep working verbatim.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as _hmac
+import secrets
+import struct
+from typing import Optional
+
+
+class InvalidSignature(Exception):
+    """Mirror of cryptography.exceptions.InvalidSignature."""
+
+
+class InvalidTag(Exception):
+    """Mirror of cryptography.exceptions.InvalidTag."""
+
+
+# ---------------------------------------------------------------------------
+# ed25519 (RFC 8032, edwards25519)
+# ---------------------------------------------------------------------------
+
+_P = 2**255 - 19
+_L = 2**252 + 27742317777372353535851937790883648493
+_D = (-121665 * pow(121666, _P - 2, _P)) % _P
+_SQRT_M1 = pow(2, (_P - 1) // 4, _P)
+
+# base point (x, y, z, t) in extended homogeneous coordinates
+_BY = 4 * pow(5, _P - 2, _P) % _P
+_BX = None  # recovered below
+
+
+def _recover_x(y: int, sign: int) -> Optional[int]:
+    if y >= _P:
+        return None
+    x2 = (y * y - 1) * pow(_D * y * y + 1, _P - 2, _P) % _P
+    if x2 == 0:
+        if sign:
+            return None
+        return 0
+    x = pow(x2, (_P + 3) // 8, _P)
+    if (x * x - x2) % _P != 0:
+        x = x * _SQRT_M1 % _P
+    if (x * x - x2) % _P != 0:
+        return None
+    if x & 1 != sign:
+        x = _P - x
+    return x
+
+
+_BX = _recover_x(_BY, 0)
+_B = (_BX, _BY, 1, _BX * _BY % _P)
+_IDENT = (0, 1, 1, 0)
+
+
+def _pt_add(p, q):
+    px, py, pz, pt = p
+    qx, qy, qz, qt = q
+    a = (py - px) * (qy - qx) % _P
+    b = (py + px) * (qy + qx) % _P
+    c = 2 * pt * qt * _D % _P
+    d = 2 * pz * qz % _P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % _P, g * h % _P, f * g % _P, e * h % _P)
+
+
+def _pt_mul(s: int, p):
+    q = _IDENT
+    while s > 0:
+        if s & 1:
+            q = _pt_add(q, p)
+        p = _pt_add(p, p)
+        s >>= 1
+    return q
+
+
+def _pt_encode(p) -> bytes:
+    zinv = pow(p[2], _P - 2, _P)
+    x = p[0] * zinv % _P
+    y = p[1] * zinv % _P
+    return int.to_bytes(y | ((x & 1) << 255), 32, "little")
+
+
+def _pt_decode(b: bytes):
+    """None for non-canonical y (>= p) or non-square x² — the rejects Go's
+    edwards25519 Point.SetBytes applies."""
+    if len(b) != 32:
+        return None
+    val = int.from_bytes(b, "little")
+    sign = val >> 255
+    y = val & ((1 << 255) - 1)
+    x = _recover_x(y, sign)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % _P)
+
+
+def _sha512_mod_l(*parts: bytes) -> int:
+    h = hashlib.sha512()
+    for part in parts:
+        h.update(part)
+    return int.from_bytes(h.digest(), "little") % _L
+
+
+def _clamp(h32: bytes) -> int:
+    a = bytearray(h32)
+    a[0] &= 248
+    a[31] &= 127
+    a[31] |= 64
+    return int.from_bytes(a, "little")
+
+
+def ed25519_public_from_seed(seed: bytes) -> bytes:
+    a = _clamp(hashlib.sha512(seed).digest()[:32])
+    return _pt_encode(_pt_mul(a, _B))
+
+
+def ed25519_sign(seed: bytes, pub: bytes, msg: bytes) -> bytes:
+    h = hashlib.sha512(seed).digest()
+    a = _clamp(h[:32])
+    r = _sha512_mod_l(h[32:], msg)
+    r_enc = _pt_encode(_pt_mul(r, _B))
+    k = _sha512_mod_l(r_enc, pub, msg)
+    s = (r + k * a) % _L
+    return r_enc + int.to_bytes(s, 32, "little")
+
+
+def ed25519_verify(pub: bytes, msg: bytes, sig: bytes) -> bool:
+    """Cofactorless verify: encode(sB - hA) must byte-equal sig[:32]
+    (Go crypto/ed25519 Verify — R is never decoded)."""
+    if len(sig) != 64 or len(pub) != 32:
+        return False
+    a_pt = _pt_decode(pub)
+    if a_pt is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= _L:
+        return False
+    h = _sha512_mod_l(sig[:32], pub, msg)
+    # sB - hA: negate A by negating x and t
+    neg_a = (_P - a_pt[0], a_pt[1], a_pt[2], _P - a_pt[3])
+    r_prime = _pt_add(_pt_mul(s, _B), _pt_mul(h, neg_a))
+    return _pt_encode(r_prime) == sig[:32]
+
+
+# ---------------------------------------------------------------------------
+# ChaCha20 / Poly1305 (RFC 8439)
+# ---------------------------------------------------------------------------
+
+_MASK32 = 0xFFFFFFFF
+
+
+def _chacha_block(key_words, counter: int, nonce_words) -> bytes:
+    state = [
+        0x61707865, 0x3320646E, 0x79622D32, 0x6B206574,
+        *key_words, counter & _MASK32, *nonce_words,
+    ]
+    x = list(state)
+
+    def qr(a, b, c, d):
+        x[a] = (x[a] + x[b]) & _MASK32
+        x[d] = ((x[d] ^ x[a]) << 16 | (x[d] ^ x[a]) >> 16) & _MASK32
+        x[c] = (x[c] + x[d]) & _MASK32
+        x[b] = ((x[b] ^ x[c]) << 12 | (x[b] ^ x[c]) >> 20) & _MASK32
+        x[a] = (x[a] + x[b]) & _MASK32
+        x[d] = ((x[d] ^ x[a]) << 8 | (x[d] ^ x[a]) >> 24) & _MASK32
+        x[c] = (x[c] + x[d]) & _MASK32
+        x[b] = ((x[b] ^ x[c]) << 7 | (x[b] ^ x[c]) >> 25) & _MASK32
+
+    for _ in range(10):
+        qr(0, 4, 8, 12)
+        qr(1, 5, 9, 13)
+        qr(2, 6, 10, 14)
+        qr(3, 7, 11, 15)
+        qr(0, 5, 10, 15)
+        qr(1, 6, 11, 12)
+        qr(2, 7, 8, 13)
+        qr(3, 4, 9, 14)
+    return struct.pack(
+        "<16I", *((x[i] + state[i]) & _MASK32 for i in range(16))
+    )
+
+
+def _chacha_stream(key: bytes, nonce12: bytes, length: int,
+                   counter: int = 1) -> bytes:
+    key_words = struct.unpack("<8I", key)
+    nonce_words = struct.unpack("<3I", nonce12)
+    out = bytearray()
+    while len(out) < length:
+        out += _chacha_block(key_words, counter, nonce_words)
+        counter += 1
+    return bytes(out[:length])
+
+
+def poly1305_mac(key32: bytes, data: bytes) -> bytes:
+    r = int.from_bytes(key32[:16], "little") & 0x0FFFFFFC0FFFFFFC0FFFFFFC0FFFFFFF
+    s = int.from_bytes(key32[16:32], "little")
+    p = (1 << 130) - 5
+    acc = 0
+    for i in range(0, len(data), 16):
+        block = data[i:i + 16] + b"\x01"
+        acc = (acc + int.from_bytes(block, "little")) * r % p
+    return int.to_bytes((acc + s) & ((1 << 128) - 1), 16, "little")
+
+
+class Poly1305:
+    """Mirror of cryptography.hazmat.primitives.poly1305.Poly1305."""
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("poly1305 key must be 32 bytes")
+        self._key = bytes(key)
+        self._buf = bytearray()
+
+    def update(self, data: bytes) -> None:
+        self._buf += data
+
+    def finalize(self) -> bytes:
+        return poly1305_mac(self._key, bytes(self._buf))
+
+    def verify(self, tag: bytes) -> None:
+        if not _hmac.compare_digest(self.finalize(), tag):
+            raise InvalidSignature("poly1305 tag mismatch")
+
+
+def _pad16(b: bytes) -> bytes:
+    return b"\x00" * (-len(b) % 16)
+
+
+class ChaCha20Poly1305:
+    """Mirror of cryptography.hazmat.primitives.ciphers.aead
+    .ChaCha20Poly1305 (RFC 8439 AEAD construction)."""
+
+    def __init__(self, key: bytes):
+        if len(key) != 32:
+            raise ValueError("ChaCha20Poly1305 key must be 32 bytes")
+        self._key = bytes(key)
+
+    def _tag(self, nonce: bytes, ct: bytes, aad: bytes) -> bytes:
+        otk = _chacha_stream(self._key, nonce, 32, counter=0)
+        mac_data = (
+            aad + _pad16(aad) + ct + _pad16(ct)
+            + struct.pack("<QQ", len(aad), len(ct))
+        )
+        return poly1305_mac(otk, mac_data)
+
+    def encrypt(self, nonce: bytes, data: bytes,
+                associated_data: Optional[bytes]) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        aad = associated_data or b""
+        ct = bytes(
+            a ^ b for a, b in zip(data, _chacha_stream(
+                self._key, nonce, len(data)))
+        )
+        return ct + self._tag(nonce, ct, aad)
+
+    def decrypt(self, nonce: bytes, data: bytes,
+                associated_data: Optional[bytes]) -> bytes:
+        if len(nonce) != 12:
+            raise ValueError("nonce must be 12 bytes")
+        if len(data) < 16:
+            raise InvalidTag("ciphertext too short")
+        aad = associated_data or b""
+        ct, tag = data[:-16], data[-16:]
+        if not _hmac.compare_digest(self._tag(nonce, ct, aad), tag):
+            raise InvalidTag("aead tag mismatch")
+        return bytes(
+            a ^ b for a, b in zip(ct, _chacha_stream(
+                self._key, nonce, len(ct)))
+        )
+
+
+# ---------------------------------------------------------------------------
+# X25519 (RFC 7748)
+# ---------------------------------------------------------------------------
+
+_A24 = 121665
+
+
+def x25519(k32: bytes, u32: bytes) -> bytes:
+    k = int.from_bytes(k32, "little")
+    k &= ~(7 | (1 << 255))
+    k |= 1 << 254
+    u = int.from_bytes(u32, "little") & ((1 << 255) - 1)
+    x1, x2, z2, x3, z3 = u, 1, 0, u, 1
+    swap = 0
+    for t in range(254, -1, -1):
+        bit = (k >> t) & 1
+        swap ^= bit
+        if swap:
+            x2, x3 = x3, x2
+            z2, z3 = z3, z2
+        swap = bit
+        a = (x2 + z2) % _P
+        aa = a * a % _P
+        b = (x2 - z2) % _P
+        bb = b * b % _P
+        e = (aa - bb) % _P
+        c = (x3 + z3) % _P
+        d = (x3 - z3) % _P
+        da = d * a % _P
+        cb = c * b % _P
+        x3 = (da + cb) % _P
+        x3 = x3 * x3 % _P
+        z3 = (da - cb) % _P
+        z3 = x1 * z3 * z3 % _P
+        x2 = aa * bb % _P
+        z2 = e * (aa + _A24 * e) % _P
+    if swap:
+        x2, x3 = x3, x2
+        z2, z3 = z3, z2
+    return int.to_bytes(x2 * pow(z2, _P - 2, _P) % _P, 32, "little")
+
+
+_X25519_BASE = int.to_bytes(9, 32, "little")
+
+
+class X25519PublicKey:
+    """Mirror of cryptography ...asymmetric.x25519.X25519PublicKey."""
+
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("x25519 public key must be 32 bytes")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def from_public_bytes(cls, data: bytes) -> "X25519PublicKey":
+        return cls(data)
+
+    def public_bytes_raw(self) -> bytes:
+        return self._raw
+
+
+class X25519PrivateKey:
+    """Mirror of cryptography ...asymmetric.x25519.X25519PrivateKey."""
+
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("x25519 private key must be 32 bytes")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def generate(cls) -> "X25519PrivateKey":
+        return cls(secrets.token_bytes(32))
+
+    def public_key(self) -> X25519PublicKey:
+        return X25519PublicKey(x25519(self._raw, _X25519_BASE))
+
+    def exchange(self, peer_public_key: X25519PublicKey) -> bytes:
+        out = x25519(self._raw, peer_public_key.public_bytes_raw())
+        if out == b"\x00" * 32:
+            # low-order peer point — same all-zero rejection the
+            # OpenSSL-backed exchange raises on
+            raise ValueError("x25519 shared secret is all zeros")
+        return out
+
+
+# ---------------------------------------------------------------------------
+# HKDF-SHA256 (RFC 5869)
+# ---------------------------------------------------------------------------
+
+
+class SHA256:
+    """Algorithm marker mirroring cryptography ...hashes.SHA256."""
+
+    digest_size = 32
+
+
+class HKDF:
+    """Mirror of cryptography ...kdf.hkdf.HKDF (extract-then-expand)."""
+
+    def __init__(self, algorithm=None, length: int = 32,
+                 salt: Optional[bytes] = None, info: Optional[bytes] = None):
+        if length > 255 * 32:
+            raise ValueError("hkdf output too long")
+        self._length = length
+        self._salt = salt or b"\x00" * 32
+        self._info = info or b""
+
+    def derive(self, key_material: bytes) -> bytes:
+        prk = _hmac.new(self._salt, key_material, hashlib.sha256).digest()
+        okm = b""
+        t = b""
+        i = 1
+        while len(okm) < self._length:
+            t = _hmac.new(
+                prk, t + self._info + bytes([i]), hashlib.sha256
+            ).digest()
+            okm += t
+            i += 1
+        return okm[: self._length]

@@ -1,0 +1,199 @@
+"""Measured CPU↔device routing calibration.
+
+By-construction routing thresholds lied twice in round 5: the Merkle
+device path was gated at 128 leaves but LOSES to the host tree at every
+size on the tunneled link (81 ms device vs 18 ms CPU at 10k leaves —
+BENCH_onchip_probe.json), and the ed25519 floor was a constant tuned to
+one session of a link whose per-dispatch cost jitters 40–75 ms between
+sessions. This module replaces both with numbers measured ON THIS LINK:
+node warmup (node/node.py _warm_tpu_kernels) runs `record()` in its
+bounded subprocess, which times device vs CPU at several sizes and
+writes a crossover table; routing then asks the table.
+
+Failure posture: no table (fresh node, CPU-only CI, wedged tunnel) means
+NO device claim has been proven, so `merkle_min_leaves()` returns None
+(host tree — the measured-safe default) and `ed25519_min_batch()` falls
+back to the conservative constant. Explicitly-set env knobs
+(CBFT_TPU_MERKLE_MIN_LEAVES / CBFT_TPU_MIN_BATCH) keep operator
+precedence over the table at the call sites.
+
+This module imports no jax at module level — the table accessors run on
+hot consensus paths and must never touch the device plane.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+TABLE_VERSION = 1
+
+_mtx = threading.Lock()
+_configured_path: Optional[str] = None
+# (path, mtime) -> table; one entry — the path rarely changes
+_cache: Optional[Tuple[str, float, Optional[dict]]] = None
+
+
+def set_table_path(path: Optional[str]) -> None:
+    """Install the node's calibration table location (node start sets
+    {root}/data/tpu_calibration.json). CBFT_TPU_CALIBRATION wins."""
+    global _configured_path, _cache
+    with _mtx:
+        _configured_path = path
+        _cache = None
+
+
+def table_path() -> Optional[str]:
+    return os.environ.get("CBFT_TPU_CALIBRATION") or _configured_path
+
+
+def load_table() -> Optional[dict]:
+    """The calibration table, or None when absent/unreadable/stale-
+    versioned. Cached by (path, mtime) so hot routing checks cost one
+    stat, and a re-recorded table is picked up without a restart."""
+    global _cache
+    path = table_path()
+    if not path:
+        return None
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    with _mtx:
+        if _cache is not None and _cache[0] == path and _cache[1] == mtime:
+            return _cache[2]
+    table: Optional[dict] = None
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            loaded = json.load(f)
+        if isinstance(loaded, dict) and loaded.get("version") == TABLE_VERSION:
+            table = loaded
+    except (OSError, ValueError):
+        table = None
+    with _mtx:
+        _cache = (path, mtime, table)
+    return table
+
+
+def _floor(table: Optional[dict], key: str) -> Optional[int]:
+    if not table:
+        return None
+    v = table.get(key)
+    if isinstance(v, int) and not isinstance(v, bool) and v > 0:
+        return v
+    return None
+
+
+def merkle_min_leaves() -> Optional[int]:
+    """Measured leaf count above which the device tree beats the host
+    tree, or None when the device never won (or nothing was measured) —
+    callers must then keep the root on the host."""
+    return _floor(load_table(), "merkle_min_leaves")
+
+
+def ed25519_min_batch() -> Optional[int]:
+    """Measured batch size above which the ed25519 device dispatch beats
+    the CPU plane, or None when unmeasured."""
+    return _floor(load_table(), "ed25519_min_batch")
+
+
+def _crossover(points: Dict[int, Tuple[float, float]]) -> Optional[int]:
+    """Smallest measured size from which the device wins at EVERY
+    larger measured size too — a single lucky window in the middle of
+    the sweep must not open routing below sizes where the device loses."""
+    best: Optional[int] = None
+    for size in sorted(points, reverse=True):
+        device_ms, cpu_ms = points[size]
+        if device_ms < cpu_ms:
+            best = size
+        else:
+            break
+    return best
+
+
+def _best_ms(fn, reps: int) -> float:
+    fn()  # warm: compile / first-touch
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def run_calibration(
+    merkle_sizes=(1024, 4096, 10_000),
+    ed_sizes=(256, 512, 1024, 2048),
+    reps: int = 2,
+) -> dict:
+    """Time device vs CPU at each size and derive the crossovers. Runs
+    inside the warmup subprocess (device touches are bounded there);
+    synthetic inputs — both planes' cost is shape-dependent only."""
+    import numpy as np
+
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto import merkle as cpu_merkle
+    from cometbft_tpu.crypto.tpu import ed25519_batch
+    from cometbft_tpu.crypto.tpu import merkle as tpu_merkle
+
+    table: dict = {"version": TABLE_VERSION, "measured_at": time.time()}
+
+    merkle_pts: Dict[int, Tuple[float, float]] = {}
+    rng = np.random.default_rng(7)
+    for n in merkle_sizes:
+        items = [rng.bytes(int(rng.integers(40, 90))) for _ in range(n)]
+        dev = _best_ms(
+            lambda: tpu_merkle.hash_from_byte_slices(items, force_device=True),
+            reps,
+        )
+        cpu = _best_ms(
+            lambda: cpu_merkle.hash_from_byte_slices(items), reps
+        )
+        merkle_pts[n] = (dev, cpu)
+    table["merkle"] = {
+        str(n): {"device_ms": round(d, 2), "cpu_ms": round(c, 2)}
+        for n, (d, c) in merkle_pts.items()
+    }
+    table["merkle_min_leaves"] = _crossover(merkle_pts)
+
+    ed_pts: Dict[int, Tuple[float, float]] = {}
+    key = ed.gen_priv_key_from_secret(b"calibrate")
+    pk = key.pub_key()
+    msg = b"calibration message, vote-sized padding ........................"
+    sig = key.sign(msg)
+    for n in ed_sizes:
+        pks = [pk.bytes()] * n
+        msgs = [msg] * n
+        sigs = [sig] * n
+        dev = _best_ms(
+            lambda: ed25519_batch.verify_batch(pks, msgs, sigs), reps
+        )
+        items = [(pk, msg, sig)] * n
+        cpu = _best_ms(lambda: ed.verify_many(items), reps)
+        ed_pts[n] = (dev, cpu)
+    table["ed25519"] = {
+        str(n): {"device_ms": round(d, 2), "cpu_ms": round(c, 2)}
+        for n, (d, c) in ed_pts.items()
+    }
+    table["ed25519_min_batch"] = _crossover(ed_pts)
+    return table
+
+
+def save_table(table: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)  # atomic: readers never see a torn table
+
+
+def record(path: Optional[str] = None, **kwargs) -> dict:
+    """Measure and persist — the warmup-subprocess entry point."""
+    path = path or table_path()
+    table = run_calibration(**kwargs)
+    if path:
+        save_table(table, path)
+    return table

@@ -43,6 +43,24 @@ _INNER_LEN = 65  # 0x01 || left32 || right32
 # (crypto.merkle.enable_parallel) and this floor is env-tunable for
 # locally-attached TPUs where the round-trip is microseconds.
 MIN_DEVICE_LEAVES = int(os.environ.get("CBFT_TPU_MERKLE_MIN_LEAVES", "128"))
+
+
+def device_wins(n: int) -> bool:
+    """Measurement-driven routing verdict for an n-leaf root: True only
+    when the crossover table recorded at node warmup (tpu/calibrate.py)
+    PROVED the device tree beats the host tree at this size on this
+    link. No table (fresh node, CPU-only CI, wedged tunnel) → False:
+    the round-5 measurement is that the tunneled device LOSES at every
+    size, so unproven means host. An explicitly-set
+    CBFT_TPU_MERKLE_MIN_LEAVES keeps operator precedence (e.g. a
+    locally-attached TPU whose round-trip is microseconds)."""
+    raw = os.environ.get("CBFT_TPU_MERKLE_MIN_LEAVES")
+    if raw is not None:
+        return n >= int(raw)
+    from cometbft_tpu.crypto.tpu import calibrate
+
+    floor = calibrate.merkle_min_leaves()
+    return floor is not None and n >= floor
 # device leaf hashing caps the per-item size (16 SHA blocks ≈ 1 KiB);
 # larger items fall back to host-hashed leaves + device tree. The SHA
 # message is prefix ‖ item ‖ 0x80-pad ‖ 8-byte length, so the prefix

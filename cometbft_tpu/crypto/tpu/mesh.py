@@ -114,32 +114,96 @@ def n_devices() -> int:
 
 
 _sharded_kernels = {}
+_donating_kernels = {}
+
+# [crypto] max_chunk, installed by node start (configure_chunk_cap).
+# Module state rather than an env var so in-process multi-node setups
+# don't leak one node's tuning into another via the process environment
+# — though the cap tunes the LINK, so differing values on one host are
+# a configuration smell; last configure wins.
+_configured_cap: Optional[int] = None
+
+
+def configure_chunk_cap(cap: Optional[int]) -> None:
+    """Install the [crypto] max_chunk default for every curve kernel.
+    An explicitly-set CBFT_TPU_MAX_CHUNK env var still wins (operator
+    A/B override, same precedence as the min_batch knob)."""
+    global _configured_cap
+    _configured_cap = cap
 
 
 def chunk_cap(default: int, min_pad: int) -> int:
-    """Resolve the dispatch chunk cap: CBFT_TPU_MAX_CHUNK (validated and
-    rounded UP to a power of two, so the dispatched bucket always equals
-    a padded shape and warmup covers it) overrides the caller's
-    per-curve default. One knob governs every curve kernel — the cap
-    tunes a property of the LINK (per-dispatch cost vs bytes), not of a
-    curve."""
+    """Resolve the dispatch chunk cap: CBFT_TPU_MAX_CHUNK (validated)
+    beats the configured [crypto] max_chunk beats the caller's per-curve
+    default; the winner is rounded UP to a power of two, so the
+    dispatched bucket always equals a padded shape and warmup covers it.
+    One knob governs every curve kernel — the cap tunes a property of
+    the LINK (per-dispatch cost vs bytes), not of a curve."""
     raw = os.environ.get("CBFT_TPU_MAX_CHUNK")
     if raw is None:
-        return default
-    try:
-        cap = int(raw)
-    except ValueError:
-        raise ValueError(
-            f"CBFT_TPU_MAX_CHUNK={raw!r} is not an integer"
-        ) from None
-    if cap < min_pad:
-        raise ValueError(
-            f"CBFT_TPU_MAX_CHUNK={cap} is below the minimum pad {min_pad}"
-        )
+        if _configured_cap is None:
+            return default
+        # config is validated at load (config.validate_basic); a cap
+        # below the curve's minimum pad just means "smallest bucket"
+        cap = max(int(_configured_cap), min_pad)
+    else:
+        try:
+            cap = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"CBFT_TPU_MAX_CHUNK={raw!r} is not an integer"
+            ) from None
+        if cap < min_pad:
+            raise ValueError(
+                f"CBFT_TPU_MAX_CHUNK={cap} is below the minimum pad {min_pad}"
+            )
     size = min_pad
     while size < cap:
         size *= 2
     return size
+
+
+def pipeline_depth() -> int:
+    """How many chunk dispatches may be in flight before the oldest is
+    retired. 2 = double buffering: the host packs/transfers chunk N+1
+    while the device computes chunk N — the measured win (two pipelined
+    8k chunks beat one 16k dispatch ~1.8× on the tunneled link,
+    MAXCHUNK16K.jsonl) — while staging memory stays bounded at two
+    chunks' wire. Deeper pipelines buy nothing once transfer and compute
+    overlap (the link is the bottleneck) and cost HBM per stage."""
+    raw = os.environ.get("CBFT_TPU_PIPELINE_DEPTH")
+    if raw is None:
+        return 2
+    try:
+        depth = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"CBFT_TPU_PIPELINE_DEPTH={raw!r} is not an integer"
+        ) from None
+    if depth < 1:
+        raise ValueError(f"CBFT_TPU_PIPELINE_DEPTH={depth} must be >= 1")
+    return depth
+
+
+def donating_kernel(kernel, nargs: int, donate_from: int = 0):
+    """Single-device jit of `kernel` with args [donate_from:] donated —
+    the per-chunk staging buffers are single-use, so XLA reuses their
+    space instead of holding input + workspace live together (same
+    rationale as sharded_verify's donate_argnums). Cached per
+    (kernel, nargs, donate_from) like _sharded_kernels."""
+    key = (id(kernel), nargs, donate_from)
+    step = _donating_kernels.get(key)
+    if step is None:
+        import jax
+
+        inner = getattr(kernel, "_fun", None) or getattr(
+            kernel, "__wrapped__", kernel
+        )
+        step = jax.jit(
+            inner, donate_argnums=tuple(range(donate_from, nargs))
+        )
+        _donating_kernels[key] = step
+    return step
 
 
 def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
@@ -147,8 +211,16 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
     all three curve entries): pads each chunk's trailing batch axis to a
     power of two (rounded to equal per-device shards), shards over the
     mesh when >1 device is visible, and gathers the boolean masks.
-    Dispatches every chunk before collecting any, so device work
-    overlaps host packing.
+
+    Double-buffered: at most pipeline_depth() (default 2) chunk
+    dispatches are in flight — the host packs and device_puts chunk N+1
+    (async H2D) while the device computes chunk N, then the OLDEST
+    dispatch is retired (np.asarray blocks only on it). Transfer
+    dominates this link (~180 ms of a ~216 ms 16k dispatch,
+    MAXCHUNK16K.jsonl), so the overlap is the whole win; the depth bound
+    keeps staging memory at depth × chunk wire instead of the full
+    batch. Single-device dispatches donate their staging buffers
+    (donating_kernel); the sharded path already does.
 
     `packed` is either a list of pre-packed arrays (trailing axis = the
     full batch) or a callable ``(start, end) -> list`` producing one
@@ -156,12 +228,20 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
     packing (SHA-512 hashing, merlin transcripts, scalar inversions) for
     chunk i+1 overlap the device's transfer+compute of chunk i, since
     jax dispatch returns before the result is ready."""
+    from collections import deque
+
     import numpy as np
 
     max_chunk = chunk_cap(max_chunk, min_pad)
     ndev = n_devices()
+    depth = pipeline_depth()
     out = np.zeros(n, bool)
-    pending = []
+    inflight: "deque" = deque()
+
+    def retire(slot):
+        start, end, mask = slot
+        out[start:end] = np.asarray(mask)[: end - start]
+
     for start in range(0, n, max_chunk):
         end = min(start + max_chunk, n)
         if callable(packed):
@@ -183,10 +263,19 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
         if ndev > 1:
             mask = sharded_verify(kernel, padded_args)
         else:
-            mask = kernel(*padded_args)
-        pending.append((start, end, mask))
-    for start, end, mask in pending:
-        out[start:end] = np.asarray(mask)[: end - start]
+            import jax
+            import jax.numpy as jnp
+
+            # explicit async device_put: H2D for this chunk starts now,
+            # overlapping the previous chunk's compute; the jit call
+            # then consumes already-placed (donated) buffers
+            placed = [jax.device_put(jnp.asarray(a)) for a in padded_args]
+            mask = donating_kernel(kernel, len(placed))(*placed)
+        inflight.append((start, end, mask))
+        while len(inflight) > depth:
+            retire(inflight.popleft())
+    while inflight:
+        retire(inflight.popleft())
     return out
 
 

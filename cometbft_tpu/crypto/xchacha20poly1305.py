@@ -13,7 +13,10 @@ from __future__ import annotations
 
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # slim image: RFC 8439 pure-Python inner AEAD
+    from cometbft_tpu.crypto.purepy import ChaCha20Poly1305
 
 KEY_SIZE = 32
 NONCE_SIZE = 24

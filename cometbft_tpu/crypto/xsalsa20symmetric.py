@@ -14,7 +14,10 @@ from __future__ import annotations
 import os
 import struct
 
-from cryptography.hazmat.primitives import poly1305
+try:
+    from cryptography.hazmat.primitives import poly1305
+except ImportError:  # slim image: purepy exposes the same Poly1305 API
+    from cometbft_tpu.crypto import purepy as poly1305
 
 NONCE_LEN = 24
 SECRET_LEN = 32

@@ -59,7 +59,13 @@ class Group:
 
     def write(self, data: bytes) -> int:
         with self._mtx:
-            assert self._head is not None
+            if self._head is None:
+                # rotate_file hit a double OSError and parked the group
+                # headless; retry the reopen on the next write so one
+                # transient fs error (ENOSPC, EMFILE) doesn't turn every
+                # later write into an AssertionError — the OSError from
+                # a still-failing reopen is the typed signal callers log
+                self._open_head()
             n = self._head.write(data)
             return n
 

@@ -43,12 +43,15 @@ def _build_inner() -> bool:
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return True
     cc = os.environ.get("CC", "cc")
-    # the image has libcrypto.so.3 but no dev symlink/headers: try the
-    # dev-style -lcrypto first, then link the runtime .so by path
+    # build images ship a runtime libcrypto (.so.3 or .so.1.1) without
+    # dev symlink/headers: try the dev-style -lcrypto first, then link
+    # the runtime .so by path (the EVP ABI used is stable since 1.1.1)
     candidates = [
         ["-lcrypto"],
         ["/usr/lib/x86_64-linux-gnu/libcrypto.so.3"],
         ["/lib/x86_64-linux-gnu/libcrypto.so.3"],
+        ["/usr/lib/x86_64-linux-gnu/libcrypto.so.1.1"],
+        ["/lib/x86_64-linux-gnu/libcrypto.so.1.1"],
     ]
     for libargs in candidates:
         cmd = [
@@ -155,6 +158,55 @@ def ed25519_verify_batch(
     if rc != 0:
         return None
     return [bool(out[i]) and ok_shape[i] for i in range(n)]
+
+
+def _load_single():
+    """ctypes bindings for the single-key sign/keygen entry points
+    (same .so); None on any load failure."""
+    lib = load_ed25519()
+    if lib is None:
+        return None
+    sign = getattr(lib, "cbft_ed25519_sign", None)
+    pub = getattr(lib, "cbft_ed25519_pub_from_seed", None)
+    if sign is None or pub is None:
+        return None  # stale cached .so predating these entry points
+    if not getattr(sign, "_cbft_typed", False):
+        sign.restype = ctypes.c_int
+        sign.argtypes = [
+            ctypes.c_char_p,  # seed (32)
+            ctypes.c_char_p,  # msg
+            ctypes.c_size_t,  # msglen
+            ctypes.c_char_p,  # sig out (64)
+        ]
+        sign._cbft_typed = True
+        pub.restype = ctypes.c_int
+        pub.argtypes = [
+            ctypes.c_char_p,  # seed (32)
+            ctypes.c_char_p,  # pub out (32)
+        ]
+    return sign, pub
+
+
+def ed25519_sign(seed: bytes, msg: bytes) -> Optional[bytes]:
+    """OpenSSL ed25519 signature over msg; None if the lib is unavailable."""
+    fns = _load_single()
+    if fns is None or len(seed) != 32:
+        return None
+    out = ctypes.create_string_buffer(64)
+    if fns[0](seed, msg, len(msg), out) != 0:
+        return None
+    return out.raw
+
+
+def ed25519_pub_from_seed(seed: bytes) -> Optional[bytes]:
+    """seed → 32-byte public key; None if the lib is unavailable."""
+    fns = _load_single()
+    if fns is None or len(seed) != 32:
+        return None
+    out = ctypes.create_string_buffer(32)
+    if fns[1](seed, out) != 0:
+        return None
+    return out.raw
 
 
 def load_challenges():

@@ -39,6 +39,15 @@ int EVP_DigestVerifyInit(EVP_MD_CTX *ctx, EVP_PKEY_CTX **pctx,
 int EVP_DigestVerify(EVP_MD_CTX *ctx, const unsigned char *sig,
                      size_t siglen, const unsigned char *tbs, size_t tbslen);
 
+EVP_PKEY *EVP_PKEY_new_raw_private_key(int type, ENGINE *e,
+                                       const unsigned char *priv, size_t len);
+int EVP_PKEY_get_raw_public_key(const EVP_PKEY *pkey, unsigned char *pub,
+                                size_t *len);
+int EVP_DigestSignInit(EVP_MD_CTX *ctx, EVP_PKEY_CTX **pctx,
+                       const EVP_MD *type, ENGINE *e, EVP_PKEY *pkey);
+int EVP_DigestSign(EVP_MD_CTX *ctx, unsigned char *sig, size_t *siglen,
+                   const unsigned char *tbs, size_t tbslen);
+
 typedef struct {
     const unsigned char *pubs;   /* n * 32 */
     const unsigned char *msgs;   /* concatenated */
@@ -112,6 +121,52 @@ int cbft_ed25519_verify_batch(const unsigned char *pubs,
     for (int t = 0; t < spawned; t++)
         pthread_join(tids[t], NULL);
     return 0;
+}
+
+/* --- single-key sign / keygen ------------------------------------------
+ *
+ * The image may lack the Python `cryptography` wheel entirely; these two
+ * entry points let crypto/ed25519.py keep OpenSSL semantics for signing
+ * and seed→pubkey derivation through the same ctypes .so instead of
+ * dropping to the (much slower) pure-Python scalar path. */
+
+/* Returns 0 on success; sig_out receives 64 bytes. */
+int cbft_ed25519_sign(const unsigned char *seed, const unsigned char *msg,
+                      size_t msglen, unsigned char *sig_out)
+{
+    int rc = 1;
+    EVP_PKEY *pk = EVP_PKEY_new_raw_private_key(
+        EVP_PKEY_ED25519, NULL, seed, 32);
+    if (pk != NULL) {
+        EVP_MD_CTX *ctx = EVP_MD_CTX_new();
+        if (ctx != NULL) {
+            size_t siglen = 64;
+            if (EVP_DigestSignInit(ctx, NULL, NULL, NULL, pk) == 1 &&
+                EVP_DigestSign(ctx, sig_out, &siglen, msg, msglen) == 1 &&
+                siglen == 64)
+                rc = 0;
+            EVP_MD_CTX_free(ctx);
+        }
+        EVP_PKEY_free(pk);
+    }
+    return rc;
+}
+
+/* Returns 0 on success; pub_out receives 32 bytes. */
+int cbft_ed25519_pub_from_seed(const unsigned char *seed,
+                               unsigned char *pub_out)
+{
+    int rc = 1;
+    EVP_PKEY *pk = EVP_PKEY_new_raw_private_key(
+        EVP_PKEY_ED25519, NULL, seed, 32);
+    if (pk != NULL) {
+        size_t publen = 32;
+        if (EVP_PKEY_get_raw_public_key(pk, pub_out, &publen) == 1 &&
+            publen == 32)
+            rc = 0;
+        EVP_PKEY_free(pk);
+    }
+    return rc;
 }
 
 /* --- batch challenge scalars: h = SHA-512(R ‖ A ‖ M) mod L ------------

@@ -152,10 +152,19 @@ class Node(BaseService):
             self._dbs.append(db)
             return db
 
-        # [crypto] backend is threaded explicitly to every consumer below —
-        # never set process-globally here, so in-process multi-node setups
-        # (tests, localnet runners) can mix backends. The CLI entrypoint
-        # (default_new_node) additionally sets the process default.
+        # [crypto] backend AND its tuning are threaded explicitly to
+        # every consumer below as one BackendSpec — never set
+        # process-globally here, so in-process multi-node setups (tests,
+        # localnet runners) can mix backends and min_batch values. The
+        # CLI entrypoint (default_new_node) additionally sets the
+        # process default backend name.
+        from cometbft_tpu.crypto.batch import BackendSpec
+
+        self.crypto_spec = BackendSpec(
+            name=config.crypto.backend,
+            min_batch=config.crypto.min_batch,
+            max_chunk=config.crypto.max_chunk,
+        )
 
         # 0. metrics provider (node.go:122-152 DefaultMetricsProvider —
         # Prometheus-backed when [instrumentation] enables it, no-ops
@@ -287,7 +296,7 @@ class Node(BaseService):
         # 7. evidence
         self.evidence_pool = EvidencePool(
             db_provider("evidence", config), self.state_store,
-            self.block_store, crypto_backend=config.crypto.backend,
+            self.block_store, crypto_backend=self.crypto_spec,
         )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
 
@@ -298,7 +307,7 @@ class Node(BaseService):
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
-            crypto_backend=config.crypto.backend,
+            crypto_backend=self.crypto_spec,
             metrics=sm_metrics,
             logger=self.logger,
         )
@@ -308,7 +317,7 @@ class Node(BaseService):
         self.blocksync_reactor = BlocksyncReactor(
             state, self.block_executor, self.block_store,
             fast_sync=fast_sync and not self.state_sync_enabled,
-            crypto_backend=config.crypto.backend,
+            crypto_backend=self.crypto_spec,
             logger=self.logger,
         )
         self._fast_sync_after_statesync = fast_sync
@@ -334,7 +343,7 @@ class Node(BaseService):
             config.consensus, state, self.block_executor, self.block_store,
             tx_notifier=self.mempool, evpool=self.evidence_pool, wal=wal,
             event_bus=self.event_bus,
-            crypto_backend=config.crypto.backend, metrics=cons_metrics,
+            crypto_backend=self.crypto_spec, metrics=cons_metrics,
             logger=self.logger,
         )
         if priv_validator is not None:
@@ -645,7 +654,7 @@ class Node(BaseService):
                         height=ss_cfg.trust_height,
                         hash=bytes.fromhex(ss_cfg.trust_hash),
                     ),
-                    crypto_backend=self.config.crypto.backend,
+                    crypto_backend=self.crypto_spec,
                     logger=self.logger,
                 )
             else:
@@ -786,7 +795,10 @@ def _warm_tpu_kernels(config: Config) -> None:
     - pre-compile the dispatch-size buckets in a daemon thread, so the
       first real commit hits a warm executable instead of an XLA
       compile. Failures are non-fatal — the batch boundary degrades to
-      CPU per its routing thresholds.
+      CPU per its routing thresholds;
+    - record the CPU↔device crossover table (tpu/calibrate.py) right
+      after warmup, so Merkle/ed25519 routing runs on numbers measured
+      on THIS link instead of by-construction thresholds.
 
     The whole warmup runs in a BOUNDED SUBPROCESS: the TPU tunnel can
     wedge for hours, and in-process jax init would then hang holding
@@ -800,6 +812,9 @@ def _warm_tpu_kernels(config: Config) -> None:
     import threading
 
     cache_dir = os.path.join(config.root_dir, "data", "jax_cache")
+    calib_path = os.path.join(
+        config.root_dir, "data", "tpu_calibration.json"
+    )
 
     def warm():
         try:
@@ -827,8 +842,12 @@ def _warm_tpu_kernels(config: Config) -> None:
                     f"jax.config.update('jax_compilation_cache_dir', {cache_dir!r})\n"
                     "jax.config.update("
                     "'jax_persistent_cache_min_compile_time_secs', 5.0)\n"
-                    "from cometbft_tpu.crypto.tpu import ed25519_batch\n"
-                    "ed25519_batch.warmup()\n",
+                    "from cometbft_tpu.crypto.tpu import calibrate, ed25519_batch\n"
+                    f"ed25519_batch.warmup(floor={int(config.crypto.min_batch)})\n"
+                    # the buckets are warm now, so the timings below see
+                    # steady-state dispatch, not compiles; the node's
+                    # routing reads the table lazily by mtime
+                    f"calibrate.record({calib_path!r})\n",
                 ],
                 timeout=int(os.environ.get("CBFT_TPU_WARMUP_TIMEOUT", "900")),
                 capture_output=True,
@@ -857,12 +876,19 @@ def default_new_node(config: Config, logger: Optional[Logger] = None) -> Node:
     from cometbft_tpu.crypto import batch as cryptobatch
 
     cryptobatch.set_default_backend(config.crypto.backend)
-    # [crypto] min_batch reaches the batch plane through the same knob
-    # the kernels/bench read; an operator-set env var keeps precedence
-    os.environ.setdefault(
-        "CBFT_TPU_MIN_BATCH", str(config.crypto.min_batch)
-    )
+    # [crypto] min_batch reaches the batch plane through the BackendSpec
+    # the Node threads to every consumer (crypto/batch.py) — NOT through
+    # os.environ.setdefault, which made in-process multi-node setups
+    # silently share the first node's value. max_chunk tunes the shared
+    # dispatch layer (a link property — one value per process).
     if config.crypto.backend == "tpu":
+        from cometbft_tpu.crypto.tpu import calibrate
+        from cometbft_tpu.crypto.tpu import mesh as tpu_mesh
+
+        tpu_mesh.configure_chunk_cap(config.crypto.max_chunk)
+        calibrate.set_table_path(
+            os.path.join(config.root_dir, "data", "tpu_calibration.json")
+        )
         _warm_tpu_kernels(config)
 
     node_key = NodeKey.load_or_gen(

@@ -17,13 +17,22 @@ import struct
 import threading
 from typing import Optional, Tuple
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.hashes import SHA256
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.hashes import SHA256
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+except ImportError:  # slim image: RFC-exact pure-Python primitives
+    from cometbft_tpu.crypto.purepy import (
+        ChaCha20Poly1305,
+        HKDF,
+        SHA256,
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
 
 from cometbft_tpu.crypto import ed25519
 from cometbft_tpu.crypto.merlin import Transcript

@@ -411,7 +411,13 @@ class ValidatorSet:
             raise ValueError("int64 overflow while calculating voting power needed")
         needed = total_mul // trust_level.denominator
         seen_vals: Dict[int, int] = {}
-        entries = []  # (commit_idx, val_idx, val) in order, until speculative quorum
+        # lanes are indexed by TRUSTED-set position (seen_vals guarantees
+        # each appears once), so _verify_lanes can route this variant
+        # through the resident full-lane path too — the trusted set's
+        # pubkey rows are the ones living on device
+        entries = []  # (val_idx, commit_idx, val), until speculative quorum
+        lane_msgs: list = [None] * self.size()
+        lane_sigs: list = [None] * self.size()
         speculative = 0
         double_vote: Optional[Tuple[Validator, int, int]] = None
         for idx, cs in enumerate(commit.signatures):
@@ -426,16 +432,15 @@ class ValidatorSet:
                 double_vote = (val, seen_vals[val_idx], idx)
                 break
             seen_vals[val_idx] = idx
-            entries.append((idx, val))
+            lane_msgs[val_idx] = commit.vote_sign_bytes(chain_id, idx)
+            lane_sigs[val_idx] = cs_sig(commit, idx)
+            entries.append((val_idx, idx, val))
             speculative += val.voting_power
             if speculative > needed:
                 break
-        bv = cryptobatch.new_batch_verifier(backend)
-        for idx, val in entries:
-            bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs_sig(commit, idx))
-        _, mask = bv.verify() if entries else (True, [])
+        mask = self._verify_lanes(lane_msgs, lane_sigs, entries, backend)
         tallied = 0
-        for (idx, val), ok in zip(entries, mask):
+        for (val_idx, idx, val), ok in zip(entries, mask):
             if not ok:
                 raise ValueError(
                     f"wrong signature (#{idx}): {commit.signatures[idx].signature.hex().upper()}"

@@ -19,13 +19,23 @@ import struct
 import threading
 
 import pytest
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.hashes import SHA256
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+try:
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.hashes import SHA256
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+except ImportError:  # slim image: the same fallback the module under test uses
+    from cometbft_tpu.crypto.purepy import (
+        ChaCha20Poly1305,
+        HKDF,
+        SHA256,
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
 
 from cometbft_tpu.crypto import ed25519
 from cometbft_tpu.crypto.merlin import Transcript

@@ -11,7 +11,8 @@ import pytest
 
 
 @pytest.mark.parametrize(
-    "section", ["ed25519", "validator_set", "light", "mempool", "wal"]
+    "section",
+    ["ed25519", "validator_set", "light", "mempool", "routing", "wal"],
 )
 def test_section_produces_numbers(section):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

@@ -254,6 +254,37 @@ class TestAutofile:
         assert total <= 120 + 50
         g.close()
 
+    def test_write_retries_reopen_after_failed_rotation(self, tmp_path):
+        """A double OSError during rotation parks the group headless;
+        the NEXT write must retry the reopen (one transient ENOSPC must
+        not turn every later WAL write into a dead assert), surfacing
+        OSError only while the reopen keeps failing."""
+        head = str(tmp_path / "wal")
+        g = Group(head, head_size_limit=10_000)
+        g.write(b"before")
+        g.flush()
+        real_open = g._open_head
+
+        def boom():
+            raise OSError("disk full")
+
+        g._open_head = boom
+        try:
+            with pytest.raises(OSError):
+                g.rotate_file()  # rename ok, reopen fails twice → headless
+            assert g._head is None
+            # reopen still failing: the typed error, not AssertionError
+            with pytest.raises(OSError):
+                g.write(b"lost?")
+        finally:
+            g._open_head = real_open
+        # fs recovered: the very next write reopens and lands
+        assert g.write(b"after") == 5
+        g.flush()
+        with g.reader() as r:
+            assert r.read() == b"before" + b"after"
+        g.close()
+
 
 class TestDB:
     @pytest.mark.parametrize("make", [lambda p: MemDB(), lambda p: SQLiteDB(str(p / "x.db"))])

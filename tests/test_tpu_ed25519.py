@@ -460,3 +460,77 @@ class TestValsetResident:
             vset.verify_commit("res-chain", bid, 5, commit, backend="cpu")
         with _pytest.raises(ValueError):
             vset.verify_commit("res-chain", bid, 5, commit, backend="tpu")
+
+
+class TestChunkedPipelineParity:
+    """The double-buffered chunked dispatch (the DEFAULT verify_batch
+    path) must be bit-identical to a single dispatch and to the CPU
+    serial verifier on adversarial batches: one corrupt signature
+    walked across every chunk position, at sizes straddling the chunk
+    boundary (cap 64 = the kernel's min pad, so 63/64/65/127/128/129
+    cover last-lane-of-chunk, exact-fill, and one-lane-overflow)."""
+
+    _POOL = {}
+
+    def _pool(self, n):
+        """n deterministic (pk, msg, sig) lanes, memoized — signing 129
+        keys once keeps the walk over positions cheap."""
+        if n not in self._POOL:
+            keys = [
+                ed.gen_priv_key_from_secret(b"chunk-%d" % i) for i in range(n)
+            ]
+            msgs = [b"pipelined vote %d" % i for i in range(n)]
+            self._POOL[n] = (
+                [k.pub_key().bytes() for k in keys],
+                msgs,
+                [k.sign(m) for k, m in zip(keys, msgs)],
+            )
+        pks, msgs, sigs = self._POOL[n]
+        return list(pks), list(msgs), list(sigs)
+
+    @pytest.mark.parametrize("size", [63, 64, 65, 127, 128, 129])
+    def test_one_bad_lane_per_chunk_position(self, size, monkeypatch):
+        monkeypatch.setenv("CBFT_TPU_MAX_CHUNK", "64")
+        pks, msgs, sigs = self._pool(size)
+        # positions that matter for chunk reassembly: first/last lane of
+        # each chunk, the boundary straddle, and the final ragged lane
+        positions = sorted(
+            {0, size - 1}
+            | {p for p in (63, 64, 65, 127, 128) if p < size}
+        )
+        for bad in positions:
+            s = list(sigs)
+            corrupted = bytearray(s[bad])
+            corrupted[8] ^= 1
+            s[bad] = bytes(corrupted)
+            got = ed25519_batch.verify_batch(pks, msgs, s)
+            want = [i != bad for i in range(size)]
+            # the corrupt lane must reject and, critically, reassembly
+            # must not smear the verdict onto any neighbor lane
+            assert got == want, f"size={size} bad={bad}: {got}"
+
+    def test_pipelined_matches_single_dispatch_and_cpu(self, monkeypatch):
+        """Same adversarial batch through three dispatch shapes — chunked
+        double-buffered (depth 2), chunked serial (depth 1), and one
+        unchunked dispatch — all equal to the CPU reference."""
+        n = 129
+        pks, msgs, sigs = self._pool(n)
+        for i in range(0, n, 7):  # corrupt every 7th lane
+            b = bytearray(sigs[i])
+            b[40] ^= 0x80
+            sigs[i] = bytes(b)
+        want = [
+            ed.PubKeyEd25519(p).verify_signature(m, s)
+            for p, m, s in zip(pks, msgs, sigs)
+        ]
+
+        monkeypatch.setenv("CBFT_TPU_MAX_CHUNK", "64")
+        monkeypatch.delenv("CBFT_TPU_PIPELINE_DEPTH", raising=False)
+        assert ed25519_batch.verify_batch(pks, msgs, sigs) == want
+
+        monkeypatch.setenv("CBFT_TPU_PIPELINE_DEPTH", "1")
+        assert ed25519_batch.verify_batch(pks, msgs, sigs) == want
+
+        monkeypatch.delenv("CBFT_TPU_PIPELINE_DEPTH", raising=False)
+        monkeypatch.delenv("CBFT_TPU_MAX_CHUNK", raising=False)
+        assert ed25519_batch.verify_batch(pks, msgs, sigs) == want

@@ -102,3 +102,89 @@ class TestDispatchChunking:
         ones = np.ones((2, 17), np.int32)  # column sum 2 → even → True
         out = mesh.dispatch_batch(kernel, [ones], 17, 16, 8)
         assert out.shape == (17,) and out.all()
+
+
+class TestDispatchKnobs:
+    """Resolution of the dispatch tuning knobs: CBFT_TPU_MAX_CHUNK env >
+    configured [crypto] max_chunk > per-curve default, power-of-two
+    rounding, and pipeline-depth validation."""
+
+    @staticmethod
+    def _clean(monkeypatch):
+        monkeypatch.delenv("CBFT_TPU_MAX_CHUNK", raising=False)
+        monkeypatch.delenv("CBFT_TPU_PIPELINE_DEPTH", raising=False)
+
+    def test_default_when_nothing_configured(self, monkeypatch):
+        self._clean(monkeypatch)
+        mesh.configure_chunk_cap(None)
+        assert mesh.chunk_cap(8192, 64) == 8192
+
+    def test_configured_cap_beats_default_and_rounds_up(self, monkeypatch):
+        self._clean(monkeypatch)
+        mesh.configure_chunk_cap(100)  # → next pow2 bucket = 128
+        try:
+            assert mesh.chunk_cap(8192, 64) == 128
+        finally:
+            mesh.configure_chunk_cap(None)
+
+    def test_configured_cap_below_min_pad_clamps(self, monkeypatch):
+        self._clean(monkeypatch)
+        mesh.configure_chunk_cap(3)
+        try:
+            assert mesh.chunk_cap(8192, 64) == 64
+        finally:
+            mesh.configure_chunk_cap(None)
+
+    def test_env_beats_configured(self, monkeypatch):
+        self._clean(monkeypatch)
+        monkeypatch.setenv("CBFT_TPU_MAX_CHUNK", "256")
+        mesh.configure_chunk_cap(100)
+        try:
+            assert mesh.chunk_cap(8192, 64) == 256
+        finally:
+            mesh.configure_chunk_cap(None)
+
+    def test_env_validation(self, monkeypatch):
+        self._clean(monkeypatch)
+        import pytest
+
+        monkeypatch.setenv("CBFT_TPU_MAX_CHUNK", "not-a-number")
+        with pytest.raises(ValueError, match="not an integer"):
+            mesh.chunk_cap(8192, 64)
+        monkeypatch.setenv("CBFT_TPU_MAX_CHUNK", "32")
+        with pytest.raises(ValueError, match="below the minimum pad"):
+            mesh.chunk_cap(8192, 64)
+
+    def test_pipeline_depth_default_and_override(self, monkeypatch):
+        self._clean(monkeypatch)
+        assert mesh.pipeline_depth() == 2  # double buffering
+        monkeypatch.setenv("CBFT_TPU_PIPELINE_DEPTH", "4")
+        assert mesh.pipeline_depth() == 4
+
+    def test_pipeline_depth_validation(self, monkeypatch):
+        import pytest
+
+        monkeypatch.setenv("CBFT_TPU_PIPELINE_DEPTH", "0")
+        with pytest.raises(ValueError, match="must be >= 1"):
+            mesh.pipeline_depth()
+        monkeypatch.setenv("CBFT_TPU_PIPELINE_DEPTH", "two")
+        with pytest.raises(ValueError, match="not an integer"):
+            mesh.pipeline_depth()
+
+    def test_dispatch_identical_across_depths(self, monkeypatch):
+        """Pipelining is a latency optimization only: depth 1 (serial
+        retire) and depth 3 must produce the same reassembled output."""
+        self._clean(monkeypatch)
+        import jax
+
+        @jax.jit
+        def parity_kernel(rows):
+            return (rows.sum(axis=0) % 2) == 0
+
+        rng = np.random.default_rng(41)
+        full = rng.integers(0, 100, size=(3, 50)).astype(np.int32)
+        want = (full.sum(axis=0) % 2) == 0
+        for depth in ("1", "3"):
+            monkeypatch.setenv("CBFT_TPU_PIPELINE_DEPTH", depth)
+            out = mesh.dispatch_batch(parity_kernel, [full], 50, 16, 8)
+            assert (out == want).all(), f"depth={depth}"

@@ -182,3 +182,35 @@ class TestReceiveLoopBatching:
         cs._batch_preverify_votes(batch)
         assert cs.n_batch_verify_calls == calls
         assert getattr(v1, "sig_batch_verified", None) is None
+
+
+class TestNotifyTxsAvailable:
+    def test_full_queue_drops_instead_of_parking_a_thread(self):
+        """notify_txs_available on a FULL peer queue must return
+        immediately without spawning a fallback thread (it can fire ON
+        the consensus thread via the mempool-update callback — a
+        blocking put would deadlock the node). The signal is
+        level-triggered, so dropping is safe: the next mempool update
+        re-fires it."""
+        import threading
+        import time
+
+        cs, _, _ = _make_cs(4)
+        while True:
+            try:
+                cs.peer_msg_queue.put_nowait(MsgInfo(None, "@filler"))
+            except queue.Full:
+                break
+        before = threading.active_count()
+        t0 = time.monotonic()
+        cs.notify_txs_available()  # must neither block nor park a thread
+        assert time.monotonic() - t0 < 1.0
+        assert threading.active_count() == before
+        assert cs.peer_msg_queue.full()
+
+        # with room available the poke lands
+        while not cs.peer_msg_queue.empty():
+            cs.peer_msg_queue.get_nowait()
+        cs.notify_txs_available()
+        mi = cs.peer_msg_queue.get_nowait()
+        assert mi.msg is None and mi.peer_id == "@txs"
