@@ -180,8 +180,28 @@ def _stage_compile():
     pks, msgs, sigs = _make_batch(64)
     t0 = time.perf_counter()
     out = ed25519_batch.verify_batch(pks, msgs, sigs)
+    compile_and_run_s = time.perf_counter() - t0
     assert all(out), "preflight batch must verify"
-    print(json.dumps({"compile_and_run_s": round(time.perf_counter() - t0, 2)}))
+    # emit before the split measurement: a hang on the second call must
+    # not lose the compile number (last-parseable-line contract)
+    print(
+        json.dumps({"compile_and_run_s": round(compile_and_run_s, 2)}),
+        flush=True,
+    )
+    # the second call reuses the warmed executable — pure execute time;
+    # the difference is the compile cost (persistent-cache-aware: near
+    # zero when .jax_cache already holds this shape)
+    t0 = time.perf_counter()
+    ed25519_batch.verify_batch(pks, msgs, sigs)
+    execute_s = time.perf_counter() - t0
+    print(
+        json.dumps({
+            "compile_and_run_s": round(compile_and_run_s, 2),
+            "execute_s": round(execute_s, 3),
+            "compile_s": round(max(compile_and_run_s - execute_s, 0.0), 2),
+        }),
+        flush=True,
+    )
 
 
 def _stage_run():
@@ -216,6 +236,84 @@ def _stage_run():
                 json.dumps({"sigs_per_sec": best_overall, "sweep": out}),
                 flush=True,
             )
+
+
+def _stage_scheduler():
+    """Coalesced vs per-caller dispatch throughput. N concurrent callers
+    each hold a sub-floor 64-sig request: per_caller mode builds one
+    BatchVerifier per request (N separate backend dispatches); coalesced
+    mode submits the same requests to one VerifyScheduler, whose
+    deadline/lane-budget flush folds them into fewer, larger dispatches
+    routed on the COALESCED size."""
+    import threading
+
+    _maybe_force_cpu()
+    _set_cache()
+    from cometbft_tpu.crypto import batch as cryptobatch
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+
+    backend = "cpu" if os.environ.get("BENCH_FORCE_CPU") == "1" else "tpu"
+    n_callers, per_caller = 4, 64
+    reqs = [
+        [
+            (ed.PubKeyEd25519(pk), m, s)
+            for pk, m, s in zip(*_make_batch(per_caller))
+        ]
+        for _ in range(n_callers)
+    ]
+    n_sigs = n_callers * per_caller
+
+    def fanout(fn):
+        errs = []
+
+        def wrap(i):
+            try:
+                fn(i)
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        ts = [
+            threading.Thread(target=wrap, args=(i,))
+            for i in range(n_callers)
+        ]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        return dt
+
+    def per_caller_verify(i):
+        bv = cryptobatch.new_batch_verifier(backend)
+        for pk, m, s in reqs[i]:
+            bv.add(pk, m, s)
+        ok, _ = bv.verify()
+        assert ok
+
+    per_caller_verify(0)  # warm the kernel: neither mode pays compile
+    dt_per_caller = min(fanout(per_caller_verify) for _ in range(3))
+    out = {"per_caller_sigs_per_sec": round(n_sigs / dt_per_caller, 1)}
+    print(json.dumps(out), flush=True)
+
+    sched = VerifyScheduler(spec=backend)
+    sched.start()
+    try:
+
+        def coalesced_verify(i):
+            ok, _ = sched.submit(reqs[i]).result(timeout=120)
+            assert ok
+
+        dt_coalesced = min(fanout(coalesced_verify) for _ in range(3))
+        out["coalesced_sigs_per_sec"] = round(n_sigs / dt_coalesced, 1)
+        out["scheduler_dispatches"] = sched.n_dispatches
+        out["per_caller_dispatches"] = 3 * n_callers
+    finally:
+        sched.stop()
+    print(json.dumps(out), flush=True)
 
 
 def _stage_p50():
@@ -562,7 +660,10 @@ def main():
             result = parsed["sigs_per_sec"]
 
     if result is not None:
-        for name, timeout in (("p50", 600), ("variants", 600), ("breakdown", 600)):
+        for name, timeout in (
+            ("p50", 600), ("variants", 600), ("breakdown", 600),
+            ("scheduler", 600),
+        ):
             parsed, diag = _run_stage(name, _STAGE_ENV_TPU, timeout)
             stages[f"tpu_{name}"] = parsed if parsed is not None else diag
 
@@ -580,6 +681,10 @@ def main():
         stages["cpu_fallback_run"] = parsed if parsed is not None else diag
         if parsed is not None and "sigs_per_sec" in parsed:
             result = parsed["sigs_per_sec"]
+        # scheduler coalescing numbers still matter off-chip: the
+        # contract (fewer dispatches than callers) is platform-neutral
+        parsed, diag = _run_stage("scheduler", _STAGE_ENV_CPU, 600)
+        stages["cpu_scheduler"] = parsed if parsed is not None else diag
         prior = _last_onchip_session()
         if prior is not None:
             last_onchip = {
@@ -626,6 +731,7 @@ if __name__ == "__main__":
             "p50": _stage_p50,
             "variants": _stage_variants,
             "breakdown": _stage_breakdown,
+            "scheduler": _stage_scheduler,
         }[sys.argv[2]]()
     else:
         main()

@@ -9,6 +9,9 @@ IS the parity surface):
                    bisection verification
   mempool        — mempool/v0/bench_test.go:13-82 CheckTx + Reap
   wal            — consensus/wal_test.go write throughput
+  scheduler      — VerifyScheduler coalescing contract (no Go analogue:
+                   fewer dispatches than concurrent submitters, serial-
+                   identical verdicts, deadline-bounded sub-floor flush)
 
 Run: python bench_micro.py [section ...]   (default: all, one JSON line
 per section). The headline TPU-vs-CPU bench stays in bench.py.
@@ -270,12 +273,122 @@ def bench_routing() -> dict:
     return out
 
 
+def bench_scheduler() -> dict:
+    """The VerifyScheduler coalescing contract, asserted on CPU-only CI:
+
+    - four threads each submitting a 64-sig request concurrently must
+      produce STRICTLY FEWER backend dispatches than four, with
+      per-request verdicts identical to running each request serially
+      through CPUBatchVerifier (including a poisoned request whose bad
+      signature must not leak into its neighbours' verdicts);
+    - a lone sub-floor request must complete within 10× flush_us — the
+      deadline flush, not the lane budget, is what releases it.
+
+    Keys are positive counts/values so the harness's ">0" invariant
+    doubles as the assertion surface.
+    """
+    import os
+    import threading
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["CBFT_TPU_PROBE"] = "0"
+
+    from bench import _make_batch
+    from cometbft_tpu.crypto import batch as cryptobatch
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec, CPUBatchVerifier
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+
+    dispatches = {"n": 0}
+
+    class CountingVerifier(CPUBatchVerifier):
+        def verify(self):
+            dispatches["n"] += 1
+            return super().verify()
+
+    cryptobatch.register_backend("counting", CountingVerifier)
+
+    n_callers, per_caller = 4, 64
+    reqs = [
+        [
+            (ed.PubKeyEd25519(pk), m, s)
+            for pk, m, s in zip(*_make_batch(per_caller))
+        ]
+        for _ in range(n_callers)
+    ]
+    # poison request 2: its verdicts must come back per-slice, leaving
+    # the other callers' all-ok untouched
+    pk, m, _ = reqs[2][5]
+    reqs[2][5] = (pk, m, b"\x00" * 64)
+
+    def serial_verdict(items):
+        bv = CPUBatchVerifier()
+        for k, msg, sig in items:
+            bv.add(k, msg, sig)
+        return bv.verify()
+
+    serial = [serial_verdict(items) for items in reqs]
+
+    sched = VerifyScheduler(spec=BackendSpec("counting"), flush_us=5000)
+    sched.start()
+    try:
+        results = [None] * n_callers
+        barrier = threading.Barrier(n_callers)
+
+        def worker(i):
+            barrier.wait()
+            results[i] = sched.submit(reqs[i]).result(timeout=60)
+
+        ts = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(n_callers)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        if dispatches["n"] >= n_callers:
+            raise AssertionError(
+                f"{n_callers} concurrent submitters cost {dispatches['n']} "
+                f"dispatches — no coalescing"
+            )
+        if results != serial:
+            raise AssertionError("coalesced verdicts diverge from serial")
+        if results[2][0] or not all(results[i][0] for i in (0, 1, 3)):
+            raise AssertionError("poisoned request leaked into neighbours")
+        out = {
+            "coalesced_dispatches": dispatches["n"],
+            "dispatch_savings": n_callers - dispatches["n"],
+            "verdicts_match_serial": 1,
+            "poison_isolated": 1,
+        }
+
+        # lone sub-floor request: only the deadline can release it
+        t0 = time.perf_counter()
+        ok, mask = sched.submit(reqs[0][:3]).result(timeout=60)
+        dt = time.perf_counter() - t0
+        if not (ok and len(mask) == 3):
+            raise AssertionError("sub-floor request verdict wrong")
+        bound_s = 10 * sched.flush_us / 1e6
+        if dt > bound_s:
+            raise AssertionError(
+                f"sub-floor request took {dt * 1e3:.1f}ms > 10×flush_us "
+                f"({bound_s * 1e3:.0f}ms)"
+            )
+        out["sub_floor_latency_ms"] = round(dt * 1e3, 2)
+        out["deadline_bound_ms"] = round(bound_s * 1e3, 1)
+    finally:
+        sched.stop()
+    return out
+
+
 SECTIONS = {
     "ed25519": bench_ed25519,
     "validator_set": bench_validator_set,
     "light": bench_light,
     "mempool": bench_mempool,
     "routing": bench_routing,
+    "scheduler": bench_scheduler,
     "wal": bench_wal,
 }
 

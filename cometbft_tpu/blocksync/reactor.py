@@ -13,6 +13,13 @@ quorum-sigs signatures per device round-trip, which is where batch
 hardware wins (BASELINE.md config #4). Validator-set changes inside the
 window are detected via header.validators_hash and those blocks drop out
 of the batch to the exact reference per-block path.
+
+When the node's VerifyScheduler travels crypto_backend
+(crypto/scheduler.py), each window block's commit is submitted as its
+own request instead: the scheduler coalesces them (and any concurrent
+consensus/light submissions) into one dispatch, and the per-block
+futures let block i APPLY while blocks i+1.. are still verifying —
+the next commit is in flight during the current apply.
 """
 
 from __future__ import annotations
@@ -296,6 +303,13 @@ class BlocksyncReactor(Reactor):
             per_block.append(entries)
             lanes_per_block.append((lane_msgs, lane_sigs))
 
+        futs = self._submit_window_commits(per_block, lanes_per_block, state)
+        if futs is not None:
+            return self._apply_window_pipelined(
+                chain_id, state, val_hash, firsts, block_ids, part_sets,
+                per_block, futs, window, needed,
+            )
+
         mask = self._verify_window_lanes(per_block, lanes_per_block, state)
         if not all(mask):
             return self._sync_one(chain_id, state)
@@ -316,6 +330,80 @@ class BlocksyncReactor(Reactor):
             # assumption from this point on — re-verify individually
             if state.validators.hash() != val_hash:
                 return state
+            try:
+                self.block_exec.validate_block(state, first)
+            except Exception:
+                # single-block path re-verifies and attributes the failure
+                return self._sync_one(chain_id, state)
+            state = self._apply_one(
+                state, block_ids[i], first, part_sets[i],
+                window[i + 1].last_commit,
+            )
+        return state
+
+    def _submit_window_commits(self, per_block, lanes_per_block, state):
+        """Submit every window block's quorum prefix as its OWN request
+        to the node-wide verification scheduler → one VerifyFuture per
+        block, or None when the scheduler isn't wired (bare backend
+        name/spec) or the resident full-lane path is the better route.
+
+        All requests land inside one flush deadline, so the scheduler
+        coalesces the whole window (plus whatever consensus/light have
+        pending) into one dispatch — and because each block keeps its
+        own verdict slice, a bad commit deep in the window no longer
+        throws away its verified predecessors."""
+        scheduler = (
+            self.crypto_backend
+            if hasattr(self.crypto_backend, "submit")
+            and hasattr(self.crypto_backend, "spec")
+            else None
+        )
+        if scheduler is None:
+            return None
+        from cometbft_tpu.crypto import ed25519 as ed
+
+        vals = state.validators.validators
+        if all(
+            cryptobatch.resident_commit_eligible(
+                len(entries), self.crypto_backend
+            )
+            for entries in per_block
+        ) and all(isinstance(v.pub_key, ed.PubKeyEd25519) for v in vals):
+            return None  # device-resident fixed executable wins at scale
+        return [
+            scheduler.submit(
+                [
+                    (val.pub_key, lane_msgs[idx], lane_sigs[idx])
+                    for idx, val in entries
+                ]
+            )
+            for entries, (lane_msgs, lane_sigs) in zip(
+                per_block, lanes_per_block
+            )
+        ]
+
+    def _apply_window_pipelined(
+        self, chain_id, state, val_hash, firsts, block_ids, part_sets,
+        per_block, futs, window, needed,
+    ):
+        """Apply the window with verification overlapped: every block's
+        commit was already submitted (_submit_window_commits), so while
+        block i applies, blocks i+1.. are still verifying in the
+        scheduler — the next block's commit is in flight during the
+        current block's apply. A failed verdict or quorum only costs the
+        suffix: the verified prefix stays applied and the reference
+        single-block path re-attributes the failure from there."""
+        for i, first in enumerate(firsts):
+            # a validator-set change mid-window invalidates the batch
+            # assumption from this point on — re-verify individually
+            if state.validators.hash() != val_hash:
+                return state
+            ok_all, mask_i = futs[i].result()
+            if not ok_all:
+                return self._sync_one(chain_id, state)
+            tallied = sum(val.voting_power for _, val in per_block[i])
+            if tallied <= needed:
+                return self._sync_one(chain_id, state)
             try:
                 self.block_exec.validate_block(state, first)
             except Exception:

@@ -239,6 +239,12 @@ class CryptoConfig:
     # Rounded up to a power of two at the dispatch layer; an
     # explicitly-set CBFT_TPU_MAX_CHUNK env var wins.
     max_chunk: int = 8192
+    # Deadline (µs) the node-wide verification scheduler
+    # (crypto/scheduler.py) holds a pending request open for the chance
+    # of coalescing with other subsystems' submissions before flushing
+    # a partial dispatch. Bounds the extra latency a lone request pays;
+    # an explicitly-set CBFT_VERIFY_FLUSH_US env var wins.
+    flush_us: int = 500
 
 
 @dataclass
@@ -275,7 +281,7 @@ class Config:
         # min_batch/max_chunk are load-bearing (they drive the batch
         # plane's routing and chunking): reject malformed TOML at
         # startup, not at the first commit
-        for knob in ("min_batch", "max_chunk"):
+        for knob in ("min_batch", "max_chunk", "flush_us"):
             v = getattr(self.crypto, knob)
             if not isinstance(v, int) or isinstance(v, bool) or v < 1:
                 raise ValueError(

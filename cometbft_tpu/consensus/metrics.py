@@ -80,6 +80,11 @@ class Metrics:
             SUBSYSTEM, "block_gossip_parts_received",
             "Block parts received, by relevance to the gathering block.",
         )
+        self.preverify_dropped = r.counter(
+            SUBSYSTEM, "preverify_dropped",
+            "Drained votes excluded from batch preverification, by "
+            "reason (negative_index|empty_signature).",
+        )
         self.quorum_prevote_delay = r.gauge(
             SUBSYSTEM, "quorum_prevote_delay",
             "Seconds from proposal timestamp to the prevote that completed "

@@ -403,6 +403,21 @@ class ConsensusState(BaseService):
                     continue
                 vote = m.msg.vote
                 if vote.validator_index < 0 or not vote.signature:
+                    reason = (
+                        "negative_index"
+                        if vote.validator_index < 0
+                        else "empty_signature"
+                    )
+                    self.metrics.preverify_dropped.with_labels(
+                        reason=reason
+                    ).add()
+                    self.logger.debug(
+                        "vote excluded from batch preverification",
+                        reason=reason,
+                        height=vote.height,
+                        round=vote.round,
+                        validator_index=vote.validator_index,
+                    )
                     continue
                 vs = self._resolve_vote_target(vote)
                 if vs is None:

@@ -38,11 +38,23 @@ class BackendSpec:
     max_chunk: Optional[int] = None
 
 
-# what every verify path accepts where a backend used to be a str
-Backend = Union[str, BackendSpec, None]
+# what every verify path accepts where a backend used to be a str: a
+# bare name, a BackendSpec, or the node's VerifyScheduler (duck-typed:
+# anything exposing .submit + .spec — crypto/scheduler.py), which
+# coalesces concurrent callers into one dispatch
+Backend = Union[str, BackendSpec, None, object]
+
+
+def unwrap_backend(backend: Backend) -> Union[str, BackendSpec, None]:
+    """A scheduler travels the same opaque parameter a backend name
+    does; every eligibility/floor check resolves against its spec."""
+    if hasattr(backend, "submit") and hasattr(backend, "spec"):
+        return backend.spec
+    return backend
 
 
 def backend_name(backend: Backend) -> str:
+    backend = unwrap_backend(backend)
     if isinstance(backend, BackendSpec):
         return backend.name
     return backend or _default_backend
@@ -330,9 +342,8 @@ def resident_commit_eligible(
     key-type scan and pk-bytes build that verify_commit_valset needs."""
     if backend_name(backend) != "tpu":
         return False
-    spec_floor = (
-        backend.min_batch if isinstance(backend, BackendSpec) else None
-    )
+    spec = unwrap_backend(backend)
+    spec_floor = spec.min_batch if isinstance(spec, BackendSpec) else None
     if n_present < ed25519_routing_floor(spec_floor):
         return False
     return device_plane_ok()
@@ -359,9 +370,8 @@ def verify_commit_valset(
     if backend_name(backend) != "tpu":
         return None
     present = sum(1 for m in msgs if m is not None)
-    spec_floor = (
-        backend.min_batch if isinstance(backend, BackendSpec) else None
-    )
+    spec = unwrap_backend(backend)
+    spec_floor = spec.min_batch if isinstance(spec, BackendSpec) else None
     if present < ed25519_routing_floor(spec_floor):
         return None
     if not device_plane_ok():
@@ -403,7 +413,37 @@ def default_backend() -> str:
     return _default_backend
 
 
+class ScheduledBatchVerifier(BatchVerifier):
+    """add()/verify() protocol on top of the node-wide VerifyScheduler
+    (crypto/scheduler.py): verify() submits the collected items as ONE
+    request and blocks on its future, so whatever OTHER subsystems have
+    pending rides the same coalesced dispatch — and the TPU/CPU routing
+    floor is applied to the coalesced size, not this caller's size.
+    Existing call sites get coalescing without code changes the moment
+    the node threads its scheduler where the BackendSpec used to go."""
+
+    def __init__(self, scheduler):
+        self._scheduler = scheduler
+        self._items: List[Tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key is None:
+            raise ValueError("nil pubkey")
+        self._items.append((pub_key, bytes(msg), bytes(sig)))
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        items, self._items = self._items, []
+        if not items:
+            return False, []
+        return self._scheduler.submit(items).result()
+
+
 def new_batch_verifier(backend: Backend = None) -> BatchVerifier:
+    if hasattr(backend, "submit") and hasattr(backend, "spec"):
+        return ScheduledBatchVerifier(backend)
     with _mtx:
         name = backend_name(backend)
         factory = _registry.get(name)

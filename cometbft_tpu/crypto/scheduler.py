@@ -1,0 +1,369 @@
+"""Node-wide verification scheduler — cross-subsystem micro-batch
+coalescing with deadline flush and future-based results.
+
+PR 1 made a *single* dispatch fast (double-buffered chunks, resident
+valsets, measured routing), but every call site — consensus vote-drain
+preverify, blocksync commit checks, the light verifier, evidence — still
+built its own BatchVerifier and blocked on its own dispatch, so
+concurrent sub-floor batches (a 150-sig commit, a dozen drained votes)
+either under-filled the 1024-lane dispatch or were routed to CPU
+entirely. This is the dynamic-batching pattern from inference serving
+(and the FPGA ECDSA engine's shared request queue feeding one wide
+pipeline — PAPERS.md) applied to the node: one background service
+accepts ``submit(items) -> VerifyFuture`` from any thread, coalesces
+every concurrently pending request into ONE padded lane-aligned
+dispatch, and flushes on whichever fires first:
+
+  * lane budget reached (``[crypto] max_chunk`` — the dispatch layer's
+    chunk cap, so a full coalesced batch is exactly one device chunk);
+  * deadline expiry (``[crypto] flush_us`` / env ``CBFT_VERIFY_FLUSH_US``,
+    default 500 µs — bounds the latency a lone request can pay for the
+    chance of sharing a dispatch);
+  * explicit ``flush()`` (drain paths, tests).
+
+Per-request verdict slices are demultiplexed from the batch mask, so one
+caller's bad signature never fails another's request, and TPU-vs-CPU
+routing (the calibrated floor in crypto/batch.py) is decided on the
+COALESCED size by construction: the dispatch builds one backend verifier
+over all coalesced items, whose per-curve thresholds see the total
+count. Small concurrent batches now clear the floor together.
+
+Integration: the scheduler is accepted anywhere a backend name /
+BackendSpec travels (crypto/batch.py ``Backend``) — ``new_batch_verifier``
+returns a thin adapter whose ``verify()`` submits to the scheduler, so
+every existing call site coalesces the moment the node threads its
+scheduler instead of its bare spec. ``new_batch_verifier("cpu"|"tpu")``
+keeps working standalone for tests and embedders.
+
+If the device plane dies mid-flight (a dispatch raises), the affected
+flush falls back to the CPU ground-truth verifier so no future is left
+hanging and verdicts stay bit-identical to serial verification; the
+fallback is counted. ``stop()`` drains: queued requests are dispatched
+(not abandoned) before the worker exits.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from cometbft_tpu.crypto import PubKey
+from cometbft_tpu.crypto.batch import (
+    Backend,
+    BackendSpec,
+    CPUBatchVerifier,
+    new_batch_verifier,
+)
+from cometbft_tpu.libs.log import Logger
+from cometbft_tpu.libs.metrics import Registry
+from cometbft_tpu.libs.service import BaseService
+
+DEFAULT_FLUSH_US = 500
+SUBSYSTEM = "verify_scheduler"
+
+Item = Tuple[PubKey, bytes, bytes]
+
+
+def flush_us_default(config_flush_us: Optional[int] = None) -> int:
+    """Deadline resolution, same precedence shape as the routing floor
+    (crypto/batch.py ed25519_routing_floor): env operator override >
+    configured [crypto] flush_us > built-in 500 µs."""
+    raw = os.environ.get("CBFT_VERIFY_FLUSH_US")
+    if raw is not None:
+        return int(raw)
+    if config_flush_us is not None:
+        return config_flush_us
+    return DEFAULT_FLUSH_US
+
+
+class Metrics:
+    """Scheduler observability (libs/metrics.py instruments), wired into
+    the node's Prometheus registry when [instrumentation] enables it."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry if registry is not None else Registry()
+        self.lane_fill_ratio = r.histogram(
+            SUBSYSTEM, "lane_fill_ratio",
+            "Coalesced dispatch size as a fraction of the lane budget.",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        self.flushes = r.counter(
+            SUBSYSTEM, "flushes",
+            "Coalesced dispatches, by flush trigger (size|deadline|"
+            "explicit|drain).",
+        )
+        self.queue_depth = r.gauge(
+            SUBSYSTEM, "queue_depth",
+            "Requests currently waiting for the next coalesced dispatch.",
+        )
+        self.pending_lanes = r.gauge(
+            SUBSYSTEM, "pending_lanes",
+            "Signatures currently waiting for the next coalesced dispatch.",
+        )
+        self.request_wait_seconds = r.histogram(
+            SUBSYSTEM, "request_wait_seconds",
+            "Per-request wait from submit to dispatch start.",
+            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                     0.05, 0.25, 1.0),
+        )
+        self.requests = r.counter(
+            SUBSYSTEM, "requests", "Requests submitted."
+        )
+        self.signatures = r.counter(
+            SUBSYSTEM, "signatures", "Signatures submitted."
+        )
+        self.cpu_fallbacks = r.counter(
+            SUBSYSTEM, "cpu_fallbacks",
+            "Dispatches that fell back to the CPU ground-truth verifier "
+            "after the configured backend raised mid-flight.",
+        )
+
+    @classmethod
+    def nop(cls) -> "Metrics":
+        return cls(None)
+
+
+class VerifyFuture:
+    """Result handle for one submitted request. ``result()`` blocks until
+    the request's flush lands and returns ``(all_ok, per_item_mask)`` —
+    the same contract as BatchVerifier.verify(), sliced to this request
+    only (another caller's bad signature is invisible here)."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._result: Optional[Tuple[bool, List[bool]]] = None
+        self._exc: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(
+        self, timeout: Optional[float] = None
+    ) -> Tuple[bool, List[bool]]:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("verification future not ready")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    # -- completion (scheduler-side) ---------------------------------------
+
+    def _set(self, result: Tuple[bool, List[bool]]) -> None:
+        self._result = result
+        self._ev.set()
+
+    def _set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._ev.set()
+
+
+class _Request:
+    __slots__ = ("items", "future", "t_submit")
+
+    def __init__(self, items: List[Item]):
+        self.items = items
+        self.future = VerifyFuture()
+        self.t_submit = time.monotonic()
+
+
+class VerifyScheduler(BaseService):
+    """Per-node background coalescer over the batch-verification boundary.
+
+    Threads carrying verification work (consensus receive loop, blocksync
+    pool routine, light client / statesync, evidence, RPC) call
+    ``submit`` and block on the returned future only when they need the
+    verdict — so requests submitted while another caller's dispatch is
+    being assembled ride the same device round-trip.
+
+    The scheduler is duck-typed as a crypto Backend: it exposes ``spec``
+    (the node's BackendSpec) and ``submit``, which crypto/batch.py
+    unwraps. When the service is not running (standalone use, or after
+    stop), ``submit`` degrades to an inline synchronous dispatch — the
+    future is completed before it is returned, so no caller can hang on
+    a dead service.
+    """
+
+    def __init__(
+        self,
+        spec: Backend = None,
+        flush_us: Optional[int] = None,
+        lane_budget: Optional[int] = None,
+        metrics: Optional[Metrics] = None,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("VerifyScheduler", logger)
+        if isinstance(spec, BackendSpec):
+            self.spec = spec
+        else:
+            self.spec = BackendSpec(name=spec) if spec else BackendSpec(
+                name=os.environ.get("CMT_CRYPTO_BACKEND", "cpu")
+            )
+        self._flush_s = flush_us_default(flush_us) / 1e6
+        if lane_budget is None:
+            lane_budget = self.spec.max_chunk
+        if lane_budget is None:
+            raw = os.environ.get("CBFT_TPU_MAX_CHUNK")
+            lane_budget = int(raw) if raw else 8192
+        self._lane_budget = max(1, int(lane_budget))
+        self.metrics = metrics if metrics is not None else Metrics.nop()
+
+        self._cond = threading.Condition()
+        self._requests: List[_Request] = []
+        self._pending_lanes = 0
+        self._flush_asked = False
+        self._draining = False
+        self._worker: Optional[threading.Thread] = None
+        # observability for tests/bench: coalesced dispatches performed
+        self.n_dispatches = 0
+
+    # -- knob introspection --------------------------------------------------
+
+    @property
+    def flush_us(self) -> int:
+        return int(self._flush_s * 1e6)
+
+    @property
+    def lane_budget(self) -> int:
+        return self._lane_budget
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def on_start(self) -> None:
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name="verify-scheduler"
+        )
+        self._worker.start()
+
+    def on_stop(self) -> None:
+        with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+        w = self._worker
+        if w is not None and w is not threading.current_thread():
+            w.join(timeout=30.0)
+        # belt and braces: if the worker died or never ran, complete
+        # whatever is still queued inline so no future is left hanging
+        with self._cond:
+            leftovers, self._requests = self._requests, []
+            self._pending_lanes = 0
+        if leftovers:
+            self._dispatch(leftovers, "drain")
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, items: Sequence[Item]) -> VerifyFuture:
+        """Queue ``items`` (``(pub_key, msg, sig)`` triples) for the next
+        coalesced dispatch. Thread-safe; never blocks on the device."""
+        req = _Request([(pk, bytes(m), bytes(s)) for pk, m, s in items])
+        self.metrics.requests.add()
+        self.metrics.signatures.add(len(req.items))
+        if not req.items:
+            req.future._set((True, []))
+            return req.future
+        if not self.is_running():
+            # standalone / post-stop: synchronous inline dispatch keeps
+            # the contract (future complete on return, exact verdicts)
+            self._dispatch([req], "explicit")
+            return req.future
+        with self._cond:
+            self._requests.append(req)
+            self._pending_lanes += len(req.items)
+            self.metrics.queue_depth.set(len(self._requests))
+            self.metrics.pending_lanes.set(self._pending_lanes)
+            self._cond.notify_all()
+        return req.future
+
+    def flush(self) -> None:
+        """Ask the worker to dispatch whatever is pending right now."""
+        if not self.is_running():
+            return
+        with self._cond:
+            self._flush_asked = True
+            self._cond.notify_all()
+
+    # -- worker --------------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                reason = None
+                while reason is None:
+                    if self._draining:
+                        reason = "drain"
+                        break
+                    if self._pending_lanes >= self._lane_budget:
+                        reason = "size"
+                        break
+                    if self._flush_asked:
+                        # an explicit flush with nothing pending is a no-op
+                        self._flush_asked = False
+                        if self._requests:
+                            reason = "explicit"
+                            break
+                    if self._requests:
+                        wake = self._requests[0].t_submit + self._flush_s
+                        left = wake - time.monotonic()
+                        if left <= 0:
+                            reason = "deadline"
+                            break
+                        self._cond.wait(left)
+                    else:
+                        self._cond.wait(0.1)
+                batch, self._requests = self._requests, []
+                self._pending_lanes = 0
+                self.metrics.queue_depth.set(0)
+                self.metrics.pending_lanes.set(0)
+                draining = self._draining
+            if batch:
+                self._dispatch(batch, reason)
+            if draining and not batch:
+                return
+            if draining:
+                # one more sweep: a submit that raced stop lands too
+                continue
+
+    def _dispatch(self, batch: List[_Request], reason: str) -> None:
+        """ONE backend verify over the coalesced items, demultiplexed back
+        into per-request verdict slices."""
+        t0 = time.monotonic()
+        items: List[Item] = []
+        for req in batch:
+            self.metrics.request_wait_seconds.observe(t0 - req.t_submit)
+            items.extend(req.items)
+        self.n_dispatches += 1
+        self.metrics.flushes.with_labels(reason=reason).add()
+        self.metrics.lane_fill_ratio.observe(
+            min(1.0, len(items) / self._lane_budget)
+        )
+        mask = self._verify(items)
+        pos = 0
+        for req in batch:
+            sub = mask[pos : pos + len(req.items)]
+            pos += len(req.items)
+            req.future._set((all(sub), sub))
+
+    def _verify(self, items: List[Item]) -> List[bool]:
+        try:
+            bv = new_batch_verifier(self.spec)
+            for pk, m, s in items:
+                bv.add(pk, m, s)
+            _, mask = bv.verify()
+            if len(mask) != len(items):
+                raise RuntimeError(
+                    f"backend returned {len(mask)} verdicts for "
+                    f"{len(items)} items"
+                )
+            return mask
+        except Exception as exc:  # noqa: BLE001 - device plane died mid-flight
+            self.metrics.cpu_fallbacks.add()
+            self.logger.error(
+                "verify dispatch failed; falling back to CPU",
+                err=str(exc), n=len(items),
+            )
+            bv = CPUBatchVerifier()
+            for pk, m, s in items:
+                bv.add(pk, m, s)
+            _, mask = bv.verify()
+            return mask

@@ -6,13 +6,17 @@ ValidateTrustLevel (:196). Signature checks route through the
 batch-verification boundary via ValidatorSet.verify_commit_light /
 verify_commit_light_trusting, so the TPU backend accelerates both the
 2/3 check on the new set and the 1/3 trusting check on the old set.
+The `backend` parameter accepts anything `crypto.batch.Backend` does —
+a backend name, a BackendSpec, or the node's VerifyScheduler, in which
+case light-client signature lanes coalesce with verification traffic
+from other subsystems into shared TPU dispatches.
 
 Durations are nanoseconds; `now` is a proto Timestamp.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from cometbft_tpu.crypto.batch import Backend
 
 from cometbft_tpu.light.errors import (
     ErrInvalidHeader,
@@ -100,7 +104,7 @@ def verify_adjacent(
     trusting_period_ns: int,
     now: Timestamp,
     max_clock_drift_ns: int,
-    backend: Optional[str] = None,
+    backend: Backend = None,
 ) -> None:
     """verifier.go:93 VerifyAdjacent."""
     if untrusted_header.height != trusted_header.height + 1:
@@ -149,7 +153,7 @@ def verify_non_adjacent(
     now: Timestamp,
     max_clock_drift_ns: int,
     trust_level: Fraction = DEFAULT_TRUST_LEVEL,
-    backend: Optional[str] = None,
+    backend: Backend = None,
 ) -> None:
     """verifier.go:32 VerifyNonAdjacent."""
     if untrusted_header.height == trusted_header.height + 1:
@@ -200,7 +204,7 @@ def verify(
     now: Timestamp,
     max_clock_drift_ns: int,
     trust_level: Fraction = DEFAULT_TRUST_LEVEL,
-    backend: Optional[str] = None,
+    backend: Backend = None,
 ) -> None:
     """verifier.go:135 Verify — dispatch on adjacency."""
     if untrusted_header.height != trusted_header.height + 1:

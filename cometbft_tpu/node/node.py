@@ -170,6 +170,7 @@ class Node(BaseService):
         # Prometheus-backed when [instrumentation] enables it, no-ops
         # otherwise so instrumentation points stay free)
         from cometbft_tpu.consensus.metrics import Metrics as ConsMetrics
+        from cometbft_tpu.crypto.scheduler import Metrics as SchedMetrics
         from cometbft_tpu.libs.metrics import Registry
         from cometbft_tpu.mempool.metrics import Metrics as MemMetrics
         from cometbft_tpu.p2p.metrics import Metrics as P2PMetrics
@@ -183,12 +184,30 @@ class Node(BaseService):
             p2p_metrics = P2PMetrics(self.metrics_registry)
             mem_metrics = MemMetrics(self.metrics_registry)
             sm_metrics = SMMetrics(self.metrics_registry)
+            sched_metrics = SchedMetrics(self.metrics_registry)
         else:
             self.metrics_registry = None
             cons_metrics = ConsMetrics.nop()
             p2p_metrics = P2PMetrics.nop()
             mem_metrics = MemMetrics.nop()
             sm_metrics = SMMetrics.nop()
+            sched_metrics = SchedMetrics.nop()
+
+        # 0b. the node-wide verification scheduler: ONE coalescer every
+        # verification-carrying subsystem submits through, so concurrent
+        # sub-floor batches (a commit check racing a vote drain) share a
+        # single padded dispatch and clear the TPU routing floor
+        # together. It travels the same parameter the BackendSpec did —
+        # crypto/batch.py unwraps it — so standalone new_batch_verifier
+        # users keep working unchanged.
+        from cometbft_tpu.crypto.scheduler import VerifyScheduler
+
+        self.verify_scheduler = VerifyScheduler(
+            spec=self.crypto_spec,
+            flush_us=config.crypto.flush_us,
+            metrics=sched_metrics,
+            logger=self.logger,
+        )
 
         # 1. stores
         self.block_store = BlockStore(db_provider("blockstore", config))
@@ -296,7 +315,7 @@ class Node(BaseService):
         # 7. evidence
         self.evidence_pool = EvidencePool(
             db_provider("evidence", config), self.state_store,
-            self.block_store, crypto_backend=self.crypto_spec,
+            self.block_store, crypto_backend=self.verify_scheduler,
         )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
 
@@ -307,7 +326,7 @@ class Node(BaseService):
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
-            crypto_backend=self.crypto_spec,
+            crypto_backend=self.verify_scheduler,
             metrics=sm_metrics,
             logger=self.logger,
         )
@@ -317,7 +336,7 @@ class Node(BaseService):
         self.blocksync_reactor = BlocksyncReactor(
             state, self.block_executor, self.block_store,
             fast_sync=fast_sync and not self.state_sync_enabled,
-            crypto_backend=self.crypto_spec,
+            crypto_backend=self.verify_scheduler,
             logger=self.logger,
         )
         self._fast_sync_after_statesync = fast_sync
@@ -343,7 +362,7 @@ class Node(BaseService):
             config.consensus, state, self.block_executor, self.block_store,
             tx_notifier=self.mempool, evpool=self.evidence_pool, wal=wal,
             event_bus=self.event_bus,
-            crypto_backend=self.crypto_spec, metrics=cons_metrics,
+            crypto_backend=self.verify_scheduler, metrics=cons_metrics,
             logger=self.logger,
         )
         if priv_validator is not None:
@@ -589,6 +608,11 @@ class Node(BaseService):
                 pass
 
     def on_start(self) -> None:
+        # the verification coalescer goes live before any reactor that
+        # can carry signatures (blocksync starts verifying immediately
+        # after switch.start); submit() degrades to inline dispatch when
+        # the service is down, so ordering is a perf matter, not safety
+        self.verify_scheduler.start()
         host, port = _parse_laddr(self.config.p2p.laddr)
         self.transport.listen(NetAddress(self.node_key.id(), host, port))
         if self.addr_book is not None:
@@ -654,7 +678,7 @@ class Node(BaseService):
                         height=ss_cfg.trust_height,
                         hash=bytes.fromhex(ss_cfg.trust_hash),
                     ),
-                    crypto_backend=self.crypto_spec,
+                    crypto_backend=self.verify_scheduler,
                     logger=self.logger,
                 )
             else:
@@ -750,6 +774,15 @@ class Node(BaseService):
                 self.logger.error("error stopping service", err=str(exc))
         if self.consensus_state.is_running():
             self.consensus_state.stop()
+        # after every verification-carrying service: stop() drains the
+        # queue (dispatching, not abandoning), so no future hangs
+        if self.verify_scheduler.is_running():
+            try:
+                self.verify_scheduler.stop()
+            except Exception as exc:
+                self.logger.error(
+                    "error stopping verify scheduler", err=str(exc)
+                )
         if self._privval_endpoint is not None:
             self._privval_endpoint.close()
         # release DB file locks so maintenance commands (rollback,

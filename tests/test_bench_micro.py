@@ -12,7 +12,10 @@ import pytest
 
 @pytest.mark.parametrize(
     "section",
-    ["ed25519", "validator_set", "light", "mempool", "routing", "wal"],
+    [
+        "ed25519", "validator_set", "light", "mempool", "routing",
+        "scheduler", "wal",
+    ],
 )
 def test_section_produces_numbers(section):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

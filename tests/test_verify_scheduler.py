@@ -1,0 +1,439 @@
+"""VerifyScheduler: cross-subsystem micro-batch coalescing.
+
+Contract under test (crypto/scheduler.py):
+  - concurrent submitters share ONE coalesced backend dispatch, with
+    per-request verdict slices identical to serial verification;
+  - a lone sub-floor request is released by the deadline flush within
+    10x flush_us;
+  - one caller's bad signature never fails another caller's request;
+  - stop() drains — no future is left hanging;
+  - a backend that dies mid-flight falls back to the CPU ground truth.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import batch as cryptobatch
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto.batch import (
+    BackendSpec,
+    CPUBatchVerifier,
+    ScheduledBatchVerifier,
+    new_batch_verifier,
+    unwrap_backend,
+)
+from cometbft_tpu.crypto.scheduler import (
+    DEFAULT_FLUSH_US,
+    VerifyScheduler,
+    flush_us_default,
+)
+
+
+def _make_items(n, tag=b"", poison_at=None):
+    items = []
+    for i in range(n):
+        k = ed.gen_priv_key_from_secret(tag + bytes([i & 0xFF, i >> 8]))
+        msg = b"scheduler-msg-" + tag + i.to_bytes(4, "big")
+        sig = k.sign(msg)
+        if poison_at is not None and i == poison_at:
+            sig = b"\x00" * 64
+        items.append((k.pub_key(), msg, sig))
+    return items
+
+
+def _serial_verdict(items):
+    bv = CPUBatchVerifier()
+    for pk, m, s in items:
+        bv.add(pk, m, s)
+    return bv.verify()
+
+
+class CountingVerifier(CPUBatchVerifier):
+    dispatches = 0
+    sizes = []
+
+    def verify(self):
+        CountingVerifier.dispatches += 1
+        CountingVerifier.sizes.append(self.count())
+        return super().verify()
+
+
+@pytest.fixture()
+def counting_backend():
+    CountingVerifier.dispatches = 0
+    CountingVerifier.sizes = []
+    cryptobatch.register_backend("counting", CountingVerifier)
+    return BackendSpec("counting")
+
+
+@pytest.fixture()
+def sched(counting_backend):
+    s = VerifyScheduler(spec=counting_backend, flush_us=5000)
+    s.start()
+    yield s
+    if s.is_running():
+        s.stop()
+
+
+def _fanout(sched, reqs, timeout=60):
+    results = [None] * len(reqs)
+    barrier = threading.Barrier(len(reqs))
+
+    def worker(i):
+        barrier.wait()
+        results[i] = sched.submit(reqs[i]).result(timeout=timeout)
+
+    ts = [
+        threading.Thread(target=worker, args=(i,)) for i in range(len(reqs))
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return results
+
+
+class TestCoalescing:
+    def test_concurrent_submitters_share_dispatches(self, sched):
+        reqs = [_make_items(64, tag=bytes([i])) for i in range(4)]
+        serial = [_serial_verdict(items) for items in reqs]
+        results = _fanout(sched, reqs)
+        # strictly fewer backend dispatches than submitters
+        assert 1 <= CountingVerifier.dispatches < 4
+        assert results == serial
+        assert all(ok for ok, _ in results)
+        assert sched.n_dispatches == CountingVerifier.dispatches
+
+    def test_routing_sees_coalesced_size(self, sched):
+        # each request is sub-floor; the backend must see the total
+        reqs = [_make_items(8, tag=bytes([i])) for i in range(4)]
+        _fanout(sched, reqs)
+        assert max(CountingVerifier.sizes) > 8
+
+    def test_poison_request_is_isolated(self, sched):
+        reqs = [
+            _make_items(16, tag=bytes([i]), poison_at=5 if i == 2 else None)
+            for i in range(4)
+        ]
+        results = _fanout(sched, reqs)
+        ok2, mask2 = results[2]
+        assert not ok2
+        assert mask2[5] is False or mask2[5] == False  # noqa: E712
+        assert sum(1 for b in mask2 if not b) == 1
+        for i in (0, 1, 3):
+            ok, mask = results[i]
+            assert ok and all(mask)
+
+    def test_verdicts_match_serial_with_poison(self, sched):
+        reqs = [
+            _make_items(16, tag=bytes([i]), poison_at=i if i % 2 else None)
+            for i in range(4)
+        ]
+        serial = [_serial_verdict(items) for items in reqs]
+        assert _fanout(sched, reqs) == serial
+
+
+class TestFlushTriggers:
+    def test_deadline_flush_bounds_sub_floor_latency(self, sched):
+        items = _make_items(3)
+        t0 = time.perf_counter()
+        ok, mask = sched.submit(items).result(timeout=60)
+        dt = time.perf_counter() - t0
+        assert ok and len(mask) == 3
+        assert dt <= 10 * sched.flush_us / 1e6, (
+            f"lone sub-floor request took {dt * 1e3:.1f}ms, "
+            f"bound {10 * sched.flush_us / 1e3:.1f}ms"
+        )
+
+    def test_lane_budget_triggers_size_flush(self, counting_backend):
+        s = VerifyScheduler(
+            spec=counting_backend, flush_us=10_000_000, lane_budget=32
+        )
+        s.start()
+        try:
+            # deadline is 10s out; only the lane budget can release this
+            fut = s.submit(_make_items(32))
+            ok, mask = fut.result(timeout=5)
+            assert ok and len(mask) == 32
+        finally:
+            s.stop()
+
+    def test_explicit_flush_releases_early(self, counting_backend):
+        s = VerifyScheduler(
+            spec=counting_backend, flush_us=10_000_000, lane_budget=4096
+        )
+        s.start()
+        try:
+            fut = s.submit(_make_items(4))
+            assert not fut.done()
+            s.flush()
+            ok, mask = fut.result(timeout=5)
+            assert ok and len(mask) == 4
+        finally:
+            s.stop()
+
+    def test_empty_submit_completes_immediately(self, sched):
+        fut = sched.submit([])
+        assert fut.done()
+        assert fut.result(timeout=0) == (True, [])
+
+
+class TestLifecycle:
+    def test_stop_drains_pending_futures(self, counting_backend):
+        # deadline far in the future: only the drain can release these
+        s = VerifyScheduler(
+            spec=counting_backend, flush_us=10_000_000, lane_budget=4096
+        )
+        s.start()
+        futs = [s.submit(_make_items(8, tag=bytes([i]))) for i in range(3)]
+        s.stop()
+        for fut in futs:
+            ok, mask = fut.result(timeout=5)
+            assert ok and len(mask) == 8
+
+    def test_submit_when_not_running_is_inline(self, counting_backend):
+        s = VerifyScheduler(spec=counting_backend)
+        fut = s.submit(_make_items(4))
+        assert fut.done()  # complete before return — no one to wake it
+        ok, mask = fut.result(timeout=0)
+        assert ok and len(mask) == 4
+        assert CountingVerifier.dispatches == 1
+
+    def test_stop_is_idempotent_and_submit_survives(self, sched):
+        sched.stop()
+        fut = sched.submit(_make_items(2))
+        assert fut.result(timeout=5)[0]
+
+
+class TestFallback:
+    def test_backend_death_mid_flight_falls_back_to_cpu(self):
+        class ExplodingVerifier(CPUBatchVerifier):
+            def verify(self):
+                raise RuntimeError("device plane died")
+
+        cryptobatch.register_backend("exploding", ExplodingVerifier)
+        s = VerifyScheduler(spec=BackendSpec("exploding"), flush_us=2000)
+        s.start()
+        try:
+            items = _make_items(8, poison_at=3)
+            ok, mask = s.submit(items).result(timeout=30)
+            # CPU ground truth still lands, bit-identical to serial
+            assert (ok, mask) == _serial_verdict(items)
+            assert s.metrics.cpu_fallbacks.value() == 1
+        finally:
+            s.stop()
+
+    def test_short_mask_from_backend_falls_back(self):
+        class TruncatingVerifier(CPUBatchVerifier):
+            def verify(self):
+                ok, mask = super().verify()
+                return ok, mask[:-1]
+
+        cryptobatch.register_backend("truncating", TruncatingVerifier)
+        s = VerifyScheduler(spec=BackendSpec("truncating"), flush_us=2000)
+        s.start()
+        try:
+            items = _make_items(4)
+            ok, mask = s.submit(items).result(timeout=30)
+            assert ok and len(mask) == 4
+        finally:
+            s.stop()
+
+
+class TestBackendPlumbing:
+    def test_new_batch_verifier_returns_adapter(self, sched):
+        bv = new_batch_verifier(sched)
+        assert isinstance(bv, ScheduledBatchVerifier)
+        for pk, m, s in _make_items(5):
+            bv.add(pk, m, s)
+        assert bv.count() == 5
+        ok, mask = bv.verify()
+        assert ok and len(mask) == 5
+        assert CountingVerifier.dispatches >= 1
+
+    def test_unwrap_backend_yields_spec(self, sched):
+        assert unwrap_backend(sched) is sched.spec
+        assert cryptobatch.backend_name(sched) == "counting"
+        spec = BackendSpec("cpu")
+        assert unwrap_backend(spec) is spec
+
+    def test_metrics_count_flush_reasons(self, sched):
+        sched.submit(_make_items(2)).result(timeout=30)
+        deadline = sched.metrics.flushes.with_labels(reason="deadline")
+        explicit = sched.metrics.flushes.with_labels(reason="explicit")
+        drain = sched.metrics.flushes.with_labels(reason="drain")
+        total = deadline.value() + explicit.value() + drain.value()
+        assert total >= 1
+        assert sched.metrics.requests.value() == 1
+        assert sched.metrics.signatures.value() == 2
+
+
+class TestBlocksyncPipelined:
+    """The blocksync rewire: window commits submitted as per-block
+    scheduler requests, block i applying while i+1.. verify, with a
+    bad commit only costing the suffix."""
+
+    def _build(self, n_blocks, counting_backend):
+        from cometbft_tpu.abci.client import LocalClient
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.blocksync import BlocksyncReactor
+        from cometbft_tpu.libs.db import MemDB
+        from cometbft_tpu.proto.gogo import Timestamp
+        from cometbft_tpu.proxy import AppConnConsensus
+        from cometbft_tpu.state import make_genesis_state
+        from cometbft_tpu.state.execution import BlockExecutor
+        from cometbft_tpu.state.store import Store
+        from cometbft_tpu.store import BlockStore
+        from cometbft_tpu.types import test_util
+        from cometbft_tpu.types.block import BlockID
+        from cometbft_tpu.types.block import Commit
+        from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+        chain_id = "sched-blocksync-chain"
+        vals, privs = test_util.deterministic_validator_set(4, 10)
+        doc = GenesisDoc(
+            genesis_time=Timestamp(1_700_000_000, 0),
+            chain_id=chain_id,
+            validators=[
+                GenesisValidator(v.address, v.pub_key, v.voting_power, "")
+                for v in vals.validators
+            ],
+        )
+        # build the source chain through the real executor
+        state = make_genesis_state(doc)
+        ss = Store(MemDB())
+        ss.save(state)
+        client = LocalClient(KVStoreApplication())
+        client.start()
+        executor = BlockExecutor(ss, AppConnConsensus(client))
+        blocks = []
+        last_commit = Commit(height=0, round=0)
+        for h in range(1, n_blocks + 1):
+            proposer = state.validators.validators[h % len(privs)].address
+            block, parts = executor.create_proposal_block(
+                h, state, last_commit, proposer
+            )
+            block_id = BlockID(block.hash(), parts.header())
+            seen = test_util.make_commit(
+                block_id, h, 0, state.validators, privs, chain_id,
+                now=Timestamp(1_700_000_000 + h, 0),
+            )
+            blocks.append(block)
+            state, _ = executor.apply_block(state, block_id, block)
+            last_commit = seen
+
+        # the fresh syncer, backed by the scheduler
+        fresh = make_genesis_state(doc)
+        fss = Store(MemDB())
+        fss.save(fresh)
+        fclient = LocalClient(KVStoreApplication())
+        fclient.start()
+        fexec = BlockExecutor(fss, AppConnConsensus(fclient))
+        sched = VerifyScheduler(
+            spec=counting_backend, flush_us=5000
+        )
+        sched.start()
+        reactor = BlocksyncReactor(
+            fresh, fexec, BlockStore(MemDB()), fast_sync=True,
+            crypto_backend=sched,
+        )
+
+        class _FakePool:
+            def __init__(self, blks):
+                self.blocks = list(blks)
+                self.height = 1
+
+            def peek_window(self, n):
+                return self.blocks[:n]
+
+            def peek_two_blocks(self):
+                first = self.blocks[0] if self.blocks else None
+                second = self.blocks[1] if len(self.blocks) > 1 else None
+                return first, second
+
+            def pop_request(self):
+                self.blocks.pop(0)
+                self.height += 1
+
+            def redo_request(self, h):
+                return None
+
+            def max_peer_height(self):
+                return 0
+
+        reactor.pool = _FakePool(blocks)
+        return chain_id, fresh, reactor, sched, (client, fclient)
+
+    def test_window_applies_through_scheduler(self, counting_backend):
+        chain_id, state, reactor, sched, clients = self._build(
+            6, counting_backend
+        )
+        try:
+            new_state = reactor._try_sync_window(chain_id, state)
+            # window of 6 blocks: the last one has no child commit yet,
+            # so 5 apply — all through ONE coalesced dispatch
+            assert new_state.last_block_height == 5
+            assert sched.n_dispatches == 1
+            assert reactor.blocks_synced == 5
+        finally:
+            sched.stop()
+            for c in clients:
+                c.stop()
+
+    def test_bad_verdict_keeps_verified_prefix(self, counting_backend):
+        chain_id, state, reactor, sched, clients = self._build(
+            6, counting_backend
+        )
+
+        # corrupt the THIRD block's request at the submit boundary (the
+        # commit embedded in the block can't be touched — the carrier
+        # block's hash would change and the shape check would bail the
+        # whole window, which is the pre-existing path): the pipelined
+        # apply must keep the verified prefix and re-attribute from the
+        # failure point via the single-block path
+        class _PoisoningScheduler:
+            def __init__(self, inner):
+                self.inner = inner
+                self.n = 0
+
+            @property
+            def spec(self):
+                return self.inner.spec
+
+            def submit(self, items):
+                self.n += 1
+                if self.n == 3:
+                    items = [(pk, m, b"\x00" * 64) for pk, m, _ in items]
+                return self.inner.submit(items)
+
+        reactor.crypto_backend = _PoisoningScheduler(sched)
+        try:
+            new_state = reactor._try_sync_window(chain_id, state)
+            # blocks 1-2 applied off their futures; height 3's bad
+            # verdict stops the pipeline WITHOUT discarding them, and
+            # the single-block fallback re-verifies the real commit
+            # (which is valid — the poison was injected at submit) and
+            # applies height 3 too
+            assert new_state.last_block_height == 3
+            assert reactor.blocks_synced == 3
+        finally:
+            sched.stop()
+            for c in clients:
+                c.stop()
+
+
+class TestKnobs:
+    def test_flush_us_precedence(self, monkeypatch):
+        monkeypatch.delenv("CBFT_VERIFY_FLUSH_US", raising=False)
+        assert flush_us_default() == DEFAULT_FLUSH_US
+        assert flush_us_default(1234) == 1234
+        monkeypatch.setenv("CBFT_VERIFY_FLUSH_US", "777")
+        assert flush_us_default(1234) == 777
+
+    def test_scheduler_reads_config_flush(self):
+        s = VerifyScheduler(spec="cpu", flush_us=2500)
+        assert s.flush_us == 2500
+        assert s.spec.name == "cpu"
