@@ -535,6 +535,72 @@ def _sharded_mega_commit():
     }
 
 
+def _stage_supervisor():
+    """Degraded-mode throughput + breaker recovery latency. A supervised
+    FaultyBackend is driven healthy → broken (injected dispatch
+    failures) → repaired: the stage reports verify throughput in each
+    breaker state (broken mode = the zero-added-latency CPU route) and
+    the wall-clock from fault clearance to breaker re-close (canary
+    probe re-admission)."""
+    _maybe_force_cpu()
+    _set_cache()
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.faults import FaultPlan, install
+    from cometbft_tpu.crypto.supervisor import BROKEN, HEALTHY, BackendSupervisor
+
+    plan = install(name="bench-faulty", inner="cpu", plan=FaultPlan())
+    sup = BackendSupervisor(
+        spec=BackendSpec("bench-faulty"),
+        dispatch_timeout_ms=2000,
+        breaker_threshold=1,
+        audit_pct=0,
+        probe_base_ms=25,
+        probe_max_ms=200,
+    )
+    n = 1024
+    pks, msgs, sigs = _make_batch(n)
+    items = [
+        (ed.PubKeyEd25519(pk), m, s) for pk, m, s in zip(pks, msgs, sigs)
+    ]
+
+    def rate() -> float:
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            mask = sup.verify_items(items)
+            best = min(best, time.perf_counter() - t0)
+            assert all(mask)
+        return round(n / best, 1)
+
+    out = {"healthy_sigs_per_sec": rate()}
+    assert sup.state() == HEALTHY
+    print(json.dumps(out), flush=True)
+
+    # one failing dispatch trips the threshold-1 breaker
+    plan.exception_rate = 1.0
+    sup.verify_items(items)
+    assert sup.state() == BROKEN, sup.state()
+    out["broken_sigs_per_sec"] = rate()  # the straight-to-CPU route
+    print(json.dumps(out), flush=True)
+
+    # recovery latency: faults cleared → canary probes re-admit
+    plan.clear()
+    t0 = time.perf_counter()
+    deadline = t0 + 60.0
+    while sup.state() != HEALTHY and time.perf_counter() < deadline:
+        sup.verify_items(items[:1])  # traffic kicks the lazy async probe
+        time.sleep(0.005)
+    recovered = sup.state() == HEALTHY
+    out["breaker_recovery_ms"] = (
+        round((time.perf_counter() - t0) * 1e3, 1) if recovered
+        else "not recovered within 60s"
+    )
+    out["final_state"] = sup.state()
+    sup.stop()
+    print(json.dumps(out), flush=True)
+
+
 def _set_cache():
     import jax
 
@@ -672,6 +738,11 @@ def main():
     parsed, diag = _run_stage("p50", _STAGE_ENV_CPU, 600)
     stages["cpu_p50"] = parsed if parsed is not None else diag
 
+    # supervisor degraded-mode + recovery-latency numbers (CPU-inner
+    # faulty backend — platform-neutral, so it always runs)
+    parsed, diag = _run_stage("supervisor", _STAGE_ENV_CPU, 300)
+    stages["supervisor"] = parsed if parsed is not None else diag
+
     last_onchip = None
     if result is None:
         # TPU unavailable — same kernel on the host CPU platform so the
@@ -732,6 +803,7 @@ if __name__ == "__main__":
             "variants": _stage_variants,
             "breakdown": _stage_breakdown,
             "scheduler": _stage_scheduler,
+            "supervisor": _stage_supervisor,
         }[sys.argv[2]]()
     else:
         main()

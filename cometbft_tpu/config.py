@@ -245,6 +245,27 @@ class CryptoConfig:
     # a partial dispatch. Bounds the extra latency a lone request pays;
     # an explicitly-set CBFT_VERIFY_FLUSH_US env var wins.
     flush_us: int = 500
+    # --- BackendSupervisor knobs (crypto/supervisor.py) ---
+    # Watchdog budget (ms) per device dispatch: past it the dispatch is
+    # abandoned to a zombie thread, the batch re-verifies on CPU, and
+    # the incident counts against the breaker. CBFT_DISPATCH_TIMEOUT_MS
+    # env wins. Generous default — a cold jit compile of a new bucket
+    # can take tens of seconds on a slow link.
+    dispatch_timeout_ms: int = 60000
+    # Consecutive dispatch failures that open the circuit breaker
+    # (HEALTHY → BROKEN; watchdog trips and audit mismatches open it
+    # immediately regardless). CBFT_BREAKER_THRESHOLD env wins.
+    breaker_threshold: int = 3
+    # Percentage of healthy device batches re-verified on CPU in the
+    # background to catch silent verdict corruption (a miscompiled
+    # kernel that accepts bad signatures without raising). 0 disables;
+    # 100 audits every batch. CBFT_AUDIT_PCT env wins.
+    audit_pct: int = 5
+    # Pending-signature bound on the scheduler's submission queue:
+    # past it submit() blocks (bounded by CBFT_SUBMIT_TIMEOUT_MS)
+    # instead of growing without limit while the device plane stalls.
+    # CBFT_MAX_QUEUE env wins.
+    max_queue: int = 65536
 
 
 @dataclass
@@ -281,12 +302,20 @@ class Config:
         # min_batch/max_chunk are load-bearing (they drive the batch
         # plane's routing and chunking): reject malformed TOML at
         # startup, not at the first commit
-        for knob in ("min_batch", "max_chunk", "flush_us"):
+        for knob in (
+            "min_batch", "max_chunk", "flush_us",
+            "dispatch_timeout_ms", "breaker_threshold", "max_queue",
+        ):
             v = getattr(self.crypto, knob)
             if not isinstance(v, int) or isinstance(v, bool) or v < 1:
                 raise ValueError(
                     f"crypto.{knob} must be a positive integer, got {v!r}"
                 )
+        ap = self.crypto.audit_pct
+        if not isinstance(ap, int) or isinstance(ap, bool) or not 0 <= ap <= 100:
+            raise ValueError(
+                f"crypto.audit_pct must be an integer in [0, 100], got {ap!r}"
+            )
 
 
 def default_config() -> Config:

@@ -39,16 +39,21 @@ class BackendSpec:
 
 
 # what every verify path accepts where a backend used to be a str: a
-# bare name, a BackendSpec, or the node's VerifyScheduler (duck-typed:
+# bare name, a BackendSpec, the node's VerifyScheduler (duck-typed:
 # anything exposing .submit + .spec — crypto/scheduler.py), which
-# coalesces concurrent callers into one dispatch
+# coalesces concurrent callers into one dispatch, or a
+# BackendSupervisor (.verify_items + .spec — crypto/supervisor.py),
+# which adds the watchdog / circuit breaker / corruption audit
 Backend = Union[str, BackendSpec, None, object]
 
 
 def unwrap_backend(backend: Backend) -> Union[str, BackendSpec, None]:
-    """A scheduler travels the same opaque parameter a backend name
-    does; every eligibility/floor check resolves against its spec."""
+    """A scheduler or supervisor travels the same opaque parameter a
+    backend name does; every eligibility/floor check resolves against
+    its spec."""
     if hasattr(backend, "submit") and hasattr(backend, "spec"):
+        return backend.spec
+    if hasattr(backend, "verify_items") and hasattr(backend, "spec"):
         return backend.spec
     return backend
 
@@ -444,6 +449,12 @@ class ScheduledBatchVerifier(BatchVerifier):
 def new_batch_verifier(backend: Backend = None) -> BatchVerifier:
     if hasattr(backend, "submit") and hasattr(backend, "spec"):
         return ScheduledBatchVerifier(backend)
+    if hasattr(backend, "verify_items") and hasattr(backend, "spec"):
+        # a bare BackendSupervisor (no scheduler in front): dispatches
+        # still get the watchdog / breaker / audit treatment
+        from cometbft_tpu.crypto.supervisor import SupervisedBatchVerifier
+
+        return SupervisedBatchVerifier(backend)
     with _mtx:
         name = backend_name(backend)
         factory = _registry.get(name)

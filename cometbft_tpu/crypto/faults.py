@@ -1,0 +1,343 @@
+"""Fault injection for the verification device plane.
+
+``FaultyBackend`` wraps any BatchVerifier and injects the failure modes
+a real TPU sidecar exhibits (all observed or hypothesized in rounds 3-5:
+wedged tunnels, flapping runtimes, miscompiled kernels):
+
+* ``exception_rate``  — probability a dispatch raises FaultInjected;
+* ``hang_rate`` / ``hang_s`` — probability a dispatch wedges (sleeps
+  ``hang_s``; wakes early if the supervisor's watchdog abandons it via
+  mesh.cancel_scope — the zombie-thread path);
+* ``corrupt_rate``    — probability a dispatch returns silently WRONG
+  verdicts (every mask entry flipped, no exception raised) — the
+  silent-corruption class only the CPU audit can catch;
+* ``die_after``       — dispatches after the Nth all raise (a backend
+  that dies and stays dead until "repaired" by ``plan.clear()``);
+* ``jitter_ms``       — uniform random extra latency per dispatch.
+
+State (dispatch counter, RNG) lives in the shared ``FaultPlan``, not the
+verifier instance — new_batch_verifier constructs a fresh verifier per
+dispatch, so per-instance state would reset every batch. Mutating a plan
+(e.g. ``plan.clear()``) takes effect on the next dispatch, which is how
+tests and the chaos soak model repair/recovery.
+
+``run_chaos_soak`` drives a supervised scheduler through a random fault
+schedule over N simulated blocks and asserts the node-path invariants:
+no future is ever lost, no wrong verdict is ever released (sync audit
+mode), and the breaker re-admits the backend once faults stop. The
+`slow`-marked soak test and the standalone ``tools/chaos.py`` entry
+point both call it.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from cometbft_tpu.crypto import PubKey
+from cometbft_tpu.crypto import batch as cryptobatch
+from cometbft_tpu.crypto.batch import BatchVerifier
+
+
+class FaultInjected(RuntimeError):
+    """An injected dispatch failure (distinguishable from real bugs)."""
+
+
+class FaultPlan:
+    """Shared, mutable schedule of injected faults. Thread-safe; one
+    plan drives every FaultyBackend instance registered against it."""
+
+    def __init__(
+        self,
+        exception_rate: float = 0.0,
+        hang_rate: float = 0.0,
+        hang_s: float = 3600.0,
+        corrupt_rate: float = 0.0,
+        die_after: Optional[int] = None,
+        jitter_ms: float = 0.0,
+        seed: int = 0,
+    ):
+        self.exception_rate = exception_rate
+        self.hang_rate = hang_rate
+        self.hang_s = hang_s
+        self.corrupt_rate = corrupt_rate
+        self.die_after = die_after
+        self.jitter_ms = jitter_ms
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.dispatches = 0  # total dispatches seen (incl. faulted ones)
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        """Env-driven plan so the chaos soak (and a faulty node) can be
+        configured without code: CBFT_FAULT_EXC_RATE, CBFT_FAULT_HANG_RATE,
+        CBFT_FAULT_HANG_S, CBFT_FAULT_CORRUPT_RATE, CBFT_FAULT_DIE_AFTER,
+        CBFT_FAULT_JITTER_MS, CBFT_FAULT_SEED."""
+        e = os.environ
+        die = e.get("CBFT_FAULT_DIE_AFTER")
+        return cls(
+            exception_rate=float(e.get("CBFT_FAULT_EXC_RATE", "0")),
+            hang_rate=float(e.get("CBFT_FAULT_HANG_RATE", "0")),
+            hang_s=float(e.get("CBFT_FAULT_HANG_S", "3600")),
+            corrupt_rate=float(e.get("CBFT_FAULT_CORRUPT_RATE", "0")),
+            die_after=int(die) if die is not None else None,
+            jitter_ms=float(e.get("CBFT_FAULT_JITTER_MS", "0")),
+            seed=int(e.get("CBFT_FAULT_SEED", "0")),
+        )
+
+    def clear(self) -> None:
+        """Repair the backend: stop injecting everything (in place, so
+        already-registered factories see it on their next dispatch)."""
+        self.exception_rate = 0.0
+        self.hang_rate = 0.0
+        self.corrupt_rate = 0.0
+        self.die_after = None
+        self.jitter_ms = 0.0
+
+    def _decide(self) -> Tuple[int, bool, bool, bool, float]:
+        """→ (dispatch_no, raise?, hang?, corrupt?, jitter_s) for one
+        dispatch, under the lock so concurrent dispatches draw distinct
+        RNG samples and the counter is exact."""
+        with self._lock:
+            self.dispatches += 1
+            no = self.dispatches
+            dead = self.die_after is not None and no > self.die_after
+            raise_ = dead or self._rng.random() < self.exception_rate
+            hang = self._rng.random() < self.hang_rate
+            corrupt = self._rng.random() < self.corrupt_rate
+            jitter_s = (
+                self._rng.random() * self.jitter_ms / 1e3
+                if self.jitter_ms > 0 else 0.0
+            )
+        return no, raise_, hang, corrupt, jitter_s
+
+
+class FaultyBackend(BatchVerifier):
+    """BatchVerifier wrapper applying a FaultPlan to every verify()."""
+
+    def __init__(self, plan: FaultPlan, inner: BatchVerifier):
+        self._plan = plan
+        self._inner = inner
+        self._n = 0
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        self._inner.add(pub_key, msg, sig)
+        self._n += 1
+
+    def count(self) -> int:
+        return self._n
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        n, self._n = self._n, 0
+        no, raise_, hang, corrupt, jitter_s = self._plan._decide()
+        if jitter_s:
+            time.sleep(jitter_s)
+        if hang:
+            _interruptible_hang(self._plan.hang_s)
+        if raise_:
+            self._inner.verify()  # drop the held items like a real death
+            raise FaultInjected(
+                f"injected dispatch failure (dispatch #{no}, {n} items)"
+            )
+        ok, mask = self._inner.verify()
+        if corrupt:
+            mask = [not b for b in mask]  # silent wrong verdicts, no raise
+            ok = all(mask)
+        return ok, mask
+
+
+def _interruptible_hang(seconds: float) -> None:
+    """Simulate a wedged dispatch. If a supervisor watchdog has
+    abandoned this thread (mesh.cancel_scope), wake early and die the
+    way a cancelled chunk loop does — so tests don't strand sleeping
+    threads for an hour."""
+    from cometbft_tpu.crypto.tpu import mesh
+
+    ev = mesh.current_cancel_event()
+    if ev is None:
+        time.sleep(seconds)
+        return
+    if ev.wait(seconds):
+        raise mesh.DispatchCancelled("injected hang abandoned by watchdog")
+
+
+def install(
+    name: str = "faulty",
+    inner: cryptobatch.Backend = "cpu",
+    plan: Optional[FaultPlan] = None,
+) -> FaultPlan:
+    """Register a FaultyBackend factory under ``name`` wrapping the
+    ``inner`` backend; returns the (shared, live-mutable) plan."""
+    plan = plan if plan is not None else FaultPlan.from_env()
+    cryptobatch.register_backend(
+        name,
+        lambda: FaultyBackend(plan, cryptobatch.new_batch_verifier(inner)),
+    )
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# chaos soak: random fault schedule over simulated blocks
+# ---------------------------------------------------------------------------
+
+
+def run_chaos_soak(
+    n_blocks: int = 50,
+    batch: int = 48,
+    seed: int = 1234,
+    inner: cryptobatch.Backend = "cpu",
+    dispatch_timeout_ms: int = 500,
+    probe_base_ms: int = 20,
+    n_submitters: int = 3,
+    logger=None,
+) -> dict:
+    """Drive a supervised VerifyScheduler through ``n_blocks`` simulated
+    blocks under a randomized fault schedule (regime re-rolled every few
+    blocks among: none / exceptions / hangs / corruption / dead), with
+    ``n_submitters`` concurrent threads submitting per block, then clear
+    the faults and wait for breaker re-admission.
+
+    Invariants checked here (the caller asserts on the summary):
+      * every future completes — ``lost_futures`` == 0;
+      * every released verdict equals the CPU ground truth —
+        ``wrong_verdicts`` == 0 (sync-audit mode re-checks every device
+        batch before release, so corruption cannot escape);
+      * after faults stop, the breaker re-admits the backend —
+        ``readmitted`` is True and the device saw post-recovery traffic.
+    """
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec, CPUBatchVerifier
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+    from cometbft_tpu.crypto.supervisor import HEALTHY, BackendSupervisor
+
+    rng = random.Random(seed)
+    name = f"chaos-{seed}-{n_blocks}"
+    plan = install(name=name, inner=inner, plan=FaultPlan(seed=seed))
+    sup = BackendSupervisor(
+        spec=BackendSpec(name),
+        dispatch_timeout_ms=dispatch_timeout_ms,
+        breaker_threshold=2,
+        audit_pct=100,
+        audit_sync=True,  # the no-wrong-verdict-ever mode (see supervisor.py)
+        probe_base_ms=probe_base_ms,
+        probe_max_ms=probe_base_ms * 8,
+        logger=logger,
+    )
+    sched = VerifyScheduler(
+        spec=BackendSpec(name), flush_us=1000, supervisor=sup, logger=logger
+    )
+    sched.start()
+
+    keys = [
+        ed.gen_priv_key_from_secret(b"chaos-%d" % i) for i in range(32)
+    ]
+    regimes = ("none", "exceptions", "hangs", "corruption", "dead", "jitter")
+    wrong = lost = 0
+    regime_counts = {r: 0 for r in regimes}
+
+    def make_block(h: int):
+        items, truth = [], []
+        for i in range(batch):
+            k = keys[(h + i) % len(keys)]
+            msg = b"chaos block %d sig %d" % (h, i)
+            good = rng.random() > 0.1  # ~10% genuinely bad signatures
+            sig = k.sign(msg) if good else b"\x11" * 64
+            items.append((k.pub_key(), msg, sig))
+            truth.append(good)
+        return items, truth
+
+    def apply_regime(r: str) -> None:
+        plan.clear()
+        if r == "exceptions":
+            plan.exception_rate = 0.7
+        elif r == "hangs":
+            plan.hang_rate = 1.0
+            plan.hang_s = 30.0
+        elif r == "corruption":
+            plan.corrupt_rate = 1.0
+        elif r == "dead":
+            plan.die_after = 0
+        elif r == "jitter":
+            plan.jitter_ms = 5.0
+
+    try:
+        for h in range(n_blocks):
+            if h % 4 == 0:
+                regime = rng.choice(regimes)
+                apply_regime(regime)
+            regime_counts[regime] += 1
+            items, truth = make_block(h)
+            # split the block across concurrent submitters, like the
+            # node's subsystems racing into one coalesced dispatch
+            per = max(1, len(items) // n_submitters)
+            slices = [
+                (items[i : i + per], truth[i : i + per])
+                for i in range(0, len(items), per)
+            ]
+            futs = [(sched.submit(s), t) for s, t in slices]
+            sched.flush()
+            for fut, t in futs:
+                try:
+                    _, mask = fut.result(
+                        timeout=dispatch_timeout_ms / 1e3 + 30
+                    )
+                except Exception:  # noqa: BLE001 - a lost/failed future
+                    lost += 1
+                    continue
+                if mask != t:
+                    wrong += 1
+
+        # recovery: faults off, breaker must re-admit via canary probes
+        plan.clear()
+        deadline = time.monotonic() + 30.0
+        readmitted = False
+        while time.monotonic() < deadline:
+            if sup.state() == HEALTHY:
+                readmitted = True
+                break
+            # traffic while broken is what triggers the lazy probe kick
+            ok, _ = sched.submit(
+                [(keys[0].pub_key(), b"recovery ping", keys[0].sign(b"recovery ping"))]
+            ).result(timeout=30)
+            assert ok
+            time.sleep(probe_base_ms / 1e3)
+        before = plan.dispatches
+        post_items, post_truth = make_block(n_blocks + 1)
+        _, post_mask = sched.submit(post_items).result(timeout=60)
+        if post_mask != post_truth:
+            wrong += 1
+        device_resumed = plan.dispatches > before
+    finally:
+        sched.stop()
+        sup.stop()
+
+    # sanity: the ground-truth oracle itself agrees with serial verify
+    bv = CPUBatchVerifier()
+    for pk, m, s in post_items:
+        bv.add(pk, m, s)
+    _, oracle = bv.verify()
+    assert oracle == post_truth
+
+    def total(counter) -> float:
+        # labeled counters accumulate in with_labels() children; the
+        # parent's own value stays 0 — sum the whole series
+        return sum(c.value() for c in counter._series())
+
+    return {
+        "blocks": n_blocks,
+        "batch": batch,
+        "regimes": regime_counts,
+        "wrong_verdicts": wrong,
+        "lost_futures": lost,
+        "trips": total(sup.metrics.trips),
+        "watchdog_kills": sup.metrics.watchdog_kills.value(),
+        "audit_mismatches": sup.metrics.audit_mismatches.value(),
+        "probes": total(sup.metrics.probes),
+        "backend_dispatches": plan.dispatches,
+        "readmitted": readmitted,
+        "device_resumed_after_recovery": device_resumed,
+        "final_state": sup.state(),
+    }

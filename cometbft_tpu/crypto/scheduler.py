@@ -38,8 +38,21 @@ keeps working standalone for tests and embedders.
 If the device plane dies mid-flight (a dispatch raises), the affected
 flush falls back to the CPU ground-truth verifier so no future is left
 hanging and verdicts stay bit-identical to serial verification; the
-fallback is counted. ``stop()`` drains: queued requests are dispatched
-(not abandoned) before the worker exits.
+fallback is counted and logged with the batch size and flush reason.
+When the node threads a BackendSupervisor (crypto/supervisor.py), every
+dispatch instead runs through it — watchdog, circuit breaker, and
+corruption audit included — and an open breaker short-circuits the
+deadline wait (there is nothing to coalesce FOR when every dispatch is
+CPU-routed anyway, so pending requests flush immediately).
+
+``submit()`` is bounded: past ``[crypto] max_queue`` pending signatures
+(env ``CBFT_MAX_QUEUE``) it blocks with a deadline instead of growing
+without limit while the device plane stalls; a submitter that exhausts
+the deadline gets its items verified inline on the CPU ground truth, so
+memory stays bounded and no future is ever lost. ``stop()`` drains:
+queued requests are dispatched (not abandoned) before the worker exits —
+and if the worker cannot be joined (wedged inside a dispatch), the
+pending futures are FAILED loudly rather than leaving callers blocked.
 """
 
 from __future__ import annotations
@@ -61,6 +74,8 @@ from cometbft_tpu.libs.metrics import Registry
 from cometbft_tpu.libs.service import BaseService
 
 DEFAULT_FLUSH_US = 500
+DEFAULT_MAX_QUEUE = 65_536
+DEFAULT_SUBMIT_TIMEOUT_MS = 5_000
 SUBSYSTEM = "verify_scheduler"
 
 Item = Tuple[PubKey, bytes, bytes]
@@ -76,6 +91,17 @@ def flush_us_default(config_flush_us: Optional[int] = None) -> int:
     if config_flush_us is not None:
         return config_flush_us
     return DEFAULT_FLUSH_US
+
+
+def max_queue_default(config_max_queue: Optional[int] = None) -> int:
+    """Pending-signature bound on the submission queue, same precedence
+    shape: CBFT_MAX_QUEUE env > [crypto] max_queue > built-in 65536."""
+    raw = os.environ.get("CBFT_MAX_QUEUE")
+    if raw is not None:
+        return int(raw)
+    if config_max_queue is not None:
+        return config_max_queue
+    return DEFAULT_MAX_QUEUE
 
 
 class Metrics:
@@ -119,6 +145,16 @@ class Metrics:
             "Dispatches that fell back to the CPU ground-truth verifier "
             "after the configured backend raised mid-flight.",
         )
+        self.backpressure_waits = r.counter(
+            SUBSYSTEM, "backpressure_waits",
+            "submit() calls that blocked because the pending queue was "
+            "at [crypto] max_queue signatures.",
+        )
+        self.backpressure_timeouts = r.counter(
+            SUBSYSTEM, "backpressure_timeouts",
+            "Backpressured submit() calls that exhausted their deadline "
+            "and verified inline on CPU instead of enqueueing.",
+        )
 
     @classmethod
     def nop(cls) -> "Metrics":
@@ -133,6 +169,7 @@ class VerifyFuture:
 
     def __init__(self):
         self._ev = threading.Event()
+        self._mtx = threading.Lock()
         self._result: Optional[Tuple[bool, List[bool]]] = None
         self._exc: Optional[BaseException] = None
 
@@ -149,14 +186,23 @@ class VerifyFuture:
         return self._result
 
     # -- completion (scheduler-side) ---------------------------------------
+    # First completion wins: stop() may fail a future whose wedged worker
+    # later limps home — the zombie's late verdict must not overwrite
+    # what the caller already observed.
 
     def _set(self, result: Tuple[bool, List[bool]]) -> None:
-        self._result = result
-        self._ev.set()
+        with self._mtx:
+            if self._ev.is_set():
+                return
+            self._result = result
+            self._ev.set()
 
     def _set_exception(self, exc: BaseException) -> None:
-        self._exc = exc
-        self._ev.set()
+        with self._mtx:
+            if self._ev.is_set():
+                return
+            self._exc = exc
+            self._ev.set()
 
 
 class _Request:
@@ -192,6 +238,9 @@ class VerifyScheduler(BaseService):
         lane_budget: Optional[int] = None,
         metrics: Optional[Metrics] = None,
         logger: Optional[Logger] = None,
+        supervisor=None,
+        max_queue: Optional[int] = None,
+        join_timeout_s: float = 30.0,
     ):
         super().__init__("VerifyScheduler", logger)
         if isinstance(spec, BackendSpec):
@@ -208,9 +257,21 @@ class VerifyScheduler(BaseService):
             lane_budget = int(raw) if raw else 8192
         self._lane_budget = max(1, int(lane_budget))
         self.metrics = metrics if metrics is not None else Metrics.nop()
+        # the BackendSupervisor (crypto/supervisor.py) when the node
+        # wires one: every dispatch then runs under its watchdog/breaker/
+        # audit instead of the bare one-shot CPU fallback below
+        self._supervisor = supervisor
+        self._max_queue = max(1, max_queue_default(max_queue))
+        self._submit_timeout_s = int(
+            os.environ.get(
+                "CBFT_SUBMIT_TIMEOUT_MS", str(DEFAULT_SUBMIT_TIMEOUT_MS)
+            )
+        ) / 1e3
+        self._join_timeout_s = join_timeout_s
 
         self._cond = threading.Condition()
         self._requests: List[_Request] = []
+        self._inflight: List[_Request] = []
         self._pending_lanes = 0
         self._flush_asked = False
         self._draining = False
@@ -228,6 +289,14 @@ class VerifyScheduler(BaseService):
     def lane_budget(self) -> int:
         return self._lane_budget
 
+    @property
+    def max_queue(self) -> int:
+        return self._max_queue
+
+    @property
+    def supervisor(self):
+        return self._supervisor
+
     # -- lifecycle -----------------------------------------------------------
 
     def on_start(self) -> None:
@@ -241,13 +310,36 @@ class VerifyScheduler(BaseService):
             self._draining = True
             self._cond.notify_all()
         w = self._worker
+        joined = True
         if w is not None and w is not threading.current_thread():
-            w.join(timeout=30.0)
-        # belt and braces: if the worker died or never ran, complete
-        # whatever is still queued inline so no future is left hanging
+            w.join(timeout=self._join_timeout_s)
+            joined = not w.is_alive()
         with self._cond:
             leftovers, self._requests = self._requests, []
+            inflight = list(self._inflight)
             self._pending_lanes = 0
+            self._cond.notify_all()  # release backpressured submitters
+        if not joined:
+            # the worker is wedged inside a dispatch (a hung device plane
+            # with no supervisor watchdog): an inline dispatch here could
+            # wedge the stopping thread the same way — fail every pending
+            # future loudly instead of leaving callers blocked forever.
+            # (VerifyFuture completion is first-wins, so a zombie worker
+            # that later limps home cannot overwrite the error.)
+            self.logger.error(
+                "verify worker failed to join; failing pending futures",
+                join_timeout_s=self._join_timeout_s,
+                pending=len(leftovers) + len(inflight),
+            )
+            exc = RuntimeError(
+                "verify scheduler stopped while its worker was wedged in "
+                "a dispatch; request abandoned"
+            )
+            for req in inflight + leftovers:
+                req.future._set_exception(exc)
+            return
+        # worker exited cleanly: complete whatever is still queued inline
+        # so no future is left hanging
         if leftovers:
             self._dispatch(leftovers, "drain")
 
@@ -255,7 +347,9 @@ class VerifyScheduler(BaseService):
 
     def submit(self, items: Sequence[Item]) -> VerifyFuture:
         """Queue ``items`` (``(pub_key, msg, sig)`` triples) for the next
-        coalesced dispatch. Thread-safe; never blocks on the device."""
+        coalesced dispatch. Thread-safe; never blocks on the device, but
+        MAY block (bounded by CBFT_SUBMIT_TIMEOUT_MS) for queue room when
+        [crypto] max_queue pending signatures are already waiting."""
         req = _Request([(pk, bytes(m), bytes(s)) for pk, m, s in items])
         self.metrics.requests.add()
         self.metrics.signatures.add(len(req.items))
@@ -267,12 +361,43 @@ class VerifyScheduler(BaseService):
             # the contract (future complete on return, exact verdicts)
             self._dispatch([req], "explicit")
             return req.future
+        timed_out = False
         with self._cond:
-            self._requests.append(req)
-            self._pending_lanes += len(req.items)
-            self.metrics.queue_depth.set(len(self._requests))
-            self.metrics.pending_lanes.set(self._pending_lanes)
-            self._cond.notify_all()
+            # Backpressure: a stalled device plane must surface as
+            # bounded blocking here, not unbounded queue growth. An
+            # empty queue always admits (one oversize request may exceed
+            # the bound on its own — it still has to verify somewhere).
+            if self._pending_lanes >= self._max_queue and self._requests:
+                self.metrics.backpressure_waits.add()
+                deadline = time.monotonic() + self._submit_timeout_s
+                while (
+                    self._pending_lanes >= self._max_queue
+                    and self._requests
+                    and not self._draining
+                ):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        timed_out = True
+                        break
+                    self._cond.wait(left)
+            if not timed_out:
+                self._requests.append(req)
+                self._pending_lanes += len(req.items)
+                self.metrics.queue_depth.set(len(self._requests))
+                self.metrics.pending_lanes.set(self._pending_lanes)
+                self._cond.notify_all()
+        if timed_out:
+            # the queue never drained within the deadline: verify inline
+            # on the CPU ground truth so the caller still gets exact
+            # verdicts, memory stays bounded, and no future is lost
+            self.metrics.backpressure_timeouts.add()
+            self.logger.error(
+                "verify queue full past deadline; verifying inline on CPU",
+                n=len(req.items), max_queue=self._max_queue,
+                timeout_s=self._submit_timeout_s,
+            )
+            mask = self._cpu_ground_truth(req.items)
+            req.future._set((all(mask), mask))
         return req.future
 
     def flush(self) -> None:
@@ -302,6 +427,16 @@ class VerifyScheduler(BaseService):
                         if self._requests:
                             reason = "explicit"
                             break
+                    if (
+                        self._requests
+                        and self._supervisor is not None
+                        and self._supervisor.state() == "broken"
+                    ):
+                        # open breaker: every dispatch is CPU-routed, so
+                        # there is nothing to coalesce FOR — waiting out
+                        # flush_us only adds latency
+                        reason = "broken"
+                        break
                     if self._requests:
                         wake = self._requests[0].t_submit + self._flush_s
                         left = wake - time.monotonic()
@@ -312,12 +447,19 @@ class VerifyScheduler(BaseService):
                     else:
                         self._cond.wait(0.1)
                 batch, self._requests = self._requests, []
+                self._inflight = batch
                 self._pending_lanes = 0
                 self.metrics.queue_depth.set(0)
                 self.metrics.pending_lanes.set(0)
                 draining = self._draining
+                # queue room just opened: wake backpressured submitters
+                self._cond.notify_all()
             if batch:
-                self._dispatch(batch, reason)
+                try:
+                    self._dispatch(batch, reason)
+                finally:
+                    with self._cond:
+                        self._inflight = []
             if draining and not batch:
                 return
             if draining:
@@ -337,14 +479,19 @@ class VerifyScheduler(BaseService):
         self.metrics.lane_fill_ratio.observe(
             min(1.0, len(items) / self._lane_budget)
         )
-        mask = self._verify(items)
+        mask = self._verify(items, reason)
         pos = 0
         for req in batch:
             sub = mask[pos : pos + len(req.items)]
             pos += len(req.items)
             req.future._set((all(sub), sub))
 
-    def _verify(self, items: List[Item]) -> List[bool]:
+    def _verify(self, items: List[Item], reason: str) -> List[bool]:
+        if self._supervisor is not None:
+            # supervised path: watchdog, circuit breaker, and corruption
+            # audit live in crypto/supervisor.py — it never raises for a
+            # device failure (CPU re-verify is built in)
+            return self._supervisor.verify_items(items, reason=reason)
         try:
             bv = new_batch_verifier(self.spec)
             for pk, m, s in items:
@@ -360,10 +507,15 @@ class VerifyScheduler(BaseService):
             self.metrics.cpu_fallbacks.add()
             self.logger.error(
                 "verify dispatch failed; falling back to CPU",
-                err=str(exc), n=len(items),
+                err=repr(exc), n=len(items), reason=reason,
+                backend=self.spec.name,
             )
-            bv = CPUBatchVerifier()
-            for pk, m, s in items:
-                bv.add(pk, m, s)
-            _, mask = bv.verify()
-            return mask
+            return self._cpu_ground_truth(items)
+
+    @staticmethod
+    def _cpu_ground_truth(items: Sequence[Item]) -> List[bool]:
+        bv = CPUBatchVerifier()
+        for pk, m, s in items:
+            bv.add(pk, m, s)
+        _, mask = bv.verify()
+        return mask

@@ -1,0 +1,549 @@
+"""BackendSupervisor — the fail-safe / fail-fast / self-healing wrapper
+around the device verification plane.
+
+Routing consensus-critical signature verification through a TPU sidecar
+(the whole point of this framework) turns a wedged, dying, or
+silently-wrong device plane into a consensus-liveness and -safety
+hazard — exactly the failure class the committee-based-consensus
+verification literature flags when verification moves off the CPU hot
+path (arXiv:2302.00418, arXiv:2112.02229). Before this module, the only
+protection was a one-shot try/except CPU fallback in crypto/scheduler.py:
+a hung dispatch blocked the flush worker forever, a flapping backend
+re-failed every batch, and a kernel returning wrong verdicts without
+raising was never detected.
+
+The supervisor wraps ANY crypto Backend (crypto/batch.py) and adds:
+
+* **dispatch watchdog** — every device dispatch runs in a worker thread
+  under `[crypto] dispatch_timeout_ms` (env ``CBFT_DISPATCH_TIMEOUT_MS``).
+  A wedged call is abandoned to a zombie thread — which exits at the next
+  chunk boundary via mesh.cancel_scope rather than enqueueing more device
+  work — the batch re-verifies on CPU, and the incident opens the breaker.
+
+* **circuit breaker** — HEALTHY → DEGRADED → BROKEN. `breaker_threshold`
+  consecutive dispatch failures (or ANY watchdog trip / audit mismatch)
+  opens the breaker: traffic routes straight to the CPU ground truth with
+  zero added latency (no thread spawn, no timeout wait). Exponential-
+  backoff **canary probes** (a known-good signed batch) then re-admit the
+  device once it proves healthy again.
+
+* **silent-corruption audit** — `[crypto] audit_pct` percent of device
+  batches are re-verified on CPU; any verdict disagreement immediately
+  breaks the circuit and bumps ``verify_supervisor_audit_mismatches``, so
+  a miscompiled kernel cannot keep silently accepting bad commits. With
+  ``audit_sync`` (env ``CBFT_AUDIT_SYNC=1``) the sampled batches are
+  checked BEFORE their verdicts are released and the CPU verdict wins on
+  disagreement — at 100 % this makes the device a pure accelerator with
+  CPU confirmation (the chaos soak's no-wrong-verdict-ever mode); the
+  default background mode bounds exposure to the sampling window instead.
+
+Everything the supervisor decides is observable as ``verify_supervisor_*``
+metrics: a state gauge, breaker trips, canary probes, audits, audit
+mismatches, and watchdog kills.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import random
+import threading
+import time
+from typing import Deque, List, Optional, Tuple
+
+from cometbft_tpu.crypto import PubKey
+from cometbft_tpu.crypto.batch import (
+    Backend,
+    BackendSpec,
+    BatchVerifier,
+    CPUBatchVerifier,
+    new_batch_verifier,
+    unwrap_backend,
+)
+from cometbft_tpu.libs.log import Logger, new_nop_logger
+from cometbft_tpu.libs.metrics import Registry
+
+SUBSYSTEM = "verify_supervisor"
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+BROKEN = "broken"
+_STATE_CODE = {HEALTHY: 0, DEGRADED: 1, BROKEN: 2}
+
+DEFAULT_DISPATCH_TIMEOUT_MS = 60_000
+DEFAULT_BREAKER_THRESHOLD = 3
+DEFAULT_AUDIT_PCT = 5
+DEFAULT_PROBE_BASE_MS = 1_000
+DEFAULT_PROBE_MAX_MS = 60_000
+_AUDIT_QUEUE_CAP = 64  # batches; beyond this, drop-and-count (see audit_drops)
+
+Item = Tuple[PubKey, bytes, bytes]
+
+
+class WatchdogTimeout(RuntimeError):
+    """A device dispatch exceeded dispatch_timeout_ms and was abandoned."""
+
+
+def _knob(env: str, config_value: Optional[int], default: int) -> int:
+    """Same precedence shape as every [crypto] knob (crypto/batch.py
+    ed25519_routing_floor): env operator override > config > default."""
+    raw = os.environ.get(env)
+    if raw is not None:
+        return int(raw)
+    if config_value is not None:
+        return int(config_value)
+    return default
+
+
+def dispatch_timeout_ms_default(config_value: Optional[int] = None) -> int:
+    return _knob("CBFT_DISPATCH_TIMEOUT_MS", config_value,
+                 DEFAULT_DISPATCH_TIMEOUT_MS)
+
+
+def breaker_threshold_default(config_value: Optional[int] = None) -> int:
+    return _knob("CBFT_BREAKER_THRESHOLD", config_value,
+                 DEFAULT_BREAKER_THRESHOLD)
+
+
+def audit_pct_default(config_value: Optional[int] = None) -> int:
+    return _knob("CBFT_AUDIT_PCT", config_value, DEFAULT_AUDIT_PCT)
+
+
+class Metrics:
+    """Supervisor observability (libs/metrics.py instruments), exported
+    as verify_supervisor_* through the node's Prometheus registry."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry if registry is not None else Registry()
+        self.state = r.gauge(
+            SUBSYSTEM, "state",
+            "Circuit breaker state: 0=healthy, 1=degraded, 2=broken.",
+        )
+        self.trips = r.counter(
+            SUBSYSTEM, "trips",
+            "Circuit-breaker opens, by cause (failures|watchdog|audit|probe).",
+        )
+        self.probes = r.counter(
+            SUBSYSTEM, "probes",
+            "Canary probe dispatches, by outcome (ok|fail).",
+        )
+        self.audits = r.counter(
+            SUBSYSTEM, "audits",
+            "Device batches re-verified on CPU by the corruption audit.",
+        )
+        self.audit_mismatches = r.counter(
+            SUBSYSTEM, "audit_mismatches",
+            "Audited batches whose device verdicts disagreed with the CPU "
+            "ground truth — each one breaks the circuit (safety counter).",
+        )
+        self.audit_drops = r.counter(
+            SUBSYSTEM, "audit_drops",
+            "Sampled batches dropped because the background audit queue "
+            "was full.",
+        )
+        self.watchdog_kills = r.counter(
+            SUBSYSTEM, "watchdog_kills",
+            "Device dispatches abandoned to a zombie thread after "
+            "exceeding dispatch_timeout_ms.",
+        )
+        self.failures = r.counter(
+            SUBSYSTEM, "failures",
+            "Supervised device dispatches that raised (excl. watchdog).",
+        )
+        self.device_dispatches = r.counter(
+            SUBSYSTEM, "device_dispatches",
+            "Batches dispatched to the supervised backend.",
+        )
+        self.cpu_routed = r.counter(
+            SUBSYSTEM, "cpu_routed",
+            "Batches routed straight to CPU because the breaker was open.",
+        )
+
+    @classmethod
+    def nop(cls) -> "Metrics":
+        return cls(None)
+
+
+class BackendSupervisor:
+    """Supervised verify entry: ``verify_items(items) -> mask`` with the
+    same verdict semantics as BatchVerifier.verify()'s mask, guaranteed
+    to return (never hang) and never to lose a batch — the CPU ground
+    truth backs every failure path.
+
+    Duck-typed like the VerifyScheduler so it travels the same opaque
+    backend parameter: anything exposing ``verify_items`` + ``spec`` is
+    unwrapped by crypto/batch.py, and ``new_batch_verifier(supervisor)``
+    returns a SupervisedBatchVerifier adapter.
+    """
+
+    def __init__(
+        self,
+        spec: Backend = None,
+        dispatch_timeout_ms: Optional[int] = None,
+        breaker_threshold: Optional[int] = None,
+        audit_pct: Optional[int] = None,
+        audit_sync: Optional[bool] = None,
+        probe_base_ms: Optional[int] = None,
+        probe_max_ms: Optional[int] = None,
+        metrics: Optional[Metrics] = None,
+        logger: Optional[Logger] = None,
+    ):
+        spec = unwrap_backend(spec)
+        if not isinstance(spec, BackendSpec):
+            spec = BackendSpec(name=spec) if spec else BackendSpec(
+                name=os.environ.get("CMT_CRYPTO_BACKEND", "cpu")
+            )
+        self.spec = spec
+        self._timeout_s = dispatch_timeout_ms_default(dispatch_timeout_ms) / 1e3
+        self._threshold = max(1, breaker_threshold_default(breaker_threshold))
+        self._audit_pct = min(100, max(0, audit_pct_default(audit_pct)))
+        if audit_sync is None:
+            audit_sync = os.environ.get("CBFT_AUDIT_SYNC", "0") == "1"
+        self._audit_sync = audit_sync
+        self._probe_base_s = _knob(
+            "CBFT_PROBE_BASE_MS", probe_base_ms, DEFAULT_PROBE_BASE_MS
+        ) / 1e3
+        self._probe_max_s = _knob(
+            "CBFT_PROBE_MAX_MS", probe_max_ms, DEFAULT_PROBE_MAX_MS
+        ) / 1e3
+        self.metrics = metrics if metrics is not None else Metrics.nop()
+        self.logger = logger or new_nop_logger()
+
+        self._lock = threading.Lock()
+        self._state = HEALTHY
+        self._consecutive_failures = 0
+        self._backoff_s = self._probe_base_s
+        self._next_probe_at = 0.0
+        self._probing = False
+        self._rng = random.Random()
+
+        self._audit_cond = threading.Condition()
+        self._audit_queue: Deque[Tuple[List[Item], List[bool]]] = (
+            collections.deque()
+        )
+        self._audit_worker: Optional[threading.Thread] = None
+        self._stopped = False
+
+        self._canary: Optional[List[Item]] = None
+
+    # -- knob introspection --------------------------------------------------
+
+    @property
+    def dispatch_timeout_ms(self) -> int:
+        return int(self._timeout_s * 1e3)
+
+    @property
+    def breaker_threshold(self) -> int:
+        return self._threshold
+
+    @property
+    def audit_pct(self) -> int:
+        return self._audit_pct
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    # -- the supervised verify entry -----------------------------------------
+
+    def verify_items(
+        self, items: List[Item], reason: str = "direct"
+    ) -> List[bool]:
+        """Verify ``items`` through the supervised backend, falling back
+        to the CPU ground truth on any failure. Always returns a full
+        mask; never raises for device-plane reasons; bounded in time by
+        dispatch_timeout_ms + the CPU verify."""
+        if not items:
+            return []
+        if self.spec.name == "cpu":
+            # the wrapped backend IS the ground truth — nothing to
+            # supervise, watch, or audit against
+            return self._cpu_verify(items)
+        if self.state() == BROKEN:
+            # fail fast: zero added latency while the breaker is open
+            self._maybe_probe_async()
+            self.metrics.cpu_routed.add()
+            return self._cpu_verify(items)
+        try:
+            mask = self._device_verify(items)
+        except WatchdogTimeout as exc:
+            self.metrics.watchdog_kills.add()
+            self._trip("watchdog", err=str(exc), n=len(items), reason=reason)
+            return self._cpu_verify(items)
+        except Exception as exc:  # noqa: BLE001 - any backend death
+            self._note_failure(exc, len(items), reason)
+            return self._cpu_verify(items)
+        self._note_success()
+        if self._audit_pct > 0 and self._should_audit():
+            if self._audit_sync:
+                cpu_mask = self._cpu_verify(items)
+                self.metrics.audits.add()
+                if cpu_mask != mask:
+                    self._audit_mismatch(len(items))
+                    return cpu_mask  # ground truth wins, always
+            else:
+                self._enqueue_audit(items, mask)
+        return mask
+
+    # -- canary probes -------------------------------------------------------
+
+    def probe_now(self) -> bool:
+        """One synchronous canary probe: dispatch a known-good signed
+        batch through the supervised backend under the watchdog. Success
+        closes the breaker; failure opens it (or extends the backoff).
+        Used by the node's warmup canary, tools/chaos.py, and tests."""
+        items = self._canary_items()
+        err = None
+        try:
+            mask = self._device_verify(items)
+            ok = len(mask) == len(items) and all(mask)
+        except WatchdogTimeout as exc:
+            self.metrics.watchdog_kills.add()
+            ok, err = False, exc
+        except Exception as exc:  # noqa: BLE001
+            ok, err = False, exc
+        with self._lock:
+            if ok:
+                self._close_breaker_locked()
+            else:
+                self._backoff_s = min(self._backoff_s * 2, self._probe_max_s)
+                self._next_probe_at = time.monotonic() + self._backoff_s
+                if self._state != BROKEN:
+                    self._trip_locked("probe")
+        self.metrics.probes.with_labels(outcome="ok" if ok else "fail").add()
+        if ok:
+            self.logger.info("verify canary probe ok", state=self.state())
+        else:
+            self.logger.error(
+                "verify canary probe failed", err=str(err),
+                next_probe_in_s=round(self._backoff_s, 3),
+            )
+        return ok
+
+    def warmup_canary(self) -> None:
+        """Kick one background probe at node start so a wedged device
+        plane trips the breaker before consensus traffic arrives."""
+        threading.Thread(
+            target=self.probe_now, daemon=True, name="supervisor-canary"
+        ).start()
+
+    def _maybe_probe_async(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if (
+                self._state != BROKEN
+                or self._probing
+                or now < self._next_probe_at
+            ):
+                return
+            self._probing = True
+
+        def run():
+            try:
+                self.probe_now()
+            finally:
+                with self._lock:
+                    self._probing = False
+
+        threading.Thread(
+            target=run, daemon=True, name="supervisor-probe"
+        ).start()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def stop(self) -> None:
+        """Stop the background audit worker (idempotent). Any queued
+        audits are dropped — audits are advisory once the node is
+        shutting down."""
+        with self._audit_cond:
+            self._stopped = True
+            self._audit_queue.clear()
+            self._audit_cond.notify_all()
+        w = self._audit_worker
+        if w is not None and w is not threading.current_thread():
+            w.join(timeout=5.0)
+
+    # -- internals: dispatch -------------------------------------------------
+
+    def _device_verify(self, items: List[Item]) -> List[bool]:
+        """Run the wrapped backend under the dispatch watchdog. A call
+        that outlives dispatch_timeout_ms is abandoned: its thread keeps
+        the hardware handle (nothing can safely interrupt an XLA
+        dispatch) but exits at the next chunk boundary through the
+        cancel event, and the caller gets WatchdogTimeout."""
+        # import OUTSIDE the timed region so a cold jax import can never
+        # eat the first dispatch's timeout budget
+        from cometbft_tpu.crypto.tpu import mesh
+
+        self.metrics.device_dispatches.add()
+        done = threading.Event()
+        cancel = threading.Event()
+        box: dict = {}
+
+        def run():
+            try:
+                with mesh.cancel_scope(cancel):
+                    bv = new_batch_verifier(self.spec)
+                    for pk, m, s in items:
+                        bv.add(pk, m, s)
+                    _, mask = bv.verify()
+                if len(mask) != len(items):
+                    raise RuntimeError(
+                        f"backend returned {len(mask)} verdicts for "
+                        f"{len(items)} items"
+                    )
+                box["mask"] = mask
+            except BaseException as exc:  # noqa: BLE001 - crosses threads
+                box["exc"] = exc
+            finally:
+                done.set()
+
+        t = threading.Thread(
+            target=run, daemon=True, name="supervised-dispatch"
+        )
+        t.start()
+        if not done.wait(self._timeout_s):
+            cancel.set()  # the zombie exits at its next chunk boundary
+            raise WatchdogTimeout(
+                f"device dispatch of {len(items)} items exceeded "
+                f"{self.dispatch_timeout_ms}ms; abandoned"
+            )
+        if "exc" in box:
+            raise box["exc"]
+        return box["mask"]
+
+    def _cpu_verify(self, items: List[Item]) -> List[bool]:
+        bv: BatchVerifier = CPUBatchVerifier()
+        for pk, m, s in items:
+            bv.add(pk, m, s)
+        _, mask = bv.verify()
+        return mask
+
+    def _canary_items(self) -> List[Item]:
+        if self._canary is None:
+            from cometbft_tpu.crypto import ed25519 as ed
+
+            items = []
+            for i in range(8):
+                k = ed.gen_priv_key_from_secret(b"supervisor-canary-%d" % i)
+                m = b"supervisor canary message %d" % i
+                items.append((k.pub_key(), m, k.sign(m)))
+            self._canary = items
+        return self._canary
+
+    # -- internals: breaker state machine ------------------------------------
+
+    def _note_success(self) -> None:
+        with self._lock:
+            if self._state == BROKEN:
+                return  # only a probe may close an open breaker
+            self._consecutive_failures = 0
+            if self._state == DEGRADED:
+                self._state = HEALTHY
+                self.metrics.state.set(_STATE_CODE[HEALTHY])
+
+    def _note_failure(self, exc: BaseException, n: int, reason: str) -> None:
+        self.metrics.failures.add()
+        self.logger.error(
+            "supervised verify dispatch failed; falling back to CPU",
+            err=repr(exc), n=n, reason=reason, backend=self.spec.name,
+        )
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._consecutive_failures >= self._threshold:
+                self._trip_locked("failures")
+            elif self._state == HEALTHY:
+                self._state = DEGRADED
+                self.metrics.state.set(_STATE_CODE[DEGRADED])
+
+    def _trip(self, cause: str, **kv) -> None:
+        self.logger.error(f"verify circuit breaker opened ({cause})", **kv)
+        with self._lock:
+            self._trip_locked(cause)
+
+    def _trip_locked(self, cause: str) -> None:
+        if self._state != BROKEN:
+            self.metrics.trips.with_labels(cause=cause).add()
+        self._state = BROKEN
+        self.metrics.state.set(_STATE_CODE[BROKEN])
+        self._backoff_s = self._probe_base_s
+        self._next_probe_at = time.monotonic() + self._backoff_s
+
+    def _close_breaker_locked(self) -> None:
+        if self._state != HEALTHY:
+            self.logger.info("verify circuit breaker closed")
+        self._state = HEALTHY
+        self._consecutive_failures = 0
+        self._backoff_s = self._probe_base_s
+        self._next_probe_at = 0.0
+        self.metrics.state.set(_STATE_CODE[HEALTHY])
+
+    # -- internals: corruption audit -----------------------------------------
+
+    def _should_audit(self) -> bool:
+        if self._audit_pct >= 100:
+            return True
+        with self._lock:
+            return self._rng.random() * 100.0 < self._audit_pct
+
+    def _audit_mismatch(self, n: int) -> None:
+        self.metrics.audit_mismatches.add()
+        self._trip("audit", n=n)
+
+    def _enqueue_audit(self, items: List[Item], mask: List[bool]) -> None:
+        with self._audit_cond:
+            if self._stopped:
+                return
+            if len(self._audit_queue) >= _AUDIT_QUEUE_CAP:
+                self.metrics.audit_drops.add()
+                return
+            self._audit_queue.append((items, mask))
+            if self._audit_worker is None or not self._audit_worker.is_alive():
+                self._audit_worker = threading.Thread(
+                    target=self._audit_run, daemon=True,
+                    name="supervisor-audit",
+                )
+                self._audit_worker.start()
+            self._audit_cond.notify_all()
+
+    def _audit_run(self) -> None:
+        while True:
+            with self._audit_cond:
+                while not self._audit_queue and not self._stopped:
+                    self._audit_cond.wait(1.0)
+                if self._stopped:
+                    return
+                items, mask = self._audit_queue.popleft()
+            try:
+                cpu_mask = self._cpu_verify(items)
+            except Exception as exc:  # noqa: BLE001 - audit must not die
+                self.logger.error("corruption audit failed", err=str(exc))
+                continue
+            self.metrics.audits.add()
+            if cpu_mask != mask:
+                self._audit_mismatch(len(items))
+
+
+class SupervisedBatchVerifier(BatchVerifier):
+    """add()/verify() protocol on top of a BackendSupervisor, so the
+    supervisor can travel anywhere a backend name / BackendSpec does
+    (crypto/batch.py new_batch_verifier unwraps it)."""
+
+    def __init__(self, supervisor: BackendSupervisor):
+        self._supervisor = supervisor
+        self._items: List[Item] = []
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key is None:
+            raise ValueError("nil pubkey")
+        self._items.append((pub_key, bytes(msg), bytes(sig)))
+
+    def count(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> Tuple[bool, List[bool]]:
+        items, self._items = self._items, []
+        if not items:
+            return False, []
+        mask = self._supervisor.verify_items(items)
+        return all(mask), mask

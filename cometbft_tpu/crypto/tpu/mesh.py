@@ -37,6 +37,43 @@ _mtx = threading.Lock()
 _cached = None
 
 
+# --- cancellable dispatch entry ---------------------------------------------
+# An XLA dispatch cannot be interrupted once issued, but the chunk loop
+# CAN stop between chunks. The supervisor's watchdog (crypto/
+# supervisor.py) abandons a wedged dispatch thread and sets its cancel
+# event; the zombie then exits at the next chunk boundary instead of
+# grinding through the rest of the batch against a dead device.
+
+_cancel_local = threading.local()
+
+
+class DispatchCancelled(RuntimeError):
+    """The dispatch's cancel event fired (watchdog abandoned it)."""
+
+
+def current_cancel_event() -> Optional[threading.Event]:
+    """The cancel event installed on THIS thread, if any."""
+    return getattr(_cancel_local, "event", None)
+
+
+class cancel_scope:
+    """Context manager installing ``event`` as this thread's dispatch
+    cancel event; dispatch_batch checks it at every chunk boundary."""
+
+    def __init__(self, event: threading.Event):
+        self._event = event
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_cancel_local, "event", None)
+        _cancel_local.event = self._event
+        return self._event
+
+    def __exit__(self, *exc_info):
+        _cancel_local.event = self._prev
+        return False
+
+
 def maybe_init_distributed() -> bool:
     """Initialize jax.distributed for a multi-host verification plane
     when the operator configured one. Runs automatically on first mesh
@@ -237,41 +274,65 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
     depth = pipeline_depth()
     out = np.zeros(n, bool)
     inflight: "deque" = deque()
+    cancel = current_cancel_event()
 
     def retire(slot):
-        start, end, mask = slot
-        out[start:end] = np.asarray(mask)[: end - start]
+        chunk_idx, start, end, mask = slot
+        try:
+            out[start:end] = np.asarray(mask)[: end - start]
+        except DispatchCancelled:
+            raise
+        except Exception as exc:  # noqa: BLE001 - device died mid-retire
+            raise RuntimeError(
+                f"retire of chunk {chunk_idx} (sigs [{start}:{end}]) "
+                f"failed: {exc}"
+            ) from exc
 
-    for start in range(0, n, max_chunk):
+    for chunk_idx, start in enumerate(range(0, n, max_chunk)):
+        if cancel is not None and cancel.is_set():
+            raise DispatchCancelled(
+                f"dispatch cancelled before chunk {chunk_idx} "
+                f"(sigs [{start}:{n}] undone)"
+            )
         end = min(start + max_chunk, n)
-        if callable(packed):
-            chunk = packed(start, end)
-        else:
-            chunk = [a[..., start:end] for a in packed]
-        size = min_pad
-        while size < end - start:
-            size *= 2
-        if ndev > 1:
-            size = -(-size // ndev) * ndev
+        try:
+            if callable(packed):
+                chunk = packed(start, end)
+            else:
+                chunk = [a[..., start:end] for a in packed]
+            size = min_pad
+            while size < end - start:
+                size *= 2
+            if ndev > 1:
+                size = -(-size // ndev) * ndev
 
-        def pad(a):
-            padded = np.zeros(a.shape[:-1] + (size,), a.dtype)
-            padded[..., : end - start] = a
-            return padded
+            def pad(a):
+                padded = np.zeros(a.shape[:-1] + (size,), a.dtype)
+                padded[..., : end - start] = a
+                return padded
 
-        padded_args = [pad(a) for a in chunk]
-        if ndev > 1:
-            mask = sharded_verify(kernel, padded_args)
-        else:
-            import jax
-            import jax.numpy as jnp
+            padded_args = [pad(a) for a in chunk]
+            if ndev > 1:
+                mask = sharded_verify(kernel, padded_args)
+            else:
+                import jax
+                import jax.numpy as jnp
 
-            # explicit async device_put: H2D for this chunk starts now,
-            # overlapping the previous chunk's compute; the jit call
-            # then consumes already-placed (donated) buffers
-            placed = [jax.device_put(jnp.asarray(a)) for a in padded_args]
-            mask = donating_kernel(kernel, len(placed))(*placed)
-        inflight.append((start, end, mask))
+                # explicit async device_put: H2D for this chunk starts
+                # now, overlapping the previous chunk's compute; the jit
+                # call then consumes already-placed (donated) buffers
+                placed = [
+                    jax.device_put(jnp.asarray(a)) for a in padded_args
+                ]
+                mask = donating_kernel(kernel, len(placed))(*placed)
+        except DispatchCancelled:
+            raise
+        except Exception as exc:  # noqa: BLE001 - per-chunk context for triage
+            raise RuntimeError(
+                f"dispatch of chunk {chunk_idx} (sigs [{start}:{end}]) "
+                f"failed: {exc}"
+            ) from exc
+        inflight.append((chunk_idx, start, end, mask))
         while len(inflight) > depth:
             retire(inflight.popleft())
     while inflight:

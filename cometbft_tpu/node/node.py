@@ -171,6 +171,7 @@ class Node(BaseService):
         # otherwise so instrumentation points stay free)
         from cometbft_tpu.consensus.metrics import Metrics as ConsMetrics
         from cometbft_tpu.crypto.scheduler import Metrics as SchedMetrics
+        from cometbft_tpu.crypto.supervisor import Metrics as SupMetrics
         from cometbft_tpu.libs.metrics import Registry
         from cometbft_tpu.mempool.metrics import Metrics as MemMetrics
         from cometbft_tpu.p2p.metrics import Metrics as P2PMetrics
@@ -185,6 +186,7 @@ class Node(BaseService):
             mem_metrics = MemMetrics(self.metrics_registry)
             sm_metrics = SMMetrics(self.metrics_registry)
             sched_metrics = SchedMetrics(self.metrics_registry)
+            sup_metrics = SupMetrics(self.metrics_registry)
         else:
             self.metrics_registry = None
             cons_metrics = ConsMetrics.nop()
@@ -192,6 +194,7 @@ class Node(BaseService):
             mem_metrics = MemMetrics.nop()
             sm_metrics = SMMetrics.nop()
             sched_metrics = SchedMetrics.nop()
+            sup_metrics = SupMetrics.nop()
 
         # 0b. the node-wide verification scheduler: ONE coalescer every
         # verification-carrying subsystem submits through, so concurrent
@@ -201,12 +204,28 @@ class Node(BaseService):
         # crypto/batch.py unwraps it — so standalone new_batch_verifier
         # users keep working unchanged.
         from cometbft_tpu.crypto.scheduler import VerifyScheduler
+        from cometbft_tpu.crypto.supervisor import BackendSupervisor
 
+        # 0a. the backend supervisor: every coalesced dispatch runs
+        # under its watchdog / circuit breaker / corruption audit, so a
+        # wedged, dying, or silently-wrong device plane degrades to the
+        # CPU ground truth instead of stalling consensus or releasing
+        # wrong verdicts (crypto/supervisor.py)
+        self.verify_supervisor = BackendSupervisor(
+            spec=self.crypto_spec,
+            dispatch_timeout_ms=config.crypto.dispatch_timeout_ms,
+            breaker_threshold=config.crypto.breaker_threshold,
+            audit_pct=config.crypto.audit_pct,
+            metrics=sup_metrics,
+            logger=self.logger,
+        )
         self.verify_scheduler = VerifyScheduler(
             spec=self.crypto_spec,
             flush_us=config.crypto.flush_us,
             metrics=sched_metrics,
             logger=self.logger,
+            supervisor=self.verify_supervisor,
+            max_queue=config.crypto.max_queue,
         )
 
         # 1. stores
@@ -613,6 +632,11 @@ class Node(BaseService):
         # after switch.start); submit() degrades to inline dispatch when
         # the service is down, so ordering is a perf matter, not safety
         self.verify_scheduler.start()
+        if self.crypto_spec.name == "tpu":
+            # prove the device plane end-to-end (known-good signed batch)
+            # off the startup path; a failure trips the breaker before
+            # the first real commit instead of during it
+            self.verify_supervisor.warmup_canary()
         host, port = _parse_laddr(self.config.p2p.laddr)
         self.transport.listen(NetAddress(self.node_key.id(), host, port))
         if self.addr_book is not None:
@@ -783,6 +807,12 @@ class Node(BaseService):
                 self.logger.error(
                     "error stopping verify scheduler", err=str(exc)
                 )
+        try:
+            self.verify_supervisor.stop()
+        except Exception as exc:
+            self.logger.error(
+                "error stopping verify supervisor", err=str(exc)
+            )
         if self._privval_endpoint is not None:
             self._privval_endpoint.close()
         # release DB file locks so maintenance commands (rollback,

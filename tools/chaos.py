@@ -1,0 +1,73 @@
+"""Standalone chaos soak against the supervised verify plane.
+
+Drives crypto/faults.py run_chaos_soak — a randomized fault schedule
+(exceptions, hangs, silent verdict corruption, sudden death, jitter)
+over N simulated blocks through a supervised VerifyScheduler — and
+prints the JSON summary. Exit status is non-zero if any node-path
+invariant broke: a wrong verdict released, a future lost, or the
+breaker failing to re-admit the backend after faults stop.
+
+Default inner backend is "cpu" (self-contained soak of the supervisor
+machinery); pass --inner tpu on a host with a live device plane to soak
+the real dispatch path under injected faults. The `slow`-marked test in
+tests/test_supervisor.py runs the same soak in CI.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--blocks", type=int, default=50,
+                    help="simulated blocks to soak (default 50)")
+    ap.add_argument("--batch", type=int, default=48,
+                    help="signatures per block (default 48)")
+    ap.add_argument("--seed", type=int, default=1234,
+                    help="fault-schedule RNG seed (default 1234)")
+    ap.add_argument("--inner", default="cpu",
+                    help='backend under the faults: "cpu" (default) or '
+                         '"tpu" (requires a live device plane)')
+    ap.add_argument("--dispatch-timeout-ms", type=int, default=500,
+                    help="supervisor watchdog budget per dispatch "
+                         "(default 500; raise for a real TPU link)")
+    ap.add_argument("--probe-base-ms", type=int, default=20,
+                    help="canary probe backoff base (default 20)")
+    ap.add_argument("--submitters", type=int, default=3,
+                    help="concurrent submitter threads per block "
+                         "(default 3)")
+    args = ap.parse_args()
+
+    if args.inner == "cpu":
+        # self-contained soak: no device plane required
+        os.environ.setdefault("CBFT_TPU_PROBE", "0")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from cometbft_tpu.crypto.faults import run_chaos_soak
+
+    summary = run_chaos_soak(
+        n_blocks=args.blocks,
+        batch=args.batch,
+        seed=args.seed,
+        inner=args.inner,
+        dispatch_timeout_ms=args.dispatch_timeout_ms,
+        probe_base_ms=args.probe_base_ms,
+        n_submitters=args.submitters,
+    )
+    print(json.dumps(summary, indent=2))
+    ok = (
+        summary["wrong_verdicts"] == 0
+        and summary["lost_futures"] == 0
+        and summary["readmitted"]
+        and summary["device_resumed_after_recovery"]
+    )
+    print("CHAOS SOAK", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
