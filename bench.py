@@ -316,6 +316,102 @@ def _stage_scheduler():
     print(json.dumps(out), flush=True)
 
 
+def _stage_trace():
+    """Verify-path tracing overhead + per-stage attribution. Runs the
+    scheduler-stage workload (4 concurrent 64-sig callers) twice through
+    identical VerifySchedulers — tracing disabled (sample=0, the no-op
+    span fast path) vs fully sampled (sample=1) — and reports the
+    throughput delta. The disabled-mode budget is < 3%: the stage exits
+    non-zero past it, so a regression that puts real work on the
+    untraced hot path fails the bench loudly. Also embeds the per-stage
+    breakdown of one fully-traced dispatch (request/dispatch/supervise/
+    cpu|device/chunk durations) — the attribution numbers the trace
+    layer exists to produce."""
+    import threading
+
+    _maybe_force_cpu()
+    _set_cache()
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+    from cometbft_tpu.libs import trace as tracelib
+
+    backend = "cpu" if os.environ.get("BENCH_FORCE_CPU") == "1" else "tpu"
+    n_callers, per_caller = 4, 64
+    reqs = [
+        [
+            (ed.PubKeyEd25519(pk), m, s)
+            for pk, m, s in zip(*_make_batch(per_caller))
+        ]
+        for _ in range(n_callers)
+    ]
+    n_sigs = n_callers * per_caller
+
+    def fanout(sched):
+        errs = []
+
+        def wrap(i):
+            try:
+                ok, _ = sched.submit(reqs[i]).result(timeout=120)
+                assert ok
+            except Exception as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        ts = [
+            threading.Thread(target=wrap, args=(i,))
+            for i in range(n_callers)
+        ]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        return dt
+
+    def throughput(tracer, reps=5):
+        sched = VerifyScheduler(spec=backend, tracer=tracer)
+        sched.start()
+        try:
+            fanout(sched)  # warm (kernel + threads), untimed
+            return n_sigs / min(fanout(sched) for _ in range(reps))
+        finally:
+            sched.stop()
+
+    off = throughput(tracelib.Tracer(sample=0.0))
+    traced = tracelib.Tracer(sample=1.0, buffer=256)
+    on = throughput(traced)
+    overhead_pct = max(0.0, (off - on) / off * 100.0) if off else 0.0
+
+    # per-stage breakdown of one traced dispatch: the newest trace that
+    # actually carried a dispatch span (coalesced siblings carry only
+    # their request span)
+    breakdown = {}
+    for tr in traced.recent():
+        names = {sp["name"] for sp in tr["spans"]}
+        if "dispatch" in names:
+            breakdown = {
+                sp["name"]: round(sp["dur_us"], 1) for sp in tr["spans"]
+            }
+            break
+
+    out = {
+        "untraced_sigs_per_sec": round(off, 1),
+        "traced_sigs_per_sec": round(on, 1),
+        "tracing_overhead_pct": round(overhead_pct, 2),
+        "dispatch_breakdown_us": breakdown,
+        "traces_recorded": len(traced.recent()),
+    }
+    # emit BEFORE the budget check so a failure still carries numbers
+    print(json.dumps(out), flush=True)
+    assert overhead_pct <= 3.0, (
+        f"tracing overhead {overhead_pct:.2f}% (sampled vs off) exceeds "
+        f"the 3% budget on the scheduler stage "
+        f"(off={off:.1f} on={on:.1f} sigs/s)"
+    )
+
+
 def _stage_p50():
     _maybe_force_cpu()
     _set_cache()
@@ -743,6 +839,11 @@ def main():
     parsed, diag = _run_stage("supervisor", _STAGE_ENV_CPU, 300)
     stages["supervisor"] = parsed if parsed is not None else diag
 
+    # tracing overhead budget (<3% on the scheduler stage) + per-stage
+    # dispatch breakdown — platform-neutral, so it always runs
+    parsed, diag = _run_stage("trace", _STAGE_ENV_CPU, 300)
+    stages["trace"] = parsed if parsed is not None else diag
+
     last_onchip = None
     if result is None:
         # TPU unavailable — same kernel on the host CPU platform so the
@@ -804,6 +905,7 @@ if __name__ == "__main__":
             "breakdown": _stage_breakdown,
             "scheduler": _stage_scheduler,
             "supervisor": _stage_supervisor,
+            "trace": _stage_trace,
         }[sys.argv[2]]()
     else:
         main()

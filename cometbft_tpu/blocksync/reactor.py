@@ -375,10 +375,14 @@ class BlocksyncReactor(Reactor):
                 [
                     (val.pub_key, lane_msgs[idx], lane_sigs[idx])
                     for idx, val in entries
-                ]
+                ],
+                subsystem="blocksync",
+                # block i of the window commits at this height; trace
+                # tag only, never routing
+                height=state.last_block_height + 1 + i,
             )
-            for entries, (lane_msgs, lane_sigs) in zip(
-                per_block, lanes_per_block
+            for i, (entries, (lane_msgs, lane_sigs)) in enumerate(
+                zip(per_block, lanes_per_block)
             )
         ]
 

@@ -215,6 +215,15 @@ class InstrumentationConfig:
     prometheus_listen_addr: str = ":26660"
     max_open_connections: int = 3
     namespace: str = "cometbft"
+    # Verify-path tracing (libs/trace.py): fraction of verify requests
+    # that open a sampled trace (0 disables tracing entirely — the hot
+    # path then costs one attribute check; 1 traces everything). An
+    # explicitly-set CBFT_TRACE_SAMPLE env var wins.
+    trace_sample: float = 0.0
+    # Flight-recorder capacity: how many COMPLETED traces the in-memory
+    # ring buffer retains for /debug/traces and incident dumps.
+    # CBFT_TRACE_BUFFER env wins.
+    trace_buffer: int = 256
 
 
 @dataclass
@@ -316,6 +325,22 @@ class Config:
             raise ValueError(
                 f"crypto.audit_pct must be an integer in [0, 100], got {ap!r}"
             )
+        ts = self.instrumentation.trace_sample
+        if (
+            not isinstance(ts, (int, float))
+            or isinstance(ts, bool)
+            or not 0.0 <= float(ts) <= 1.0
+        ):
+            raise ValueError(
+                "instrumentation.trace_sample must be a number in "
+                f"[0, 1], got {ts!r}"
+            )
+        tb = self.instrumentation.trace_buffer
+        if not isinstance(tb, int) or isinstance(tb, bool) or tb < 1:
+            raise ValueError(
+                "instrumentation.trace_buffer must be a positive "
+                f"integer, got {tb!r}"
+            )
 
 
 def default_config() -> Config:
@@ -349,6 +374,11 @@ def _to_toml_value(v) -> str:
         return "true" if v else "false"
     if isinstance(v, int):
         return str(v)
+    if isinstance(v, float):
+        # repr always keeps a "." or exponent for finite floats, which
+        # is what TOML requires; without this branch floats fell through
+        # to the string case and came back as strings on reload
+        return repr(v)
     if isinstance(v, list):
         return "[" + ", ".join(f'"{x}"' for x in v) + "]"
     return f'"{v}"'

@@ -69,6 +69,7 @@ from cometbft_tpu.crypto.batch import (
     CPUBatchVerifier,
     new_batch_verifier,
 )
+from cometbft_tpu.libs import trace as tracelib
 from cometbft_tpu.libs.log import Logger
 from cometbft_tpu.libs.metrics import Registry
 from cometbft_tpu.libs.service import BaseService
@@ -206,12 +207,15 @@ class VerifyFuture:
 
 
 class _Request:
-    __slots__ = ("items", "future", "t_submit")
+    __slots__ = ("items", "future", "t_submit", "span")
 
-    def __init__(self, items: List[Item]):
+    def __init__(self, items: List[Item], span=tracelib.NOOP_SPAN):
         self.items = items
         self.future = VerifyFuture()
         self.t_submit = time.monotonic()
+        # request-level trace span (libs/trace.py); the shared no-op when
+        # tracing is off or the request wasn't sampled
+        self.span = span
 
 
 class VerifyScheduler(BaseService):
@@ -241,6 +245,7 @@ class VerifyScheduler(BaseService):
         supervisor=None,
         max_queue: Optional[int] = None,
         join_timeout_s: float = 30.0,
+        tracer: Optional[tracelib.Tracer] = None,
     ):
         super().__init__("VerifyScheduler", logger)
         if isinstance(spec, BackendSpec):
@@ -262,6 +267,7 @@ class VerifyScheduler(BaseService):
         # audit instead of the bare one-shot CPU fallback below
         self._supervisor = supervisor
         self._max_queue = max(1, max_queue_default(max_queue))
+        self._tracer = tracer if tracer is not None else tracelib.default_tracer()
         self._submit_timeout_s = int(
             os.environ.get(
                 "CBFT_SUBMIT_TIMEOUT_MS", str(DEFAULT_SUBMIT_TIMEOUT_MS)
@@ -337,6 +343,7 @@ class VerifyScheduler(BaseService):
             )
             for req in inflight + leftovers:
                 req.future._set_exception(exc)
+                req.span.end(error="abandoned_on_stop")
             return
         # worker exited cleanly: complete whatever is still queued inline
         # so no future is left hanging
@@ -345,16 +352,32 @@ class VerifyScheduler(BaseService):
 
     # -- submission ----------------------------------------------------------
 
-    def submit(self, items: Sequence[Item]) -> VerifyFuture:
+    def submit(
+        self,
+        items: Sequence[Item],
+        subsystem: Optional[str] = None,
+        height: Optional[int] = None,
+    ) -> VerifyFuture:
         """Queue ``items`` (``(pub_key, msg, sig)`` triples) for the next
         coalesced dispatch. Thread-safe; never blocks on the device, but
         MAY block (bounded by CBFT_SUBMIT_TIMEOUT_MS) for queue room when
-        [crypto] max_queue pending signatures are already waiting."""
-        req = _Request([(pk, bytes(m), bytes(s)) for pk, m, s in items])
+        [crypto] max_queue pending signatures are already waiting.
+
+        ``subsystem``/``height`` are trace tags only (who asked, for which
+        block) — they never affect routing or verdicts."""
+        triples = [(pk, bytes(m), bytes(s)) for pk, m, s in items]
+        span = self._tracer.start_span("request", n_sigs=len(triples))
+        if not span.noop:
+            if subsystem:
+                span.set_tag("subsystem", subsystem)
+            if height is not None:
+                span.set_tag("height", int(height))
+        req = _Request(triples, span)
         self.metrics.requests.add()
         self.metrics.signatures.add(len(req.items))
         if not req.items:
             req.future._set((True, []))
+            span.end(outcome="empty")
             return req.future
         if not self.is_running():
             # standalone / post-stop: synchronous inline dispatch keeps
@@ -398,6 +421,7 @@ class VerifyScheduler(BaseService):
             )
             mask = self._cpu_ground_truth(req.items)
             req.future._set((all(mask), mask))
+            span.end(outcome="backpressure_cpu", ok=all(mask))
         return req.future
 
     def flush(self) -> None:
@@ -471,20 +495,47 @@ class VerifyScheduler(BaseService):
         into per-request verdict slices."""
         t0 = time.monotonic()
         items: List[Item] = []
+        parent = None
         for req in batch:
-            self.metrics.request_wait_seconds.observe(t0 - req.t_submit)
+            wait_s = t0 - req.t_submit
+            self.metrics.request_wait_seconds.observe(wait_s)
             items.extend(req.items)
+            if not req.span.noop:
+                req.span.set_tag("wait_us", int(wait_s * 1e6))
+                if parent is None:
+                    # the OLDEST sampled request hosts the dispatch span
+                    # (spans form a tree; coalesced siblings link by tag)
+                    parent = req.span
         self.n_dispatches += 1
         self.metrics.flushes.with_labels(reason=reason).add()
-        self.metrics.lane_fill_ratio.observe(
-            min(1.0, len(items) / self._lane_budget)
+        lane_fill = min(1.0, len(items) / self._lane_budget)
+        self.metrics.lane_fill_ratio.observe(lane_fill)
+        dspan = self._tracer.start_span(
+            "dispatch",
+            parent=parent,
+            reason=reason,
+            n_requests=len(batch),
+            n_sigs=len(items),
+            lane_fill=round(lane_fill, 4),
         )
-        mask = self._verify(items, reason)
+        if not dspan.noop:
+            did = format(dspan.span_id, "x")
+            for req in batch:
+                if req.span is not parent and not req.span.noop:
+                    req.span.set_tag("dispatch_span", did)
+        try:
+            with tracelib.use(dspan):
+                mask = self._verify(items, reason)
+        except BaseException as exc:
+            dspan.end(error=repr(exc))
+            raise
+        dspan.end()
         pos = 0
         for req in batch:
             sub = mask[pos : pos + len(req.items)]
             pos += len(req.items)
             req.future._set((all(sub), sub))
+            req.span.end(ok=all(sub))
 
     def _verify(self, items: List[Item], reason: str) -> List[bool]:
         if self._supervisor is not None:
@@ -514,8 +565,9 @@ class VerifyScheduler(BaseService):
 
     @staticmethod
     def _cpu_ground_truth(items: Sequence[Item]) -> List[bool]:
-        bv = CPUBatchVerifier()
-        for pk, m, s in items:
-            bv.add(pk, m, s)
-        _, mask = bv.verify()
-        return mask
+        with tracelib.child_of_current("cpu", n_sigs=len(items)):
+            bv = CPUBatchVerifier()
+            for pk, m, s in items:
+                bv.add(pk, m, s)
+            _, mask = bv.verify()
+            return mask

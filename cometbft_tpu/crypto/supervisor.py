@@ -60,6 +60,7 @@ from cometbft_tpu.crypto.batch import (
     new_batch_verifier,
     unwrap_backend,
 )
+from cometbft_tpu.libs import trace as tracelib
 from cometbft_tpu.libs.log import Logger, new_nop_logger
 from cometbft_tpu.libs.metrics import Registry
 
@@ -187,6 +188,7 @@ class BackendSupervisor:
         probe_max_ms: Optional[int] = None,
         metrics: Optional[Metrics] = None,
         logger: Optional[Logger] = None,
+        tracer: Optional[tracelib.Tracer] = None,
     ):
         spec = unwrap_backend(spec)
         if not isinstance(spec, BackendSpec):
@@ -208,6 +210,7 @@ class BackendSupervisor:
         ) / 1e3
         self.metrics = metrics if metrics is not None else Metrics.nop()
         self.logger = logger or new_nop_logger()
+        self._tracer = tracer if tracer is not None else tracelib.default_tracer()
 
         self._lock = threading.Lock()
         self._state = HEALTHY
@@ -259,31 +262,51 @@ class BackendSupervisor:
             # the wrapped backend IS the ground truth — nothing to
             # supervise, watch, or audit against
             return self._cpu_verify(items)
-        if self.state() == BROKEN:
-            # fail fast: zero added latency while the breaker is open
-            self._maybe_probe_async()
-            self.metrics.cpu_routed.add()
-            return self._cpu_verify(items)
-        try:
-            mask = self._device_verify(items)
-        except WatchdogTimeout as exc:
-            self.metrics.watchdog_kills.add()
-            self._trip("watchdog", err=str(exc), n=len(items), reason=reason)
-            return self._cpu_verify(items)
-        except Exception as exc:  # noqa: BLE001 - any backend death
-            self._note_failure(exc, len(items), reason)
-            return self._cpu_verify(items)
-        self._note_success()
-        if self._audit_pct > 0 and self._should_audit():
-            if self._audit_sync:
-                cpu_mask = self._cpu_verify(items)
-                self.metrics.audits.add()
-                if cpu_mask != mask:
-                    self._audit_mismatch(len(items))
-                    return cpu_mask  # ground truth wins, always
-            else:
-                self._enqueue_audit(items, mask)
-        return mask
+        state = self.state()
+        span = self._tracer.span(
+            "supervise", state=state, n_sigs=len(items), reason=reason
+        )
+        with tracelib.use(span):
+            if state == BROKEN:
+                # fail fast: zero added latency while the breaker is open
+                self._maybe_probe_async()
+                self.metrics.cpu_routed.add()
+                mask = self._cpu_verify(items)
+                span.end(outcome="cpu_routed")
+                return mask
+            try:
+                mask = self._device_verify(items)
+            except WatchdogTimeout as exc:
+                self.metrics.watchdog_kills.add()
+                self._trip(
+                    "watchdog", err=str(exc), n=len(items), reason=reason
+                )
+                mask = self._cpu_verify(items)
+                span.end(outcome="watchdog_cpu")
+                return mask
+            except Exception as exc:  # noqa: BLE001 - any backend death
+                self._note_failure(exc, len(items), reason)
+                mask = self._cpu_verify(items)
+                span.end(outcome="failure_cpu")
+                return mask
+            self._note_success()
+            if self._audit_pct > 0 and self._should_audit():
+                if self._audit_sync:
+                    asp = tracelib.child_of_current(
+                        "audit", sync=True, n_sigs=len(items)
+                    )
+                    cpu_mask = self._cpu_verify(items)
+                    self.metrics.audits.add()
+                    mismatch = cpu_mask != mask
+                    asp.end(mismatch=mismatch)
+                    if mismatch:
+                        self._audit_mismatch(len(items))
+                        span.end(outcome="audit_mismatch")
+                        return cpu_mask  # ground truth wins, always
+                else:
+                    self._enqueue_audit(items, mask)
+            span.end(outcome="device_ok")
+            return mask
 
     # -- canary probes -------------------------------------------------------
 
@@ -302,6 +325,7 @@ class BackendSupervisor:
             ok, err = False, exc
         except Exception as exc:  # noqa: BLE001
             ok, err = False, exc
+        newly_opened = False
         with self._lock:
             if ok:
                 self._close_breaker_locked()
@@ -309,7 +333,9 @@ class BackendSupervisor:
                 self._backoff_s = min(self._backoff_s * 2, self._probe_max_s)
                 self._next_probe_at = time.monotonic() + self._backoff_s
                 if self._state != BROKEN:
-                    self._trip_locked("probe")
+                    newly_opened = self._trip_locked("probe")
+        if newly_opened:
+            self._dump_incident("probe")
         self.metrics.probes.with_labels(outcome="ok" if ok else "fail").add()
         if ok:
             self.logger.info("verify canary probe ok", state=self.state())
@@ -379,10 +405,16 @@ class BackendSupervisor:
         done = threading.Event()
         cancel = threading.Event()
         box: dict = {}
+        # span created on the CALLING thread (so it parents under the
+        # supervise/dispatch span) and installed inside the worker so the
+        # mesh chunk loop's spans nest under it across the thread hop
+        dev_span = tracelib.child_of_current(
+            "device", n_sigs=len(items), backend=self.spec.name
+        )
 
         def run():
             try:
-                with mesh.cancel_scope(cancel):
+                with tracelib.use(dev_span), mesh.cancel_scope(cancel):
                     bv = new_batch_verifier(self.spec)
                     for pk, m, s in items:
                         bv.add(pk, m, s)
@@ -404,20 +436,25 @@ class BackendSupervisor:
         t.start()
         if not done.wait(self._timeout_s):
             cancel.set()  # the zombie exits at its next chunk boundary
+            # span end is first-wins: the zombie's late spans are dropped
+            dev_span.end(outcome="watchdog_timeout")
             raise WatchdogTimeout(
                 f"device dispatch of {len(items)} items exceeded "
                 f"{self.dispatch_timeout_ms}ms; abandoned"
             )
         if "exc" in box:
+            dev_span.end(error=repr(box["exc"]))
             raise box["exc"]
+        dev_span.end(outcome="ok")
         return box["mask"]
 
     def _cpu_verify(self, items: List[Item]) -> List[bool]:
-        bv: BatchVerifier = CPUBatchVerifier()
-        for pk, m, s in items:
-            bv.add(pk, m, s)
-        _, mask = bv.verify()
-        return mask
+        with tracelib.child_of_current("cpu", n_sigs=len(items)):
+            bv: BatchVerifier = CPUBatchVerifier()
+            for pk, m, s in items:
+                bv.add(pk, m, s)
+            _, mask = bv.verify()
+            return mask
 
     def _canary_items(self) -> List[Item]:
         if self._canary is None:
@@ -459,15 +496,36 @@ class BackendSupervisor:
     def _trip(self, cause: str, **kv) -> None:
         self.logger.error(f"verify circuit breaker opened ({cause})", **kv)
         with self._lock:
-            self._trip_locked(cause)
+            newly_opened = self._trip_locked(cause)
+        if newly_opened:
+            self._dump_incident(cause)
 
-    def _trip_locked(self, cause: str) -> None:
-        if self._state != BROKEN:
+    def _trip_locked(self, cause: str) -> bool:
+        """Open the breaker; True if it was not already open (so callers
+        can fire once-per-incident actions outside the lock)."""
+        newly_opened = self._state != BROKEN
+        if newly_opened:
             self.metrics.trips.with_labels(cause=cause).add()
         self._state = BROKEN
         self.metrics.state.set(_STATE_CODE[BROKEN])
         self._backoff_s = self._probe_base_s
         self._next_probe_at = time.monotonic() + self._backoff_s
+        return newly_opened
+
+    def _dump_incident(self, cause: str) -> None:
+        """Write the trace flight recorder to disk so the dispatches that
+        led up to a watchdog trip / circuit-break are post-mortem
+        debuggable. Best-effort: a dump failure must never take down the
+        verify path."""
+        try:
+            path = self._tracer.dump(cause)
+        except Exception:  # noqa: BLE001 - diagnostics only
+            return
+        if path:
+            self.logger.error(
+                "verify incident: flight recorder dumped",
+                cause=cause, path=path,
+            )
 
     def _close_breaker_locked(self) -> None:
         if self._state != HEALTHY:
@@ -514,13 +572,20 @@ class BackendSupervisor:
                 if self._stopped:
                     return
                 items, mask = self._audit_queue.popleft()
+            span = self._tracer.start_span(
+                "audit", sync=False, n_sigs=len(items)
+            )
             try:
-                cpu_mask = self._cpu_verify(items)
+                with tracelib.use(span):
+                    cpu_mask = self._cpu_verify(items)
             except Exception as exc:  # noqa: BLE001 - audit must not die
+                span.end(error=repr(exc))
                 self.logger.error("corruption audit failed", err=str(exc))
                 continue
             self.metrics.audits.add()
-            if cpu_mask != mask:
+            mismatch = cpu_mask != mask
+            span.end(mismatch=mismatch)
+            if mismatch:
                 self._audit_mismatch(len(items))
 
 

@@ -22,8 +22,11 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 import warnings
 from typing import Optional
+
+from cometbft_tpu.libs import trace as _trace
 
 # the CPU fallback platform can't honor buffer donation and warns on
 # every dispatch; install the filter ONCE here — per-dispatch
@@ -277,16 +280,23 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
     cancel = current_cancel_event()
 
     def retire(slot):
-        chunk_idx, start, end, mask = slot
+        chunk_idx, start, end, mask, span = slot
+        # np.asarray blocks until the device finishes this chunk — the
+        # wait measured here IS the device-time attribution for the span
+        # (host work for the chunk already happened before dispatch).
+        t_dev = time.perf_counter_ns()
         try:
             out[start:end] = np.asarray(mask)[: end - start]
         except DispatchCancelled:
+            span.end(error="cancelled")
             raise
         except Exception as exc:  # noqa: BLE001 - device died mid-retire
+            span.end(error=repr(exc))
             raise RuntimeError(
                 f"retire of chunk {chunk_idx} (sigs [{start}:{end}]) "
                 f"failed: {exc}"
             ) from exc
+        span.end(device_wait_ns=time.perf_counter_ns() - t_dev)
 
     for chunk_idx, start in enumerate(range(0, n, max_chunk)):
         if cancel is not None and cancel.is_set():
@@ -295,6 +305,10 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
                 f"(sigs [{start}:{n}] undone)"
             )
         end = min(start + max_chunk, n)
+        span = _trace.child_of_current(
+            "chunk", chunk=chunk_idx, n_sigs=end - start
+        )
+        t_host = time.perf_counter_ns()
         try:
             if callable(packed):
                 chunk = packed(start, end)
@@ -326,13 +340,19 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
                 ]
                 mask = donating_kernel(kernel, len(placed))(*placed)
         except DispatchCancelled:
+            span.end(error="cancelled")
             raise
         except Exception as exc:  # noqa: BLE001 - per-chunk context for triage
+            span.end(error=repr(exc))
             raise RuntimeError(
                 f"dispatch of chunk {chunk_idx} (sigs [{start}:{end}]) "
                 f"failed: {exc}"
             ) from exc
-        inflight.append((chunk_idx, start, end, mask))
+        # host wall time: pack + pad + H2D issue + jit dispatch (returns
+        # before the device result is ready)
+        span.set_tag("host_ns", time.perf_counter_ns() - t_host)
+        span.set_tag("pad", size)
+        inflight.append((chunk_idx, start, end, mask, span))
         while len(inflight) > depth:
             retire(inflight.popleft())
     while inflight:

@@ -268,18 +268,52 @@ class Registry:
 
 
 class MetricsServer(RouteServer):
-    """/metrics HTTP endpoint (node/node.go:1221 startPrometheusServer)."""
+    """/metrics HTTP endpoint (node/node.go:1221 startPrometheusServer).
 
-    def __init__(self, registry: Registry):
-        super().__init__(
-            {
-                "/metrics": lambda _q: (
-                    200,
-                    "text/plain; version=0.0.4; charset=utf-8",
-                    registry.expose().encode(),
-                )
-            }
-        )
+    When handed a ``libs.trace.Tracer`` it additionally serves the
+    flight recorder:
+
+    * ``/debug/traces`` — recent completed traces as JSON (``?n=`` caps
+      the count);
+    * ``/debug/traces/chrome`` — the same traces as Chrome trace-event
+      JSON, loadable directly in Perfetto / chrome://tracing.
+    """
+
+    def __init__(self, registry: Registry, tracer=None):
+        routes = {
+            "/metrics": lambda _q: (
+                200,
+                "text/plain; version=0.0.4; charset=utf-8",
+                registry.expose().encode(),
+            )
+        }
+        if tracer is not None:
+            import json
+
+            from cometbft_tpu.libs import trace as _trace
+
+            def _limit(q) -> Optional[int]:
+                vals = q.get("n") or []
+                try:
+                    return int(vals[0]) if vals else None
+                except (TypeError, ValueError):
+                    return None
+
+            routes["/debug/traces"] = lambda q: (
+                200,
+                "application/json",
+                json.dumps(
+                    {"traces": tracer.recent(_limit(q))}, indent=1
+                ).encode(),
+            )
+            routes["/debug/traces/chrome"] = lambda q: (
+                200,
+                "application/json",
+                json.dumps(
+                    _trace.chrome_trace(tracer.recent(_limit(q)))
+                ).encode(),
+            )
+        super().__init__(routes)
 
 
 _global_registry: Optional[Registry] = None

@@ -196,6 +196,27 @@ class Node(BaseService):
             sched_metrics = SchedMetrics.nop()
             sup_metrics = SupMetrics.nop()
 
+        # 0c. verify-path tracer (libs/trace.py): per-node flight
+        # recorder over the verify pipeline (request → dispatch →
+        # supervise → device → chunk). Sampling/buffer knobs resolve
+        # env > [instrumentation] config > default; disabled (sample 0)
+        # the hot path sees only a no-op span object. Incident dumps
+        # (watchdog trip / circuit-break) land in the node's data dir.
+        from cometbft_tpu.libs import trace as tracelib
+
+        self.tracer = tracelib.Tracer(
+            sample=tracelib.trace_sample_default(
+                config.instrumentation.trace_sample
+            ),
+            buffer=tracelib.trace_buffer_default(
+                config.instrumentation.trace_buffer
+            ),
+        )
+        if config.root_dir:
+            self.tracer.set_dump_dir(os.path.join(config.root_dir, "data"))
+        if self.metrics_registry is not None:
+            tracelib.attach_stage_metrics(self.tracer, self.metrics_registry)
+
         # 0b. the node-wide verification scheduler: ONE coalescer every
         # verification-carrying subsystem submits through, so concurrent
         # sub-floor batches (a commit check racing a vote drain) share a
@@ -218,6 +239,7 @@ class Node(BaseService):
             audit_pct=config.crypto.audit_pct,
             metrics=sup_metrics,
             logger=self.logger,
+            tracer=self.tracer,
         )
         self.verify_scheduler = VerifyScheduler(
             spec=self.crypto_spec,
@@ -226,6 +248,7 @@ class Node(BaseService):
             logger=self.logger,
             supervisor=self.verify_supervisor,
             max_queue=config.crypto.max_queue,
+            tracer=self.tracer,
         )
 
         # 1. stores
@@ -667,7 +690,9 @@ class Node(BaseService):
             host, port = _parse_laddr(
                 self.config.instrumentation.prometheus_listen_addr
             )
-            self.metrics_server = MetricsServer(self.metrics_registry)
+            self.metrics_server = MetricsServer(
+                self.metrics_registry, tracer=self.tracer
+            )
             self.metrics_server.serve(host, port)
         if self.state_sync_enabled:
             self._start_state_sync()

@@ -403,11 +403,11 @@ class TestBlocksyncPipelined:
             def spec(self):
                 return self.inner.spec
 
-            def submit(self, items):
+            def submit(self, items, **kw):
                 self.n += 1
                 if self.n == 3:
                     items = [(pk, m, b"\x00" * 64) for pk, m, _ in items]
-                return self.inner.submit(items)
+                return self.inner.submit(items, **kw)
 
         reactor.crypto_backend = _PoisoningScheduler(sched)
         try:

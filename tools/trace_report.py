@@ -1,0 +1,190 @@
+"""Render a verify-path trace dump as per-stage latency tables.
+
+Reads a flight-recorder dump written by libs/trace.py (the automatic
+watchdog-trip / circuit-break incident file, or Tracer.dump output) OR a
+live node's /debug/traces endpoint, and prints:
+
+* a per-stage latency breakdown (count / p50 / p95 / max / total per
+  span name), plus device-vs-host attribution for chunk spans;
+* the top-K slowest traces with their span trees.
+
+Optionally re-exports the traces as Chrome trace-event JSON (--chrome)
+for Perfetto / chrome://tracing.
+
+Usage:
+    python tools/trace_report.py NODE_HOME/data/trace_dump_watchdog.json
+    python tools/trace_report.py http://127.0.0.1:26660/debug/traces
+    python tools/trace_report.py dump.json --top 3 --chrome out.json
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_traces(source: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Load (meta, traces) from a dump file path or /debug/traces URL.
+
+    Accepts the incident-dump shape ({"reason", "traces"}), the endpoint
+    shape ({"traces"}), or a bare trace list."""
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(source, timeout=10) as resp:
+            doc = json.loads(resp.read().decode("utf-8"))
+    else:
+        with open(source, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    if isinstance(doc, list):
+        return {}, doc
+    if not isinstance(doc, dict) or not isinstance(doc.get("traces"), list):
+        raise ValueError(
+            f"{source}: not a trace dump (expected a 'traces' list)"
+        )
+    meta = {k: v for k, v in doc.items() if k != "traces"}
+    return meta, doc["traces"]
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def stage_table(traces: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Aggregate span durations by stage (= span name): one row per
+    stage with count, p50/p95/max (µs), and total time (ms). Chunk rows
+    also attribute device wait vs host issue time from the span tags."""
+    by_stage: Dict[str, List[Dict[str, Any]]] = {}
+    for tr in traces:
+        for sp in tr.get("spans", ()):
+            by_stage.setdefault(sp.get("name", "?"), []).append(sp)
+    rows = []
+    for stage, spans in sorted(by_stage.items()):
+        durs = sorted(float(s.get("dur_us", 0.0)) for s in spans)
+        row = {
+            "stage": stage,
+            "count": len(spans),
+            "p50_us": round(_percentile(durs, 0.50), 1),
+            "p95_us": round(_percentile(durs, 0.95), 1),
+            "max_us": round(durs[-1], 1) if durs else 0.0,
+            "total_ms": round(sum(durs) / 1e3, 3),
+        }
+        dev_ns = sum(
+            int(s.get("tags", {}).get("device_wait_ns", 0)) for s in spans
+        )
+        host_ns = sum(
+            int(s.get("tags", {}).get("host_ns", 0)) for s in spans
+        )
+        if dev_ns or host_ns:
+            row["device_ms"] = round(dev_ns / 1e6, 3)
+            row["host_ms"] = round(host_ns / 1e6, 3)
+        rows.append(row)
+    return rows
+
+
+def slowest(
+    traces: List[Dict[str, Any]], k: int
+) -> List[Dict[str, Any]]:
+    """Top-k traces by root duration, each with its span tree flattened
+    in start order."""
+    ranked = sorted(
+        traces, key=lambda t: float(t.get("dur_us", 0.0)), reverse=True
+    )
+    return ranked[: max(0, k)]
+
+
+def _fmt_table(rows: List[Dict[str, Any]], columns: List[str]) -> str:
+    if not rows:
+        return "(no spans)"
+    widths = {
+        c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+        for c in columns
+    }
+    head = "  ".join(c.rjust(widths[c]) for c in columns)
+    sep = "  ".join("-" * widths[c] for c in columns)
+    body = [
+        "  ".join(str(r.get(c, "")).rjust(widths[c]) for c in columns)
+        for r in rows
+    ]
+    return "\n".join([head, sep] + body)
+
+
+def render(
+    meta: Dict[str, Any],
+    traces: List[Dict[str, Any]],
+    top: int = 5,
+) -> str:
+    out = []
+    if meta.get("reason"):
+        out.append(
+            f"incident dump: reason={meta['reason']} "
+            f"at {meta.get('wall_time', '?')}"
+        )
+    out.append(f"{len(traces)} trace(s)")
+    out.append("")
+    out.append("per-stage latency breakdown:")
+    cols = ["stage", "count", "p50_us", "p95_us", "max_us", "total_ms",
+            "device_ms", "host_ms"]
+    rows = stage_table(traces)
+    used = [c for c in cols if any(c in r for r in rows)] or cols[:6]
+    out.append(_fmt_table(rows, used))
+    out.append("")
+    out.append(f"top {min(top, len(traces))} slowest traces:")
+    for tr in slowest(traces, top):
+        out.append(
+            f"  trace {tr.get('trace_id', '?')}  root={tr.get('root', '?')}"
+            f"  dur={float(tr.get('dur_us', 0.0)) / 1e3:.3f}ms"
+        )
+        for sp in tr.get("spans", ()):
+            tags = sp.get("tags") or {}
+            tagstr = " ".join(
+                f"{k}={v}" for k, v in sorted(tags.items())
+            )
+            out.append(
+                f"    {sp.get('name', '?'):<10} "
+                f"{float(sp.get('dur_us', 0.0)) / 1e3:>10.3f}ms  {tagstr}"
+            )
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-stage latency report from a verify-trace dump."
+    )
+    ap.add_argument(
+        "source",
+        help="dump file path, or a node's /debug/traces URL",
+    )
+    ap.add_argument(
+        "--top", type=int, default=5,
+        help="how many slowest traces to detail (default 5)",
+    )
+    ap.add_argument(
+        "--chrome", metavar="OUT",
+        help="also write Chrome trace-event JSON (open in Perfetto)",
+    )
+    args = ap.parse_args(argv)
+    try:
+        meta, traces = load_traces(args.source)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    print(render(meta, traces, top=args.top))
+    if args.chrome:
+        from cometbft_tpu.libs.trace import chrome_trace
+
+        with open(args.chrome, "w", encoding="utf-8") as f:
+            json.dump(chrome_trace(traces), f)
+        print(f"\nchrome trace written to {args.chrome} "
+              f"(open at https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
