@@ -275,6 +275,21 @@ class CryptoConfig:
     # instead of growing without limit while the device plane stalls.
     # CBFT_MAX_QUEUE env wins.
     max_queue: int = 65536
+    # Hedged verification: when a device dispatch overruns predicted
+    # p99 × hedge_pct/100, the supervisor races the CPU verifier in
+    # parallel and releases whichever mask finishes first (the loser is
+    # audited for divergence). 0 disables hedging; dispatch_timeout_ms
+    # stays the last-resort bound. CBFT_HEDGE_PCT env wins.
+    hedge_pct: int = 200
+    # Base backoff before retrying a transient-classified device error
+    # (UNAVAILABLE/DEADLINE_EXCEEDED/tunnel flaps); actual delay is
+    # jittered in [0.5x, 1.5x). One retry, then the breaker ladder.
+    # CBFT_RETRY_MS env wins.
+    retry_ms: int = 25
+    # Chunk-cap recovery hysteresis: after an OOM halves the effective
+    # dispatch chunk cap, the cap recovers one doubling per this many
+    # consecutive clean device dispatches. CBFT_CHUNK_RECOVER_N env wins.
+    chunk_recover_n: int = 32
 
 
 @dataclass
@@ -314,6 +329,7 @@ class Config:
         for knob in (
             "min_batch", "max_chunk", "flush_us",
             "dispatch_timeout_ms", "breaker_threshold", "max_queue",
+            "retry_ms", "chunk_recover_n",
         ):
             v = getattr(self.crypto, knob)
             if not isinstance(v, int) or isinstance(v, bool) or v < 1:
@@ -324,6 +340,12 @@ class Config:
         if not isinstance(ap, int) or isinstance(ap, bool) or not 0 <= ap <= 100:
             raise ValueError(
                 f"crypto.audit_pct must be an integer in [0, 100], got {ap!r}"
+            )
+        hp = self.crypto.hedge_pct
+        if not isinstance(hp, int) or isinstance(hp, bool) or hp < 0:
+            # 0 is a valid value: it disables hedging entirely
+            raise ValueError(
+                f"crypto.hedge_pct must be a non-negative integer, got {hp!r}"
             )
         ts = self.instrumentation.trace_sample
         if (

@@ -13,7 +13,14 @@ wedged tunnels, flapping runtimes, miscompiled kernels):
   silent-corruption class only the CPU audit can catch;
 * ``die_after``       — dispatches after the Nth all raise (a backend
   that dies and stays dead until "repaired" by ``plan.clear()``);
-* ``jitter_ms``       — uniform random extra latency per dispatch.
+* ``jitter_ms``       — uniform random extra latency per dispatch;
+* ``oom_rate``        — probability a dispatch raises a
+  RESOURCE_EXHAUSTED-shaped error (classified OOM by the supervisor's
+  retry ladder, which halves the chunk cap instead of striking the
+  breaker);
+* ``transient_n``     — countdown: the next N dispatches raise an
+  UNAVAILABLE-shaped error then the backend recovers (the flapping
+  tunnel the transient-retry rung absorbs).
 
 State (dispatch counter, RNG) lives in the shared ``FaultPlan``, not the
 verifier instance — new_batch_verifier constructs a fresh verifier per
@@ -46,6 +53,16 @@ class FaultInjected(RuntimeError):
     """An injected dispatch failure (distinguishable from real bugs)."""
 
 
+class TransientFault(FaultInjected):
+    """Injected transient device error — message is UNAVAILABLE-shaped so
+    supervisor.classify_device_error files it under the retry rung."""
+
+
+class ResourceExhaustedFault(FaultInjected):
+    """Injected device OOM — message is RESOURCE_EXHAUSTED-shaped so the
+    supervisor's ladder shrinks the chunk cap instead of striking."""
+
+
 class FaultPlan:
     """Shared, mutable schedule of injected faults. Thread-safe; one
     plan drives every FaultyBackend instance registered against it."""
@@ -58,6 +75,8 @@ class FaultPlan:
         corrupt_rate: float = 0.0,
         die_after: Optional[int] = None,
         jitter_ms: float = 0.0,
+        oom_rate: float = 0.0,
+        transient_n: int = 0,
         seed: int = 0,
     ):
         self.exception_rate = exception_rate
@@ -66,6 +85,10 @@ class FaultPlan:
         self.corrupt_rate = corrupt_rate
         self.die_after = die_after
         self.jitter_ms = jitter_ms
+        self.oom_rate = oom_rate
+        # countdown: the next N dispatches fail transiently, then the
+        # backend recovers on its own (re-armable mid-run by assignment)
+        self.transient_n = transient_n
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self.dispatches = 0  # total dispatches seen (incl. faulted ones)
@@ -75,7 +98,8 @@ class FaultPlan:
         """Env-driven plan so the chaos soak (and a faulty node) can be
         configured without code: CBFT_FAULT_EXC_RATE, CBFT_FAULT_HANG_RATE,
         CBFT_FAULT_HANG_S, CBFT_FAULT_CORRUPT_RATE, CBFT_FAULT_DIE_AFTER,
-        CBFT_FAULT_JITTER_MS, CBFT_FAULT_SEED."""
+        CBFT_FAULT_JITTER_MS, CBFT_FAULT_OOM_RATE, CBFT_FAULT_TRANSIENT_N,
+        CBFT_FAULT_SEED."""
         e = os.environ
         die = e.get("CBFT_FAULT_DIE_AFTER")
         return cls(
@@ -85,6 +109,8 @@ class FaultPlan:
             corrupt_rate=float(e.get("CBFT_FAULT_CORRUPT_RATE", "0")),
             die_after=int(die) if die is not None else None,
             jitter_ms=float(e.get("CBFT_FAULT_JITTER_MS", "0")),
+            oom_rate=float(e.get("CBFT_FAULT_OOM_RATE", "0")),
+            transient_n=int(e.get("CBFT_FAULT_TRANSIENT_N", "0")),
             seed=int(e.get("CBFT_FAULT_SEED", "0")),
         )
 
@@ -96,11 +122,13 @@ class FaultPlan:
         self.corrupt_rate = 0.0
         self.die_after = None
         self.jitter_ms = 0.0
+        self.oom_rate = 0.0
+        self.transient_n = 0
 
-    def _decide(self) -> Tuple[int, bool, bool, bool, float]:
-        """→ (dispatch_no, raise?, hang?, corrupt?, jitter_s) for one
-        dispatch, under the lock so concurrent dispatches draw distinct
-        RNG samples and the counter is exact."""
+    def _decide(self) -> Tuple[int, bool, bool, bool, float, bool, bool]:
+        """→ (dispatch_no, raise?, hang?, corrupt?, jitter_s, transient?,
+        oom?) for one dispatch, under the lock so concurrent dispatches
+        draw distinct RNG samples and the counters are exact."""
         with self._lock:
             self.dispatches += 1
             no = self.dispatches
@@ -112,7 +140,12 @@ class FaultPlan:
                 self._rng.random() * self.jitter_ms / 1e3
                 if self.jitter_ms > 0 else 0.0
             )
-        return no, raise_, hang, corrupt, jitter_s
+            transient = False
+            if self.transient_n > 0:
+                self.transient_n -= 1
+                transient = True
+            oom = self._rng.random() < self.oom_rate
+        return no, raise_, hang, corrupt, jitter_s, transient, oom
 
 
 class FaultyBackend(BatchVerifier):
@@ -132,11 +165,25 @@ class FaultyBackend(BatchVerifier):
 
     def verify(self) -> Tuple[bool, List[bool]]:
         n, self._n = self._n, 0
-        no, raise_, hang, corrupt, jitter_s = self._plan._decide()
+        no, raise_, hang, corrupt, jitter_s, transient, oom = (
+            self._plan._decide()
+        )
         if jitter_s:
             time.sleep(jitter_s)
         if hang:
             _interruptible_hang(self._plan.hang_s)
+        if transient:
+            self._inner.verify()  # drop the held items like a real death
+            raise TransientFault(
+                f"UNAVAILABLE: injected transient tunnel flap "
+                f"(dispatch #{no}, {n} items)"
+            )
+        if oom:
+            self._inner.verify()
+            raise ResourceExhaustedFault(
+                f"RESOURCE_EXHAUSTED: injected HBM allocation failure "
+                f"(dispatch #{no}, {n} items)"
+            )
         if raise_:
             self._inner.verify()  # drop the held items like a real death
             raise FaultInjected(
@@ -234,7 +281,8 @@ def run_chaos_soak(
     keys = [
         ed.gen_priv_key_from_secret(b"chaos-%d" % i) for i in range(32)
     ]
-    regimes = ("none", "exceptions", "hangs", "corruption", "dead", "jitter")
+    regimes = ("none", "exceptions", "hangs", "corruption", "dead",
+               "jitter", "oom", "transient")
     wrong = lost = 0
     regime_counts = {r: 0 for r in regimes}
 
@@ -262,6 +310,10 @@ def run_chaos_soak(
             plan.die_after = 0
         elif r == "jitter":
             plan.jitter_ms = 5.0
+        elif r == "oom":
+            plan.oom_rate = 0.5
+        elif r == "transient":
+            plan.transient_n = 3
 
     try:
         for h in range(n_blocks):
@@ -340,4 +392,195 @@ def run_chaos_soak(
         "readmitted": readmitted,
         "device_resumed_after_recovery": device_resumed,
         "final_state": sup.state(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# chaos smoke: deterministic walk of every degradation-ladder rung
+# ---------------------------------------------------------------------------
+
+
+def _metric_total(counter) -> float:
+    """Sum a (possibly labeled) counter across its whole series."""
+    return sum(c.value() for c in counter._series())
+
+
+def run_chaos_smoke(
+    seed: int = 7,
+    inner: cryptobatch.Backend = "cpu",
+    logger=None,
+) -> dict:
+    """Walk every rung of the degradation ladder exactly once, fast and
+    deterministically (seeded faults, no sleep over 50 ms): transient
+    retry, OOM chunk-shrink + hysteretic recovery, hedged verification,
+    failed-batch triage with per-request attribution, and the breaker
+    trip/probe/re-admit cycle. Ground-truth verdict equality is checked
+    at every step. Returns a summary dict; callers (the tier-1 smoke
+    test, tools/chaos.py) assert on it."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec, CPUBatchVerifier
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+    from cometbft_tpu.crypto.supervisor import (
+        BROKEN,
+        HEALTHY,
+        BackendSupervisor,
+    )
+    from cometbft_tpu.crypto.tpu import mesh
+
+    name = f"chaos-smoke-{seed}"
+    plan = install(name=name, inner=inner, plan=FaultPlan(seed=seed))
+    sup = BackendSupervisor(
+        spec=BackendSpec(name),
+        dispatch_timeout_ms=2000,
+        breaker_threshold=3,
+        audit_pct=100,
+        audit_sync=True,  # no wrong verdict may ever be released
+        probe_base_ms=10,
+        probe_max_ms=80,
+        hedge_pct=200,
+        retry_ms=5,
+        chunk_recover_n=2,
+        logger=logger,
+    )
+    sched = VerifyScheduler(
+        spec=BackendSpec(name), flush_us=1000, supervisor=sup,
+        logger=logger,
+    )
+    sched.start()
+
+    keys = [
+        ed.gen_priv_key_from_secret(b"chaos-smoke-%d" % i) for i in range(8)
+    ]
+
+    def make_items(count, tag, poison_at=None):
+        items, truth = [], []
+        for i in range(count):
+            k = keys[i % len(keys)]
+            msg = b"smoke %s %d" % (tag, i)
+            good = i != poison_at
+            items.append((k.pub_key(), msg,
+                          k.sign(msg) if good else b"\x13" * 64))
+            truth.append(good)
+        return items, truth
+
+    wrong = 0
+    m = sup.metrics
+    mesh.reset_chunk_shrink()
+    try:
+        # rung 1 — transient retry: one UNAVAILABLE flap is absorbed by
+        # a single jittered retry; no breaker strike, no CPU fallback
+        plan.transient_n = 1
+        items, truth = make_items(16, b"transient")
+        if sup.verify_items(items, reason="smoke-transient") != truth:
+            wrong += 1
+        retried = _metric_total(m.retries)
+        state_after_transient = sup.state()
+
+        # rung 2 — OOM shrink + hysteretic recovery: RESOURCE_EXHAUSTED
+        # halves the chunk cap per retry down to the floor (then the CPU
+        # ground truth serves the batch); clean dispatches after repair
+        # recover the cap one doubling per chunk_recover_n
+        plan.clear()
+        plan.oom_rate = 1.0
+        items, truth = make_items(16, b"oom")
+        if sup.verify_items(items, reason="smoke-oom") != truth:
+            wrong += 1
+        shrinks = m.chunk_shrinks.value()
+        shrink_levels_peak = mesh.chunk_shrink_levels()
+        plan.clear()
+        items, truth = make_items(16, b"recover")
+        for _ in range(2 * sup.chunk_recover_n):
+            if sup.verify_items(items, reason="smoke-recover") != truth:
+                wrong += 1
+        recoveries = m.chunk_recoveries.value()
+
+        # rung 3 — hedged verification: prime the latency model so a
+        # 40 ms injected stall overruns predicted p99 × hedge_pct and
+        # races the CPU; either side may win, verdicts must agree
+        items, truth = make_items(16, b"hedge")
+        for _ in range(5):
+            sup.latency_model.observe(len(items), 0.002)
+        plan.hang_rate = 1.0
+        plan.hang_s = 0.04  # 40 ms — inside the smoke's 50 ms sleep cap
+        if sup.verify_items(items, reason="smoke-hedge") != truth:
+            wrong += 1
+        plan.clear()
+        plan.hang_rate = 0.0
+        hedge_fires = m.hedge_fires.value()
+        hedge_wins = _metric_total(m.hedge_wins)
+
+        # rung 4 — failed-batch triage: three coalesced requests, one
+        # poisoned; the offender is localized and attributed to its
+        # subsystem, the clean requests complete all_ok, no trip
+        trips_before_triage = _metric_total(m.trips)
+        good_a, truth_a = make_items(8, b"triage-a")
+        bad_b, truth_b = make_items(8, b"triage-b", poison_at=3)
+        good_c, truth_c = make_items(8, b"triage-c")
+        futs = [
+            sched.submit(good_a, subsystem="consensus", height=11),
+            sched.submit(bad_b, subsystem="blocksync", height=12),
+            sched.submit(good_c, subsystem="evidence", height=13),
+        ]
+        sched.flush()
+        res = [f.result(timeout=30) for f in futs]
+        for (ok, mask), truth in zip(res, (truth_a, truth_b, truth_c)):
+            if mask != truth:
+                wrong += 1
+        triage_clean_futures_ok = res[0][0] and res[2][0] and not res[1][0]
+        triage_runs = m.triage_runs.value()
+        triage_passes = m.triage_passes.value()
+        offender_by_subsystem = {
+            c._labels["subsystem"]: c.value()
+            for c in m.triage_offenders._series()
+            if "subsystem" in c._labels
+        }
+        triage_tripped = _metric_total(m.trips) > trips_before_triage
+
+        # rung 5 — breaker: persistent failures strike it open, repair +
+        # canary probe re-admits
+        plan.die_after = 0
+        items, truth = make_items(16, b"dead")
+        for _ in range(sup.breaker_threshold):
+            if sup.verify_items(items, reason="smoke-dead") != truth:
+                wrong += 1
+        state_broken = sup.state()
+        plan.clear()
+        probe_ok = sup.probe_now()
+        state_final = sup.state()
+    finally:
+        sched.stop()
+        sup.stop()
+        mesh.reset_chunk_shrink()
+
+    # the oracle agrees with itself: pure sanity, mirrors the soak
+    bv = CPUBatchVerifier()
+    for pk, msg, sig in items:
+        bv.add(pk, msg, sig)
+    _, oracle = bv.verify()
+    assert oracle == truth
+
+    return {
+        "wrong_verdicts": wrong,
+        "retries": retried,
+        "state_after_transient": state_after_transient,
+        "chunk_shrinks": shrinks,
+        "shrink_levels_peak": shrink_levels_peak,
+        "chunk_recoveries": recoveries,
+        "hedge_fires": hedge_fires,
+        "hedge_wins": hedge_wins,
+        "hedge_divergence": m.hedge_divergence.value(),
+        "triage_runs": triage_runs,
+        "triage_passes": triage_passes,
+        "triage_offenders": offender_by_subsystem,
+        "triage_clean_futures_ok": triage_clean_futures_ok,
+        "triage_tripped_breaker": triage_tripped,
+        "triage_divergence": m.triage_divergence.value(),
+        "state_broken": state_broken,
+        "probe_ok": probe_ok,
+        "state_final": state_final,
+        "expected": {
+            "state_broken": BROKEN,
+            "state_final": HEALTHY,
+        },
+        "backend_dispatches": plan.dispatches,
     }

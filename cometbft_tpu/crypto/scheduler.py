@@ -207,15 +207,27 @@ class VerifyFuture:
 
 
 class _Request:
-    __slots__ = ("items", "future", "t_submit", "span")
+    __slots__ = ("items", "future", "t_submit", "span", "subsystem",
+                 "height")
 
-    def __init__(self, items: List[Item], span=tracelib.NOOP_SPAN):
+    def __init__(
+        self,
+        items: List[Item],
+        span=tracelib.NOOP_SPAN,
+        subsystem: Optional[str] = None,
+        height: Optional[int] = None,
+    ):
         self.items = items
         self.future = VerifyFuture()
         self.t_submit = time.monotonic()
         # request-level trace span (libs/trace.py); the shared no-op when
         # tracing is off or the request wasn't sampled
         self.span = span
+        # who asked, for which block — carried through the coalesced
+        # dispatch so supervisor triage can attribute a bad signature to
+        # the request that submitted it
+        self.subsystem = subsystem
+        self.height = height
 
 
 class VerifyScheduler(BaseService):
@@ -363,8 +375,10 @@ class VerifyScheduler(BaseService):
         MAY block (bounded by CBFT_SUBMIT_TIMEOUT_MS) for queue room when
         [crypto] max_queue pending signatures are already waiting.
 
-        ``subsystem``/``height`` are trace tags only (who asked, for which
-        block) — they never affect routing or verdicts."""
+        ``subsystem``/``height`` never affect routing or verdicts — they
+        tag the request's trace span and, when the supervisor triages a
+        mixed-verdict batch, attribute offending signatures back to the
+        submitting subsystem/block in metrics and logs."""
         triples = [(pk, bytes(m), bytes(s)) for pk, m, s in items]
         span = self._tracer.start_span("request", n_sigs=len(triples))
         if not span.noop:
@@ -372,7 +386,7 @@ class VerifyScheduler(BaseService):
                 span.set_tag("subsystem", subsystem)
             if height is not None:
                 span.set_tag("height", int(height))
-        req = _Request(triples, span)
+        req = _Request(triples, span, subsystem, height)
         self.metrics.requests.add()
         self.metrics.signatures.add(len(req.items))
         if not req.items:
@@ -523,9 +537,14 @@ class VerifyScheduler(BaseService):
             for req in batch:
                 if req.span is not parent and not req.span.noop:
                     req.span.set_tag("dispatch_span", did)
+        # demux shape for supervisor triage attribution: one
+        # (n_items, subsystem, height) per coalesced request, item order
+        origins = [
+            (len(req.items), req.subsystem, req.height) for req in batch
+        ]
         try:
             with tracelib.use(dspan):
-                mask = self._verify(items, reason)
+                mask = self._verify(items, reason, origins)
         except BaseException as exc:
             dspan.end(error=repr(exc))
             raise
@@ -537,12 +556,21 @@ class VerifyScheduler(BaseService):
             req.future._set((all(sub), sub))
             req.span.end(ok=all(sub))
 
-    def _verify(self, items: List[Item], reason: str) -> List[bool]:
+    def _verify(
+        self,
+        items: List[Item],
+        reason: str,
+        origins: Optional[List[Tuple[int, Optional[str], Optional[int]]]]
+        = None,
+    ) -> List[bool]:
         if self._supervisor is not None:
-            # supervised path: watchdog, circuit breaker, and corruption
-            # audit live in crypto/supervisor.py — it never raises for a
-            # device failure (CPU re-verify is built in)
-            return self._supervisor.verify_items(items, reason=reason)
+            # supervised path: watchdog, circuit breaker, retry/hedge
+            # ladder, and corruption audit live in crypto/supervisor.py —
+            # it never raises for a device failure (CPU re-verify is
+            # built in); origins let its triage attribute bad signatures
+            return self._supervisor.verify_items(
+                items, reason=reason, origins=origins
+            )
         try:
             bv = new_batch_verifier(self.spec)
             for pk, m, s in items:

@@ -37,19 +37,54 @@ The supervisor wraps ANY crypto Backend (crypto/batch.py) and adds:
   CPU confirmation (the chaos soak's no-wrong-verdict-ever mode); the
   default background mode bounds exposure to the sampling window instead.
 
+Between "healthy" and "broken" sits the **adaptive degradation ladder**
+(retry → hedge → chunk-shrink → breaker → CPU), the graceful-degradation
+shapes that bound tail latency in inference-serving stacks applied to
+the verify plane:
+
+* **transient retry** — device exceptions are classified
+  (``classify_device_error``): a transient XLA/tunnel error is retried
+  once with jittered backoff (``[crypto] retry_ms`` / ``CBFT_RETRY_MS``)
+  before any breaker strike; a RESOURCE_EXHAUSTED halves the effective
+  dispatch chunk cap (mesh.shrink_chunk_cap) and retries at the smaller
+  size, and the cap recovers one doubling per ``[crypto]
+  chunk_recover_n`` clean dispatches (hysteresis); only persistent
+  errors strike the breaker.
+
+* **hedged verification** — an EWMA latency model per batch-size bucket
+  (fed by the same timings the device trace spans record) predicts each
+  dispatch's p99. When a dispatch overruns ``predicted p99 ×
+  [crypto] hedge_pct / 100`` (``CBFT_HEDGE_PCT``; 0 disables), the CPU
+  verifier launches IN PARALLEL and the first finisher wins (same mask
+  semantics); the loser is audited for divergence when it completes. The
+  fixed dispatch_timeout_ms becomes the last-resort bound instead of the
+  common-case tail.
+
+* **failed-batch triage** — a mixed verdict mask is never taken at lane
+  granularity on faith: the suspect (claimed-bad) lanes are re-verified
+  on device by segment bisection (≤ ⌈log₂ n⌉ + 1 device passes,
+  aggregate per segment — an all-clean re-check clears a segment, a
+  failing one splits), and the surviving convictions are confirmed on
+  the CPU ground truth (k lanes, not the whole batch). A conviction the
+  CPU overturns is corruption: it counts as an audit mismatch and trips
+  the breaker. Offenders are attributed to the submitting subsystem /
+  block height via the scheduler's demux (``origins``).
+
 Everything the supervisor decides is observable as ``verify_supervisor_*``
 metrics: a state gauge, breaker trips, canary probes, audits, audit
-mismatches, and watchdog kills.
+mismatches, watchdog kills, retries by class, hedge fires/wins/
+divergence, the effective chunk cap, and triage runs/passes/offenders.
 """
 
 from __future__ import annotations
 
 import collections
+import math
 import os
 import random
 import threading
 import time
-from typing import Deque, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from cometbft_tpu.crypto import PubKey
 from cometbft_tpu.crypto.batch import (
@@ -76,13 +111,144 @@ DEFAULT_BREAKER_THRESHOLD = 3
 DEFAULT_AUDIT_PCT = 5
 DEFAULT_PROBE_BASE_MS = 1_000
 DEFAULT_PROBE_MAX_MS = 60_000
+DEFAULT_HEDGE_PCT = 200
+DEFAULT_RETRY_MS = 25
+DEFAULT_CHUNK_RECOVER_N = 32
 _AUDIT_QUEUE_CAP = 64  # batches; beyond this, drop-and-count (see audit_drops)
 
 Item = Tuple[PubKey, bytes, bytes]
 
+# origin of one coalesced sub-request: (n_items, subsystem, height) —
+# the scheduler's demux passes these so triage can attribute offending
+# signatures to the subsystem/block that submitted them
+Origin = Tuple[int, Optional[str], Optional[int]]
+
 
 class WatchdogTimeout(RuntimeError):
     """A device dispatch exceeded dispatch_timeout_ms and was abandoned."""
+
+
+# --- device-error classification --------------------------------------------
+# The retry ladder needs to tell a flapping tunnel from an exhausted HBM
+# from a genuinely broken plane. XLA/jax surface these as RuntimeErrors
+# whose text carries the gRPC-style status; mesh.dispatch_batch wraps
+# them with chunk context but chains the original, so classification
+# scans the whole __cause__/__context__ chain.
+
+TRANSIENT = "transient"
+OOM = "oom"
+PERSISTENT = "persistent"
+
+_OOM_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "hbm",
+    "allocation failure",
+    "oom ",  # "oom killed", "oom while allocating" — NOT bare "oom",
+    # which substring-matches innocents like "boom"/"zoomed"
+)
+_TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "aborted",
+    "cancelled by runtime",
+    "connection reset",
+    "broken pipe",
+    "socket closed",
+    "tunnel",
+    "transient",
+    "temporarily",
+    "try again",
+)
+
+
+def classify_device_error(exc: BaseException) -> str:
+    """→ "oom" | "transient" | "persistent" for a device-plane exception
+    (OOM checked first: a RESOURCE_EXHAUSTED often also mentions retry)."""
+    texts = []
+    seen = set()
+    cur: Optional[BaseException] = exc
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        texts.append(f"{type(cur).__name__}: {cur}".lower())
+        cur = cur.__cause__ or cur.__context__
+    blob = " | ".join(texts)
+    if any(m in blob for m in _OOM_MARKERS):
+        return OOM
+    if any(m in blob for m in _TRANSIENT_MARKERS):
+        return TRANSIENT
+    return PERSISTENT
+
+
+class LatencyModel:
+    """EWMA latency + mean-absolute-deviation per power-of-two batch-size
+    bucket, fed from the supervised device dispatch timings (the same
+    wall-clock the ``device`` trace spans record). ``predict_p99``
+    approximates the tail as mean + 4·deviation — cheap, monotone in
+    both, and good enough to decide "this dispatch is already an
+    outlier, hedge it"."""
+
+    ALPHA = 0.2
+    MIN_SAMPLES = 3
+
+    def __init__(self):
+        self._mtx = threading.Lock()
+        # bucket (bit_length of n) -> [n_samples, ewma_mean_s, ewma_dev_s]
+        self._buckets: Dict[int, List[float]] = {}
+
+    @staticmethod
+    def _bucket(n_sigs: int) -> int:
+        return max(1, int(n_sigs)).bit_length()
+
+    def observe(self, n_sigs: int, seconds: float) -> None:
+        with self._mtx:
+            b = self._buckets.setdefault(self._bucket(n_sigs), [0, 0.0, 0.0])
+            b[0] += 1
+            if b[0] == 1:
+                b[1] = seconds
+                return
+            err = seconds - b[1]
+            b[1] += self.ALPHA * err
+            b[2] += self.ALPHA * (abs(err) - b[2])
+
+    def predict_p99(self, n_sigs: int) -> Optional[float]:
+        """Predicted tail latency for a batch of ``n_sigs``, or None
+        while the bucket (or any neighbor) is cold."""
+        want = self._bucket(n_sigs)
+        with self._mtx:
+            warm = {
+                k: v for k, v in self._buckets.items()
+                if v[0] >= self.MIN_SAMPLES
+            }
+            if not warm:
+                return None
+            # exact bucket, else the nearest warm one (a 2x-off bucket
+            # still beats no prediction — the hedge threshold is a
+            # multiplier away anyway)
+            key = want if want in warm else min(
+                warm, key=lambda k: abs(k - want)
+            )
+            n, mean, dev = warm[key]
+            return mean + 4.0 * dev
+
+
+class _DeviceCall:
+    """Handle for one in-flight watchdog-abandonable device dispatch:
+    the worker signals ``done`` after writing ``box["mask"]`` or
+    ``box["exc"]``; the owner may set ``cancel`` to abandon it at the
+    next chunk boundary."""
+
+    __slots__ = ("done", "cancel", "box", "span", "t0", "n")
+
+    def __init__(self) -> None:
+        self.done = threading.Event()
+        self.cancel = threading.Event()
+        self.box: dict = {}
+        self.span = None
+        self.t0 = 0.0
+        self.n = 0
 
 
 def _knob(env: str, config_value: Optional[int], default: int) -> int:
@@ -108,6 +274,19 @@ def breaker_threshold_default(config_value: Optional[int] = None) -> int:
 
 def audit_pct_default(config_value: Optional[int] = None) -> int:
     return _knob("CBFT_AUDIT_PCT", config_value, DEFAULT_AUDIT_PCT)
+
+
+def hedge_pct_default(config_value: Optional[int] = None) -> int:
+    return _knob("CBFT_HEDGE_PCT", config_value, DEFAULT_HEDGE_PCT)
+
+
+def retry_ms_default(config_value: Optional[int] = None) -> int:
+    return _knob("CBFT_RETRY_MS", config_value, DEFAULT_RETRY_MS)
+
+
+def chunk_recover_n_default(config_value: Optional[int] = None) -> int:
+    return _knob("CBFT_CHUNK_RECOVER_N", config_value,
+                 DEFAULT_CHUNK_RECOVER_N)
 
 
 class Metrics:
@@ -159,6 +338,64 @@ class Metrics:
             SUBSYSTEM, "cpu_routed",
             "Batches routed straight to CPU because the breaker was open.",
         )
+        # -- degradation-ladder rungs (retry → hedge → shrink → triage) --
+        self.retries = r.counter(
+            SUBSYSTEM, "retries",
+            "Device dispatch retries before any breaker strike, by error "
+            "class (transient|oom).",
+        )
+        self.hedge_fires = r.counter(
+            SUBSYSTEM, "hedge_fires",
+            "Dispatches that overran their predicted-latency hedge "
+            "threshold and launched the parallel CPU verifier.",
+        )
+        self.hedge_wins = r.counter(
+            SUBSYSTEM, "hedge_wins",
+            "Hedged dispatches by winner (cpu|device) — first finisher's "
+            "verdicts are released.",
+        )
+        self.hedge_divergence = r.counter(
+            SUBSYSTEM, "hedge_divergence",
+            "Hedged dispatches whose loser disagreed with the released "
+            "verdicts once it completed (each one trips the breaker).",
+        )
+        self.chunk_cap = r.gauge(
+            SUBSYSTEM, "chunk_cap",
+            "Effective device dispatch chunk cap after OOM-adaptive "
+            "shrinking (mesh.chunk_cap).",
+        )
+        self.chunk_shrinks = r.counter(
+            SUBSYSTEM, "chunk_shrinks",
+            "Chunk-cap halvings after a RESOURCE_EXHAUSTED dispatch.",
+        )
+        self.chunk_recoveries = r.counter(
+            SUBSYSTEM, "chunk_recoveries",
+            "Chunk-cap doublings recovered after chunk_recover_n "
+            "consecutive clean dispatches.",
+        )
+        self.triage_runs = r.counter(
+            SUBSYSTEM, "triage_runs",
+            "Mixed-verdict batches localized by device bisection instead "
+            "of a wholesale CPU re-verify.",
+        )
+        self.triage_passes = r.counter(
+            SUBSYSTEM, "triage_passes",
+            "Device bisection passes across all triage runs.",
+        )
+        self.triage_offenders = r.counter(
+            SUBSYSTEM, "triage_offenders",
+            "Bad signatures localized by triage, by submitting subsystem.",
+        )
+        self.triage_divergence = r.counter(
+            SUBSYSTEM, "triage_divergence",
+            "Triage convictions the CPU ground truth overturned (device "
+            "called a good signature bad — corruption; trips the breaker).",
+        )
+        self.triage_cpu_fallbacks = r.counter(
+            SUBSYSTEM, "triage_cpu_fallbacks",
+            "Triage runs whose device passes failed and fell back to CPU "
+            "verification of the remaining suspect lanes.",
+        )
 
     @classmethod
     def nop(cls) -> "Metrics":
@@ -186,6 +423,9 @@ class BackendSupervisor:
         audit_sync: Optional[bool] = None,
         probe_base_ms: Optional[int] = None,
         probe_max_ms: Optional[int] = None,
+        hedge_pct: Optional[int] = None,
+        retry_ms: Optional[int] = None,
+        chunk_recover_n: Optional[int] = None,
         metrics: Optional[Metrics] = None,
         logger: Optional[Logger] = None,
         tracer: Optional[tracelib.Tracer] = None,
@@ -208,6 +448,10 @@ class BackendSupervisor:
         self._probe_max_s = _knob(
             "CBFT_PROBE_MAX_MS", probe_max_ms, DEFAULT_PROBE_MAX_MS
         ) / 1e3
+        self._hedge_pct = max(0, hedge_pct_default(hedge_pct))
+        self._retry_s = max(1, retry_ms_default(retry_ms)) / 1e3
+        self._chunk_recover_n = max(1, chunk_recover_n_default(chunk_recover_n))
+        self.latency_model = LatencyModel()
         self.metrics = metrics if metrics is not None else Metrics.nop()
         self.logger = logger or new_nop_logger()
         self._tracer = tracer if tracer is not None else tracelib.default_tracer()
@@ -226,8 +470,13 @@ class BackendSupervisor:
         )
         self._audit_worker: Optional[threading.Thread] = None
         self._stopped = False
+        # in-flight background probe/canary threads, joined by stop() so
+        # a daemon probe can never touch a torn-down backend at shutdown
+        self._bg_threads: List[threading.Thread] = []
 
         self._canary: Optional[List[Item]] = None
+        if self.spec.name != "cpu":
+            self._update_chunk_cap_gauge()
 
     # -- knob introspection --------------------------------------------------
 
@@ -243,6 +492,18 @@ class BackendSupervisor:
     def audit_pct(self) -> int:
         return self._audit_pct
 
+    @property
+    def hedge_pct(self) -> int:
+        return self._hedge_pct
+
+    @property
+    def retry_ms(self) -> int:
+        return int(self._retry_s * 1e3)
+
+    @property
+    def chunk_recover_n(self) -> int:
+        return self._chunk_recover_n
+
     def state(self) -> str:
         with self._lock:
             return self._state
@@ -250,12 +511,20 @@ class BackendSupervisor:
     # -- the supervised verify entry -----------------------------------------
 
     def verify_items(
-        self, items: List[Item], reason: str = "direct"
+        self,
+        items: List[Item],
+        reason: str = "direct",
+        origins: Optional[Sequence[Origin]] = None,
     ) -> List[bool]:
         """Verify ``items`` through the supervised backend, falling back
         to the CPU ground truth on any failure. Always returns a full
         mask; never raises for device-plane reasons; bounded in time by
-        dispatch_timeout_ms + the CPU verify."""
+        dispatch_timeout_ms + the CPU verify.
+
+        ``origins`` (optional) is the scheduler's demux shape — one
+        ``(n_items, subsystem, height)`` per coalesced request, in item
+        order — used only to attribute triaged bad signatures to the
+        subsystem/block that submitted them (metrics + logs)."""
         if not items:
             return []
         if self.spec.name == "cpu":
@@ -275,7 +544,7 @@ class BackendSupervisor:
                 span.end(outcome="cpu_routed")
                 return mask
             try:
-                mask = self._device_verify(items)
+                mask, source = self._dispatch_adaptive(items, reason)
             except WatchdogTimeout as exc:
                 self.metrics.watchdog_kills.add()
                 self._trip(
@@ -289,7 +558,19 @@ class BackendSupervisor:
                 mask = self._cpu_verify(items)
                 span.end(outcome="failure_cpu")
                 return mask
+            if source != "device":
+                # the CPU hedge won the race: its verdicts ARE the ground
+                # truth — nothing to audit or triage, and the device's
+                # health is judged by the loser-audit in the hedge path,
+                # not by this batch's success
+                span.end(outcome="hedge_cpu")
+                return mask
             self._note_success()
+            self._note_clean_dispatch()
+            if not all(mask):
+                # a mixed verdict is never released at lane granularity
+                # on device faith alone — localize and confirm
+                mask = self._triage(items, mask, reason, origins)
             if self._audit_pct > 0 and self._should_audit():
                 if self._audit_sync:
                     asp = tracelib.child_of_current(
@@ -308,13 +589,192 @@ class BackendSupervisor:
             span.end(outcome="device_ok")
             return mask
 
+    # -- internals: the retry/hedge rungs of the ladder ----------------------
+
+    def _dispatch_adaptive(self, items: List[Item], reason: str):
+        """Retry rungs: classify device errors, retry a transient once
+        with jittered backoff, halve the chunk cap and retry on OOM, and
+        hand everything else up for a breaker strike. → (mask, source)
+        where source is "device" or "hedge_cpu"."""
+        transient_retries = 0
+        while True:
+            try:
+                return self._device_verify_hedged(items, reason)
+            except WatchdogTimeout:
+                raise  # the last-resort rung; never retried
+            except Exception as exc:  # noqa: BLE001 - classify + retry
+                cls = classify_device_error(exc)
+                if cls == OOM:
+                    from cometbft_tpu.crypto.tpu import mesh
+
+                    if mesh.shrink_chunk_cap():
+                        self.metrics.retries.with_labels(cls=OOM).add()
+                        self.metrics.chunk_shrinks.add()
+                        self._update_chunk_cap_gauge()
+                        self.logger.error(
+                            "device OOM; chunk cap halved, retrying",
+                            err=repr(exc), n=len(items),
+                            shrink_levels=mesh.chunk_shrink_levels(),
+                        )
+                        with tracelib.use(tracelib.child_of_current(
+                            "retry", cls=OOM,
+                            shrink_levels=mesh.chunk_shrink_levels(),
+                        )):
+                            continue
+                    # already at the floor: the device is out of memory
+                    # even at the smallest chunk — treat as persistent
+                    raise
+                if cls == TRANSIENT and transient_retries < 1:
+                    transient_retries += 1
+                    self.metrics.retries.with_labels(cls=TRANSIENT).add()
+                    with self._lock:
+                        jitter = self._rng.random()
+                    delay = self._retry_s * (0.5 + jitter)
+                    self.logger.info(
+                        "transient device error; retrying once",
+                        err=repr(exc), n=len(items),
+                        backoff_ms=round(delay * 1e3, 1),
+                    )
+                    with tracelib.use(tracelib.child_of_current(
+                        "retry", cls=TRANSIENT,
+                        backoff_ms=round(delay * 1e3, 1),
+                    )):
+                        time.sleep(delay)
+                    continue
+                raise
+
+    def _device_verify_hedged(self, items: List[Item], reason: str):
+        """Watchdogged device dispatch with predictive CPU hedging.
+        While the latency model is cold (or ``hedge_pct`` is 0) this is
+        exactly the plain watchdogged dispatch. Once warm, a dispatch
+        overrunning predicted-p99 × hedge_pct/100 races a parallel CPU
+        verify and the first usable mask wins; the loser is audited for
+        divergence when it completes. → (mask, source)."""
+        pred = (
+            self.latency_model.predict_p99(len(items))
+            if self._hedge_pct > 0 else None
+        )
+        h = self._start_device(items)
+        deadline = h.t0 + self._timeout_s
+        hedge_at = (
+            h.t0 + pred * self._hedge_pct / 100.0
+            if pred is not None else None
+        )
+        if hedge_at is None or hedge_at >= deadline:
+            # cold model / hedge beyond the watchdog: plain path
+            if not h.done.wait(self._timeout_s):
+                h.cancel.set()
+                h.span.end(outcome="watchdog_timeout")
+                raise WatchdogTimeout(
+                    f"device dispatch of {len(items)} items exceeded "
+                    f"{self.dispatch_timeout_ms}ms; abandoned"
+                )
+            return self._reap_device(h), "device"
+        if h.done.wait(max(0.0, hedge_at - time.monotonic())):
+            return self._reap_device(h), "device"
+
+        # hedge fires: race the CPU ground truth against the device
+        self.metrics.hedge_fires.add()
+        hspan = tracelib.child_of_current(
+            "hedge", n_sigs=len(items),
+            predicted_ms=round(pred * 1e3, 3),
+        )
+        cond = threading.Condition()
+        race: dict = {"winner": None}
+
+        def settle(side: str, kind: str, val) -> None:
+            with cond:
+                race[side] = (kind, val)
+                if race["winner"] is None and kind == "ok":
+                    race["winner"] = side
+                both = "cpu" in race and "device" in race
+                cond.notify_all()
+            if not both:
+                return
+            # exactly one settler sees both results present: the loser
+            # audit and any late-watchdog incident are handled here
+            dev, cpu = race["device"], race["cpu"]
+            if dev[0] == "timeout":
+                self.metrics.watchdog_kills.add()
+                self._trip(
+                    "watchdog",
+                    err="hedged device dispatch overran "
+                        "dispatch_timeout_ms",
+                    n=len(items), reason=reason,
+                )
+            elif dev[0] == "ok" and cpu[0] == "ok" and dev[1] != cpu[1]:
+                self.metrics.hedge_divergence.add()
+                self.logger.error(
+                    "hedge loser diverged from released verdicts",
+                    n=len(items), winner=race["winner"],
+                )
+                self._audit_mismatch(len(items))
+
+        def cpu_run() -> None:
+            try:
+                settle("cpu", "ok", self._cpu_verify(items))
+            except Exception as exc:  # noqa: BLE001
+                settle("cpu", "err", exc)
+
+        def dev_relay() -> None:
+            if not h.done.wait(max(0.0, deadline - time.monotonic())):
+                h.cancel.set()
+                h.span.end(outcome="watchdog_timeout")
+                settle("device", "timeout", None)
+                return
+            if "exc" in h.box:
+                h.span.end(error=repr(h.box["exc"]))
+                settle("device", "err", h.box["exc"])
+                return
+            self.latency_model.observe(
+                len(items), time.monotonic() - h.t0
+            )
+            h.span.end(outcome="ok")
+            settle("device", "ok", h.box["mask"])
+
+        threading.Thread(
+            target=cpu_run, daemon=True, name="supervisor-hedge-cpu"
+        ).start()
+        threading.Thread(
+            target=dev_relay, daemon=True, name="supervisor-hedge-relay"
+        ).start()
+        with cond:
+            while race["winner"] is None and not (
+                "cpu" in race and "device" in race
+            ):
+                cond.wait(0.05)
+            winner = race["winner"]
+        if winner is not None:
+            self.metrics.hedge_wins.with_labels(winner=winner).add()
+            hspan.end(winner=winner)
+            mask = race[winner][1]
+            return mask, ("device" if winner == "device" else "hedge_cpu")
+        # neither side produced a mask: surface the device's failure so
+        # the retry ladder can classify it (a CPU verifier error is a
+        # programming bug, not a device incident)
+        hspan.end(winner="none")
+        kind, val = race["device"]
+        if kind == "timeout":
+            raise RuntimeError(
+                f"hedged dispatch of {len(items)} items: device overran "
+                f"{self.dispatch_timeout_ms}ms and the CPU hedge failed: "
+                f"{race['cpu'][1]!r}"
+            )
+        raise val
+
     # -- canary probes -------------------------------------------------------
 
     def probe_now(self) -> bool:
         """One synchronous canary probe: dispatch a known-good signed
         batch through the supervised backend under the watchdog. Success
         closes the breaker; failure opens it (or extends the backoff).
-        Used by the node's warmup canary, tools/chaos.py, and tests."""
+        Used by the node's warmup canary, tools/chaos.py, and tests.
+
+        A no-op (returns False) once the supervisor is stopped: a probe
+        scheduled before shutdown must never touch a torn-down backend."""
+        with self._audit_cond:
+            if self._stopped:
+                return False
         items = self._canary_items()
         err = None
         try:
@@ -349,9 +809,7 @@ class BackendSupervisor:
     def warmup_canary(self) -> None:
         """Kick one background probe at node start so a wedged device
         plane trips the breaker before consensus traffic arrives."""
-        threading.Thread(
-            target=self.probe_now, daemon=True, name="supervisor-canary"
-        ).start()
+        self._spawn_bg(self.probe_now, "supervisor-canary")
 
     def _maybe_probe_async(self) -> None:
         now = time.monotonic()
@@ -371,16 +829,26 @@ class BackendSupervisor:
                 with self._lock:
                     self._probing = False
 
-        threading.Thread(
-            target=run, daemon=True, name="supervisor-probe"
-        ).start()
+        self._spawn_bg(run, "supervisor-probe")
+
+    def _spawn_bg(self, target, name: str) -> None:
+        """Start a background probe/canary thread, tracked so stop()
+        can join it (a daemon probe must never outlive the supervisor
+        and touch a torn-down backend)."""
+        t = threading.Thread(target=target, daemon=True, name=name)
+        with self._lock:
+            self._bg_threads = [
+                x for x in self._bg_threads if x.is_alive()
+            ]
+            self._bg_threads.append(t)
+        t.start()
 
     # -- lifecycle -----------------------------------------------------------
 
     def stop(self) -> None:
-        """Stop the background audit worker (idempotent). Any queued
-        audits are dropped — audits are advisory once the node is
-        shutting down."""
+        """Stop the background audit worker and join any in-flight
+        probe/canary threads (idempotent). Any queued audits are
+        dropped — audits are advisory once the node is shutting down."""
         with self._audit_cond:
             self._stopped = True
             self._audit_queue.clear()
@@ -388,33 +856,40 @@ class BackendSupervisor:
         w = self._audit_worker
         if w is not None and w is not threading.current_thread():
             w.join(timeout=5.0)
+        with self._lock:
+            bg = list(self._bg_threads)
+            self._bg_threads = []
+        me = threading.current_thread()
+        for t in bg:
+            if t is not me:
+                # bounded: an in-flight probe is itself bounded by the
+                # dispatch watchdog, so this join cannot hang shutdown
+                t.join(timeout=self._timeout_s + 5.0)
 
     # -- internals: dispatch -------------------------------------------------
 
-    def _device_verify(self, items: List[Item]) -> List[bool]:
-        """Run the wrapped backend under the dispatch watchdog. A call
-        that outlives dispatch_timeout_ms is abandoned: its thread keeps
-        the hardware handle (nothing can safely interrupt an XLA
-        dispatch) but exits at the next chunk boundary through the
-        cancel event, and the caller gets WatchdogTimeout."""
+    def _start_device(self, items: List[Item]) -> "_DeviceCall":
+        """Launch the wrapped backend on a watchdog-abandonable worker
+        thread and return immediately with the call handle. A call that
+        outlives its wait is abandoned: its thread keeps the hardware
+        handle (nothing can safely interrupt an XLA dispatch) but exits
+        at the next chunk boundary through the cancel event."""
         # import OUTSIDE the timed region so a cold jax import can never
         # eat the first dispatch's timeout budget
         from cometbft_tpu.crypto.tpu import mesh
 
         self.metrics.device_dispatches.add()
-        done = threading.Event()
-        cancel = threading.Event()
-        box: dict = {}
+        h = _DeviceCall()
         # span created on the CALLING thread (so it parents under the
         # supervise/dispatch span) and installed inside the worker so the
         # mesh chunk loop's spans nest under it across the thread hop
-        dev_span = tracelib.child_of_current(
+        h.span = tracelib.child_of_current(
             "device", n_sigs=len(items), backend=self.spec.name
         )
 
         def run():
             try:
-                with tracelib.use(dev_span), mesh.cancel_scope(cancel):
+                with tracelib.use(h.span), mesh.cancel_scope(h.cancel):
                     bv = new_batch_verifier(self.spec)
                     for pk, m, s in items:
                         bv.add(pk, m, s)
@@ -424,29 +899,213 @@ class BackendSupervisor:
                         f"backend returned {len(mask)} verdicts for "
                         f"{len(items)} items"
                     )
-                box["mask"] = mask
+                h.box["mask"] = mask
             except BaseException as exc:  # noqa: BLE001 - crosses threads
-                box["exc"] = exc
+                h.box["exc"] = exc
             finally:
-                done.set()
+                h.done.set()
 
-        t = threading.Thread(
+        h.n = len(items)
+        h.t0 = time.monotonic()
+        threading.Thread(
             target=run, daemon=True, name="supervised-dispatch"
-        )
-        t.start()
-        if not done.wait(self._timeout_s):
-            cancel.set()  # the zombie exits at its next chunk boundary
+        ).start()
+        return h
+
+    def _reap_device(self, h: "_DeviceCall") -> List[bool]:
+        """Collect a completed device call: re-raise its exception or
+        return its mask, feeding the latency model on success."""
+        if "exc" in h.box:
+            h.span.end(error=repr(h.box["exc"]))
+            raise h.box["exc"]
+        self.latency_model.observe(h.n, time.monotonic() - h.t0)
+        h.span.end(outcome="ok")
+        return h.box["mask"]
+
+    def _device_verify(self, items: List[Item]) -> List[bool]:
+        """Plain watchdogged device dispatch (no hedging): used by the
+        canary probe and the triage bisection passes."""
+        h = self._start_device(items)
+        if not h.done.wait(self._timeout_s):
+            h.cancel.set()  # the zombie exits at its next chunk boundary
             # span end is first-wins: the zombie's late spans are dropped
-            dev_span.end(outcome="watchdog_timeout")
+            h.span.end(outcome="watchdog_timeout")
             raise WatchdogTimeout(
                 f"device dispatch of {len(items)} items exceeded "
                 f"{self.dispatch_timeout_ms}ms; abandoned"
             )
-        if "exc" in box:
-            dev_span.end(error=repr(box["exc"]))
-            raise box["exc"]
-        dev_span.end(outcome="ok")
-        return box["mask"]
+        return self._reap_device(h)
+
+    # -- internals: failed-batch triage --------------------------------------
+
+    def _triage(
+        self,
+        items: List[Item],
+        claimed: List[bool],
+        reason: str,
+        origins: Optional[Sequence[Origin]],
+    ) -> List[bool]:
+        """Localize and confirm the claimed-bad lanes of a mixed-verdict
+        batch instead of trusting (or wholesale CPU-re-verifying) the
+        device's per-lane word. Suspects start as the maximal runs of
+        claimed-bad lanes; each pass coalesces every live segment into
+        ONE device dispatch, clears segments the device re-affirms
+        all-clean, bisects segments that still contain a failure, and
+        convicts the singletons that survive. Convictions are confirmed
+        against the CPU ground truth (k lanes, not the whole batch); a
+        CPU overturn is silent corruption and trips the breaker. Bounded
+        by ⌈log₂ n⌉ + 1 device passes; any device failure mid-triage
+        falls back to CPU-verifying the remaining suspects."""
+        n = len(items)
+        n_claimed = sum(1 for ok in claimed if not ok)
+        span = tracelib.child_of_current(
+            "triage", n_sigs=n, n_claimed=n_claimed
+        )
+        self.metrics.triage_runs.add()
+        mask = list(claimed)
+        max_passes = (max(1, math.ceil(math.log2(n))) + 1) if n > 1 else 1
+        segments: List[Tuple[int, int]] = []
+        i = 0
+        while i < n:
+            if not claimed[i]:
+                j = i
+                while j < n and not claimed[j]:
+                    j += 1
+                segments.append((i, j))
+                i = j
+            else:
+                i += 1
+        passes = 0
+        convicted: List[int] = []
+        fell_back = False
+        with tracelib.use(span):
+            while segments and passes < max_passes:
+                lanes = [k for s, e in segments for k in range(s, e)]
+                try:
+                    sub = self._device_verify([items[k] for k in lanes])
+                except WatchdogTimeout as exc:
+                    # a hang mid-triage is a real incident, not advisory
+                    self.metrics.watchdog_kills.add()
+                    self._trip(
+                        "watchdog", err=str(exc), n=len(lanes),
+                        reason=reason,
+                    )
+                    fell_back = True
+                    break
+                except Exception as exc:  # noqa: BLE001
+                    self.logger.error(
+                        "triage device pass failed; CPU-verifying "
+                        "remaining suspects",
+                        err=repr(exc), n=len(lanes),
+                    )
+                    fell_back = True
+                    break
+                passes += 1
+                self.metrics.triage_passes.add()
+                pos = 0
+                nxt: List[Tuple[int, int]] = []
+                for s, e in segments:
+                    seg = sub[pos:pos + (e - s)]
+                    pos += e - s
+                    if all(seg):
+                        # the device re-affirmed the whole segment clean:
+                        # clear it (same trust as any positive verdict —
+                        # the corruption audit covers positives)
+                        for k in range(s, e):
+                            mask[k] = True
+                        continue
+                    if e - s == 1:
+                        convicted.append(s)
+                        continue
+                    mid = (s + e) // 2
+                    nxt.append((s, mid))
+                    nxt.append((mid, e))
+                segments = nxt
+            if segments:
+                # pass cap hit or the device died: remaining suspects go
+                # straight to the ground truth
+                if not fell_back:
+                    self.logger.error(
+                        "triage pass cap hit; CPU-verifying remaining "
+                        "suspects",
+                        passes=passes, cap=max_passes,
+                    )
+                self.metrics.triage_cpu_fallbacks.add()
+                lanes = [k for s, e in segments for k in range(s, e)]
+                cpu = self._cpu_verify([items[k] for k in lanes])
+                for k, ok in zip(lanes, cpu):
+                    mask[k] = ok
+            overturned = 0
+            if convicted:
+                cpu = self._cpu_verify([items[k] for k in convicted])
+                for k, ok in zip(convicted, cpu):
+                    mask[k] = ok
+                    if ok:
+                        overturned += 1
+            if overturned:
+                # the device repeatedly convicted lanes the CPU accepts:
+                # that is silent corruption, the worst failure we guard
+                self.metrics.triage_divergence.add(overturned)
+                self.logger.error(
+                    "triage convictions overturned by CPU ground truth",
+                    n=overturned, reason=reason,
+                )
+                self._audit_mismatch(overturned)
+            offenders = sum(1 for ok in mask if not ok)
+            self._attribute_offenders(mask, origins, reason)
+        span.end(
+            passes=passes, offenders=offenders,
+            cleared=n_claimed - offenders, fell_back=fell_back,
+        )
+        return mask
+
+    def _attribute_offenders(
+        self,
+        mask: List[bool],
+        origins: Optional[Sequence[Origin]],
+        reason: str,
+    ) -> None:
+        """Charge each triaged bad signature to the request that
+        submitted it, using the scheduler's demux shape."""
+        if origins is None:
+            origins = [(len(mask), None, None)]
+        pos = 0
+        for count, subsystem, height in origins:
+            bad = sum(1 for ok in mask[pos:pos + count] if not ok)
+            pos += count
+            if not bad:
+                continue
+            label = subsystem or "direct"
+            self.metrics.triage_offenders.with_labels(
+                subsystem=label
+            ).add(bad)
+            self.logger.error(
+                "verify triage localized bad signatures",
+                n_bad=bad, subsystem=label, height=height, reason=reason,
+            )
+
+    # -- internals: adaptive chunk cap ---------------------------------------
+
+    def _note_clean_dispatch(self) -> None:
+        from cometbft_tpu.crypto.tpu import mesh
+
+        if mesh.note_clean_dispatch(self._chunk_recover_n):
+            self.metrics.chunk_recoveries.add()
+            self._update_chunk_cap_gauge()
+            self.logger.info(
+                "chunk cap recovered one doubling",
+                shrink_levels=mesh.chunk_shrink_levels(),
+            )
+
+    def _update_chunk_cap_gauge(self) -> None:
+        from cometbft_tpu.crypto.tpu import mesh
+
+        try:
+            self.metrics.chunk_cap.set(
+                mesh.effective_chunk_cap(self.spec.max_chunk or 8192)
+            )
+        except ValueError:
+            pass  # malformed CBFT_TPU_MAX_CHUNK surfaces at dispatch
 
     def _cpu_verify(self, items: List[Item]) -> List[bool]:
         with tracelib.child_of_current("cpu", n_sigs=len(items)):

@@ -178,14 +178,20 @@ def chunk_cap(default: int, min_pad: int) -> int:
     default; the winner is rounded UP to a power of two, so the
     dispatched bucket always equals a padded shape and warmup covers it.
     One knob governs every curve kernel — the cap tunes a property of
-    the LINK (per-dispatch cost vs bytes), not of a curve."""
+    the LINK (per-dispatch cost vs bytes), not of a curve.
+
+    The resolved cap is then halved once per active OOM shrink level
+    (shrink_chunk_cap / note_clean_dispatch below), never below min_pad
+    — a RESOURCE_EXHAUSTED device keeps serving smaller chunks instead
+    of being abandoned wholesale."""
     raw = os.environ.get("CBFT_TPU_MAX_CHUNK")
     if raw is None:
         if _configured_cap is None:
-            return default
-        # config is validated at load (config.validate_basic); a cap
-        # below the curve's minimum pad just means "smallest bucket"
-        cap = max(int(_configured_cap), min_pad)
+            cap = default
+        else:
+            # config is validated at load (config.validate_basic); a cap
+            # below the curve's minimum pad just means "smallest bucket"
+            cap = max(int(_configured_cap), min_pad)
     else:
         try:
             cap = int(raw)
@@ -200,7 +206,71 @@ def chunk_cap(default: int, min_pad: int) -> int:
     size = min_pad
     while size < cap:
         size *= 2
-    return size
+    return max(min_pad, size >> chunk_shrink_levels())
+
+
+# --- OOM-adaptive chunk cap (runtime shrink / hysteretic recovery) ----------
+# A device raising RESOURCE_EXHAUSTED is not broken — it is over-chunked
+# (HBM pressure from another tenant, a bigger-than-calibrated pad, a
+# fragmented allocator). The supervisor halves the effective cap and
+# retries instead of striking the breaker; the cap recovers one doubling
+# per N clean dispatches (hysteresis: one stray OOM must not oscillate
+# the chunk size). Module state mirrors _configured_cap: the cap tunes
+# the LINK, so one shrink level governs every curve kernel.
+
+MAX_SHRINK_LEVELS = 6  # 8192 → 128 floor; min_pad clamps earlier anyway
+
+_shrink_mtx = threading.Lock()
+_shrink_levels = 0
+_clean_streak = 0
+
+
+def chunk_shrink_levels() -> int:
+    """How many halvings are currently applied to the resolved cap."""
+    with _shrink_mtx:
+        return _shrink_levels
+
+
+def shrink_chunk_cap() -> bool:
+    """Halve the effective chunk cap (one more shrink level) after a
+    device OOM. → True if a level was added, False at the floor (the
+    caller should then treat the OOM as persistent)."""
+    global _shrink_levels, _clean_streak
+    with _shrink_mtx:
+        _clean_streak = 0  # an OOM restarts the recovery hysteresis
+        if _shrink_levels >= MAX_SHRINK_LEVELS:
+            return False
+        _shrink_levels += 1
+        return True
+
+
+def note_clean_dispatch(recover_n: int) -> bool:
+    """Record one clean device dispatch; after ``recover_n`` consecutive
+    clean dispatches one shrink level is removed (the cap recovers one
+    doubling). → True when a level was recovered on this call."""
+    global _shrink_levels, _clean_streak
+    with _shrink_mtx:
+        if _shrink_levels == 0:
+            return False
+        _clean_streak += 1
+        if _clean_streak < max(1, recover_n):
+            return False
+        _clean_streak = 0
+        _shrink_levels -= 1
+        return True
+
+
+def reset_chunk_shrink() -> None:
+    """Drop all shrink state (tests, chaos harness setup)."""
+    global _shrink_levels, _clean_streak
+    with _shrink_mtx:
+        _shrink_levels = 0
+        _clean_streak = 0
+
+
+def effective_chunk_cap(default: int = 8192, min_pad: int = 64) -> int:
+    """The cap dispatch_batch would use right now (gauge fodder)."""
+    return chunk_cap(default, min_pad)
 
 
 def pipeline_depth() -> int:

@@ -282,6 +282,25 @@ class Tracer:
     def set_on_span_end(self, fn: Optional[Callable[[Span], None]]) -> None:
         self._on_span_end = fn
 
+    def add_span_end_listener(self, fn: Callable[[Span], None]) -> None:
+        """Chain ``fn`` onto the span-end hook without displacing the
+        current listener (both run; listener exceptions are swallowed at
+        the call site as before). Lets several consumers — the stage
+        histogram, the supervisor's dispatch latency model, tests —
+        observe finished spans independently."""
+        prev = self._on_span_end
+        if prev is None:
+            self._on_span_end = fn
+            return
+
+        def chained(span: "Span") -> None:
+            try:
+                prev(span)
+            finally:
+                fn(span)
+
+        self._on_span_end = chained
+
     def set_dump_dir(self, path: Optional[str]) -> None:
         self._dump_dir = path
 
@@ -516,11 +535,7 @@ def attach_stage_metrics(tracer: Tracer, registry: Any) -> None:
         buckets=_STAGE_BUCKETS,
     )
 
-    prev = tracer._on_span_end
-
     def on_end(span: Span) -> None:
         hist.with_labels(stage=span.name).observe(span.duration_ns() / 1e9)
-        if prev is not None:
-            prev(span)
 
-    tracer.set_on_span_end(on_end)
+    tracer.add_span_end_listener(on_end)

@@ -237,6 +237,9 @@ class Node(BaseService):
             dispatch_timeout_ms=config.crypto.dispatch_timeout_ms,
             breaker_threshold=config.crypto.breaker_threshold,
             audit_pct=config.crypto.audit_pct,
+            hedge_pct=config.crypto.hedge_pct,
+            retry_ms=config.crypto.retry_ms,
+            chunk_recover_n=config.crypto.chunk_recover_n,
             metrics=sup_metrics,
             logger=self.logger,
             tracer=self.tracer,

@@ -95,7 +95,10 @@ class TestBreakerStateMachine:
         items = _make_items(8, poison_at=3)
         assert sup.verify_items(items) == _cpu_mask(items)
         assert sup.state() == HEALTHY
-        assert sup.metrics.device_dispatches.value() == 1
+        # mixed verdicts cost one extra device pass: the triage re-check
+        # that convicts the poisoned lane (tests/test_adaptive_dispatch.py)
+        assert sup.metrics.device_dispatches.value() == 2
+        assert sup.metrics.triage_runs.value() == 1
         sup.stop()
 
     def test_failures_walk_healthy_degraded_broken(self):
@@ -239,21 +242,26 @@ class TestCorruptionAudit:
         plan, sup = _faulty(audit_pct=100, audit_sync=True)
         items = _make_items(6, poison_at=4)
         plan.corrupt_rate = 1.0
-        # the device verdict is flipped; the sync audit re-checks on CPU
-        # BEFORE release and the ground truth wins
+        # the device verdict is flipped; triage overturns the false
+        # convictions (one mismatch), then the sync audit catches the
+        # false accept on the poisoned lane BEFORE release (a second
+        # mismatch) and the ground truth wins
         assert sup.verify_items(items) == _cpu_mask(items)
         assert sup.state() == BROKEN
-        assert sup.metrics.audit_mismatches.value() == 1
+        assert sup.metrics.audit_mismatches.value() == 2
         assert sup.metrics.trips.with_labels(cause="audit").value() == 1
         sup.stop()
 
     def test_async_audit_breaks_circuit_in_background(self):
         plan, sup = _faulty(audit_pct=100, audit_sync=False)
-        items = _make_items(6)
+        # all signatures bad: corruption flips the mask to all-True, an
+        # all-ok verdict that triage never re-checks (triage only chases
+        # claimed-BAD lanes) — the classic silent false accept
+        items = [(pk, m, b"\x00" * 64) for pk, m, _ in _make_items(6)]
         plan.corrupt_rate = 1.0
         mask = sup.verify_items(items)
         # background mode: the corrupted verdict escapes THIS batch...
-        assert mask == [False] * 6
+        assert mask == [True] * 6
         # ...but the audit catches it and breaks the circuit shortly
         deadline = time.monotonic() + 10.0
         while sup.state() != BROKEN and time.monotonic() < deadline:
@@ -544,6 +552,46 @@ class TestStopJoinFailure:
         s.stop()
         ok, mask = fut.result(timeout=5)
         assert ok and len(mask) == 4
+
+
+class TestStopMidProbe:
+    def test_stop_joins_inflight_probe(self, gated_backend):
+        # a warmup canary wedges on the device plane; stop() must join
+        # the probe thread (bounded by the dispatch watchdog) instead of
+        # leaving a daemon probe to touch the torn-down backend later
+        sup = BackendSupervisor(
+            spec=gated_backend, dispatch_timeout_ms=300,
+            breaker_threshold=3, audit_pct=0,
+            probe_base_ms=10, probe_max_ms=80,
+        )
+        sup.warmup_canary()
+        assert _GatedVerifier.entered.wait(5)  # probe is on the device
+        t0 = time.monotonic()
+        sup.stop()
+        # the probe abandons its wedged dispatch at the watchdog bound,
+        # so the join is bounded too (well under timeout_s + 5)
+        assert time.monotonic() - t0 < 5.0
+        assert not any(
+            t.name in ("supervisor-probe", "supervisor-canary")
+            and t.is_alive()
+            for t in threading.enumerate()
+        )
+        # after stop, probe_now is a no-op that never dispatches
+        _GatedVerifier.entered.clear()
+        assert sup.probe_now() is False
+        assert not _GatedVerifier.entered.is_set()
+
+    def test_stop_idempotent_after_probe_join(self, gated_backend):
+        _GatedVerifier.gate.set()
+        sup = BackendSupervisor(
+            spec=gated_backend, dispatch_timeout_ms=300,
+            breaker_threshold=3, audit_pct=0,
+            probe_base_ms=10, probe_max_ms=80,
+        )
+        sup.warmup_canary()
+        sup.stop()
+        sup.stop()  # second stop must not raise or hang
+        assert sup.probe_now() is False
 
 
 class TestMeshCancellation:

@@ -437,3 +437,58 @@ class TestKnobs:
         s = VerifyScheduler(spec="cpu", flush_us=2500)
         assert s.flush_us == 2500
         assert s.spec.name == "cpu"
+
+
+class TestSupervisedTriageIntegration:
+    def test_coalesced_flush_triages_only_the_poisoned_request(self):
+        # three coalesced requests from distinct subsystems, one carrying
+        # a single bad signature: triage must (a) localize the failure to
+        # that request's lanes and attribute it to its subsystem, (b)
+        # complete the clean futures all_ok, (c) never move the breaker
+        # (a bad signature is not a device incident)
+        from cometbft_tpu.crypto.faults import FaultPlan, install
+        from cometbft_tpu.crypto.supervisor import HEALTHY, BackendSupervisor
+
+        name = "sched-triage-integration"
+        install(name=name, inner="cpu", plan=FaultPlan(seed=99))
+        sup = BackendSupervisor(
+            spec=BackendSpec(name), dispatch_timeout_ms=2000,
+            breaker_threshold=3, audit_pct=0,
+            probe_base_ms=10, probe_max_ms=80, retry_ms=5,
+        )
+        sched = VerifyScheduler(spec=BackendSpec(name), flush_us=1000,
+                                supervisor=sup)
+        sched.start()
+        try:
+            good_a = _make_items(8, tag=b"cons")
+            bad_b = _make_items(8, tag=b"bsync", poison_at=5)
+            good_c = _make_items(8, tag=b"evid")
+            futs = [
+                sched.submit(good_a, subsystem="consensus", height=21),
+                sched.submit(bad_b, subsystem="blocksync", height=22),
+                sched.submit(good_c, subsystem="evidence", height=23),
+            ]
+            sched.flush()
+            res = [f.result(timeout=30) for f in futs]
+
+            ok_a, mask_a = res[0]
+            ok_b, mask_b = res[1]
+            ok_c, mask_c = res[2]
+            assert ok_a and mask_a == [True] * 8
+            assert ok_c and mask_c == [True] * 8
+            assert not ok_b and mask_b == _serial_verdict(bad_b)[1]
+
+            m = sup.metrics
+            assert m.triage_runs.value() == 1
+            offenders = {
+                c._labels["subsystem"]: c.value()
+                for c in m.triage_offenders._series()
+                if "subsystem" in c._labels
+            }
+            assert offenders == {"blocksync": 1.0}
+            assert m.triage_divergence.value() == 0
+            assert sum(c.value() for c in m.trips._series()) == 0
+            assert sup.state() == HEALTHY
+        finally:
+            sched.stop()
+            sup.stop()

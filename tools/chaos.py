@@ -1,16 +1,27 @@
-"""Standalone chaos soak against the supervised verify plane.
+"""Standalone chaos harness against the supervised verify plane.
 
-Drives crypto/faults.py run_chaos_soak — a randomized fault schedule
-(exceptions, hangs, silent verdict corruption, sudden death, jitter)
-over N simulated blocks through a supervised VerifyScheduler — and
-prints the JSON summary. Exit status is non-zero if any node-path
+Two modes:
+
+* default (smoke) — crypto/faults.py run_chaos_smoke: a fast,
+  deterministic walk of every degradation-ladder rung (transient retry,
+  OOM chunk-shrink + recovery, hedged verification, failed-batch triage,
+  breaker trip/probe/re-admit), asserting ground-truth verdict equality
+  at every step. Finishes in well under a second.
+
+* --soak — crypto/faults.py run_chaos_soak: a randomized fault schedule
+  (exceptions, hangs, silent verdict corruption, sudden death, jitter,
+  OOM, transient flaps) over N simulated blocks through a supervised
+  VerifyScheduler.
+
+Both print a JSON summary; exit status is non-zero if any node-path
 invariant broke: a wrong verdict released, a future lost, or the
 breaker failing to re-admit the backend after faults stop.
 
-Default inner backend is "cpu" (self-contained soak of the supervisor
-machinery); pass --inner tpu on a host with a live device plane to soak
-the real dispatch path under injected faults. The `slow`-marked test in
-tests/test_supervisor.py runs the same soak in CI.
+Default inner backend is "cpu" (self-contained exercise of the
+supervisor machinery); pass --inner tpu on a host with a live device
+plane to drive the real dispatch path under injected faults. The fast
+smoke runs in tier-1 CI (tests/test_adaptive_dispatch.py); the
+`slow`-marked soak test in tests/test_supervisor.py runs the soak.
 """
 
 import argparse
@@ -23,49 +34,89 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--soak", action="store_true",
+                    help="run the long randomized soak instead of the "
+                         "fast deterministic ladder smoke (default)")
     ap.add_argument("--blocks", type=int, default=50,
-                    help="simulated blocks to soak (default 50)")
+                    help="[soak] simulated blocks to soak (default 50)")
     ap.add_argument("--batch", type=int, default=48,
-                    help="signatures per block (default 48)")
+                    help="[soak] signatures per block (default 48)")
     ap.add_argument("--seed", type=int, default=1234,
-                    help="fault-schedule RNG seed (default 1234)")
+                    help="fault-schedule RNG seed (default 1234; the "
+                         "smoke uses it for its key material too)")
     ap.add_argument("--inner", default="cpu",
                     help='backend under the faults: "cpu" (default) or '
                          '"tpu" (requires a live device plane)')
     ap.add_argument("--dispatch-timeout-ms", type=int, default=500,
-                    help="supervisor watchdog budget per dispatch "
+                    help="[soak] supervisor watchdog budget per dispatch "
                          "(default 500; raise for a real TPU link)")
     ap.add_argument("--probe-base-ms", type=int, default=20,
-                    help="canary probe backoff base (default 20)")
+                    help="[soak] canary probe backoff base (default 20)")
     ap.add_argument("--submitters", type=int, default=3,
-                    help="concurrent submitter threads per block "
+                    help="[soak] concurrent submitter threads per block "
                          "(default 3)")
+    ap.add_argument("--oom-rate", type=float, default=None,
+                    help="override CBFT_FAULT_OOM_RATE for ad-hoc runs "
+                         "of a faulty node (exported to the env)")
+    ap.add_argument("--transient-n", type=int, default=None,
+                    help="override CBFT_FAULT_TRANSIENT_N for ad-hoc "
+                         "runs of a faulty node (exported to the env)")
     args = ap.parse_args()
 
     if args.inner == "cpu":
-        # self-contained soak: no device plane required
+        # self-contained: no device plane required
         os.environ.setdefault("CBFT_TPU_PROBE", "0")
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # env-driven fault knobs: picked up by any FaultPlan.from_env() in
+    # this process (e.g. a faulty node backend installed elsewhere)
+    if args.oom_rate is not None:
+        os.environ["CBFT_FAULT_OOM_RATE"] = str(args.oom_rate)
+    if args.transient_n is not None:
+        os.environ["CBFT_FAULT_TRANSIENT_N"] = str(args.transient_n)
 
-    from cometbft_tpu.crypto.faults import run_chaos_soak
+    if args.soak:
+        from cometbft_tpu.crypto.faults import run_chaos_soak
 
-    summary = run_chaos_soak(
-        n_blocks=args.blocks,
-        batch=args.batch,
-        seed=args.seed,
-        inner=args.inner,
-        dispatch_timeout_ms=args.dispatch_timeout_ms,
-        probe_base_ms=args.probe_base_ms,
-        n_submitters=args.submitters,
-    )
+        summary = run_chaos_soak(
+            n_blocks=args.blocks,
+            batch=args.batch,
+            seed=args.seed,
+            inner=args.inner,
+            dispatch_timeout_ms=args.dispatch_timeout_ms,
+            probe_base_ms=args.probe_base_ms,
+            n_submitters=args.submitters,
+        )
+        print(json.dumps(summary, indent=2))
+        ok = (
+            summary["wrong_verdicts"] == 0
+            and summary["lost_futures"] == 0
+            and summary["readmitted"]
+            and summary["device_resumed_after_recovery"]
+        )
+        print("CHAOS SOAK", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+
+    from cometbft_tpu.crypto.faults import run_chaos_smoke
+
+    summary = run_chaos_smoke(seed=args.seed, inner=args.inner)
     print(json.dumps(summary, indent=2))
     ok = (
         summary["wrong_verdicts"] == 0
-        and summary["lost_futures"] == 0
-        and summary["readmitted"]
-        and summary["device_resumed_after_recovery"]
+        and summary["retries"] >= 1
+        and summary["chunk_shrinks"] >= 1
+        and summary["chunk_recoveries"] >= 1
+        and summary["hedge_fires"] >= 1
+        and summary["hedge_wins"] >= 1
+        and summary["hedge_divergence"] == 0
+        and summary["triage_runs"] >= 1
+        and summary["triage_clean_futures_ok"]
+        and not summary["triage_tripped_breaker"]
+        and summary["triage_divergence"] == 0
+        and summary["state_broken"] == summary["expected"]["state_broken"]
+        and summary["probe_ok"]
+        and summary["state_final"] == summary["expected"]["state_final"]
     )
-    print("CHAOS SOAK", "PASS" if ok else "FAIL")
+    print("CHAOS SMOKE", "PASS" if ok else "FAIL")
     return 0 if ok else 1
 
 
