@@ -807,6 +807,86 @@ def _stage_degraded():
     }
     print(json.dumps(out), flush=True)
 
+    # partial degradation: an N-virtual-domain mesh with one domain
+    # dead must keep >= 0.6 x (N-1)/N of its own healthy rate ON THE
+    # DEVICE PATH — quarantine + batch-axis redistribution over the
+    # survivors, never a node-wide CPU fallback — and the verdicts of
+    # a mixed batch must equal the serial CPU ground truth throughout
+    from cometbft_tpu.crypto.tpu import topology as topolib
+
+    ndev, kill = 4, 2
+    topo = topolib.DeviceTopology.virtual(ndev)
+    plan2 = install(
+        name="bench-partial", inner="cpu", plan=FaultPlan(device=kill)
+    )
+    sup2 = BackendSupervisor(
+        spec=BackendSpec("bench-partial"),
+        dispatch_timeout_ms=10_000,
+        breaker_threshold=1,
+        audit_pct=0,
+        hedge_pct=0,
+        # quarantine must hold for the whole degraded window: push the
+        # async canary backoff far past the stage timeout
+        probe_base_ms=300_000,
+        probe_max_ms=600_000,
+        retry_ms=5,
+        topology=topo,
+    )
+
+    def rate2() -> float:
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            mask2 = sup2.verify_items(items)
+            assert all(mask2)
+        return round(rounds * n / (time.perf_counter() - t0), 1)
+
+    part = {"n_domains": ndev, "killed": f"dev{kill}"}
+    part["healthy_sigs_per_sec"] = rate2()
+
+    # kill domain 2: its first shard fails, trips its breaker, and the
+    # batch axis redistributes over the three survivors
+    plan2.exception_rate = 1.0
+    mask2 = sup2.verify_items(items, reason="bench-partial-trip")
+    assert all(mask2)
+    part["killed_state"] = sup2.device_states()[f"dev{kill}"]
+
+    cpu_before = sup2.metrics.cpu_routed.value()
+    dev_before = sup2.metrics.device_dispatches.value()
+    part["degraded_sigs_per_sec"] = rate2()
+    part["cpu_routed_while_degraded"] = int(
+        sup2.metrics.cpu_routed.value() - cpu_before
+    )
+    part["device_dispatches_while_degraded"] = int(
+        sup2.metrics.device_dispatches.value() - dev_before
+    )
+
+    # verdict parity under partial degradation: 8 bad lanes, ground
+    # truth from the batch construction
+    mixed = list(items)
+    truth2 = [True] * n
+    for lane in range(0, n, n // 8):
+        mixed[lane] = (mixed[lane][0], mixed[lane][1], b"\x17" * 64)
+        truth2[lane] = False
+    part["verdicts_match_ground_truth"] = (
+        sup2.verify_items(mixed, reason="bench-partial-mixed") == truth2
+    )
+
+    floor = 0.6 * (ndev - 1) / ndev
+    ratio = part["degraded_sigs_per_sec"] / max(
+        part["healthy_sigs_per_sec"], 1e-9
+    )
+    part["throughput_ratio"] = round(ratio, 3)
+    part["floor"] = round(floor, 3)
+    part["above_floor"] = ratio >= floor
+    part["survivors_kept_device_path"] = (
+        part["cpu_routed_while_degraded"] == 0
+        and part["device_dispatches_while_degraded"] > 0
+    )
+    out["partial_degraded"] = part
+    plan2.clear()
+    sup2.stop()
+    print(json.dumps(out), flush=True)
+
 
 def _set_cache():
     import jax

@@ -290,6 +290,12 @@ class CryptoConfig:
     # dispatch chunk cap, the cap recovers one doubling per this many
     # consecutive clean device dispatches. CBFT_CHUNK_RECOVER_N env wins.
     chunk_recover_n: int = 32
+    # Fault domains the supervisor shards its breaker/retry/shrink state
+    # over (crypto/tpu/topology.py). 1 = single-device behavior
+    # (default); N > 1 = N virtual domains sharing the batch axis;
+    # 0 = auto-detect from the visible device plane at startup.
+    # CBFT_FAULT_DOMAINS env wins.
+    fault_domains: int = 1
 
 
 @dataclass
@@ -340,6 +346,13 @@ class Config:
         if not isinstance(ap, int) or isinstance(ap, bool) or not 0 <= ap <= 100:
             raise ValueError(
                 f"crypto.audit_pct must be an integer in [0, 100], got {ap!r}"
+            )
+        fd = self.crypto.fault_domains
+        if not isinstance(fd, int) or isinstance(fd, bool) or fd < 0:
+            # 0 is a valid value: auto-detect from the device plane
+            raise ValueError(
+                "crypto.fault_domains must be a non-negative integer, "
+                f"got {fd!r}"
             )
         hp = self.crypto.hedge_pct
         if not isinstance(hp, int) or isinstance(hp, bool) or hp < 0:

@@ -20,7 +20,12 @@ wedged tunnels, flapping runtimes, miscompiled kernels):
   breaker);
 * ``transient_n``     — countdown: the next N dispatches raise an
   UNAVAILABLE-shaped error then the backend recovers (the flapping
-  tunnel the transient-retry rung absorbs).
+  tunnel the transient-retry rung absorbs);
+* ``device``          — scope every fault above to ONE fault domain
+  (``CBFT_FAULT_DEVICE=<idx>``): a dispatch whose thread-installed
+  topology.device_scope names a different device bypasses injection
+  entirely — the multi-device chaos rung kills device k of N and
+  asserts the survivors keep serving.
 
 State (dispatch counter, RNG) lives in the shared ``FaultPlan``, not the
 verifier instance — new_batch_verifier constructs a fresh verifier per
@@ -78,6 +83,7 @@ class FaultPlan:
         oom_rate: float = 0.0,
         transient_n: int = 0,
         seed: int = 0,
+        device: Optional[int] = None,
     ):
         self.exception_rate = exception_rate
         self.hang_rate = hang_rate
@@ -89,9 +95,16 @@ class FaultPlan:
         # countdown: the next N dispatches fail transiently, then the
         # backend recovers on its own (re-armable mid-run by assignment)
         self.transient_n = transient_n
+        # fault-domain scope: None = every dispatch; an index = only
+        # dispatches whose thread carries that topology.device_scope
+        self.device = device
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self.dispatches = 0  # total dispatches seen (incl. faulted ones)
+        # dispatches seen per fault-domain index (only for dispatches
+        # carrying a device scope) — the multi-device rung reads this to
+        # prove the survivors kept serving the device path
+        self.per_device: dict = {}
 
     @classmethod
     def from_env(cls) -> "FaultPlan":
@@ -99,9 +112,10 @@ class FaultPlan:
         configured without code: CBFT_FAULT_EXC_RATE, CBFT_FAULT_HANG_RATE,
         CBFT_FAULT_HANG_S, CBFT_FAULT_CORRUPT_RATE, CBFT_FAULT_DIE_AFTER,
         CBFT_FAULT_JITTER_MS, CBFT_FAULT_OOM_RATE, CBFT_FAULT_TRANSIENT_N,
-        CBFT_FAULT_SEED."""
+        CBFT_FAULT_SEED, CBFT_FAULT_DEVICE (fault-domain scope)."""
         e = os.environ
         die = e.get("CBFT_FAULT_DIE_AFTER")
+        dev = e.get("CBFT_FAULT_DEVICE")
         return cls(
             exception_rate=float(e.get("CBFT_FAULT_EXC_RATE", "0")),
             hang_rate=float(e.get("CBFT_FAULT_HANG_RATE", "0")),
@@ -112,6 +126,7 @@ class FaultPlan:
             oom_rate=float(e.get("CBFT_FAULT_OOM_RATE", "0")),
             transient_n=int(e.get("CBFT_FAULT_TRANSIENT_N", "0")),
             seed=int(e.get("CBFT_FAULT_SEED", "0")),
+            device=int(dev) if dev is not None else None,
         )
 
     def clear(self) -> None:
@@ -125,13 +140,30 @@ class FaultPlan:
         self.oom_rate = 0.0
         self.transient_n = 0
 
-    def _decide(self) -> Tuple[int, bool, bool, bool, float, bool, bool]:
+    def _count_bypass(self, device_idx: Optional[int]) -> int:
+        """Count a dispatch that bypassed injection because its device
+        scope is outside the plan's target domain."""
+        with self._lock:
+            self.dispatches += 1
+            if device_idx is not None:
+                self.per_device[device_idx] = (
+                    self.per_device.get(device_idx, 0) + 1
+                )
+            return self.dispatches
+
+    def _decide(
+        self, device_idx: Optional[int] = None
+    ) -> Tuple[int, bool, bool, bool, float, bool, bool]:
         """→ (dispatch_no, raise?, hang?, corrupt?, jitter_s, transient?,
         oom?) for one dispatch, under the lock so concurrent dispatches
         draw distinct RNG samples and the counters are exact."""
         with self._lock:
             self.dispatches += 1
             no = self.dispatches
+            if device_idx is not None:
+                self.per_device[device_idx] = (
+                    self.per_device.get(device_idx, 0) + 1
+                )
             dead = self.die_after is not None and no > self.die_after
             raise_ = dead or self._rng.random() < self.exception_rate
             hang = self._rng.random() < self.hang_rate
@@ -165,8 +197,18 @@ class FaultyBackend(BatchVerifier):
 
     def verify(self) -> Tuple[bool, List[bool]]:
         n, self._n = self._n, 0
+        from cometbft_tpu.crypto.tpu import topology
+
+        dev = topology.current_device()
+        dev_idx = dev.index if dev is not None else None
+        if self._plan.device is not None and dev_idx != self._plan.device:
+            # this dispatch targets a different fault domain than the
+            # plan scopes to — it runs clean (that is the whole point of
+            # device-targeted chaos: the survivors must not feel it)
+            self._plan._count_bypass(dev_idx)
+            return self._inner.verify()
         no, raise_, hang, corrupt, jitter_s, transient, oom = (
-            self._plan._decide()
+            self._plan._decide(dev_idx)
         )
         if jitter_s:
             time.sleep(jitter_s)
@@ -584,3 +626,174 @@ def run_chaos_smoke(
         },
         "backend_dispatches": plan.dispatches,
     }
+
+
+# ---------------------------------------------------------------------------
+# multi-device chaos: kill device k of N, survivors must keep serving
+# ---------------------------------------------------------------------------
+
+
+def run_chaos_multidevice(
+    devices: int = 4,
+    kill: int = 2,
+    seed: int = 7,
+    inner: cryptobatch.Backend = "cpu",
+    logger=None,
+) -> dict:
+    """The partial-mesh degradation proof: on an N-fault-domain
+    topology, inject hang → oom → corrupt into device ``kill`` ONLY
+    (``FaultPlan.device``) and assert after every phase that
+
+      * zero wrong verdicts are ever released (the faulted shard is
+        served from the CPU ground truth / triage overturn);
+      * the surviving devices keep serving the device path — no
+        node-wide CPU fallback (``cpu_routed`` stays 0) and no global
+        breaker trip (aggregate state is DEGRADED, never BROKEN);
+      * exactly the killed device's breaker leaves HEALTHY (quarantine),
+        and its own exponential-backoff canary re-admits it once the
+        fault clears.
+
+    Returns a summary dict; tools/chaos.py and the tier-1 smoke test
+    assert on it. Deterministic: seeded faults, rate-1.0 regimes."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.supervisor import (
+        BROKEN,
+        DEGRADED,
+        HEALTHY,
+        BackendSupervisor,
+    )
+    from cometbft_tpu.crypto.tpu import topology
+
+    if not 0 <= kill < devices:
+        raise ValueError(f"kill index {kill} outside 0..{devices - 1}")
+    topo = topology.DeviceTopology.virtual(devices)
+    name = f"chaos-md-{seed}-{devices}-{kill}"
+    plan = install(
+        name=name, inner=inner, plan=FaultPlan(seed=seed, device=kill)
+    )
+    sup = BackendSupervisor(
+        spec=BackendSpec(name),
+        dispatch_timeout_ms=500,
+        breaker_threshold=1,  # first strike quarantines — deterministic
+        audit_pct=100,
+        audit_sync=True,  # no wrong verdict may ever be released
+        # async canary backoff pushed beyond the run: a background probe
+        # racing the fault window would fail and re-trip AFTER the
+        # explicit re-admission — re-admission here is driven solely by
+        # the synchronous per-device probe_now(device=kill) canary
+        probe_base_ms=60_000,
+        probe_max_ms=120_000,
+        hedge_pct=0,  # hedging off: phase outcomes must be attributable
+        retry_ms=5,
+        chunk_recover_n=1,
+        logger=logger,
+        topology=topo,
+    )
+    killed_label = topo.device(kill).label
+    m = sup.metrics
+    keys = [
+        ed.gen_priv_key_from_secret(b"chaos-md-%d" % i) for i in range(8)
+    ]
+    batch = 64 * devices  # big enough that every healthy domain shards
+
+    def make_items(tag: bytes):
+        items, truth = [], []
+        for i in range(batch):
+            k = keys[i % len(keys)]
+            msg = b"md %s %d" % (tag, i)
+            items.append((k.pub_key(), msg, k.sign(msg)))
+            truth.append(True)
+        return items, truth
+
+    def series(counter) -> dict:
+        return {
+            c._labels["device"]: c.value()
+            for c in counter._series() if "device" in c._labels
+        }
+
+    wrong = 0
+    phases = {}
+    try:
+        for phase, arm in (
+            ("hang", lambda: setattr(plan, "hang_rate", 1.0)),
+            ("oom", lambda: setattr(plan, "oom_rate", 1.0)),
+            ("corrupt", lambda: setattr(plan, "corrupt_rate", 1.0)),
+        ):
+            plan.clear()
+            arm()
+            if phase == "hang":
+                plan.hang_s = 30.0
+            # 1) faulted batch: device `kill`'s shard fails its way down
+            # the ladder and is served from the ground truth; the other
+            # shards complete on the device path
+            items, truth = make_items(phase.encode())
+            if sup.verify_items(items, reason=f"md-{phase}") != truth:
+                wrong += 1
+            states = sup.device_states()
+            quarantined_only_kill = (
+                states.get(killed_label) == BROKEN
+                and all(
+                    s == HEALTHY for d, s in states.items()
+                    if d != killed_label
+                )
+            )
+            # 2) survivors keep serving while the fault is still armed:
+            # the quarantined domain is excluded from the partition, so
+            # the armed fault cannot even fire
+            before = dict(plan.per_device)
+            items, truth = make_items(phase.encode() + b"-survivors")
+            if sup.verify_items(items, reason=f"md-{phase}-surv") != truth:
+                wrong += 1
+            survivors_grew = all(
+                plan.per_device.get(i, 0) > before.get(i, 0)
+                for i in range(devices) if i != kill
+            )
+            state_quarantined = sup.state()
+            # 3) repair + per-device canary re-admission
+            plan.clear()
+            readmit_ok = sup.probe_now(device=kill)
+            phases[phase] = {
+                "quarantined_only_kill": quarantined_only_kill,
+                "survivors_grew": survivors_grew,
+                "state_while_quarantined": state_quarantined,
+                "readmit_probe_ok": readmit_ok,
+                "states_after_readmit": sup.device_states(),
+            }
+            if phase == "oom":
+                # the OOM phase rode the shrink ladder to the floor;
+                # model the operator repair (HBM pressure gone) so the
+                # corrupt phase shards at full capacity again
+                topo.device(kill).reset_chunk_shrink()
+    finally:
+        final_states = sup.device_states()
+        sup.stop()
+
+    quarantine_series = series(m.quarantines)
+    summary = {
+        "devices": devices,
+        "kill": kill,
+        "wrong_verdicts": wrong,
+        "cpu_routed": m.cpu_routed.value(),
+        "quarantines": quarantine_series,
+        "readmissions": series(m.readmissions),
+        "redistributions": m.redistributions.value(),
+        "phases": phases,
+        "final_states": final_states,
+        "backend_dispatches": plan.dispatches,
+        "per_device_dispatches": dict(plan.per_device),
+        "expected": {
+            "state_while_quarantined": DEGRADED,
+            "final_state": HEALTHY,
+        },
+    }
+    # the safety invariants hold unconditionally — assert here so every
+    # caller (CLI, tests, bench) gets them for free
+    assert wrong == 0, f"wrong verdicts released: {wrong}"
+    assert m.cpu_routed.value() == 0, "node-wide CPU fallback engaged"
+    assert set(quarantine_series) == {killed_label}, (
+        f"devices quarantined: {sorted(quarantine_series)} "
+        f"(expected only {killed_label})"
+    )
+    assert all(s == HEALTHY for s in final_states.values()), final_states
+    return summary

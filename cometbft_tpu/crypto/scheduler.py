@@ -315,6 +315,29 @@ class VerifyScheduler(BaseService):
     def supervisor(self):
         return self._supervisor
 
+    def _effective_lane_budget(self) -> int:
+        """The size-flush threshold scaled to the capacity the HEALTHY
+        fault domains can actually absorb right now: with k of N devices
+        quarantined (or OOM-shrunk), coalescing to the full nominal
+        budget just builds a batch the survivors must split anyway —
+        flushing at the surviving capacity keeps per-device chunk sizes
+        on target. Duck-typed: any supervisor without
+        healthy_capacity_fraction (or a failing one) means the nominal
+        budget."""
+        sup = self._supervisor
+        if sup is None:
+            return self._lane_budget
+        frac_fn = getattr(sup, "healthy_capacity_fraction", None)
+        if frac_fn is None:
+            return self._lane_budget
+        try:
+            frac = float(frac_fn())
+        except Exception:  # noqa: BLE001 - budget is advisory
+            return self._lane_budget
+        if frac <= 0.0 or frac >= 1.0:
+            return self._lane_budget
+        return max(1, int(self._lane_budget * frac))
+
     # -- lifecycle -----------------------------------------------------------
 
     def on_start(self) -> None:
@@ -456,7 +479,7 @@ class VerifyScheduler(BaseService):
                     if self._draining:
                         reason = "drain"
                         break
-                    if self._pending_lanes >= self._lane_budget:
+                    if self._pending_lanes >= self._effective_lane_budget():
                         reason = "size"
                         break
                     if self._flush_asked:

@@ -251,6 +251,53 @@ class _DeviceCall:
         self.n = 0
 
 
+class _Domain:
+    """Per-fault-domain supervision record: the breaker machine, probe
+    backoff, and latency model that used to be node-global, now one per
+    topology.DeviceHandle. Mutated only under the supervisor's lock
+    (except latency_model, which locks itself)."""
+
+    __slots__ = (
+        "handle", "state", "consecutive_failures", "backoff_s",
+        "next_probe_at", "probing", "latency_model",
+    )
+
+    def __init__(self, handle, probe_base_s: float):
+        self.handle = handle
+        self.state = HEALTHY
+        self.consecutive_failures = 0
+        self.backoff_s = probe_base_s
+        self.next_probe_at = 0.0
+        self.probing = False
+        self.latency_model = LatencyModel()
+
+
+# a batch shard below this many signatures is not worth a separate
+# device dispatch (pad + launch overhead dominates); small batches stay
+# on fewer domains
+_MIN_SHARD = 32
+
+
+def _slice_origins(
+    origins: Optional[Sequence[Origin]], start: int, end: int
+) -> Optional[List[Origin]]:
+    """The sub-sequence of the scheduler's demux shape covering item
+    positions [start:end) — so a sharded batch still attributes triaged
+    offenders to the right submitting subsystem."""
+    if origins is None:
+        return None
+    out: List[Origin] = []
+    pos = 0
+    for count, subsystem, height in origins:
+        s, e = max(start, pos), min(end, pos + count)
+        if e > s:
+            out.append((e - s, subsystem, height))
+        pos += count
+        if pos >= end:
+            break
+    return out
+
+
 def _knob(env: str, config_value: Optional[int], default: int) -> int:
     """Same precedence shape as every [crypto] knob (crypto/batch.py
     ed25519_routing_floor): env operator override > config > default."""
@@ -396,6 +443,31 @@ class Metrics:
             "Triage runs whose device passes failed and fell back to CPU "
             "verification of the remaining suspect lanes.",
         )
+        # -- per-fault-domain instruments (device= label) ----------------
+        # existing instruments keep their label shapes (a labeled child
+        # never feeds the parent series in libs/metrics.py, so relabeling
+        # them would zero every unlabeled consumer); per-device state
+        # gets its own family instead.
+        self.breaker_state = r.gauge(
+            SUBSYSTEM, "breaker_state",
+            "Per-device circuit breaker state (device= label): "
+            "0=healthy, 1=degraded, 2=broken.",
+        )
+        self.quarantines = r.counter(
+            SUBSYSTEM, "quarantines",
+            "Fault domains quarantined (per-device breaker opened while "
+            "other devices stayed in service), by device.",
+        )
+        self.readmissions = r.counter(
+            SUBSYSTEM, "readmissions",
+            "Quarantined fault domains re-admitted by their own canary "
+            "probe, by device.",
+        )
+        self.redistributions = r.counter(
+            SUBSYSTEM, "redistributions",
+            "Batches whose quarantined-device share of the batch axis was "
+            "redistributed to the healthy devices.",
+        )
 
     @classmethod
     def nop(cls) -> "Metrics":
@@ -429,6 +501,7 @@ class BackendSupervisor:
         metrics: Optional[Metrics] = None,
         logger: Optional[Logger] = None,
         tracer: Optional[tracelib.Tracer] = None,
+        topology=None,
     ):
         spec = unwrap_backend(spec)
         if not isinstance(spec, BackendSpec):
@@ -451,21 +524,33 @@ class BackendSupervisor:
         self._hedge_pct = max(0, hedge_pct_default(hedge_pct))
         self._retry_s = max(1, retry_ms_default(retry_ms)) / 1e3
         self._chunk_recover_n = max(1, chunk_recover_n_default(chunk_recover_n))
-        self.latency_model = LatencyModel()
         self.metrics = metrics if metrics is not None else Metrics.nop()
         self.logger = logger or new_nop_logger()
         self._tracer = tracer if tracer is not None else tracelib.default_tracer()
 
+        # supervision state is sharded over the device topology: one
+        # _Domain (breaker / probe backoff / latency model) per fault
+        # domain. Default = the process topology, whose device 0 the
+        # mesh module's legacy chunk-cap globals shim onto — so
+        # single-device behavior is bit-identical to the pre-topology
+        # supervisor.
+        if topology is None:
+            from cometbft_tpu.crypto.tpu import topology as topolib
+
+            topology = topolib.default_topology()
+        self.topology = topology
         self._lock = threading.Lock()
-        self._state = HEALTHY
-        self._consecutive_failures = 0
-        self._backoff_s = self._probe_base_s
-        self._next_probe_at = 0.0
-        self._probing = False
+        self._domains = [
+            _Domain(h, self._probe_base_s) for h in topology
+        ]
+        for dom in self._domains:
+            self.metrics.breaker_state.with_labels(
+                device=dom.handle.label
+            ).set(_STATE_CODE[HEALTHY])
         self._rng = random.Random()
 
         self._audit_cond = threading.Condition()
-        self._audit_queue: Deque[Tuple[List[Item], List[bool]]] = (
+        self._audit_queue: Deque[Tuple[_Domain, List[Item], List[bool]]] = (
             collections.deque()
         )
         self._audit_worker: Optional[threading.Thread] = None
@@ -504,9 +589,52 @@ class BackendSupervisor:
     def chunk_recover_n(self) -> int:
         return self._chunk_recover_n
 
+    @property
+    def latency_model(self) -> LatencyModel:
+        """Back-compat: the single-device supervisor's latency model is
+        fault domain 0's (multi-device callers use per-domain models)."""
+        return self._domains[0].latency_model
+
+    @property
+    def _backoff_s(self) -> float:
+        """Back-compat introspection: domain 0's probe backoff."""
+        return self._domains[0].backoff_s
+
     def state(self) -> str:
+        """Aggregate node state: BROKEN only when EVERY fault domain is
+        broken (that is the only condition that routes the node to CPU);
+        DEGRADED while any domain is degraded or quarantined; HEALTHY
+        otherwise. With one domain this is exactly the old breaker."""
         with self._lock:
-            return self._state
+            return self._aggregate_state_locked()
+
+    def _aggregate_state_locked(self) -> str:
+        states = [d.state for d in self._domains]
+        if all(s == BROKEN for s in states):
+            return BROKEN
+        if any(s != HEALTHY for s in states):
+            return DEGRADED
+        return HEALTHY
+
+    def device_states(self) -> Dict[str, str]:
+        """Per-fault-domain breaker state, keyed by device label — the
+        flight-recorder dump and /debug consumers read this."""
+        with self._lock:
+            return {d.handle.label: d.state for d in self._domains}
+
+    def healthy_capacity_fraction(self) -> float:
+        """Fraction of nominal device capacity currently in service:
+        quarantined (BROKEN) domains contribute 0, OOM-shrunk domains
+        their shrunken share. The scheduler scales its lane budget by
+        this so coalesced flushes target what the surviving devices can
+        actually absorb."""
+        with self._lock:
+            n = len(self._domains)
+            live = sum(
+                d.handle.capacity_fraction()
+                for d in self._domains if d.state != BROKEN
+            )
+        return live / max(1, n)
 
     # -- the supervised verify entry -----------------------------------------
 
@@ -536,62 +664,171 @@ class BackendSupervisor:
             "supervise", state=state, n_sigs=len(items), reason=reason
         )
         with tracelib.use(span):
-            if state == BROKEN:
-                # fail fast: zero added latency while the breaker is open
+            with self._lock:
+                healthy = [d for d in self._domains if d.state != BROKEN]
+                n_domains = len(self._domains)
+            if not healthy:
+                # EVERY fault domain is quarantined — only now does the
+                # node fall back to CPU. Fail fast: zero added latency
+                # while the breakers are open.
                 self._maybe_probe_async()
                 self.metrics.cpu_routed.add()
                 mask = self._cpu_verify(items)
                 span.end(outcome="cpu_routed")
                 return mask
-            try:
-                mask, source = self._dispatch_adaptive(items, reason)
-            except WatchdogTimeout as exc:
-                self.metrics.watchdog_kills.add()
-                self._trip(
-                    "watchdog", err=str(exc), n=len(items), reason=reason
+            if len(healthy) < n_domains:
+                # partial quarantine: the broken devices' batch-axis
+                # share lands on the survivors, and their canaries keep
+                # probing for re-admission
+                self._maybe_probe_async()
+                self.metrics.redistributions.add()
+            shards = self._partition(len(items), healthy)
+            if len(shards) == 1:
+                dom = shards[0][0]
+                mask, outcome = self._supervise_shard(
+                    dom, items, reason, origins
                 )
-                mask = self._cpu_verify(items)
-                span.end(outcome="watchdog_cpu")
+                span.end(outcome=outcome)
                 return mask
-            except Exception as exc:  # noqa: BLE001 - any backend death
-                self._note_failure(exc, len(items), reason)
-                mask = self._cpu_verify(items)
-                span.end(outcome="failure_cpu")
-                return mask
-            if source != "device":
-                # the CPU hedge won the race: its verdicts ARE the ground
-                # truth — nothing to audit or triage, and the device's
-                # health is judged by the loser-audit in the hedge path,
-                # not by this batch's success
-                span.end(outcome="hedge_cpu")
-                return mask
-            self._note_success()
-            self._note_clean_dispatch()
-            if not all(mask):
-                # a mixed verdict is never released at lane granularity
-                # on device faith alone — localize and confirm
-                mask = self._triage(items, mask, reason, origins)
-            if self._audit_pct > 0 and self._should_audit():
-                if self._audit_sync:
-                    asp = tracelib.child_of_current(
-                        "audit", sync=True, n_sigs=len(items)
+            return self._verify_sharded(
+                span, shards, items, reason, origins,
+                n_healthy=len(healthy),
+            )
+
+    def _partition(self, n: int, healthy: List[_Domain]):
+        """Split the batch axis [0, n) into contiguous shards over the
+        healthy fault domains, weighted by each device's
+        capacity_fraction (an OOM-shrunk device takes a smaller share).
+        Small batches use fewer domains (_MIN_SHARD floor) — the pad +
+        launch overhead of a tiny shard beats any parallelism win.
+        → list of (domain, start, end), end-exclusive, covering [0, n)."""
+        use = healthy[: max(1, min(len(healthy), n // _MIN_SHARD or 1))]
+        weights = [d.handle.capacity_fraction() for d in use]
+        total = sum(weights) or float(len(use))
+        shards = []
+        start = 0
+        for i, (dom, w) in enumerate(zip(use, weights)):
+            end = n if i == len(use) - 1 else min(
+                n, start + int(round(n * w / total))
+            )
+            if end > start:
+                shards.append((dom, start, end))
+            start = end
+        return shards or [(use[0], 0, n)]
+
+    def _verify_sharded(
+        self,
+        span,
+        shards,
+        items: List[Item],
+        reason: str,
+        origins: Optional[Sequence[Origin]],
+        n_healthy: int,
+    ) -> List[bool]:
+        """Run one shard per healthy domain — shard 0 inline on the
+        calling thread, the rest on workers that re-install the
+        supervise span so their device/cpu children parent correctly.
+        Each shard is independently supervised (watchdog, ladder,
+        triage, audit); a shard whose worker outlives even the watchdog
+        bound is served from the CPU ground truth, so the full mask is
+        always returned."""
+        results: List[Optional[List[bool]]] = [None] * len(shards)
+        outcomes: List[Optional[str]] = [None] * len(shards)
+
+        def run_shard(i: int, dom: _Domain, start: int, end: int) -> None:
+            try:
+                with tracelib.use(span):
+                    m, oc = self._supervise_shard(
+                        dom, items[start:end], reason,
+                        _slice_origins(origins, start, end),
                     )
-                    cpu_mask = self._cpu_verify(items)
-                    self.metrics.audits.add()
-                    mismatch = cpu_mask != mask
-                    asp.end(mismatch=mismatch)
-                    if mismatch:
-                        self._audit_mismatch(len(items))
-                        span.end(outcome="audit_mismatch")
-                        return cpu_mask  # ground truth wins, always
-                else:
-                    self._enqueue_audit(items, mask)
-            span.end(outcome="device_ok")
-            return mask
+                results[i], outcomes[i] = m, oc
+            except Exception:  # noqa: BLE001 - assembly CPU-fills the hole
+                pass
+
+        threads = []
+        for i, (dom, start, end) in enumerate(shards):
+            if i == 0:
+                continue
+            t = threading.Thread(
+                target=run_shard, args=(i, dom, start, end), daemon=True,
+                name=f"supervisor-shard-{dom.handle.label}",
+            )
+            threads.append(t)
+            t.start()
+        run_shard(0, *shards[0])
+        # every shard is bounded by its own watchdog + CPU fallback;
+        # this join bound only guards against a pathological scheduler
+        # stall, so it is generous rather than tight
+        deadline = time.monotonic() + self._timeout_s * 2.0 + 30.0
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        mask: List[bool] = [False] * len(items)
+        for i, (dom, start, end) in enumerate(shards):
+            if results[i] is None:
+                results[i] = self._cpu_verify(items[start:end])
+                outcomes[i] = "wedged_cpu"
+            mask[start:end] = results[i]
+        span.end(
+            outcome="sharded", shards=len(shards), n_healthy=n_healthy,
+            shard_outcomes=",".join(o or "?" for o in outcomes),
+        )
+        return mask
+
+    def _supervise_shard(
+        self,
+        dom: _Domain,
+        items: List[Item],
+        reason: str,
+        origins: Optional[Sequence[Origin]],
+    ):
+        """The per-domain supervised verify — the full degradation
+        ladder (retry/hedge/shrink → breaker strike → CPU fallback),
+        triage, and audit for ONE fault domain's share of the batch.
+        → (mask, outcome-tag)."""
+        try:
+            mask, source = self._dispatch_adaptive(dom, items, reason)
+        except WatchdogTimeout as exc:
+            self.metrics.watchdog_kills.add()
+            self._trip(
+                dom, "watchdog", err=str(exc), n=len(items), reason=reason
+            )
+            return self._cpu_verify(items), "watchdog_cpu"
+        except Exception as exc:  # noqa: BLE001 - any backend death
+            self._note_failure(dom, exc, len(items), reason)
+            return self._cpu_verify(items), "failure_cpu"
+        if source != "device":
+            # the CPU hedge won the race: its verdicts ARE the ground
+            # truth — nothing to audit or triage, and the device's
+            # health is judged by the loser-audit in the hedge path,
+            # not by this batch's success
+            return mask, "hedge_cpu"
+        self._note_success(dom)
+        self._note_clean_dispatch(dom)
+        if not all(mask):
+            # a mixed verdict is never released at lane granularity
+            # on device faith alone — localize and confirm
+            mask = self._triage(dom, items, mask, reason, origins)
+        if self._audit_pct > 0 and self._should_audit():
+            if self._audit_sync:
+                asp = tracelib.child_of_current(
+                    "audit", sync=True, n_sigs=len(items)
+                )
+                cpu_mask = self._cpu_verify(items)
+                self.metrics.audits.add()
+                mismatch = cpu_mask != mask
+                asp.end(mismatch=mismatch)
+                if mismatch:
+                    self._audit_mismatch(dom, len(items))
+                    return cpu_mask, "audit_mismatch"  # truth wins, always
+            else:
+                self._enqueue_audit(dom, items, mask)
+        return mask, "device_ok"
 
     # -- internals: the retry/hedge rungs of the ladder ----------------------
 
-    def _dispatch_adaptive(self, items: List[Item], reason: str):
+    def _dispatch_adaptive(self, dom: _Domain, items: List[Item],
+                           reason: str):
         """Retry rungs: classify device errors, retry a transient once
         with jittered backoff, halve the chunk cap and retry on OOM, and
         hand everything else up for a breaker strike. → (mask, source)
@@ -599,26 +836,25 @@ class BackendSupervisor:
         transient_retries = 0
         while True:
             try:
-                return self._device_verify_hedged(items, reason)
+                return self._device_verify_hedged(dom, items, reason)
             except WatchdogTimeout:
                 raise  # the last-resort rung; never retried
             except Exception as exc:  # noqa: BLE001 - classify + retry
                 cls = classify_device_error(exc)
                 if cls == OOM:
-                    from cometbft_tpu.crypto.tpu import mesh
-
-                    if mesh.shrink_chunk_cap():
+                    if dom.handle.shrink_chunk_cap():
                         self.metrics.retries.with_labels(cls=OOM).add()
                         self.metrics.chunk_shrinks.add()
                         self._update_chunk_cap_gauge()
                         self.logger.error(
                             "device OOM; chunk cap halved, retrying",
                             err=repr(exc), n=len(items),
-                            shrink_levels=mesh.chunk_shrink_levels(),
+                            device=dom.handle.label,
+                            shrink_levels=dom.handle.chunk_shrink_levels(),
                         )
                         with tracelib.use(tracelib.child_of_current(
-                            "retry", cls=OOM,
-                            shrink_levels=mesh.chunk_shrink_levels(),
+                            "retry", cls=OOM, device=dom.handle.label,
+                            shrink_levels=dom.handle.chunk_shrink_levels(),
                         )):
                             continue
                     # already at the floor: the device is out of memory
@@ -643,7 +879,8 @@ class BackendSupervisor:
                     continue
                 raise
 
-    def _device_verify_hedged(self, items: List[Item], reason: str):
+    def _device_verify_hedged(self, dom: _Domain, items: List[Item],
+                              reason: str):
         """Watchdogged device dispatch with predictive CPU hedging.
         While the latency model is cold (or ``hedge_pct`` is 0) this is
         exactly the plain watchdogged dispatch. Once warm, a dispatch
@@ -651,10 +888,10 @@ class BackendSupervisor:
         verify and the first usable mask wins; the loser is audited for
         divergence when it completes. → (mask, source)."""
         pred = (
-            self.latency_model.predict_p99(len(items))
+            dom.latency_model.predict_p99(len(items))
             if self._hedge_pct > 0 else None
         )
-        h = self._start_device(items)
+        h = self._start_device(dom, items)
         deadline = h.t0 + self._timeout_s
         hedge_at = (
             h.t0 + pred * self._hedge_pct / 100.0
@@ -669,9 +906,9 @@ class BackendSupervisor:
                     f"device dispatch of {len(items)} items exceeded "
                     f"{self.dispatch_timeout_ms}ms; abandoned"
                 )
-            return self._reap_device(h), "device"
+            return self._reap_device(dom, h), "device"
         if h.done.wait(max(0.0, hedge_at - time.monotonic())):
-            return self._reap_device(h), "device"
+            return self._reap_device(dom, h), "device"
 
         # hedge fires: race the CPU ground truth against the device
         self.metrics.hedge_fires.add()
@@ -697,7 +934,7 @@ class BackendSupervisor:
             if dev[0] == "timeout":
                 self.metrics.watchdog_kills.add()
                 self._trip(
-                    "watchdog",
+                    dom, "watchdog",
                     err="hedged device dispatch overran "
                         "dispatch_timeout_ms",
                     n=len(items), reason=reason,
@@ -707,8 +944,9 @@ class BackendSupervisor:
                 self.logger.error(
                     "hedge loser diverged from released verdicts",
                     n=len(items), winner=race["winner"],
+                    device=dom.handle.label,
                 )
-                self._audit_mismatch(len(items))
+                self._audit_mismatch(dom, len(items))
 
         def cpu_run() -> None:
             try:
@@ -726,7 +964,7 @@ class BackendSupervisor:
                 h.span.end(error=repr(h.box["exc"]))
                 settle("device", "err", h.box["exc"])
                 return
-            self.latency_model.observe(
+            dom.latency_model.observe(
                 len(items), time.monotonic() - h.t0
             )
             h.span.end(outcome="ok")
@@ -764,21 +1002,37 @@ class BackendSupervisor:
 
     # -- canary probes -------------------------------------------------------
 
-    def probe_now(self) -> bool:
-        """One synchronous canary probe: dispatch a known-good signed
-        batch through the supervised backend under the watchdog. Success
-        closes the breaker; failure opens it (or extends the backoff).
-        Used by the node's warmup canary, tools/chaos.py, and tests.
+    def probe_now(self, device: Optional[int] = None) -> bool:
+        """Synchronous canary probe(s): dispatch a known-good signed
+        batch through the supervised backend under the watchdog, on ONE
+        fault domain (``device`` index) or every domain (None). Success
+        closes that domain's breaker; failure opens it (or extends its
+        backoff). Used by the node's warmup canary, tools/chaos.py, and
+        tests. → True iff every probed domain passed.
 
         A no-op (returns False) once the supervisor is stopped: a probe
         scheduled before shutdown must never touch a torn-down backend."""
         with self._audit_cond:
             if self._stopped:
                 return False
+        doms = (
+            list(self._domains) if device is None
+            else [self._domains[device]]
+        )
+        ok = True
+        for dom in doms:
+            ok = self._probe_domain(dom) and ok
+        return ok
+
+    def _probe_domain(self, dom: _Domain) -> bool:
+        """One canary probe against one fault domain's breaker."""
+        with self._audit_cond:
+            if self._stopped:
+                return False
         items = self._canary_items()
         err = None
         try:
-            mask = self._device_verify(items)
+            mask = self._device_verify(dom, items)
             ok = len(mask) == len(items) and all(mask)
         except WatchdogTimeout as exc:
             self.metrics.watchdog_kills.add()
@@ -786,23 +1040,33 @@ class BackendSupervisor:
         except Exception as exc:  # noqa: BLE001
             ok, err = False, exc
         newly_opened = False
+        readmitted = False
         with self._lock:
             if ok:
-                self._close_breaker_locked()
+                readmitted = dom.state == BROKEN
+                self._close_breaker_locked(dom)
             else:
-                self._backoff_s = min(self._backoff_s * 2, self._probe_max_s)
-                self._next_probe_at = time.monotonic() + self._backoff_s
-                if self._state != BROKEN:
-                    newly_opened = self._trip_locked("probe")
+                dom.backoff_s = min(dom.backoff_s * 2, self._probe_max_s)
+                dom.next_probe_at = time.monotonic() + dom.backoff_s
+                if dom.state != BROKEN:
+                    newly_opened = self._trip_locked(dom, "probe")
         if newly_opened:
             self._dump_incident("probe")
+        if readmitted:
+            self.metrics.readmissions.with_labels(
+                device=dom.handle.label
+            ).add()
         self.metrics.probes.with_labels(outcome="ok" if ok else "fail").add()
         if ok:
-            self.logger.info("verify canary probe ok", state=self.state())
+            self.logger.info(
+                "verify canary probe ok", state=self.state(),
+                device=dom.handle.label,
+            )
         else:
             self.logger.error(
                 "verify canary probe failed", err=str(err),
-                next_probe_in_s=round(self._backoff_s, 3),
+                device=dom.handle.label,
+                next_probe_in_s=round(dom.backoff_s, 3),
             )
         return ok
 
@@ -812,24 +1076,28 @@ class BackendSupervisor:
         self._spawn_bg(self.probe_now, "supervisor-canary")
 
     def _maybe_probe_async(self) -> None:
+        """Kick an exponential-backoff canary for every quarantined
+        domain that is due — each domain re-admits on its own schedule."""
         now = time.monotonic()
+        due: List[_Domain] = []
         with self._lock:
-            if (
-                self._state != BROKEN
-                or self._probing
-                or now < self._next_probe_at
-            ):
-                return
-            self._probing = True
+            for dom in self._domains:
+                if (
+                    dom.state == BROKEN
+                    and not dom.probing
+                    and now >= dom.next_probe_at
+                ):
+                    dom.probing = True
+                    due.append(dom)
+        for dom in due:
+            def run(dom: _Domain = dom) -> None:
+                try:
+                    self._probe_domain(dom)
+                finally:
+                    with self._lock:
+                        dom.probing = False
 
-        def run():
-            try:
-                self.probe_now()
-            finally:
-                with self._lock:
-                    self._probing = False
-
-        self._spawn_bg(run, "supervisor-probe")
+            self._spawn_bg(run, f"supervisor-probe-{dom.handle.label}")
 
     def _spawn_bg(self, target, name: str) -> None:
         """Start a background probe/canary thread, tracked so stop()
@@ -865,18 +1133,25 @@ class BackendSupervisor:
                 # bounded: an in-flight probe is itself bounded by the
                 # dispatch watchdog, so this join cannot hang shutdown
                 t.join(timeout=self._timeout_s + 5.0)
+        # a restarted supervisor must not inherit a shrunken chunk cap
+        # (or any other per-device runtime state) from this lifecycle's
+        # incidents
+        self.topology.reset_runtime_state()
 
     # -- internals: dispatch -------------------------------------------------
 
-    def _start_device(self, items: List[Item]) -> "_DeviceCall":
+    def _start_device(self, dom: _Domain, items: List[Item]) -> "_DeviceCall":
         """Launch the wrapped backend on a watchdog-abandonable worker
         thread and return immediately with the call handle. A call that
         outlives its wait is abandoned: its thread keeps the hardware
         handle (nothing can safely interrupt an XLA dispatch) but exits
-        at the next chunk boundary through the cancel event."""
+        at the next chunk boundary through the cancel event. The target
+        fault domain's handle is installed as the worker's device scope,
+        so the mesh chunk loop caps chunks by THIS device's shrink
+        ladder and fault injection can target one domain."""
         # import OUTSIDE the timed region so a cold jax import can never
         # eat the first dispatch's timeout budget
-        from cometbft_tpu.crypto.tpu import mesh
+        from cometbft_tpu.crypto.tpu import mesh, topology
 
         self.metrics.device_dispatches.add()
         h = _DeviceCall()
@@ -884,12 +1159,14 @@ class BackendSupervisor:
         # supervise/dispatch span) and installed inside the worker so the
         # mesh chunk loop's spans nest under it across the thread hop
         h.span = tracelib.child_of_current(
-            "device", n_sigs=len(items), backend=self.spec.name
+            "device", n_sigs=len(items), backend=self.spec.name,
+            device=dom.handle.label,
         )
 
         def run():
             try:
-                with tracelib.use(h.span), mesh.cancel_scope(h.cancel):
+                with tracelib.use(h.span), mesh.cancel_scope(h.cancel), \
+                        topology.device_scope(dom.handle):
                     bv = new_batch_verifier(self.spec)
                     for pk, m, s in items:
                         bv.add(pk, m, s)
@@ -912,20 +1189,20 @@ class BackendSupervisor:
         ).start()
         return h
 
-    def _reap_device(self, h: "_DeviceCall") -> List[bool]:
+    def _reap_device(self, dom: _Domain, h: "_DeviceCall") -> List[bool]:
         """Collect a completed device call: re-raise its exception or
-        return its mask, feeding the latency model on success."""
+        return its mask, feeding the domain's latency model on success."""
         if "exc" in h.box:
             h.span.end(error=repr(h.box["exc"]))
             raise h.box["exc"]
-        self.latency_model.observe(h.n, time.monotonic() - h.t0)
+        dom.latency_model.observe(h.n, time.monotonic() - h.t0)
         h.span.end(outcome="ok")
         return h.box["mask"]
 
-    def _device_verify(self, items: List[Item]) -> List[bool]:
+    def _device_verify(self, dom: _Domain, items: List[Item]) -> List[bool]:
         """Plain watchdogged device dispatch (no hedging): used by the
         canary probe and the triage bisection passes."""
-        h = self._start_device(items)
+        h = self._start_device(dom, items)
         if not h.done.wait(self._timeout_s):
             h.cancel.set()  # the zombie exits at its next chunk boundary
             # span end is first-wins: the zombie's late spans are dropped
@@ -934,12 +1211,13 @@ class BackendSupervisor:
                 f"device dispatch of {len(items)} items exceeded "
                 f"{self.dispatch_timeout_ms}ms; abandoned"
             )
-        return self._reap_device(h)
+        return self._reap_device(dom, h)
 
     # -- internals: failed-batch triage --------------------------------------
 
     def _triage(
         self,
+        dom: _Domain,
         items: List[Item],
         claimed: List[bool],
         reason: str,
@@ -982,12 +1260,14 @@ class BackendSupervisor:
             while segments and passes < max_passes:
                 lanes = [k for s, e in segments for k in range(s, e)]
                 try:
-                    sub = self._device_verify([items[k] for k in lanes])
+                    sub = self._device_verify(
+                        dom, [items[k] for k in lanes]
+                    )
                 except WatchdogTimeout as exc:
                     # a hang mid-triage is a real incident, not advisory
                     self.metrics.watchdog_kills.add()
                     self._trip(
-                        "watchdog", err=str(exc), n=len(lanes),
+                        dom, "watchdog", err=str(exc), n=len(lanes),
                         reason=reason,
                     )
                     fell_back = True
@@ -1048,9 +1328,9 @@ class BackendSupervisor:
                 self.metrics.triage_divergence.add(overturned)
                 self.logger.error(
                     "triage convictions overturned by CPU ground truth",
-                    n=overturned, reason=reason,
+                    n=overturned, reason=reason, device=dom.handle.label,
                 )
-                self._audit_mismatch(overturned)
+                self._audit_mismatch(dom, overturned)
             offenders = sum(1 for ok in mask if not ok)
             self._attribute_offenders(mask, origins, reason)
         span.end(
@@ -1086,24 +1366,30 @@ class BackendSupervisor:
 
     # -- internals: adaptive chunk cap ---------------------------------------
 
-    def _note_clean_dispatch(self) -> None:
-        from cometbft_tpu.crypto.tpu import mesh
-
-        if mesh.note_clean_dispatch(self._chunk_recover_n):
+    def _note_clean_dispatch(self, dom: _Domain) -> None:
+        if dom.handle.note_clean_dispatch(self._chunk_recover_n):
             self.metrics.chunk_recoveries.add()
             self._update_chunk_cap_gauge()
             self.logger.info(
                 "chunk cap recovered one doubling",
-                shrink_levels=mesh.chunk_shrink_levels(),
+                device=dom.handle.label,
+                shrink_levels=dom.handle.chunk_shrink_levels(),
             )
 
     def _update_chunk_cap_gauge(self) -> None:
-        from cometbft_tpu.crypto.tpu import mesh
-
+        default = self.spec.max_chunk or 8192
         try:
-            self.metrics.chunk_cap.set(
-                mesh.effective_chunk_cap(self.spec.max_chunk or 8192)
-            )
+            caps = [
+                d.handle.chunk_cap(default, 64) for d in self._domains
+            ]
+            # the parent series stays the most-constrained device's cap
+            # (identical to the old node-global gauge with one domain);
+            # each device also exports its own child series
+            self.metrics.chunk_cap.set(min(caps))
+            for d, cap in zip(self._domains, caps):
+                self.metrics.chunk_cap.with_labels(
+                    device=d.handle.label
+                ).set(cap)
         except ValueError:
             pass  # malformed CBFT_TPU_MAX_CHUNK surfaces at dispatch
 
@@ -1129,55 +1415,83 @@ class BackendSupervisor:
 
     # -- internals: breaker state machine ------------------------------------
 
-    def _note_success(self) -> None:
-        with self._lock:
-            if self._state == BROKEN:
-                return  # only a probe may close an open breaker
-            self._consecutive_failures = 0
-            if self._state == DEGRADED:
-                self._state = HEALTHY
-                self.metrics.state.set(_STATE_CODE[HEALTHY])
+    def _set_state_locked(self, dom: _Domain, new_state: str) -> None:
+        """Move one domain's breaker and refresh both gauges: the
+        per-device breaker_state{device=} series and the aggregate node
+        state the pre-topology consumers watch."""
+        dom.state = new_state
+        self.metrics.breaker_state.with_labels(
+            device=dom.handle.label
+        ).set(_STATE_CODE[new_state])
+        self.metrics.state.set(
+            _STATE_CODE[self._aggregate_state_locked()]
+        )
 
-    def _note_failure(self, exc: BaseException, n: int, reason: str) -> None:
+    def _note_success(self, dom: _Domain) -> None:
+        with self._lock:
+            if dom.state == BROKEN:
+                return  # only a probe may close an open breaker
+            dom.consecutive_failures = 0
+            if dom.state == DEGRADED:
+                self._set_state_locked(dom, HEALTHY)
+
+    def _note_failure(
+        self, dom: _Domain, exc: BaseException, n: int, reason: str
+    ) -> None:
         self.metrics.failures.add()
         self.logger.error(
             "supervised verify dispatch failed; falling back to CPU",
             err=repr(exc), n=n, reason=reason, backend=self.spec.name,
+            device=dom.handle.label,
         )
         with self._lock:
-            self._consecutive_failures += 1
-            if self._consecutive_failures >= self._threshold:
-                self._trip_locked("failures")
-            elif self._state == HEALTHY:
-                self._state = DEGRADED
-                self.metrics.state.set(_STATE_CODE[DEGRADED])
+            dom.consecutive_failures += 1
+            if dom.consecutive_failures >= self._threshold:
+                self._trip_locked(dom, "failures")
+            elif dom.state == HEALTHY:
+                self._set_state_locked(dom, DEGRADED)
 
-    def _trip(self, cause: str, **kv) -> None:
-        self.logger.error(f"verify circuit breaker opened ({cause})", **kv)
+    def _trip(self, dom: _Domain, cause: str, **kv) -> None:
+        self.logger.error(
+            f"verify circuit breaker opened ({cause})",
+            device=dom.handle.label, **kv,
+        )
         with self._lock:
-            newly_opened = self._trip_locked(cause)
+            newly_opened = self._trip_locked(dom, cause)
         if newly_opened:
             self._dump_incident(cause)
 
-    def _trip_locked(self, cause: str) -> bool:
-        """Open the breaker; True if it was not already open (so callers
-        can fire once-per-incident actions outside the lock)."""
-        newly_opened = self._state != BROKEN
+    def _trip_locked(self, dom: _Domain, cause: str) -> bool:
+        """Open one domain's breaker; True if it was not already open
+        (so callers can fire once-per-incident actions outside the
+        lock). A trip that leaves other domains serving is a quarantine,
+        not a node outage — counted per device."""
+        newly_opened = dom.state != BROKEN
         if newly_opened:
             self.metrics.trips.with_labels(cause=cause).add()
-        self._state = BROKEN
-        self.metrics.state.set(_STATE_CODE[BROKEN])
-        self._backoff_s = self._probe_base_s
-        self._next_probe_at = time.monotonic() + self._backoff_s
+            self.metrics.quarantines.with_labels(
+                device=dom.handle.label
+            ).add()
+        self._set_state_locked(dom, BROKEN)
+        dom.backoff_s = self._probe_base_s
+        dom.next_probe_at = time.monotonic() + dom.backoff_s
         return newly_opened
 
     def _dump_incident(self, cause: str) -> None:
         """Write the trace flight recorder to disk so the dispatches that
         led up to a watchdog trip / circuit-break are post-mortem
         debuggable. Best-effort: a dump failure must never take down the
-        verify path."""
+        verify path. The per-device breaker states ride along so the
+        post-mortem shows WHICH fault domain was sick."""
         try:
-            path = self._tracer.dump(cause)
+            try:
+                path = self._tracer.dump(
+                    cause,
+                    extra={"device_breaker_states": self.device_states()},
+                )
+            except TypeError:
+                # a custom tracer predating the extra= parameter
+                path = self._tracer.dump(cause)
         except Exception:  # noqa: BLE001 - diagnostics only
             return
         if path:
@@ -1186,14 +1500,15 @@ class BackendSupervisor:
                 cause=cause, path=path,
             )
 
-    def _close_breaker_locked(self) -> None:
-        if self._state != HEALTHY:
-            self.logger.info("verify circuit breaker closed")
-        self._state = HEALTHY
-        self._consecutive_failures = 0
-        self._backoff_s = self._probe_base_s
-        self._next_probe_at = 0.0
-        self.metrics.state.set(_STATE_CODE[HEALTHY])
+    def _close_breaker_locked(self, dom: _Domain) -> None:
+        if dom.state != HEALTHY:
+            self.logger.info(
+                "verify circuit breaker closed", device=dom.handle.label
+            )
+        self._set_state_locked(dom, HEALTHY)
+        dom.consecutive_failures = 0
+        dom.backoff_s = self._probe_base_s
+        dom.next_probe_at = 0.0
 
     # -- internals: corruption audit -----------------------------------------
 
@@ -1203,18 +1518,20 @@ class BackendSupervisor:
         with self._lock:
             return self._rng.random() * 100.0 < self._audit_pct
 
-    def _audit_mismatch(self, n: int) -> None:
+    def _audit_mismatch(self, dom: _Domain, n: int) -> None:
         self.metrics.audit_mismatches.add()
-        self._trip("audit", n=n)
+        self._trip(dom, "audit", n=n)
 
-    def _enqueue_audit(self, items: List[Item], mask: List[bool]) -> None:
+    def _enqueue_audit(
+        self, dom: _Domain, items: List[Item], mask: List[bool]
+    ) -> None:
         with self._audit_cond:
             if self._stopped:
                 return
             if len(self._audit_queue) >= _AUDIT_QUEUE_CAP:
                 self.metrics.audit_drops.add()
                 return
-            self._audit_queue.append((items, mask))
+            self._audit_queue.append((dom, items, mask))
             if self._audit_worker is None or not self._audit_worker.is_alive():
                 self._audit_worker = threading.Thread(
                     target=self._audit_run, daemon=True,
@@ -1230,7 +1547,7 @@ class BackendSupervisor:
                     self._audit_cond.wait(1.0)
                 if self._stopped:
                     return
-                items, mask = self._audit_queue.popleft()
+                dom, items, mask = self._audit_queue.popleft()
             span = self._tracer.start_span(
                 "audit", sync=False, n_sigs=len(items)
             )
@@ -1245,7 +1562,7 @@ class BackendSupervisor:
             mismatch = cpu_mask != mask
             span.end(mismatch=mismatch)
             if mismatch:
-                self._audit_mismatch(len(items))
+                self._audit_mismatch(dom, len(items))
 
 
 class SupervisedBatchVerifier(BatchVerifier):

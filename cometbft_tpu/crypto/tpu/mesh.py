@@ -172,18 +172,14 @@ def configure_chunk_cap(cap: Optional[int]) -> None:
     _configured_cap = cap
 
 
-def chunk_cap(default: int, min_pad: int) -> int:
-    """Resolve the dispatch chunk cap: CBFT_TPU_MAX_CHUNK (validated)
-    beats the configured [crypto] max_chunk beats the caller's per-curve
-    default; the winner is rounded UP to a power of two, so the
-    dispatched bucket always equals a padded shape and warmup covers it.
-    One knob governs every curve kernel — the cap tunes a property of
-    the LINK (per-dispatch cost vs bytes), not of a curve.
-
-    The resolved cap is then halved once per active OOM shrink level
-    (shrink_chunk_cap / note_clean_dispatch below), never below min_pad
-    — a RESOURCE_EXHAUSTED device keeps serving smaller chunks instead
-    of being abandoned wholesale."""
+def resolve_chunk_cap(default: int, min_pad: int) -> int:
+    """Resolve the node-wide dispatch chunk cap, BEFORE any per-device
+    OOM shrink: CBFT_TPU_MAX_CHUNK (validated) beats the configured
+    [crypto] max_chunk beats the caller's per-curve default; the winner
+    is rounded UP to a power of two, so the dispatched bucket always
+    equals a padded shape and warmup covers it. One knob governs every
+    curve kernel — the cap tunes a property of the LINK (per-dispatch
+    cost vs bytes), not of a curve."""
     raw = os.environ.get("CBFT_TPU_MAX_CHUNK")
     if raw is None:
         if _configured_cap is None:
@@ -206,7 +202,17 @@ def chunk_cap(default: int, min_pad: int) -> int:
     size = min_pad
     while size < cap:
         size *= 2
-    return max(min_pad, size >> chunk_shrink_levels())
+    return size
+
+
+def chunk_cap(default: int, min_pad: int) -> int:
+    """The resolved cap halved once per active OOM shrink level of the
+    DEFAULT device (topology device 0), never below min_pad — a
+    RESOURCE_EXHAUSTED device keeps serving smaller chunks instead of
+    being abandoned wholesale. Per-device callers use
+    DeviceHandle.chunk_cap (crypto/tpu/topology.py) instead."""
+    return max(min_pad, resolve_chunk_cap(default, min_pad)
+               >> chunk_shrink_levels())
 
 
 # --- OOM-adaptive chunk cap (runtime shrink / hysteretic recovery) ----------
@@ -215,57 +221,50 @@ def chunk_cap(default: int, min_pad: int) -> int:
 # fragmented allocator). The supervisor halves the effective cap and
 # retries instead of striking the breaker; the cap recovers one doubling
 # per N clean dispatches (hysteresis: one stray OOM must not oscillate
-# the chunk size). Module state mirrors _configured_cap: the cap tunes
-# the LINK, so one shrink level governs every curve kernel.
+# the chunk size).
+#
+# The shrink ladder is PER FAULT DOMAIN (crypto/tpu/topology.py
+# DeviceHandle) — one over-chunked chip must not shrink its healthy
+# neighbors' dispatches. The module-level functions below are the
+# single-device shim: they delegate to the default topology's device 0,
+# so pre-topology callers and tests see the exact old behavior.
 
 MAX_SHRINK_LEVELS = 6  # 8192 → 128 floor; min_pad clamps earlier anyway
 
-_shrink_mtx = threading.Lock()
-_shrink_levels = 0
-_clean_streak = 0
+
+def _shim_device():
+    """Device 0 of the process-default topology — the fault domain the
+    legacy module-global chunk-cap API maps onto."""
+    from cometbft_tpu.crypto.tpu import topology
+
+    return topology.default_topology().device(0)
 
 
 def chunk_shrink_levels() -> int:
-    """How many halvings are currently applied to the resolved cap."""
-    with _shrink_mtx:
-        return _shrink_levels
+    """How many halvings are applied to the default device's cap."""
+    return _shim_device().chunk_shrink_levels()
 
 
 def shrink_chunk_cap() -> bool:
-    """Halve the effective chunk cap (one more shrink level) after a
-    device OOM. → True if a level was added, False at the floor (the
-    caller should then treat the OOM as persistent)."""
-    global _shrink_levels, _clean_streak
-    with _shrink_mtx:
-        _clean_streak = 0  # an OOM restarts the recovery hysteresis
-        if _shrink_levels >= MAX_SHRINK_LEVELS:
-            return False
-        _shrink_levels += 1
-        return True
+    """Halve the default device's effective chunk cap after an OOM.
+    → True if a level was added, False at the floor (the caller should
+    then treat the OOM as persistent)."""
+    return _shim_device().shrink_chunk_cap()
 
 
 def note_clean_dispatch(recover_n: int) -> bool:
-    """Record one clean device dispatch; after ``recover_n`` consecutive
-    clean dispatches one shrink level is removed (the cap recovers one
-    doubling). → True when a level was recovered on this call."""
-    global _shrink_levels, _clean_streak
-    with _shrink_mtx:
-        if _shrink_levels == 0:
-            return False
-        _clean_streak += 1
-        if _clean_streak < max(1, recover_n):
-            return False
-        _clean_streak = 0
-        _shrink_levels -= 1
-        return True
+    """Record one clean dispatch on the default device; after
+    ``recover_n`` consecutive clean dispatches one shrink level is
+    removed. → True when a level was recovered on this call."""
+    return _shim_device().note_clean_dispatch(recover_n)
 
 
 def reset_chunk_shrink() -> None:
-    """Drop all shrink state (tests, chaos harness setup)."""
-    global _shrink_levels, _clean_streak
-    with _shrink_mtx:
-        _shrink_levels = 0
-        _clean_streak = 0
+    """Drop the DEFAULT TOPOLOGY's shrink state — every device, not just
+    device 0 (supervisor stop, tests, chaos harness setup)."""
+    from cometbft_tpu.crypto.tpu import topology
+
+    topology.default_topology().reset_runtime_state()
 
 
 def effective_chunk_cap(default: int = 8192, min_pad: int = 64) -> int:
@@ -316,11 +315,19 @@ def donating_kernel(kernel, nargs: int, donate_from: int = 0):
     return step
 
 
-def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
+def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
+                   device=None):
     """Shared chunk-pad-dispatch loop for batch verify kernels (used by
     all three curve entries): pads each chunk's trailing batch axis to a
     power of two (rounded to equal per-device shards), shards over the
     mesh when >1 device is visible, and gathers the boolean masks.
+
+    ``device`` is an optional topology.DeviceHandle naming the fault
+    domain this dispatch runs against; when omitted the thread's
+    device_scope (installed by the supervisor) is consulted, and with
+    neither the default device-0 chunk cap applies. The handle only
+    selects WHOSE OOM-shrink ladder caps the chunk size — placement
+    stays with jax.
 
     Double-buffered: at most pipeline_depth() (default 2) chunk
     dispatches are in flight — the host packs and device_puts chunk N+1
@@ -342,7 +349,14 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int):
 
     import numpy as np
 
-    max_chunk = chunk_cap(max_chunk, min_pad)
+    if device is None:
+        from cometbft_tpu.crypto.tpu import topology
+
+        device = topology.current_device()
+    if device is not None:
+        max_chunk = device.chunk_cap(max_chunk, min_pad)
+    else:
+        max_chunk = chunk_cap(max_chunk, min_pad)
     ndev = n_devices()
     depth = pipeline_depth()
     out = np.zeros(n, bool)

@@ -1,0 +1,263 @@
+"""Device topology — the verification plane's fault domains as a
+first-class registry.
+
+ROADMAP item 1 names the blocker for multi-chip sharding: supervision
+state (circuit breaker, chunk-cap shrink, latency model, canary
+backoff) was node-global, so one sick chip tripped the whole node to
+CPU. This module makes the *unit of failure* explicit:
+
+* a ``DeviceHandle`` is ONE fault domain — a physical accelerator chip,
+  a logical shard of a virtual CPU mesh, or the host fallback plane —
+  and owns the runtime state the DISPATCH layer needs per device (the
+  OOM-adaptive chunk-cap shrink ladder that used to be module-global in
+  crypto/tpu/mesh.py);
+* a ``DeviceTopology`` enumerates the node's fault domains: one chip
+  (``single``), an N-device mesh (``detect`` — real chips or the
+  virtual CPU mesh ``XLA_FLAGS=--xla_force_host_platform_device_count``
+  creates), or N logical domains for tests and chaos harnesses
+  (``virtual``);
+* ``device_scope`` installs a handle as the calling thread's dispatch
+  target, the same thread-local pattern as mesh.cancel_scope — the
+  mesh chunk loop reads it for the per-device chunk cap, and fault
+  injection (crypto/faults.py ``CBFT_FAULT_DEVICE``) reads it to scope
+  faults to one domain.
+
+The supervisor (crypto/supervisor.py) shards its breaker / probe /
+latency state over the topology: a BROKEN device is quarantined (its
+share of the batch axis redistributed to the healthy devices) while the
+survivors keep serving, and only all-devices-BROKEN routes the node to
+CPU.
+
+Back-compat: the module-global chunk-cap functions in mesh.py
+(``shrink_chunk_cap`` & co.) are now shims over the DEFAULT topology's
+device 0, so single-device callers and existing tests see identical
+behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Iterator, List, Optional
+
+KIND_CHIP = "chip"        # one physical accelerator
+KIND_MESH = "mesh"        # member of a multi-device mesh
+KIND_VIRTUAL = "virtual"  # logical domain (virtual CPU mesh, tests)
+KIND_CPU = "cpu"          # the host fallback plane
+
+
+class DeviceHandle:
+    """One fault domain. Owns the per-device OOM-adaptive chunk-cap
+    ladder (halve on RESOURCE_EXHAUSTED, recover one doubling per N
+    clean dispatches — hysteresis, see mesh.py); everything else that
+    is per-domain (breaker, probes, latency model) lives with the
+    supervisor's domain records, keyed by this handle."""
+
+    def __init__(self, index: int, kind: str = KIND_VIRTUAL):
+        self.index = int(index)
+        self.kind = kind
+        self.label = f"dev{int(index)}"
+        self._mtx = threading.Lock()
+        self._shrink_levels = 0
+        self._clean_streak = 0
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"DeviceHandle({self.label}, kind={self.kind})"
+
+    # -- per-device OOM-adaptive chunk cap -----------------------------------
+
+    def chunk_shrink_levels(self) -> int:
+        """How many halvings are currently applied to this device's cap."""
+        with self._mtx:
+            return self._shrink_levels
+
+    def shrink_chunk_cap(self) -> bool:
+        """Halve this device's effective chunk cap after an OOM. → True
+        if a level was added, False at the floor (the caller should then
+        treat the OOM as persistent)."""
+        from cometbft_tpu.crypto.tpu import mesh
+
+        with self._mtx:
+            self._clean_streak = 0  # an OOM restarts the hysteresis
+            if self._shrink_levels >= mesh.MAX_SHRINK_LEVELS:
+                return False
+            self._shrink_levels += 1
+            return True
+
+    def note_clean_dispatch(self, recover_n: int) -> bool:
+        """Record one clean dispatch on this device; after ``recover_n``
+        consecutive clean dispatches one shrink level is removed. → True
+        when a level was recovered on this call."""
+        with self._mtx:
+            if self._shrink_levels == 0:
+                return False
+            self._clean_streak += 1
+            if self._clean_streak < max(1, recover_n):
+                return False
+            self._clean_streak = 0
+            self._shrink_levels -= 1
+            return True
+
+    def reset_chunk_shrink(self) -> None:
+        """Drop this device's shrink state (supervisor stop, topology
+        change, tests) — a restarted supervisor must not inherit a
+        shrunken cap from a previous incident."""
+        with self._mtx:
+            self._shrink_levels = 0
+            self._clean_streak = 0
+
+    def chunk_cap(self, default: int, min_pad: int) -> int:
+        """The dispatch chunk cap THIS device serves right now: the
+        node-wide resolved cap (env > config > per-curve default, pow2)
+        halved once per active shrink level, floored at min_pad."""
+        from cometbft_tpu.crypto.tpu import mesh
+
+        size = mesh.resolve_chunk_cap(default, min_pad)
+        return max(min_pad, size >> self.chunk_shrink_levels())
+
+    def capacity_fraction(self) -> float:
+        """This device's share of its own nominal lane capacity
+        (1.0 unshrunk, halved per active OOM shrink level) — the weight
+        the supervisor's batch-axis partition and the scheduler's
+        healthy lane budget use."""
+        return 1.0 / float(1 << self.chunk_shrink_levels())
+
+
+class DeviceTopology:
+    """Registry of the node's verification fault domains."""
+
+    def __init__(self, devices: List[DeviceHandle], kind: str = KIND_VIRTUAL):
+        if not devices:
+            raise ValueError("a topology needs at least one device")
+        self.devices = list(devices)
+        self.kind = kind
+
+    # -- constructors --------------------------------------------------------
+
+    @classmethod
+    def single(cls, kind: str = KIND_CHIP) -> "DeviceTopology":
+        """The 1-chip (or plain-CPU-plane) topology — the default; every
+        pre-topology behavior maps onto its device 0."""
+        return cls([DeviceHandle(0, kind)], kind)
+
+    @classmethod
+    def virtual(cls, n: int) -> "DeviceTopology":
+        """``n`` logical fault domains with no hardware binding — chaos
+        harnesses, tests, and the CBFT_FAULT_DOMAINS operator knob."""
+        n = max(1, int(n))
+        return cls([DeviceHandle(i, KIND_VIRTUAL) for i in range(n)],
+                   KIND_VIRTUAL)
+
+    @classmethod
+    def detect(cls) -> "DeviceTopology":
+        """One fault domain per visible jax device (real chips over ICI
+        or the virtual CPU mesh ``--xla_force_host_platform_device_count``
+        creates). Falls back to ``single()`` if the device plane cannot
+        be probed — topology detection must never take down node start."""
+        try:
+            from cometbft_tpu.crypto.tpu import mesh
+
+            n = mesh.n_devices()
+        except Exception:  # noqa: BLE001 - no backend / import failure
+            return cls.single()
+        if n <= 1:
+            return cls.single()
+        return cls([DeviceHandle(i, KIND_MESH) for i in range(n)], KIND_MESH)
+
+    # -- registry ------------------------------------------------------------
+
+    def device(self, index: int) -> DeviceHandle:
+        return self.devices[index]
+
+    def labels(self) -> List[str]:
+        return [d.label for d in self.devices]
+
+    def __len__(self) -> int:
+        return len(self.devices)
+
+    def __iter__(self) -> Iterator[DeviceHandle]:
+        return iter(self.devices)
+
+    def reset_runtime_state(self) -> None:
+        """Drop every device's runtime (shrink) state — called on
+        supervisor stop and on topology change so no incident state
+        leaks into the next lifecycle."""
+        for d in self.devices:
+            d.reset_chunk_shrink()
+
+
+# --- default topology (process-wide, like mesh._configured_cap) -------------
+
+_mtx = threading.Lock()
+_default: Optional[DeviceTopology] = None
+
+
+def default_topology() -> DeviceTopology:
+    """The process default: lazily a single-device topology. Node start
+    installs a detected/configured one via set_default_topology. The
+    mesh module's legacy chunk-cap globals are shims over THIS
+    topology's device 0."""
+    global _default
+    with _mtx:
+        if _default is None:
+            _default = DeviceTopology.single()
+        return _default
+
+
+def set_default_topology(topo: DeviceTopology) -> DeviceTopology:
+    """Install ``topo`` as the process default. Runtime state of both
+    the outgoing and incoming topologies is reset — a topology change is
+    an incident boundary; shrink levels calibrated against the old
+    fault domains are meaningless against the new ones."""
+    global _default
+    with _mtx:
+        old, _default = _default, topo
+    if old is not None and old is not topo:
+        old.reset_runtime_state()
+    topo.reset_runtime_state()
+    return topo
+
+
+def fault_domains_default(config_value: Optional[int] = None) -> int:
+    """[crypto] fault_domains resolution: CBFT_FAULT_DOMAINS env >
+    config > 1. 0 means auto-detect (one domain per visible device);
+    any N >= 1 forces N logical domains."""
+    raw = os.environ.get("CBFT_FAULT_DOMAINS")
+    if raw is not None:
+        return int(raw)
+    if config_value is not None:
+        return int(config_value)
+    return 1
+
+
+# --- thread-local device scope ----------------------------------------------
+# Same pattern as mesh.cancel_scope: the supervisor installs the target
+# domain's handle on the dispatching thread; the mesh chunk loop reads
+# it for the per-device chunk cap, fault injection reads it to target
+# one domain. Strictly thread-local, so concurrent dispatches to
+# different devices never see each other's handle.
+
+_scope_local = threading.local()
+
+
+def current_device() -> Optional[DeviceHandle]:
+    """The device handle installed on THIS thread, if any."""
+    return getattr(_scope_local, "device", None)
+
+
+class device_scope:
+    """Context manager installing ``handle`` as this thread's dispatch
+    target device; nests (restores the previous handle on exit)."""
+
+    def __init__(self, handle: DeviceHandle):
+        self._handle = handle
+        self._prev = None
+
+    def __enter__(self) -> DeviceHandle:
+        self._prev = getattr(_scope_local, "device", None)
+        _scope_local.device = self._handle
+        return self._handle
+
+    def __exit__(self, *exc_info) -> bool:
+        _scope_local.device = self._prev
+        return False

@@ -346,13 +346,21 @@ class Tracer:
             self._buffer.clear()
             self._open.clear()
 
-    def dump(self, reason: str, path: Optional[str] = None) -> Optional[str]:
+    def dump(
+        self,
+        reason: str,
+        path: Optional[str] = None,
+        extra: Optional[dict] = None,
+    ) -> Optional[str]:
         """Write the flight recorder to a JSON file; returns the path.
 
         Destination: explicit ``path`` > ``CBFT_TRACE_DUMP_DIR`` env >
         configured dump dir.  Returns None (no-op) when no destination is
         configured.  The filename is keyed by reason so repeated incidents
-        overwrite rather than grow unboundedly.
+        overwrite rather than grow unboundedly.  ``extra`` (a JSON-able
+        dict) is merged into the document — the supervisor records the
+        per-device breaker states here so an incident dump shows which
+        fault domain was sick.
         """
         if path is None:
             dump_dir = os.environ.get("CBFT_TRACE_DUMP_DIR") or self._dump_dir
@@ -366,6 +374,8 @@ class Tracer:
             "sample": self.sample,
             "traces": self.recent(),
         }
+        if extra:
+            doc.update(extra)
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             tmp = path + ".tmp"

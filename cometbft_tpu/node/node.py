@@ -227,6 +227,27 @@ class Node(BaseService):
         from cometbft_tpu.crypto.scheduler import VerifyScheduler
         from cometbft_tpu.crypto.supervisor import BackendSupervisor
 
+        # 0a'. the device topology the supervisor shards its fault
+        # state over: [crypto] fault_domains (CBFT_FAULT_DOMAINS wins)
+        # selects single-domain (1, default), an N-domain virtual mesh
+        # (N > 1), or auto-detection from the visible device plane (0).
+        # Installed as the process default so the mesh dispatch layer's
+        # single-device shim and any standalone verifier resolve the
+        # same registry (crypto/tpu/topology.py).
+        from cometbft_tpu.crypto.tpu import topology as topolib
+
+        n_domains = topolib.fault_domains_default(
+            config.crypto.fault_domains
+        )
+        if n_domains <= 0:
+            verify_topology = topolib.DeviceTopology.detect()
+        elif n_domains == 1:
+            verify_topology = topolib.DeviceTopology.single()
+        else:
+            verify_topology = topolib.DeviceTopology.virtual(n_domains)
+        topolib.set_default_topology(verify_topology)
+        self.verify_topology = verify_topology
+
         # 0a. the backend supervisor: every coalesced dispatch runs
         # under its watchdog / circuit breaker / corruption audit, so a
         # wedged, dying, or silently-wrong device plane degrades to the
@@ -243,6 +264,7 @@ class Node(BaseService):
             metrics=sup_metrics,
             logger=self.logger,
             tracer=self.tracer,
+            topology=verify_topology,
         )
         self.verify_scheduler = VerifyScheduler(
             spec=self.crypto_spec,

@@ -1,0 +1,408 @@
+"""Per-device fault domains: topology registry, partial-mesh
+degradation, and device-targeted chaos.
+
+Contract under test (crypto/tpu/topology.py, crypto/supervisor.py,
+crypto/scheduler.py, crypto/faults.py):
+  - the DeviceTopology registry shards supervision state per fault
+    domain; the legacy mesh.py module-global chunk-cap functions are a
+    back-compat shim over the default topology's device 0;
+  - a fault injected on ONE domain quarantines only that domain: the
+    survivors keep serving the device path (no node-wide CPU fallback)
+    with the batch axis redistributed over them, verdicts always equal
+    to the CPU ground truth;
+  - the quarantined domain is re-admitted by ITS OWN canary, on its own
+    backoff schedule; only all-domains-BROKEN routes the node to CPU;
+  - the scheduler's size-flush threshold scales to the healthy-domain
+    capacity, and stop() during an in-flight quarantine/canary cannot
+    deadlock;
+  - per-device runtime state (OOM chunk-shrink) is reset on supervisor
+    stop and on topology change — no incident state leaks into the
+    next lifecycle.
+"""
+
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto.batch import BackendSpec, CPUBatchVerifier
+from cometbft_tpu.crypto.faults import (
+    FaultPlan,
+    install,
+    run_chaos_multidevice,
+)
+from cometbft_tpu.crypto.scheduler import VerifyScheduler
+from cometbft_tpu.crypto.supervisor import (
+    BROKEN,
+    DEGRADED,
+    HEALTHY,
+    BackendSupervisor,
+)
+from cometbft_tpu.crypto.tpu import mesh, topology
+
+
+def _make_items(n, tag=b"", poison_at=None):
+    items = []
+    for i in range(n):
+        k = ed.gen_priv_key_from_secret(tag + bytes([i & 0xFF, i >> 8]))
+        msg = b"fault-domain-msg-" + tag + i.to_bytes(4, "big")
+        sig = k.sign(msg)
+        if poison_at is not None and i == poison_at:
+            sig = b"\x00" * 64
+        items.append((k.pub_key(), msg, sig))
+    return items
+
+
+def _cpu_mask(items):
+    bv = CPUBatchVerifier()
+    for pk, m, s in items:
+        bv.add(pk, m, s)
+    _, mask = bv.verify()
+    return mask
+
+
+_seq = [0]
+
+
+def _faulty_multi(n_domains, plan=None, **sup_kwargs):
+    """A fresh FaultyBackend + supervisor sharded over an n-domain
+    virtual topology (unique backend name per call)."""
+    _seq[0] += 1
+    name = f"test-domains-{_seq[0]}"
+    plan = install(name=name, inner="cpu",
+                   plan=plan if plan is not None else FaultPlan(seed=_seq[0]))
+    topo = topology.DeviceTopology.virtual(n_domains)
+    sup_kwargs.setdefault("dispatch_timeout_ms", 2000)
+    sup_kwargs.setdefault("breaker_threshold", 1)
+    sup_kwargs.setdefault("audit_pct", 0)
+    sup_kwargs.setdefault("hedge_pct", 0)
+    # push the async canary backoff past the test unless a test opts in
+    sup_kwargs.setdefault("probe_base_ms", 60_000)
+    sup_kwargs.setdefault("probe_max_ms", 120_000)
+    sup = BackendSupervisor(spec=BackendSpec(name), topology=topo,
+                            **sup_kwargs)
+    return plan, sup, topo
+
+
+@pytest.fixture(autouse=True)
+def _restore_default_topology():
+    """Tests that install a default topology must not leak it into the
+    rest of the suite (the mesh shim and single-device supervisors
+    resolve the process default)."""
+    before = topology.default_topology()
+    yield
+    topology.set_default_topology(before)
+
+
+class TestTopologyRegistry:
+    def test_single_and_virtual_constructors(self):
+        one = topology.DeviceTopology.single()
+        assert len(one) == 1 and one.labels() == ["dev0"]
+        four = topology.DeviceTopology.virtual(4)
+        assert len(four) == 4
+        assert four.labels() == ["dev0", "dev1", "dev2", "dev3"]
+        assert [d.index for d in four] == [0, 1, 2, 3]
+        with pytest.raises(ValueError):
+            topology.DeviceTopology([])
+
+    def test_per_device_shrink_ladder_is_independent(self):
+        topo = topology.DeviceTopology.virtual(2)
+        a, b = topo.device(0), topo.device(1)
+        assert a.shrink_chunk_cap()
+        assert a.chunk_shrink_levels() == 1
+        assert b.chunk_shrink_levels() == 0  # untouched neighbor
+        assert a.capacity_fraction() == 0.5
+        assert b.capacity_fraction() == 1.0
+        # hysteretic recovery on the shrunk device only
+        assert not a.note_clean_dispatch(2)
+        assert a.note_clean_dispatch(2)
+        assert a.chunk_shrink_levels() == 0
+        # floor: MAX_SHRINK_LEVELS halvings, then False
+        for _ in range(mesh.MAX_SHRINK_LEVELS):
+            assert b.shrink_chunk_cap()
+        assert not b.shrink_chunk_cap()
+        topo.reset_runtime_state()
+        assert b.chunk_shrink_levels() == 0
+
+    def test_mesh_globals_are_shim_over_default_device0(self):
+        topo = topology.set_default_topology(
+            topology.DeviceTopology.virtual(2)
+        )
+        assert mesh.chunk_shrink_levels() == 0
+        assert mesh.shrink_chunk_cap()
+        # the module-global view IS device 0's view
+        assert topo.device(0).chunk_shrink_levels() == 1
+        assert mesh.chunk_shrink_levels() == 1
+        assert topo.device(1).chunk_shrink_levels() == 0
+        # reset_chunk_shrink clears the WHOLE default topology
+        topo.device(1).shrink_chunk_cap()
+        mesh.reset_chunk_shrink()
+        assert topo.device(0).chunk_shrink_levels() == 0
+        assert topo.device(1).chunk_shrink_levels() == 0
+
+    def test_device_scope_nests_and_is_thread_local(self):
+        topo = topology.DeviceTopology.virtual(2)
+        assert topology.current_device() is None
+        with topology.device_scope(topo.device(0)) as d0:
+            assert topology.current_device() is d0
+            with topology.device_scope(topo.device(1)):
+                assert topology.current_device() is topo.device(1)
+            assert topology.current_device() is d0
+            seen = []
+            t = threading.Thread(
+                target=lambda: seen.append(topology.current_device())
+            )
+            t.start()
+            t.join()
+            assert seen == [None]  # strictly thread-local
+        assert topology.current_device() is None
+
+    def test_fault_domains_default_resolution(self, monkeypatch):
+        monkeypatch.delenv("CBFT_FAULT_DOMAINS", raising=False)
+        assert topology.fault_domains_default() == 1
+        assert topology.fault_domains_default(4) == 4
+        assert topology.fault_domains_default(0) == 0  # 0 = auto-detect
+        monkeypatch.setenv("CBFT_FAULT_DOMAINS", "8")
+        assert topology.fault_domains_default(4) == 8  # env wins
+
+    def test_set_default_topology_resets_old_and_new(self):
+        old = topology.set_default_topology(
+            topology.DeviceTopology.virtual(2)
+        )
+        old = topology.default_topology()
+        old.device(0).shrink_chunk_cap()
+        new = topology.DeviceTopology.virtual(3)
+        new.device(1).shrink_chunk_cap()
+        topology.set_default_topology(new)
+        # a topology change is an incident boundary: both sides clean
+        assert old.device(0).chunk_shrink_levels() == 0
+        assert new.device(1).chunk_shrink_levels() == 0
+
+
+class TestPartialMeshDegradation:
+    def test_one_domain_quarantined_survivors_keep_device_path(self):
+        plan, sup, topo = _faulty_multi(4, FaultPlan(seed=3, device=2))
+        items = _make_items(4 * 32, poison_at=7)
+        truth = _cpu_mask(items)
+
+        # healthy: the batch shards over all 4 domains
+        assert sup.verify_items(items) == truth
+        assert sup.state() == HEALTHY
+        assert all(plan.per_device.get(i, 0) >= 1 for i in range(4))
+
+        # kill dev2: its shard fails, ONLY dev2 trips
+        plan.exception_rate = 1.0
+        assert sup.verify_items(items) == truth
+        states = sup.device_states()
+        assert states["dev2"] == BROKEN
+        assert [k for k, v in states.items() if v == BROKEN] == ["dev2"]
+        assert sup.state() == DEGRADED  # never node-wide BROKEN
+        assert (
+            sup.metrics.quarantines.with_labels(device="dev2").value() == 1
+        )
+
+        # while quarantined: survivors keep serving ON THE DEVICE PATH
+        # with dev2's batch-axis share redistributed over them
+        before = {i: plan.per_device.get(i, 0) for i in range(4)}
+        cpu_before = sup.metrics.cpu_routed.value()
+        redis_before = sup.metrics.redistributions.value()
+        assert sup.verify_items(items) == truth
+        assert sup.metrics.cpu_routed.value() == cpu_before
+        assert sup.metrics.redistributions.value() == redis_before + 1
+        after = {i: plan.per_device.get(i, 0) for i in range(4)}
+        assert all(after[i] > before[i] for i in (0, 1, 3))
+        assert after[2] == before[2]  # quarantined: no dispatches
+
+        # re-admission by dev2's OWN canary once the fault clears
+        plan.clear()
+        assert sup.probe_now(device=2)
+        assert sup.device_states()["dev2"] == HEALTHY
+        assert sup.state() == HEALTHY
+        assert (
+            sup.metrics.readmissions.with_labels(device="dev2").value() == 1
+        )
+        assert sup.verify_items(items) == truth
+        sup.stop()
+
+    def test_breaker_state_gauge_tracks_exactly_one_device(self):
+        plan, sup, topo = _faulty_multi(4, FaultPlan(seed=4, device=1))
+        items = _make_items(4 * 32)
+        plan.exception_rate = 1.0
+        assert sup.verify_items(items) == [True] * len(items)
+        gauge = sup.metrics.breaker_state
+        per_dev = {
+            d.handle.label: gauge.with_labels(
+                device=d.handle.label
+            ).value()
+            for d in sup._domains
+        }
+        assert per_dev["dev1"] == 2.0  # BROKEN
+        assert all(
+            v == 0.0 for k, v in per_dev.items() if k != "dev1"
+        )
+        sup.stop()
+
+    def test_all_domains_broken_routes_node_to_cpu(self):
+        plan, sup, topo = _faulty_multi(2, FaultPlan(seed=5))  # no device
+        items = _make_items(2 * 32, poison_at=5)
+        truth = _cpu_mask(items)
+        plan.exception_rate = 1.0
+        assert sup.verify_items(items) == truth  # both shards fail → CPU
+        assert sup.state() == BROKEN
+        assert set(sup.device_states().values()) == {BROKEN}
+        cpu_before = sup.metrics.cpu_routed.value()
+        assert sup.verify_items(items) == truth
+        assert sup.metrics.cpu_routed.value() == cpu_before + 1
+        # a full-node probe re-admits every domain
+        plan.clear()
+        assert sup.probe_now()
+        assert sup.state() == HEALTHY
+        sup.stop()
+
+    def test_small_batch_uses_fewer_domains(self):
+        plan, sup, topo = _faulty_multi(4, FaultPlan(seed=6))
+        # below 2 * _MIN_SHARD lanes there is nothing to shard: one
+        # domain serves the whole batch (pad + per-shard overhead would
+        # beat the parallelism)
+        items = _make_items(16)
+        assert sup.verify_items(items) == [True] * 16
+        assert plan.per_device.get(0, 0) == 1
+        assert all(plan.per_device.get(i, 0) == 0 for i in (1, 2, 3))
+        sup.stop()
+
+    def test_healthy_capacity_fraction(self):
+        plan, sup, topo = _faulty_multi(4, FaultPlan(seed=7, device=0))
+        assert sup.healthy_capacity_fraction() == 1.0
+        plan.exception_rate = 1.0
+        sup.verify_items(_make_items(4 * 32))
+        # dev0 quarantined: 3 of 4 domains' capacity remains
+        assert sup.healthy_capacity_fraction() == pytest.approx(0.75)
+        # an OOM-shrunk survivor halves its own share
+        topo.device(1).shrink_chunk_cap()
+        assert sup.healthy_capacity_fraction() == pytest.approx(
+            (0.5 + 1.0 + 1.0) / 4.0
+        )
+        sup.stop()
+
+
+class TestSchedulerHealthyCapacity:
+    class _FakeSup:
+        def __init__(self, frac):
+            self.frac = frac
+
+        def healthy_capacity_fraction(self):
+            if isinstance(self.frac, Exception):
+                raise self.frac
+            return self.frac
+
+    def _sched(self, sup):
+        return VerifyScheduler(
+            spec=BackendSpec("cpu"), lane_budget=128, supervisor=sup
+        )
+
+    def test_budget_scales_to_healthy_fraction(self):
+        assert self._sched(self._FakeSup(0.75))._effective_lane_budget() == 96
+        assert self._sched(self._FakeSup(0.25))._effective_lane_budget() == 32
+
+    def test_budget_nominal_when_healthy_absent_or_degenerate(self):
+        assert self._sched(None)._effective_lane_budget() == 128
+        assert self._sched(object())._effective_lane_budget() == 128
+        assert self._sched(self._FakeSup(1.0))._effective_lane_budget() == 128
+        # all-broken: dispatches CPU-route anyway; budget stays nominal
+        assert self._sched(self._FakeSup(0.0))._effective_lane_budget() == 128
+        assert (
+            self._sched(
+                self._FakeSup(RuntimeError("boom"))
+            )._effective_lane_budget()
+            == 128
+        )
+
+    def test_budget_floor_is_one_lane(self):
+        s = VerifyScheduler(
+            spec=BackendSpec("cpu"), lane_budget=2,
+            supervisor=self._FakeSup(0.1),
+        )
+        assert s._effective_lane_budget() == 1
+
+
+class TestStateLeakAndShutdown:
+    def test_supervisor_stop_resets_per_device_shrink(self):
+        # satellite 1: a restarted supervisor must not inherit a
+        # shrunken chunk cap from a previous incident
+        plan, sup, topo = _faulty_multi(2, FaultPlan(seed=8))
+        topo.device(0).shrink_chunk_cap()
+        topo.device(1).shrink_chunk_cap()
+        topo.device(1).shrink_chunk_cap()
+        sup.stop()
+        assert topo.device(0).chunk_shrink_levels() == 0
+        assert topo.device(1).chunk_shrink_levels() == 0
+
+    def test_scheduler_stop_while_device_mid_canary(self):
+        # satellite 2: stopping the scheduler while one quarantined
+        # domain is mid-canary (probe thread wedged in a hanging
+        # dispatch) must not deadlock — the join is timeout-bounded and
+        # every pending future completes
+        plan, sup, topo = _faulty_multi(
+            2,
+            FaultPlan(seed=9, device=1),
+            dispatch_timeout_ms=300,
+            probe_base_ms=1,  # canary due immediately after the trip
+            probe_max_ms=10,
+        )
+        sched = VerifyScheduler(
+            spec=BackendSpec("cpu"), flush_us=100, supervisor=sup,
+            join_timeout_s=5.0,
+        )
+        sched.start()
+        items = _make_items(2 * 32)
+        # trip dev1 (its shard hangs; the watchdog abandons it), then
+        # submit again so _maybe_probe_async launches dev1's canary into
+        # the still-armed hang: the probe thread is now mid-canary
+        plan.hang_rate = 1.0
+        plan.hang_s = 20.0
+        fut = sched.submit(items)
+        assert fut.result(timeout=30.0)[1] == [True] * len(items)
+        assert sup.device_states()["dev1"] == BROKEN
+        time.sleep(0.05)
+        fut2 = sched.submit(items)
+        assert fut2.result(timeout=30.0)[1] == [True] * len(items)
+        t0 = time.perf_counter()
+        sched.stop()
+        stopped_in = time.perf_counter() - t0
+        assert stopped_in < 10.0, f"scheduler stop took {stopped_in:.1f}s"
+        plan.clear()
+        t0 = time.perf_counter()
+        sup.stop()  # joins the canary thread, bounded by the watchdog
+        assert time.perf_counter() - t0 < 10.0
+        assert fut.done() and fut2.done()
+
+
+class TestMultiDeviceChaosRung:
+    def test_chaos_multidevice_acceptance(self):
+        # the PR's acceptance rung: >= 4 virtual domains, device 2
+        # injected with hang → oom → corrupt; survivors keep serving the
+        # device path, dev2 is quarantined and re-admitted by its own
+        # canary, zero wrong verdicts, exactly one domain leaves HEALTHY
+        summary = run_chaos_multidevice(devices=4, kill=2, seed=7)
+        assert summary["wrong_verdicts"] == 0
+        assert summary["cpu_routed"] == 0
+        assert set(summary["quarantines"]) == {"dev2"}
+        assert summary["readmissions"]["dev2"] >= 3
+        assert summary["redistributions"] >= 3
+        for phase in ("hang", "oom", "corrupt"):
+            p = summary["phases"][phase]
+            assert p["quarantined_only_kill"], phase
+            assert p["survivors_grew"], phase
+            assert (
+                p["state_while_quarantined"]
+                == summary["expected"]["state_while_quarantined"]
+            ), phase
+            assert p["readmit_probe_ok"], phase
+        assert all(
+            s == summary["expected"]["final_state"]
+            for s in summary["final_states"].values()
+        )
+        # survivors dispatched in every phase; dev2 only while healthy
+        per_dev = summary["per_device_dispatches"]
+        assert all(per_dev.get(i, 0) >= 3 for i in (0, 1, 3))

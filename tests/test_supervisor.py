@@ -148,7 +148,7 @@ class TestBreakerStateMachine:
         sup.verify_items(_make_items(2))
         assert sup.state() == BROKEN
         plan.clear()
-        sup._note_success()
+        sup._note_success(sup._domains[0])
         assert sup.state() == BROKEN
         sup.stop()
 
@@ -233,7 +233,7 @@ class TestWatchdog:
         plan.hang_rate = 1.0
         plan.hang_s = 30.0
         with pytest.raises(WatchdogTimeout):
-            sup._device_verify(_make_items(2))
+            sup._device_verify(sup._domains[0], _make_items(2))
         sup.stop()
 
 

@@ -5,10 +5,12 @@ xla_force_host_platform_device_count=8): verify_batch must route
 through the sharded program automatically and stay bit-identical.
 """
 
+import threading
+
 import numpy as np
 
 from cometbft_tpu.crypto import ed25519 as ed
-from cometbft_tpu.crypto.tpu import ed25519_batch, mesh
+from cometbft_tpu.crypto.tpu import ed25519_batch, mesh, topology
 
 
 class TestMeshDispatch:
@@ -102,6 +104,116 @@ class TestDispatchChunking:
         ones = np.ones((2, 17), np.int32)  # column sum 2 → even → True
         out = mesh.dispatch_batch(kernel, [ones], 17, 16, 8)
         assert out.shape == (17,) and out.all()
+
+
+class TestCancelScopeIsolation:
+    """cancel_scope and device_scope are strictly thread-local: a zombie
+    dispatch (abandoned by the watchdog, cancel event set) exiting via
+    DispatchCancelled at a chunk boundary must never cancel — or cap —
+    a healthy dispatch running concurrently on another thread/device."""
+
+    def _toy_kernel(self):
+        import jax
+
+        @jax.jit
+        def parity_kernel(rows):
+            return (rows.sum(axis=0) % 2) == 0
+
+        return parity_kernel
+
+    def test_zombie_cancel_does_not_cancel_healthy_dispatch(self, monkeypatch):
+        monkeypatch.delenv("CBFT_TPU_MAX_CHUNK", raising=False)
+        kernel = self._toy_kernel()
+        n = 48  # 3 chunks of 16
+        full = np.ones((2, n), np.int32)  # even column sums → all True
+        cancel = threading.Event()
+        zombie_mid_chunk = threading.Event()
+        release_zombie = threading.Event()
+        zombie_exc = []
+
+        def zombie_pack(start, end):
+            if start == 16:
+                # wedged mid-dispatch, the way an abandoned watchdog
+                # worker sits on a hung device call
+                zombie_mid_chunk.set()
+                release_zombie.wait(10)
+            return [full[:, start:end]]
+
+        def zombie():
+            try:
+                with mesh.cancel_scope(cancel):
+                    mesh.dispatch_batch(kernel, zombie_pack, n, 16, 8)
+            except BaseException as exc:  # noqa: BLE001 - recorded for assert
+                zombie_exc.append(exc)
+
+        zt = threading.Thread(target=zombie, daemon=True, name="zombie")
+        zt.start()
+        assert zombie_mid_chunk.wait(10)
+        cancel.set()  # the watchdog abandons the zombie
+
+        # a healthy dispatch on ANOTHER thread and device, overlapping
+        # both the wedged window and the zombie's cancelled exit
+        topo = topology.DeviceTopology.virtual(2)
+        healthy_out = {}
+
+        def healthy():
+            with topology.device_scope(topo.device(1)):
+                healthy_out["mask"] = mesh.dispatch_batch(
+                    kernel, [full], n, 16, 8
+                )
+
+        ht = threading.Thread(target=healthy, name="healthy")
+        ht.start()
+        ht.join(30)
+        release_zombie.set()  # zombie resumes → next chunk boundary raises
+        zt.join(30)
+        assert not ht.is_alive() and not zt.is_alive()
+        assert len(zombie_exc) == 1
+        assert isinstance(zombie_exc[0], mesh.DispatchCancelled)
+        # the healthy dispatch never saw the zombie's cancel event
+        assert healthy_out["mask"].shape == (n,)
+        assert healthy_out["mask"].all()
+
+    def test_device_param_selects_that_devices_chunk_cap(self, monkeypatch):
+        monkeypatch.delenv("CBFT_TPU_MAX_CHUNK", raising=False)
+        kernel = self._toy_kernel()
+        topo = topology.DeviceTopology.virtual(2)
+        topo.device(1).shrink_chunk_cap()  # dev1: 16 → 8
+        full = np.ones((2, 32), np.int32)
+        calls = []
+
+        def pack(start, end):
+            calls.append((start, end))
+            return [full[:, start:end]]
+
+        out = mesh.dispatch_batch(
+            kernel, pack, 32, 16, 8, device=topo.device(1)
+        )
+        assert out.all()
+        assert calls == [(0, 8), (8, 16), (16, 24), (24, 32)]
+        calls.clear()
+        # the unshrunk neighbor keeps the full cap
+        out = mesh.dispatch_batch(
+            kernel, pack, 32, 16, 8, device=topo.device(0)
+        )
+        assert out.all()
+        assert calls == [(0, 16), (16, 32)]
+
+    def test_thread_scope_supplies_device_when_param_omitted(self, monkeypatch):
+        monkeypatch.delenv("CBFT_TPU_MAX_CHUNK", raising=False)
+        kernel = self._toy_kernel()
+        topo = topology.DeviceTopology.virtual(2)
+        topo.device(1).shrink_chunk_cap()
+        full = np.ones((2, 32), np.int32)
+        calls = []
+
+        def pack(start, end):
+            calls.append((start, end))
+            return [full[:, start:end]]
+
+        with topology.device_scope(topo.device(1)):
+            assert mesh.dispatch_batch(kernel, pack, 32, 16, 8).all()
+        assert calls == [(0, 8), (8, 16), (16, 24), (24, 32)]
 
 
 class TestDispatchKnobs:

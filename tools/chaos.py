@@ -1,12 +1,22 @@
 """Standalone chaos harness against the supervised verify plane.
 
-Two modes:
+Three modes:
 
 * default (smoke) — crypto/faults.py run_chaos_smoke: a fast,
   deterministic walk of every degradation-ladder rung (transient retry,
   OOM chunk-shrink + recovery, hedged verification, failed-batch triage,
   breaker trip/probe/re-admit), asserting ground-truth verdict equality
   at every step. Finishes in well under a second.
+
+* --devices N --kill K — crypto/faults.py run_chaos_multidevice: the
+  partial-mesh degradation rung. On an N-fault-domain topology, device
+  K alone is injected with hang → oom → corrupt (FaultPlan.device /
+  CBFT_FAULT_DEVICE); asserts zero wrong verdicts, continued
+  device-path service on the survivors (no node-wide CPU fallback, no
+  global breaker trip), quarantine of K, and re-admission by K's own
+  canary. Deterministic under --seed. Runs on the virtual CPU mesh, so
+  it needs no hardware (tier-1 CI runs it via
+  XLA_FLAGS=--xla_force_host_platform_device_count).
 
 * --soak — crypto/faults.py run_chaos_soak: a randomized fault schedule
   (exceptions, hangs, silent verdict corruption, sudden death, jitter,
@@ -61,6 +71,13 @@ def main() -> int:
     ap.add_argument("--transient-n", type=int, default=None,
                     help="override CBFT_FAULT_TRANSIENT_N for ad-hoc "
                          "runs of a faulty node (exported to the env)")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="fault domains for the multi-device rung; >1 "
+                         "runs run_chaos_multidevice instead of the "
+                         "single-device smoke (default 1)")
+    ap.add_argument("--kill", type=int, default=2,
+                    help="[multi-device] fault-domain index to inject "
+                         "(default 2)")
     args = ap.parse_args()
 
     if args.inner == "cpu":
@@ -94,6 +111,44 @@ def main() -> int:
             and summary["device_resumed_after_recovery"]
         )
         print("CHAOS SOAK", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+
+    if args.devices > 1:
+        if args.inner != "cpu":
+            # a real device plane needs N visible devices; the virtual
+            # CPU mesh is how the rung runs hardware-free
+            os.environ.setdefault(
+                "XLA_FLAGS",
+                f"--xla_force_host_platform_device_count={args.devices}",
+            )
+        from cometbft_tpu.crypto.faults import run_chaos_multidevice
+
+        summary = run_chaos_multidevice(
+            devices=args.devices, kill=args.kill, seed=args.seed,
+            inner=args.inner,
+        )
+        print(json.dumps(summary, indent=2))
+        killed = f"dev{args.kill}"
+        ok = (
+            summary["wrong_verdicts"] == 0
+            and summary["cpu_routed"] == 0
+            and set(summary["quarantines"]) == {killed}
+            and summary["readmissions"].get(killed, 0) >= 3
+            and summary["redistributions"] >= 3
+            and all(
+                p["quarantined_only_kill"]
+                and p["survivors_grew"]
+                and p["state_while_quarantined"]
+                == summary["expected"]["state_while_quarantined"]
+                and p["readmit_probe_ok"]
+                for p in summary["phases"].values()
+            )
+            and all(
+                s == summary["expected"]["final_state"]
+                for s in summary["final_states"].values()
+            )
+        )
+        print("CHAOS MULTIDEVICE", "PASS" if ok else "FAIL")
         return 0 if ok else 1
 
     from cometbft_tpu.crypto.faults import run_chaos_smoke
