@@ -382,7 +382,76 @@ def bench_scheduler() -> dict:
     return out
 
 
+def bench_coldboot() -> dict:
+    """AOT warm-boot smoke (crypto/tpu/aot.py), asserted on CPU-only CI
+    with the virtual device mesh and the smallest bucket only:
+
+    - run_warm_boot over bucket 64 must leave ≥1 executable resident in
+      the process registry;
+    - a real 64-sig dispatch AFTER the warm boot must be a registry HIT:
+      zero new XLA compilations and zero registry misses (the ROADMAP
+      item 2 acceptance contract, smoke-sized) — with verdicts correct.
+
+    The full cold-vs-warm cache timing lives in bench.py's coldboot
+    stage; this section fails fast when a registry key drifts away from
+    what dispatch_batch actually asks for.
+    """
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["CBFT_TPU_PROBE"] = "0"
+    import jax
+
+    cache = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+    )
+    jax.config.update("jax_compilation_cache_dir", cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.tpu import aot, ed25519_batch
+    from cometbft_tpu.crypto.tpu import mesh as mesh_mod
+
+    reg = aot.default_registry()
+    # single-device variants are skipped: with the virtual mesh up,
+    # dispatch_batch always takes the sharded path, and the smoke must
+    # fit the tier-1 budget (every compile here is a CPU XLA compile)
+    include_single = mesh_mod.n_devices() == 1
+    t0 = time.perf_counter()
+    obs = aot.run_warm_boot(sizes=[64], include_single=include_single)
+    warm_ms = (time.perf_counter() - t0) * 1e3
+    if not obs:
+        raise AssertionError("warm boot planned no targets")
+
+    misses_before = reg.metrics.registry_misses.value()
+    compiles_before = reg.compile_count
+    key = ed.gen_priv_key_from_secret(b"coldboot-smoke")
+    pk, msg = key.pub_key().bytes(), b"warm boot smoke message ......."
+    sig = key.sign(msg)
+    t0 = time.perf_counter()
+    mask = ed25519_batch.verify_batch([pk] * 64, [msg] * 64, [sig] * 64)
+    first_ms = (time.perf_counter() - t0) * 1e3
+    if not all(mask):
+        raise AssertionError("post-warm-boot verdict wrong")
+    if reg.compile_count != compiles_before:
+        raise AssertionError(
+            "dispatch at a warmed bucket paid "
+            f"{reg.compile_count - compiles_before} fresh compiles"
+        )
+    if reg.metrics.registry_misses.value() != misses_before:
+        raise AssertionError(
+            "dispatch at a warmed bucket missed the executable registry"
+        )
+    return {
+        "warm_targets": len(obs),
+        "warm_boot_ms": round(warm_ms, 1),
+        "first_verdict_ms": round(first_ms, 1),
+        "zero_compile_dispatch": 1,
+    }
+
+
 SECTIONS = {
+    "coldboot": bench_coldboot,
     "ed25519": bench_ed25519,
     "validator_set": bench_validator_set,
     "light": bench_light,

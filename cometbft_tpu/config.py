@@ -296,6 +296,13 @@ class CryptoConfig:
     # 0 = auto-detect from the visible device plane at startup.
     # CBFT_FAULT_DOMAINS env wins.
     fault_domains: int = 1
+    # AOT warm-boot phase (crypto/tpu/aot.py): pre-lower and compile the
+    # pow2 shape-bucket ladder before traffic arrives so no dispatch
+    # ever pays trace+compile. "background" (default) warms on a thread
+    # the supervisor's warmup canary joins before declaring HEALTHY;
+    # "eager" blocks node start until warm; "off" disables. CBFT_WARM_BOOT
+    # env wins; CBFT_TPU_WARMUP=0 (legacy kill switch) still forces off.
+    warm_boot: str = "background"
 
 
 @dataclass
@@ -353,6 +360,12 @@ class Config:
             raise ValueError(
                 "crypto.fault_domains must be a non-negative integer, "
                 f"got {fd!r}"
+            )
+        wb = self.crypto.warm_boot
+        if wb not in ("eager", "background", "off"):
+            raise ValueError(
+                "crypto.warm_boot must be one of "
+                f"['eager', 'background', 'off'], got {wb!r}"
             )
         hp = self.crypto.hedge_pct
         if not isinstance(hp, int) or isinstance(hp, bool) or hp < 0:

@@ -1072,8 +1072,28 @@ class BackendSupervisor:
 
     def warmup_canary(self) -> None:
         """Kick one background probe at node start so a wedged device
-        plane trips the breaker before consensus traffic arrives."""
-        self._spawn_bg(self.probe_now, "supervisor-canary")
+        plane trips the breaker before consensus traffic arrives. The
+        probe first JOINS the AOT warm boot (crypto/tpu/aot.py) when one
+        is running, bounded by the dispatch watchdog budget: HEALTHY is
+        only declared once the executable ladder is warm (or the bound
+        expires — a slow warm boot must not wedge the canary forever;
+        the probe then exercises whatever is compiled so far)."""
+
+        def run() -> None:
+            from cometbft_tpu.crypto.tpu import aot
+
+            wb = aot.current_warm_boot()
+            if wb is not None and not wb.join(timeout=self._timeout_s):
+                self.logger.info(
+                    "warm boot still compiling past the canary bound; "
+                    "probing anyway",
+                    bound_s=round(self._timeout_s, 1),
+                )
+            if self._stopped:
+                return
+            self.probe_now()
+
+        self._spawn_bg(run, "supervisor-canary")
 
     def _maybe_probe_async(self) -> None:
         """Kick an exponential-backoff canary for every quarantined

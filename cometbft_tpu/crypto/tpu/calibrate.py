@@ -195,5 +195,101 @@ def record(path: Optional[str] = None, **kwargs) -> dict:
     path = path or table_path()
     table = run_calibration(**kwargs)
     if path:
+        # a fresh calibration must not drop previously-merged compile
+        # observations — they key by topology fingerprint, not by the
+        # routing sweep this run just re-measured
+        old = load_table()
+        if old and isinstance(old.get("compile"), dict):
+            table["compile"] = old["compile"]
         save_table(table, path)
     return table
+
+
+# --- compile-time economics (crypto/tpu/aot.py warm boot) -------------------
+# The warm boot observes the REAL per-(bucket, topology) compile cost of
+# every executable it builds. Folding those observations in here makes
+# two decisions measurement-driven instead of guessed: the warmup
+# LADDER ORDER (cheap buckets first covers more of the ladder before
+# traffic arrives — aot.bucket_ladder consults compile_seconds()) and
+# the jax persistent-cache admission threshold
+# (jax_persistent_cache_min_compile_time_secs — a cache that refuses to
+# store this link's actual compiles warms nothing on the next boot).
+
+
+def merge_compile_times(
+    observations, path: Optional[str] = None
+) -> Optional[dict]:
+    """Fold warm-boot compile observations ({kernel, bucket, sharded,
+    topology, compile_s, cached}) into the table under
+    ``table["compile"][topology][bucket]`` = total fresh-compile seconds
+    across that bucket's kernels/variants. Cached (0-cost) observations
+    are skipped — they measure the cache, not the compiler. Creates a
+    minimal table when none exists yet; None when there is no path."""
+    path = path or table_path()
+    if not path:
+        return None
+    table = load_table()
+    if table is None:
+        table = {"version": TABLE_VERSION, "measured_at": time.time()}
+    compile_tbl = table.setdefault("compile", {})
+    touched = False
+    for ob in observations:
+        if ob.get("cached") or not ob.get("compile_s"):
+            continue
+        topo = str(ob.get("topology", "?"))
+        bucket = str(int(ob.get("bucket", 0)))
+        per_topo = compile_tbl.setdefault(topo, {})
+        per_topo[bucket] = round(
+            float(per_topo.get(bucket, 0.0)) + float(ob["compile_s"]), 3
+        )
+        touched = True
+    if touched:
+        save_table(table, path)
+    return table
+
+
+def compile_seconds(topology_fp: Optional[str] = None) -> Dict[int, float]:
+    """Measured total compile seconds per bucket for ``topology_fp``
+    (the current topology's fingerprint when omitted); {} when nothing
+    was ever merged — callers fall back to size order."""
+    table = load_table()
+    if not table or not isinstance(table.get("compile"), dict):
+        return {}
+    if topology_fp is None:
+        from cometbft_tpu.crypto.tpu import aot
+
+        topology_fp = aot.topology_fingerprint()
+    per_topo = table["compile"].get(str(topology_fp))
+    if not isinstance(per_topo, dict):
+        return {}
+    out: Dict[int, float] = {}
+    for bucket, secs in per_topo.items():
+        try:
+            out[int(bucket)] = float(secs)
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+def persistent_cache_min_compile_secs(default: float = 5.0) -> float:
+    """The jax_persistent_cache_min_compile_time_secs threshold this
+    link has EARNED: strictly below the cheapest fresh compile ever
+    observed (so every warm-boot executable is cache-admitted), floored
+    at 0.1 s (never cache trivia), capped at ``default`` (the
+    conservative unmeasured fallback)."""
+    table = load_table()
+    cheapest: Optional[float] = None
+    if table and isinstance(table.get("compile"), dict):
+        for per_topo in table["compile"].values():
+            if not isinstance(per_topo, dict):
+                continue
+            for secs in per_topo.values():
+                try:
+                    s = float(secs)
+                except (TypeError, ValueError):
+                    continue
+                if s > 0 and (cheapest is None or s < cheapest):
+                    cheapest = s
+    if cheapest is None:
+        return default
+    return min(default, max(0.1, 0.5 * cheapest))

@@ -656,6 +656,30 @@ def _verify_core_resident(a_words: jnp.ndarray, rsh: jnp.ndarray) -> jnp.ndarray
 verify_kernel_resident = jax.jit(_verify_core_resident)
 
 
+# AOT registration: stable names (never id()-keyed) plus the per-bucket
+# arg shape templates warm boot pre-compiles (crypto/tpu/aot.py).
+# verify_full_kernel has no template — its msg-block axis is ragged per
+# commit, so it cannot be bucket-warmed; it still gets a stable name.
+def _register_aot_kernels():
+    from cometbft_tpu.crypto.tpu import aot
+
+    aot.register_kernel(
+        "ed25519.verify",
+        verify_kernel,
+        bucket_shapes=lambda b: [((32, b), np.uint32)],
+    )
+    aot.register_kernel(
+        "ed25519.verify_resident",
+        verify_kernel_resident,
+        bucket_shapes=lambda b: [((8, b), np.uint32), ((24, b), np.uint32)],
+        donate_from=1,
+    )
+    aot.register_kernel("ed25519.verify_full", verify_full_kernel)
+
+
+_register_aot_kernels()
+
+
 def _build_resident(pub_keys: Sequence[bytes]) -> _ResidentValset:
     """Pad the valset's pubkey rows into the dispatch chunk layout and
     place them on device (sharded over the mesh when >1 device)."""
@@ -779,9 +803,9 @@ def verify_valset_resident(
             )
         else:
             rsh_dev = jax.device_put(jnp.asarray(rsh_pad))
-            mask = mesh_mod.donating_kernel(
-                verify_kernel_resident, 2, donate_from=1
-            )(a_dev, rsh_dev)
+            mask = mesh_mod.run_single(
+                verify_kernel_resident, [a_dev, rsh_dev], donate_from=1
+            )
         inflight.append((start, end, mask, valid))
         while len(inflight) > depth:
             retire(inflight.popleft())

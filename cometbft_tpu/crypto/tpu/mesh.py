@@ -153,9 +153,6 @@ def n_devices() -> int:
     return int(batch_mesh().devices.size)
 
 
-_sharded_kernels = {}
-_donating_kernels = {}
-
 # [crypto] max_chunk, installed by node start (configure_chunk_cap).
 # Module state rather than an env var so in-process multi-node setups
 # don't leak one node's tuning into another via the process environment
@@ -294,25 +291,20 @@ def pipeline_depth() -> int:
     return depth
 
 
-def donating_kernel(kernel, nargs: int, donate_from: int = 0):
-    """Single-device jit of `kernel` with args [donate_from:] donated —
-    the per-chunk staging buffers are single-use, so XLA reuses their
-    space instead of holding input + workspace live together (same
-    rationale as sharded_verify's donate_argnums). Cached per
-    (kernel, nargs, donate_from) like _sharded_kernels."""
-    key = (id(kernel), nargs, donate_from)
-    step = _donating_kernels.get(key)
-    if step is None:
-        import jax
+def run_single(kernel, args, donate_from: int = 0):
+    """Run `kernel` single-device through the AOT executable registry
+    with args [donate_from:] donated — the per-chunk staging buffers
+    are single-use, so XLA reuses their space instead of holding input
+    + workspace live together (same rationale as sharded_verify's
+    donate_argnums). The registry (crypto/tpu/aot.py) keys by stable
+    kernel name + exact arg shapes + fingerprints — never by id(), which
+    CPython reuses after GC — and is what warm boot pre-populates, so a
+    warmed bucket never pays trace+compile here."""
+    from cometbft_tpu.crypto.tpu import aot
 
-        inner = getattr(kernel, "_fun", None) or getattr(
-            kernel, "__wrapped__", kernel
-        )
-        step = jax.jit(
-            inner, donate_argnums=tuple(range(donate_from, nargs))
-        )
-        _donating_kernels[key] = step
-    return step
+    return aot.default_registry().call(
+        kernel, list(args), donate_from=donate_from, sharded=False
+    )
 
 
 def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
@@ -422,7 +414,7 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
                 placed = [
                     jax.device_put(jnp.asarray(a)) for a in padded_args
                 ]
-                mask = donating_kernel(kernel, len(placed))(*placed)
+                mask = run_single(kernel, placed)
         except DispatchCancelled:
             span.end(error="cancelled")
             raise
@@ -460,26 +452,17 @@ def sharded_verify(kernel, args, donate_from: int = 0):
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as PS
 
+    from cometbft_tpu.crypto.tpu import aot
+
     mesh = batch_mesh()
-    key = (id(kernel), tuple(a.ndim for a in args), donate_from)
-    step = _sharded_kernels.get(key)
     shardings = tuple(
         NamedSharding(mesh, PS(*([None] * (a.ndim - 1) + ["batch"])))
         for a in args
     )
-    if step is None:
-        inner = getattr(kernel, "_fun", None) or getattr(
-            kernel, "__wrapped__", kernel
-        )
-        step = jax.jit(
-            inner,
-            in_shardings=shardings,
-            out_shardings=NamedSharding(mesh, PS("batch")),
-            donate_argnums=tuple(range(donate_from, len(args))),
-        )
-        _sharded_kernels[key] = step
     placed = [
         jax.device_put(jnp.asarray(a), s) for a, s in zip(args, shardings)
     ]
     with mesh:
-        return step(*placed)
+        return aot.default_registry().call(
+            kernel, placed, donate_from=donate_from, sharded=True
+        )

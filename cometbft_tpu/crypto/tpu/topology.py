@@ -185,6 +185,15 @@ class DeviceTopology:
         for d in self.devices:
             d.reset_chunk_shrink()
 
+    def fingerprint(self) -> str:
+        """Identity of this fault-domain layout for the AOT executable
+        registry (crypto/tpu/aot.py): an executable compiled for one
+        topology is discarded — never run — under another. Deliberately
+        excludes runtime state (shrink levels, breaker phases): an OOM
+        shrink changes chunk SIZE, which is already part of the registry
+        key via the arg shapes, not the program's device layout."""
+        return "{}:{}".format(self.kind, len(self.devices))
+
 
 # --- default topology (process-wide, like mesh._configured_cap) -------------
 

@@ -177,6 +177,8 @@ class Node(BaseService):
         from cometbft_tpu.p2p.metrics import Metrics as P2PMetrics
         from cometbft_tpu.state.metrics import Metrics as SMMetrics
 
+        from cometbft_tpu.crypto.tpu.aot import Metrics as AotMetrics
+
         if config.instrumentation.prometheus:
             self.metrics_registry = Registry(
                 namespace=config.instrumentation.namespace
@@ -187,6 +189,7 @@ class Node(BaseService):
             sm_metrics = SMMetrics(self.metrics_registry)
             sched_metrics = SchedMetrics(self.metrics_registry)
             sup_metrics = SupMetrics(self.metrics_registry)
+            aot_metrics = AotMetrics(self.metrics_registry)
         else:
             self.metrics_registry = None
             cons_metrics = ConsMetrics.nop()
@@ -195,6 +198,13 @@ class Node(BaseService):
             sm_metrics = SMMetrics.nop()
             sched_metrics = SchedMetrics.nop()
             sup_metrics = SupMetrics.nop()
+            aot_metrics = AotMetrics.nop()
+        # the AOT executable registry is process-global (it backs the
+        # mesh dispatch layer, which predates any Node); the node only
+        # lends it an exporter, exactly like the topology default above
+        from cometbft_tpu.crypto.tpu import aot as aotlib
+
+        aotlib.default_registry().set_metrics(aot_metrics)
 
         # 0c. verify-path tracer (libs/trace.py): per-node flight
         # recorder over the verify pipeline (request → dispatch →
@@ -863,6 +873,18 @@ class Node(BaseService):
             self.logger.error(
                 "error stopping verify supervisor", err=str(exc)
             )
+        # the AOT warm boot checks its stop event between compiles, so
+        # this join is bounded by one in-flight compile (plus the warmup
+        # subprocess timeout if phase 1 is mid-run — the thread is a
+        # daemon either way)
+        try:
+            from cometbft_tpu.crypto.tpu import aot as aotlib
+
+            if not aotlib.stop_warm_boot(timeout=10.0):
+                self.logger.info("warm boot still compiling at stop; "
+                                 "abandoned as daemon")
+        except Exception as exc:
+            self.logger.error("error stopping warm boot", err=str(exc))
         if self._privval_endpoint is not None:
             self._privval_endpoint.close()
         # release DB file locks so maintenance commands (rollback,
@@ -901,43 +923,50 @@ def default_db_provider(name: str, config: Config) -> DB:
 
 
 def _warm_tpu_kernels(config: Config) -> None:
-    """Arm the device plane at node start (VERDICT r4 item 2):
+    """Arm the device plane at node start (VERDICT r4 item 2, ROADMAP
+    item 2 — the AOT warm boot, crypto/tpu/aot.py):
 
     - point the jax persistent compilation cache at the node home so
-      bucket executables survive restarts;
-    - pre-compile the dispatch-size buckets in a daemon thread, so the
-      first real commit hits a warm executable instead of an XLA
-      compile. Failures are non-fatal — the batch boundary degrades to
-      CPU per its routing thresholds;
-    - record the CPU↔device crossover table (tpu/calibrate.py) right
-      after warmup, so Merkle/ed25519 routing runs on numbers measured
-      on THIS link instead of by-construction thresholds.
+      bucket executables survive restarts, with an admission threshold
+      earned from measured compile times (calibrate.py) instead of a
+      guess;
+    - run the warm boot: a bounded SUBPROCESS fills the disk cache for
+      the whole pow2 bucket ladder (single-device + sharded variants,
+      commit-p50 first) and records the calibration table + per-bucket
+      compile seconds; then the node's OWN executable registry loads
+      the now-cached programs, so the first real commit is a registry
+      hit — zero trace+compile on the dispatch path. Failures are
+      non-fatal — the batch boundary degrades to CPU per its routing
+      thresholds;
+    - the supervisor's warmup canary (on_start) joins the warm boot
+      before declaring HEALTHY; on_stop stops it with a bounded join.
 
-    The whole warmup runs in a BOUNDED SUBPROCESS: the TPU tunnel can
-    wedge for hours, and in-process jax init would then hang holding
-    jax's process-global init lock — stalling the consensus thread the
-    moment a batch crosses the routing threshold. The subprocess fills
-    the DISK cache; the node's own first dispatch then loads warm
-    executables. In-process jax only gets its cache-dir config set (no
-    device touch)."""
+    The subprocess-first split survives a wedged tunnel: the TPU tunnel
+    can hang for hours, and the phase-2 in-process loads only start
+    after the device probe AND the subprocess proved the plane answers.
+    [crypto] warm_boot = eager|background|off (CBFT_WARM_BOOT env wins)
+    selects blocking/threaded/disabled."""
     import subprocess
     import sys
-    import threading
+
+    from cometbft_tpu.crypto.tpu import aot, calibrate
 
     cache_dir = os.path.join(config.root_dir, "data", "jax_cache")
     calib_path = os.path.join(
         config.root_dir, "data", "tpu_calibration.json"
     )
+    floor = int(config.crypto.min_batch)
+    min_secs = calibrate.persistent_cache_min_compile_secs()
 
-    def warm():
+    def body(stop_event):
         try:
             from cometbft_tpu.crypto import batch as _batch
 
-            # the probe (kicked below, before this thread starts) must
-            # say the tunnel answers — otherwise the warmup subprocess
+            # the probe (kicked below, before this body runs) must say
+            # the tunnel answers — otherwise the warmup subprocess
             # would hang against the wedged device for its full timeout
             if not _batch.device_plane_ok(wait=True):
-                return
+                return None
             # in-process cache config for the pre-imported-jax case
             # (sitecustomize may import jax before the env vars above
             # are set); off the start path, so the import cost is free
@@ -945,8 +974,10 @@ def _warm_tpu_kernels(config: Config) -> None:
 
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update(
-                "jax_persistent_cache_min_compile_time_secs", 5.0
+                "jax_persistent_cache_min_compile_time_secs", min_secs
             )
+            if stop_event.is_set():
+                return None
             subprocess.run(
                 [
                     sys.executable,
@@ -954,30 +985,41 @@ def _warm_tpu_kernels(config: Config) -> None:
                     "import jax\n"
                     f"jax.config.update('jax_compilation_cache_dir', {cache_dir!r})\n"
                     "jax.config.update("
-                    "'jax_persistent_cache_min_compile_time_secs', 5.0)\n"
-                    "from cometbft_tpu.crypto.tpu import calibrate, ed25519_batch\n"
-                    f"ed25519_batch.warmup(floor={int(config.crypto.min_batch)})\n"
+                    f"'jax_persistent_cache_min_compile_time_secs', {min_secs!r})\n"
+                    "from cometbft_tpu.crypto.tpu import aot, calibrate\n"
+                    f"calibrate.set_table_path({calib_path!r})\n"
+                    f"obs = aot.run_warm_boot(floor={floor})\n"
                     # the buckets are warm now, so the timings below see
                     # steady-state dispatch, not compiles; the node's
                     # routing reads the table lazily by mtime
-                    f"calibrate.record({calib_path!r})\n",
+                    f"calibrate.record({calib_path!r})\n"
+                    f"calibrate.merge_compile_times(obs, {calib_path!r})\n",
                 ],
                 timeout=int(os.environ.get("CBFT_TPU_WARMUP_TIMEOUT", "900")),
                 capture_output=True,
             )
+            if stop_event.is_set():
+                return None
+            # phase 2: populate THIS process's executable registry from
+            # the disk cache the subprocess just filled — loads, not
+            # fresh compiles; checks stop_event between buckets
+            return aot.run_warm_boot(floor=floor, stop_event=stop_event)
         except Exception:  # noqa: BLE001 - warming is best-effort
-            pass
+            return None
 
     from cometbft_tpu.crypto import batch as cryptobatch
 
     cryptobatch.start_device_probe()  # verdict ready before first commit
     # cache config via env (read by jax at import) — and, in the warm
-    # thread below, via config.update for the pre-imported-jax case.
+    # body above, via config.update for the pre-imported-jax case.
     # Importing jax HERE would add seconds of blocking start-up work.
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
-    if os.environ.get("CBFT_TPU_WARMUP", "1") != "0":
-        threading.Thread(target=warm, daemon=True, name="tpu-warmup").start()
+    os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", str(min_secs)
+    )
+    aot.start_warm_boot(
+        aot.warm_boot_mode(config.crypto.warm_boot), body=body
+    )
 
 
 def default_new_node(config: Config, logger: Optional[Logger] = None) -> Node:

@@ -13,8 +13,8 @@ import pytest
 @pytest.mark.parametrize(
     "section",
     [
-        "ed25519", "validator_set", "light", "mempool", "routing",
-        "scheduler", "wal",
+        "coldboot", "ed25519", "validator_set", "light", "mempool",
+        "routing", "scheduler", "wal",
     ],
 )
 def test_section_produces_numbers(section):

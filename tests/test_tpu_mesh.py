@@ -38,8 +38,9 @@ class TestMeshDispatch:
         ]
         assert got == want
 
-    def test_sharded_kernel_cache_reused(self):
-        before = dict(mesh._sharded_kernels)
+    def test_sharded_executable_registry_reused(self):
+        from cometbft_tpu.crypto.tpu import aot
+
         pks, msgs, sigs = [], [], []
         for i in range(8):
             k = ed.gen_priv_key_from_secret(bytes([i, 66]))
@@ -47,10 +48,17 @@ class TestMeshDispatch:
             pks.append(k.pub_key().bytes())
             msgs.append(m)
             sigs.append(k.sign(m))
+        reg = aot.default_registry()
         assert all(ed25519_batch.verify_batch(pks, msgs, sigs))
+        compiles = reg.compile_count
+        entries = len(reg)
+        hits = reg.metrics.registry_hits.value()
+        # the repeat dispatch lands on the SAME (kernel, bucket,
+        # topology, backend) registry key: zero new executables
         assert all(ed25519_batch.verify_batch(pks, msgs, sigs))
-        # at most one new compiled sharded program per (kernel, arity)
-        assert len(mesh._sharded_kernels) <= len(before) + 1
+        assert reg.compile_count == compiles
+        assert len(reg) == entries
+        assert reg.metrics.registry_hits.value() > hits
 
     def test_maybe_init_distributed_noop_without_config(self, monkeypatch):
         monkeypatch.delenv("CBFT_TPU_COORDINATOR", raising=False)
