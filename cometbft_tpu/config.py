@@ -224,6 +224,15 @@ class InstrumentationConfig:
     # ring buffer retains for /debug/traces and incident dumps.
     # CBFT_TRACE_BUFFER env wins.
     trace_buffer: int = 256
+    # SLO engine (crypto/telemetry.py): rolling-window p50/p99 commit-
+    # verify latency is judged against this target; the burn-rate gauge
+    # reads how fast the error budget is being spent. Default = the ZKP
+    # runtime study's p50 commit-verify bar. CBFT_SLO_COMMIT_MS wins.
+    slo_commit_ms: int = 100
+    # Incident dump retention: trace_dump_*.json files kept in
+    # NODE_HOME/data (newest N; older dumps deleted at write time).
+    # CBFT_TRACE_DUMP_KEEP env wins.
+    trace_dump_keep: int = 20
 
 
 @dataclass
@@ -388,6 +397,18 @@ class Config:
             raise ValueError(
                 "instrumentation.trace_buffer must be a positive "
                 f"integer, got {tb!r}"
+            )
+        slo = self.instrumentation.slo_commit_ms
+        if not isinstance(slo, int) or isinstance(slo, bool) or slo < 1:
+            raise ValueError(
+                "instrumentation.slo_commit_ms must be a positive "
+                f"integer, got {slo!r}"
+            )
+        tdk = self.instrumentation.trace_dump_keep
+        if not isinstance(tdk, int) or isinstance(tdk, bool) or tdk < 1:
+            raise ValueError(
+                "instrumentation.trace_dump_keep must be a positive "
+                f"integer, got {tdk!r}"
             )
 
 

@@ -71,7 +71,7 @@ from cometbft_tpu.crypto.batch import (
 )
 from cometbft_tpu.libs import trace as tracelib
 from cometbft_tpu.libs.log import Logger
-from cometbft_tpu.libs.metrics import Registry
+from cometbft_tpu.libs.metrics import MICRO_BUCKETS, Registry
 from cometbft_tpu.libs.service import BaseService
 
 DEFAULT_FLUSH_US = 500
@@ -132,8 +132,7 @@ class Metrics:
         self.request_wait_seconds = r.histogram(
             SUBSYSTEM, "request_wait_seconds",
             "Per-request wait from submit to dispatch start.",
-            buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
-                     0.05, 0.25, 1.0),
+            buckets=MICRO_BUCKETS,
         )
         self.requests = r.counter(
             SUBSYSTEM, "requests", "Requests submitted."
@@ -258,6 +257,7 @@ class VerifyScheduler(BaseService):
         max_queue: Optional[int] = None,
         join_timeout_s: float = 30.0,
         tracer: Optional[tracelib.Tracer] = None,
+        telemetry=None,
     ):
         super().__init__("VerifyScheduler", logger)
         if isinstance(spec, BackendSpec):
@@ -280,6 +280,10 @@ class VerifyScheduler(BaseService):
         self._supervisor = supervisor
         self._max_queue = max(1, max_queue_default(max_queue))
         self._tracer = tracer if tracer is not None else tracelib.default_tracer()
+        # the capacity-telemetry hub (crypto/telemetry.py) when the node
+        # wires one: every demuxed request is then RED-metered under its
+        # origin tag and feeds the SLO engine. None = zero cost.
+        self._telemetry = telemetry
         self._submit_timeout_s = int(
             os.environ.get(
                 "CBFT_SUBMIT_TIMEOUT_MS", str(DEFAULT_SUBMIT_TIMEOUT_MS)
@@ -314,6 +318,20 @@ class VerifyScheduler(BaseService):
     @property
     def supervisor(self):
         return self._supervisor
+
+    def queue_snapshot(self) -> dict:
+        """Point-in-time queue state for the health/capacity plane
+        (/debug/verify): what is waiting and what budget the next
+        size-flush targets."""
+        with self._cond:
+            return {
+                "queue_depth": len(self._requests),
+                "pending_lanes": self._pending_lanes,
+                "lane_budget": self._lane_budget,
+                "effective_lane_budget": self._effective_lane_budget(),
+                "flush_us": self.flush_us,
+                "dispatches": self.n_dispatches,
+            }
 
     def _effective_lane_budget(self) -> int:
         """The size-flush threshold scaled to the capacity the HEALTHY
@@ -533,8 +551,10 @@ class VerifyScheduler(BaseService):
         t0 = time.monotonic()
         items: List[Item] = []
         parent = None
+        waits: List[float] = []
         for req in batch:
             wait_s = t0 - req.t_submit
+            waits.append(wait_s)
             self.metrics.request_wait_seconds.observe(wait_s)
             items.extend(req.items)
             if not req.span.noop:
@@ -572,12 +592,25 @@ class VerifyScheduler(BaseService):
             dspan.end(error=repr(exc))
             raise
         dspan.end()
+        service_s = time.monotonic() - t0
         pos = 0
-        for req in batch:
+        for i, req in enumerate(batch):
             sub = mask[pos : pos + len(req.items)]
             pos += len(req.items)
-            req.future._set((all(sub), sub))
-            req.span.end(ok=all(sub))
+            ok = all(sub)
+            req.future._set((ok, sub))
+            req.span.end(ok=ok)
+            if self._telemetry is not None:
+                # the coalesced dispatch's service time is every rider's
+                # service time — they all waited on the same flush
+                self._telemetry.note_request(
+                    n_sigs=len(req.items),
+                    wait_s=waits[i],
+                    service_s=service_s,
+                    ok=ok,
+                    subsystem=req.subsystem,
+                    height=req.height,
+                )
 
     def _verify(
         self,

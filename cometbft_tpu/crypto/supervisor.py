@@ -502,6 +502,7 @@ class BackendSupervisor:
         logger: Optional[Logger] = None,
         tracer: Optional[tracelib.Tracer] = None,
         topology=None,
+        telemetry=None,
     ):
         spec = unwrap_backend(spec)
         if not isinstance(spec, BackendSpec):
@@ -563,6 +564,15 @@ class BackendSupervisor:
         if self.spec.name != "cpu":
             self._update_chunk_cap_gauge()
 
+        # the capacity-telemetry hub (crypto/telemetry.py): every
+        # completed device call reports its busy interval (the windowed
+        # duty-cycle numerator), and the hub's headroom estimator scales
+        # by this supervisor's healthy_capacity_fraction. None = free.
+        self._telemetry = telemetry
+        if telemetry is not None:
+            telemetry.register_source("supervisor", self.capacity_snapshot)
+            telemetry.set_capacity_fraction(self.healthy_capacity_fraction)
+
     # -- knob introspection --------------------------------------------------
 
     @property
@@ -621,6 +631,38 @@ class BackendSupervisor:
         flight-recorder dump and /debug consumers read this."""
         with self._lock:
             return {d.handle.label: d.state for d in self._domains}
+
+    def capacity_snapshot(self) -> Dict[str, object]:
+        """Per-domain health for the capacity plane (/debug/verify):
+        breaker states, effective chunk caps (post-OOM-shrink), and the
+        aggregate healthy fraction — what the headroom estimate and the
+        future sidecar's admission control read."""
+        default = self.spec.max_chunk or 8192
+        with self._lock:
+            handles = [
+                (d.handle, d.state, d.consecutive_failures)
+                for d in self._domains
+            ]
+        domains = {}
+        for handle, state, failures in handles:
+            try:
+                cap = handle.chunk_cap(default, 64)
+            except ValueError:  # malformed CBFT_TPU_MAX_CHUNK
+                cap = None
+            domains[handle.label] = {
+                "state": state,
+                "failures": failures,
+                "shrink_levels": handle.chunk_shrink_levels(),
+                "capacity_fraction": handle.capacity_fraction(),
+                "chunk_cap": cap,
+            }
+        return {
+            "state": self.state(),
+            "backend": self.spec.name,
+            "dispatch_timeout_ms": self.dispatch_timeout_ms,
+            "healthy_capacity_fraction": self.healthy_capacity_fraction(),
+            "domains": domains,
+        }
 
     def healthy_capacity_fraction(self) -> float:
         """Fraction of nominal device capacity currently in service:
@@ -964,9 +1006,12 @@ class BackendSupervisor:
                 h.span.end(error=repr(h.box["exc"]))
                 settle("device", "err", h.box["exc"])
                 return
-            dom.latency_model.observe(
-                len(items), time.monotonic() - h.t0
-            )
+            t1 = time.monotonic()
+            dom.latency_model.observe(len(items), t1 - h.t0)
+            if self._telemetry is not None:
+                self._telemetry.note_device_busy(
+                    dom.handle.label, h.t0, t1, len(items)
+                )
             h.span.end(outcome="ok")
             settle("device", "ok", h.box["mask"])
 
@@ -1215,7 +1260,12 @@ class BackendSupervisor:
         if "exc" in h.box:
             h.span.end(error=repr(h.box["exc"]))
             raise h.box["exc"]
-        dom.latency_model.observe(h.n, time.monotonic() - h.t0)
+        t1 = time.monotonic()
+        dom.latency_model.observe(h.n, t1 - h.t0)
+        if self._telemetry is not None:
+            self._telemetry.note_device_busy(
+                dom.handle.label, h.t0, t1, h.n
+            )
         h.span.end(outcome="ok")
         return h.box["mask"]
 
@@ -1415,10 +1465,18 @@ class BackendSupervisor:
 
     def _cpu_verify(self, items: List[Item]) -> List[bool]:
         with tracelib.child_of_current("cpu", n_sigs=len(items)):
+            t0 = time.monotonic()
             bv: BatchVerifier = CPUBatchVerifier()
             for pk, m, s in items:
                 bv.add(pk, m, s)
             _, mask = bv.verify()
+            if self._telemetry is not None:
+                # the host fallback plane is a capacity pool too: meter
+                # it as its own pseudo-device so a CPU-routed (or plain
+                # cpu-backend) node still shows utilization and headroom
+                self._telemetry.note_device_busy(
+                    "cpu", t0, time.monotonic(), len(items)
+                )
             return mask
 
     def _canary_items(self) -> List[Item]:

@@ -349,6 +349,14 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
         max_chunk = device.chunk_cap(max_chunk, min_pad)
     else:
         max_chunk = chunk_cap(max_chunk, min_pad)
+    # capacity telemetry: real lanes vs padded pow2-bucket lanes per
+    # chunk feed the hub's lane-fill efficiency (no hub installed =
+    # one attribute read per batch). Device-less dispatches account
+    # against the module shim's device 0, matching the chunk-cap shim.
+    from cometbft_tpu.crypto import telemetry as _telemetry
+
+    _hub = _telemetry.default_hub()
+    _dev_label = device.label if device is not None else "dev0"
     ndev = n_devices()
     depth = pipeline_depth()
     out = np.zeros(n, bool)
@@ -428,6 +436,8 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
         # before the device result is ready)
         span.set_tag("host_ns", time.perf_counter_ns() - t_host)
         span.set_tag("pad", size)
+        if _hub is not None:
+            _hub.note_chunk(_dev_label, end - start, size)
         inflight.append((chunk_idx, start, end, mask, span))
         while len(inflight) > depth:
             retire(inflight.popleft())

@@ -185,6 +185,24 @@ class DeviceTopology:
         for d in self.devices:
             d.reset_chunk_shrink()
 
+    def snapshot(self) -> dict:
+        """JSON-ready layout + runtime state for the capacity plane
+        (/debug/verify): which fault domains exist and how much of
+        their nominal lane capacity each currently serves."""
+        return {
+            "kind": self.kind,
+            "n_devices": len(self.devices),
+            "devices": [
+                {
+                    "label": d.label,
+                    "kind": d.kind,
+                    "shrink_levels": d.chunk_shrink_levels(),
+                    "capacity_fraction": d.capacity_fraction(),
+                }
+                for d in self.devices
+            ],
+        }
+
     def fingerprint(self) -> str:
         """Identity of this fault-domain layout for the AOT executable
         registry (crypto/tpu/aot.py): an executable compiled for one

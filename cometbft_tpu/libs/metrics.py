@@ -26,6 +26,16 @@ DEFAULT_BUCKETS = (
     0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# Latency buckets for the verify hot path. DEFAULT_BUCKETS starts at 5ms
+# — chain-level timescales — so every sub-millisecond verify stage
+# (coalesce wait, dispatch issue, per-chunk device wait) collapses into
+# the first bucket. verify_* latency families use this µs-resolution
+# ladder instead; it still reaches seconds for the watchdog tail.
+MICRO_BUCKETS = (
+    0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
 
 def _fmt_labels(labels: Dict[str, str]) -> str:
     if not labels:
@@ -38,6 +48,11 @@ def _fmt_labels(labels: Dict[str, str]) -> str:
 
 def _escape(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    # v0.0.4: HELP text escapes backslash and newline (quotes stay raw).
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_value(v: float) -> str:
@@ -86,7 +101,7 @@ class _Instrument:
 
     def expose(self) -> List[str]:
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {_escape_help(self.help)}",
             f"# TYPE {self.name} {self.kind}",
         ]
         n = 0
@@ -235,6 +250,17 @@ class Registry:
                     raise ValueError(
                         f"metric {inst.name} re-registered as a different kind"
                     )
+                if isinstance(existing, Histogram) and (
+                    existing._buckets != inst._buckets
+                ):
+                    # Silently returning the first registration would let
+                    # two callers believe they picked the buckets; the
+                    # second one's observations would land in a ladder it
+                    # never asked for.
+                    raise ValueError(
+                        f"histogram {inst.name} re-registered with "
+                        f"different buckets"
+                    )
                 return existing
             self._instruments[inst.name] = inst
             return inst
@@ -277,9 +303,19 @@ class MetricsServer(RouteServer):
       the count);
     * ``/debug/traces/chrome`` — the same traces as Chrome trace-event
       JSON, loadable directly in Perfetto / chrome://tracing.
+
+    When handed a ``crypto.telemetry.TelemetryHub`` it serves the
+    health/capacity plane:
+
+    * ``/debug/verify`` — one JSON snapshot of the verify path:
+      per-device utilization, lane-fill efficiency, per-subsystem RED
+      metering, SLO burn rate, headroom, and every registered source
+      (supervisor breaker states, scheduler queue, topology).
     """
 
-    def __init__(self, registry: Registry, tracer=None):
+    def __init__(self, registry: Registry, tracer=None, telemetry=None):
+        import json
+
         routes = {
             "/metrics": lambda _q: (
                 200,
@@ -287,9 +323,13 @@ class MetricsServer(RouteServer):
                 registry.expose().encode(),
             )
         }
+        if telemetry is not None:
+            routes["/debug/verify"] = lambda _q: (
+                200,
+                "application/json",
+                json.dumps(telemetry.snapshot(), indent=1).encode(),
+            )
         if tracer is not None:
-            import json
-
             from cometbft_tpu.libs import trace as _trace
 
             def _limit(q) -> Optional[int]:

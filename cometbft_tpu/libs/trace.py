@@ -32,9 +32,10 @@ Design:
 
 Env overrides (highest precedence), then config, then built-ins:
 
-- ``CBFT_TRACE_SAMPLE``   fraction of request roots sampled (0 disables)
-- ``CBFT_TRACE_BUFFER``   flight-recorder capacity (completed traces)
-- ``CBFT_TRACE_DUMP_DIR`` directory for incident dumps
+- ``CBFT_TRACE_SAMPLE``    fraction of request roots sampled (0 disables)
+- ``CBFT_TRACE_BUFFER``    flight-recorder capacity (completed traces)
+- ``CBFT_TRACE_DUMP_DIR``  directory for incident dumps
+- ``CBFT_TRACE_DUMP_KEEP`` incident dumps kept on disk (newest N)
 """
 
 from __future__ import annotations
@@ -49,6 +50,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 
 DEFAULT_SAMPLE = 0.0
 DEFAULT_BUFFER = 256
+DEFAULT_DUMP_KEEP = 20
 
 # Bound memory held by traces whose root never ends (leaked roots).
 _MAX_OPEN_TRACES = 1024
@@ -80,6 +82,20 @@ def trace_buffer_default(config_value: Optional[int] = None) -> int:
     if config_value is not None:
         return max(1, int(config_value))
     return DEFAULT_BUFFER
+
+
+def trace_dump_keep_default(config_value: Optional[int] = None) -> int:
+    """Resolve on-disk incident-dump retention (newest N kept):
+    env > [instrumentation] trace_dump_keep > built-in 20."""
+    raw = os.environ.get("CBFT_TRACE_DUMP_KEEP")
+    if raw is not None:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    if config_value is not None:
+        return max(1, int(config_value))
+    return DEFAULT_DUMP_KEEP
 
 
 # --------------------------------------------------------------------------
@@ -259,12 +275,18 @@ class Tracer:
         on_span_end: Optional[Callable[[Span], None]] = None,
         seed: Optional[int] = None,
         dump_dir: Optional[str] = None,
+        dump_keep: Optional[int] = None,
     ):
         self.sample = trace_sample_default(sample) if sample is None else min(
             1.0, max(0.0, float(sample))
         )
         self.buffer_size = trace_buffer_default(buffer) if buffer is None else max(
             1, int(buffer)
+        )
+        self.dump_keep = (
+            trace_dump_keep_default(dump_keep)
+            if dump_keep is None
+            else max(1, int(dump_keep))
         )
         self._on_span_end = on_span_end
         self._rng = random.Random(seed)
@@ -356,18 +378,29 @@ class Tracer:
 
         Destination: explicit ``path`` > ``CBFT_TRACE_DUMP_DIR`` env >
         configured dump dir.  Returns None (no-op) when no destination is
-        configured.  The filename is keyed by reason so repeated incidents
-        overwrite rather than grow unboundedly.  ``extra`` (a JSON-able
-        dict) is merged into the document — the supervisor records the
-        per-device breaker states here so an incident dump shows which
-        fault domain was sick.
+        configured.  Each incident gets its OWN file
+        (``trace_dump_<reason>_<ns>.json`` — a repeated cause no longer
+        overwrites the previous incident's evidence), and retention is
+        bounded at write time: only the newest ``dump_keep``
+        (CBFT_TRACE_DUMP_KEEP > [instrumentation] trace_dump_keep > 20)
+        ``trace_dump_*.json`` files survive in the destination
+        directory.  An explicit ``path`` is written verbatim and exempt
+        from pruning — the caller owns that location.  ``extra`` (a
+        JSON-able dict) is merged into the document — the supervisor
+        records the per-device breaker states here so an incident dump
+        shows which fault domain was sick.
         """
+        prune_dir = None
         if path is None:
             dump_dir = os.environ.get("CBFT_TRACE_DUMP_DIR") or self._dump_dir
             if not dump_dir:
                 return None
             safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in reason)
-            path = os.path.join(dump_dir, f"trace_dump_{safe or 'incident'}.json")
+            path = os.path.join(
+                dump_dir,
+                f"trace_dump_{safe or 'incident'}_{time.time_ns()}.json",
+            )
+            prune_dir = dump_dir
         doc = {
             "reason": reason,
             "wall_time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -384,7 +417,33 @@ class Tracer:
             os.replace(tmp, path)
         except OSError:
             return None
+        if prune_dir is not None:
+            self._prune_dumps(prune_dir)
         return path
+
+    def _prune_dumps(self, dump_dir: str) -> None:
+        """Delete the oldest ``trace_dump_*.json`` files beyond
+        ``dump_keep`` (by mtime, newest kept). Best-effort: a dump dir
+        race or permission error never surfaces into the incident path."""
+        try:
+            entries = []
+            for name in os.listdir(dump_dir):
+                if not (name.startswith("trace_dump_")
+                        and name.endswith(".json")):
+                    continue
+                p = os.path.join(dump_dir, name)
+                try:
+                    entries.append((os.path.getmtime(p), p))
+                except OSError:
+                    continue
+            entries.sort(reverse=True)  # newest first
+            for _, p in entries[self.dump_keep:]:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+        except OSError:
+            pass
 
     # -- internals ---------------------------------------------------------
 

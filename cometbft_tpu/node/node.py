@@ -172,6 +172,7 @@ class Node(BaseService):
         from cometbft_tpu.consensus.metrics import Metrics as ConsMetrics
         from cometbft_tpu.crypto.scheduler import Metrics as SchedMetrics
         from cometbft_tpu.crypto.supervisor import Metrics as SupMetrics
+        from cometbft_tpu.crypto.telemetry import Metrics as TelMetrics
         from cometbft_tpu.libs.metrics import Registry
         from cometbft_tpu.mempool.metrics import Metrics as MemMetrics
         from cometbft_tpu.p2p.metrics import Metrics as P2PMetrics
@@ -190,6 +191,7 @@ class Node(BaseService):
             sched_metrics = SchedMetrics(self.metrics_registry)
             sup_metrics = SupMetrics(self.metrics_registry)
             aot_metrics = AotMetrics(self.metrics_registry)
+            tel_metrics = TelMetrics(self.metrics_registry)
         else:
             self.metrics_registry = None
             cons_metrics = ConsMetrics.nop()
@@ -199,6 +201,7 @@ class Node(BaseService):
             sched_metrics = SchedMetrics.nop()
             sup_metrics = SupMetrics.nop()
             aot_metrics = AotMetrics.nop()
+            tel_metrics = TelMetrics.nop()
         # the AOT executable registry is process-global (it backs the
         # mesh dispatch layer, which predates any Node); the node only
         # lends it an exporter, exactly like the topology default above
@@ -221,11 +224,31 @@ class Node(BaseService):
             buffer=tracelib.trace_buffer_default(
                 config.instrumentation.trace_buffer
             ),
+            dump_keep=tracelib.trace_dump_keep_default(
+                config.instrumentation.trace_dump_keep
+            ),
         )
         if config.root_dir:
             self.tracer.set_dump_dir(os.path.join(config.root_dir, "data"))
         if self.metrics_registry is not None:
             tracelib.attach_stage_metrics(self.tracer, self.metrics_registry)
+
+        # 0d. the capacity-telemetry hub (crypto/telemetry.py): per-
+        # device utilization, lane-fill efficiency, per-subsystem RED
+        # metering, and the SLO engine — the health/capacity plane
+        # served as /debug/verify. Installed as the process default so
+        # the mesh chunk loop (which predates any node) reports lane
+        # fill without plumbing; supervisor and scheduler are handed it
+        # explicitly below.
+        from cometbft_tpu.crypto import telemetry as telemetrylib
+
+        self.telemetry_hub = telemetrylib.TelemetryHub(
+            metrics=tel_metrics,
+            slo_target_ms=telemetrylib.slo_commit_ms_default(
+                config.instrumentation.slo_commit_ms
+            ),
+        )
+        telemetrylib.set_default_hub(self.telemetry_hub)
 
         # 0b. the node-wide verification scheduler: ONE coalescer every
         # verification-carrying subsystem submits through, so concurrent
@@ -275,6 +298,7 @@ class Node(BaseService):
             logger=self.logger,
             tracer=self.tracer,
             topology=verify_topology,
+            telemetry=self.telemetry_hub,
         )
         self.verify_scheduler = VerifyScheduler(
             spec=self.crypto_spec,
@@ -284,6 +308,13 @@ class Node(BaseService):
             supervisor=self.verify_supervisor,
             max_queue=config.crypto.max_queue,
             tracer=self.tracer,
+            telemetry=self.telemetry_hub,
+        )
+        self.telemetry_hub.register_source(
+            "scheduler", self.verify_scheduler.queue_snapshot
+        )
+        self.telemetry_hub.register_source(
+            "topology", verify_topology.snapshot
         )
 
         # 1. stores
@@ -726,7 +757,9 @@ class Node(BaseService):
                 self.config.instrumentation.prometheus_listen_addr
             )
             self.metrics_server = MetricsServer(
-                self.metrics_registry, tracer=self.tracer
+                self.metrics_registry,
+                tracer=self.tracer,
+                telemetry=self.telemetry_hub,
             )
             self.metrics_server.serve(host, port)
         if self.state_sync_enabled:
@@ -873,6 +906,16 @@ class Node(BaseService):
             self.logger.error(
                 "error stopping verify supervisor", err=str(exc)
             )
+        # uninstall OUR telemetry hub from the process default so a
+        # later node (or test) never feeds a stopped node's plane; a
+        # hub another owner installed meanwhile is left alone
+        try:
+            from cometbft_tpu.crypto import telemetry as telemetrylib
+
+            if telemetrylib.default_hub() is self.telemetry_hub:
+                telemetrylib.set_default_hub(None)
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            pass
         # the AOT warm boot checks its stop event between compiles, so
         # this join is bounded by one in-flight compile (plus the warmup
         # subprocess timeout if phase 1 is mid-run — the thread is a

@@ -14,7 +14,7 @@ import pytest
     "section",
     [
         "coldboot", "ed25519", "validator_set", "light", "mempool",
-        "routing", "scheduler", "wal",
+        "routing", "scheduler", "telemetry", "wal",
     ],
 )
 def test_section_produces_numbers(section):

@@ -3,14 +3,22 @@ sets, and a live node serving /metrics.
 
 Model: reference consensus/metrics.go + node/node.go:1221
 startPrometheusServer (scrape endpoint contract).
+
+Also under test here: a strict v0.0.4 exposition conformance pass (the
+contract a real Prometheus scraper holds us to — label escaping, bucket
+monotonicity, +Inf == _count, no duplicate TYPE lines) and a
+concurrency hammer racing with_labels() child creation against
+expose().
 """
 
+import threading
 import urllib.request
 
 import pytest
 
 from cometbft_tpu.consensus.metrics import Metrics as ConsMetrics
 from cometbft_tpu.libs.metrics import (
+    MICRO_BUCKETS,
     MetricsServer,
     Registry,
 )
@@ -98,6 +106,246 @@ class TestEngineMetricSets:
         m.height.set(1)
         m.block_interval_seconds.observe(0.5)
         m.mark_step("prevote")
+
+
+class TestBucketOverrides:
+    def test_micro_buckets_are_sorted_and_sub_ms(self):
+        assert list(MICRO_BUCKETS) == sorted(MICRO_BUCKETS)
+        assert MICRO_BUCKETS[0] < 1e-5  # µs resolution at the bottom
+        assert MICRO_BUCKETS[-1] >= 1.0  # still reaches the watchdog tail
+
+    def test_same_buckets_reregistration_is_idempotent(self):
+        r = Registry("t")
+        a = r.histogram("sub", "lat", buckets=MICRO_BUCKETS)
+        b = r.histogram("sub", "lat", buckets=MICRO_BUCKETS)
+        assert a is b
+
+    def test_bucket_mismatch_raises(self):
+        r = Registry("t")
+        r.histogram("sub", "lat", buckets=MICRO_BUCKETS)
+        with pytest.raises(ValueError, match="different buckets"):
+            r.histogram("sub", "lat", buckets=(0.1, 1.0))
+
+    def test_children_inherit_parent_buckets(self):
+        r = Registry("t")
+        h = r.histogram("sub", "lat", buckets=(0.25, 2.0))
+        h.with_labels(subsystem="x").observe(1.0)
+        text = r.expose()
+        assert 't_sub_lat_bucket{le="0.25",subsystem="x"} 0' in text
+        assert 't_sub_lat_bucket{le="2",subsystem="x"} 1' in text
+
+
+def _parse_exposition(text):
+    """Strict Prometheus v0.0.4 text parser: returns (types, samples)
+    where samples is a list of (name, labels_dict, value). Raises
+    AssertionError on any malformed line, duplicate TYPE, or a sample
+    appearing before its family's TYPE line."""
+    types = {}
+    samples = []
+    if not text:  # nothing touched yet — an empty exposition is legal
+        return types, samples
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.splitlines():
+        if not line:  # blank separator lines are legal v0.0.4
+            continue
+        assert line == line.strip(), f"stray whitespace: {line!r}"
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name = rest.split(" ", 1)[0]
+            assert name, f"HELP without a name: {line!r}"
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split(" ")
+            assert len(parts) == 2, f"malformed TYPE: {line!r}"
+            name, kind = parts
+            assert kind in (
+                "counter", "gauge", "histogram", "summary", "untyped"
+            ), f"unknown kind: {line!r}"
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line!r}"
+        # sample: name[{labels}] value
+        i = 0
+        while i < len(line) and (line[i].isalnum() or line[i] in "_:"):
+            i += 1
+        name = line[:i]
+        assert name and not name[0].isdigit(), f"bad name: {line!r}"
+        labels = {}
+        if i < len(line) and line[i] == "{":
+            i += 1
+            while line[i] != "}":
+                j = i
+                while line[j] not in "=":
+                    j += 1
+                lname = line[i:j]
+                assert line[j + 1] == '"', f"unquoted label: {line!r}"
+                j += 2
+                val = []
+                while line[j] != '"':
+                    if line[j] == "\\":
+                        nxt = line[j + 1]
+                        assert nxt in ('"', "\\", "n"), (
+                            f"bad escape \\{nxt}: {line!r}"
+                        )
+                        val.append("\n" if nxt == "n" else nxt)
+                        j += 2
+                    else:
+                        val.append(line[j])
+                        j += 1
+                assert lname not in labels, f"duplicate label: {line!r}"
+                labels[lname] = "".join(val)
+                i = j + 1
+                if line[i] == ",":
+                    i += 1
+            i += 1
+        assert line[i] == " ", f"missing value separator: {line!r}"
+        raw = line[i + 1:]
+        value = float("inf") if raw == "+Inf" else float(raw)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types:
+                base = name[:-len(suffix)]
+        assert base in types, f"sample before TYPE: {line!r}"
+        if types[base] == "histogram":
+            assert base != name or False, (
+                f"bare sample for histogram family: {line!r}"
+            )
+        key = (name, tuple(sorted(labels.items())))
+        assert key not in [
+            (n, tuple(sorted(l.items()))) for n, l, _ in samples
+        ], f"duplicate series: {line!r}"
+        samples.append((name, labels, value))
+    return types, samples
+
+
+class TestExpositionConformance:
+    def _verify_registry(self):
+        """A registry shaped like the node's verify path exports."""
+        r = Registry("cometbft")
+        g = r.gauge("verify_slo", "p99_ms", "Rolling p99.")
+        g.set(12.5)
+        c = r.counter(
+            "verify_telemetry", "red_requests", "Requests by subsystem."
+        )
+        c.with_labels(subsystem="consensus").add(3)
+        c.with_labels(subsystem="blocksync").add(1)
+        h = r.histogram(
+            "verify_telemetry", "red_latency_seconds",
+            "Per-request latency.", buckets=MICRO_BUCKETS,
+        )
+        hs = h.with_labels(subsystem="consensus")
+        for v in (0.00002, 0.0004, 0.009, 4.0):
+            hs.observe(v)
+        return r
+
+    def test_strict_parse(self):
+        types, samples = _parse_exposition(self._verify_registry().expose())
+        assert types["cometbft_verify_slo_p99_ms"] == "gauge"
+        assert types["cometbft_verify_telemetry_red_requests"] == "counter"
+        assert (
+            types["cometbft_verify_telemetry_red_latency_seconds"]
+            == "histogram"
+        )
+        by_sub = {
+            l["subsystem"]: v for n, l, v in samples
+            if n == "cometbft_verify_telemetry_red_requests"
+        }
+        assert by_sub == {"consensus": 3.0, "blocksync": 1.0}
+
+    def test_bucket_monotonicity_and_inf_equals_count(self):
+        _, samples = _parse_exposition(self._verify_registry().expose())
+        fam = "cometbft_verify_telemetry_red_latency_seconds"
+        buckets = [
+            (float(l["le"]) if l["le"] != "+Inf" else float("inf"), v)
+            for n, l, v in samples if n == fam + "_bucket"
+        ]
+        assert len(buckets) == len(MICRO_BUCKETS) + 1
+        assert buckets == sorted(buckets, key=lambda b: b[0])
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts), "bucket counts must be cumulative"
+        count = next(v for n, l, v in samples if n == fam + "_count")
+        assert buckets[-1][0] == float("inf")
+        assert buckets[-1][1] == count
+        total = next(v for n, l, v in samples if n == fam + "_sum")
+        assert total == pytest.approx(0.00002 + 0.0004 + 0.009 + 4.0)
+
+    def test_label_value_escaping_roundtrip(self):
+        r = Registry("t")
+        nasty = 'quote:" back:\\ newline:\nend'
+        r.counter("sub", "evil", "h").with_labels(device=nasty).add()
+        types, samples = _parse_exposition(r.expose())
+        assert samples == [("t_sub_evil", {"device": nasty}, 1.0)]
+
+    def test_help_escaping(self):
+        r = Registry("t")
+        r.gauge("sub", "g", "line one\nline \\ two").set(1)
+        text = r.expose()
+        assert "# HELP t_sub_g line one\\nline \\\\ two" in text
+        _parse_exposition(text)  # still one physical line per entry
+
+    def test_no_duplicate_type_lines_across_families(self):
+        text = self._verify_registry().expose()
+        type_lines = [
+            l for l in text.splitlines() if l.startswith("# TYPE")
+        ]
+        assert len(type_lines) == len(set(type_lines))
+
+
+class TestConcurrencyHammer:
+    def test_with_labels_races_expose(self):
+        """Satellite contract: scrapes concurrent with hot-path child
+        creation never tear — every expose() parses strictly, and the
+        final totals equal exactly what the writers wrote."""
+        r = Registry("cometbft")
+        c = r.counter("verify_telemetry", "red_requests", "Req.")
+        h = r.histogram(
+            "verify_telemetry", "red_latency_seconds", "Lat.",
+            buckets=MICRO_BUCKETS,
+        )
+        n_writers, per_writer = 8, 300
+        stop = threading.Event()
+        errors = []
+
+        def writer(wid):
+            try:
+                for i in range(per_writer):
+                    sub = f"sub{(wid + i) % 5}"
+                    c.with_labels(subsystem=sub).add()
+                    h.with_labels(subsystem=sub).observe(0.0001 * (i % 7))
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        def scraper():
+            try:
+                while not stop.is_set():
+                    _parse_exposition(r.expose())
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        scrapers = [threading.Thread(target=scraper) for _ in range(2)]
+        writers = [
+            threading.Thread(target=writer, args=(w,))
+            for w in range(n_writers)
+        ]
+        for t in scrapers + writers:
+            t.start()
+        for t in writers:
+            t.join()
+        stop.set()
+        for t in scrapers:
+            t.join()
+        assert not errors, errors[:3]
+        _, samples = _parse_exposition(r.expose())
+        req = {
+            l["subsystem"]: v for n, l, v in samples
+            if n == "cometbft_verify_telemetry_red_requests"
+        }
+        assert sum(req.values()) == n_writers * per_writer
+        assert set(req) == {f"sub{i}" for i in range(5)}
+        fam = "cometbft_verify_telemetry_red_latency_seconds_count"
+        obs = sum(v for n, _, v in samples if n == fam)
+        assert obs == n_writers * per_writer
 
 
 class TestMetricsServer:

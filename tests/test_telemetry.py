@@ -1,0 +1,454 @@
+"""Capacity telemetry: windowed utilization, per-subsystem RED metering,
+the SLO engine, and the /debug/verify health plane.
+
+Contract under test (crypto/telemetry.py + the MetricsServer route +
+tools/verify_top.py):
+  - _IntervalWindow clips busy intervals to the rolling window; the
+    duty cycle never exceeds 1.0 even with overlapping hedge intervals;
+  - SLOEngine reports nearest-rank p50/p99, violation counts, and an
+    error-budget burn rate against the configured target;
+  - note_request meters RED per origin subsystem (untagged tenants fall
+    under "untagged") and feeds the SLO window;
+  - the headroom estimator projects from the bottleneck device's duty
+    cycle scaled by healthy capacity, and refuses to project while cold;
+  - snapshot() is one JSON-ready document that survives raising
+    sources, and refreshes the verify_slo_*/verify_telemetry_* gauges;
+  - scheduler + supervisor integration: a real submit through
+    BackendSpec("cpu") lands in the RED table and the "cpu"
+    pseudo-device busy window;
+  - MetricsServer serves the snapshot at /debug/verify and
+    tools/verify_top.py --once renders it.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import telemetry as telemetrylib
+from cometbft_tpu.crypto.batch import BackendSpec
+from cometbft_tpu.crypto.scheduler import VerifyScheduler
+from cometbft_tpu.crypto.supervisor import BackendSupervisor
+from cometbft_tpu.crypto.telemetry import (
+    DEFAULT_SLO_COMMIT_MS,
+    SLOEngine,
+    TelemetryHub,
+    _IntervalWindow,
+    slo_commit_ms_default,
+)
+from cometbft_tpu.libs.metrics import MetricsServer, Registry
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _make_items(n, tag=b"tel"):
+    items = []
+    for i in range(n):
+        k = ed.gen_priv_key_from_secret(tag + bytes([i & 0xFF, i >> 8]))
+        msg = b"telemetry-msg-" + i.to_bytes(4, "big")
+        items.append((k.pub_key(), msg, k.sign(msg)))
+    return items
+
+
+class TestSLODefault:
+    def test_precedence_env_config_builtin(self, monkeypatch):
+        monkeypatch.delenv("CBFT_SLO_COMMIT_MS", raising=False)
+        assert slo_commit_ms_default() == DEFAULT_SLO_COMMIT_MS
+        assert slo_commit_ms_default(250) == 250
+        monkeypatch.setenv("CBFT_SLO_COMMIT_MS", "42")
+        assert slo_commit_ms_default(250) == 42
+        monkeypatch.setenv("CBFT_SLO_COMMIT_MS", "not-a-number")
+        assert slo_commit_ms_default(250) == 250
+
+    def test_floor_is_one_ms(self, monkeypatch):
+        monkeypatch.setenv("CBFT_SLO_COMMIT_MS", "-5")
+        assert slo_commit_ms_default() == 1
+
+
+class TestIntervalWindow:
+    def test_clips_to_window(self):
+        w = _IntervalWindow()
+        w.add(0.0, 10.0, 100)  # straddles the cutoff
+        w.add(95.0, 96.0, 7)
+        busy, sigs = w.busy_in(now=100.0, window_s=10.0)
+        # only [95, 96] is inside [90, 100]; the first interval ended
+        # at t=10, before the cutoff
+        assert busy == pytest.approx(1.0)
+        assert sigs == 7
+
+    def test_partial_overlap_is_clipped(self):
+        w = _IntervalWindow()
+        w.add(85.0, 95.0, 10)  # 5s of it lands inside [90, 100]
+        busy, _ = w.busy_in(now=100.0, window_s=10.0)
+        assert busy == pytest.approx(5.0)
+
+    def test_overlapping_intervals_cap_at_saturation(self):
+        # hedge + retry racing on one device: raw busy can exceed the
+        # window; the hub caps utilization at 1.0
+        clock = FakeClock()
+        hub = TelemetryHub(window_s=10.0, clock=clock)
+        hub.note_device_busy("dev0", clock.t - 8, clock.t, 64)
+        hub.note_device_busy("dev0", clock.t - 8, clock.t, 64)
+        util = hub.utilization()
+        assert util["dev0"]["utilization"] == 1.0
+        assert util["dev0"]["window_sigs"] == 128
+
+
+class TestSLOEngine:
+    def test_percentiles_and_violations(self):
+        clock = FakeClock()
+        slo = SLOEngine(target_ms=100, window_s=60.0, clock=clock)
+        clock.advance(10.0)
+        for ms in (10, 20, 30, 40, 50, 60, 70, 80, 90, 500):
+            slo.observe(ms / 1e3, n_sigs=10)
+        snap = slo.snapshot()
+        assert snap["requests"] == 10
+        assert snap["violations"] == 1  # only the 500ms sample
+        assert snap["p50_ms"] == pytest.approx(50.0)
+        assert snap["p99_ms"] == pytest.approx(500.0)
+        # 10% violating over a 1% budget: burning 10x sustainable
+        assert snap["burn_rate"] == pytest.approx(10.0)
+        # 100 sigs over the 10s the node has been alive (< window)
+        assert snap["throughput_sigs_per_sec"] == pytest.approx(10.0)
+
+    def test_samples_age_out_of_window(self):
+        clock = FakeClock()
+        slo = SLOEngine(target_ms=100, window_s=60.0, clock=clock)
+        slo.observe(0.5)  # violation, soon stale
+        clock.advance(120.0)
+        slo.observe(0.01)
+        snap = slo.snapshot()
+        assert snap["requests"] == 1
+        assert snap["violations"] == 0
+        assert snap["burn_rate"] == 0.0
+
+    def test_empty_window_is_calm(self):
+        snap = SLOEngine(target_ms=100).snapshot()
+        assert snap["requests"] == 0
+        assert snap["p50_ms"] is None
+        assert snap["p99_ms"] is None
+        assert snap["burn_rate"] == 0.0
+
+
+class TestHubRED:
+    def test_per_subsystem_accounting(self):
+        clock = FakeClock()
+        hub = TelemetryHub(window_s=60.0, clock=clock)
+        hub.note_request(64, 0.001, 0.004, True,
+                         subsystem="consensus", height=7)
+        hub.note_request(32, 0.001, 0.004, False,
+                         subsystem="consensus", height=8)
+        hub.note_request(16, 0.0, 0.002, True, subsystem="blocksync")
+        hub.note_request(8, 0.0, 0.001, True)  # origin-less
+        subs = hub.subsystems()
+        cons = subs["consensus"]
+        assert cons["requests"] == 2
+        assert cons["errors"] == 1
+        assert cons["sigs"] == 96
+        assert cons["last_height"] == 8
+        assert cons["p50_ms"] == pytest.approx(5.0)
+        assert subs["blocksync"]["requests"] == 1
+        assert subs[telemetrylib.UNTAGGED]["sigs"] == 8
+
+    def test_red_counters_exported(self):
+        r = Registry("cometbft")
+        hub = TelemetryHub(metrics=telemetrylib.Metrics(r))
+        hub.note_request(4, 0.0, 0.001, False, subsystem="evidence")
+        text = r.expose()
+        assert ('cometbft_verify_telemetry_red_requests'
+                '{subsystem="evidence"} 1') in text
+        assert ('cometbft_verify_telemetry_red_errors'
+                '{subsystem="evidence"} 1') in text
+        assert ('cometbft_verify_telemetry_red_sigs'
+                '{subsystem="evidence"} 4') in text
+        assert "verify_telemetry_red_latency_seconds_bucket" in text
+
+
+class TestLaneFill:
+    def test_efficiency_ratio(self):
+        clock = FakeClock()
+        hub = TelemetryHub(window_s=60.0, clock=clock)
+        hub.note_chunk("dev0", 100, 128)
+        hub.note_chunk("dev0", 28, 32)
+        fill = hub.lane_fill()
+        assert fill["chunks"] == 2
+        assert fill["real_lanes"] == 128
+        assert fill["padded_lanes"] == 160
+        assert fill["efficiency"] == pytest.approx(0.8)
+
+    def test_no_chunks_means_no_ratio(self):
+        assert TelemetryHub().lane_fill()["efficiency"] is None
+
+
+class TestHeadroom:
+    def test_cold_refuses_to_project(self):
+        head = TelemetryHub().headroom()
+        assert head["headroom_sigs_per_sec"] is None
+        assert head["projected_capacity_sigs_per_sec"] is None
+
+    def test_projection_math(self):
+        clock = FakeClock()
+        hub = TelemetryHub(window_s=10.0, clock=clock)
+        clock.advance(100.0)
+        # device busy 50% of the window, serving all observed traffic
+        hub.note_device_busy("dev0", clock.t - 5.0, clock.t, 1000)
+        hub.note_request(1000, 0.0, 0.001, True, subsystem="consensus")
+        hub.set_capacity_fraction(lambda: 0.5)
+        head = hub.headroom()
+        tput = head["throughput_sigs_per_sec"]
+        assert tput == pytest.approx(100.0)  # 1000 sigs / 10s window
+        assert head["peak_device_utilization"] == pytest.approx(0.5)
+        assert head["healthy_capacity_fraction"] == pytest.approx(0.5)
+        # 100 / 0.5 util * 0.5 healthy = 100 projected -> 0 headroom
+        assert head["projected_capacity_sigs_per_sec"] == pytest.approx(
+            tput
+        )
+        assert head["headroom_sigs_per_sec"] == pytest.approx(0.0)
+
+    def test_raising_capacity_oracle_is_advisory(self):
+        clock = FakeClock()
+        hub = TelemetryHub(window_s=10.0, clock=clock)
+        clock.advance(100.0)
+        hub.note_device_busy("dev0", clock.t - 5.0, clock.t, 100)
+        hub.note_request(100, 0.0, 0.001, True)
+
+        def boom():
+            raise RuntimeError("oracle down")
+
+        hub.set_capacity_fraction(boom)
+        head = hub.headroom()
+        assert head["healthy_capacity_fraction"] == 1.0
+        assert head["headroom_sigs_per_sec"] is not None
+
+
+class TestSnapshot:
+    def test_document_shape(self):
+        hub = TelemetryHub()
+        hub.note_request(4, 0.0, 0.001, True, subsystem="light")
+        snap = hub.snapshot()
+        for key in ("ts", "window_s", "devices", "lane_fill",
+                    "subsystems", "slo", "headroom", "sources"):
+            assert key in snap
+        json.dumps(snap)  # must be JSON-ready as served
+
+    def test_raising_source_reports_error(self):
+        hub = TelemetryHub()
+        hub.register_source("ok", lambda: {"fine": 1})
+        hub.register_source("broken", lambda: 1 / 0)
+        sources = hub.snapshot()["sources"]
+        assert sources["ok"] == {"fine": 1}
+        assert "ZeroDivisionError" in sources["broken"]["error"]
+
+    def test_snapshot_refreshes_gauges(self):
+        r = Registry("cometbft")
+        clock = FakeClock()
+        hub = TelemetryHub(
+            metrics=telemetrylib.Metrics(r), slo_target_ms=100,
+            window_s=10.0, clock=clock,
+        )
+        clock.advance(50.0)
+        hub.note_device_busy("dev0", clock.t - 2.0, clock.t, 64)
+        hub.note_request(64, 0.0, 0.010, True, subsystem="consensus")
+        hub.snapshot()
+        text = r.expose()
+        assert "cometbft_verify_slo_target_ms 100" in text
+        assert "cometbft_verify_slo_p50_ms 10" in text
+        assert "cometbft_verify_slo_window_requests 1" in text
+        assert ('cometbft_verify_telemetry_device_utilization'
+                '{device="dev0"} 0.2') in text
+
+    def test_cold_headroom_gauge_is_negative_one(self):
+        r = Registry("cometbft")
+        hub = TelemetryHub(metrics=telemetrylib.Metrics(r))
+        hub.note_request(1, 0.0, 0.001, True)  # wakes slo gauges
+        hub.snapshot()
+        assert "cometbft_verify_slo_headroom_sigs_per_sec -1" in (
+            r.expose()
+        )
+
+
+class TestDefaultHub:
+    def test_set_get_restore(self):
+        prev = telemetrylib.set_default_hub(None)
+        try:
+            assert telemetrylib.default_hub() is None
+            hub = TelemetryHub()
+            assert telemetrylib.set_default_hub(hub) is None
+            assert telemetrylib.default_hub() is hub
+            assert telemetrylib.set_default_hub(None) is hub
+        finally:
+            telemetrylib.set_default_hub(prev)
+
+
+class TestSchedulerIntegration:
+    def test_submit_lands_in_red_and_slo(self):
+        hub = TelemetryHub(slo_target_ms=60_000)
+        sched = VerifyScheduler(
+            spec=BackendSpec("cpu"), flush_us=500, telemetry=hub
+        )
+        sched.start()
+        try:
+            ok, mask = sched.submit(
+                _make_items(4), subsystem="blocksync", height=12
+            ).result(timeout=60)
+            assert ok and all(mask)
+        finally:
+            sched.stop()
+        snap = hub.snapshot()
+        bs = snap["subsystems"]["blocksync"]
+        assert bs["requests"] == 1
+        assert bs["sigs"] == 4
+        assert bs["last_height"] == 12
+        assert bs["p50_ms"] is not None and bs["p50_ms"] > 0
+        assert snap["slo"]["requests"] == 1
+        assert snap["slo"]["violations"] == 0
+
+    def test_queue_snapshot_source(self):
+        hub = TelemetryHub()
+        sched = VerifyScheduler(
+            spec=BackendSpec("cpu"), flush_us=500, telemetry=hub
+        )
+        hub.register_source("scheduler", sched.queue_snapshot)
+        sched.start()
+        try:
+            sched.submit(_make_items(2)).result(timeout=60)
+        finally:
+            sched.stop()
+        q = hub.snapshot()["sources"]["scheduler"]
+        assert q["queue_depth"] == 0
+        assert q["dispatches"] >= 1
+        assert q["lane_budget"] > 0
+
+
+class TestSupervisorIntegration:
+    def test_cpu_pseudo_device_and_capacity_source(self):
+        hub = TelemetryHub()
+        sup = BackendSupervisor(spec=BackendSpec("cpu"), telemetry=hub)
+        try:
+            mask = sup.verify_items(_make_items(3))
+            assert mask == [True, True, True]
+        finally:
+            sup.stop()
+        snap = hub.snapshot()
+        cpu = snap["devices"]["cpu"]
+        assert cpu["window_sigs"] == 3
+        assert cpu["busy_s"] > 0
+        cap = snap["sources"]["supervisor"]
+        assert cap["state"] == "healthy"
+        assert cap["healthy_capacity_fraction"] == pytest.approx(1.0)
+        assert cap["domains"]  # at least device 0
+        for dom in cap["domains"].values():
+            assert dom["state"] == "healthy"
+            assert dom["failures"] == 0
+
+    def test_headroom_scales_by_supervisor_fraction(self):
+        hub = TelemetryHub()
+        sup = BackendSupervisor(spec=BackendSpec("cpu"), telemetry=hub)
+        try:
+            assert hub._capacity_fn is not None
+            assert hub._capacity_fn() == pytest.approx(
+                sup.healthy_capacity_fraction()
+            )
+        finally:
+            sup.stop()
+
+
+class TestDebugVerifyEndpoint:
+    def test_served_snapshot(self):
+        r = Registry("cometbft")
+        hub = TelemetryHub(metrics=telemetrylib.Metrics(r))
+        hub.note_request(8, 0.0, 0.002, True,
+                         subsystem="consensus", height=3)
+        srv = MetricsServer(r, telemetry=hub)
+        port = srv.serve("127.0.0.1", 0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/verify", timeout=5
+            ).read().decode()
+        finally:
+            srv.stop()
+        doc = json.loads(body)
+        assert doc["subsystems"]["consensus"]["last_height"] == 3
+        assert doc["slo"]["target_ms"] == hub.slo.target_ms
+        assert "headroom" in doc and "devices" in doc
+
+    def test_absent_without_hub(self):
+        srv = MetricsServer(Registry("cometbft"))
+        port = srv.serve("127.0.0.1", 0)
+        try:
+            with pytest.raises(Exception):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/verify", timeout=5
+                )
+        finally:
+            srv.stop()
+
+
+class TestVerifyTopCLI:
+    def test_once_renders_live_endpoint(self, tmp_path):
+        r = Registry("cometbft")
+        hub = TelemetryHub(metrics=telemetrylib.Metrics(r))
+        hub.note_request(64, 0.0005, 0.004, True,
+                         subsystem="consensus", height=41)
+        hub.note_device_busy("dev0", hub._clock() - 0.01,
+                             hub._clock(), 64)
+        hub.note_chunk("dev0", 64, 64)
+        srv = MetricsServer(r, telemetry=hub)
+        port = srv.serve("127.0.0.1", 0)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.join(repo, "tools",
+                                              "verify_top.py"),
+                 f"http://127.0.0.1:{port}", "--once"],
+                capture_output=True, text=True, timeout=60, cwd=repo,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            )
+        finally:
+            srv.stop()
+        assert res.returncode == 0, res.stderr[-400:]
+        out = res.stdout
+        assert "verify-path capacity" in out
+        assert "SLO" in out and "target=" in out
+        assert "consensus" in out
+        assert "dev0" in out
+        assert "41" in out  # last_height rendered
+
+    def test_once_renders_snapshot_file(self, tmp_path):
+        hub = TelemetryHub()
+        hub.note_request(4, 0.0, 0.001, True, subsystem="light")
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(hub.snapshot()))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        res = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "verify_top.py"),
+             str(path), "--once"],
+            capture_output=True, text=True, timeout=60, cwd=repo,
+        )
+        assert res.returncode == 0, res.stderr[-400:]
+        assert "light" in res.stdout
+
+    def test_rejects_non_snapshot(self, tmp_path):
+        path = tmp_path / "junk.json"
+        path.write_text('{"hello": 1}')
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        res = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "verify_top.py"),
+             str(path), "--once"],
+            capture_output=True, text=True, timeout=60, cwd=repo,
+        )
+        assert res.returncode == 1
+        assert "not a verify capacity snapshot" in res.stderr

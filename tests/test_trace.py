@@ -10,6 +10,7 @@ then trips the watchdog to produce the automatic flight-recorder dump
 and renders it through the report CLI and the Chrome exporter.
 """
 
+import glob
 import importlib.util
 import json
 import os
@@ -22,6 +23,14 @@ from cometbft_tpu.libs import trace as tracelib
 from cometbft_tpu.libs.metrics import Registry
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _dumps(dirpath, reason="watchdog"):
+    """Incident dump files for ``reason`` in ``dirpath``, oldest first
+    (filenames embed a nanosecond timestamp, so name order = time order)."""
+    return sorted(
+        glob.glob(os.path.join(str(dirpath), f"trace_dump_{reason}_*.json"))
+    )
 
 
 def _load_trace_report():
@@ -282,14 +291,51 @@ class TestKnobsAndExporters:
         assert tr.dump("nowhere") is None  # no destination configured
         tr.set_dump_dir(str(tmp_path / "cfg"))
         p1 = tr.dump("watchdog")
-        assert p1 == str(tmp_path / "cfg" / "trace_dump_watchdog.json")
+        assert p1 in _dumps(tmp_path / "cfg")
         doc = json.load(open(p1))
         assert doc["reason"] == "watchdog"
         assert len(doc["traces"]) == 1
         envdir = tmp_path / "env"
         monkeypatch.setenv("CBFT_TRACE_DUMP_DIR", str(envdir))
         p2 = tr.dump("watchdog")
-        assert p2 == str(envdir / "trace_dump_watchdog.json")
+        assert p2 in _dumps(envdir)
+        assert _dumps(tmp_path / "cfg")  # cfg dump untouched
+
+    def test_dump_retention_keeps_newest_n(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("CBFT_TRACE_DUMP_DIR", raising=False)
+        monkeypatch.delenv("CBFT_TRACE_DUMP_KEEP", raising=False)
+        tr = tracelib.Tracer(sample=1.0, buffer=8, dump_keep=3)
+        tr.start_span("request").end()
+        tr.set_dump_dir(str(tmp_path))
+        paths = [tr.dump(f"cause{i}") for i in range(6)]
+        assert all(paths)
+        left = sorted(
+            glob.glob(str(tmp_path / "trace_dump_*.json"))
+        )
+        assert len(left) == 3
+        # the newest three survived, oldest three were pruned
+        assert set(left) == set(paths[-3:])
+
+    def test_dump_keep_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("CBFT_TRACE_DUMP_KEEP", "7")
+        tr = tracelib.Tracer(sample=1.0, buffer=8)
+        assert tr.dump_keep == 7
+        monkeypatch.delenv("CBFT_TRACE_DUMP_KEEP")
+        assert tracelib.Tracer(sample=0).dump_keep == (
+            tracelib.DEFAULT_DUMP_KEEP
+        )
+
+    def test_explicit_path_write_does_not_prune(self, tmp_path):
+        tr = tracelib.Tracer(sample=1.0, buffer=8, dump_keep=1)
+        tr.start_span("request").end()
+        tr.set_dump_dir(str(tmp_path))
+        auto = tr.dump("auto")
+        assert auto and os.path.exists(auto)
+        # an explicit-path write is caller-owned: verbatim filename, no
+        # retention sweep of the surrounding directory
+        pinned = str(tmp_path / "trace_dump_pinned.json")
+        assert tr.dump("pinned", path=pinned) == pinned
+        assert os.path.exists(auto)
 
 
 # ---------------------------------------------------------------------------
@@ -421,9 +467,9 @@ class TestSupervisorTracing:
         mask = sup.verify_items(items)  # watchdog fires; CPU fallback
         assert mask == [True] * 4
         assert sup.state() == "broken"
-        path = tmp_path / "trace_dump_watchdog.json"
-        assert path.exists()
-        doc = json.load(open(path))
+        dumps = _dumps(tmp_path)
+        assert dumps
+        doc = json.load(open(dumps[-1]))
         assert doc["reason"] == "watchdog"
         assert doc["traces"]  # the healthy dispatch made it in
         # the dump is written at trip time, so it holds the COMPLETED
@@ -515,8 +561,9 @@ class TestEndToEnd:
         sup2.stop()
         plan.clear()
 
-        dump_path = str(tmp_path / "trace_dump_watchdog.json")
-        assert os.path.exists(dump_path)
+        dumps = _dumps(tmp_path)
+        assert dumps
+        dump_path = dumps[-1]
         doc = json.load(open(dump_path))
         assert doc["reason"] == "watchdog"
 

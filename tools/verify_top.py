@@ -1,0 +1,212 @@
+"""verify_top — a live "top" for the verify path.
+
+Polls a node's /debug/verify endpoint (crypto/telemetry.py's
+health/capacity plane, served by MetricsServer) or reads a snapshot
+JSON file, and renders the capacity picture an operator actually asks
+for: per-device utilization, lane-fill efficiency, per-subsystem RED
+metering, SLO attainment/burn, and remaining headroom.
+
+Usage:
+    python tools/verify_top.py http://127.0.0.1:26660/debug/verify
+    python tools/verify_top.py http://127.0.0.1:26660          # path added
+    python tools/verify_top.py snapshot.json --once
+    python tools/verify_top.py URL --interval 1 --count 10
+
+``--once`` prints a single frame and exits (tests / CI / cron); without
+it the screen refreshes every ``--interval`` seconds until ^C.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ENDPOINT_PATH = "/debug/verify"
+
+
+def load_snapshot(source: str) -> Dict[str, Any]:
+    """Load one capacity snapshot from a /debug/verify URL or a file."""
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        url = source
+        if ENDPOINT_PATH not in url:
+            url = url.rstrip("/") + ENDPOINT_PATH
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            doc = json.loads(resp.read().decode("utf-8"))
+    else:
+        with open(source, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    if not isinstance(doc, dict) or "slo" not in doc:
+        raise ValueError(
+            f"{source}: not a verify capacity snapshot "
+            "(expected the /debug/verify document)"
+        )
+    return doc
+
+
+def _fmt_table(rows: List[Dict[str, Any]], columns: List[str]) -> str:
+    if not rows:
+        return "  (no data)"
+    widths = {
+        c: max(len(c), *(len(str(r.get(c, "-"))) for r in rows))
+        for c in columns
+    }
+    head = "  ".join(c.rjust(widths[c]) for c in columns)
+    sep = "  ".join("-" * widths[c] for c in columns)
+    body = [
+        "  ".join(str(r.get(c, "-")).rjust(widths[c]) for c in columns)
+        for r in rows
+    ]
+    return "\n".join(["  " + head, "  " + sep] + ["  " + b for b in body])
+
+
+def _pct(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v * 100:.1f}%"
+
+
+def render(snap: Dict[str, Any]) -> str:
+    """One frame of the capacity picture, plain text."""
+    out: List[str] = []
+    slo = snap.get("slo", {})
+    head = snap.get("headroom", {})
+    sources = snap.get("sources", {})
+    sup = sources.get("supervisor", {}) if isinstance(sources, dict) else {}
+    sched = sources.get("scheduler", {}) if isinstance(sources, dict) else {}
+
+    state = sup.get("state", "?")
+    frac = head.get("healthy_capacity_fraction")
+    out.append(
+        f"verify-path capacity  state={state}  "
+        f"healthy_capacity={_pct(frac)}  "
+        f"window={snap.get('window_s', '?')}s"
+    )
+    burn = slo.get("burn_rate", 0.0)
+    burn_flag = " !!" if isinstance(burn, (int, float)) and burn > 1.0 else ""
+    out.append(
+        f"SLO  target={slo.get('target_ms', '?')}ms  "
+        f"p50={slo.get('p50_ms', '-')}ms  p99={slo.get('p99_ms', '-')}ms  "
+        f"burn={burn}{burn_flag}  "
+        f"({slo.get('violations', 0)}/{slo.get('requests', 0)} over target)"
+    )
+    hr = head.get("headroom_sigs_per_sec")
+    out.append(
+        f"load  {head.get('throughput_sigs_per_sec', 0)} sigs/s  "
+        f"peak_device_util={_pct(head.get('peak_device_utilization'))}  "
+        f"headroom={'(cold)' if hr is None else f'{hr} sigs/s'}"
+    )
+    if sched:
+        out.append(
+            f"queue  depth={sched.get('queue_depth', '-')}  "
+            f"pending_lanes={sched.get('pending_lanes', '-')}  "
+            f"lane_budget={sched.get('effective_lane_budget', '-')}"
+            f"/{sched.get('lane_budget', '-')}  "
+            f"dispatches={sched.get('dispatches', '-')}"
+        )
+    fill = snap.get("lane_fill", {})
+    if fill.get("padded_lanes"):
+        out.append(
+            f"lanes  efficiency={_pct(fill.get('efficiency'))}  "
+            f"real={fill.get('real_lanes')}  "
+            f"padded={fill.get('padded_lanes')}  "
+            f"chunks={fill.get('chunks')}"
+        )
+
+    out.append("")
+    out.append("devices:")
+    dev_rows = []
+    domains = sup.get("domains", {}) if isinstance(sup, dict) else {}
+    devices = snap.get("devices", {})
+    for label in sorted(set(devices) | set(domains)):
+        d = devices.get(label, {})
+        dom = domains.get(label, {})
+        dev_rows.append({
+            "device": label,
+            "util": _pct(d.get("utilization")),
+            "busy_s": d.get("busy_s", "-"),
+            "sigs": d.get("window_sigs", "-"),
+            "state": dom.get("state", "-"),
+            "chunk_cap": dom.get("chunk_cap", "-"),
+            "capacity": _pct(dom.get("capacity_fraction")),
+        })
+    out.append(_fmt_table(
+        dev_rows,
+        ["device", "util", "busy_s", "sigs", "state", "chunk_cap",
+         "capacity"],
+    ))
+
+    out.append("")
+    out.append("subsystems (RED):")
+    sub_rows = []
+    for name, s in sorted(snap.get("subsystems", {}).items()):
+        sub_rows.append({
+            "subsystem": name,
+            "req": s.get("requests", 0),
+            "err": s.get("errors", 0),
+            "sigs": s.get("sigs", 0),
+            "req/s": s.get("rate_per_sec", "-"),
+            "p50_ms": s.get("p50_ms", "-"),
+            "p99_ms": s.get("p99_ms", "-"),
+            "height": s.get("last_height", "-"),
+        })
+    out.append(_fmt_table(
+        sub_rows,
+        ["subsystem", "req", "err", "sigs", "req/s", "p50_ms", "p99_ms",
+         "height"],
+    ))
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Live capacity view of a node's verify path."
+    )
+    ap.add_argument(
+        "source",
+        help="a node's /debug/verify URL (path appended if missing) or "
+             "a snapshot JSON file",
+    )
+    ap.add_argument(
+        "--once", action="store_true",
+        help="print one frame and exit (tests / CI)",
+    )
+    ap.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh period in seconds (default 2)",
+    )
+    ap.add_argument(
+        "--count", type=int, default=0,
+        help="stop after N frames (0 = until interrupted)",
+    )
+    args = ap.parse_args(argv)
+
+    frames = 0
+    while True:
+        try:
+            snap = load_snapshot(args.source)
+        except Exception as exc:  # noqa: BLE001 - CLI surface
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
+        frame = render(snap)
+        if args.once:
+            print(frame)
+            return 0
+        # clear + home, like top; fall back to plain prints when piped
+        if sys.stdout.isatty():
+            print("\x1b[2J\x1b[H", end="")
+        print(frame, flush=True)
+        frames += 1
+        if args.count and frames >= args.count:
+            return 0
+        try:
+            time.sleep(max(0.1, args.interval))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
