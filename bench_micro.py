@@ -474,6 +474,94 @@ def bench_telemetry() -> dict:
     }
 
 
+def bench_memory() -> dict:
+    """Memory-plane overhead (crypto/tpu/memory.py), asserted on
+    CPU-only CI with the real ed25519 verify cost dominating:
+
+    - the bench_telemetry workload (8 requests × 64 real ed25519 sigs
+      through BackendSpec("cpu")) is timed with a model-only
+      MemoryPlane installed as the process default (poll_ms=0, so the
+      scheduler's ride-along poll fires on EVERY dispatch — worst case)
+      and with no plane installed, best-of-3 per mode, interleaved;
+    - plane-on throughput must be within 1% of plane-off throughput —
+      the "hot path is a clock compare" contract, measured;
+    - the plane must actually have polled: its polls counter grew by at
+      least one per plane-on arm (the scheduler coalesces submissions,
+      so the dispatch count — not the request count — is the floor).
+
+    ``overhead_margin_pct`` is ``1.0 − overhead_pct`` so the harness's
+    ">0" invariant IS the <1% assertion.
+    """
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["CBFT_TPU_PROBE"] = "0"
+
+    from bench import _make_batch
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+    from cometbft_tpu.crypto.tpu import memory as memlib
+
+    n_reqs, per_req = 8, 64
+    pks, msgs, sigs = _make_batch(per_req)
+    items = [
+        (ed.PubKeyEd25519(pk), m, s) for pk, m, s in zip(pks, msgs, sigs)
+    ]
+    reqs = [list(items) for _ in range(n_reqs)]
+
+    def run_workload() -> float:
+        sched = VerifyScheduler(spec=BackendSpec("cpu"), flush_us=500)
+        sched.start()
+        try:
+            sched.submit(reqs[0], subsystem="bench").result(timeout=60)
+            t0 = time.perf_counter()
+            futs = [sched.submit(r, subsystem="bench") for r in reqs]
+            for f in futs:
+                ok, mask = f.result(timeout=60)
+                if not (ok and all(mask)):
+                    raise AssertionError("memory bench verdict wrong")
+            return time.perf_counter() - t0
+        finally:
+            sched.stop()
+
+    plane = memlib.MemoryPlane(poll_ms=0, stats=False)
+    off_s, on_s = [], []
+    prev = memlib.set_default_plane(None)
+    try:
+        for _ in range(3):  # interleave so drift hits both modes equally
+            memlib.set_default_plane(None)
+            off_s.append(run_workload())
+            memlib.set_default_plane(plane)
+            on_s.append(run_workload())
+    finally:
+        memlib.set_default_plane(prev)
+    base, planed = min(off_s), min(on_s)
+
+    polls = plane.metrics.polls.value()
+    if polls < 3:
+        raise AssertionError(
+            f"plane polled {polls} times, expected >= 3 "
+            "— the scheduler ride-along poll was not engaged"
+        )
+
+    overhead_pct = (planed - base) / base * 100.0
+    if overhead_pct >= 1.0:
+        raise AssertionError(
+            f"memory-plane overhead {overhead_pct:.2f}% >= 1% budget "
+            f"(off={base * 1e3:.1f}ms on={planed * 1e3:.1f}ms)"
+        )
+    total_sigs = n_reqs * per_req
+    return {
+        "baseline_ms": round(base * 1e3, 2),
+        "memplane_ms": round(planed * 1e3, 2),
+        "baseline_sigs_per_sec": round(total_sigs / base, 1),
+        "memplane_sigs_per_sec": round(total_sigs / planed, 1),
+        "overhead_margin_pct": round(1.0 - overhead_pct, 3),
+        "plane_polls": int(polls),
+    }
+
+
 def bench_coldboot() -> dict:
     """AOT warm-boot smoke (crypto/tpu/aot.py), asserted on CPU-only CI
     with the virtual device mesh and the smallest bucket only:
@@ -547,6 +635,7 @@ SECTIONS = {
     "ed25519": bench_ed25519,
     "validator_set": bench_validator_set,
     "light": bench_light,
+    "memory": bench_memory,
     "mempool": bench_mempool,
     "routing": bench_routing,
     "scheduler": bench_scheduler,

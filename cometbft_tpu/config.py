@@ -233,6 +233,21 @@ class InstrumentationConfig:
     # NODE_HOME/data (newest N; older dumps deleted at write time).
     # CBFT_TRACE_DUMP_KEEP env wins.
     trace_dump_keep: int = 20
+    # Memory-plane poll period (crypto/tpu/memory.py): device
+    # memory_stats() is read at most once per this many milliseconds,
+    # lazily from whichever dispatch touches the plane first — no
+    # background thread. CBFT_MEM_POLL_MS env wins.
+    mem_poll_ms: int = 500
+    # Incident profiler auto-capture threshold (libs/profiling.py): a
+    # bounded one-shot jax.profiler capture fires when the SLO
+    # error-budget burn rate crosses this value. 0 disables
+    # auto-capture (the /debug/profile endpoint still works).
+    # CBFT_PROFILE_ON_BURN env wins.
+    profile_on_burn: float = 0.0
+    # Profiler capture retention: profile_* capture dirs kept in
+    # NODE_HOME/data/profiles (newest N — captures are an order of
+    # magnitude bigger than trace dumps). CBFT_PROFILE_KEEP env wins.
+    profile_keep: int = 4
 
 
 @dataclass
@@ -409,6 +424,25 @@ class Config:
             raise ValueError(
                 "instrumentation.trace_dump_keep must be a positive "
                 f"integer, got {tdk!r}"
+            )
+        for knob in ("mem_poll_ms", "profile_keep"):
+            v = getattr(self.instrumentation, knob)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"instrumentation.{knob} must be a positive "
+                    f"integer, got {v!r}"
+                )
+        pb = self.instrumentation.profile_on_burn
+        if (
+            not isinstance(pb, (int, float))
+            or isinstance(pb, bool)
+            or float(pb) < 0.0
+        ):
+            # 0 is a valid value: auto-capture disabled. No upper
+            # bound — burn rate is an unbounded ratio.
+            raise ValueError(
+                "instrumentation.profile_on_burn must be a "
+                f"non-negative number, got {pb!r}"
             )
 
 

@@ -18,6 +18,13 @@ wedged tunnels, flapping runtimes, miscompiled kernels):
   RESOURCE_EXHAUSTED-shaped error (classified OOM by the supervisor's
   retry ladder, which halves the chunk cap instead of striking the
   breaker);
+* ``oom_above_lanes`` — allocator model for the OOM fault
+  (``CBFT_FAULT_OOM_ABOVE=<lanes>``): the injected OOM only fires while
+  the dispatch device's EFFECTIVE chunk cap (reactive shrinks + the
+  memory plane's pre-dispatch guard, topology.DeviceHandle.chunk_cap)
+  exceeds the threshold — a cap at or below it "fits in HBM" and the
+  dispatch runs clean. This is what lets the memory-guard rung prove a
+  proactive shrink PREVENTS the OOM instead of reacting to it;
 * ``transient_n``     — countdown: the next N dispatches raise an
   UNAVAILABLE-shaped error then the backend recovers (the flapping
   tunnel the transient-retry rung absorbs);
@@ -81,6 +88,7 @@ class FaultPlan:
         die_after: Optional[int] = None,
         jitter_ms: float = 0.0,
         oom_rate: float = 0.0,
+        oom_above_lanes: Optional[int] = None,
         transient_n: int = 0,
         seed: int = 0,
         device: Optional[int] = None,
@@ -92,6 +100,10 @@ class FaultPlan:
         self.die_after = die_after
         self.jitter_ms = jitter_ms
         self.oom_rate = oom_rate
+        # allocator model: an injected OOM fires only while the dispatch
+        # device's effective chunk cap exceeds this many lanes (None =
+        # every drawn OOM fires, the pre-guard behavior)
+        self.oom_above_lanes = oom_above_lanes
         # countdown: the next N dispatches fail transiently, then the
         # backend recovers on its own (re-armable mid-run by assignment)
         self.transient_n = transient_n
@@ -101,6 +113,10 @@ class FaultPlan:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self.dispatches = 0  # total dispatches seen (incl. faulted ones)
+        # RESOURCE_EXHAUSTED faults that actually FIRED (drawn OOMs
+        # suppressed by the oom_above_lanes allocator model don't count)
+        # — the memory-guard rung asserts this stays flat under guard
+        self.ooms_fired = 0
         # dispatches seen per fault-domain index (only for dispatches
         # carrying a device scope) — the multi-device rung reads this to
         # prove the survivors kept serving the device path
@@ -111,11 +127,13 @@ class FaultPlan:
         """Env-driven plan so the chaos soak (and a faulty node) can be
         configured without code: CBFT_FAULT_EXC_RATE, CBFT_FAULT_HANG_RATE,
         CBFT_FAULT_HANG_S, CBFT_FAULT_CORRUPT_RATE, CBFT_FAULT_DIE_AFTER,
-        CBFT_FAULT_JITTER_MS, CBFT_FAULT_OOM_RATE, CBFT_FAULT_TRANSIENT_N,
+        CBFT_FAULT_JITTER_MS, CBFT_FAULT_OOM_RATE, CBFT_FAULT_OOM_ABOVE
+        (allocator-model lane threshold), CBFT_FAULT_TRANSIENT_N,
         CBFT_FAULT_SEED, CBFT_FAULT_DEVICE (fault-domain scope)."""
         e = os.environ
         die = e.get("CBFT_FAULT_DIE_AFTER")
         dev = e.get("CBFT_FAULT_DEVICE")
+        above = e.get("CBFT_FAULT_OOM_ABOVE")
         return cls(
             exception_rate=float(e.get("CBFT_FAULT_EXC_RATE", "0")),
             hang_rate=float(e.get("CBFT_FAULT_HANG_RATE", "0")),
@@ -124,6 +142,7 @@ class FaultPlan:
             die_after=int(die) if die is not None else None,
             jitter_ms=float(e.get("CBFT_FAULT_JITTER_MS", "0")),
             oom_rate=float(e.get("CBFT_FAULT_OOM_RATE", "0")),
+            oom_above_lanes=int(above) if above is not None else None,
             transient_n=int(e.get("CBFT_FAULT_TRANSIENT_N", "0")),
             seed=int(e.get("CBFT_FAULT_SEED", "0")),
             device=int(dev) if dev is not None else None,
@@ -220,7 +239,19 @@ class FaultyBackend(BatchVerifier):
                 f"UNAVAILABLE: injected transient tunnel flap "
                 f"(dispatch #{no}, {n} items)"
             )
+        if oom and self._plan.oom_above_lanes is not None:
+            # allocator model: the OOM only fires while the device would
+            # dispatch WIDER than the threshold — a chunk cap already
+            # clamped (by the memory guard, or by earlier reactive
+            # shrinks) at or below it fits in HBM and runs clean
+            handle = dev
+            if handle is None:
+                handle = topology.default_topology().device(0)
+            if handle.chunk_cap(8192, 1) <= self._plan.oom_above_lanes:
+                oom = False
         if oom:
+            with self._plan._lock:
+                self._plan.ooms_fired += 1
             self._inner.verify()
             raise ResourceExhaustedFault(
                 f"RESOURCE_EXHAUSTED: injected HBM allocation failure "
@@ -796,4 +827,162 @@ def run_chaos_multidevice(
         f"(expected only {killed_label})"
     )
     assert all(s == HEALTHY for s in final_states.values()), final_states
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# memory-guard chaos: the proactive shrink must PREVENT the OOM
+# ---------------------------------------------------------------------------
+
+
+def run_chaos_memory_guard(
+    seed: int = 11,
+    inner: cryptobatch.Backend = "cpu",
+    lanes_threshold: int = 256,
+    rounds: int = 5,
+    logger=None,
+) -> dict:
+    """The proactive-vs-reactive proof for the memory plane's
+    pre-dispatch guard (crypto/tpu/memory.py refresh_guard).
+
+    An allocator-modeled OOM fault (``oom_rate=1.0`` gated by
+    ``oom_above_lanes``) fires whenever the device would dispatch wider
+    than ``lanes_threshold`` lanes. Two phases over the same fault:
+
+    * **reactive control** (no guard): every dispatch OOMs until the
+      supervisor's retry ladder has halved the chunk cap under the
+      threshold — each halving cost a real RESOURCE_EXHAUSTED
+      (``plan.ooms_fired`` > 0, supervisor ``chunk_shrinks`` > 0);
+    * **proactive guard**: a model-only MemoryPlane whose modeled HBM
+      limit only fits ``lanes_threshold`` lanes clamps the cap BEFORE
+      dispatch — the armed fault never fires (``ooms_fired`` flat,
+      ``chunk_shrinks`` flat, zero RESOURCE_EXHAUSTED reaches the
+      supervisor) and every verdict still matches the ground truth.
+
+    Deterministic (rate-1.0 fault, seeded keys); asserts the invariants
+    inline like the other rungs and returns a summary dict for
+    tools/chaos.py and the tier-1 test."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.supervisor import HEALTHY, BackendSupervisor
+    from cometbft_tpu.crypto.tpu import memory as memlib
+    from cometbft_tpu.crypto.tpu import mesh, topology
+
+    topo = topology.default_topology()
+    handle = topo.device(0)
+    handle.reset_chunk_shrink()
+    name = f"chaos-mem-{seed}"
+    plan = install(
+        name=name, inner=inner,
+        plan=FaultPlan(
+            seed=seed, oom_rate=1.0, oom_above_lanes=lanes_threshold
+        ),
+    )
+    sup = BackendSupervisor(
+        spec=BackendSpec(name),
+        dispatch_timeout_ms=2000,
+        breaker_threshold=3,
+        audit_pct=100,
+        audit_sync=True,
+        retry_ms=5,
+        chunk_recover_n=1000,  # no cap recovery mid-run: phases stay clean
+        logger=logger,
+        topology=topo,
+    )
+    m = sup.metrics
+    keys = [
+        ed.gen_priv_key_from_secret(b"chaos-mem-%d" % i) for i in range(8)
+    ]
+
+    def make_items(tag: bytes):
+        items, truth = [], []
+        for i in range(16):
+            k = keys[i % len(keys)]
+            msg = b"mem %s %d" % (tag, i)
+            items.append((k.pub_key(), msg, k.sign(msg)))
+            truth.append(True)
+        return items, truth
+
+    # a modeled HBM limit that fits lanes_threshold lanes but not twice
+    # that: free = limit × 0.9 lands just above the threshold bucket's
+    # projected footprint, so the guard halves exactly down to it
+    try:
+        depth = mesh.pipeline_depth()
+    except ValueError:
+        depth = 2
+    fit_bytes = int(memlib.SEED_BYTES_PER_LANE * lanes_threshold * depth)
+    model_limit = int(fit_bytes / 0.9) + 1
+
+    wrong = 0
+    prev_plane = None
+    plane_installed = False
+    try:
+        # phase A — reactive control: the OOM must actually COST
+        # dispatches before the cap shrinks under the threshold
+        items, truth = make_items(b"reactive")
+        if sup.verify_items(items, reason="mem-reactive") != truth:
+            wrong += 1
+        reactive_ooms = plan.ooms_fired
+        reactive_shrinks = m.chunk_shrinks.value()
+        reactive_levels = handle.chunk_shrink_levels()
+
+        # phase B — proactive guard: same armed fault, but the memory
+        # plane clamps the cap pre-dispatch so it can never fire
+        handle.reset_chunk_shrink()
+        plane = memlib.MemoryPlane(
+            topology=topo,
+            poll_ms=1,
+            headroom_fraction=0.9,
+            model_limit_bytes=model_limit,
+            stats=False,
+        )
+        prev_plane = memlib.set_default_plane(plane)
+        plane_installed = True
+        guard_cap = plane.refresh_guard(handle, 8192, 64)
+        ooms_before = plan.ooms_fired
+        shrinks_before = m.chunk_shrinks.value()
+        for r in range(rounds):
+            items, truth = make_items(b"guarded-%d" % r)
+            if sup.verify_items(items, reason="mem-guarded") != truth:
+                wrong += 1
+        guarded_ooms = plan.ooms_fired - ooms_before
+        guarded_shrinks = m.chunk_shrinks.value() - shrinks_before
+        guard_shrink_events = sum(
+            c.value() for c in plane.metrics.guard_shrinks._series()
+        )
+        state_final = sup.state()
+    finally:
+        sup.stop()
+        if plane_installed:
+            memlib.set_default_plane(prev_plane)
+        handle.reset_chunk_shrink()
+
+    summary = {
+        "lanes_threshold": lanes_threshold,
+        "model_limit_bytes": model_limit,
+        "wrong_verdicts": wrong,
+        "reactive_ooms": reactive_ooms,
+        "reactive_shrinks": reactive_shrinks,
+        "reactive_levels": reactive_levels,
+        "guard_cap": guard_cap,
+        "guarded_ooms": guarded_ooms,
+        "guarded_shrinks": guarded_shrinks,
+        "guard_shrink_events": guard_shrink_events,
+        "state_final": state_final,
+        "backend_dispatches": plan.dispatches,
+        "expected": {"guarded_ooms": 0, "state_final": HEALTHY},
+    }
+    assert wrong == 0, f"wrong verdicts released: {wrong}"
+    assert reactive_ooms > 0, "control phase never fired the OOM fault"
+    assert reactive_shrinks > 0, "control phase never shrank reactively"
+    assert guard_cap <= lanes_threshold, (
+        f"guard cap {guard_cap} above the allocator threshold "
+        f"{lanes_threshold}"
+    )
+    assert guarded_ooms == 0, (
+        f"{guarded_ooms} RESOURCE_EXHAUSTED reached the supervisor "
+        "despite the pre-dispatch guard"
+    )
+    assert guarded_shrinks == 0, "reactive rung engaged under guard"
+    assert guard_shrink_events > 0, "guard never recorded its shrink"
     return summary

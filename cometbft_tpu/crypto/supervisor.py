@@ -233,6 +233,24 @@ class LatencyModel:
             n, mean, dev = warm[key]
             return mean + 4.0 * dev
 
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        """Per-bucket EWMA state for the telemetry snapshot and
+        verify_top — the hedge decision inputs, inspectable from
+        outside. Keys are the bucket's max batch size (2^b − 1);
+        p99_ms is None while the bucket is cold."""
+        with self._mtx:
+            out: Dict[str, Dict[str, object]] = {}
+            for bucket, (n, mean, dev) in sorted(self._buckets.items()):
+                out[str((1 << bucket) - 1)] = {
+                    "n": int(n),
+                    "ewma_ms": round(mean * 1e3, 3),
+                    "p99_ms": (
+                        round((mean + 4.0 * dev) * 1e3, 3)
+                        if n >= self.MIN_SAMPLES else None
+                    ),
+                }
+            return out
+
 
 class _DeviceCall:
     """Handle for one in-flight watchdog-abandonable device dispatch:
@@ -503,6 +521,8 @@ class BackendSupervisor:
         tracer: Optional[tracelib.Tracer] = None,
         topology=None,
         telemetry=None,
+        memory_plane=None,
+        profiler=None,
     ):
         spec = unwrap_backend(spec)
         if not isinstance(spec, BackendSpec):
@@ -573,6 +593,15 @@ class BackendSupervisor:
             telemetry.register_source("supervisor", self.capacity_snapshot)
             telemetry.set_capacity_fraction(self.healthy_capacity_fraction)
 
+        # the device-memory plane (crypto/tpu/memory.py) is the
+        # PROACTIVE rung ahead of the reactive OOM shrink: the mesh
+        # chunk loop consults its pre-dispatch guard, and the
+        # capacity snapshot surfaces its per-device guard caps. The
+        # incident profiler (libs/profiling.py) fires a bounded
+        # one-shot capture when a breaker trips. Both optional.
+        self._memory_plane = memory_plane
+        self._profiler = profiler
+
     # -- knob introspection --------------------------------------------------
 
     @property
@@ -640,11 +669,11 @@ class BackendSupervisor:
         default = self.spec.max_chunk or 8192
         with self._lock:
             handles = [
-                (d.handle, d.state, d.consecutive_failures)
+                (d.handle, d.state, d.consecutive_failures, d.latency_model)
                 for d in self._domains
             ]
         domains = {}
-        for handle, state, failures in handles:
+        for handle, state, failures, lm in handles:
             try:
                 cap = handle.chunk_cap(default, 64)
             except ValueError:  # malformed CBFT_TPU_MAX_CHUNK
@@ -655,6 +684,10 @@ class BackendSupervisor:
                 "shrink_levels": handle.chunk_shrink_levels(),
                 "capacity_fraction": handle.capacity_fraction(),
                 "chunk_cap": cap,
+                "memory_guard_cap": handle.memory_guard_cap(),
+                # the hedge decision inputs (satellite of the memory
+                # plane PR): per-bucket EWMA/p99 predictions
+                "latency_model": lm.snapshot(),
             }
         return {
             "state": self.state(),
@@ -1096,6 +1129,7 @@ class BackendSupervisor:
                 if dom.state != BROKEN:
                     newly_opened = self._trip_locked(dom, "probe")
         if newly_opened:
+            self._capture_incident_profile("probe")
             self._dump_incident("probe")
         if readmitted:
             self.metrics.readmissions.with_labels(
@@ -1537,6 +1571,7 @@ class BackendSupervisor:
         with self._lock:
             newly_opened = self._trip_locked(dom, cause)
         if newly_opened:
+            self._capture_incident_profile(cause)
             self._dump_incident(cause)
 
     def _trip_locked(self, dom: _Domain, cause: str) -> bool:
@@ -1555,18 +1590,44 @@ class BackendSupervisor:
         dom.next_probe_at = time.monotonic() + dom.backoff_s
         return newly_opened
 
+    def _capture_incident_profile(self, cause: str) -> None:
+        """Fire the incident profiler's one-shot capture on a breaker
+        trip (bounded, cooldown-limited — see libs/profiling.py). The
+        capture path is tagged into the flight-recorder dump through
+        the profiler's last_capture record. Best-effort."""
+        if self._profiler is None:
+            return
+        try:
+            self._profiler.on_breaker_trip(cause)
+        except Exception:  # noqa: BLE001 - diagnostics only
+            pass
+
     def _dump_incident(self, cause: str) -> None:
         """Write the trace flight recorder to disk so the dispatches that
         led up to a watchdog trip / circuit-break are post-mortem
         debuggable. Best-effort: a dump failure must never take down the
         verify path. The per-device breaker states ride along so the
-        post-mortem shows WHICH fault domain was sick."""
+        post-mortem shows WHICH fault domain was sick, and — when the
+        memory plane / incident profiler are installed — a memory
+        snapshot and the latest profile capture ride along too, so an
+        OOM-adjacent incident carries bytes_in_use/peak next to the
+        breaker states."""
+        extra: Dict[str, object] = {
+            "device_breaker_states": self.device_states()
+        }
+        if self._memory_plane is not None:
+            try:
+                extra["memory"] = self._memory_plane.snapshot()
+            except Exception:  # noqa: BLE001 - diagnostics only
+                pass
+        if self._profiler is not None:
+            try:
+                extra["profile"] = self._profiler.last_capture()
+            except Exception:  # noqa: BLE001 - diagnostics only
+                pass
         try:
             try:
-                path = self._tracer.dump(
-                    cause,
-                    extra={"device_breaker_states": self.device_states()},
-                )
+                path = self._tracer.dump(cause, extra=extra)
             except TypeError:
                 # a custom tracer predating the extra= parameter
                 path = self._tracer.dump(cause)

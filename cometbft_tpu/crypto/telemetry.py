@@ -310,6 +310,7 @@ class TelemetryHub:
         self._subsystems: Dict[str, List[Any]] = {}
         self._sources: Dict[str, Callable[[], Any]] = {}
         self._capacity_fn: Optional[Callable[[], float]] = None
+        self._burn_watcher: Optional[Callable[[float], None]] = None
 
     # -- feeders (hot path) --------------------------------------------------
 
@@ -388,6 +389,13 @@ class TelemetryHub:
         """Install the healthy-capacity oracle (the supervisor's
         ``healthy_capacity_fraction``) the headroom estimator scales by."""
         self._capacity_fn = fn
+
+    def set_burn_watcher(self, fn: Optional[Callable[[float], None]]) -> None:
+        """Install a callable invoked with the SLO burn rate on every
+        ``snapshot()`` — the incident profiler's auto-capture trigger
+        (libs/profiling.py ``on_burn``). Best-effort: a raising watcher
+        never breaks the plane."""
+        self._burn_watcher = fn
 
     def utilization(self, now: Optional[float] = None) -> Dict[str, Any]:
         """Windowed per-device duty cycle + served signature counts."""
@@ -501,6 +509,12 @@ class TelemetryHub:
         util = self.utilization(now)
         fill = self.lane_fill(now)
         slo = self.slo.snapshot(now)
+        watcher = self._burn_watcher
+        if watcher is not None:
+            try:
+                watcher(float(slo.get("burn_rate") or 0.0))
+            except Exception:  # noqa: BLE001 - watcher is advisory
+                pass
         head = self.headroom(slo=slo, util=util, now=now)
         subs = self.subsystems(now)
         sources: Dict[str, Any] = {}

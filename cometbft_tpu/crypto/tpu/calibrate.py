@@ -271,6 +271,66 @@ def compile_seconds(topology_fp: Optional[str] = None) -> Dict[int, float]:
     return out
 
 
+
+# --- device-memory footprints (crypto/tpu/memory.py) -------------------------
+# The memory plane's per-(kernel, bucket) bytes/lane model starts from
+# the static Straus-table seed; observed allocation peaks correct it.
+# Persisting the corrected model here means a restarted node's
+# pre-dispatch guard plans with what earlier runs actually measured
+# instead of re-learning from the seed.
+
+
+def merge_memory_footprints(
+    footprints: Dict[str, Dict[int, float]], path: Optional[str] = None
+) -> Optional[dict]:
+    """Fold the memory plane's learned bytes/lane model
+    ({kernel: {bucket: bytes_per_lane}}) into the table under
+    ``table["memory"][kernel][bucket]``. Later merges overwrite — the
+    plane's EWMA already folds history. Creates a minimal table when
+    none exists yet; None when there is no path."""
+    path = path or table_path()
+    if not path or not footprints:
+        return None
+    table = load_table()
+    if table is None:
+        table = {"version": TABLE_VERSION, "measured_at": time.time()}
+    mem_tbl = table.setdefault("memory", {})
+    touched = False
+    for kernel, buckets in footprints.items():
+        per_kernel = mem_tbl.setdefault(str(kernel), {})
+        for bucket, bpl in buckets.items():
+            try:
+                per_kernel[str(int(bucket))] = round(float(bpl), 1)
+            except (TypeError, ValueError):
+                continue
+            touched = True
+    if touched:
+        save_table(table, path)
+    return table
+
+
+def load_memory_footprints() -> Dict[str, Dict[int, float]]:
+    """The persisted bytes/lane model ({kernel: {bucket: bytes/lane}});
+    {} when nothing was ever merged — the plane then runs from the
+    static seed."""
+    table = load_table()
+    if not table or not isinstance(table.get("memory"), dict):
+        return {}
+    out: Dict[str, Dict[int, float]] = {}
+    for kernel, buckets in table["memory"].items():
+        if not isinstance(buckets, dict):
+            continue
+        per_kernel: Dict[int, float] = {}
+        for bucket, bpl in buckets.items():
+            try:
+                per_kernel[int(bucket)] = float(bpl)
+            except (TypeError, ValueError):
+                continue
+        if per_kernel:
+            out[str(kernel)] = per_kernel
+    return out
+
+
 def persistent_cache_min_compile_secs(default: float = 5.0) -> float:
     """The jax_persistent_cache_min_compile_time_secs threshold this
     link has EARNED: strictly below the cheapest fresh compile ever

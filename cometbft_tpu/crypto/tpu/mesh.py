@@ -345,6 +345,24 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
         from cometbft_tpu.crypto.tpu import topology
 
         device = topology.current_device()
+    # pre-dispatch memory guard (crypto/tpu/memory.py): project this
+    # dispatch's footprint and clamp the chunk cap BEFORE the allocator
+    # can fail — the reactive OOM rung stays as the last resort. The
+    # guarded cap lands on the device handle, so the chunk_cap reads
+    # below already include it. Device-less dispatches guard (and cap)
+    # against the module shim's device 0, matching the telemetry shim.
+    from cometbft_tpu.crypto.tpu import memory as _memory
+
+    _plane = _memory.default_plane()
+    _guard_dev = device if device is not None else _shim_device()
+    _kernel_name = getattr(kernel, "__name__", "kernel")
+    if _plane is not None:
+        _plane.refresh_guard(
+            _guard_dev, max_chunk, min_pad, kernel=_kernel_name
+        )
+        _mem_baseline = _plane.device_view(_guard_dev).get("bytes_in_use")
+    else:
+        _mem_baseline = None
     if device is not None:
         max_chunk = device.chunk_cap(max_chunk, min_pad)
     else:
@@ -443,7 +461,26 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
             retire(inflight.popleft())
     while inflight:
         retire(inflight.popleft())
+    if _plane is not None and n > 0:
+        # post-dispatch model correction: the observed allocation peak
+        # over the pre-dispatch baseline calibrates the per-(kernel,
+        # bucket) footprint model. Best-effort — a stats failure must
+        # never fail a dispatch that already produced its mask.
+        try:
+            _plane.observe_dispatch(
+                _guard_dev, _kernel_name, min(max_chunk, _pow2(n, min_pad)),
+                baseline_in_use=_mem_baseline,
+            )
+        except Exception:  # noqa: BLE001 - observability only
+            pass
     return out
+
+
+def _pow2(n: int, floor: int) -> int:
+    size = max(1, int(floor))
+    while size < n:
+        size *= 2
+    return size
 
 
 def sharded_verify(kernel, args, donate_from: int = 0):

@@ -60,6 +60,7 @@ class DeviceHandle:
         self._mtx = threading.Lock()
         self._shrink_levels = 0
         self._clean_streak = 0
+        self._memory_guard_cap: Optional[int] = None
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"DeviceHandle({self.label}, kind={self.kind})"
@@ -101,19 +102,41 @@ class DeviceHandle:
     def reset_chunk_shrink(self) -> None:
         """Drop this device's shrink state (supervisor stop, topology
         change, tests) — a restarted supervisor must not inherit a
-        shrunken cap from a previous incident."""
+        shrunken cap from a previous incident. The memory-guard cap is
+        dropped too: it is recomputed from live stats on the next
+        guarded dispatch."""
         with self._mtx:
             self._shrink_levels = 0
             self._clean_streak = 0
+            self._memory_guard_cap = None
+
+    # -- pre-dispatch memory-guard cap (crypto/tpu/memory.py) ----------------
+
+    def memory_guard_cap(self) -> Optional[int]:
+        """The chunk cap the memory plane's pre-dispatch guard imposes
+        on this device right now, or None when unconstrained."""
+        with self._mtx:
+            return self._memory_guard_cap
+
+    def set_memory_guard_cap(self, cap: Optional[int]) -> None:
+        """Install (or clear, with None) the memory-guard chunk cap.
+        Written only by MemoryPlane.refresh_guard."""
+        with self._mtx:
+            self._memory_guard_cap = None if cap is None else int(cap)
 
     def chunk_cap(self, default: int, min_pad: int) -> int:
         """The dispatch chunk cap THIS device serves right now: the
         node-wide resolved cap (env > config > per-curve default, pow2)
-        halved once per active shrink level, floored at min_pad."""
+        halved once per active shrink level, clamped by the memory
+        plane's pre-dispatch guard, floored at min_pad."""
         from cometbft_tpu.crypto.tpu import mesh
 
         size = mesh.resolve_chunk_cap(default, min_pad)
-        return max(min_pad, size >> self.chunk_shrink_levels())
+        size = max(min_pad, size >> self.chunk_shrink_levels())
+        guard = self.memory_guard_cap()
+        if guard is not None:
+            size = max(min_pad, min(size, guard))
+        return size
 
     def capacity_fraction(self) -> float:
         """This device's share of its own nominal lane capacity
@@ -198,6 +221,7 @@ class DeviceTopology:
                     "kind": d.kind,
                     "shrink_levels": d.chunk_shrink_levels(),
                     "capacity_fraction": d.capacity_fraction(),
+                    "memory_guard_cap": d.memory_guard_cap(),
                 }
                 for d in self.devices
             ],

@@ -311,9 +311,18 @@ class MetricsServer(RouteServer):
       per-device utilization, lane-fill efficiency, per-subsystem RED
       metering, SLO burn rate, headroom, and every registered source
       (supervisor breaker states, scheduler queue, topology).
+
+    When handed a ``libs.profiling.ProfilerCapture`` it serves on-demand
+    device profiling:
+
+    * ``/debug/profile`` — runs ONE bounded jax.profiler capture
+      (``?ms=`` overrides the duration) and returns its path as JSON;
+      503 when the profiler is unavailable (no jax, no profile dir, or
+      a capture already in flight).
     """
 
-    def __init__(self, registry: Registry, tracer=None, telemetry=None):
+    def __init__(self, registry: Registry, tracer=None, telemetry=None,
+                 profiler=None):
         import json
 
         routes = {
@@ -329,6 +338,42 @@ class MetricsServer(RouteServer):
                 "application/json",
                 json.dumps(telemetry.snapshot(), indent=1).encode(),
             )
+        if profiler is not None:
+
+            def _profile(q):
+                vals = q.get("ms") or []
+                try:
+                    ms = int(vals[0]) if vals else None
+                except (TypeError, ValueError):
+                    ms = None
+                if not profiler.available():
+                    return (
+                        503,
+                        "application/json",
+                        json.dumps({
+                            "error": "profiler unavailable "
+                                     "(no jax / no profile dir)",
+                        }).encode(),
+                    )
+                path = profiler.capture(duration_ms=ms, reason="debug")
+                if path is None:
+                    return (
+                        503,
+                        "application/json",
+                        json.dumps({
+                            "error": "capture failed or already in flight",
+                        }).encode(),
+                    )
+                return (
+                    200,
+                    "application/json",
+                    json.dumps({
+                        "path": path,
+                        "duration_ms": ms or profiler.duration_ms,
+                    }, indent=1).encode(),
+                )
+
+            routes["/debug/profile"] = _profile
         if tracer is not None:
             from cometbft_tpu.libs import trace as _trace
 

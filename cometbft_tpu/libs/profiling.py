@@ -1,0 +1,249 @@
+"""Incident profiler capture — bounded ``jax.profiler.trace`` snapshots
+of the device plane, on demand and on incident.
+
+The flight recorder (libs/trace.py) answers "which dispatch was slow";
+a JAX profiler capture answers "what was the device DOING" — XLA op
+timelines, HBM allocations, host/device overlap. But profiling is far
+too heavy to run always-on, and by the time an operator attaches one
+the incident is over. This module makes capture a bounded one-shot:
+
+* ``ProfilerCapture.capture(duration_ms, reason)`` runs
+  ``jax.profiler.start_trace``/``stop_trace`` around a sleep, writing a
+  TensorBoard-loadable capture directory under the profile dir
+  (env ``CBFT_PROFILE_DIR`` > configured). Retention is keep-N
+  (``[instrumentation] profile_keep`` / ``CBFT_PROFILE_KEEP``,
+  default 4) — the same policy as PR 8's trace dumps, because profile
+  captures are an order of magnitude bigger.
+
+* **Automatic one-shot triggers**: ``on_burn(rate)`` (wired to the
+  TelemetryHub's burn watcher) fires a background capture when the SLO
+  error-budget burn rate crosses ``[instrumentation] profile_on_burn``
+  (``CBFT_PROFILE_ON_BURN``; 0 = disabled, the default), and
+  ``on_breaker_trip(cause)`` fires when the supervisor opens a breaker.
+  Both are cooldown-limited and single-flight: an incident storm
+  produces ONE capture per cooldown window, not a disk-filling spray.
+
+* ``last_capture()`` is tagged into the flight-recorder incident dump,
+  so the post-mortem links the trace evidence to the profile evidence.
+
+Failure posture: no jax, no profiler support, no profile dir — every
+entry degrades to a silent None. A profiler problem must never touch
+the verify path.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import time
+from typing import Dict, Optional
+
+DEFAULT_PROFILE_KEEP = 4
+DEFAULT_DURATION_MS = 1500
+DEFAULT_COOLDOWN_S = 120.0
+
+
+def profile_on_burn_default(config_value: Optional[float] = None) -> float:
+    """[instrumentation] profile_on_burn resolution: CBFT_PROFILE_ON_BURN
+    env > config > 0.0 (auto-capture disabled)."""
+    raw = os.environ.get("CBFT_PROFILE_ON_BURN")
+    if raw is not None:
+        try:
+            return max(0.0, float(raw))
+        except ValueError:
+            pass
+    if config_value is not None:
+        return max(0.0, float(config_value))
+    return 0.0
+
+
+def profile_keep_default(config_value: Optional[int] = None) -> int:
+    """[instrumentation] profile_keep resolution: CBFT_PROFILE_KEEP env
+    > config > 4 (newest N capture dirs kept)."""
+    raw = os.environ.get("CBFT_PROFILE_KEEP")
+    if raw is not None:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    if config_value is not None:
+        return max(1, int(config_value))
+    return DEFAULT_PROFILE_KEEP
+
+
+class ProfilerCapture:
+    """Bounded one-shot JAX profiler captures with keep-N retention and
+    cooldown-limited automatic incident triggers."""
+
+    def __init__(
+        self,
+        profile_dir: Optional[str] = None,
+        keep: Optional[int] = None,
+        on_burn_threshold: Optional[float] = None,
+        duration_ms: int = DEFAULT_DURATION_MS,
+        cooldown_s: float = DEFAULT_COOLDOWN_S,
+        logger=None,
+    ):
+        self._configured_dir = profile_dir
+        self.keep = profile_keep_default(keep)
+        self.on_burn_threshold = profile_on_burn_default(on_burn_threshold)
+        self.duration_ms = max(1, int(duration_ms))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self._logger = logger
+        self._lock = threading.Lock()
+        self._inflight = False
+        self._last_auto_at = 0.0
+        self._last: Optional[Dict[str, object]] = None
+
+    # -- resolution ----------------------------------------------------------
+
+    def profile_dir(self) -> Optional[str]:
+        """Capture destination: CBFT_PROFILE_DIR env > configured dir >
+        None (captures disabled)."""
+        return os.environ.get("CBFT_PROFILE_DIR") or self._configured_dir
+
+    def available(self) -> bool:
+        """True when a capture could run: a destination is configured
+        and jax's profiler imports. Never initializes a backend."""
+        if not self.profile_dir():
+            return False
+        try:
+            import jax.profiler  # noqa: F401
+        except Exception:  # noqa: BLE001 - no jax in this environment
+            return False
+        return True
+
+    def last_capture(self) -> Optional[Dict[str, object]]:
+        """The most recent capture record ({path, reason, duration_ms,
+        wall_time}) or None — tagged into flight-recorder dumps."""
+        with self._lock:
+            return dict(self._last) if self._last else None
+
+    # -- capture -------------------------------------------------------------
+
+    def capture(
+        self, duration_ms: Optional[int] = None, reason: str = "manual"
+    ) -> Optional[str]:
+        """Run ONE bounded profiler capture; returns the capture dir or
+        None (unavailable, already in flight, or the profiler failed).
+        The capture traces whatever the process does for the duration —
+        for an incident that means the live verify traffic."""
+        base = self.profile_dir()
+        if not base:
+            return None
+        with self._lock:
+            if self._inflight:
+                return None
+            self._inflight = True
+        try:
+            return self._capture_locked_out(base, duration_ms, reason)
+        finally:
+            with self._lock:
+                self._inflight = False
+
+    def _capture_locked_out(
+        self, base: str, duration_ms: Optional[int], reason: str
+    ) -> Optional[str]:
+        try:
+            import jax
+        except Exception:  # noqa: BLE001 - no jax in this environment
+            return None
+        dur_s = max(1, int(duration_ms or self.duration_ms)) / 1e3
+        safe = "".join(
+            c if c.isalnum() or c in "-_" else "_" for c in reason
+        )
+        path = os.path.join(
+            base, f"profile_{safe or 'capture'}_{time.time_ns()}"
+        )
+        try:
+            os.makedirs(path, exist_ok=True)
+            jax.profiler.start_trace(path)
+            try:
+                time.sleep(dur_s)
+            finally:
+                jax.profiler.stop_trace()
+        except Exception as exc:  # noqa: BLE001 - profiler must not kill us
+            if self._logger is not None:
+                try:
+                    self._logger.error(
+                        "profiler capture failed", err=repr(exc),
+                        reason=reason,
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+            shutil.rmtree(path, ignore_errors=True)
+            return None
+        record = {
+            "path": path,
+            "reason": reason,
+            "duration_ms": int(dur_s * 1e3),
+            "wall_time": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        }
+        with self._lock:
+            self._last = record
+        self._prune(base)
+        if self._logger is not None:
+            try:
+                self._logger.info(
+                    "profiler capture written", path=path, reason=reason
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        return path
+
+    def _prune(self, base: str) -> None:
+        """Keep the newest ``keep`` profile_* capture dirs (by mtime).
+        Best-effort, mirroring the trace-dump retention policy."""
+        try:
+            entries = []
+            for name in os.listdir(base):
+                if not name.startswith("profile_"):
+                    continue
+                p = os.path.join(base, name)
+                if not os.path.isdir(p):
+                    continue
+                try:
+                    entries.append((os.path.getmtime(p), p))
+                except OSError:
+                    continue
+            entries.sort(reverse=True)  # newest first
+            for _, p in entries[self.keep:]:
+                shutil.rmtree(p, ignore_errors=True)
+        except OSError:
+            pass
+
+    # -- automatic incident triggers -----------------------------------------
+
+    def _auto_capture(self, reason: str) -> bool:
+        """Cooldown-gated background capture; True if one was started."""
+        if not self.profile_dir():
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if self._inflight:
+                return False
+            if now - self._last_auto_at < self.cooldown_s:
+                return False
+            self._last_auto_at = now
+        threading.Thread(
+            target=self.capture, kwargs={"reason": reason},
+            daemon=True, name="profiler-capture",
+        ).start()
+        return True
+
+    def on_burn(self, burn_rate: float) -> bool:
+        """TelemetryHub burn-watcher hook: one-shot capture when the SLO
+        error-budget burn crosses the configured threshold."""
+        if self.on_burn_threshold <= 0.0:
+            return False
+        if burn_rate < self.on_burn_threshold:
+            return False
+        return self._auto_capture(f"burn_{burn_rate:.2f}")
+
+    def on_breaker_trip(self, cause: str) -> bool:
+        """Supervisor breaker hook: one-shot capture on a newly-opened
+        circuit, tagged with the trip cause."""
+        return self._auto_capture(f"trip_{cause}")

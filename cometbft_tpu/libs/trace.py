@@ -296,6 +296,7 @@ class Tracer:
         self._open: Dict[int, List[Span]] = {}
         self._buffer: deque = deque(maxlen=self.buffer_size)
         self._dump_dir = dump_dir
+        self._dump_context: Optional[Callable[[], dict]] = None
         self.n_started = 0  # sampled root spans created (test/debug stat)
         self.n_completed = 0  # traces that reached the flight recorder
 
@@ -325,6 +326,16 @@ class Tracer:
 
     def set_dump_dir(self, path: Optional[str]) -> None:
         self._dump_dir = path
+
+    def set_dump_context(self, fn: Optional[Callable[[], dict]]) -> None:
+        """Install a callable whose dict result is merged into EVERY
+        incident dump document (under explicit ``extra`` keys' losing
+        side — a caller's extra wins on collision). The node wires the
+        memory plane's snapshot here so any dump, whoever initiates it,
+        carries bytes_in_use/peak alongside the breaker states.
+        Best-effort: a context failure is recorded in the dump rather
+        than failing it."""
+        self._dump_context = fn
 
     def start_span(self, name: str, parent: Optional[Span] = None, **tags: Any) -> Span:
         """Open a span.  With no parent this is a trace root and the
@@ -407,6 +418,14 @@ class Tracer:
             "sample": self.sample,
             "traces": self.recent(),
         }
+        ctx = self._dump_context
+        if ctx is not None:
+            try:
+                ctx_doc = ctx()
+                if isinstance(ctx_doc, dict):
+                    doc.update(ctx_doc)
+            except Exception as exc:  # noqa: BLE001 - diagnostics only
+                doc["dump_context_error"] = repr(exc)
         if extra:
             doc.update(extra)
         try:

@@ -179,6 +179,7 @@ class Node(BaseService):
         from cometbft_tpu.state.metrics import Metrics as SMMetrics
 
         from cometbft_tpu.crypto.tpu.aot import Metrics as AotMetrics
+        from cometbft_tpu.crypto.tpu.memory import Metrics as MemPlaneMetrics
 
         if config.instrumentation.prometheus:
             self.metrics_registry = Registry(
@@ -192,6 +193,7 @@ class Node(BaseService):
             sup_metrics = SupMetrics(self.metrics_registry)
             aot_metrics = AotMetrics(self.metrics_registry)
             tel_metrics = TelMetrics(self.metrics_registry)
+            memplane_metrics = MemPlaneMetrics(self.metrics_registry)
         else:
             self.metrics_registry = None
             cons_metrics = ConsMetrics.nop()
@@ -202,6 +204,7 @@ class Node(BaseService):
             sup_metrics = SupMetrics.nop()
             aot_metrics = AotMetrics.nop()
             tel_metrics = TelMetrics.nop()
+            memplane_metrics = MemPlaneMetrics.nop()
         # the AOT executable registry is process-global (it backs the
         # mesh dispatch layer, which predates any Node); the node only
         # lends it an exporter, exactly like the topology default above
@@ -281,6 +284,58 @@ class Node(BaseService):
         topolib.set_default_topology(verify_topology)
         self.verify_topology = verify_topology
 
+        # 0e. the device-memory plane (crypto/tpu/memory.py): per-device
+        # HBM occupancy polled lazily from device.memory_stats() plus a
+        # calibrated per-(kernel, bucket) footprint model. Installed as
+        # the process default so the mesh dispatch layer consults the
+        # pre-dispatch guard — projected footprint vs free headroom
+        # shrinks the chunk cap BEFORE an allocation can fail, demoting
+        # the reactive RESOURCE_EXHAUSTED shrink rung to a last resort.
+        from cometbft_tpu.crypto.tpu import memory as memlib
+
+        self.memory_plane = memlib.MemoryPlane(
+            topology=verify_topology,
+            poll_ms=memlib.mem_poll_ms_default(
+                config.instrumentation.mem_poll_ms
+            ),
+            metrics=memplane_metrics,
+        )
+        memlib.set_default_plane(self.memory_plane)
+        self.telemetry_hub.register_source(
+            "memory", self.memory_plane.snapshot
+        )
+
+        # 0f. the incident profiler (libs/profiling.py): bounded one-shot
+        # jax.profiler captures into NODE_HOME/data/profiles — on demand
+        # (/debug/profile), on SLO burn ([instrumentation]
+        # profile_on_burn via the hub's burn watcher), and on breaker
+        # trip (the supervisor is handed it below). The flight recorder
+        # tags the newest capture into its incident dumps.
+        from cometbft_tpu.libs import profiling as proflib
+
+        self.profiler = proflib.ProfilerCapture(
+            profile_dir=(
+                os.path.join(config.root_dir, "data", "profiles")
+                if config.root_dir
+                else None
+            ),
+            keep=proflib.profile_keep_default(
+                config.instrumentation.profile_keep
+            ),
+            on_burn_threshold=proflib.profile_on_burn_default(
+                config.instrumentation.profile_on_burn
+            ),
+            logger=self.logger,
+        )
+        self.telemetry_hub.set_burn_watcher(self.profiler.on_burn)
+        # every incident dump — whoever triggers it — carries the memory
+        # plane's view of the device; the post-mortem reads HBM pressure
+        # next to the breaker states instead of guessing
+        _mem_plane = self.memory_plane
+        self.tracer.set_dump_context(
+            lambda: {"memory": _mem_plane.snapshot()}
+        )
+
         # 0a. the backend supervisor: every coalesced dispatch runs
         # under its watchdog / circuit breaker / corruption audit, so a
         # wedged, dying, or silently-wrong device plane degrades to the
@@ -299,6 +354,8 @@ class Node(BaseService):
             tracer=self.tracer,
             topology=verify_topology,
             telemetry=self.telemetry_hub,
+            memory_plane=self.memory_plane,
+            profiler=self.profiler,
         )
         self.verify_scheduler = VerifyScheduler(
             spec=self.crypto_spec,
@@ -760,6 +817,7 @@ class Node(BaseService):
                 self.metrics_registry,
                 tracer=self.tracer,
                 telemetry=self.telemetry_hub,
+                profiler=self.profiler,
             )
             self.metrics_server.serve(host, port)
         if self.state_sync_enabled:
@@ -914,6 +972,23 @@ class Node(BaseService):
 
             if telemetrylib.default_hub() is self.telemetry_hub:
                 telemetrylib.set_default_hub(None)
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            pass
+        # same for the memory plane — and fold what it LEARNED (observed
+        # per-bucket footprints) into the calibration table first, so
+        # the next boot's pre-dispatch guard starts from measured peaks
+        # instead of the static Straus estimate
+        try:
+            from cometbft_tpu.crypto.tpu import calibrate as caliblib
+            from cometbft_tpu.crypto.tpu import memory as memlib
+
+            plane = getattr(self, "memory_plane", None)
+            if plane is not None:
+                footprints = plane.export_footprints()
+                if footprints:
+                    caliblib.merge_memory_footprints(footprints)
+                if memlib.default_plane() is plane:
+                    memlib.set_default_plane(None)
         except Exception:  # noqa: BLE001 - teardown is best-effort
             pass
         # the AOT warm boot checks its stop event between compiles, so

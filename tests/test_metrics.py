@@ -291,6 +291,45 @@ class TestExpositionConformance:
         ]
         assert len(type_lines) == len(set(type_lines))
 
+    def test_verify_memory_family_conformance(self):
+        """The memory plane's verify_memory_* families, driven by a
+        real model-only MemoryPlane (poll + guard shrink + model
+        update), must survive the strict v0.0.4 parse with the device
+        label intact."""
+        from cometbft_tpu.crypto.tpu import memory as memlib
+        from cometbft_tpu.crypto.tpu import topology as topolib
+
+        r = Registry("cometbft")
+        plane = memlib.MemoryPlane(
+            metrics=memlib.Metrics(r), stats=False, poll_ms=0,
+            model_limit_bytes=1 << 20,  # tiny: forces a guard shrink
+        )
+        handle = topolib.default_topology().device(0)
+        handle.reset_chunk_shrink()
+        try:
+            plane.poll(force=True)
+            plane.refresh_guard(handle, 8192, 64)
+            plane.observe_footprint("ed25519", 1024, 1024 * 5000)
+            types, samples = _parse_exposition(r.expose())
+            for gauge in (
+                "bytes_in_use", "bytes_peak", "bytes_limit",
+                "headroom_bytes", "guard_cap",
+            ):
+                assert types[f"cometbft_verify_memory_{gauge}"] == "gauge"
+            for counter in ("guard_shrinks", "polls", "model_updates"):
+                assert (
+                    types[f"cometbft_verify_memory_{counter}"] == "counter"
+                )
+            shrink_series = [
+                (l, v) for n, l, v in samples
+                if n == "cometbft_verify_memory_guard_shrinks"
+            ]
+            assert any(
+                "device" in l and v > 0 for l, v in shrink_series
+            ), "guard shrink must surface as a device-labeled series"
+        finally:
+            handle.reset_chunk_shrink()
+
 
 class TestConcurrencyHammer:
     def test_with_labels_races_expose(self):

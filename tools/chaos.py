@@ -1,6 +1,6 @@
 """Standalone chaos harness against the supervised verify plane.
 
-Three modes:
+Four modes:
 
 * default (smoke) — crypto/faults.py run_chaos_smoke: a fast,
   deterministic walk of every degradation-ladder rung (transient retry,
@@ -17,6 +17,15 @@ Three modes:
   canary. Deterministic under --seed. Runs on the virtual CPU mesh, so
   it needs no hardware (tier-1 CI runs it via
   XLA_FLAGS=--xla_force_host_platform_device_count).
+
+* --memory-guard — crypto/faults.py run_chaos_memory_guard: the
+  proactive-vs-reactive OOM proof. An allocator-modeled OOM fault
+  (CBFT_FAULT_OOM_ABOVE semantics) first runs WITHOUT the memory
+  plane's pre-dispatch guard — every cap halving costs a real
+  RESOURCE_EXHAUSTED — then WITH it: the guard clamps the chunk cap
+  from the modeled HBM headroom before dispatch, so zero
+  RESOURCE_EXHAUSTED ever reaches the supervisor while verdicts stay
+  ground-truth-exact.
 
 * --soak — crypto/faults.py run_chaos_soak: a randomized fault schedule
   (exceptions, hangs, silent verdict corruption, sudden death, jitter,
@@ -78,6 +87,13 @@ def main() -> int:
     ap.add_argument("--kill", type=int, default=2,
                     help="[multi-device] fault-domain index to inject "
                          "(default 2)")
+    ap.add_argument("--memory-guard", action="store_true",
+                    help="run the proactive-vs-reactive OOM rung "
+                         "(memory plane pre-dispatch guard)")
+    ap.add_argument("--lanes-threshold", type=int, default=256,
+                    help="[memory-guard] allocator-model lane threshold "
+                         "above which the injected OOM fires "
+                         "(default 256)")
     args = ap.parse_args()
 
     if args.inner == "cpu":
@@ -111,6 +127,27 @@ def main() -> int:
             and summary["device_resumed_after_recovery"]
         )
         print("CHAOS SOAK", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+
+    if args.memory_guard:
+        from cometbft_tpu.crypto.faults import run_chaos_memory_guard
+
+        summary = run_chaos_memory_guard(
+            seed=args.seed, inner=args.inner,
+            lanes_threshold=args.lanes_threshold,
+        )
+        print(json.dumps(summary, indent=2))
+        # run_chaos_memory_guard asserts the invariants inline; re-check
+        # the headline ones here so --memory-guard reads like the others
+        ok = (
+            summary["wrong_verdicts"] == 0
+            and summary["reactive_ooms"] > 0
+            and summary["guarded_ooms"] == 0
+            and summary["guarded_shrinks"] == 0
+            and summary["guard_cap"] <= args.lanes_threshold
+            and summary["state_final"] == summary["expected"]["state_final"]
+        )
+        print("CHAOS MEMORY-GUARD", "PASS" if ok else "FAIL")
         return 0 if ok else 1
 
     if args.devices > 1:

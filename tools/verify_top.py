@@ -4,7 +4,9 @@ Polls a node's /debug/verify endpoint (crypto/telemetry.py's
 health/capacity plane, served by MetricsServer) or reads a snapshot
 JSON file, and renders the capacity picture an operator actually asks
 for: per-device utilization, lane-fill efficiency, per-subsystem RED
-metering, SLO attainment/burn, and remaining headroom.
+metering, SLO attainment/burn, remaining headroom, the memory plane's
+per-device HBM picture (in-use/free/guard cap, device vs model mode),
+and the supervisor's per-bucket dispatch latency model (EWMA / p99).
 
 Usage:
     python tools/verify_top.py http://127.0.0.1:26660/debug/verify
@@ -69,6 +71,17 @@ def _pct(v: Optional[float]) -> str:
     return "-" if v is None else f"{v * 100:.1f}%"
 
 
+def _human_bytes(v: Any) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    v = float(v)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(v) < 1024.0 or unit == "GiB":
+            return f"{v:.0f}{unit}" if unit == "B" else f"{v:.1f}{unit}"
+        v /= 1024.0
+    return "-"
+
+
 def render(snap: Dict[str, Any]) -> str:
     """One frame of the capacity picture, plain text."""
     out: List[str] = []
@@ -121,9 +134,13 @@ def render(snap: Dict[str, Any]) -> str:
     dev_rows = []
     domains = sup.get("domains", {}) if isinstance(sup, dict) else {}
     devices = snap.get("devices", {})
-    for label in sorted(set(devices) | set(domains)):
+    mem = sources.get("memory", {}) if isinstance(sources, dict) else {}
+    mem_devs = mem.get("devices", {}) if isinstance(mem, dict) else {}
+    for label in sorted(set(devices) | set(domains) | set(mem_devs)):
         d = devices.get(label, {})
         dom = domains.get(label, {})
+        md = mem_devs.get(label, {})
+        guard = md.get("guard_cap") or dom.get("memory_guard_cap")
         dev_rows.append({
             "device": label,
             "util": _pct(d.get("utilization")),
@@ -132,12 +149,39 @@ def render(snap: Dict[str, Any]) -> str:
             "state": dom.get("state", "-"),
             "chunk_cap": dom.get("chunk_cap", "-"),
             "capacity": _pct(dom.get("capacity_fraction")),
+            "hbm_used": _human_bytes(md.get("bytes_in_use"))
+            if md else "-",
+            "hbm_free": _human_bytes(md.get("headroom_bytes"))
+            if md else "-",
+            "guard": guard if guard else "-",
+            "mem": md.get("mode", "-"),
         })
     out.append(_fmt_table(
         dev_rows,
         ["device", "util", "busy_s", "sigs", "state", "chunk_cap",
-         "capacity"],
+         "capacity", "hbm_used", "hbm_free", "guard", "mem"],
     ))
+
+    lat_rows = []
+    for label in sorted(domains):
+        model = domains[label].get("latency_model")
+        if not isinstance(model, dict):
+            continue
+        for bucket in sorted(model, key=lambda b: int(b)):
+            ent = model[bucket]
+            lat_rows.append({
+                "device": label,
+                "bucket": bucket,
+                "n": ent.get("n", "-"),
+                "ewma_ms": ent.get("ewma_ms", "-"),
+                "p99_ms": ent.get("p99_ms") or "-",
+            })
+    if lat_rows:
+        out.append("")
+        out.append("dispatch latency model (per bucket):")
+        out.append(_fmt_table(
+            lat_rows, ["device", "bucket", "n", "ewma_ms", "p99_ms"],
+        ))
 
     out.append("")
     out.append("subsystems (RED):")
