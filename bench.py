@@ -45,6 +45,13 @@ _STAGE_ENV_CPU = {
     "JAX_PLATFORMS": "cpu",
     "BENCH_FORCE_CPU": "1",
 }
+# the sharded stage needs a multi-device plane; the virtual CPU mesh is
+# how it runs hardware-free (same flag tier-1 CI uses)
+_STAGE_ENV_SHARDED = {
+    **_STAGE_ENV_CPU,
+    "CBFT_TPU_PROBE": "0",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
 
 
 def _make_batch(n: int, msg_len: int = 120):
@@ -631,6 +638,55 @@ def _sharded_mega_commit():
     }
 
 
+def _stage_sharded():
+    """Sharded-megabatch routing stage: a 10k-commit megabatch through
+    the PRODUCTION dispatch path — shard plan over the topology, AOT
+    registry, per-device chunk caps, NamedSharding on the batch axis —
+    once pinned single-chip and once sharded over the full mesh (the
+    two routes the scheduler picks between at the learned crossover).
+    Unlike _sharded_mega_commit (a hand-jitted program), this measures
+    what a routed flush actually runs. Emits incrementally so a timeout
+    keeps the single-chip number."""
+    _maybe_force_cpu()
+    _set_cache()
+    from cometbft_tpu.crypto.tpu import ed25519_batch, mesh, topology
+
+    topo = topology.DeviceTopology.detect()
+    topology.set_default_topology(topo)
+    plan = mesh.shard_plan(topo)
+    n = int(os.environ.get("BENCH_SHARDED_N", "10000"))
+    pks, msgs, sigs = _make_batch(n)
+    out = {
+        "n": n,
+        "n_devices": len(topo),
+        "shards": plan.n_shards if plan is not None else 1,
+    }
+    # meta first: a timeout mid-compile still leaves a parseable record
+    print(json.dumps(out), flush=True)
+
+    def best_rate(route, reps=3):
+        with mesh.route_scope(route):
+            mask = ed25519_batch.verify_batch(pks, msgs, sigs)  # warm
+            assert all(mask), "mega-commit must verify"
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                ed25519_batch.verify_batch(pks, msgs, sigs)
+                best = min(best, time.perf_counter() - t0)
+        return n / best
+
+    out["single_chip_sigs_per_sec"] = round(best_rate(mesh.ROUTE_SINGLE), 1)
+    print(json.dumps(out), flush=True)
+    if plan is not None:
+        out["sharded_sigs_per_sec"] = round(best_rate(mesh.ROUTE_SHARDED), 1)
+        out["sharded_vs_single"] = round(
+            out["sharded_sigs_per_sec"] / out["single_chip_sigs_per_sec"], 3
+        ) if out["single_chip_sigs_per_sec"] else 0.0
+    else:
+        out["sharded_unavailable"] = "fewer than 2 healthy devices"
+    print(json.dumps(out), flush=True)
+
+
 def _stage_supervisor():
     """Degraded-mode throughput + breaker recovery latency. A supervised
     FaultyBackend is driven healthy → broken (injected dispatch
@@ -1174,6 +1230,15 @@ def main():
     if parsed is not None:
         _append_history(parsed, stage="coldboot")
 
+    # sharded-megabatch routing: the 10k-commit megabatch on the 8-way
+    # virtual mesh vs the same kernel single-chip — the two device-side
+    # routes the scheduler crossover picks between (platform-neutral);
+    # the appended record puts sharded throughput under the sentinel
+    parsed, diag = _run_stage("sharded", _STAGE_ENV_SHARDED, 900)
+    stages["sharded"] = parsed if parsed is not None else diag
+    if parsed is not None:
+        _append_history(parsed, stage="sharded")
+
     last_onchip = None
     if result is None:
         # TPU unavailable — same kernel on the host CPU platform so the
@@ -1241,6 +1306,7 @@ if __name__ == "__main__":
             "scheduler": _stage_scheduler,
             "supervisor": _stage_supervisor,
             "degraded": _stage_degraded,
+            "sharded": _stage_sharded,
             "trace": _stage_trace,
             "coldboot": _stage_coldboot,
         }[sys.argv[2]]()

@@ -320,6 +320,13 @@ class CryptoConfig:
     # 0 = auto-detect from the visible device plane at startup.
     # CBFT_FAULT_DOMAINS env wins.
     fault_domains: int = 1
+    # Coalesced-flush size at which the scheduler routes a dispatch to
+    # the multi-device sharded mesh (ONE program sharded over every
+    # healthy fault domain) instead of a single chip. 0 = auto: use the
+    # per-topology crossover learned by calibrate.py's sharded sweep,
+    # falling back to 4096. CBFT_SHARD_MIN_BATCH env wins;
+    # CBFT_MESH_ROUTE=single|sharded overrides the decision entirely.
+    shard_min_batch: int = 0
     # AOT warm-boot phase (crypto/tpu/aot.py): pre-lower and compile the
     # pow2 shape-bucket ladder before traffic arrives so no dispatch
     # ever pays trace+compile. "background" (default) warms on a thread
@@ -384,6 +391,13 @@ class Config:
             raise ValueError(
                 "crypto.fault_domains must be a non-negative integer, "
                 f"got {fd!r}"
+            )
+        smb = self.crypto.shard_min_batch
+        if not isinstance(smb, int) or isinstance(smb, bool) or smb < 0:
+            # 0 is a valid value: use the calibrated crossover
+            raise ValueError(
+                "crypto.shard_min_batch must be a non-negative integer, "
+                f"got {smb!r}"
             )
         wb = self.crypto.warm_boot
         if wb not in ("eager", "background", "off"):

@@ -214,18 +214,47 @@ class FaultyBackend(BatchVerifier):
     def count(self) -> int:
         return self._n
 
+    def _sharded_target_label(self) -> Optional[str]:
+        """When this dispatch is a sharded multi-device program whose
+        current shard plan still contains the plan's target device,
+        return the target's label: the injected failure then takes down
+        the WHOLE program (one device's death is the program's death)
+        and the error names the offender so the supervisor's sharded
+        failure attribution can quarantine the right domain. None when
+        not sharded, or once the target is quarantined out of the mesh
+        (the re-sliced program no longer touches it)."""
+        from cometbft_tpu.crypto.tpu import mesh
+
+        if mesh.current_route() != mesh.ROUTE_SHARDED:
+            return None
+        try:
+            plan_obj = mesh.shard_plan()
+        except Exception:  # noqa: BLE001 - no mesh, no participation
+            return None
+        if plan_obj is None:
+            return None
+        for h in plan_obj.handles:
+            if h.index == self._plan.device:
+                return h.label
+        return None
+
     def verify(self) -> Tuple[bool, List[bool]]:
         n, self._n = self._n, 0
         from cometbft_tpu.crypto.tpu import topology
 
         dev = topology.current_device()
         dev_idx = dev.index if dev is not None else None
+        target = ""
         if self._plan.device is not None and dev_idx != self._plan.device:
-            # this dispatch targets a different fault domain than the
-            # plan scopes to — it runs clean (that is the whole point of
-            # device-targeted chaos: the survivors must not feel it)
-            self._plan._count_bypass(dev_idx)
-            return self._inner.verify()
+            label = self._sharded_target_label()
+            if label is None:
+                # this dispatch targets a different fault domain than
+                # the plan scopes to — it runs clean (that is the whole
+                # point of device-targeted chaos: the survivors must not
+                # feel it)
+                self._plan._count_bypass(dev_idx)
+                return self._inner.verify()
+            target = f" on device {label}"
         no, raise_, hang, corrupt, jitter_s, transient, oom = (
             self._plan._decide(dev_idx)
         )
@@ -237,7 +266,7 @@ class FaultyBackend(BatchVerifier):
             self._inner.verify()  # drop the held items like a real death
             raise TransientFault(
                 f"UNAVAILABLE: injected transient tunnel flap "
-                f"(dispatch #{no}, {n} items)"
+                f"(dispatch #{no}, {n} items){target}"
             )
         if oom and self._plan.oom_above_lanes is not None:
             # allocator model: the OOM only fires while the device would
@@ -255,12 +284,13 @@ class FaultyBackend(BatchVerifier):
             self._inner.verify()
             raise ResourceExhaustedFault(
                 f"RESOURCE_EXHAUSTED: injected HBM allocation failure "
-                f"(dispatch #{no}, {n} items)"
+                f"(dispatch #{no}, {n} items){target}"
             )
         if raise_:
             self._inner.verify()  # drop the held items like a real death
             raise FaultInjected(
-                f"injected dispatch failure (dispatch #{no}, {n} items)"
+                f"injected dispatch failure (dispatch #{no}, "
+                f"{n} items){target}"
             )
         ok, mask = self._inner.verify()
         if corrupt:
@@ -985,4 +1015,218 @@ def run_chaos_memory_guard(
     )
     assert guarded_shrinks == 0, "reactive rung engaged under guard"
     assert guard_shrink_events > 0, "guard never recorded its shrink"
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# sharded-mesh chaos: kill one domain mid-sharded-flow, mesh re-slices
+# ---------------------------------------------------------------------------
+
+
+def run_chaos_sharded(
+    devices: int = 8,
+    kill: int = 3,
+    seed: int = 7,
+    inner: cryptobatch.Backend = "cpu",
+    rounds: int = 4,
+    logger=None,
+) -> dict:
+    """The sharded-dispatch degradation proof: megabatches route as ONE
+    multi-device program over an N-domain mesh; device ``kill`` is then
+    injected with a program-fatal failure (a sharded program containing
+    the target dies whole, named — see FaultyBackend._sharded_target_label)
+    and the run asserts
+
+      * zero wrong verdicts are ever released (sync-audit mode) and no
+        node-wide CPU fallback engages;
+      * the failure is attributed to the OFFENDING domain: exactly
+        device ``kill`` is quarantined, the topology mirror marks it,
+        and the shard plan re-slices to N-1 devices for the retry —
+        the faulted megabatch still completes with ground-truth verdicts;
+      * sharded throughput on the degraded mesh stays within the
+        partial-degradation bound: ≥ 0.6 × (N-1)/N of the full-mesh rate
+        (the PR 6 bound, applied to the sharded path);
+      * repair + the killed domain's canary re-admit it and the plan
+        re-slices back to N devices.
+
+    Requires ≥ ``devices`` visible jax devices (the virtual CPU mesh via
+    XLA_FLAGS=--xla_force_host_platform_device_count). Deterministic:
+    seeded faults, rate-1.0 kill. Returns a summary dict; tools/chaos.py
+    --sharded and the tier-1 suite assert on it."""
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.supervisor import (
+        DEGRADED,
+        HEALTHY,
+        BackendSupervisor,
+    )
+    from cometbft_tpu.crypto.tpu import mesh, topology
+
+    if not 0 <= kill < devices:
+        raise ValueError(f"kill index {kill} outside 0..{devices - 1}")
+    topo = topology.DeviceTopology.virtual(devices)
+    prev_topo = topology.default_topology()
+    # the mesh module's shard_plan resolves the DEFAULT topology (that
+    # is what production does: node start installs its detected one)
+    topology.set_default_topology(topo)
+    name = f"chaos-sh-{seed}-{devices}-{kill}"
+    plan = install(
+        name=name, inner=inner, plan=FaultPlan(seed=seed, device=kill)
+    )
+    sup = BackendSupervisor(
+        spec=BackendSpec(name),
+        dispatch_timeout_ms=2000,
+        breaker_threshold=1,
+        audit_pct=100,
+        audit_sync=True,  # no wrong verdict may ever be released
+        probe_base_ms=60_000,
+        probe_max_ms=120_000,
+        hedge_pct=0,  # hedging off: outcomes must be attributable
+        retry_ms=5,
+        logger=logger,
+        topology=topo,
+    )
+    if mesh.shard_plan(topo) is None:
+        sup.stop()
+        topology.set_default_topology(prev_topo)
+        raise RuntimeError(
+            f"sharded chaos needs a {devices}-way device plane "
+            "(XLA_FLAGS=--xla_force_host_platform_device_count)"
+        )
+    killed_label = topo.device(kill).label
+    m = sup.metrics
+    keys = [
+        ed.gen_priv_key_from_secret(b"chaos-sh-%d" % i) for i in range(8)
+    ]
+    batch = 64 * devices
+
+    def make_items(tag: bytes, poison_at=None):
+        items, truth = [], []
+        for i in range(batch):
+            k = keys[i % len(keys)]
+            msg = b"sh %s %d" % (tag, i)
+            good = i != poison_at
+            items.append((k.pub_key(), msg,
+                          k.sign(msg) if good else b"\x17" * 64))
+            truth.append(good)
+        return items, truth
+
+    def series(counter) -> dict:
+        return {
+            c._labels["device"]: c.value()
+            for c in counter._series() if "device" in c._labels
+        }
+
+    def timed_rounds(tag: bytes) -> float:
+        """Sigs/sec over ``rounds`` sharded megabatches (wall clock)."""
+        t0 = time.perf_counter()
+        for r in range(rounds):
+            items, truth = make_items(tag + b"-%d" % r)
+            got = sup.verify_items(
+                items, reason="sh-" + tag.decode(), route="sharded"
+            )
+            if got != truth:
+                wrong.append(tag)
+        return rounds * batch / (time.perf_counter() - t0)
+
+    wrong: List[bytes] = []
+    try:
+        # phase 1 — full-mesh baseline: clean sharded megabatches (one
+        # poisoned lane proves per-lane verdict attribution rides along)
+        items, truth = make_items(b"base", poison_at=11)
+        if sup.verify_items(items, reason="sh-base", route="sharded") != truth:
+            wrong.append(b"base")
+        full_rate = timed_rounds(b"full")
+        dispatches_full = m.sharded_dispatches.value()
+
+        # phase 2 — kill: the armed fault takes down the whole sharded
+        # program, named; the supervisor attributes, quarantines device
+        # `kill`, re-slices to N-1, and the SAME megabatch completes
+        plan.exception_rate = 1.0
+        items, truth = make_items(b"kill", poison_at=5)
+        if sup.verify_items(items, reason="sh-kill", route="sharded") != truth:
+            wrong.append(b"kill")
+        states = sup.device_states()
+        quarantined_only_kill = (
+            states.get(killed_label) == "broken"
+            and all(s == HEALTHY for d, s in states.items()
+                    if d != killed_label)
+        )
+        state_degraded = sup.state()
+        reslices = m.sharded_reslices.value()
+        plan_after = mesh.shard_plan(topo)
+        resliced_n = plan_after.n_shards if plan_after is not None else 0
+        topo_mirrored = topo.is_quarantined(kill)
+
+        # phase 3 — degraded throughput: the fault is still armed, but
+        # the re-sliced mesh no longer contains the target, so sharded
+        # megabatches keep serving on N-1 devices within the bound
+        degraded_rate = timed_rounds(b"degraded")
+        bound = 0.6 * (devices - 1) / devices * full_rate
+        throughput_ok = degraded_rate >= bound
+
+        # phase 4 — repair + re-admission: the killed domain's canary
+        # closes its breaker, the mirror clears, the plan re-slices back
+        plan.clear()
+        readmit_ok = sup.probe_now(device=kill)
+        plan_back = mesh.shard_plan(topo)
+        restored_n = plan_back.n_shards if plan_back is not None else 0
+        items, truth = make_items(b"restored")
+        if (
+            sup.verify_items(items, reason="sh-restored", route="sharded")
+            != truth
+        ):
+            wrong.append(b"restored")
+        final_states = sup.device_states()
+    finally:
+        sup.stop()
+        topology.set_default_topology(prev_topo)
+
+    summary = {
+        "devices": devices,
+        "kill": kill,
+        "batch": batch,
+        "wrong_verdicts": len(wrong),
+        "cpu_routed": m.cpu_routed.value(),
+        "quarantines": series(m.quarantines),
+        "sharded_dispatches": m.sharded_dispatches.value(),
+        "sharded_dispatches_full_phase": dispatches_full,
+        "sharded_reslices": reslices,
+        "quarantined_only_kill": quarantined_only_kill,
+        "state_while_quarantined": state_degraded,
+        "topology_mirrored_quarantine": topo_mirrored,
+        "resliced_shards": resliced_n,
+        "full_rate_sigs_s": round(full_rate, 1),
+        "degraded_rate_sigs_s": round(degraded_rate, 1),
+        "throughput_bound_sigs_s": round(bound, 1),
+        "throughput_ok": throughput_ok,
+        "readmit_probe_ok": readmit_ok,
+        "restored_shards": restored_n,
+        "final_states": final_states,
+        "backend_dispatches": plan.dispatches,
+        "expected": {
+            "state_while_quarantined": DEGRADED,
+            "final_state": HEALTHY,
+        },
+    }
+    # safety invariants hold unconditionally — assert here so every
+    # caller (CLI, tests, bench) gets them for free
+    assert not wrong, f"wrong verdicts released in phases {wrong}"
+    assert m.cpu_routed.value() == 0, "node-wide CPU fallback engaged"
+    assert quarantined_only_kill, (
+        f"quarantine attribution missed: {states}"
+    )
+    assert topo_mirrored, "breaker trip never mirrored into the topology"
+    assert resliced_n == devices - 1, (
+        f"shard plan re-sliced to {resliced_n}, expected {devices - 1}"
+    )
+    assert reslices >= 1, "sharded re-slice counter never moved"
+    assert throughput_ok, (
+        f"degraded sharded rate {degraded_rate:.1f} sigs/s below bound "
+        f"{bound:.1f} (full-mesh {full_rate:.1f})"
+    )
+    assert readmit_ok and restored_n == devices, (
+        f"re-admission failed: probe={readmit_ok} shards={restored_n}"
+    )
+    assert all(s == HEALTHY for s in final_states.values()), final_states
     return summary

@@ -78,6 +78,7 @@ from cometbft_tpu.libs.service import BaseService
 DEFAULT_FLUSH_US = 500
 DEFAULT_MAX_QUEUE = 65_536
 DEFAULT_SUBMIT_TIMEOUT_MS = 5_000
+DEFAULT_SHARD_MIN_BATCH = 4096
 SUBSYSTEM = "verify_scheduler"
 
 Item = Tuple[PubKey, bytes, bytes]
@@ -104,6 +105,27 @@ def max_queue_default(config_max_queue: Optional[int] = None) -> int:
     if config_max_queue is not None:
         return config_max_queue
     return DEFAULT_MAX_QUEUE
+
+
+def shard_min_batch_default(config_value: Optional[int] = None) -> int:
+    """Coalesced-flush size at which the scheduler routes to the sharded
+    mesh instead of one chip. Precedence: CBFT_SHARD_MIN_BATCH env >
+    [crypto] shard_min_batch (0 = auto) > the per-topology crossover
+    learned by calibrate.py's sharded sweep > built-in 4096."""
+    raw = os.environ.get("CBFT_SHARD_MIN_BATCH")
+    if raw is not None:
+        return int(raw)
+    if config_value:  # 0 = auto (fall through to calibration)
+        return int(config_value)
+    try:
+        from cometbft_tpu.crypto.tpu import calibrate
+
+        learned = calibrate.shard_min_batch()
+    except Exception:  # noqa: BLE001 - calibration is advisory
+        learned = None
+    if learned:
+        return int(learned)
+    return DEFAULT_SHARD_MIN_BATCH
 
 
 class Metrics:
@@ -259,6 +281,7 @@ class VerifyScheduler(BaseService):
         join_timeout_s: float = 30.0,
         tracer: Optional[tracelib.Tracer] = None,
         telemetry=None,
+        shard_min_batch: Optional[int] = None,
     ):
         super().__init__("VerifyScheduler", logger)
         if isinstance(spec, BackendSpec):
@@ -301,6 +324,13 @@ class VerifyScheduler(BaseService):
         self._worker: Optional[threading.Thread] = None
         # observability for tests/bench: coalesced dispatches performed
         self.n_dispatches = 0
+        # three-way routing ladder (CPU / single-chip / sharded mesh):
+        # the [crypto] shard_min_batch config (0 = auto) is resolved
+        # lazily against the calibration table on the first supervised
+        # flush, and per-route dispatch counts feed /debug + verify_top
+        self._shard_min_batch_cfg = shard_min_batch
+        self._shard_min_batch_resolved: Optional[int] = None
+        self._routes = {"cpu": 0, "single": 0, "sharded": 0}
 
     # -- knob introspection --------------------------------------------------
 
@@ -320,6 +350,16 @@ class VerifyScheduler(BaseService):
     def supervisor(self):
         return self._supervisor
 
+    @property
+    def shard_min_batch(self) -> int:
+        """The resolved sharded-routing floor (resolves lazily so a
+        calibration recorded after construction is still honored)."""
+        if self._shard_min_batch_resolved is None:
+            self._shard_min_batch_resolved = max(
+                1, shard_min_batch_default(self._shard_min_batch_cfg)
+            )
+        return self._shard_min_batch_resolved
+
     def queue_snapshot(self) -> dict:
         """Point-in-time queue state for the health/capacity plane
         (/debug/verify): what is waiting and what budget the next
@@ -332,6 +372,7 @@ class VerifyScheduler(BaseService):
                 "effective_lane_budget": self._effective_lane_budget(),
                 "flush_us": self.flush_us,
                 "dispatches": self.n_dispatches,
+                "routes": dict(self._routes),
             }
 
     def _effective_lane_budget(self) -> int:
@@ -626,6 +667,45 @@ class VerifyScheduler(BaseService):
                     height=req.height,
                 )
 
+    def _route_for(self, n: int) -> Optional[str]:
+        """Per-flush routing decision over the three-way ladder. The CPU
+        rung stays where it always was (a cpu spec / the calibrated
+        per-curve floor inside the backend); this decides single-chip vs
+        sharded mesh for a device-bound flush: CBFT_MESH_ROUTE operator
+        override > sharded when the healthy mesh has ≥2 devices and the
+        flush clears shard_min_batch > None (legacy single-chip auto)."""
+        if self.spec.name == "cpu":
+            return None
+        try:
+            from cometbft_tpu.crypto.tpu import mesh
+        except Exception:  # noqa: BLE001 - no TPU package, no routing
+            return None
+        try:
+            override = mesh.route_override()
+        except Exception:  # noqa: BLE001 - malformed CBFT_MESH_ROUTE
+            self.logger.error(
+                "malformed CBFT_MESH_ROUTE; routing on size",
+                value=os.environ.get("CBFT_MESH_ROUTE"),
+            )
+            override = None
+        if override is not None:
+            return override
+        try:
+            topo = getattr(self._supervisor, "topology", None)
+            if n >= self.shard_min_batch and mesh.sharded_available(topo):
+                return mesh.ROUTE_SHARDED
+        except Exception:  # noqa: BLE001 - routing is advisory
+            pass
+        return None
+
+    def _note_route(self, route: Optional[str]) -> None:
+        if self.spec.name == "cpu":
+            self._routes["cpu"] += 1
+        elif route == "sharded":
+            self._routes["sharded"] += 1
+        else:
+            self._routes["single"] += 1
+
     def _verify(
         self,
         items: List[Item],
@@ -638,9 +718,16 @@ class VerifyScheduler(BaseService):
             # ladder, and corruption audit live in crypto/supervisor.py —
             # it never raises for a device failure (CPU re-verify is
             # built in); origins let its triage attribute bad signatures
+            route = self._route_for(len(items))
+            self._note_route(route)
+            if route is not None:
+                return self._supervisor.verify_items(
+                    items, reason=reason, origins=origins, route=route
+                )
             return self._supervisor.verify_items(
                 items, reason=reason, origins=origins
             )
+        self._note_route(None)
         try:
             bv = new_batch_verifier(self.spec)
             for pk, m, s in items:

@@ -82,6 +82,7 @@ import collections
 import math
 import os
 import random
+import re
 import threading
 import time
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
@@ -486,6 +487,21 @@ class Metrics:
             "Batches whose quarantined-device share of the batch axis was "
             "redistributed to the healthy devices.",
         )
+        self.sharded_dispatches = r.counter(
+            SUBSYSTEM, "sharded_dispatches",
+            "Megabatches dispatched as ONE multi-device sharded program "
+            "over the healthy mesh (routing mode 'sharded').",
+        )
+        self.sharded_reslices = r.counter(
+            SUBSYSTEM, "sharded_reslices",
+            "Sharded mesh dispatches retried on a re-sliced (shrunken) "
+            "mesh after a failure was attributed to one fault domain.",
+        )
+        self.sharded_fallbacks = r.counter(
+            SUBSYSTEM, "sharded_fallbacks",
+            "Sharded-routed batches that fell back to the per-domain "
+            "partition path because the mesh was or became unavailable.",
+        )
 
     @classmethod
     def nop(cls) -> "Metrics":
@@ -718,6 +734,7 @@ class BackendSupervisor:
         items: List[Item],
         reason: str = "direct",
         origins: Optional[Sequence[Origin]] = None,
+        route: Optional[str] = None,
     ) -> List[bool]:
         """Verify ``items`` through the supervised backend, falling back
         to the CPU ground truth on any failure. Always returns a full
@@ -727,7 +744,14 @@ class BackendSupervisor:
         ``origins`` (optional) is the scheduler's demux shape — one
         ``(n_items, subsystem, height)`` per coalesced request, in item
         order — used only to attribute triaged bad signatures to the
-        subsystem/block that submitted them (metrics + logs)."""
+        subsystem/block that submitted them (metrics + logs).
+
+        ``route`` (optional) is the scheduler's routing decision for
+        this flush: "sharded" runs the whole batch as ONE multi-device
+        program over the healthy mesh (mesh.dispatch_sharded), "single"
+        pins the dispatch to one chip, None keeps the legacy per-domain
+        partition. A sharded route degrades to the partition path (and
+        ultimately CPU) whenever the mesh shrinks below two devices."""
         if not items:
             return []
         if self.spec.name == "cpu":
@@ -736,9 +760,20 @@ class BackendSupervisor:
             return self._cpu_verify(items)
         state = self.state()
         span = self._tracer.span(
-            "supervise", state=state, n_sigs=len(items), reason=reason
+            "supervise", state=state, n_sigs=len(items), reason=reason,
+            route=route or "auto",
         )
         with tracelib.use(span):
+            if route == "sharded":
+                out = self._verify_mesh(items, reason, origins)
+                if out is not None:
+                    mask, outcome = out
+                    span.end(outcome=outcome)
+                    return mask
+                # the mesh was (or became) unavailable: fall through to
+                # the per-domain partition over whatever still serves
+                self.metrics.sharded_fallbacks.add()
+                route = None
             with self._lock:
                 healthy = [d for d in self._domains if d.state != BROKEN]
                 n_domains = len(self._domains)
@@ -761,13 +796,13 @@ class BackendSupervisor:
             if len(shards) == 1:
                 dom = shards[0][0]
                 mask, outcome = self._supervise_shard(
-                    dom, items, reason, origins
+                    dom, items, reason, origins, route=route
                 )
                 span.end(outcome=outcome)
                 return mask
             return self._verify_sharded(
                 span, shards, items, reason, origins,
-                n_healthy=len(healthy),
+                n_healthy=len(healthy), route=route,
             )
 
     def _partition(self, n: int, healthy: List[_Domain]):
@@ -791,6 +826,111 @@ class BackendSupervisor:
             start = end
         return shards or [(use[0], 0, n)]
 
+    def _verify_mesh(
+        self,
+        items: List[Item],
+        reason: str,
+        origins: Optional[Sequence[Origin]],
+    ):
+        """ONE supervised sharded-mesh dispatch: the megabatch runs as a
+        single multi-device program sharded over every healthy fault
+        domain (mesh.dispatch_sharded via route_scope). The lead healthy
+        domain fronts the call — its watchdog, retry ladder, latency
+        model, and hedge apply to the whole program — but a failure is
+        attributed to the OFFENDING fault domain (parsed out of the
+        error chain), which is quarantined so the mesh shrinks and the
+        shard plan re-slices before the bounded retry. Returns
+        (mask, outcome) or None when the mesh is or becomes unavailable
+        (fewer than two healthy devices) so verify_items falls through
+        to the per-domain partition path."""
+        from cometbft_tpu.crypto.tpu import mesh as mesh_mod
+
+        for _ in range(max(1, len(self._domains))):
+            with self._lock:
+                healthy = [d for d in self._domains if d.state != BROKEN]
+            if len(healthy) < 2:
+                return None
+            try:
+                if not mesh_mod.sharded_available(self.topology):
+                    return None
+            except Exception:  # noqa: BLE001 - mesh probe must not raise
+                return None
+            lead = healthy[0]
+            self.metrics.sharded_dispatches.add()
+            mspan = tracelib.child_of_current(
+                "mesh_dispatch", n_sigs=len(items),
+                n_domains=len(healthy), lead=lead.handle.label,
+            )
+            try:
+                with tracelib.use(mspan):
+                    mask, source = self._dispatch_adaptive(
+                        lead, items, reason, route="sharded"
+                    )
+            except WatchdogTimeout as exc:
+                mspan.end(outcome="watchdog_timeout")
+                self.metrics.watchdog_kills.add()
+                offender = self._attribute_sharded_failure(
+                    exc, healthy, lead
+                )
+                self._trip(
+                    offender, "watchdog", err=str(exc), n=len(items),
+                    reason=reason, sharded=True,
+                )
+                self.metrics.sharded_reslices.add()
+                continue
+            except Exception as exc:  # noqa: BLE001 - any program death
+                mspan.end(error=repr(exc))
+                self.metrics.failures.add()
+                offender = self._attribute_sharded_failure(
+                    exc, healthy, lead
+                )
+                self.logger.error(
+                    "sharded mesh dispatch failed; quarantining the "
+                    "offending domain and re-slicing",
+                    err=repr(exc), n=len(items), reason=reason,
+                    device=offender.handle.label,
+                    n_domains=len(healthy),
+                )
+                self._trip(
+                    offender, "sharded", err=repr(exc), n=len(items),
+                    reason=reason,
+                )
+                self.metrics.sharded_reslices.add()
+                continue
+            mspan.end(outcome="ok")
+            return self._release_shard(
+                lead, items, mask, source, reason, origins
+            )
+        return None
+
+    def _attribute_sharded_failure(
+        self, exc: BaseException, healthy: List[_Domain], lead: _Domain
+    ) -> _Domain:
+        """Best-effort attribution of a failed multi-device program to
+        the offending fault domain: walk the exception chain looking for
+        a healthy device's label or index (fault injection and most XLA
+        device errors name the device); default to the lead domain when
+        nothing matches, so SOME domain always takes the strike and the
+        retry loop always shrinks the mesh."""
+        by_index = {d.handle.index: d for d in healthy}
+        seen = set()
+        e: Optional[BaseException] = exc
+        while e is not None and id(e) not in seen:
+            seen.add(id(e))
+            text = str(e)
+            for d in healthy:
+                if d.handle.label and re.search(
+                    r"\b%s\b" % re.escape(d.handle.label), text
+                ):
+                    return d
+            m = re.search(
+                r"\b(?:device|dev|TPU)[ _:#]?(\d+)\b", text, re.IGNORECASE
+            )
+            if m and int(m.group(1)) in by_index:
+                return by_index[int(m.group(1))]
+            e = e.__cause__ or e.__context__
+        return lead
+
     def _verify_sharded(
         self,
         span,
@@ -799,6 +939,7 @@ class BackendSupervisor:
         reason: str,
         origins: Optional[Sequence[Origin]],
         n_healthy: int,
+        route: Optional[str] = None,
     ) -> List[bool]:
         """Run one shard per healthy domain — shard 0 inline on the
         calling thread, the rest on workers that re-install the
@@ -816,6 +957,7 @@ class BackendSupervisor:
                     m, oc = self._supervise_shard(
                         dom, items[start:end], reason,
                         _slice_origins(origins, start, end),
+                        route=route,
                     )
                 results[i], outcomes[i] = m, oc
             except Exception:  # noqa: BLE001 - assembly CPU-fills the hole
@@ -856,13 +998,16 @@ class BackendSupervisor:
         items: List[Item],
         reason: str,
         origins: Optional[Sequence[Origin]],
+        route: Optional[str] = None,
     ):
         """The per-domain supervised verify — the full degradation
         ladder (retry/hedge/shrink → breaker strike → CPU fallback),
         triage, and audit for ONE fault domain's share of the batch.
         → (mask, outcome-tag)."""
         try:
-            mask, source = self._dispatch_adaptive(dom, items, reason)
+            mask, source = self._dispatch_adaptive(
+                dom, items, reason, route=route
+            )
         except WatchdogTimeout as exc:
             self.metrics.watchdog_kills.add()
             self._trip(
@@ -872,6 +1017,21 @@ class BackendSupervisor:
         except Exception as exc:  # noqa: BLE001 - any backend death
             self._note_failure(dom, exc, len(items), reason)
             return self._cpu_verify(items), "failure_cpu"
+        return self._release_shard(dom, items, mask, source, reason, origins)
+
+    def _release_shard(
+        self,
+        dom: _Domain,
+        items: List[Item],
+        mask: List[bool],
+        source: str,
+        reason: str,
+        origins: Optional[Sequence[Origin]],
+    ):
+        """Post-dispatch release path shared by the per-domain shard and
+        the whole-mesh sharded dispatch: hedge-winner short-circuit,
+        breaker bookkeeping, mixed-verdict triage, and the corruption
+        audit. → (mask, outcome-tag)."""
         if source != "device":
             # the CPU hedge won the race: its verdicts ARE the ground
             # truth — nothing to audit or triage, and the device's
@@ -903,7 +1063,7 @@ class BackendSupervisor:
     # -- internals: the retry/hedge rungs of the ladder ----------------------
 
     def _dispatch_adaptive(self, dom: _Domain, items: List[Item],
-                           reason: str):
+                           reason: str, route: Optional[str] = None):
         """Retry rungs: classify device errors, retry a transient once
         with jittered backoff, halve the chunk cap and retry on OOM, and
         hand everything else up for a breaker strike. → (mask, source)
@@ -911,7 +1071,8 @@ class BackendSupervisor:
         transient_retries = 0
         while True:
             try:
-                return self._device_verify_hedged(dom, items, reason)
+                return self._device_verify_hedged(dom, items, reason,
+                                                  route=route)
             except WatchdogTimeout:
                 raise  # the last-resort rung; never retried
             except Exception as exc:  # noqa: BLE001 - classify + retry
@@ -955,7 +1116,7 @@ class BackendSupervisor:
                 raise
 
     def _device_verify_hedged(self, dom: _Domain, items: List[Item],
-                              reason: str):
+                              reason: str, route: Optional[str] = None):
         """Watchdogged device dispatch with predictive CPU hedging.
         While the latency model is cold (or ``hedge_pct`` is 0) this is
         exactly the plain watchdogged dispatch. Once warm, a dispatch
@@ -966,7 +1127,7 @@ class BackendSupervisor:
             dom.latency_model.predict_p99(len(items))
             if self._hedge_pct > 0 else None
         )
-        h = self._start_device(dom, items)
+        h = self._start_device(dom, items, route=route)
         deadline = h.t0 + self._timeout_s
         hedge_at = (
             h.t0 + pred * self._hedge_pct / 100.0
@@ -1239,7 +1400,8 @@ class BackendSupervisor:
 
     # -- internals: dispatch -------------------------------------------------
 
-    def _start_device(self, dom: _Domain, items: List[Item]) -> "_DeviceCall":
+    def _start_device(self, dom: _Domain, items: List[Item],
+                      route: Optional[str] = None) -> "_DeviceCall":
         """Launch the wrapped backend on a watchdog-abandonable worker
         thread and return immediately with the call handle. A call that
         outlives its wait is abandoned: its thread keeps the hardware
@@ -1259,13 +1421,14 @@ class BackendSupervisor:
         # mesh chunk loop's spans nest under it across the thread hop
         h.span = tracelib.child_of_current(
             "device", n_sigs=len(items), backend=self.spec.name,
-            device=dom.handle.label,
+            device=dom.handle.label, route=route or "auto",
         )
 
         def run():
             try:
                 with tracelib.use(h.span), mesh.cancel_scope(h.cancel), \
-                        topology.device_scope(dom.handle):
+                        topology.device_scope(dom.handle), \
+                        mesh.route_scope(route):
                     bv = new_batch_verifier(self.spec)
                     for pk, m, s in items:
                         bv.add(pk, m, s)
@@ -1588,7 +1751,22 @@ class BackendSupervisor:
         self._set_state_locked(dom, BROKEN)
         dom.backoff_s = self._probe_base_s
         dom.next_probe_at = time.monotonic() + dom.backoff_s
+        self._sync_quarantine(dom, True)
         return newly_opened
+
+    def _sync_quarantine(self, dom: _Domain, flag: bool) -> None:
+        """Mirror one domain's breaker into the topology's quarantine
+        set, bumping its generation counter so the sharded mesh plan
+        cache (mesh.shard_plan) re-slices on the next dispatch. Best
+        effort: a topology without quarantine support (tests, shims)
+        simply keeps the full mesh."""
+        setter = getattr(self.topology, "set_quarantined", None)
+        if setter is None:
+            return
+        try:
+            setter(dom.handle.index, flag)
+        except Exception:  # noqa: BLE001 - plan cache stays stale, not fatal
+            pass
 
     def _capture_incident_profile(self, cause: str) -> None:
         """Fire the incident profiler's one-shot capture on a breaker
@@ -1648,6 +1826,7 @@ class BackendSupervisor:
         dom.consecutive_failures = 0
         dom.backoff_s = self._probe_base_s
         dom.next_probe_at = 0.0
+        self._sync_quarantine(dom, False)
 
     # -- internals: corruption audit -----------------------------------------
 

@@ -467,10 +467,25 @@ class ExecutableRegistry:
             (tuple(int(d) for d in a.shape), str(a.dtype)) for a in args
         )
 
-    def _key(self, kernel, shape_key, donate_from, sharded):
+    def _key(self, kernel, shape_key, donate_from, sharded, mesh=None):
         bfp = backend_fingerprint()
         tfp = topology_fingerprint()
         self._note_fps(bfp, tfp)
+        # sharded executables are additionally keyed by the mesh's exact
+        # device set: a re-sliced (quarantine-shrunk) sub-mesh compiles
+        # and caches separately from the full-strength program — running
+        # an 8-way executable on a 7-device mesh would be wrong, not slow
+        if sharded:
+            if mesh is None:
+                from cometbft_tpu.crypto.tpu import mesh as mesh_mod
+
+                mesh = mesh_mod.batch_mesh()
+            mkey = tuple(
+                int(getattr(d, "id", i))
+                for i, d in enumerate(mesh.devices.flat)
+            )
+        else:
+            mkey = None
         return (
             stable_kernel_name(kernel),
             shape_key,
@@ -478,6 +493,7 @@ class ExecutableRegistry:
             bool(sharded),
             tfp,
             bfp,
+            mkey,
         ), bfp, tfp
 
     def _note_fps(self, bfp: str, tfp: str) -> None:
@@ -506,11 +522,16 @@ class ExecutableRegistry:
         donate_from: int = 0,
         sharded: bool = False,
         trigger: str = "dispatch",
+        mesh=None,
     ):
         """The compiled executable for ``args``' exact shapes, compiling
-        on miss. ``args`` may be concrete arrays or ShapeDtypeStructs."""
+        on miss. ``args`` may be concrete arrays or ShapeDtypeStructs.
+        ``mesh`` names the device mesh a sharded executable runs over
+        (default: the full batch_mesh) — part of the cache key."""
         shape_key = self._shape_key(args)
-        key, bfp, tfp = self._key(kernel, shape_key, donate_from, sharded)
+        key, bfp, tfp = self._key(
+            kernel, shape_key, donate_from, sharded, mesh=mesh
+        )
         with self._mtx:
             ent = self._entries.get(key)
             if ent is not None:
@@ -536,7 +557,7 @@ class ExecutableRegistry:
             return fut.compiled
         try:
             compiled = self._load_or_compile(
-                kernel, key, args, donate_from, sharded, trigger
+                kernel, key, args, donate_from, sharded, trigger, mesh=mesh
             )
             fut.compiled = compiled
         except BaseException as exc:
@@ -563,11 +584,14 @@ class ExecutableRegistry:
         args: Sequence[Any],
         donate_from: int = 0,
         sharded: bool = False,
+        mesh=None,
     ):
         """Run ``kernel`` on ``args`` through the registry (the
-        dispatch-layer entry — mesh.run_single / mesh.sharded_verify)."""
+        dispatch-layer entry — mesh.run_single / mesh.sharded_verify /
+        mesh.dispatch_sharded)."""
         compiled = self.lookup(
-            kernel, args, donate_from=donate_from, sharded=sharded
+            kernel, args, donate_from=donate_from, sharded=sharded,
+            mesh=mesh,
         )
         return compiled(*args)
 
@@ -577,6 +601,7 @@ class ExecutableRegistry:
         shapes: Sequence[Tuple[tuple, Any]],
         donate_from: int = 0,
         sharded: bool = False,
+        mesh=None,
     ) -> float:
         """Pre-lower and compile one (kernel, bucket, variant) without
         running it. → compile wall seconds (0.0 when already resident)."""
@@ -587,14 +612,14 @@ class ExecutableRegistry:
         before = self._compile_count
         self.lookup(
             kernel, sds, donate_from=donate_from, sharded=sharded,
-            trigger="warmup",
+            trigger="warmup", mesh=mesh,
         )
         if self._compile_count == before:
             return 0.0
         return time.perf_counter() - t0
 
     def _load_or_compile(
-        self, kernel, key, args, donate_from, sharded, trigger
+        self, kernel, key, args, donate_from, sharded, trigger, mesh=None
     ):
         """Serve a registry miss: deserialize from the disk executable
         store when a fingerprint-matched entry exists (no trace, no
@@ -619,13 +644,14 @@ class ExecutableRegistry:
         else:
             self.metrics.exec_store_misses.add()
         compiled = self._compile(
-            kernel, key, args, donate_from, sharded, trigger
+            kernel, key, args, donate_from, sharded, trigger, mesh=mesh
         )
         if store is not None:
             store.save(key, compiled)
         return compiled
 
-    def _compile(self, kernel, key, args, donate_from, sharded, trigger):
+    def _compile(self, kernel, key, args, donate_from, sharded, trigger,
+                 mesh=None):
         """Explicit jit(...).lower(shapes).compile() with one fresh-
         compile retry: a corrupted or truncated persistent-cache entry
         (or a transient backend hiccup) must degrade to a fresh compile
@@ -639,7 +665,9 @@ class ExecutableRegistry:
         t0 = time.perf_counter()
         try:
             try:
-                compiled = self._build(kernel, args, donate_from, sharded)
+                compiled = self._build(
+                    kernel, args, donate_from, sharded, mesh=mesh
+                )
             except Exception as exc:  # noqa: BLE001 - retry fresh once
                 warnings.warn(
                     f"aot compile of {name} bucket {bucket} failed "
@@ -652,7 +680,9 @@ class ExecutableRegistry:
                         "aot compile failed; retrying fresh",
                         kernel=name, bucket=bucket, err=str(exc),
                     )
-                compiled = self._build(kernel, args, donate_from, sharded)
+                compiled = self._build(
+                    kernel, args, donate_from, sharded, mesh=mesh
+                )
                 self.metrics.compile_fallbacks.add()
         except Exception as exc:  # noqa: BLE001
             span.end(error=repr(exc))
@@ -665,7 +695,7 @@ class ExecutableRegistry:
         self.metrics.compile_seconds.add(secs)
         return compiled
 
-    def _build(self, kernel, args, donate_from, sharded):
+    def _build(self, kernel, args, donate_from, sharded, mesh=None):
         import jax
 
         inner = unwrap_kernel(kernel)
@@ -679,7 +709,7 @@ class ExecutableRegistry:
             from cometbft_tpu.crypto.tpu import mesh as mesh_mod
             from jax.sharding import NamedSharding, PartitionSpec as PS
 
-            m = mesh_mod.batch_mesh()
+            m = mesh if mesh is not None else mesh_mod.batch_mesh()
             in_shardings = tuple(
                 NamedSharding(m, PS(*([None] * (len(s.shape) - 1) + ["batch"])))
                 for s in sds
@@ -815,14 +845,28 @@ def warmup_plan(
         include_single = True
     buckets = list(sizes) if sizes is not None else bucket_ladder(floor=floor)
     targets: List[WarmTarget] = []
+    seen_sharded = set()
     for bucket in buckets:
         for reg in registered_kernels():
             if ndev > 1:
-                size = -(-bucket // ndev) * ndev  # dispatch_batch rounding
-                targets.append(WarmTarget(
-                    reg.name, reg.kernel, reg.bucket_shapes(size),
-                    reg.donate_from, True, size,
-                ))
+                # two sharded roundings can be in play: the legacy
+                # dispatch_batch auto-shard (pow2 rounded up to a
+                # multiple of ndev) and dispatch_sharded's pow2
+                # PER-SHARD bucket (shard_bucket). They coincide except
+                # at the smallest buckets; warm both, deduplicated, so
+                # either path finds its executable resident.
+                sharded_sizes = {
+                    -(-bucket // ndev) * ndev,
+                    mesh_mod.shard_bucket(bucket, ndev, _MIN_PAD),
+                }
+                for size in sorted(sharded_sizes):
+                    if (reg.name, size) in seen_sharded:
+                        continue
+                    seen_sharded.add((reg.name, size))
+                    targets.append(WarmTarget(
+                        reg.name, reg.kernel, reg.bucket_shapes(size),
+                        reg.donate_from, True, size,
+                    ))
             if ndev == 1 or include_single:
                 targets.append(WarmTarget(
                     reg.name, reg.kernel, reg.bucket_shapes(bucket),

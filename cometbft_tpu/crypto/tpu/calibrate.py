@@ -182,6 +182,79 @@ def run_calibration(
     return table
 
 
+def run_sharded_calibration(
+    sizes=(1024, 2048, 4096, 8192),
+    reps: int = 2,
+) -> Optional[dict]:
+    """Time the single-chip route vs the sharded-mesh route at each
+    size on the LIVE topology and derive the per-topology crossover —
+    the scheduler's third routing rung. → a ``sharded`` table section
+    ({topology_fp: {points, shard_min_batch, n_shards}}), or None when
+    no multi-device mesh is available (nothing measurable, so no
+    sharded claim is recorded)."""
+    from cometbft_tpu.crypto.tpu import aot, ed25519_batch, mesh
+
+    plan = mesh.shard_plan()
+    if plan is None:
+        return None
+
+    from cometbft_tpu.crypto import ed25519 as ed
+
+    key = ed.gen_priv_key_from_secret(b"calibrate-sharded")
+    pk = key.pub_key()
+    msg = b"calibration message, vote-sized padding ........................"
+    sig = key.sign(msg)
+    pts: Dict[int, Tuple[float, float]] = {}
+    for n in sizes:
+        pks = [pk.bytes()] * n
+        msgs = [msg] * n
+        sigs = [sig] * n
+
+        def single():
+            with mesh.route_scope(mesh.ROUTE_SINGLE):
+                ed25519_batch.verify_batch(pks, msgs, sigs)
+
+        def sharded():
+            with mesh.route_scope(mesh.ROUTE_SHARDED):
+                ed25519_batch.verify_batch(pks, msgs, sigs)
+
+        # crossover convention: "device" = the sharded mesh, "cpu" =
+        # the single-chip baseline it must beat
+        pts[n] = (_best_ms(sharded, reps), _best_ms(single, reps))
+    fp = aot.topology_fingerprint()
+    return {
+        str(fp): {
+            "n_shards": plan.n_shards,
+            "points": {
+                str(n): {"sharded_ms": round(s, 2), "single_ms": round(c, 2)}
+                for n, (s, c) in pts.items()
+            },
+            "shard_min_batch": _crossover(pts),
+        }
+    }
+
+
+def shard_min_batch(topology_fp: Optional[str] = None) -> Optional[int]:
+    """Measured batch size above which the sharded mesh beats the
+    single chip for ``topology_fp`` (the current topology's fingerprint
+    when omitted), or None when unmeasured / the mesh never won —
+    routing then keeps batches on the single-chip rung."""
+    table = load_table()
+    if not table or not isinstance(table.get("sharded"), dict):
+        return None
+    if topology_fp is None:
+        from cometbft_tpu.crypto.tpu import aot
+
+        topology_fp = aot.topology_fingerprint()
+    section = table["sharded"].get(str(topology_fp))
+    if not isinstance(section, dict):
+        return None
+    v = section.get("shard_min_batch")
+    if isinstance(v, int) and not isinstance(v, bool) and v > 0:
+        return v
+    return None
+
+
 def save_table(table: dict, path: str) -> None:
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     tmp = path + ".tmp"
@@ -190,17 +263,33 @@ def save_table(table: dict, path: str) -> None:
     os.replace(tmp, path)  # atomic: readers never see a torn table
 
 
-def record(path: Optional[str] = None, **kwargs) -> dict:
-    """Measure and persist — the warmup-subprocess entry point."""
+def record(path: Optional[str] = None, sharded_sizes=None, **kwargs) -> dict:
+    """Measure and persist — the warmup-subprocess entry point. When a
+    multi-device mesh is visible the sharded sweep runs too (its result
+    lands under ``table["sharded"][topology_fp]``); pass
+    ``sharded_sizes`` to tune it, or let the defaults apply."""
     path = path or table_path()
     table = run_calibration(**kwargs)
+    try:
+        sh_kwargs = {} if sharded_sizes is None else {"sizes": sharded_sizes}
+        section = run_sharded_calibration(**sh_kwargs)
+    except Exception:  # noqa: BLE001 - sharded sweep is additive, never fatal
+        section = None
+    if section:
+        table["sharded"] = section
     if path:
         # a fresh calibration must not drop previously-merged compile
         # observations — they key by topology fingerprint, not by the
-        # routing sweep this run just re-measured
+        # routing sweep this run just re-measured. Same for sharded
+        # crossovers of OTHER topologies (this run only re-measured the
+        # live one).
         old = load_table()
         if old and isinstance(old.get("compile"), dict):
             table["compile"] = old["compile"]
+        if old and isinstance(old.get("sharded"), dict):
+            merged = dict(old["sharded"])
+            merged.update(table.get("sharded", {}))
+            table["sharded"] = merged
         save_table(table, path)
     return table
 

@@ -77,6 +77,60 @@ class cancel_scope:
         return False
 
 
+# --- dispatch route (cpu / single-chip / sharded mesh) ----------------------
+# The scheduler decides per coalesced flush which rung of the routing
+# ladder a batch takes (see calibrate.shard_min_batch for the learned
+# crossover); the supervisor installs the decision on the dispatching
+# thread, same pattern as cancel_scope. No route installed = legacy
+# behavior: dispatch_batch auto-shards over the full mesh when more
+# than one device is visible.
+
+ROUTE_SINGLE = "single"    # force one chip even when a mesh is visible
+ROUTE_SHARDED = "sharded"  # the healthy-sub-mesh megabatch path
+
+_route_local = threading.local()
+
+
+def current_route() -> Optional[str]:
+    """The dispatch route installed on THIS thread, if any."""
+    return getattr(_route_local, "route", None)
+
+
+class route_scope:
+    """Context manager installing ``route`` (ROUTE_SINGLE /
+    ROUTE_SHARDED / None) as this thread's dispatch route; nests."""
+
+    def __init__(self, route: Optional[str]):
+        self._route = route
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_route_local, "route", None)
+        _route_local.route = self._route
+        return self._route
+
+    def __exit__(self, *exc_info):
+        _route_local.route = self._prev
+        return False
+
+
+def route_override() -> Optional[str]:
+    """Operator A/B override of the scheduler's routing decision:
+    CBFT_MESH_ROUTE=auto|single|sharded (auto/unset = learned
+    crossover)."""
+    raw = os.environ.get("CBFT_MESH_ROUTE")
+    if raw is None:
+        return None
+    raw = raw.strip().lower()
+    if raw in ("", "auto"):
+        return None
+    if raw in (ROUTE_SINGLE, ROUTE_SHARDED):
+        return raw
+    raise ValueError(
+        f"CBFT_MESH_ROUTE={raw!r} must be auto, single, or sharded"
+    )
+
+
 def maybe_init_distributed() -> bool:
     """Initialize jax.distributed for a multi-host verification plane
     when the operator configured one. Runs automatically on first mesh
@@ -341,6 +395,16 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
 
     import numpy as np
 
+    route = current_route()
+    if route == ROUTE_SHARDED:
+        plan = shard_plan()
+        if plan is not None:
+            return dispatch_sharded(
+                kernel, packed, n, max_chunk, min_pad, plan=plan
+            )
+        # the mesh shrank under us (quarantine left <2 usable devices):
+        # fall through to the single-device path rather than failing
+        route = ROUTE_SINGLE
     if device is None:
         from cometbft_tpu.crypto.tpu import topology
 
@@ -375,7 +439,10 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
 
     _hub = _telemetry.default_hub()
     _dev_label = device.label if device is not None else "dev0"
-    ndev = n_devices()
+    # ROUTE_SINGLE pins the program to one chip even when a mesh is
+    # visible (the scheduler's below-crossover rung); no route keeps the
+    # legacy auto-shard-over-everything behavior.
+    ndev = 1 if route == ROUTE_SINGLE else n_devices()
     depth = pipeline_depth()
     out = np.zeros(n, bool)
     inflight: "deque" = deque()
@@ -483,33 +550,337 @@ def _pow2(n: int, floor: int) -> int:
     return size
 
 
+def shard_bucket(n: int, n_shards: int, min_pad: int) -> int:
+    """Total padded lanes for ``n`` real lanes sharded over ``n_shards``
+    devices: each device's shard is padded to a power of two (floored at
+    min_pad) so every per-device program runs a warmable pow2 bucket;
+    the total is that bucket × n_shards. Warm boot (aot.warmup_plan)
+    uses the SAME arithmetic, so a warmed sharded ladder covers every
+    shape dispatch_sharded can produce — the zero-compiles-after-warm
+    guarantee depends on these two staying in lockstep."""
+    n_shards = max(1, int(n_shards))
+    return _pow2(-(-max(1, int(n)) // n_shards), min_pad) * n_shards
+
+
+# --- sharded dispatch plan ---------------------------------------------------
+# Which fault domains participate in a sharded dispatch, decided ONCE
+# per topology generation and cached: quarantining a domain bumps the
+# topology's generation counter, so the next dispatch re-slices the
+# mesh over the survivors instead of tripping the whole plane. The
+# handle list comes from topology.healthy_devices() (stable index
+# order), so every thread observing the same generation builds the
+# identical mesh.
+
+
+class ShardPlan:
+    """An immutable slice of the topology for one sharded-dispatch
+    epoch: the participating healthy fault domains (deterministic index
+    order) and the jax Mesh over their backing devices."""
+
+    def __init__(self, generation: int, handles, jax_mesh):
+        self.generation = int(generation)
+        self.handles = list(handles)
+        self.mesh = jax_mesh
+        self.n_shards = len(self.handles)
+
+    def labels(self):
+        return [h.label for h in self.handles]
+
+
+_plan_mtx = threading.Lock()
+_plan_cache = None  # (topology, generation, Optional[ShardPlan])
+
+
+def shard_plan(topology=None):
+    """The current sharded-dispatch plan for ``topology`` (default: the
+    process default), or None when sharded execution is not possible —
+    fewer than two healthy fault domains backed by distinct visible jax
+    devices (e.g. a virtual multi-domain topology over one real chip).
+    Cached per (topology, generation)."""
+    from cometbft_tpu.crypto.tpu import topology as topolib
+
+    topo = topology if topology is not None else topolib.default_topology()
+    gen = topo.generation()
+    global _plan_cache
+    with _plan_mtx:
+        cached = _plan_cache
+    if cached is not None and cached[0] is topo and cached[1] == gen:
+        return cached[2]
+    full = batch_mesh()  # may init jax.distributed; never under _plan_mtx
+    jax_devs = list(full.devices.flat)
+    healthy = [h for h in topo.healthy_devices() if h.index < len(jax_devs)]
+    if len(healthy) < 2:
+        plan = None
+    elif len(healthy) == len(jax_devs) and len(topo) == len(jax_devs):
+        # full-strength mesh: reuse the cached process mesh so the AOT
+        # registry key (mesh device set) matches warm boot's
+        plan = ShardPlan(gen, healthy, full)
+    else:
+        import numpy as np
+        from jax.sharding import Mesh
+
+        plan = ShardPlan(
+            gen, healthy,
+            Mesh(np.array([jax_devs[h.index] for h in healthy]), ("batch",)),
+        )
+    with _plan_mtx:
+        _plan_cache = (topo, gen, plan)
+    return plan
+
+
+def sharded_available(topology=None) -> bool:
+    """True when a sharded dispatch is currently possible (>= 2 healthy
+    fault domains backed by distinct jax devices) — the scheduler's
+    routing gate."""
+    try:
+        return shard_plan(topology) is not None
+    except Exception:  # noqa: BLE001 - routing probe must never raise
+        return False
+
+
+def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
+                     topology=None, plan=None, donate_from: int = 0):
+    """The production multi-device megabatch path: chunk-pad-dispatch
+    with every chunk's trailing batch axis sharded over the HEALTHY
+    fault domains of the topology (NamedSharding on the "batch" mesh
+    axis, limbs replicated).
+
+    Same contract as dispatch_batch — ``packed`` is pre-packed arrays or
+    a ``(start, end) -> list`` callable, the thread's cancel event is
+    checked at every chunk boundary, chunks are double-buffered
+    (pipeline_depth), staging buffers are donated — plus the sharded
+    specifics: the per-shard lane count is the MINIMUM chunk cap over
+    the participating devices (each device's OOM-shrink ladder and
+    memory-plane guard clamp it), each chunk pads to a pow2 per-shard
+    bucket (shard_bucket), and per-shard child spans attribute the work
+    to each fault domain. Quarantined domains are excluded by the
+    ShardPlan; a topology generation bump re-slices on the next call."""
+    from collections import deque
+
+    import numpy as np
+
+    if plan is None:
+        plan = shard_plan(topology)
+    if plan is None:
+        # no usable multi-device mesh: serve the batch on the single-
+        # device path (route pinned so dispatch_batch cannot bounce back)
+        with route_scope(ROUTE_SINGLE):
+            return dispatch_batch(kernel, packed, n, max_chunk, min_pad)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    from cometbft_tpu.crypto import telemetry as _telemetry
+    from cometbft_tpu.crypto.tpu import aot
+    from cometbft_tpu.crypto.tpu import memory as _memory
+
+    nsh = plan.n_shards
+    _kernel_name = getattr(kernel, "__name__", "kernel")
+    _plane = _memory.default_plane()
+    _baselines = {}
+    per_shard_cap = None
+    for h in plan.handles:
+        if _plane is not None:
+            _plane.refresh_guard(h, max_chunk, min_pad, kernel=_kernel_name)
+            _baselines[h.label] = _plane.device_view(h).get("bytes_in_use")
+        cap = h.chunk_cap(max_chunk, min_pad)
+        per_shard_cap = cap if per_shard_cap is None else min(
+            per_shard_cap, cap)
+    mega = per_shard_cap * nsh
+    _hub = _telemetry.default_hub()
+    registry = aot.default_registry()
+    depth = pipeline_depth()
+    out = np.zeros(n, bool)
+    inflight: "deque" = deque()
+    cancel = current_cancel_event()
+    max_bucket = 0
+
+    def retire(slot):
+        chunk_idx, start, end, mask, span, shard_spans = slot
+        t_dev = time.perf_counter_ns()
+        try:
+            out[start:end] = np.asarray(mask)[: end - start]
+        except DispatchCancelled:
+            for s in shard_spans:
+                s.end(error="cancelled")
+            span.end(error="cancelled")
+            raise
+        except Exception as exc:  # noqa: BLE001 - device died mid-retire
+            for s in shard_spans:
+                s.end(error=repr(exc))
+            span.end(error=repr(exc))
+            raise RuntimeError(
+                f"sharded retire of chunk {chunk_idx} (sigs [{start}:{end}]) "
+                f"failed: {exc}"
+            ) from exc
+        wait = time.perf_counter_ns() - t_dev
+        for s in shard_spans:
+            s.end(device_wait_ns=wait)
+        span.end(device_wait_ns=wait)
+
+    for chunk_idx, start in enumerate(range(0, n, mega)):
+        if cancel is not None and cancel.is_set():
+            raise DispatchCancelled(
+                f"sharded dispatch cancelled before chunk {chunk_idx} "
+                f"(sigs [{start}:{n}] undone)"
+            )
+        end = min(start + mega, n)
+        span = _trace.child_of_current(
+            "sharded_chunk", chunk=chunk_idx, n_sigs=end - start,
+            shards=nsh, generation=plan.generation,
+        )
+        t_host = time.perf_counter_ns()
+        try:
+            if callable(packed):
+                chunk = packed(start, end)
+            else:
+                chunk = [a[..., start:end] for a in packed]
+            # pow2 per-shard bucket; end-start <= per_shard_cap * nsh
+            # and the cap is pow2-derived, so per <= per_shard_cap
+            per = _pow2(-(-(end - start) // nsh), min_pad)
+            size = per * nsh
+            max_bucket = max(max_bucket, per)
+
+            def pad(a):
+                padded = np.zeros(a.shape[:-1] + (size,), a.dtype)
+                padded[..., : end - start] = a
+                return padded
+
+            padded_args = [pad(a) for a in chunk]
+            shardings = tuple(
+                NamedSharding(
+                    plan.mesh, PS(*([None] * (a.ndim - 1) + ["batch"]))
+                )
+                for a in padded_args
+            )
+            placed = [
+                jax.device_put(jnp.asarray(a), s)
+                for a, s in zip(padded_args, shardings)
+            ]
+            shard_spans = []
+            real = end - start
+            for si, h in enumerate(plan.handles):
+                lanes = max(0, min(per, real - si * per))
+                shard_spans.append(
+                    span.child("shard", device=h.label, shard=si,
+                               n_sigs=lanes, pad=per)
+                )
+                if _hub is not None:
+                    _hub.note_chunk(h.label, lanes, per)
+            with plan.mesh:
+                mask = registry.call(
+                    kernel, placed, donate_from=donate_from, sharded=True,
+                    mesh=plan.mesh,
+                )
+        except DispatchCancelled:
+            span.end(error="cancelled")
+            raise
+        except Exception as exc:  # noqa: BLE001 - per-chunk context for triage
+            span.end(error=repr(exc))
+            raise RuntimeError(
+                f"sharded dispatch of chunk {chunk_idx} "
+                f"(sigs [{start}:{end}] over {nsh} shards "
+                f"{plan.labels()}) failed: {exc}"
+            ) from exc
+        span.set_tag("host_ns", time.perf_counter_ns() - t_host)
+        span.set_tag("pad", size)
+        inflight.append((chunk_idx, start, end, mask, span, shard_spans))
+        while len(inflight) > depth:
+            retire(inflight.popleft())
+    while inflight:
+        retire(inflight.popleft())
+    if _plane is not None and n > 0 and max_bucket > 0:
+        # per-device model correction: each shard served max_bucket
+        # lanes of this kernel; best-effort, never fails a dispatch
+        for h in plan.handles:
+            try:
+                _plane.observe_dispatch(
+                    h, _kernel_name, max_bucket,
+                    baseline_in_use=_baselines.get(h.label),
+                )
+            except Exception:  # noqa: BLE001 - observability only
+                pass
+    return out
+
+
 def sharded_verify(kernel, args, donate_from: int = 0):
     """Run a verify kernel with every input's trailing (batch) axis
-    sharded over the mesh. args are numpy arrays (or already-placed jax
-    arrays) whose trailing dim is the (padded) batch — the caller pads
-    to a multiple of the device count × lane tile already.
+    sharded over the FULL mesh. args are numpy arrays (or already-placed
+    jax arrays) whose trailing dim is the (padded) batch — the caller
+    pads to a multiple of the device count × lane tile already.
 
     donate_from: index of the first argument eligible for buffer
     donation. Single-use staging buffers are donated so XLA reuses the
     space instead of holding input + workspace live together (matters
     at the 8k-lane chunks); RESIDENT buffers (the valset pubkey rows
     that live across commits) must come before donate_from or donation
-    would free them after one dispatch."""
+    would free them after one dispatch.
+
+    Same dispatch contract as dispatch_batch: the thread's cancel event
+    is honored (DispatchCancelled before any work is issued), every
+    dispatch emits a trace span, and a batch axis wider than the
+    resolved chunk cap × device count is split into capped sub-dispatches
+    whose masks are concatenated. Megabatch callers should prefer
+    dispatch_sharded, which additionally honors the topology's
+    quarantine set and per-device memory guards; this entry serves
+    pre-placed/resident buffers (verify_valset_resident) against the
+    full mesh."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as PS
 
     from cometbft_tpu.crypto.tpu import aot
 
     mesh = batch_mesh()
-    shardings = tuple(
-        NamedSharding(mesh, PS(*([None] * (a.ndim - 1) + ["batch"])))
-        for a in args
-    )
-    placed = [
-        jax.device_put(jnp.asarray(a), s) for a, s in zip(args, shardings)
-    ]
-    with mesh:
-        return aot.default_registry().call(
-            kernel, placed, donate_from=donate_from, sharded=True
+    ndev = int(mesh.devices.size)
+    batch = int(args[0].shape[-1])
+    # chunk-cap contract: cap × ndev lanes per dispatch, using the
+    # default-device ladder (this entry predates per-domain dispatch)
+    limit = chunk_cap(aot._DEFAULT_CAP, aot._MIN_PAD) * ndev
+    cancel = current_cancel_event()
+    registry = aot.default_registry()
+
+    def one(chunk_args, lanes):
+        if cancel is not None and cancel.is_set():
+            raise DispatchCancelled(
+                f"sharded_verify cancelled ({lanes} lanes undone)"
+            )
+        span = _trace.child_of_current(
+            "sharded_verify", n_lanes=lanes, shards=ndev
         )
+        t_host = time.perf_counter_ns()
+        try:
+            shardings = tuple(
+                NamedSharding(mesh, PS(*([None] * (a.ndim - 1) + ["batch"])))
+                for a in chunk_args
+            )
+            placed = [
+                jax.device_put(jnp.asarray(a), s)
+                for a, s in zip(chunk_args, shardings)
+            ]
+            with mesh:
+                mask = registry.call(
+                    kernel, placed, donate_from=donate_from, sharded=True,
+                    mesh=mesh,
+                )
+        except DispatchCancelled:
+            span.end(error="cancelled")
+            raise
+        except Exception as exc:  # noqa: BLE001 - dispatch context
+            span.end(error=repr(exc))
+            raise
+        span.end(host_ns=time.perf_counter_ns() - t_host)
+        return mask
+
+    if batch <= limit:
+        return one(args, batch)
+    # oversize batch: honor the cap by splitting (limit is a multiple of
+    # ndev, and callers pad to a multiple of ndev, so every sub-chunk
+    # still shards evenly)
+    masks = []
+    for start in range(0, batch, limit):
+        end = min(start + limit, batch)
+        chunk = [a[..., start:end] for a in args]
+        masks.append(np.asarray(one(chunk, end - start)))
+    return np.concatenate(masks)

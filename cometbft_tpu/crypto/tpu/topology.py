@@ -154,6 +154,15 @@ class DeviceTopology:
             raise ValueError("a topology needs at least one device")
         self.devices = list(devices)
         self.kind = kind
+        # quarantine membership + the change generation live on the
+        # TOPOLOGY, not the handle: healthy_devices() must be computed
+        # against one consistent set under one lock, so every thread
+        # slicing a shard plan from the same generation builds the same
+        # mesh (mesh construction from divergent views would hand XLA
+        # two different device orders for "the same" program).
+        self._q_mtx = threading.Lock()
+        self._quarantined: set = set()
+        self._generation = 0
 
     # -- constructors --------------------------------------------------------
 
@@ -204,9 +213,56 @@ class DeviceTopology:
     def reset_runtime_state(self) -> None:
         """Drop every device's runtime (shrink) state — called on
         supervisor stop and on topology change so no incident state
-        leaks into the next lifecycle."""
+        leaks into the next lifecycle. Quarantine state goes with it
+        (the breakers that imposed it are gone), bumping the generation
+        so cached shard plans re-slice."""
         for d in self.devices:
             d.reset_chunk_shrink()
+        with self._q_mtx:
+            if self._quarantined:
+                self._quarantined.clear()
+                self._generation += 1
+
+    # -- quarantine / mesh membership ----------------------------------------
+
+    def set_quarantined(self, index: int, flag: bool = True) -> bool:
+        """Mark device ``index`` quarantined (excluded from the sharded
+        mesh) or readmit it. The supervisor calls this when a domain's
+        breaker trips/closes; the sharded plan cache (mesh.py) re-slices
+        on the generation bump. → True when membership actually changed
+        on this call."""
+        index = int(index)
+        with self._q_mtx:
+            if flag:
+                if index in self._quarantined:
+                    return False
+                self._quarantined.add(index)
+            else:
+                if index not in self._quarantined:
+                    return False
+                self._quarantined.discard(index)
+            self._generation += 1
+            return True
+
+    def is_quarantined(self, index: int) -> bool:
+        with self._q_mtx:
+            return int(index) in self._quarantined
+
+    def healthy_devices(self) -> List[DeviceHandle]:
+        """The non-quarantined devices in STABLE index order — the mesh
+        construction order. Deterministic by design: two threads that
+        observe the same generation() get the same list, so re-slicing
+        under quarantine yields the same sub-mesh everywhere."""
+        with self._q_mtx:
+            quarantined = set(self._quarantined)
+        return [d for d in self.devices if d.index not in quarantined]
+
+    def generation(self) -> int:
+        """Topology-change counter: bumps on every quarantine membership
+        change (and on reset clearing a non-empty set). Cached shard
+        plans key on this and re-slice when it moves."""
+        with self._q_mtx:
+            return self._generation
 
     def snapshot(self) -> dict:
         """JSON-ready layout + runtime state for the capacity plane
@@ -215,6 +271,7 @@ class DeviceTopology:
         return {
             "kind": self.kind,
             "n_devices": len(self.devices),
+            "generation": self.generation(),
             "devices": [
                 {
                     "label": d.label,
@@ -222,6 +279,7 @@ class DeviceTopology:
                     "shrink_levels": d.chunk_shrink_levels(),
                     "capacity_fraction": d.capacity_fraction(),
                     "memory_guard_cap": d.memory_guard_cap(),
+                    "quarantined": self.is_quarantined(d.index),
                 }
                 for d in self.devices
             ],

@@ -366,6 +366,7 @@ class Node(BaseService):
             max_queue=config.crypto.max_queue,
             tracer=self.tracer,
             telemetry=self.telemetry_hub,
+            shard_min_batch=config.crypto.shard_min_batch,
         )
         self.telemetry_hub.register_source(
             "scheduler", self.verify_scheduler.queue_snapshot

@@ -1,6 +1,6 @@
 """Standalone chaos harness against the supervised verify plane.
 
-Four modes:
+Five modes:
 
 * default (smoke) — crypto/faults.py run_chaos_smoke: a fast,
   deterministic walk of every degradation-ladder rung (transient retry,
@@ -17,6 +17,17 @@ Four modes:
   canary. Deterministic under --seed. Runs on the virtual CPU mesh, so
   it needs no hardware (tier-1 CI runs it via
   XLA_FLAGS=--xla_force_host_platform_device_count).
+
+* --sharded — crypto/faults.py run_chaos_sharded: the sharded-mesh
+  degradation rung. Megabatches route as ONE multi-device sharded
+  program over an N-domain mesh (routing mode "sharded"); device K is
+  then killed mid-flow with a program-fatal injected failure. Asserts
+  ground-truth verdicts with zero wrong answers, attribution of the
+  failure to the offending domain (exactly K quarantined, topology
+  mirror set, shard plan re-sliced to N-1 for the in-flight retry),
+  degraded sharded throughput ≥ 0.6 × (N-1)/N of the full-mesh rate,
+  and re-slice back to N after K's canary re-admits it. Needs N
+  visible jax devices — exported via XLA_FLAGS automatically.
 
 * --memory-guard — crypto/faults.py run_chaos_memory_guard: the
   proactive-vs-reactive OOM proof. An allocator-modeled OOM fault
@@ -87,6 +98,14 @@ def main() -> int:
     ap.add_argument("--kill", type=int, default=2,
                     help="[multi-device] fault-domain index to inject "
                          "(default 2)")
+    ap.add_argument("--sharded", action="store_true",
+                    help="run the sharded-mesh rung: kill one domain "
+                         "mid-sharded-megabatch-flow and assert "
+                         "attribution, re-slice, and the degraded "
+                         "throughput bound (uses --devices/--kill)")
+    ap.add_argument("--rounds", type=int, default=4,
+                    help="[sharded] timed megabatch rounds per "
+                         "throughput phase (default 4)")
     ap.add_argument("--memory-guard", action="store_true",
                     help="run the proactive-vs-reactive OOM rung "
                          "(memory plane pre-dispatch guard)")
@@ -148,6 +167,46 @@ def main() -> int:
             and summary["state_final"] == summary["expected"]["state_final"]
         )
         print("CHAOS MEMORY-GUARD", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+
+    if args.sharded:
+        # the sharded program genuinely shards over N jax devices, so
+        # the virtual device plane is required even for --inner cpu;
+        # must land in the env before anything imports jax
+        devices = args.devices if args.devices > 1 else 8
+        os.environ.setdefault(
+            "XLA_FLAGS",
+            f"--xla_force_host_platform_device_count={devices}",
+        )
+        from cometbft_tpu.crypto.faults import run_chaos_sharded
+
+        summary = run_chaos_sharded(
+            devices=devices, kill=args.kill, seed=args.seed,
+            inner=args.inner, rounds=args.rounds,
+        )
+        print(json.dumps(summary, indent=2))
+        killed = f"dev{args.kill}"
+        # run_chaos_sharded asserts the invariants inline; re-check the
+        # headline ones so --sharded reads like the other rungs
+        ok = (
+            summary["wrong_verdicts"] == 0
+            and summary["cpu_routed"] == 0
+            and set(summary["quarantines"]) == {killed}
+            and summary["quarantined_only_kill"]
+            and summary["topology_mirrored_quarantine"]
+            and summary["sharded_reslices"] >= 1
+            and summary["resliced_shards"] == devices - 1
+            and summary["throughput_ok"]
+            and summary["degraded_rate_sigs_s"]
+            >= summary["throughput_bound_sigs_s"]
+            and summary["readmit_probe_ok"]
+            and summary["restored_shards"] == devices
+            and all(
+                s == summary["expected"]["final_state"]
+                for s in summary["final_states"].values()
+            )
+        )
+        print("CHAOS SHARDED", "PASS" if ok else "FAIL")
         return 0 if ok else 1
 
     if args.devices > 1:

@@ -120,6 +120,15 @@ def render(snap: Dict[str, Any]) -> str:
             f"/{sched.get('lane_budget', '-')}  "
             f"dispatches={sched.get('dispatches', '-')}"
         )
+        routes = sched.get("routes")
+        if isinstance(routes, dict):
+            total = sum(routes.values()) or 1
+            out.append(
+                "routing  " + "  ".join(
+                    f"{r}={routes.get(r, 0)} ({routes.get(r, 0) * 100 // total}%)"
+                    for r in ("cpu", "single", "sharded")
+                )
+            )
     fill = snap.get("lane_fill", {})
     if fill.get("padded_lanes"):
         out.append(
