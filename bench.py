@@ -944,6 +944,41 @@ def _stage_degraded():
     print(json.dumps(out), flush=True)
 
 
+
+def _stage_overload():
+    """QoS overload numbers (crypto/qos, crypto/scheduler admission
+    layer): the chaos overload rung's latency picture as bench evidence
+    — unloaded vs loaded consensus p99 with the class ladder on, the
+    same flood's consensus p99 with CBFT_QOS_CLASSES=off, and the
+    shed/drop/brownout counters. The headline booleans (latency bound
+    held, floods shed, brownout tripped and re-admitted, FIFO starved)
+    ride along so the history ledger records pass/fail, not just
+    milliseconds."""
+    _maybe_force_cpu()
+    _set_cache()
+    from cometbft_tpu.crypto.faults import run_chaos_overload
+
+    s = run_chaos_overload(seed=int(os.environ.get("CBFT_BENCH_SEED", "17")))
+    out = {
+        "unloaded_p99_ms": s["unloaded_p99_ms"],
+        "loaded_p99_ms": s["loaded_p99_ms"],
+        "latency_bound_ms": s["latency_bound_ms"],
+        "latency_ok": s["latency_ok"],
+        "qos_off_p99_ms": s["qos_off_p99_ms"],
+        "starvation_ratio": s["starvation_ratio"],
+        "starved_without_qos": s["starved_without_qos"],
+        "flood_sheds": s["flood_sheds"],
+        "flood_drops": s["flood_drops"],
+        "consensus_sheds": s["consensus_sheds"],
+        "consensus_drops": s["consensus_drops"],
+        "brownout_trips": s["brownout"]["trips"],
+        "brownout_readmissions": s["brownout"]["readmissions"],
+        "readmitted": s["readmitted"],
+        "wrong_verdicts": s["wrong_verdicts"],
+    }
+    print(json.dumps(out), flush=True)
+
+
 _COLDBOOT_SCRIPT = r"""
 import json, time
 t0 = time.perf_counter()
@@ -1217,6 +1252,14 @@ def main():
     if parsed is not None:
         _append_history(parsed, stage="degraded")
 
+    # QoS overload numbers: consensus p99 through the flood (ladder on
+    # vs CBFT_QOS_CLASSES=off), shed/drop/brownout counters —
+    # platform-neutral (CPU-inner faulty backend)
+    parsed, diag = _run_stage("overload", _STAGE_ENV_CPU, 300)
+    stages["overload"] = parsed if parsed is not None else diag
+    if parsed is not None:
+        _append_history(parsed, stage="overload")
+
     # tracing overhead budget (<3% on the scheduler stage) + per-stage
     # dispatch breakdown — platform-neutral, so it always runs
     parsed, diag = _run_stage("trace", _STAGE_ENV_CPU, 300)
@@ -1306,6 +1349,7 @@ if __name__ == "__main__":
             "scheduler": _stage_scheduler,
             "supervisor": _stage_supervisor,
             "degraded": _stage_degraded,
+            "overload": _stage_overload,
             "sharded": _stage_sharded,
             "trace": _stage_trace,
             "coldboot": _stage_coldboot,

@@ -454,7 +454,9 @@ class BlocksyncReactor(Reactor):
                 flat.extend(bool(full[idx]) for idx, _ in entries)
             else:
                 return flat
-        bv = cryptobatch.new_batch_verifier(self.crypto_backend)
+        bv = cryptobatch.new_batch_verifier(
+            self.crypto_backend, subsystem="blocksync"
+        )
         for entries, (lane_msgs, lane_sigs) in zip(per_block, lanes_per_block):
             for idx, val in entries:
                 bv.add(val.pub_key, lane_msgs[idx], lane_sigs[idx])

@@ -334,6 +334,18 @@ class CryptoConfig:
     # "eager" blocks node start until warm; "off" disables. CBFT_WARM_BOOT
     # env wins; CBFT_TPU_WARMUP=0 (legacy kill switch) still forces off.
     warm_boot: str = "background"
+    # QoS admission control for the verification scheduler
+    # (crypto/qos.py): "default" = the built-in priority ladder
+    # (consensus > evidence > blocksync > light > mempool, each with its
+    # own overload policy), "off" = the legacy single FIFO, or an
+    # explicit comma-separated "name[:policy[:max_queue[:weight]]]"
+    # spec whose order is the priority order. CBFT_QOS_CLASSES env wins.
+    qos_classes: str = "default"
+    # Per-tenant token-bucket quota (signatures/sec refill; burst = 2×)
+    # keyed by the subsystem origin tag. 0 = quotas off. Block-policy
+    # classes are never throttled — over-quota submits there are only
+    # counted. CBFT_QOS_TENANT_RATE env wins.
+    qos_tenant_rate: int = 0
 
 
 @dataclass
@@ -398,6 +410,20 @@ class Config:
             raise ValueError(
                 "crypto.shard_min_batch must be a non-negative integer, "
                 f"got {smb!r}"
+            )
+        # qos_classes is load-bearing the moment overload hits: reject
+        # unknown class names / policies / non-positive bounds at
+        # startup, not at the first flood. The parser raises ValueError
+        # in the same crypto.<knob> style as the checks above.
+        from cometbft_tpu.crypto import qos as qoslib
+
+        qoslib.parse_qos_classes(self.crypto.qos_classes)
+        qtr = self.crypto.qos_tenant_rate
+        if not isinstance(qtr, int) or isinstance(qtr, bool) or qtr < 0:
+            # 0 is a valid value: per-tenant quotas disabled
+            raise ValueError(
+                "crypto.qos_tenant_rate must be a non-negative integer, "
+                f"got {qtr!r}"
             )
         wb = self.crypto.warm_boot
         if wb not in ("eager", "background", "off"):

@@ -428,7 +428,9 @@ class ConsensusState(BaseService):
                 entries.append((vote, vs.chain_id, val.pub_key))
         if len(entries) < 2:
             return  # nothing to batch; serial path handles singletons
-        bv = cryptobatch.new_batch_verifier(self.crypto_backend)
+        bv = cryptobatch.new_batch_verifier(
+            self.crypto_backend, subsystem="consensus"
+        )
         for vote, chain_id, pub_key in entries:
             bv.add(pub_key, vote.sign_bytes(chain_id), vote.signature)
         self.n_batch_verify_calls += 1

@@ -427,8 +427,12 @@ class ScheduledBatchVerifier(BatchVerifier):
     Existing call sites get coalescing without code changes the moment
     the node threads its scheduler where the BackendSpec used to go."""
 
-    def __init__(self, scheduler):
+    def __init__(self, scheduler, subsystem: Optional[str] = None):
         self._scheduler = scheduler
+        # origin tag: resolves the QoS class and the RED-metering tenant
+        # for everything this verifier submits (None = untagged, which
+        # maps to the top class — never shed by default)
+        self._subsystem = subsystem
         self._items: List[Tuple[PubKey, bytes, bytes]] = []
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
@@ -443,12 +447,16 @@ class ScheduledBatchVerifier(BatchVerifier):
         items, self._items = self._items, []
         if not items:
             return False, []
-        return self._scheduler.submit(items).result()
+        return self._scheduler.submit(
+            items, subsystem=self._subsystem
+        ).result()
 
 
-def new_batch_verifier(backend: Backend = None) -> BatchVerifier:
+def new_batch_verifier(
+    backend: Backend = None, subsystem: Optional[str] = None
+) -> BatchVerifier:
     if hasattr(backend, "submit") and hasattr(backend, "spec"):
-        return ScheduledBatchVerifier(backend)
+        return ScheduledBatchVerifier(backend, subsystem=subsystem)
     if hasattr(backend, "verify_items") and hasattr(backend, "spec"):
         # a bare BackendSupervisor (no scheduler in front): dispatches
         # still get the watchdog / breaker / audit treatment

@@ -1230,3 +1230,283 @@ def run_chaos_sharded(
     )
     assert all(s == HEALTHY for s in final_states.values()), final_states
     return summary
+
+
+def _p99_ms(samples_s: List[float]) -> float:
+    """p99 of a latency sample list, in milliseconds (0.0 when empty)."""
+    if not samples_s:
+        return 0.0
+    xs = sorted(samples_s)
+    idx = min(len(xs) - 1, int(round(0.99 * (len(xs) - 1))))
+    return xs[idx] * 1e3
+
+
+def run_chaos_overload(
+    seed: int = 17,
+    inner: cryptobatch.Backend = "cpu",
+    logger=None,
+    flood_s: float = 1.5,
+) -> dict:
+    """The QoS overload rung: a steady consensus workload rides through a
+    10x blocksync+mempool flood without starving, because the admission
+    layer sheds/drops the floods and the brownout controller browns the
+    low classes out — and the SAME flood with ``CBFT_QOS_CLASSES=off``
+    demonstrably starves consensus (the contrast is what proves the
+    mechanism is load-bearing, not the workload being easy).
+
+    Phase A (QoS on, default ladder): measure unloaded consensus p99,
+    then flood blocksync+mempool for ``flood_s`` while a consensus
+    submitter keeps a steady cadence. Assertable outcomes collected in
+    the summary: zero consensus sheds/drops/backpressure-timeouts, flood
+    sheds >= 1 and drops >= 1, brownout trips >= 1, loaded consensus p99
+    within 2x of max(unloaded p99, one dispatch quantum), full brownout
+    re-admission once the flood stops (readmissions >= 1, disabled
+    empty), and ground-truth verdicts on every non-rejected future.
+
+    Phase B (QoS off, same flood): consensus p99 must come out >= 2x the
+    phase-A loaded p99 — FIFO starvation the QoS layer prevented.
+
+    Returns a summary dict; callers (the tier-1 overload test,
+    ``tools/chaos.py --overload``) assert on it.
+    """
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+    from cometbft_tpu.crypto.telemetry import TelemetryHub
+
+    name = f"chaos-overload-{seed}"
+    # jitter-dominated dispatch cost: 0-20 ms per flush makes the
+    # queueing dynamics (and therefore the latency contrast between the
+    # two phases) mostly independent of how fast the host CPU verifies
+    install(name=name, inner=inner, plan=FaultPlan(seed=seed, jitter_ms=20))
+
+    keys = [
+        ed.gen_priv_key_from_secret(b"chaos-overload-%d" % i)
+        for i in range(8)
+    ]
+
+    def make_items(count, tag):
+        items = []
+        for i in range(count):
+            k = keys[i % len(keys)]
+            msg = b"overload %s %d" % (tag, i)
+            items.append((k.pub_key(), msg, k.sign(msg)))
+        return items
+
+    CONSENSUS_N = 8
+    FLOOD_N = 32
+    SLO_TARGET_MS = 30
+    # one flood-heavy dispatch quantum (injected jitter + a budget's
+    # worth of verification): loaded consensus latency is ~2 quanta (the
+    # in-flight flush, then its own), so a bound below 2x this floor
+    # would fail on timing noise, not on starvation
+    DISPATCH_FLOOR_MS = 40.0
+
+    consensus_items = make_items(CONSENSUS_N, b"consensus")
+    flood_items = {
+        "blocksync": make_items(FLOOD_N, b"blocksync"),
+        "mempool": make_items(FLOOD_N, b"mempool"),
+    }
+
+    def run_phase(qos_mode: str) -> dict:
+        """One full unloaded->flood->drain cycle under ``qos_mode``."""
+        env_save = {
+            k: os.environ.get(k)
+            for k in ("CBFT_QOS_CLASSES", "CBFT_QOS_SHED_MS")
+        }
+        os.environ["CBFT_QOS_CLASSES"] = qos_mode
+        # tight shed deadline: the rung wants deadline sheds to actually
+        # fire within a sub-2s flood, not only post-brownout fast-sheds
+        os.environ["CBFT_QOS_SHED_MS"] = "5"
+        hub = TelemetryHub(slo_target_ms=SLO_TARGET_MS, window_s=1.5)
+        try:
+            sched = VerifyScheduler(
+                spec=BackendSpec(name),
+                flush_us=200,
+                lane_budget=64,
+                max_queue=128,
+                telemetry=hub,
+                submit_timeout_ms=250,
+                logger=logger,
+            )
+        finally:
+            for k, v in env_save.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if sched.qos_enabled:
+            hub.add_burn_watcher(sched.on_burn)
+        sched.start()
+
+        wrong = 0
+        rejected = 0
+        flood_futs: List[Tuple[str, object]] = []
+        stop_flood = threading.Event()
+        stop_scrape = threading.Event()
+
+        def scraper():
+            # the node's metrics scrape loop: each snapshot recomputes
+            # SLO burn and feeds the brownout controller via the watcher
+            while not stop_scrape.is_set():
+                hub.snapshot()
+                time.sleep(0.05)
+
+        def flood(sub):
+            while not stop_flood.is_set():
+                fut = sched.submit(flood_items[sub], subsystem=sub)
+                flood_futs.append((sub, fut))
+                time.sleep(0.002)
+
+        scrape_t = threading.Thread(target=scraper, daemon=True)
+        scrape_t.start()
+        try:
+            # -- warmup: the first dispatch pays one-time backend setup
+            # (jit/compile on the CPU path) — keep it out of the baseline
+            sched.submit(
+                consensus_items, subsystem="consensus"
+            ).result(timeout=60)
+
+            # -- unloaded baseline ----------------------------------------
+            unloaded = []
+            for _ in range(30):
+                t0 = time.monotonic()
+                ok, mask = sched.submit(
+                    consensus_items, subsystem="consensus"
+                ).result(timeout=30)
+                unloaded.append(time.monotonic() - t0)
+                if not ok or mask != [True] * CONSENSUS_N:
+                    wrong += 1
+                time.sleep(0.002)
+
+            # -- flood ----------------------------------------------------
+            flood_threads = [
+                threading.Thread(target=flood, args=(sub,), daemon=True)
+                for sub in ("blocksync", "blocksync", "mempool", "mempool")
+            ]
+            for t in flood_threads:
+                t.start()
+            loaded = []
+            t_end = time.monotonic() + flood_s
+            while time.monotonic() < t_end:
+                t0 = time.monotonic()
+                ok, mask = sched.submit(
+                    consensus_items, subsystem="consensus"
+                ).result(timeout=30)
+                loaded.append(time.monotonic() - t0)
+                if not ok or mask != [True] * CONSENSUS_N:
+                    wrong += 1
+                time.sleep(0.005)
+            stop_flood.set()
+            for t in flood_threads:
+                t.join(timeout=30)
+
+            # -- drain: every flood future resolves, verdicts ground-truth
+            for sub, fut in flood_futs:
+                ok, mask = fut.result(timeout=30)
+                if getattr(fut, "rejected", False):
+                    rejected += 1
+                    if ok or any(mask):
+                        wrong += 1  # a drop must never claim validity
+                elif not ok or mask != [True] * FLOOD_N:
+                    wrong += 1
+
+            # -- recovery: flood latencies age out of the SLO window, burn
+            # clears, the brownout ladder re-admits bottom-up
+            readmitted = True
+            if sched.qos_enabled:
+                readmitted = False
+                deadline = time.monotonic() + 12.0
+                while time.monotonic() < deadline:
+                    bo = sched.queue_snapshot()["qos"]["brownout"]
+                    if not bo["disabled"] and bo["readmissions"] >= 1:
+                        readmitted = True
+                        break
+                    time.sleep(0.2)
+            snap = sched.queue_snapshot()
+            bp_timeouts = sched.metrics.backpressure_timeouts.value()
+        finally:
+            stop_flood.set()
+            stop_scrape.set()
+            scrape_t.join(timeout=10)
+            sched.stop()
+
+        out = {
+            "backpressure_timeouts": bp_timeouts,
+            "qos_mode": qos_mode,
+            "unloaded_p99_ms": round(_p99_ms(unloaded), 2),
+            "loaded_p99_ms": round(_p99_ms(loaded), 2),
+            "consensus_samples": len(loaded),
+            "flood_requests": len(flood_futs),
+            "wrong_verdicts": wrong,
+            "rejected": rejected,
+            "readmitted": readmitted,
+            "snapshot": snap,
+        }
+        if snap["qos"]["enabled"]:
+            cls = snap["qos"]["classes"]
+            out["consensus_sheds"] = cls["consensus"]["sheds"]
+            out["consensus_drops"] = cls["consensus"]["drops"]
+            out["flood_sheds"] = sum(
+                cls[c]["sheds"] for c in ("blocksync", "mempool")
+            )
+            out["flood_drops"] = sum(
+                cls[c]["drops"] for c in ("blocksync", "mempool")
+            )
+            out["brownout"] = snap["qos"]["brownout"]
+        return out
+
+    phase_a = run_phase("default")
+    phase_b = run_phase("off")
+
+    latency_bound_ms = 2.0 * max(
+        phase_a["unloaded_p99_ms"], DISPATCH_FLOOR_MS
+    )
+    latency_ok = phase_a["loaded_p99_ms"] <= latency_bound_ms
+    starvation_ratio = (
+        phase_b["loaded_p99_ms"] / phase_a["loaded_p99_ms"]
+        if phase_a["loaded_p99_ms"] > 0
+        else float("inf")
+    )
+    # same bound, both directions: QoS keeps loaded consensus p99 inside
+    # it, and the identical flood through a FIFO scheduler blows it
+    starved_without_qos = phase_b["loaded_p99_ms"] > latency_bound_ms
+
+    summary = {
+        "seed": seed,
+        "flood_s": flood_s,
+        "wrong_verdicts": phase_a["wrong_verdicts"] + phase_b["wrong_verdicts"],
+        "unloaded_p99_ms": phase_a["unloaded_p99_ms"],
+        "loaded_p99_ms": phase_a["loaded_p99_ms"],
+        "latency_bound_ms": round(latency_bound_ms, 2),
+        "latency_ok": latency_ok,
+        "consensus_sheds": phase_a["consensus_sheds"],
+        "consensus_drops": phase_a["consensus_drops"],
+        # in phase A only block-policy classes (consensus/evidence) can
+        # hit the backpressure timeout -> inline-CPU path, so this total
+        # IS the consensus timeout count
+        "consensus_backpressure_timeouts": phase_a["backpressure_timeouts"],
+        "flood_sheds": phase_a["flood_sheds"],
+        "flood_drops": phase_a["flood_drops"],
+        "rejected": phase_a["rejected"],
+        "brownout": phase_a["brownout"],
+        "readmitted": phase_a["readmitted"],
+        "qos_off_p99_ms": phase_b["loaded_p99_ms"],
+        "starvation_ratio": round(starvation_ratio, 2),
+        "starved_without_qos": starved_without_qos,
+        "flush_reasons": phase_a["snapshot"]["flush_reasons"],
+        "expected": {
+            "wrong_verdicts": 0,
+            "consensus_sheds": 0,
+            "consensus_drops": 0,
+            "consensus_backpressure_timeouts": 0,
+            "flood_sheds": ">= 1",
+            "flood_drops": ">= 1",
+            "brownout_trips": ">= 1",
+            "readmitted": True,
+            "latency": "loaded p99 <= 2x max(unloaded p99, %.0fms)"
+            % DISPATCH_FLOOR_MS,
+            "starvation": "qos-off p99 above the same bound",
+        },
+    }
+    return summary

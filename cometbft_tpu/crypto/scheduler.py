@@ -1,5 +1,6 @@
 """Node-wide verification scheduler — cross-subsystem micro-batch
-coalescing with deadline flush and future-based results.
+coalescing with deadline flush, future-based results, and QoS
+admission control.
 
 PR 1 made a *single* dispatch fast (double-buffered chunks, resident
 valsets, measured routing), but every call site — consensus vote-drain
@@ -28,6 +29,27 @@ COALESCED size by construction: the dispatch builds one backend verifier
 over all coalesced items, whose per-curve thresholds see the total
 count. Small concurrent batches now clear the floor together.
 
+QoS admission control (crypto/qos.py) replaces the single FIFO with
+per-priority-class lanes (``consensus`` > ``evidence`` > ``blocksync``
+> ``light`` > ``mempool``; class resolved from the request's
+``subsystem`` origin tag, configured via ``[crypto] qos_classes`` /
+env ``CBFT_QOS_CLASSES``, ``off`` = the legacy single FIFO). Flush
+assembly serves the top class strictly first, then shares the
+remaining lane budget across the lower classes by weighted deficit
+round-robin — low classes make progress but can never displace votes.
+Each class carries its own queue bound and overload policy: block
+(bounded backpressure — consensus/evidence), shed (wait out a short
+deadline, then verify inline on the submitter's CPU — blocksync/
+light), or drop (complete immediately with a ``rejected`` verdict —
+mempool; callers re-verify on CPU). Per-tenant token buckets
+(``[crypto] qos_tenant_rate``) stop one tenant from monopolizing a
+class, and a brownout controller — fed by the telemetry hub's SLO burn
+watcher and the supervisor's aggregate state — progressively disables
+the sheddable classes (mempool first) under overload and re-admits
+them hysteretically. Every shed/drop/backpressure-CPU verdict is
+RED-metered under its tenant tag so overload shows up in
+/debug/verify instead of hiding from it.
+
 Integration: the scheduler is accepted anywhere a backend name /
 BackendSpec travels (crypto/batch.py ``Backend``) — ``new_batch_verifier``
 returns a thin adapter whose ``verify()`` submits to the scheduler, so
@@ -45,25 +67,29 @@ corruption audit included — and an open breaker short-circuits the
 deadline wait (there is nothing to coalesce FOR when every dispatch is
 CPU-routed anyway, so pending requests flush immediately).
 
-``submit()`` is bounded: past ``[crypto] max_queue`` pending signatures
-(env ``CBFT_MAX_QUEUE``) it blocks with a deadline instead of growing
-without limit while the device plane stalls; a submitter that exhausts
-the deadline gets its items verified inline on the CPU ground truth, so
+``submit()`` is bounded: past the class's queue bound (default
+``[crypto] max_queue`` pending signatures, env ``CBFT_MAX_QUEUE``) a
+block-policy submit blocks with a deadline instead of growing without
+limit while the device plane stalls; a submitter that exhausts the
+deadline gets its items verified inline on the CPU ground truth, so
 memory stays bounded and no future is ever lost. ``stop()`` drains:
 queued requests are dispatched (not abandoned) before the worker exits —
-and if the worker cannot be joined (wedged inside a dispatch), the
-pending futures are FAILED loudly rather than leaving callers blocked.
+a submit that races stop past the final drain sweep is dispatched
+inline by the submitting thread itself — and if the worker cannot be
+joined (wedged inside a dispatch), the pending futures are FAILED
+loudly rather than leaving callers blocked.
 """
 
 from __future__ import annotations
 
+import collections
 import os
 import sys
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
-from cometbft_tpu.crypto import PubKey
+from cometbft_tpu.crypto import PubKey, qos as qoslib
 from cometbft_tpu.crypto.batch import (
     Backend,
     BackendSpec,
@@ -80,6 +106,10 @@ DEFAULT_MAX_QUEUE = 65_536
 DEFAULT_SUBMIT_TIMEOUT_MS = 5_000
 DEFAULT_SHARD_MIN_BATCH = 4096
 SUBSYSTEM = "verify_scheduler"
+
+# the single lane the scheduler degrades to when QoS is off
+_FIFO = "fifo"
+_FLUSH_REASONS = ("size", "deadline", "explicit", "drain", "broken")
 
 Item = Tuple[PubKey, bytes, bytes]
 
@@ -105,6 +135,17 @@ def max_queue_default(config_max_queue: Optional[int] = None) -> int:
     if config_max_queue is not None:
         return config_max_queue
     return DEFAULT_MAX_QUEUE
+
+
+def submit_timeout_default(config_timeout_ms: Optional[int] = None) -> int:
+    """Backpressure deadline (ms) a block-policy submit waits for queue
+    room: CBFT_SUBMIT_TIMEOUT_MS env > configured > built-in 5000."""
+    raw = os.environ.get("CBFT_SUBMIT_TIMEOUT_MS")
+    if raw is not None:
+        return int(raw)
+    if config_timeout_ms is not None:
+        return int(config_timeout_ms)
+    return DEFAULT_SUBMIT_TIMEOUT_MS
 
 
 def shard_min_batch_default(config_value: Optional[int] = None) -> int:
@@ -142,7 +183,7 @@ class Metrics:
         self.flushes = r.counter(
             SUBSYSTEM, "flushes",
             "Coalesced dispatches, by flush trigger (size|deadline|"
-            "explicit|drain).",
+            "explicit|drain|broken).",
         )
         self.queue_depth = r.gauge(
             SUBSYSTEM, "queue_depth",
@@ -170,8 +211,8 @@ class Metrics:
         )
         self.backpressure_waits = r.counter(
             SUBSYSTEM, "backpressure_waits",
-            "submit() calls that blocked because the pending queue was "
-            "at [crypto] max_queue signatures.",
+            "submit() calls that blocked because their lane was at its "
+            "queue bound.",
         )
         self.backpressure_timeouts = r.counter(
             SUBSYSTEM, "backpressure_timeouts",
@@ -188,13 +229,19 @@ class VerifyFuture:
     """Result handle for one submitted request. ``result()`` blocks until
     the request's flush lands and returns ``(all_ok, per_item_mask)`` —
     the same contract as BatchVerifier.verify(), sliced to this request
-    only (another caller's bad signature is invisible here)."""
+    only (another caller's bad signature is invisible here).
+
+    ``rejected`` distinguishes a QoS drop (the mempool class's
+    best-effort overload policy completed the future with an all-False
+    mask WITHOUT verifying) from a genuine bad-signature verdict:
+    callers that see it re-verify on their own CPU."""
 
     def __init__(self):
         self._ev = threading.Event()
         self._mtx = threading.Lock()
         self._result: Optional[Tuple[bool, List[bool]]] = None
         self._exc: Optional[BaseException] = None
+        self.rejected = False
 
     def done(self) -> bool:
         return self._ev.is_set()
@@ -230,7 +277,7 @@ class VerifyFuture:
 
 class _Request:
     __slots__ = ("items", "future", "t_submit", "span", "subsystem",
-                 "height")
+                 "height", "qclass")
 
     def __init__(
         self,
@@ -238,6 +285,7 @@ class _Request:
         span=tracelib.NOOP_SPAN,
         subsystem: Optional[str] = None,
         height: Optional[int] = None,
+        qclass: str = _FIFO,
     ):
         self.items = items
         self.future = VerifyFuture()
@@ -250,6 +298,35 @@ class _Request:
         # the request that submitted it
         self.subsystem = subsystem
         self.height = height
+        # the priority class the subsystem tag resolved to
+        self.qclass = qclass
+
+
+class _Lane:
+    """One priority class's admission queue and its running counters
+    (mirrored into queue_snapshot so /debug/verify needs no metric
+    series iteration)."""
+
+    __slots__ = ("spec", "bound", "reqs", "pending_sigs", "deficit",
+                 "admits", "sheds", "drops", "quota_rejections",
+                 "g_depth", "g_pending")
+
+    def __init__(self, spec: qoslib.ClassSpec, bound: int, qos_metrics):
+        self.spec = spec
+        self.bound = bound
+        self.reqs: Deque[_Request] = collections.deque()
+        self.pending_sigs = 0
+        # weighted-deficit round-robin credit, carried across flushes
+        # while the lane stays backlogged
+        self.deficit = 0
+        self.admits = 0
+        self.sheds = 0
+        self.drops = 0
+        self.quota_rejections = 0
+        self.g_depth = qos_metrics.depth.with_labels(qclass=spec.name)
+        self.g_pending = qos_metrics.pending_sigs.with_labels(
+            qclass=spec.name
+        )
 
 
 class VerifyScheduler(BaseService):
@@ -282,6 +359,10 @@ class VerifyScheduler(BaseService):
         tracer: Optional[tracelib.Tracer] = None,
         telemetry=None,
         shard_min_batch: Optional[int] = None,
+        qos: Optional[str] = None,
+        qos_metrics: Optional[qoslib.QoSMetrics] = None,
+        tenant_rate: Optional[int] = None,
+        submit_timeout_ms: Optional[int] = None,
     ):
         super().__init__("VerifyScheduler", logger)
         if isinstance(spec, BackendSpec):
@@ -308,22 +389,64 @@ class VerifyScheduler(BaseService):
         # wires one: every demuxed request is then RED-metered under its
         # origin tag and feeds the SLO engine. None = zero cost.
         self._telemetry = telemetry
-        self._submit_timeout_s = int(
-            os.environ.get(
-                "CBFT_SUBMIT_TIMEOUT_MS", str(DEFAULT_SUBMIT_TIMEOUT_MS)
-            )
+        self._submit_timeout_s = submit_timeout_default(
+            submit_timeout_ms
         ) / 1e3
         self._join_timeout_s = join_timeout_s
 
+        # -- QoS admission control (crypto/qos.py) -------------------------
+        # env CBFT_QOS_CLASSES > constructor/config > built-in ladder;
+        # "off" = the legacy single FIFO (one block-policy lane bounded
+        # at max_queue — bit-identical to the pre-QoS scheduler).
+        specs = qoslib.parse_qos_classes(qoslib.qos_classes_default(qos))
+        self._qos_enabled = specs is not None
+        self.qos_metrics = (
+            qos_metrics if qos_metrics is not None else qoslib.QoSMetrics.nop()
+        )
+        if specs is None:
+            specs = [qoslib.ClassSpec(
+                name=_FIFO, policy=qoslib.POLICY_BLOCK,
+                max_queue=None, weight=1,
+            )]
+        self._lanes: "collections.OrderedDict[str, _Lane]" = (
+            collections.OrderedDict()
+        )
+        for s in specs:
+            bound = s.max_queue if s.max_queue is not None else self._max_queue
+            self._lanes[s.name] = _Lane(s, max(1, bound), self.qos_metrics)
+        self._class_names = tuple(self._lanes.keys())
+        self._quotas = qoslib.TenantQuotas(
+            qoslib.tenant_rate_default(tenant_rate)
+        )
+        self.brownout: Optional[qoslib.BrownoutController] = None
+        if self._qos_enabled:
+            # disable order: lowest priority first; block-policy classes
+            # are exactly who brownout protects, so they are never in
+            # the ladder
+            ladder = [
+                s.name for s in reversed(specs)
+                if s.policy != qoslib.POLICY_BLOCK
+            ]
+            self.brownout = qoslib.BrownoutController(
+                ladder, on_change=self._on_brownout_change
+            )
+
         self._cond = threading.Condition()
-        self._requests: List[_Request] = []
         self._inflight: List[_Request] = []
         self._pending_lanes = 0
         self._flush_asked = False
         self._draining = False
+        # flipped (under _cond) by on_stop immediately before the
+        # leftover sweep: any submit that lost the race dispatches
+        # inline on its own thread instead of appending to a queue
+        # nobody will ever drain again
+        self._accepting = True
         self._worker: Optional[threading.Thread] = None
         # observability for tests/bench: coalesced dispatches performed
         self.n_dispatches = 0
+        self._flush_reasons: Dict[str, int] = {
+            r: 0 for r in _FLUSH_REASONS
+        }
         # three-way routing ladder (CPU / single-chip / sharded mesh):
         # the [crypto] shard_min_batch config (0 = auto) is resolved
         # lazily against the calibration table on the first supervised
@@ -351,6 +474,10 @@ class VerifyScheduler(BaseService):
         return self._supervisor
 
     @property
+    def qos_enabled(self) -> bool:
+        return self._qos_enabled
+
+    @property
     def shard_min_batch(self) -> int:
         """The resolved sharded-routing floor (resolves lazily so a
         calibration recorded after construction is still honored)."""
@@ -362,18 +489,53 @@ class VerifyScheduler(BaseService):
 
     def queue_snapshot(self) -> dict:
         """Point-in-time queue state for the health/capacity plane
-        (/debug/verify): what is waiting and what budget the next
-        size-flush targets."""
+        (/debug/verify): what is waiting, what budget the next
+        size-flush targets, per-route and per-flush-reason dispatch
+        counts, and the QoS plane (per-class lanes, brownout state)."""
         with self._cond:
-            return {
-                "queue_depth": len(self._requests),
+            snap = {
+                "queue_depth": self._depth_locked(),
                 "pending_lanes": self._pending_lanes,
                 "lane_budget": self._lane_budget,
                 "effective_lane_budget": self._effective_lane_budget(),
                 "flush_us": self.flush_us,
                 "dispatches": self.n_dispatches,
                 "routes": dict(self._routes),
+                "flush_reasons": dict(self._flush_reasons),
             }
+            if not self._qos_enabled:
+                snap["qos"] = {"enabled": False}
+                return snap
+            disabled = set(
+                self.brownout.disabled() if self.brownout else ()
+            )
+            classes = {}
+            for i, (name, lane) in enumerate(self._lanes.items()):
+                classes[name] = {
+                    "priority": i,
+                    "policy": lane.spec.policy,
+                    "max_queue": lane.bound,
+                    "weight": lane.spec.weight,
+                    "depth": len(lane.reqs),
+                    "pending_sigs": lane.pending_sigs,
+                    "admits": lane.admits,
+                    "sheds": lane.sheds,
+                    "drops": lane.drops,
+                    "quota_rejections": lane.quota_rejections,
+                    "browned_out": name in disabled,
+                }
+            snap["qos"] = {
+                "enabled": True,
+                "classes": classes,
+                "brownout": (
+                    self.brownout.snapshot() if self.brownout else {}
+                ),
+                "tenant_rate": self._quotas.rate,
+            }
+            return snap
+
+    def _depth_locked(self) -> int:
+        return sum(len(lane.reqs) for lane in self._lanes.values())
 
     def _effective_lane_budget(self) -> int:
         """The size-flush threshold scaled to the capacity the HEALTHY
@@ -398,6 +560,36 @@ class VerifyScheduler(BaseService):
             return self._lane_budget
         return max(1, int(self._lane_budget * frac))
 
+    # -- QoS hooks -----------------------------------------------------------
+
+    def on_burn(self, burn: float) -> None:
+        """TelemetryHub burn-watcher entry point (the same hook the
+        incident profiler rides): SLO error-budget burn feeds the
+        brownout controller. No-op with QoS off."""
+        if self.brownout is not None:
+            self.brownout.observe_burn(burn)
+
+    def on_supervisor_state(self, state: str) -> None:
+        """BackendSupervisor state-listener entry point: an aggregate
+        DEGRADED/BROKEN transition is overload evidence even before the
+        SLO window catches up. No-op with QoS off."""
+        if self.brownout is not None:
+            self.brownout.observe_state(state)
+
+    def _on_brownout_change(self, cls: str, disabled: bool) -> None:
+        if disabled:
+            self.qos_metrics.brownouts.with_labels(qclass=cls).add()
+            self.qos_metrics.brownout_active.with_labels(qclass=cls).set(1)
+            self.logger.error(
+                "qos brownout: class disabled under overload", qclass=cls,
+            )
+        else:
+            self.qos_metrics.readmits.with_labels(qclass=cls).add()
+            self.qos_metrics.brownout_active.with_labels(qclass=cls).set(0)
+            self.logger.info(
+                "qos brownout: class re-admitted", qclass=cls,
+            )
+
     # -- lifecycle -----------------------------------------------------------
 
     def on_start(self) -> None:
@@ -416,7 +608,17 @@ class VerifyScheduler(BaseService):
             w.join(timeout=self._join_timeout_s)
             joined = not w.is_alive()
         with self._cond:
-            leftovers, self._requests = self._requests, []
+            # close admission BEFORE sweeping leftovers: a submit that
+            # reacquires the lock after this point sees _accepting False
+            # and dispatches inline instead of appending to lanes nobody
+            # will drain again (the future-leak race)
+            self._accepting = False
+            leftovers: List[_Request] = []
+            for lane in self._lanes.values():
+                leftovers.extend(lane.reqs)
+                lane.reqs.clear()
+                lane.pending_sigs = 0
+                lane.deficit = 0
             inflight = list(self._inflight)
             self._pending_lanes = 0
             self._cond.notify_all()  # release backpressured submitters
@@ -455,21 +657,26 @@ class VerifyScheduler(BaseService):
     ) -> VerifyFuture:
         """Queue ``items`` (``(pub_key, msg, sig)`` triples) for the next
         coalesced dispatch. Thread-safe; never blocks on the device, but
-        MAY block (bounded by CBFT_SUBMIT_TIMEOUT_MS) for queue room when
-        [crypto] max_queue pending signatures are already waiting.
+        MAY block (bounded by CBFT_SUBMIT_TIMEOUT_MS, or the class's
+        shed deadline) for queue room when the class lane is at its
+        bound.
 
-        ``subsystem``/``height`` never affect routing or verdicts — they
-        tag the request's trace span and, when the supervisor triages a
-        mixed-verdict batch, attribute offending signatures back to the
-        submitting subsystem/block in metrics and logs."""
+        ``subsystem`` resolves the request's QoS class (untagged maps
+        to the top class — commit verification must never be shed by
+        default) and, with ``height``, tags the request's trace span and
+        lets supervisor triage attribute offending signatures back to
+        the submitting subsystem/block in metrics and logs."""
         triples = [(pk, bytes(m), bytes(s)) for pk, m, s in items]
+        qclass = qoslib.resolve_class(subsystem, self._class_names)
         span = self._tracer.start_span("request", n_sigs=len(triples))
         if not span.noop:
             if subsystem:
                 span.set_tag("subsystem", subsystem)
             if height is not None:
                 span.set_tag("height", int(height))
-        req = _Request(triples, span, subsystem, height)
+            if self._qos_enabled:
+                span.set_tag("qos_class", qclass)
+        req = _Request(triples, span, subsystem, height, qclass)
         self.metrics.requests.add()
         self.metrics.signatures.add(len(req.items))
         if not req.items:
@@ -481,45 +688,172 @@ class VerifyScheduler(BaseService):
             # the contract (future complete on return, exact verdicts)
             self._dispatch([req], "explicit")
             return req.future
-        timed_out = False
+        lane = self._lanes[qclass]
+        policy = lane.spec.policy
+        # admission outcome decided under the lock, acted on outside it
+        # (the shed/drop paths verify or complete without the lock held)
+        action: Optional[str] = None
         with self._cond:
-            # Backpressure: a stalled device plane must surface as
-            # bounded blocking here, not unbounded queue growth. An
-            # empty queue always admits (one oversize request may exceed
-            # the bound on its own — it still has to verify somewhere).
-            if self._pending_lanes >= self._max_queue and self._requests:
-                self.metrics.backpressure_waits.add()
-                deadline = time.monotonic() + self._submit_timeout_s
-                while (
-                    self._pending_lanes >= self._max_queue
-                    and self._requests
-                    and not self._draining
-                ):
-                    left = deadline - time.monotonic()
-                    if left <= 0:
-                        timed_out = True
-                        break
-                    self._cond.wait(left)
-            if not timed_out:
-                self._requests.append(req)
+            if not self._accepting:
+                action = "stopped"
+            elif (
+                self.brownout is not None
+                and not self.brownout.allows(qclass)
+            ):
+                # browned-out class: apply the overload policy without
+                # touching the lane (only sheddable classes are ever in
+                # the brownout ladder)
+                action = (
+                    "drop" if policy == qoslib.POLICY_DROP else "shed"
+                )
+            elif not self._quotas.try_take(
+                subsystem or qoslib.TENANT_UNTAGGED, len(req.items)
+            ):
+                lane.quota_rejections += 1
+                self.qos_metrics.quota_rejections.with_labels(
+                    tenant=subsystem or qoslib.TENANT_UNTAGGED
+                ).add()
+                if policy == qoslib.POLICY_SHED:
+                    action = "shed"
+                elif policy == qoslib.POLICY_DROP:
+                    action = "drop"
+                # block-policy classes are never throttled by quota —
+                # consensus must not stall because its tenant is hot; the
+                # rejection is counted (metric + snapshot) and admission
+                # proceeds
+            if action is None and (
+                lane.pending_sigs >= lane.bound and lane.reqs
+            ):
+                # Backpressure: a stalled device plane must surface as
+                # bounded blocking here, not unbounded queue growth. An
+                # empty lane always admits (one oversize request may
+                # exceed the bound on its own — it still has to verify
+                # somewhere).
+                if policy == qoslib.POLICY_DROP:
+                    action = "drop"
+                else:
+                    self.metrics.backpressure_waits.add()
+                    wait_budget = (
+                        self._submit_timeout_s
+                        if policy == qoslib.POLICY_BLOCK
+                        else lane.spec.shed_ms / 1e3
+                    )
+                    deadline = time.monotonic() + wait_budget
+                    timed_out = False
+                    while (
+                        lane.pending_sigs >= lane.bound
+                        and lane.reqs
+                        and not self._draining
+                        and self._accepting
+                    ):
+                        left = deadline - time.monotonic()
+                        if left <= 0:
+                            timed_out = True
+                            break
+                        self._cond.wait(left)
+                    if not self._accepting:
+                        action = "stopped"
+                    elif timed_out:
+                        action = (
+                            "shed" if policy == qoslib.POLICY_SHED
+                            else "block_timeout"
+                        )
+            if action is None:
+                lane.reqs.append(req)
+                lane.pending_sigs += len(req.items)
+                lane.admits += 1
                 self._pending_lanes += len(req.items)
-                self.metrics.queue_depth.set(len(self._requests))
+                self.metrics.queue_depth.set(self._depth_locked())
                 self.metrics.pending_lanes.set(self._pending_lanes)
+                if self._qos_enabled:
+                    self.qos_metrics.admits.with_labels(qclass=qclass).add()
+                    lane.g_depth.set(len(lane.reqs))
+                    lane.g_pending.set(lane.pending_sigs)
                 self._cond.notify_all()
-        if timed_out:
-            # the queue never drained within the deadline: verify inline
-            # on the CPU ground truth so the caller still gets exact
-            # verdicts, memory stays bounded, and no future is lost
-            self.metrics.backpressure_timeouts.add()
-            self.logger.error(
-                "verify queue full past deadline; verifying inline on CPU",
-                n=len(req.items), max_queue=self._max_queue,
-                timeout_s=self._submit_timeout_s,
-            )
-            mask = self._cpu_ground_truth(req.items)
-            req.future._set((all(mask), mask))
-            span.end(outcome="backpressure_cpu", ok=all(mask))
+                return req.future
+        if action == "stopped":
+            # lost the race with stop(): the final drain sweep is done,
+            # so complete on the submitting thread (exact verdicts)
+            self._dispatch([req], "explicit")
+            return req.future
+        if action == "drop":
+            self._drop(req, lane)
+            return req.future
+        if action == "shed":
+            self._shed_inline(req, lane)
+            return req.future
+        # block_timeout: the lane never drained within the deadline —
+        # verify inline on the CPU ground truth so the caller still gets
+        # exact verdicts, memory stays bounded, and no future is lost
+        self.metrics.backpressure_timeouts.add()
+        self.logger.error(
+            "verify queue full past deadline; verifying inline on CPU",
+            n=len(req.items), qclass=qclass, max_queue=lane.bound,
+            timeout_s=self._submit_timeout_s,
+        )
+        self._inline_cpu(req, outcome="backpressure_cpu")
         return req.future
+
+    def _inline_cpu(self, req: _Request, outcome: str) -> None:
+        """Verify a refused request inline on the submitter's CPU and
+        RED-meter the verdict under its tenant tag — an overloaded
+        tenant must look overloaded in /debug/verify, not drop out of
+        its own rate the moment its traffic stops riding the device."""
+        t0 = time.monotonic()
+        mask = self._cpu_ground_truth(req.items)
+        service_s = time.monotonic() - t0
+        ok = all(mask)
+        req.future._set((ok, mask))
+        req.span.end(outcome=outcome, ok=ok)
+        if self._telemetry is not None:
+            self._telemetry.note_request(
+                n_sigs=len(req.items),
+                wait_s=t0 - req.t_submit,
+                service_s=service_s,
+                ok=ok,
+                subsystem=req.subsystem,
+                height=req.height,
+            )
+
+    def _shed_inline(self, req: _Request, lane: _Lane) -> None:
+        """Shed-policy overload action: the submitter pays its own CPU
+        verify instead of stalling the lane. Exact verdicts, counted."""
+        with self._cond:
+            lane.sheds += 1
+        self.qos_metrics.sheds.with_labels(
+            qclass=lane.spec.name, policy=qoslib.POLICY_SHED
+        ).add()
+        self.qos_metrics.shed_sigs.with_labels(
+            qclass=lane.spec.name
+        ).add(len(req.items))
+        self._inline_cpu(req, outcome="qos_shed")
+
+    def _drop(self, req: _Request, lane: _Lane) -> None:
+        """Drop-policy overload action: best-effort traffic gets an
+        immediate ``rejected`` verdict (all-False mask, ``rejected``
+        flag set) — the caller re-verifies on CPU if it still cares.
+        The error IS metered under the tenant so a flooding tenant's
+        error rate rises in /debug/verify."""
+        with self._cond:
+            lane.drops += 1
+        self.qos_metrics.sheds.with_labels(
+            qclass=lane.spec.name, policy=qoslib.POLICY_DROP
+        ).add()
+        self.qos_metrics.shed_sigs.with_labels(
+            qclass=lane.spec.name
+        ).add(len(req.items))
+        req.future.rejected = True
+        req.future._set((False, [False] * len(req.items)))
+        req.span.end(outcome="qos_drop", ok=False)
+        if self._telemetry is not None:
+            self._telemetry.note_request(
+                n_sigs=len(req.items),
+                wait_s=time.monotonic() - req.t_submit,
+                service_s=0.0,
+                ok=False,
+                subsystem=req.subsystem,
+                height=req.height,
+            )
 
     def flush(self) -> None:
         """Ask the worker to dispatch whatever is pending right now."""
@@ -542,24 +876,27 @@ class VerifyScheduler(BaseService):
                     if self._pending_lanes >= self._effective_lane_budget():
                         reason = "size"
                         break
+                    depth = self._depth_locked()
                     if self._flush_asked:
                         # an explicit flush with nothing pending is a no-op
                         self._flush_asked = False
-                        if self._requests:
+                        if depth:
                             reason = "explicit"
                             break
-                    if (
-                        self._requests
-                        and self._supervisor is not None
-                        and self._supervisor.state() == "broken"
-                    ):
-                        # open breaker: every dispatch is CPU-routed, so
-                        # there is nothing to coalesce FOR — waiting out
-                        # flush_us only adds latency
-                        reason = "broken"
-                        break
-                    if self._requests:
-                        wake = self._requests[0].t_submit + self._flush_s
+                    if depth and self._supervisor is not None:
+                        sup_state = self._sup_state()
+                        if sup_state == "broken":
+                            # open breaker: every dispatch is CPU-routed,
+                            # so there is nothing to coalesce FOR —
+                            # waiting out flush_us only adds latency
+                            reason = "broken"
+                            break
+                    if depth:
+                        oldest = min(
+                            lane.reqs[0].t_submit
+                            for lane in self._lanes.values() if lane.reqs
+                        )
+                        wake = oldest + self._flush_s
                         left = wake - time.monotonic()
                         if left <= 0:
                             reason = "deadline"
@@ -567,11 +904,15 @@ class VerifyScheduler(BaseService):
                         self._cond.wait(left)
                     else:
                         self._cond.wait(0.1)
-                batch, self._requests = self._requests, []
+                batch = self._assemble_locked(
+                    self._effective_lane_budget(),
+                    unbounded=(
+                        not self._qos_enabled or reason == "drain"
+                    ),
+                )
                 self._inflight = batch
-                self._pending_lanes = 0
-                self.metrics.queue_depth.set(0)
-                self.metrics.pending_lanes.set(0)
+                self.metrics.queue_depth.set(self._depth_locked())
+                self.metrics.pending_lanes.set(self._pending_lanes)
                 draining = self._draining
                 # queue room just opened: wake backpressured submitters
                 self._cond.notify_all()
@@ -586,6 +927,89 @@ class VerifyScheduler(BaseService):
             if draining:
                 # one more sweep: a submit that raced stop lands too
                 continue
+
+    def _sup_state(self) -> Optional[str]:
+        try:
+            state = self._supervisor.state()
+        except Exception:  # noqa: BLE001 - supervisor state is advisory
+            return None
+        # the worker polls this anyway — feed the brownout controller so
+        # a scheduler without the node's listener wiring still reacts
+        if self.brownout is not None:
+            self.brownout.observe_state(state)
+        return state
+
+    def _assemble_locked(
+        self, budget: int, unbounded: bool
+    ) -> List[_Request]:
+        """Pull the next coalesced batch out of the class lanes: the top
+        class is served strictly first (votes never wait behind anything),
+        then the remaining budget is shared across the lower classes by
+        weighted deficit round-robin — each backlogged lane earns
+        weight × quantum signatures of credit per round and spends it on
+        whole requests, so progress is proportional to weight without
+        ever splitting a request. Unspent credit carries to the next
+        flush while the lane stays backlogged. ``unbounded`` (QoS off /
+        final drain) takes everything in priority order."""
+        batch: List[_Request] = []
+        total = 0
+        lanes = list(self._lanes.values())
+
+        def take(lane: _Lane) -> None:
+            nonlocal total
+            req = lane.reqs.popleft()
+            n = len(req.items)
+            lane.pending_sigs -= n
+            self._pending_lanes -= n
+            total += n
+            batch.append(req)
+
+        def fits(lane: _Lane) -> bool:
+            if unbounded or not batch:
+                # an empty batch always takes one request: an oversize
+                # request still has to dispatch somewhere
+                return True
+            return total + len(lane.reqs[0].items) <= budget
+
+        top = lanes[0]
+        while top.reqs:
+            if not fits(top):
+                return batch  # the budget went entirely to the top class
+            take(top)
+        lower = [lane for lane in lanes[1:] if lane.reqs]
+        # quantum scaled to the budget actually left for the lower
+        # classes: with the nominal 64-sig quantum and a small effective
+        # budget, one round of the first lane's weight would swallow the
+        # whole flush and the classes below it would never interleave
+        if lower:
+            remaining = max(1, budget - total)
+            weight_sum = sum(lane.spec.weight for lane in lower)
+            quantum = max(1, min(
+                qoslib.DRR_QUANTUM, remaining // max(1, weight_sum)
+            ))
+        budget_full = False
+        while lower and not budget_full:
+            for lane in lower:
+                lane.deficit += lane.spec.weight * quantum
+                while (
+                    lane.reqs
+                    and lane.deficit >= len(lane.reqs[0].items)
+                ):
+                    if not fits(lane):
+                        budget_full = True
+                        break
+                    lane.deficit -= len(lane.reqs[0].items)
+                    take(lane)
+                if budget_full:
+                    break
+            lower = [lane for lane in lower if lane.reqs]
+        for lane in lanes:
+            if not lane.reqs:
+                lane.deficit = 0
+            if self._qos_enabled:
+                lane.g_depth.set(len(lane.reqs))
+                lane.g_pending.set(lane.pending_sigs)
+        return batch
 
     def _dispatch(self, batch: List[_Request], reason: str) -> None:
         """ONE backend verify over the coalesced items, demultiplexed back
@@ -607,11 +1031,15 @@ class VerifyScheduler(BaseService):
         items: List[Item] = []
         parent = None
         waits: List[float] = []
+        by_class: Dict[str, List[int]] = {}
         for req in batch:
             wait_s = t0 - req.t_submit
             waits.append(wait_s)
             self.metrics.request_wait_seconds.observe(wait_s)
             items.extend(req.items)
+            counts = by_class.setdefault(req.qclass, [0, 0])
+            counts[0] += 1
+            counts[1] += len(req.items)
             if not req.span.noop:
                 req.span.set_tag("wait_us", int(wait_s * 1e6))
                 if parent is None:
@@ -620,6 +1048,10 @@ class VerifyScheduler(BaseService):
                     parent = req.span
         self.n_dispatches += 1
         self.metrics.flushes.with_labels(reason=reason).add()
+        with self._cond:
+            self._flush_reasons[reason] = (
+                self._flush_reasons.get(reason, 0) + 1
+            )
         lane_fill = min(1.0, len(items) / self._lane_budget)
         self.metrics.lane_fill_ratio.observe(lane_fill)
         dspan = self._tracer.start_span(
@@ -635,6 +1067,13 @@ class VerifyScheduler(BaseService):
             for req in batch:
                 if req.span is not parent and not req.span.noop:
                     req.span.set_tag("dispatch_span", did)
+            if self._qos_enabled:
+                # per-class composition of this flush, e.g.
+                # "consensus=3r/48s,mempool=1r/16s"
+                dspan.set_tag("qos_classes", ",".join(
+                    f"{name}={c[0]}r/{c[1]}s"
+                    for name, c in by_class.items()
+                ))
         # demux shape for supervisor triage attribution: one
         # (n_items, subsystem, height) per coalesced request, item order
         origins = [

@@ -85,7 +85,7 @@ import random
 import re
 import threading
 import time
-from typing import Deque, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from cometbft_tpu.crypto import PubKey
 from cometbft_tpu.crypto.batch import (
@@ -618,6 +618,13 @@ class BackendSupervisor:
         self._memory_plane = memory_plane
         self._profiler = profiler
 
+        # aggregate-state transition listeners (QoS brownout, future
+        # sidecar admission): invoked under self._lock from
+        # _set_state_locked, so they must be fast and never call back
+        # into the supervisor
+        self._state_listeners: List[Callable[[str], None]] = []
+        self._last_aggregate_state = HEALTHY
+
     # -- knob introspection --------------------------------------------------
 
     @property
@@ -662,6 +669,17 @@ class BackendSupervisor:
         otherwise. With one domain this is exactly the old breaker."""
         with self._lock:
             return self._aggregate_state_locked()
+
+    def add_state_listener(self, fn: Callable[[str], None]) -> None:
+        """Subscribe to aggregate-state TRANSITIONS (healthy/degraded/
+        broken). The listener runs under the supervisor lock at the
+        moment of the breaker flip — it must be fast, never raise (a
+        raise is swallowed), and never call back into the supervisor.
+        The QoS brownout controller (crypto/qos.py) is the canonical
+        subscriber: DEGRADED/BROKEN is overload evidence before the SLO
+        window catches up."""
+        with self._lock:
+            self._state_listeners.append(fn)
 
     def _aggregate_state_locked(self) -> str:
         states = [d.state for d in self._domains]
@@ -1698,9 +1716,15 @@ class BackendSupervisor:
         self.metrics.breaker_state.with_labels(
             device=dom.handle.label
         ).set(_STATE_CODE[new_state])
-        self.metrics.state.set(
-            _STATE_CODE[self._aggregate_state_locked()]
-        )
+        agg = self._aggregate_state_locked()
+        self.metrics.state.set(_STATE_CODE[agg])
+        if agg != self._last_aggregate_state:
+            self._last_aggregate_state = agg
+            for fn in self._state_listeners:
+                try:
+                    fn(agg)
+                except Exception:  # noqa: BLE001 - listener is advisory
+                    pass
 
     def _note_success(self, dom: _Domain) -> None:
         with self._lock:

@@ -310,7 +310,7 @@ class TelemetryHub:
         self._subsystems: Dict[str, List[Any]] = {}
         self._sources: Dict[str, Callable[[], Any]] = {}
         self._capacity_fn: Optional[Callable[[], float]] = None
-        self._burn_watcher: Optional[Callable[[float], None]] = None
+        self._burn_watchers: List[Callable[[float], None]] = []
 
     # -- feeders (hot path) --------------------------------------------------
 
@@ -394,8 +394,18 @@ class TelemetryHub:
         """Install a callable invoked with the SLO burn rate on every
         ``snapshot()`` — the incident profiler's auto-capture trigger
         (libs/profiling.py ``on_burn``). Best-effort: a raising watcher
-        never breaks the plane."""
-        self._burn_watcher = fn
+        never breaks the plane. Replaces any previously installed
+        watchers; use ``add_burn_watcher`` to stack several (profiler
+        capture + QoS brownout ride the same signal)."""
+        with self._mtx:
+            self._burn_watchers = [fn] if fn is not None else []
+
+    def add_burn_watcher(self, fn: Callable[[float], None]) -> None:
+        """Append a burn watcher without displacing the ones already
+        installed — every watcher sees every ``snapshot()``'s burn rate,
+        each isolated in its own try/except."""
+        with self._mtx:
+            self._burn_watchers.append(fn)
 
     def utilization(self, now: Optional[float] = None) -> Dict[str, Any]:
         """Windowed per-device duty cycle + served signature counts."""
@@ -509,10 +519,12 @@ class TelemetryHub:
         util = self.utilization(now)
         fill = self.lane_fill(now)
         slo = self.slo.snapshot(now)
-        watcher = self._burn_watcher
-        if watcher is not None:
+        with self._mtx:
+            watchers = list(self._burn_watchers)
+        burn = float(slo.get("burn_rate") or 0.0)
+        for watcher in watchers:
             try:
-                watcher(float(slo.get("burn_rate") or 0.0))
+                watcher(burn)
             except Exception:  # noqa: BLE001 - watcher is advisory
                 pass
         head = self.headroom(slo=slo, util=util, now=now)

@@ -178,6 +178,7 @@ class Node(BaseService):
         from cometbft_tpu.p2p.metrics import Metrics as P2PMetrics
         from cometbft_tpu.state.metrics import Metrics as SMMetrics
 
+        from cometbft_tpu.crypto.qos import QoSMetrics
         from cometbft_tpu.crypto.tpu.aot import Metrics as AotMetrics
         from cometbft_tpu.crypto.tpu.memory import Metrics as MemPlaneMetrics
 
@@ -190,6 +191,7 @@ class Node(BaseService):
             mem_metrics = MemMetrics(self.metrics_registry)
             sm_metrics = SMMetrics(self.metrics_registry)
             sched_metrics = SchedMetrics(self.metrics_registry)
+            qos_metrics = QoSMetrics(self.metrics_registry)
             sup_metrics = SupMetrics(self.metrics_registry)
             aot_metrics = AotMetrics(self.metrics_registry)
             tel_metrics = TelMetrics(self.metrics_registry)
@@ -201,6 +203,7 @@ class Node(BaseService):
             mem_metrics = MemMetrics.nop()
             sm_metrics = SMMetrics.nop()
             sched_metrics = SchedMetrics.nop()
+            qos_metrics = QoSMetrics.nop()
             sup_metrics = SupMetrics.nop()
             aot_metrics = AotMetrics.nop()
             tel_metrics = TelMetrics.nop()
@@ -367,9 +370,19 @@ class Node(BaseService):
             tracer=self.tracer,
             telemetry=self.telemetry_hub,
             shard_min_batch=config.crypto.shard_min_batch,
+            qos=config.crypto.qos_classes,
+            qos_metrics=qos_metrics,
+            tenant_rate=config.crypto.qos_tenant_rate,
         )
         self.telemetry_hub.register_source(
             "scheduler", self.verify_scheduler.queue_snapshot
+        )
+        # overload signals → QoS brownout: the hub's SLO burn rate on
+        # every snapshot (the same hook the profiler rides) and the
+        # supervisor's aggregate-state transitions
+        self.telemetry_hub.add_burn_watcher(self.verify_scheduler.on_burn)
+        self.verify_supervisor.add_state_listener(
+            self.verify_scheduler.on_supervisor_state
         )
         self.telemetry_hub.register_source(
             "topology", verify_topology.snapshot

@@ -1,6 +1,6 @@
 """Standalone chaos harness against the supervised verify plane.
 
-Five modes:
+Six modes:
 
 * default (smoke) — crypto/faults.py run_chaos_smoke: a fast,
   deterministic walk of every degradation-ladder rung (transient retry,
@@ -37,6 +37,16 @@ Five modes:
   from the modeled HBM headroom before dispatch, so zero
   RESOURCE_EXHAUSTED ever reaches the supervisor while verdicts stay
   ground-truth-exact.
+
+* --overload — crypto/faults.py run_chaos_overload: the QoS admission
+  rung. A steady consensus workload rides through a 10x
+  blocksync+mempool flood: with the default class ladder, consensus
+  p99 stays inside 2x max(unloaded p99, one dispatch quantum), zero
+  consensus sheds/drops, the floods shed/drop, the brownout controller
+  trips and re-admits once the flood stops, and every non-rejected
+  future carries ground-truth verdicts. The SAME flood is then replayed
+  with CBFT_QOS_CLASSES=off and must blow the same latency bound — the
+  contrast that proves the admission layer is load-bearing.
 
 * --soak — crypto/faults.py run_chaos_soak: a randomized fault schedule
   (exceptions, hangs, silent verdict corruption, sudden death, jitter,
@@ -106,6 +116,16 @@ def main() -> int:
     ap.add_argument("--rounds", type=int, default=4,
                     help="[sharded] timed megabatch rounds per "
                          "throughput phase (default 4)")
+    ap.add_argument("--overload", action="store_true",
+                    help="run the QoS overload rung: consensus stays "
+                         "inside its latency bound through a "
+                         "blocksync+mempool flood, the floods "
+                         "shed/drop, brownout trips and re-admits; the "
+                         "same flood with CBFT_QOS_CLASSES=off starves "
+                         "consensus")
+    ap.add_argument("--flood-s", type=float, default=1.5,
+                    help="[overload] flood duration per phase "
+                         "(default 1.5)")
     ap.add_argument("--memory-guard", action="store_true",
                     help="run the proactive-vs-reactive OOM rung "
                          "(memory plane pre-dispatch guard)")
@@ -146,6 +166,31 @@ def main() -> int:
             and summary["device_resumed_after_recovery"]
         )
         print("CHAOS SOAK", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+
+    if args.overload:
+        from cometbft_tpu.crypto.faults import run_chaos_overload
+
+        summary = run_chaos_overload(
+            seed=args.seed, inner=args.inner, flood_s=args.flood_s,
+        )
+        print(json.dumps(summary, indent=2))
+        ok = (
+            summary["wrong_verdicts"] == 0
+            and summary["latency_ok"]
+            and summary["consensus_sheds"] == 0
+            and summary["consensus_drops"] == 0
+            and summary["consensus_backpressure_timeouts"] == 0
+            and summary["flood_sheds"] >= 1
+            and summary["flood_drops"] >= 1
+            and summary["rejected"] >= 1
+            and summary["brownout"]["trips"] >= 1
+            and summary["brownout"]["readmissions"] >= 1
+            and not summary["brownout"]["disabled"]
+            and summary["readmitted"]
+            and summary["starved_without_qos"]
+        )
+        print("CHAOS OVERLOAD", "PASS" if ok else "FAIL")
         return 0 if ok else 1
 
     if args.memory_guard:

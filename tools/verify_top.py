@@ -123,12 +123,55 @@ def render(snap: Dict[str, Any]) -> str:
         routes = sched.get("routes")
         if isinstance(routes, dict):
             total = sum(routes.values()) or 1
-            out.append(
-                "routing  " + "  ".join(
-                    f"{r}={routes.get(r, 0)} ({routes.get(r, 0) * 100 // total}%)"
-                    for r in ("cpu", "single", "sharded")
-                )
+            line = "routing  " + "  ".join(
+                f"{r}={routes.get(r, 0)} ({routes.get(r, 0) * 100 // total}%)"
+                for r in ("cpu", "single", "sharded")
             )
+            reasons = sched.get("flush_reasons")
+            if isinstance(reasons, dict):
+                # broken-state flushes are the "device plane fell over
+                # mid-queue" tell — keep them on the operator's glance line
+                line += f"  broken_flushes={reasons.get('broken', 0)}"
+            out.append(line)
+        qos = sched.get("qos")
+        if isinstance(qos, dict) and qos.get("enabled"):
+            out.append("")
+            out.append("qos classes:")
+            qos_rows = []
+            classes = qos.get("classes", {})
+            for name, c in sorted(
+                classes.items(), key=lambda kv: kv[1].get("priority", 0)
+            ):
+                qos_rows.append({
+                    "class": name,
+                    "pri": c.get("priority", "-"),
+                    "policy": c.get("policy", "-"),
+                    "wt": c.get("weight", "-"),
+                    "depth": c.get("depth", "-"),
+                    "pending": c.get("pending_sigs", "-"),
+                    "bound": c.get("max_queue", "-"),
+                    "admits": c.get("admits", "-"),
+                    "sheds": c.get("sheds", "-"),
+                    "drops": c.get("drops", "-"),
+                    "quota_rej": c.get("quota_rejections", "-"),
+                    "brownout": "OUT" if c.get("browned_out") else "-",
+                })
+            out.append(_fmt_table(
+                qos_rows,
+                ["class", "pri", "policy", "wt", "depth", "pending",
+                 "bound", "admits", "sheds", "drops", "quota_rej",
+                 "brownout"],
+            ))
+            bo = qos.get("brownout")
+            if isinstance(bo, dict):
+                disabled = bo.get("disabled") or []
+                out.append(
+                    f"brownout  disabled={','.join(disabled) or '-'}  "
+                    f"trips={bo.get('trips', 0)}  "
+                    f"readmissions={bo.get('readmissions', 0)}  "
+                    f"burn={bo.get('last_burn', '-')}  "
+                    f"state={bo.get('last_state', '-')}"
+                )
     fill = snap.get("lane_fill", {})
     if fill.get("padded_lanes"):
         out.append(
