@@ -1234,6 +1234,11 @@ def main():
         ):
             parsed, diag = _run_stage(name, _STAGE_ENV_TPU, timeout)
             stages[f"tpu_{name}"] = parsed if parsed is not None else diag
+            if name == "breakdown" and parsed is not None:
+                # wire-path phase numbers (prepare/transfer/compute ms)
+                # join the regression ledger so the sentinel pages on
+                # link regressions, not just throughput ones
+                _append_history(parsed, stage="tpu_breakdown")
 
     # CPU-side p50s always run (serial CPU verifier — no kernel compile):
     # BASELINE.md's comparison needs both backends from one bench run

@@ -630,6 +630,95 @@ def bench_coldboot() -> dict:
     }
 
 
+def bench_wire() -> dict:
+    """Wire-ledger overhead (crypto/wire.py), asserted on CPU-only CI
+    with the real ed25519 verify cost dominating:
+
+    - the bench_telemetry workload (8 requests × 64 real ed25519 sigs
+      through BackendSpec("cpu")) is timed with a WireLedger installed
+      as the process default and with no ledger installed, best-of-3
+      per mode, interleaved so machine noise hits both equally;
+    - ledger-on throughput must be within 1% of ledger-off throughput —
+      on the CPU route only the scheduler's demux phase feeds the
+      ledger, which is exactly the scheduler-side cost the acceptance
+      bound covers (the mesh-side note_chunk rides inside dispatches
+      that already cost tens of ms);
+    - the ledger must actually have been engaged: every dispatch's
+      verdict demux lands one note_demux, so demux_notes must grow by
+      at least one per ledger-on arm.
+
+    ``overhead_margin_pct`` is ``1.0 − overhead_pct`` so the harness's
+    ">0" invariant IS the <1% assertion.
+    """
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["CBFT_TPU_PROBE"] = "0"
+
+    from bench import _make_batch
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto import wire as wirelib
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+
+    n_reqs, per_req = 8, 64
+    pks, msgs, sigs = _make_batch(per_req)
+    items = [
+        (ed.PubKeyEd25519(pk), m, s) for pk, m, s in zip(pks, msgs, sigs)
+    ]
+    reqs = [list(items) for _ in range(n_reqs)]
+
+    def run_workload() -> float:
+        sched = VerifyScheduler(spec=BackendSpec("cpu"), flush_us=500)
+        sched.start()
+        try:
+            sched.submit(reqs[0], subsystem="bench").result(timeout=60)
+            t0 = time.perf_counter()
+            futs = [sched.submit(r, subsystem="bench") for r in reqs]
+            for f in futs:
+                ok, mask = f.result(timeout=60)
+                if not (ok and all(mask)):
+                    raise AssertionError("wire bench verdict wrong")
+            return time.perf_counter() - t0
+        finally:
+            sched.stop()
+
+    ledger = wirelib.WireLedger()
+    off_s, on_s = [], []
+    prev = wirelib.set_default_ledger(None)
+    try:
+        for _ in range(3):  # interleave so drift hits both modes equally
+            wirelib.set_default_ledger(None)
+            off_s.append(run_workload())
+            wirelib.set_default_ledger(ledger)
+            on_s.append(run_workload())
+    finally:
+        wirelib.set_default_ledger(prev)
+    base, led = min(off_s), min(on_s)
+
+    if ledger.demux_notes < 3:
+        raise AssertionError(
+            f"ledger saw {ledger.demux_notes} demux notes, expected "
+            ">= 3 — the scheduler demux feeder was not engaged"
+        )
+
+    overhead_pct = (led - base) / base * 100.0
+    if overhead_pct >= 1.0:
+        raise AssertionError(
+            f"wire-ledger overhead {overhead_pct:.2f}% >= 1% budget "
+            f"(off={base * 1e3:.1f}ms on={led * 1e3:.1f}ms)"
+        )
+    total_sigs = n_reqs * per_req
+    return {
+        "baseline_ms": round(base * 1e3, 2),
+        "wire_ms": round(led * 1e3, 2),
+        "baseline_sigs_per_sec": round(total_sigs / base, 1),
+        "wire_sigs_per_sec": round(total_sigs / led, 1),
+        "overhead_margin_pct": round(1.0 - overhead_pct, 3),
+        "demux_notes": int(ledger.demux_notes),
+    }
+
+
 SECTIONS = {
     "coldboot": bench_coldboot,
     "ed25519": bench_ed25519,
@@ -641,6 +730,7 @@ SECTIONS = {
     "scheduler": bench_scheduler,
     "telemetry": bench_telemetry,
     "wal": bench_wal,
+    "wire": bench_wire,
 }
 
 

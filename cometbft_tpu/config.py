@@ -248,6 +248,16 @@ class InstrumentationConfig:
     # NODE_HOME/data/profiles (newest N — captures are an order of
     # magnitude bigger than trace dumps). CBFT_PROFILE_KEEP env wins.
     profile_keep: int = 4
+    # Wire ledger (crypto/wire.py): continuous per-phase dispatch
+    # attribution (pack / h2d / compute / d2h / demux) with EWMA cost
+    # profiles per (route, bucket, device) — feeds /debug/verify,
+    # verify_wire_* metrics, and the CostProfile API. Off = the mesh
+    # hot path pays one module-attribute read per dispatch.
+    # CBFT_WIRE_LEDGER env wins.
+    wire_ledger: bool = True
+    # EWMA window (in chunk observations) for the wire ledger's cost
+    # profiles: alpha = 2/(window+1). CBFT_WIRE_WINDOW env wins.
+    wire_window: int = 64
 
 
 @dataclass
@@ -465,13 +475,19 @@ class Config:
                 "instrumentation.trace_dump_keep must be a positive "
                 f"integer, got {tdk!r}"
             )
-        for knob in ("mem_poll_ms", "profile_keep"):
+        for knob in ("mem_poll_ms", "profile_keep", "wire_window"):
             v = getattr(self.instrumentation, knob)
             if not isinstance(v, int) or isinstance(v, bool) or v < 1:
                 raise ValueError(
                     f"instrumentation.{knob} must be a positive "
                     f"integer, got {v!r}"
                 )
+        wl = self.instrumentation.wire_ledger
+        if not isinstance(wl, bool):
+            raise ValueError(
+                "instrumentation.wire_ledger must be a boolean, "
+                f"got {wl!r}"
+            )
         pb = self.instrumentation.profile_on_burn
         if (
             not isinstance(pb, (int, float))

@@ -1510,3 +1510,135 @@ def run_chaos_overload(
         },
     }
     return summary
+
+
+def _wire_probe_kernel(x):
+    """Trivial parity kernel for the wire chaos rung: True where the
+    lane's byte-column sum is even. Module-level so the AOT registry
+    gets a stable __qualname__ across runs."""
+    import jax.numpy as jnp
+
+    return (x.astype(jnp.uint32).sum(axis=0) & 1) == 0
+
+
+def run_chaos_wire(
+    seed: int = 7,
+    chunks: int = 4,
+    lanes: int = 128,
+    jitter_ms: float = 25.0,
+    logger=None,
+) -> dict:
+    """The attribution proof for the wire ledger (crypto/wire.py): under
+    a jittery LINK — every ``jax.device_put`` stretched by a FaultPlan
+    jitter draw — the ledger must blame the slowdown on the h2d phase,
+    not compute.
+
+    Three runs of the same deterministic payload through
+    mesh.dispatch_batch (single-device route, fresh WireLedger each):
+
+    * **warm** — absorbs the kernel compile so neither measured run
+      carries it;
+    * **clean** — baseline per-phase totals;
+    * **jittery** — ``jax.device_put`` monkeypatched to sleep a
+      ``FaultPlan(jitter_ms=..., seed=...)`` draw before each real put
+      (mesh resolves the attribute at call time, so the patch IS the
+      slow link), restored in a finally.
+
+    Asserts: every mask matches the host-computed parity ground truth;
+    the jittery run's h2d total grew by at least half the injected
+    sleep; the compute total stayed flat (within max(5 ms, 25% of the
+    injected sleep) — attribution did NOT leak into the kernel phase).
+    Deterministic (seeded RNG payload + seeded jitter draws); returns a
+    summary dict for tools/chaos.py and the tier-1 test."""
+    import numpy as np
+
+    from cometbft_tpu.crypto import wire as wirelib
+    from cometbft_tpu.crypto.tpu import mesh
+
+    n = chunks * lanes
+    rng = np.random.RandomState(seed)
+    payload = rng.randint(0, 256, size=(4, n)).astype(np.uint8)
+    expected = ((payload.astype(np.uint32).sum(axis=0) & 1) == 0)
+
+    def one_run() -> dict:
+        """Dispatch the payload under a fresh ledger; → its last
+        dispatch reconciliation record (per-phase ms totals)."""
+        ledger = wirelib.WireLedger(window=8)
+        prev = wirelib.set_default_ledger(ledger)
+        try:
+            with mesh.route_scope(mesh.ROUTE_SINGLE):
+                mask = mesh.dispatch_batch(
+                    _wire_probe_kernel, [payload], n, lanes, lanes
+                )
+        finally:
+            wirelib.set_default_ledger(prev)
+        if not (np.asarray(mask) == expected).all():
+            raise AssertionError("wire chaos rung: wrong verdicts")
+        recent = ledger.snapshot()["recent"]
+        if not recent:
+            raise AssertionError(
+                "wire chaos rung: ledger saw no dispatch"
+            )
+        return recent[-1]
+
+    one_run()  # warm: compile cost must not pollute either measurement
+    clean = one_run()
+
+    import jax
+
+    plan = FaultPlan(jitter_ms=jitter_ms, seed=seed)
+    injected = {"ms": 0.0}
+    real_put = jax.device_put
+
+    def jittery_put(*args, **kwargs):
+        jitter_s = plan._decide()[4]
+        if jitter_s > 0:
+            time.sleep(jitter_s)
+            injected["ms"] += jitter_s * 1e3
+        return real_put(*args, **kwargs)
+
+    jax.device_put = jittery_put
+    try:
+        jittery = one_run()
+    finally:
+        jax.device_put = real_put
+
+    d_h2d = jittery["h2d_ms"] - clean["h2d_ms"]
+    d_compute = jittery["compute_ms"] - clean["compute_ms"]
+    compute_slack_ms = max(5.0, 0.25 * injected["ms"])
+    if injected["ms"] <= 0:
+        raise AssertionError("wire chaos rung: no jitter was injected")
+    if d_h2d < 0.5 * injected["ms"]:
+        raise AssertionError(
+            f"wire ledger missed the slow link: h2d grew {d_h2d:.1f}ms "
+            f"for {injected['ms']:.1f}ms injected"
+        )
+    if d_compute > compute_slack_ms:
+        raise AssertionError(
+            f"wire ledger misattributed the slow link to compute: "
+            f"compute grew {d_compute:.1f}ms (slack {compute_slack_ms:.1f}ms)"
+        )
+    summary = {
+        "chunks": chunks,
+        "lanes": lanes,
+        "injected_jitter_ms": round(injected["ms"], 1),
+        "clean_h2d_ms": clean["h2d_ms"],
+        "jittery_h2d_ms": jittery["h2d_ms"],
+        "h2d_delta_ms": round(d_h2d, 1),
+        "clean_compute_ms": clean["compute_ms"],
+        "jittery_compute_ms": jittery["compute_ms"],
+        "compute_delta_ms": round(d_compute, 1),
+        "clean_overlap": clean["overlap"],
+        "jittery_overlap": jittery["overlap"],
+        "expected": {
+            "wrong_verdicts": 0,
+            "h2d_delta": ">= 0.5x injected jitter",
+            "compute_delta": "<= max(5ms, 0.25x injected jitter)",
+        },
+        "ok": True,
+    }
+    if logger is not None:
+        logger.info("chaos wire rung passed", **{
+            k: v for k, v in summary.items() if k != "expected"
+        })
+    return summary

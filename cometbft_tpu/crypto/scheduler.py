@@ -89,7 +89,7 @@ import threading
 import time
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
-from cometbft_tpu.crypto import PubKey, qos as qoslib
+from cometbft_tpu.crypto import PubKey, qos as qoslib, wire as wirelib
 from cometbft_tpu.crypto.batch import (
     Backend,
     BackendSpec,
@@ -1081,12 +1081,16 @@ class VerifyScheduler(BaseService):
         ]
         try:
             with tracelib.use(dspan):
-                mask = self._verify(items, reason, origins)
+                mask, wire_route = self._verify(items, reason, origins)
         except BaseException as exc:
             dspan.end(error=repr(exc))
             raise
-        dspan.end()
+        # flush-level ledger tag: which wire route served this dispatch
+        # rides on the dispatch span, and the verdict-demux loop below is
+        # the ledger's fifth phase (host-side fan-out back to futures)
+        dspan.end(route=wire_route)
         service_s = time.monotonic() - t0
+        t_demux = time.perf_counter()
         pos = 0
         for i, req in enumerate(batch):
             sub = mask[pos : pos + len(req.items)]
@@ -1105,6 +1109,11 @@ class VerifyScheduler(BaseService):
                     subsystem=req.subsystem,
                     height=req.height,
                 )
+        ledger = wirelib.default_ledger()
+        if ledger is not None:
+            ledger.note_demux(
+                wire_route, len(items), time.perf_counter() - t_demux
+            )
 
     def _route_for(self, n: int) -> Optional[str]:
         """Per-flush routing decision over the three-way ladder. The CPU
@@ -1151,7 +1160,14 @@ class VerifyScheduler(BaseService):
         reason: str,
         origins: Optional[List[Tuple[int, Optional[str], Optional[int]]]]
         = None,
-    ) -> List[bool]:
+    ) -> Tuple[List[bool], str]:
+        """Returns (verdict mask, wire-route label). The label is the
+        ledger key for demux attribution: "cpu" for host backends,
+        "sharded"/"single" mirroring _note_route's ladder."""
+        if self.spec.name == "cpu":
+            wire_route = "cpu"
+        else:
+            wire_route = "single"
         if self._supervisor is not None:
             # supervised path: watchdog, circuit breaker, retry/hedge
             # ladder, and corruption audit live in crypto/supervisor.py —
@@ -1159,13 +1175,15 @@ class VerifyScheduler(BaseService):
             # built in); origins let its triage attribute bad signatures
             route = self._route_for(len(items))
             self._note_route(route)
+            if route == "sharded":
+                wire_route = "sharded"
             if route is not None:
                 return self._supervisor.verify_items(
                     items, reason=reason, origins=origins, route=route
-                )
+                ), wire_route
             return self._supervisor.verify_items(
                 items, reason=reason, origins=origins
-            )
+            ), wire_route
         self._note_route(None)
         try:
             bv = new_batch_verifier(self.spec)
@@ -1177,7 +1195,7 @@ class VerifyScheduler(BaseService):
                     f"backend returned {len(mask)} verdicts for "
                     f"{len(items)} items"
                 )
-            return mask
+            return mask, wire_route
         except Exception as exc:  # noqa: BLE001 - device plane died mid-flight
             self.metrics.cpu_fallbacks.add()
             self.logger.error(
@@ -1185,7 +1203,7 @@ class VerifyScheduler(BaseService):
                 err=repr(exc), n=len(items), reason=reason,
                 backend=self.spec.name,
             )
-            return self._cpu_ground_truth(items)
+            return self._cpu_ground_truth(items), "cpu"
 
     @staticmethod
     def _cpu_ground_truth(items: Sequence[Item]) -> List[bool]:

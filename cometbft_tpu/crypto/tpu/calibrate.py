@@ -420,6 +420,60 @@ def load_memory_footprints() -> Dict[str, Dict[int, float]]:
     return out
 
 
+# --- link profile (tools/tpu_link_probe.py → crypto/wire.py) -----------------
+# The probe's measured H2D latency/bandwidth curve, persisted so the
+# wire ledger's CostProfile answers predict_ms() cold — before the
+# first live dispatch lands — from what the link actually measured.
+
+
+_LINK_NUMERIC_KEYS = (
+    "kernel_roundtrip_ms",
+    "effective_MBps",
+    "fixed_latency_ms_est",
+)
+
+
+def merge_link_profile(
+    probe: dict, path: Optional[str] = None
+) -> Optional[dict]:
+    """Fold a tpu_link_probe result document into the table under
+    ``table["link"]``. Later merges overwrite — the probe is a fresh
+    measurement, not an increment. Creates a minimal table when none
+    exists yet; None when there is no path or nothing usable."""
+    path = path or table_path()
+    if not path or not isinstance(probe, dict):
+        return None
+    link: Dict[str, object] = {}
+    for key, val in probe.items():
+        if key in _LINK_NUMERIC_KEYS or (
+            key.startswith("put_") and key.endswith("_ms")
+        ):
+            try:
+                link[key] = round(float(val), 4)
+            except (TypeError, ValueError):
+                continue
+        elif key == "platform":
+            link[key] = str(val)
+    if not any(k in link for k in _LINK_NUMERIC_KEYS):
+        return None
+    link["measured_at"] = time.time()
+    table = load_table()
+    if table is None:
+        table = {"version": TABLE_VERSION, "measured_at": time.time()}
+    table["link"] = link
+    save_table(table, path)
+    return table
+
+
+def load_link_profile() -> dict:
+    """The persisted link profile ({kernel_roundtrip_ms, effective_MBps,
+    fixed_latency_ms_est, put_*_ms, platform, measured_at}); {} when no
+    probe was ever merged — the wire ledger then has no cold seed."""
+    table = load_table()
+    link = table.get("link") if table else None
+    return dict(link) if isinstance(link, dict) else {}
+
+
 def persistent_cache_min_compile_secs(default: float = 5.0) -> float:
     """The jax_persistent_cache_min_compile_time_secs threshold this
     link has EARNED: strictly below the cheapest fresh compile ever

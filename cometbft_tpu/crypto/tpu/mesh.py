@@ -436,36 +436,60 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
     # one attribute read per batch). Device-less dispatches account
     # against the module shim's device 0, matching the chunk-cap shim.
     from cometbft_tpu.crypto import telemetry as _telemetry
+    from cometbft_tpu.crypto import wire as _wirelib
 
     _hub = _telemetry.default_hub()
+    _ledger = _wirelib.default_ledger()
     _dev_label = device.label if device is not None else "dev0"
     # ROUTE_SINGLE pins the program to one chip even when a mesh is
     # visible (the scheduler's below-crossover rung); no route keeps the
     # legacy auto-shard-over-everything behavior.
     ndev = 1 if route == ROUTE_SINGLE else n_devices()
+    # wire-ledger route key: the legacy auto-shard path (>1 device, no
+    # installed route) keeps its own label because its phase split is
+    # coarser — the device_put happens inside sharded_verify, so h2d
+    # folds into compute there.
+    _wire_route = ROUTE_SINGLE if ndev == 1 else "auto"
     depth = pipeline_depth()
     out = np.zeros(n, bool)
     inflight: "deque" = deque()
     cancel = current_cancel_event()
+    t_wall0 = time.perf_counter()
+    # per-dispatch phase totals (seconds); d2h accumulates in retire
+    _tot = {"pack": 0.0, "h2d": 0.0, "compute": 0.0, "d2h": 0.0,
+            "hidden": 0.0, "bytes": 0, "chunks": 0}
 
     def retire(slot):
-        chunk_idx, start, end, mask, span = slot
+        chunk_idx, start, end, mask, span, winfo = slot
         # np.asarray blocks until the device finishes this chunk — the
         # wait measured here IS the device-time attribution for the span
         # (host work for the chunk already happened before dispatch).
+        rspan = span.child("wire_d2h")
         t_dev = time.perf_counter_ns()
         try:
             out[start:end] = np.asarray(mask)[: end - start]
         except DispatchCancelled:
+            rspan.end(error="cancelled")
             span.end(error="cancelled")
             raise
         except Exception as exc:  # noqa: BLE001 - device died mid-retire
+            rspan.end(error=repr(exc))
             span.end(error=repr(exc))
             raise RuntimeError(
                 f"retire of chunk {chunk_idx} (sigs [{start}:{end}]) "
                 f"failed: {exc}"
             ) from exc
-        span.end(device_wait_ns=time.perf_counter_ns() - t_dev)
+        wait_ns = time.perf_counter_ns() - t_dev
+        rspan.end()
+        d2h_s = wait_ns / 1e9
+        _tot["d2h"] += d2h_s
+        if _ledger is not None and winfo is not None:
+            size, wire_bytes, pack_s, h2d_s, compute_s, hidden_s = winfo
+            _ledger.note_chunk(
+                _wire_route, _dev_label, size, end - start, wire_bytes,
+                pack_s, h2d_s, compute_s, d2h_s, hidden_s=hidden_s,
+            )
+        span.end(device_wait_ns=wait_ns)
 
     for chunk_idx, start in enumerate(range(0, n, max_chunk)):
         if cancel is not None and cancel.is_set():
@@ -477,8 +501,12 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
         span = _trace.child_of_current(
             "chunk", chunk=chunk_idx, n_sigs=end - start
         )
+        # transfer issued while an earlier chunk is still in flight is
+        # hidden behind its compute — the pipeline-overlap accounting
+        pipelined = len(inflight) > 0
         t_host = time.perf_counter_ns()
         try:
+            pspan = span.child("wire_pack")
             if callable(packed):
                 chunk = packed(start, end)
             else:
@@ -495,8 +523,18 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
                 return padded
 
             padded_args = [pad(a) for a in chunk]
+            t_pack = time.perf_counter_ns()
+            pspan.end()
+            wire_bytes = sum(int(a.nbytes) for a in padded_args)
             if ndev > 1:
+                # legacy auto-shard path: the device_put happens inside
+                # sharded_verify, so there is no separable h2d window —
+                # the whole call lands in the compute phase
+                cspan = span.child("wire_compute")
                 mask = sharded_verify(kernel, padded_args)
+                t_h2d = t_pack
+                t_compute = time.perf_counter_ns()
+                cspan.end()
             else:
                 import jax
                 import jax.numpy as jnp
@@ -504,10 +542,16 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
                 # explicit async device_put: H2D for this chunk starts
                 # now, overlapping the previous chunk's compute; the jit
                 # call then consumes already-placed (donated) buffers
+                hspan = span.child("wire_h2d")
                 placed = [
                     jax.device_put(jnp.asarray(a)) for a in padded_args
                 ]
+                t_h2d = time.perf_counter_ns()
+                hspan.end()
+                cspan = span.child("wire_compute")
                 mask = run_single(kernel, placed)
+                t_compute = time.perf_counter_ns()
+                cspan.end()
         except DispatchCancelled:
             span.end(error="cancelled")
             raise
@@ -521,13 +565,41 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
         # before the device result is ready)
         span.set_tag("host_ns", time.perf_counter_ns() - t_host)
         span.set_tag("pad", size)
+        pack_s = (t_pack - t_host) / 1e9
+        h2d_s = (t_h2d - t_pack) / 1e9
+        compute_s = (t_compute - t_h2d) / 1e9
+        hidden_s = h2d_s if pipelined else 0.0
+        span.set_tag("pack_ns", t_pack - t_host)
+        span.set_tag("h2d_ns", t_h2d - t_pack)
+        span.set_tag("compute_ns", t_compute - t_h2d)
+        span.set_tag("hidden_ns", int(hidden_s * 1e9))
+        span.set_tag("wire_bytes", wire_bytes)
+        _tot["pack"] += pack_s
+        _tot["h2d"] += h2d_s
+        _tot["compute"] += compute_s
+        _tot["hidden"] += hidden_s
+        _tot["bytes"] += wire_bytes
+        _tot["chunks"] += 1
         if _hub is not None:
             _hub.note_chunk(_dev_label, end - start, size)
-        inflight.append((chunk_idx, start, end, mask, span))
+        winfo = (
+            (size, wire_bytes, pack_s, h2d_s, compute_s, hidden_s)
+            if _ledger is not None else None
+        )
+        inflight.append((chunk_idx, start, end, mask, span, winfo))
         while len(inflight) > depth:
             retire(inflight.popleft())
     while inflight:
         retire(inflight.popleft())
+    if _ledger is not None and _tot["chunks"]:
+        _ledger.note_dispatch(
+            _wire_route, _dev_label, n,
+            wall_s=time.perf_counter() - t_wall0,
+            pack_s=_tot["pack"], h2d_s=_tot["h2d"],
+            compute_s=_tot["compute"], d2h_s=_tot["d2h"],
+            hidden_s=_tot["hidden"], wire_bytes=_tot["bytes"],
+            chunks=_tot["chunks"],
+        )
     if _plane is not None and n > 0:
         # post-dispatch model correction: the observed allocation peak
         # over the pre-dispatch baseline calibrates the per-(kernel,
@@ -688,24 +760,38 @@ def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
             per_shard_cap, cap)
     mega = per_shard_cap * nsh
     _hub = _telemetry.default_hub()
+    from cometbft_tpu.crypto import wire as _wirelib
+
+    _ledger = _wirelib.default_ledger()
+    _wire_dev = f"mesh:{nsh}"
     registry = aot.default_registry()
     depth = pipeline_depth()
     out = np.zeros(n, bool)
     inflight: "deque" = deque()
     cancel = current_cancel_event()
     max_bucket = 0
+    t_wall0 = time.perf_counter()
+    # per-dispatch phase totals (seconds); d2h accumulates in retire.
+    # The wire ledger buckets sharded work by the per-shard pow2 lane
+    # count and labels the whole mesh as one "device" — the link is what
+    # the ledger models, and all shards ride the same host egress.
+    _tot = {"pack": 0.0, "h2d": 0.0, "compute": 0.0, "d2h": 0.0,
+            "hidden": 0.0, "bytes": 0, "chunks": 0}
 
     def retire(slot):
-        chunk_idx, start, end, mask, span, shard_spans = slot
+        chunk_idx, start, end, mask, span, shard_spans, winfo = slot
+        rspan = span.child("wire_d2h")
         t_dev = time.perf_counter_ns()
         try:
             out[start:end] = np.asarray(mask)[: end - start]
         except DispatchCancelled:
+            rspan.end(error="cancelled")
             for s in shard_spans:
                 s.end(error="cancelled")
             span.end(error="cancelled")
             raise
         except Exception as exc:  # noqa: BLE001 - device died mid-retire
+            rspan.end(error=repr(exc))
             for s in shard_spans:
                 s.end(error=repr(exc))
             span.end(error=repr(exc))
@@ -714,6 +800,15 @@ def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
                 f"failed: {exc}"
             ) from exc
         wait = time.perf_counter_ns() - t_dev
+        rspan.end()
+        d2h_s = wait / 1e9
+        _tot["d2h"] += d2h_s
+        if _ledger is not None and winfo is not None:
+            per_b, wire_bytes, pack_s, h2d_s, compute_s, hidden_s = winfo
+            _ledger.note_chunk(
+                ROUTE_SHARDED, _wire_dev, per_b, end - start, wire_bytes,
+                pack_s, h2d_s, compute_s, d2h_s, hidden_s=hidden_s,
+            )
         for s in shard_spans:
             s.end(device_wait_ns=wait)
         span.end(device_wait_ns=wait)
@@ -729,8 +824,10 @@ def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
             "sharded_chunk", chunk=chunk_idx, n_sigs=end - start,
             shards=nsh, generation=plan.generation,
         )
+        pipelined = len(inflight) > 0
         t_host = time.perf_counter_ns()
         try:
+            pspan = span.child("wire_pack")
             if callable(packed):
                 chunk = packed(start, end)
             else:
@@ -747,16 +844,22 @@ def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
                 return padded
 
             padded_args = [pad(a) for a in chunk]
+            t_pack = time.perf_counter_ns()
+            pspan.end()
+            wire_bytes = sum(int(a.nbytes) for a in padded_args)
             shardings = tuple(
                 NamedSharding(
                     plan.mesh, PS(*([None] * (a.ndim - 1) + ["batch"]))
                 )
                 for a in padded_args
             )
+            hspan = span.child("wire_h2d")
             placed = [
                 jax.device_put(jnp.asarray(a), s)
                 for a, s in zip(padded_args, shardings)
             ]
+            t_h2d = time.perf_counter_ns()
+            hspan.end()
             shard_spans = []
             real = end - start
             for si, h in enumerate(plan.handles):
@@ -767,11 +870,14 @@ def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
                 )
                 if _hub is not None:
                     _hub.note_chunk(h.label, lanes, per)
+            cspan = span.child("wire_compute")
             with plan.mesh:
                 mask = registry.call(
                     kernel, placed, donate_from=donate_from, sharded=True,
                     mesh=plan.mesh,
                 )
+            t_compute = time.perf_counter_ns()
+            cspan.end()
         except DispatchCancelled:
             span.end(error="cancelled")
             raise
@@ -784,11 +890,41 @@ def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
             ) from exc
         span.set_tag("host_ns", time.perf_counter_ns() - t_host)
         span.set_tag("pad", size)
-        inflight.append((chunk_idx, start, end, mask, span, shard_spans))
+        pack_s = (t_pack - t_host) / 1e9
+        h2d_s = (t_h2d - t_pack) / 1e9
+        compute_s = (t_compute - t_h2d) / 1e9
+        hidden_s = h2d_s if pipelined else 0.0
+        span.set_tag("pack_ns", t_pack - t_host)
+        span.set_tag("h2d_ns", t_h2d - t_pack)
+        span.set_tag("compute_ns", t_compute - t_h2d)
+        span.set_tag("hidden_ns", int(hidden_s * 1e9))
+        span.set_tag("wire_bytes", wire_bytes)
+        _tot["pack"] += pack_s
+        _tot["h2d"] += h2d_s
+        _tot["compute"] += compute_s
+        _tot["hidden"] += hidden_s
+        _tot["bytes"] += wire_bytes
+        _tot["chunks"] += 1
+        winfo = (
+            (per, wire_bytes, pack_s, h2d_s, compute_s, hidden_s)
+            if _ledger is not None else None
+        )
+        inflight.append(
+            (chunk_idx, start, end, mask, span, shard_spans, winfo)
+        )
         while len(inflight) > depth:
             retire(inflight.popleft())
     while inflight:
         retire(inflight.popleft())
+    if _ledger is not None and _tot["chunks"]:
+        _ledger.note_dispatch(
+            ROUTE_SHARDED, _wire_dev, n,
+            wall_s=time.perf_counter() - t_wall0,
+            pack_s=_tot["pack"], h2d_s=_tot["h2d"],
+            compute_s=_tot["compute"], d2h_s=_tot["d2h"],
+            hidden_s=_tot["hidden"], wire_bytes=_tot["bytes"],
+            chunks=_tot["chunks"],
+        )
     if _plane is not None and n > 0 and max_bucket > 0:
         # per-device model correction: each shard served max_bucket
         # lanes of this kernel; best-effort, never fails a dispatch

@@ -1,0 +1,601 @@
+"""Wire ledger — continuous per-phase attribution of every live
+device dispatch (ROADMAP item 1, "attack the wire", made measurable).
+
+The bench anecdote this plane replaces: per 16k batch the kernel runs
+~0.1 ms while host prepare takes ~15 ms and H2D transfer ~181 ms
+(MAXCHUNK16K.jsonl) — yet until now the live path was blind to where
+dispatch wall-time goes. The mesh chunk loop (crypto/tpu/mesh.py)
+timestamps five phases on every chunk and feeds them here:
+
+* ``pack``    — host chunk materialization + pow2 zero-pad;
+* ``h2d``     — the explicit ``jax.device_put`` issue wall (on a
+  blocking backend this is the transfer; on an async device plane it
+  is the issue cost, with the remainder surfacing in d2h);
+* ``compute`` — the kernel dispatch call (async backends: issue cost;
+  the CPU fallback platform executes here);
+* ``d2h``     — the retire wait (``np.asarray`` on the verdict mask
+  blocks until the device finishes and the mask is copied back);
+* ``demux``   — scheduler-side verdict demultiplex into rider futures
+  (crypto/scheduler.py notes it at flush level).
+
+compute and d2h split differently per backend; their SUM is the
+device-side residency either way, and pack + h2d + compute + d2h
+reconciles with the dispatch wall time (the ledger records coverage =
+phase sum / wall per dispatch — the acceptance bound is within 10%).
+
+Overlap accounting: under the double-buffered pipeline
+(mesh.pipeline_depth) the host packs/transfers chunk N+1 while the
+device still owes chunk N's verdict. Transfer time spent while ≥1
+earlier chunk was in flight is HIDDEN — it costs no wall time.
+Overlap efficiency = hidden transfer seconds / total transfer seconds
+(1.0 = the pipe is fully saturated, 0 = every byte was paid serially).
+
+The ledger maintains EWMA cost profiles keyed by (route, pow2 bucket,
+device): per-phase p50/p99, bytes-on-wire per lane, effective link
+bandwidth, and the pipeline overlap ratio. It registers as a
+TelemetryHub source ("wire" in /debug/verify), exports the
+``verify_wire_*`` metric family, and answers cost queries through
+:class:`CostProfile` — the exact interface ROADMAP item 5b's learned
+router consumes. Cold profiles are seeded from the persisted link
+probe (tools/tpu_link_probe.py --merge → calibrate.load_link_profile).
+
+Hot-path contract (bench_micro's wire section bounds it under 1%):
+note_* methods are deque appends, EWMA folds, and counter bumps under
+one short lock; all percentile math happens at snapshot time.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from cometbft_tpu.libs.metrics import MICRO_BUCKETS, Registry
+
+SUBSYSTEM = "verify_wire"
+
+# Chunk-level phases (measured in the mesh dispatch loop). demux is the
+# fifth phase, measured at flush level by the scheduler.
+CHUNK_PHASES = ("pack", "h2d", "compute", "d2h")
+PHASES = CHUNK_PHASES + ("demux",)
+
+DEFAULT_WINDOW = 64     # EWMA window (samples); alpha = 2 / (window + 1)
+_MAX_SAMPLES = 512      # per-phase percentile retention per profile
+_MAX_DISPATCHES = 128   # recent dispatch records kept for reconciliation
+# ed25519 verify wire: 32 B pubkey + 64 B sig + 32 B SHA-512 prefix per
+# lane — the cold-boot bytes/lane guess before any chunk is observed.
+DEFAULT_BYTES_PER_LANE = 128.0
+
+
+def wire_ledger_default(config_value: bool = True) -> bool:
+    """Resolve the wire-ledger enable knob: an explicitly-set
+    CBFT_WIRE_LEDGER env var wins over [instrumentation] wire_ledger."""
+    raw = os.environ.get("CBFT_WIRE_LEDGER")
+    if raw is not None:
+        return raw.strip().lower() not in ("0", "false", "no", "off", "")
+    return bool(config_value)
+
+
+def wire_window_default(config_value: Optional[int] = None) -> int:
+    """Resolve the EWMA window (samples): CBFT_WIRE_WINDOW env >
+    [instrumentation] wire_window > DEFAULT_WINDOW."""
+    raw = os.environ.get("CBFT_WIRE_WINDOW")
+    if raw is not None:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    if config_value is not None:
+        return max(1, int(config_value))
+    return DEFAULT_WINDOW
+
+
+class Metrics:
+    """verify_wire_* export (libs/metrics.py instruments), wired into
+    the node's Prometheus registry when [instrumentation] enables it.
+    Phase latencies use MICRO_BUCKETS — the wire phases live at µs-to-ms
+    scale on a healthy link."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry if registry is not None else Registry()
+        self.phase_seconds = r.histogram(
+            SUBSYSTEM, "phase_seconds",
+            "Per-dispatch-phase wall seconds (pack / h2d / compute / "
+            "d2h per chunk, demux per flush), by phase and route.",
+            buckets=MICRO_BUCKETS,
+        )
+        self.chunks = r.counter(
+            SUBSYSTEM, "chunks",
+            "Chunk dispatches attributed by the wire ledger, by route.",
+        )
+        self.dispatches = r.counter(
+            SUBSYSTEM, "dispatches",
+            "Whole batch dispatches attributed by the wire ledger, by "
+            "route.",
+        )
+        self.bytes_on_wire = r.counter(
+            SUBSYSTEM, "bytes",
+            "Bytes shipped H2D by attributed dispatches (padded wire "
+            "bytes), by device label.",
+        )
+        self.lanes = r.counter(
+            SUBSYSTEM, "lanes",
+            "Real signature lanes carried by attributed chunks, by "
+            "route.",
+        )
+        self.overlap_ratio = r.gauge(
+            SUBSYSTEM, "overlap_ratio",
+            "Pipeline overlap efficiency of the latest attributed "
+            "dispatch: hidden transfer seconds / total transfer "
+            "seconds, by route (1.0 = transfer fully hidden behind "
+            "compute).",
+        )
+        self.effective_mbps = r.gauge(
+            SUBSYSTEM, "effective_mbps",
+            "Effective H2D link bandwidth of the latest attributed "
+            "chunk (wire bytes / h2d seconds, MB/s), by device label.",
+        )
+        self.coverage = r.gauge(
+            SUBSYSTEM, "coverage",
+            "Phase-sum / dispatch-wall reconciliation of the latest "
+            "attributed dispatch, by route (1.0 = the five phases "
+            "account for the whole dispatch).",
+        )
+
+    @classmethod
+    def nop(cls) -> "Metrics":
+        return cls(None)
+
+
+def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile over an ascending list; None when empty."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    size = max(1, int(floor))
+    n = max(1, int(n))
+    while size < n:
+        size *= 2
+    return size
+
+
+class _Profile:
+    """EWMA cost profile for one (route, bucket, device) key."""
+
+    __slots__ = (
+        "n", "ewma_s", "samples", "bytes_ewma", "lanes_ewma",
+        "bw_ewma", "hidden_s", "h2d_s",
+    )
+
+    def __init__(self):
+        self.n = 0
+        self.ewma_s = {p: 0.0 for p in CHUNK_PHASES}
+        self.samples = {
+            p: deque(maxlen=_MAX_SAMPLES) for p in CHUNK_PHASES
+        }
+        self.bytes_ewma = 0.0   # padded wire bytes per chunk
+        self.lanes_ewma = 0.0   # real lanes per chunk
+        self.bw_ewma = 0.0      # MB/s over the h2d window
+        self.hidden_s = 0.0     # cumulative hidden transfer seconds
+        self.h2d_s = 0.0        # cumulative total transfer seconds
+
+    def overlap(self) -> Optional[float]:
+        if self.h2d_s <= 0.0:
+            return None
+        return max(0.0, min(1.0, self.hidden_s / self.h2d_s))
+
+    def per_chunk_ms(self) -> float:
+        return sum(self.ewma_s[p] for p in CHUNK_PHASES) * 1e3
+
+
+class _DemuxStat:
+    """EWMA + samples for the scheduler-side demux phase, keyed by
+    (route, pow2 bucket of the flush)."""
+
+    __slots__ = ("n", "ewma_s", "samples")
+
+    def __init__(self):
+        self.n = 0
+        self.ewma_s = 0.0
+        self.samples: deque = deque(maxlen=_MAX_SAMPLES)
+
+
+class WireLedger:
+    """Continuous per-phase dispatch attribution with EWMA cost
+    profiles keyed by (route, pow2 bucket, device). Thread-safe; the
+    note_* feeders are the hot path, snapshot()/predict_ms() do the
+    aggregation."""
+
+    def __init__(
+        self,
+        metrics: Optional[Metrics] = None,
+        window: Optional[int] = None,
+        link: Optional[dict] = None,
+    ):
+        self.metrics = metrics if metrics is not None else Metrics.nop()
+        self.window = max(1, int(window)) if window else DEFAULT_WINDOW
+        self._alpha = 2.0 / (self.window + 1.0)
+        self._lock = threading.Lock()
+        self._profiles: Dict[Tuple[str, int, str], _Profile] = {}
+        self._demux: Dict[Tuple[str, int], _DemuxStat] = {}
+        self._recent: deque = deque(maxlen=_MAX_DISPATCHES)
+        self.chunks = 0
+        self.n_dispatches = 0
+        self.demux_notes = 0
+        self._link = dict(link) if link else None
+
+    # --- cold-boot link seed -------------------------------------------------
+
+    def seed_link(self, probe: dict) -> None:
+        """Install a measured link curve (tools/tpu_link_probe.py
+        output shape) as the cold-boot prediction seed and the
+        verify_top bandwidth ceiling."""
+        if isinstance(probe, dict) and probe:
+            with self._lock:
+                self._link = dict(probe)
+
+    def link(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._link) if self._link else None
+
+    # --- hot-path feeders ----------------------------------------------------
+
+    def note_chunk(
+        self,
+        route: str,
+        device: str,
+        bucket: int,
+        lanes: int,
+        wire_bytes: int,
+        pack_s: float,
+        h2d_s: float,
+        compute_s: float,
+        d2h_s: float,
+        hidden_s: float = 0.0,
+    ) -> None:
+        """One chunk's phase attribution from the mesh dispatch loop.
+        ``hidden_s`` is the portion of ``h2d_s`` spent while an earlier
+        chunk was still in flight (paid no wall time)."""
+        a = self._alpha
+        bucket = int(bucket)
+        phases = (
+            ("pack", max(0.0, pack_s)),
+            ("h2d", max(0.0, h2d_s)),
+            ("compute", max(0.0, compute_s)),
+            ("d2h", max(0.0, d2h_s)),
+        )
+        bw = 0.0
+        if h2d_s > 0.0 and wire_bytes > 0:
+            bw = wire_bytes / h2d_s / 1e6
+        with self._lock:
+            self.chunks += 1
+            key = (route, bucket, device)
+            p = self._profiles.get(key)
+            if p is None:
+                p = self._profiles[key] = _Profile()
+            first = p.n == 0
+            p.n += 1
+            for name, v in phases:
+                p.ewma_s[name] = (
+                    v if first else p.ewma_s[name] + a * (v - p.ewma_s[name])
+                )
+                p.samples[name].append(v)
+            p.bytes_ewma = (
+                float(wire_bytes) if first
+                else p.bytes_ewma + a * (wire_bytes - p.bytes_ewma)
+            )
+            p.lanes_ewma = (
+                float(lanes) if first
+                else p.lanes_ewma + a * (lanes - p.lanes_ewma)
+            )
+            if bw > 0.0:
+                p.bw_ewma = (
+                    bw if p.bw_ewma <= 0.0
+                    else p.bw_ewma + a * (bw - p.bw_ewma)
+                )
+            p.hidden_s += max(0.0, min(hidden_s, h2d_s))
+            p.h2d_s += max(0.0, h2d_s)
+        m = self.metrics
+        for name, v in phases:
+            m.phase_seconds.with_labels(phase=name, route=route).observe(v)
+        m.chunks.with_labels(route=route).add()
+        m.lanes.with_labels(route=route).add(max(0, int(lanes)))
+        m.bytes_on_wire.with_labels(device=device).add(
+            max(0, int(wire_bytes))
+        )
+        if bw > 0.0:
+            m.effective_mbps.with_labels(device=device).set(round(bw, 2))
+
+    def note_dispatch(
+        self,
+        route: str,
+        device: str,
+        n: int,
+        wall_s: float,
+        pack_s: float,
+        h2d_s: float,
+        compute_s: float,
+        d2h_s: float,
+        hidden_s: float,
+        wire_bytes: int,
+        chunks: int,
+    ) -> None:
+        """One whole dispatch_batch/dispatch_sharded call: summed phase
+        seconds vs the observed wall — the reconciliation record the
+        acceptance bound (within 10%) is judged on."""
+        phase_s = pack_s + h2d_s + compute_s + d2h_s
+        coverage = (phase_s / wall_s) if wall_s > 0.0 else None
+        overlap = (
+            max(0.0, min(1.0, hidden_s / h2d_s)) if h2d_s > 0.0 else None
+        )
+        rec = {
+            "route": route,
+            "device": device,
+            "n": int(n),
+            "chunks": int(chunks),
+            "wall_ms": round(wall_s * 1e3, 3),
+            "pack_ms": round(pack_s * 1e3, 3),
+            "h2d_ms": round(h2d_s * 1e3, 3),
+            "compute_ms": round(compute_s * 1e3, 3),
+            "d2h_ms": round(d2h_s * 1e3, 3),
+            "hidden_ms": round(hidden_s * 1e3, 3),
+            "bytes": int(wire_bytes),
+            "coverage": round(coverage, 4) if coverage is not None else None,
+            "overlap": round(overlap, 4) if overlap is not None else None,
+        }
+        with self._lock:
+            self.n_dispatches += 1
+            self._recent.append(rec)
+        m = self.metrics
+        m.dispatches.with_labels(route=route).add()
+        if overlap is not None:
+            m.overlap_ratio.with_labels(route=route).set(round(overlap, 4))
+        if coverage is not None:
+            m.coverage.with_labels(route=route).set(round(coverage, 4))
+
+    def note_demux(self, route: str, n_sigs: int, demux_s: float) -> None:
+        """The scheduler's verdict-demux wall for one coalesced flush."""
+        a = self._alpha
+        bucket = _pow2(n_sigs)
+        demux_s = max(0.0, demux_s)
+        with self._lock:
+            self.demux_notes += 1
+            key = (route, bucket)
+            d = self._demux.get(key)
+            if d is None:
+                d = self._demux[key] = _DemuxStat()
+            d.ewma_s = (
+                demux_s if d.n == 0 else d.ewma_s + a * (demux_s - d.ewma_s)
+            )
+            d.n += 1
+            d.samples.append(demux_s)
+        self.metrics.phase_seconds.with_labels(
+            phase="demux", route=route
+        ).observe(demux_s)
+
+    # --- cost queries --------------------------------------------------------
+
+    def predict_ms(
+        self, route: str, bucket: int, device: Optional[str] = None
+    ) -> Optional[float]:
+        """Predicted wall ms for a hypothetical dispatch of ``bucket``
+        lanes on ``route`` — warm profiles first (exact bucket, then
+        the nearest measured bucket scaled around the link's fixed
+        latency), then the cold link-probe seed; None when neither
+        exists. This is the CostProfile interface the learned router
+        (ROADMAP item 5b) consumes."""
+        bucket = _pow2(bucket)
+        with self._lock:
+            cands = [
+                (k[1], p) for k, p in self._profiles.items()
+                if k[0] == route and p.n > 0
+                and (device is None or k[2] == device)
+            ]
+            link = dict(self._link) if self._link else {}
+        if cands:
+            exact = [(b, p) for b, p in cands if b == bucket]
+            if exact:
+                # multiple devices at this bucket: trust the most seen
+                _, p = max(exact, key=lambda bp: bp[1].n)
+                return p.per_chunk_ms()
+            # nearest measured bucket in log space, best-observed first
+            b0, p = min(
+                cands,
+                key=lambda bp: (abs(bp[0].bit_length() - bucket.bit_length()),
+                                -bp[1].n),
+            )
+            per_chunk = p.per_chunk_ms()
+            fixed = min(self._link_fixed_ms_from(link), per_chunk)
+            if bucket <= b0:
+                # scale only the size-dependent part down
+                return fixed + (per_chunk - fixed) * (bucket / b0)
+            # bigger than any measured chunk: the dispatcher would split
+            # into ceil(bucket / b0) chunks; pipelining hides the
+            # observed overlap fraction of each follow-up chunk's
+            # transfer
+            n_chunks = -(-bucket // b0)
+            hidden_ms = (p.overlap() or 0.0) * p.ewma_s["h2d"] * 1e3
+            return per_chunk * n_chunks - hidden_ms * (n_chunks - 1)
+        # cold: the probed link curve
+        if link:
+            try:
+                mbps = float(link.get("effective_MBps", 0.0))
+            except (TypeError, ValueError):
+                mbps = 0.0
+            fixed = self._link_fixed_ms_from(link)
+            if mbps > 0.0 or fixed > 0.0:
+                xfer = (
+                    bucket * DEFAULT_BYTES_PER_LANE / (mbps * 1e6) * 1e3
+                    if mbps > 0.0 else 0.0
+                )
+                return fixed + xfer
+        return None
+
+    @staticmethod
+    def _link_fixed_ms_from(link: dict) -> float:
+        fixed = 0.0
+        for k in ("fixed_latency_ms_est", "kernel_roundtrip_ms"):
+            try:
+                fixed += float(link.get(k, 0.0))
+            except (TypeError, ValueError):
+                pass
+        return fixed
+
+    def observations(
+        self, route: str, bucket: int, device: Optional[str] = None
+    ) -> int:
+        """How many chunks back the (route, bucket) profile — the ≥5
+        warm-up bound callers gate predictions on."""
+        bucket = _pow2(bucket)
+        with self._lock:
+            return sum(
+                p.n for k, p in self._profiles.items()
+                if k[0] == route and k[1] == bucket
+                and (device is None or k[2] == device)
+            )
+
+    def cost_profile(self) -> "CostProfile":
+        return CostProfile(self)
+
+    # --- snapshot (TelemetryHub source "wire") -------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /debug/verify wire section: per-(route, bucket, device)
+        phase EWMAs + p50/p99, bytes/lane, effective bandwidth, overlap
+        ratio, demux stats, the probed link ceiling, and the most
+        recent dispatch reconciliation records."""
+        with self._lock:
+            profiles = [
+                (k, p.n, dict(p.ewma_s),
+                 {ph: sorted(p.samples[ph]) for ph in CHUNK_PHASES},
+                 p.bytes_ewma, p.lanes_ewma, p.bw_ewma, p.overlap())
+                for k, p in self._profiles.items()
+            ]
+            demux = [
+                (k, d.n, d.ewma_s, sorted(d.samples))
+                for k, d in self._demux.items()
+            ]
+            recent = list(self._recent)[-8:]
+            link = dict(self._link) if self._link else None
+            counters = (self.chunks, self.n_dispatches, self.demux_notes)
+        prof_rows = []
+        for (route, bucket, device), n, ewma, samples, b_ewma, l_ewma, \
+                bw, overlap in sorted(profiles, key=lambda t: t[0]):
+            phases_ms = {}
+            for ph in CHUNK_PHASES:
+                vals = samples[ph]
+                phases_ms[ph] = {
+                    "ewma": round(ewma[ph] * 1e3, 3),
+                    "p50": round((_percentile(vals, 0.50) or 0.0) * 1e3, 3),
+                    "p99": round((_percentile(vals, 0.99) or 0.0) * 1e3, 3),
+                }
+            bpl = (b_ewma / l_ewma) if l_ewma > 0 else None
+            prof_rows.append({
+                "route": route,
+                "bucket": bucket,
+                "device": device,
+                "n": n,
+                "phases_ms": phases_ms,
+                "bytes_per_lane": round(bpl, 1) if bpl else None,
+                "effective_MBps": round(bw, 2) if bw > 0 else None,
+                "overlap": round(overlap, 4) if overlap is not None else None,
+                "predicted_ms": (
+                    round(pred, 3) if (pred := self.predict_ms(
+                        route, bucket, device
+                    )) is not None else None
+                ),
+            })
+        demux_rows = [
+            {
+                "route": route,
+                "bucket": bucket,
+                "n": n,
+                "ewma_ms": round(ewma * 1e3, 4),
+                "p50_ms": round((_percentile(vals, 0.50) or 0.0) * 1e3, 4),
+                "p99_ms": round((_percentile(vals, 0.99) or 0.0) * 1e3, 4),
+            }
+            for (route, bucket), n, ewma, vals in sorted(
+                demux, key=lambda t: t[0]
+            )
+        ]
+        return {
+            "window": self.window,
+            "chunks": counters[0],
+            "dispatches": counters[1],
+            "demux_notes": counters[2],
+            "link": link,
+            "profiles": prof_rows,
+            "demux": demux_rows,
+            "recent": recent,
+        }
+
+
+class CostProfile:
+    """Queryable dispatch-cost prediction over a WireLedger — the
+    interface the learned cost-model router (ROADMAP item 5b) will
+    consume. predict_ms answers for a hypothetical (route, pow2
+    bucket); observations() reports how warm that key is."""
+
+    def __init__(self, ledger: WireLedger):
+        self._ledger = ledger
+
+    def predict_ms(
+        self, route: str, bucket: int, device: Optional[str] = None
+    ) -> Optional[float]:
+        return self._ledger.predict_ms(route, bucket, device=device)
+
+    def observations(
+        self, route: str, bucket: int, device: Optional[str] = None
+    ) -> int:
+        return self._ledger.observations(route, bucket, device=device)
+
+
+# --- process default ---------------------------------------------------------
+# Installed by node start (gated by [instrumentation] wire_ledger /
+# CBFT_WIRE_LEDGER); the mesh dispatch loop and the scheduler consult
+# it with one attribute read, same pattern as telemetry.default_hub.
+
+_default_mtx = threading.Lock()
+_default_ledger: Optional[WireLedger] = None
+
+
+def default_ledger() -> Optional[WireLedger]:
+    """The process-default wire ledger, or None (attribution off)."""
+    return _default_ledger
+
+
+def set_default_ledger(
+    ledger: Optional[WireLedger],
+) -> Optional[WireLedger]:
+    """Install ``ledger`` as the process default; returns the previous
+    default so callers can restore it (tests, benches)."""
+    global _default_ledger
+    with _default_mtx:
+        prev = _default_ledger
+        _default_ledger = ledger
+        return prev
+
+
+def seed_from_calibration(ledger: Optional[WireLedger] = None) -> bool:
+    """Seed ``ledger`` (default: the process default) with the link
+    curve persisted by ``tools/tpu_link_probe.py --merge``
+    (calibrate.load_link_profile). → True when a curve was installed."""
+    target = ledger if ledger is not None else default_ledger()
+    if target is None:
+        return False
+    try:
+        from cometbft_tpu.crypto.tpu import calibrate
+
+        profile = calibrate.load_link_profile()
+    except Exception:  # noqa: BLE001 - seeding is best-effort
+        return False
+    if not profile:
+        return False
+    target.seed_link(profile)
+    return True

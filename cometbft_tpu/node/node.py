@@ -181,6 +181,7 @@ class Node(BaseService):
         from cometbft_tpu.crypto.qos import QoSMetrics
         from cometbft_tpu.crypto.tpu.aot import Metrics as AotMetrics
         from cometbft_tpu.crypto.tpu.memory import Metrics as MemPlaneMetrics
+        from cometbft_tpu.crypto.wire import Metrics as WireMetrics
 
         if config.instrumentation.prometheus:
             self.metrics_registry = Registry(
@@ -196,6 +197,7 @@ class Node(BaseService):
             aot_metrics = AotMetrics(self.metrics_registry)
             tel_metrics = TelMetrics(self.metrics_registry)
             memplane_metrics = MemPlaneMetrics(self.metrics_registry)
+            wire_metrics = WireMetrics(self.metrics_registry)
         else:
             self.metrics_registry = None
             cons_metrics = ConsMetrics.nop()
@@ -208,6 +210,7 @@ class Node(BaseService):
             aot_metrics = AotMetrics.nop()
             tel_metrics = TelMetrics.nop()
             memplane_metrics = MemPlaneMetrics.nop()
+            wire_metrics = WireMetrics.nop()
         # the AOT executable registry is process-global (it backs the
         # mesh dispatch layer, which predates any Node); the node only
         # lends it an exporter, exactly like the topology default above
@@ -307,6 +310,31 @@ class Node(BaseService):
         self.telemetry_hub.register_source(
             "memory", self.memory_plane.snapshot
         )
+
+        # 0g. the wire ledger (crypto/wire.py): continuous per-phase
+        # dispatch attribution (pack / h2d / compute / d2h / demux) with
+        # EWMA cost profiles per (route, bucket, device). Installed as
+        # the process default so the mesh chunk loop and the scheduler's
+        # demux loop feed it without plumbing; seeded cold from the
+        # calibration store's link profile (tools/tpu_link_probe.py
+        # --merge) so CostProfile.predict_ms answers before the first
+        # live dispatch lands.
+        from cometbft_tpu.crypto import wire as wirelib
+
+        if wirelib.wire_ledger_default(config.instrumentation.wire_ledger):
+            self.wire_ledger = wirelib.WireLedger(
+                metrics=wire_metrics,
+                window=wirelib.wire_window_default(
+                    config.instrumentation.wire_window
+                ),
+            )
+            wirelib.seed_from_calibration(self.wire_ledger)
+            wirelib.set_default_ledger(self.wire_ledger)
+            self.telemetry_hub.register_source(
+                "wire", self.wire_ledger.snapshot
+            )
+        else:
+            self.wire_ledger = None
 
         # 0f. the incident profiler (libs/profiling.py): bounded one-shot
         # jax.profiler captures into NODE_HOME/data/profiles — on demand
@@ -986,6 +1014,16 @@ class Node(BaseService):
 
             if telemetrylib.default_hub() is self.telemetry_hub:
                 telemetrylib.set_default_hub(None)
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            pass
+        # same for the wire ledger — a later node's dispatches must not
+        # fold into a stopped node's cost profiles
+        try:
+            from cometbft_tpu.crypto import wire as wirelib
+
+            ledger = getattr(self, "wire_ledger", None)
+            if ledger is not None and wirelib.default_ledger() is ledger:
+                wirelib.set_default_ledger(None)
         except Exception:  # noqa: BLE001 - teardown is best-effort
             pass
         # same for the memory plane — and fold what it LEARNED (observed

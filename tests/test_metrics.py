@@ -330,6 +330,46 @@ class TestExpositionConformance:
         finally:
             handle.reset_chunk_shrink()
 
+    def test_verify_wire_family_conformance(self):
+        """The wire ledger's verify_wire_* families, driven by a real
+        WireLedger (chunk + dispatch + demux notes), must survive the
+        strict v0.0.4 parse with every phase and route label intact."""
+        from cometbft_tpu.crypto import wire as wirelib
+
+        r = Registry("cometbft")
+        ledger = wirelib.WireLedger(metrics=wirelib.Metrics(r), window=8)
+        ledger.note_chunk(
+            "single", "dev0", 256, 200, 1024,
+            pack_s=1e-4, h2d_s=2e-3, compute_s=5e-4, d2h_s=1e-4,
+            hidden_s=1e-3,
+        )
+        ledger.note_dispatch(
+            "single", "dev0", 200, wall_s=3e-3,
+            pack_s=1e-4, h2d_s=2e-3, compute_s=5e-4, d2h_s=1e-4,
+            hidden_s=1e-3, wire_bytes=1024, chunks=1,
+        )
+        ledger.note_demux("cpu", 200, 5e-5)
+        types, samples = _parse_exposition(r.expose())
+        assert types["cometbft_verify_wire_phase_seconds"] == "histogram"
+        for counter in ("chunks", "dispatches", "bytes", "lanes"):
+            assert types[f"cometbft_verify_wire_{counter}"] == "counter"
+        for gauge in ("overlap_ratio", "effective_mbps", "coverage"):
+            assert types[f"cometbft_verify_wire_{gauge}"] == "gauge"
+        phase_counts = {
+            (l.get("phase"), l.get("route")): v
+            for n, l, v in samples
+            if n == "cometbft_verify_wire_phase_seconds_count"
+        }
+        for phase in ("pack", "h2d", "compute", "d2h"):
+            assert phase_counts.get((phase, "single")) == 1.0, (
+                f"phase {phase} must surface as a labeled series"
+            )
+        assert phase_counts.get(("demux", "cpu")) == 1.0
+        assert ("cometbft_verify_wire_bytes", {"device": "dev0"},
+                1024.0) in samples
+        assert ("cometbft_verify_wire_overlap_ratio",
+                {"route": "single"}, 0.5) in samples
+
 
 class TestConcurrencyHammer:
     def test_with_labels_races_expose(self):

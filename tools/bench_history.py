@@ -113,6 +113,11 @@ def direction(path: str, unit: Optional[str] = None) -> Optional[str]:
         return None
     if "sigs_per_sec" in leaf or "per_sec" in leaf:
         return HIGHER_IS_BETTER
+    # wire-path phases (bench.py tpu_breakdown → host prepare + H2D
+    # transfer): explicit so a suffix-rule rework can't silently drop
+    # the link-regression guard
+    if leaf.endswith(("_transfer_ms", "_prepare_ms")):
+        return LOWER_IS_BETTER
     if leaf.endswith(("_ms", "_s", "_us", "_ns")) or "_ms_" in leaf:
         return LOWER_IS_BETTER
     return None

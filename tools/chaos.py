@@ -1,6 +1,6 @@
 """Standalone chaos harness against the supervised verify plane.
 
-Six modes:
+Seven modes:
 
 * default (smoke) — crypto/faults.py run_chaos_smoke: a fast,
   deterministic walk of every degradation-ladder rung (transient retry,
@@ -47,6 +47,13 @@ Six modes:
   future carries ground-truth verdicts. The SAME flood is then replayed
   with CBFT_QOS_CLASSES=off and must blow the same latency bound — the
   contrast that proves the admission layer is load-bearing.
+
+* --wire — crypto/faults.py run_chaos_wire: the wire-ledger attribution
+  rung. Every jax.device_put is stretched by a seeded jitter draw (a
+  jittery link) around an otherwise clean dispatch; asserts the ledger
+  blames the slowdown on the h2d transfer phase (grew by at least half
+  the injected sleep) and NOT compute (stays flat), with every verdict
+  still ground-truth-exact. Fast and deterministic; runs in tier-1 CI.
 
 * --soak — crypto/faults.py run_chaos_soak: a randomized fault schedule
   (exceptions, hangs, silent verdict corruption, sudden death, jitter,
@@ -133,6 +140,13 @@ def main() -> int:
                     help="[memory-guard] allocator-model lane threshold "
                          "above which the injected OOM fires "
                          "(default 256)")
+    ap.add_argument("--wire", action="store_true",
+                    help="run the wire-ledger attribution rung: a "
+                         "jittery link (stretched device_put) must show "
+                         "up in the ledger's h2d phase, not compute")
+    ap.add_argument("--jitter-ms", type=float, default=25.0,
+                    help="[wire] per-put jitter draw ceiling "
+                         "(default 25)")
     args = ap.parse_args()
 
     if args.inner == "cpu":
@@ -166,6 +180,26 @@ def main() -> int:
             and summary["device_resumed_after_recovery"]
         )
         print("CHAOS SOAK", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+
+    if args.wire:
+        from cometbft_tpu.crypto.faults import run_chaos_wire
+
+        summary = run_chaos_wire(
+            seed=args.seed, jitter_ms=args.jitter_ms,
+        )
+        print(json.dumps(summary, indent=2))
+        # run_chaos_wire asserts the invariants inline; re-check the
+        # headline ones here so --wire reads like the other rungs
+        ok = (
+            summary["ok"]
+            and summary["injected_jitter_ms"] > 0
+            and summary["h2d_delta_ms"]
+            >= 0.5 * summary["injected_jitter_ms"]
+            and summary["compute_delta_ms"]
+            <= max(5.0, 0.25 * summary["injected_jitter_ms"])
+        )
+        print("CHAOS WIRE", "PASS" if ok else "FAIL")
         return 0 if ok else 1
 
     if args.overload:

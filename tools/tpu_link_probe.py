@@ -9,8 +9,14 @@ shrinking bytes/sig further (resident validator-set pubkeys) wins.
 
 Prints progressive JSON lines; the LAST line is the complete result.
 Run ONLY when the tunnel is up; bounded by the caller's timeout.
+
+``--merge`` additionally persists the measured curve into the
+calibration store (crypto/tpu/calibrate.py, table["link"]), seeding the
+wire ledger's CostProfile cold-boot predictions (crypto/wire.py); the
+merge notice goes to stderr so the last-stdout-line contract holds.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -22,7 +28,22 @@ os.environ.setdefault("CBFT_TPU_PROBE", "0")
 import numpy as np  # noqa: E402
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Characterize the TPU link: latency vs bandwidth."
+    )
+    ap.add_argument(
+        "--merge", action="store_true",
+        help="persist the measured curve into the calibration store "
+             "(seeds crypto/wire.py CostProfile cold boots)",
+    )
+    ap.add_argument(
+        "--calibration", default=None, metavar="PATH",
+        help="calibration table path for --merge "
+             "(default: CBFT_TPU_CALIBRATION / the store's default)",
+    )
+    args = ap.parse_args(argv)
+
     import jax
     import jax.numpy as jnp
 
@@ -66,6 +87,21 @@ def main():
     )
     print(json.dumps(out), flush=True)
 
+    if args.merge:
+        from cometbft_tpu.crypto.tpu import calibrate
+
+        table = calibrate.merge_link_profile(out, path=args.calibration)
+        path = args.calibration or calibrate.table_path()
+        if table is not None:
+            print(f"link profile merged into {path}", file=sys.stderr)
+        else:
+            print(
+                f"link profile NOT merged (no usable path: {path!r})",
+                file=sys.stderr,
+            )
+            return 1
+    return 0
+
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

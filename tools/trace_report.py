@@ -84,6 +84,62 @@ def stage_table(traces: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         if dev_ns or host_ns:
             row["device_ms"] = round(dev_ns / 1e6, 3)
             row["host_ms"] = round(host_ns / 1e6, 3)
+        # wire-phase column group: mesh chunk spans carry per-phase
+        # attribution tags (crypto/tpu/mesh.py wire instrumentation)
+        wire_ns = {
+            col: sum(
+                int(s.get("tags", {}).get(tag, 0)) for s in spans
+            )
+            for col, tag in (
+                ("pack_ms", "pack_ns"), ("h2d_ms", "h2d_ns"),
+                ("compute_ms", "compute_ns"), ("hidden_ms", "hidden_ns"),
+            )
+        }
+        if any(wire_ns.values()):
+            for col, ns in wire_ns.items():
+                row[col] = round(ns / 1e6, 3)
+        rows.append(row)
+    return rows
+
+
+_WIRE_PHASE_TAGS = (
+    ("pack", "pack_ns"),
+    ("h2d", "h2d_ns"),
+    ("compute", "compute_ns"),
+    ("d2h", "device_wait_ns"),
+)
+
+
+def wire_table(traces: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per-bucket wire-phase summary over the mesh chunk spans: phase
+    p50/p95 (ms) per (stage, pad bucket) plus the pipeline overlap ratio
+    (hidden transfer ÷ total transfer). Empty when the dump predates the
+    wire instrumentation (no pack_ns tags)."""
+    by_bucket: Dict[Tuple[str, int], List[Dict[str, Any]]] = {}
+    for tr in traces:
+        for sp in tr.get("spans", ()):
+            tags = sp.get("tags") or {}
+            if "pack_ns" not in tags or "pad" not in tags:
+                continue
+            key = (sp.get("name", "?"), int(tags["pad"]))
+            by_bucket.setdefault(key, []).append(tags)
+    rows = []
+    for (stage, bucket), tag_rows in sorted(by_bucket.items()):
+        row: Dict[str, Any] = {
+            "stage": stage, "bucket": bucket, "chunks": len(tag_rows),
+        }
+        for phase, tag in _WIRE_PHASE_TAGS:
+            vals = sorted(
+                int(t.get(tag, 0)) / 1e6 for t in tag_rows
+            )
+            row[f"{phase}_p50_ms"] = round(_percentile(vals, 0.50), 3)
+            row[f"{phase}_p95_ms"] = round(_percentile(vals, 0.95), 3)
+        h2d_ns = sum(int(t.get("h2d_ns", 0)) for t in tag_rows)
+        hidden_ns = sum(int(t.get("hidden_ns", 0)) for t in tag_rows)
+        row["overlap"] = (
+            f"{min(1.0, hidden_ns / h2d_ns) * 100:.1f}%"
+            if h2d_ns > 0 else "-"
+        )
         rows.append(row)
     return rows
 
@@ -119,6 +175,7 @@ def render(
     meta: Dict[str, Any],
     traces: List[Dict[str, Any]],
     top: int = 5,
+    wire: bool = False,
 ) -> str:
     out = []
     if meta.get("reason"):
@@ -130,10 +187,24 @@ def render(
     out.append("")
     out.append("per-stage latency breakdown:")
     cols = ["stage", "count", "p50_us", "p95_us", "max_us", "total_ms",
-            "device_ms", "host_ms"]
+            "device_ms", "host_ms", "pack_ms", "h2d_ms", "compute_ms",
+            "hidden_ms"]
     rows = stage_table(traces)
     used = [c for c in cols if any(c in r for r in rows)] or cols[:6]
     out.append(_fmt_table(rows, used))
+    if wire:
+        out.append("")
+        out.append("wire phases per bucket (chunk spans):")
+        wrows = wire_table(traces)
+        if wrows:
+            wcols = ["stage", "bucket", "chunks"]
+            for phase, _ in _WIRE_PHASE_TAGS:
+                wcols += [f"{phase}_p50_ms", f"{phase}_p95_ms"]
+            wcols.append("overlap")
+            out.append(_fmt_table(wrows, wcols))
+        else:
+            out.append("(no wire-phase tags — dump predates the wire "
+                       "instrumentation or tracing sampled no chunks)")
     out.append("")
     out.append(f"top {min(top, len(traces))} slowest traces:")
     for tr in slowest(traces, top):
@@ -169,13 +240,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--chrome", metavar="OUT",
         help="also write Chrome trace-event JSON (open in Perfetto)",
     )
+    ap.add_argument(
+        "--wire", action="store_true",
+        help="add the per-bucket wire-phase summary (phase p50/p95 + "
+             "pipeline overlap ratio from the mesh chunk spans)",
+    )
     args = ap.parse_args(argv)
     try:
         meta, traces = load_traces(args.source)
     except (OSError, ValueError, json.JSONDecodeError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
-    print(render(meta, traces, top=args.top))
+    print(render(meta, traces, top=args.top, wire=args.wire))
     if args.chrome:
         from cometbft_tpu.libs.trace import chrome_trace
 

@@ -71,6 +71,30 @@ def _pct(v: Optional[float]) -> str:
     return "-" if v is None else f"{v * 100:.1f}%"
 
 
+_PHASE_GLYPHS = "phcd"  # pack, h2d, compute, d2h
+
+
+def _phase_bar(phase_ms: List[float], width: int = 16) -> str:
+    """Fixed-width ASCII bar splitting ``width`` cells proportionally
+    over the four chunk phases (p=pack h=h2d c=compute d=d2h) — the
+    at-a-glance "where does the dispatch wall go" read."""
+    total = sum(v for v in phase_ms if isinstance(v, (int, float)) and v > 0)
+    if total <= 0:
+        return "-" * width
+    cells = []
+    for glyph, v in zip(_PHASE_GLYPHS, phase_ms):
+        if isinstance(v, (int, float)) and v > 0:
+            cells.append([glyph, v / total * width])
+    # round down, then hand leftover cells to the largest remainders so
+    # the bar is always exactly `width` wide
+    for c in cells:
+        c.append(int(c[1]))
+    short = width - sum(c[2] for c in cells)
+    for c in sorted(cells, key=lambda c: c[1] - c[2], reverse=True)[:short]:
+        c[2] += 1
+    return "".join(c[0] * c[2] for c in cells).ljust(width, "-")
+
+
 def _human_bytes(v: Any) -> str:
     if not isinstance(v, (int, float)):
         return "-"
@@ -213,6 +237,71 @@ def render(snap: Dict[str, Any]) -> str:
         ["device", "util", "busy_s", "sigs", "state", "chunk_cap",
          "capacity", "hbm_used", "hbm_free", "guard", "mem"],
     ))
+
+    wire = sources.get("wire", {}) if isinstance(sources, dict) else {}
+    profiles = wire.get("profiles") if isinstance(wire, dict) else None
+    if isinstance(profiles, list) and profiles:
+        out.append("")
+        out.append(
+            f"wire ledger (per-phase dispatch attribution, "
+            f"window={wire.get('window', '?')}, "
+            f"chunks={wire.get('chunks', 0)}):"
+        )
+        wire_rows = []
+        for p in sorted(
+            profiles,
+            key=lambda p: (p.get("route", ""), p.get("device", ""),
+                           int(p.get("bucket", 0))),
+        ):
+            phases = p.get("phases_ms", {})
+
+            def _p50(ph):
+                ent = phases.get(ph, {})
+                v = ent.get("p50")
+                return v if isinstance(v, (int, float)) else 0.0
+
+            wire_rows.append({
+                "route": p.get("route", "-"),
+                "bucket": p.get("bucket", "-"),
+                "device": p.get("device", "-"),
+                "n": p.get("n", "-"),
+                "pack_ms": _p50("pack"),
+                "h2d_ms": _p50("h2d"),
+                "comp_ms": _p50("compute"),
+                "d2h_ms": _p50("d2h"),
+                "phases": _phase_bar(
+                    [_p50("pack"), _p50("h2d"), _p50("compute"),
+                     _p50("d2h")]
+                ),
+                "overlap": _pct(p.get("overlap")),
+                "eff_MB/s": p.get("effective_MBps", "-"),
+                "pred_ms": p.get("predicted_ms", "-"),
+            })
+        out.append(_fmt_table(
+            wire_rows,
+            ["route", "bucket", "device", "n", "pack_ms", "h2d_ms",
+             "comp_ms", "d2h_ms", "phases", "overlap", "eff_MB/s",
+             "pred_ms"],
+        ))
+        link = wire.get("link")
+        if isinstance(link, dict):
+            ceiling = link.get("effective_MBps")
+            fixed = link.get("fixed_latency_ms_est")
+            out.append(
+                f"link ceiling (probed)  "
+                f"bw={ceiling if ceiling is not None else '-'}MB/s  "
+                f"fixed={fixed if fixed is not None else '-'}ms  "
+                f"platform={link.get('platform', '-')}"
+            )
+        demux = wire.get("demux")
+        if isinstance(demux, list) and demux:
+            out.append(
+                "demux  " + "  ".join(
+                    f"{d.get('route', '-')}/{d.get('bucket', '-')}="
+                    f"{d.get('ewma_ms', '-')}ms"
+                    for d in demux
+                )
+            )
 
     lat_rows = []
     for label in sorted(domains):
