@@ -328,11 +328,20 @@ class TPUBatchVerifier(BatchVerifier):
                 from cometbft_tpu.crypto.tpu import secp256k1_batch as kernel
             else:
                 from cometbft_tpu.crypto.tpu import sr25519_batch as kernel
-            ok = kernel.verify_batch(
-                [items[i][0].bytes() for i in idxs],
-                [items[i][1] for i in idxs],
-                [items[i][2] for i in idxs],
-            )
+            pks = [items[i][0].bytes() for i in idxs]
+            msgs = [items[i][1] for i in idxs]
+            sigs = [items[i][2] for i in idxs]
+            ok = None
+            if curve == ed.KEY_TYPE:
+                # steady-state flushes against a resident valset ship an
+                # index vector instead of the pubkeys (100 B/lane vs 128
+                # — crypto/tpu/keystore.py); None = no fresh entry
+                # covers the flush, fall through to the full wire
+                from cometbft_tpu.crypto.tpu import keystore
+
+                ok = keystore.verify_batch_indexed(pks, msgs, sigs)
+            if ok is None:
+                ok = kernel.verify_batch(pks, msgs, sigs)
             for j, i in enumerate(idxs):
                 mask[i] = bool(ok[j])
         final = [bool(m) for m in mask]

@@ -503,6 +503,16 @@ class VerifyScheduler(BaseService):
                 "routes": dict(self._routes),
                 "flush_reasons": dict(self._flush_reasons),
             }
+            # device key-store state rides along (resident valsets,
+            # generation, indexed-dispatch stats) — best-effort: the
+            # snapshot must work on CPU-only nodes where the tpu
+            # package may be degraded
+            try:
+                from cometbft_tpu.crypto.tpu import keystore
+
+                snap["keystore"] = keystore.default_store().snapshot()
+            except Exception:  # noqa: BLE001 - observability only
+                pass
             if not self._qos_enabled:
                 snap["qos"] = {"enabled": False}
                 return snap

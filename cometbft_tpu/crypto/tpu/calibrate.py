@@ -100,6 +100,17 @@ def ed25519_min_batch() -> Optional[int]:
     return _floor(load_table(), "ed25519_min_batch")
 
 
+def hash_device_min_batch() -> Optional[int]:
+    """Measured batch size above which on-device SHA-512 hashing
+    (verify_full_kernel_compact — message bytes ship raw, the padding
+    and digest run fused with the verify) beats host hashing, or None
+    when unmeasured / the device never won. hash_route() then keeps
+    SHA-512 on the host — round 5 measured the device-hash path LOSING
+    (38.8k vs 75.8k sigs/s at 16k), so an unproven crossover must never
+    open that route."""
+    return _floor(load_table(), "hash_device_min_batch")
+
+
 def _crossover(points: Dict[int, Tuple[float, float]]) -> Optional[int]:
     """Smallest measured size from which the device wins at EVERY
     larger measured size too — a single lucky window in the middle of
@@ -179,6 +190,37 @@ def run_calibration(
         for n, (d, c) in ed_pts.items()
     }
     table["ed25519_min_batch"] = _crossover(ed_pts)
+
+    # host-vs-device hashing crossover: same sizes, same dispatch route,
+    # only the SHA-512 placement differs — hash_route() consults the
+    # result instead of trusting an env flag. Convention matches
+    # _crossover: "device" = on-device hashing, "cpu" = host hashing.
+    hash_pts: Dict[int, Tuple[float, float]] = {}
+    for n in ed_sizes:
+        pks = [pk.bytes()] * n
+        msgs = [msg] * n
+        sigs = [sig] * n
+
+        def _route(mode):
+            prev = os.environ.get("CBFT_TPU_HASH")
+            os.environ["CBFT_TPU_HASH"] = mode
+            try:
+                ed25519_batch.verify_batch(pks, msgs, sigs)
+            finally:
+                if prev is None:
+                    os.environ.pop("CBFT_TPU_HASH", None)
+                else:
+                    os.environ["CBFT_TPU_HASH"] = prev
+
+        hash_pts[n] = (
+            _best_ms(lambda: _route("device"), reps),
+            _best_ms(lambda: _route("host"), reps),
+        )
+    table["hash"] = {
+        str(n): {"device_ms": round(d, 2), "host_ms": round(c, 2)}
+        for n, (d, c) in hash_pts.items()
+    }
+    table["hash_device_min_batch"] = _crossover(hash_pts)
     return table
 
 

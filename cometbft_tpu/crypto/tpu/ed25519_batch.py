@@ -48,8 +48,6 @@ multiplication — >99% of the FLOPs — is what the TPU executes.
 from __future__ import annotations
 
 import hashlib
-import threading
-from collections import OrderedDict
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -245,6 +243,16 @@ def unpack_digits(words: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(digs, axis=0)
 
 
+def bytes_to_words(rows: jnp.ndarray) -> jnp.ndarray:
+    """u8[4k,B] raw little-endian byte rows → u32[k,B] LE words, ON
+    DEVICE. The compact wire ships the 32-byte encodings exactly as they
+    appear in blocks (uint8), so the host never touches a word view; the
+    device pays three shifts and three ORs per word — noise next to the
+    253-doubling Straus loop."""
+    r = rows.astype(jnp.uint32)
+    return r[0::4] | (r[1::4] << 8) | (r[2::4] << 16) | (r[3::4] << 24)
+
+
 def _unpack_points_scalar(wire: jnp.ndarray):
     """Rows 0:24 of the wire (A, R, S — shared between the host-hash and
     device-hash layouts) → (ay, a_sign, r_y, r_sign, s_digits)."""
@@ -327,6 +335,18 @@ def _verify_core(wire: jnp.ndarray) -> jnp.ndarray:
 verify_kernel = jax.jit(_verify_core)
 
 
+def _verify_core_compact(wire: jnp.ndarray) -> jnp.ndarray:
+    """bool[B] from the COMPACT u8[128,B] wire (rows 0:32 A, 32:64 R,
+    64:96 S, 96:128 h — raw little-endian bytes). The whole decompress
+    prologue — byte→word packing, limb unpacking, sign extraction,
+    2-bit scalar windowing — runs fused in front of the Straus loop, so
+    the host pack is a byte transpose and nothing else."""
+    return _verify_unpacked(*unpack_wire(bytes_to_words(wire)))
+
+
+verify_kernel_compact = jax.jit(_verify_core_compact)
+
+
 @jax.jit
 def verify_full_kernel(
     wire: jnp.ndarray,  # u32[24,B]  rows 0:8 A, 8:16 R, 16:24 S (LE words)
@@ -344,6 +364,57 @@ def verify_full_kernel(
     h = scalar.sc_reduce(scalar.digest_to_limbs(dig_hi, dig_lo))
     h_digits = scalar.digits_msb_first(h)
     return _verify_unpacked(ay, a_sign, r_y, r_sign, s_digits, h_digits)
+
+
+@jax.jit
+def verify_full_kernel_compact(
+    wire: jnp.ndarray,  # u8[96,B]  rows 0:32 A, 32:64 R, 64:96 S (raw bytes)
+    msg: jnp.ndarray,  # u8[MP,B]  raw message bytes, zero-filled past mlen
+    mlen: jnp.ndarray,  # int32[B]  live message bytes per lane
+) -> jnp.ndarray:
+    """The compact device-hash pipeline: SHA-512 PADDING and
+    compression, mod-L, digit windowing, decompress, and the Straus
+    loop — one fused program from raw bytes. The 64-byte hash prefix
+    R ‖ A is reassembled from the wire on device, so the link never
+    ships those bytes twice and the message plane carries padded raw
+    uint8 instead of pre-split u32 block words (128 B per block per
+    lane → the actual message length rounded to the block grid)."""
+    from cometbft_tpu.crypto.tpu import scalar, sha512
+
+    words = bytes_to_words(wire)  # u32[24,B]
+    ay, a_sign, r_y, r_sign, s_digits = _unpack_points_scalar(words)
+    prefix = jnp.concatenate([wire[32:64], wire[0:32]], axis=0)  # R ‖ A
+    max_blocks = (64 + msg.shape[0]) // 128  # staging keeps this exact
+    hi, lo, n_live = sha512.blocks_from_bytes(prefix, msg, mlen, max_blocks)
+    dig_hi, dig_lo = sha512.sha512_blocks(hi, lo, n_live)
+    h = scalar.sc_reduce(scalar.digest_to_limbs(dig_hi, dig_lo))
+    h_digits = scalar.digits_msb_first(h)
+    return _verify_unpacked(ay, a_sign, r_y, r_sign, s_digits, h_digits)
+
+
+def _verify_core_indexed(
+    table: jnp.ndarray,  # u8[N,32]  resident pubkey encodings (keystore)
+    idx: jnp.ndarray,  # int32[B]  table row per lane
+    rsh: jnp.ndarray,  # u8[96,B]  rows 0:32 R, 32:64 S, 64:96 h (raw bytes)
+) -> jnp.ndarray:
+    """bool[B] against a device-resident pubkey table: steady-state
+    consensus traffic ships sigs, challenge scalars, and a 4-byte index
+    per lane — the pubkey bytes never cross the link again after the
+    key-store upload. The gather is per-lane but runs ONCE per dispatch
+    (32 bytes/lane), not inside the Straus loop."""
+    rows = jnp.take(table, idx, axis=0)  # u8[B,32]; clipped for pad lanes
+    a_words = bytes_to_words(rows.T)
+    ay = unpack_fe_limbs(a_words)
+    a_sign = (a_words[7] >> 31).astype(jnp.int32)
+    w = bytes_to_words(rsh)  # u32[24,B]
+    r_y = unpack_fe_limbs(w[0:8])
+    r_sign = (w[0:8][7] >> 31).astype(jnp.int32)
+    s_digits = unpack_digits(w[8:16])
+    h_digits = unpack_digits(w[16:24])
+    return _verify_unpacked(ay, a_sign, r_y, r_sign, s_digits, h_digits)
+
+
+verify_kernel_indexed = jax.jit(_verify_core_indexed)
 
 
 # --- host glue -------------------------------------------------------------
@@ -473,6 +544,41 @@ def prepare_batch(
     return wire, valid
 
 
+def pack_compact_rows(*row_arrs: np.ndarray) -> np.ndarray:
+    """Stack u8[B,k] byte arrays into the compact byte-major wire
+    u8[Σk,B]: one preallocated buffer and one transposed copy per
+    plane — no word views, no concatenate — which is why the compact
+    pack can never cost more host time than the word pack it replaces
+    (bench_micro `pack` asserts this on CPU CI)."""
+    n = row_arrs[0].shape[0]
+    rows = sum(a.shape[1] for a in row_arrs)
+    wire = np.empty((rows, n), np.uint8)
+    at = 0
+    for a in row_arrs:
+        wire[at : at + a.shape[1]] = a.T
+        at += a.shape[1]
+    return wire
+
+
+def prepare_batch_compact(
+    pub_keys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+):
+    """Host-side packing for the compact host-hash wire →
+    (wire u8[128,B], valid): rows 0:32 A, 32:64 R, 64:96 S,
+    96:128 h, raw little-endian bytes. Bit-identical inputs to
+    prepare_batch's u32 wire (the kernel's bytes_to_words prologue
+    reproduces the exact words), shipped without any host word
+    packing."""
+    pk_arr, sig_arr, valid = _parse_inputs(pub_keys, sigs)
+    h_arr = _challenge_scalars(pk_arr, sig_arr, msgs, valid)
+    wire = pack_compact_rows(
+        pk_arr, sig_arr[:, :32], sig_arr[:, 32:], h_arr
+    )
+    return wire, valid
+
+
 def prepare_batch_device_hash(
     pub_keys: Sequence[bytes],
     msgs: Sequence[bytes],
@@ -501,15 +607,71 @@ def prepare_batch_device_hash(
     return wire, msg_hi, msg_lo, nblocks, valid
 
 
+def prepare_batch_device_hash_compact(
+    pub_keys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+):
+    """Compact device-hash packing → (wire u8[96,B], msg u8[MP,B],
+    mlen int32[B], valid). Three wins over prepare_batch_device_hash:
+    the wire is raw bytes (no word packing), the 64-byte R ‖ A hash
+    prefix is NOT re-shipped with the message (the kernel rebuilds it
+    from the wire), and SHA padding happens on device — the message
+    plane is one bulk-scattered uint8 block instead of per-lane padded
+    u32 hi/lo word planes, with no per-message Python concatenation."""
+    from cometbft_tpu.crypto.tpu import sha512
+
+    pk_arr, sig_arr, valid = _parse_inputs(pub_keys, sigs)
+    wire = pack_compact_rows(pk_arr, sig_arr[:, :32], sig_arr[:, 32:])
+    msg, mlen = sha512.stage_ragged_np(msgs, prefix_len=64)
+    return wire, msg, mlen, valid
+
+
 def hash_mode() -> str:
+    """CBFT_TPU_HASH resolution: ``host`` and ``device`` pin the hash
+    placement for A/B runs; ``auto`` (the default) lets the calibration
+    crossover measured at warmup decide per dispatch size
+    (hash_route)."""
     import os
 
-    mode = os.environ.get("CBFT_TPU_HASH", "host")
-    if mode not in ("host", "device"):
+    mode = os.environ.get("CBFT_TPU_HASH", "auto")
+    if mode not in ("host", "device", "auto"):
         raise ValueError(
-            f"unknown CBFT_TPU_HASH={mode!r}; choose from ['device', 'host']"
+            f"unknown CBFT_TPU_HASH={mode!r}; choose from "
+            "['auto', 'device', 'host']"
         )
     return mode
+
+
+def hash_route(n: int) -> str:
+    """Where h = SHA-512(R ‖ A ‖ M) runs for an n-lane dispatch:
+    the env pin when set, else the measured crossover
+    (calibrate.hash_device_min_batch — recorded by the warmup
+    calibration sweep). Unmeasured (fresh node, CPU CI) → host: the
+    round-5 probe showed the old device-hash path LOSING (38.8k vs
+    75.8k sigs/s at 16k), so unproven means the safe side."""
+    mode = hash_mode()
+    if mode != "auto":
+        return mode
+    from cometbft_tpu.crypto.tpu import calibrate
+
+    floor = calibrate.hash_device_min_batch()
+    return "device" if floor is not None and n >= floor else "host"
+
+
+def wire_format() -> str:
+    """CBFT_TPU_WIRE: ``compact`` (default — raw uint8 rows, decompress
+    prologue on device) or ``words`` (the pre-PR-13 u32 word wire, kept
+    as the A/B and parity reference)."""
+    import os
+
+    fmt = os.environ.get("CBFT_TPU_WIRE", "compact")
+    if fmt not in ("compact", "words"):
+        raise ValueError(
+            f"unknown CBFT_TPU_WIRE={fmt!r}; choose from "
+            "['compact', 'words']"
+        )
+    return fmt
 
 
 def warmup(
@@ -573,13 +735,27 @@ def verify_batch(
 ) -> List[bool]:
     """Public entry used by crypto.batch.TPUBatchVerifier. Packing runs
     per dispatch chunk (the callable form of dispatch_batch) so the host
-    hashing of chunk i+1 overlaps the device's work on chunk i."""
+    hashing of chunk i+1 overlaps the device's work on chunk i.
+
+    Route selection is two-dimensional: wire_format() picks compact
+    (raw uint8 rows, on-device decompress — the default) vs the legacy
+    u32 word wire, and hash_route(n) picks where SHA-512 runs (env pin
+    or the measured calibration crossover)."""
     n = len(pub_keys)
     if n == 0:
         return []
-    device_hash = hash_mode() == "device"
-    prepare = prepare_batch_device_hash if device_hash else prepare_batch
-    kernel = verify_full_kernel if device_hash else verify_kernel
+    compact = wire_format() == "compact"
+    if hash_route(n) == "device":
+        prepare = (
+            prepare_batch_device_hash_compact
+            if compact else prepare_batch_device_hash
+        )
+        kernel = (
+            verify_full_kernel_compact if compact else verify_full_kernel
+        )
+    else:
+        prepare = prepare_batch_compact if compact else prepare_batch
+        kernel = verify_kernel_compact if compact else verify_kernel
     valid_full = np.ones(n, bool)
 
     def chunk_pack(start: int, end: int):
@@ -607,37 +783,23 @@ def verify_batch(
 # the set of signers varies per commit.
 
 
-class _ResidentValset:
-    __slots__ = ("chunks", "pk_arr", "pk_ok")
+# The cache itself now lives in the generational DeviceKeyStore
+# (crypto/tpu/keystore.py): same LRU + adopt-the-race-winner contract,
+# plus generation tagging (store generation, topology generation) and
+# the indexed-dispatch pubkey table. The module-level names below are
+# aliases onto the store's own state so existing callers (warmup, tests
+# that evict synthetic valsets) keep working unchanged.
+from cometbft_tpu.crypto.tpu import keystore as _keystore_mod
 
-
-_resident_cache: "OrderedDict[bytes, _ResidentValset]" = OrderedDict()
-_RESIDENT_CACHE_MAX = 4  # ~10k vals x 256B x 4 = 10 MB of HBM at most
-# verify_commit now runs this path from consensus, blocksync, AND light
-# threads concurrently; the OrderedDict get/move/insert/evict triad is
-# not atomic, so every cache touch takes this lock. The slow part —
-# building + uploading resident rows — runs OUTSIDE the lock; a lost
-# build race adopts the winner's rows (one transient duplicate upload
-# at most, never a corrupted LRU).
-_resident_mtx = threading.Lock()
+_keystore = _keystore_mod.default_store()
+_ResidentValset = _keystore_mod.KeyStoreEntry
+_RESIDENT_CACHE_MAX = _keystore_mod.CACHE_MAX
+_resident_cache = _keystore._entries
+_resident_mtx = _keystore._mtx
 
 
 def _get_resident(valset_id: bytes, pub_keys) -> _ResidentValset:
-    with _resident_mtx:
-        rv = _resident_cache.get(valset_id)
-        if rv is not None:
-            _resident_cache.move_to_end(valset_id)
-            return rv
-    rv = _build_resident(pub_keys)  # slow: H2D upload — outside the lock
-    with _resident_mtx:
-        won = _resident_cache.get(valset_id)
-        if won is not None:  # lost the race: reuse the winner's rows
-            _resident_cache.move_to_end(valset_id)
-            return won
-        _resident_cache[valset_id] = rv
-        while len(_resident_cache) > _RESIDENT_CACHE_MAX:
-            _resident_cache.popitem(last=False)
-    return rv
+    return _keystore.get(valset_id, pub_keys, _build_resident)
 
 
 def _verify_core_resident(a_words: jnp.ndarray, rsh: jnp.ndarray) -> jnp.ndarray:
@@ -675,6 +837,28 @@ def _register_aot_kernels():
         donate_from=1,
     )
     aot.register_kernel("ed25519.verify_full", verify_full_kernel)
+    # compact-wire kernels (PR 13): the host-hash compact wire is the
+    # default dispatch route, so it gets the same bucket warm plan as
+    # the word wire it replaces. The device-hash compact kernel warms
+    # the 2-block message bucket (MP = 2·128 − 64 = 192 — every
+    # prevote/precommit lands there); other message paddings compile on
+    # first use. The indexed kernel's table axis tracks valset size, so
+    # it has no static template either.
+    aot.register_kernel(
+        "ed25519.verify_compact",
+        verify_kernel_compact,
+        bucket_shapes=lambda b: [((128, b), np.uint8)],
+    )
+    aot.register_kernel(
+        "ed25519.verify_full_compact",
+        verify_full_kernel_compact,
+        bucket_shapes=lambda b: [
+            ((96, b), np.uint8), ((192, b), np.uint8), ((b,), np.int32)
+        ],
+    )
+    aot.register_kernel(
+        "ed25519.verify_indexed", verify_kernel_indexed, donate_from=1
+    )
 
 
 _register_aot_kernels()
@@ -682,7 +866,10 @@ _register_aot_kernels()
 
 def _build_resident(pub_keys: Sequence[bytes]) -> _ResidentValset:
     """Pad the valset's pubkey rows into the dispatch chunk layout and
-    place them on device (sharded over the mesh when >1 device)."""
+    place them on device (sharded over the mesh when >1 device). Also
+    builds the indexed-dispatch view (single-device only): a u8[n_pad,
+    32] gather table plus a pubkey→row index, so steady-state flushes
+    against this valset ship an index vector instead of the keys."""
     from cometbft_tpu.crypto.tpu import mesh as mesh_mod
     from jax.sharding import NamedSharding, PartitionSpec as PS
 
@@ -720,6 +907,24 @@ def _build_resident(pub_keys: Sequence[bytes]) -> _ResidentValset:
     rv.chunks = chunks
     rv.pk_arr = pk_arr
     rv.pk_ok = pk_ok
+    rv.n = n
+    if ndev == 1 and n > 0:
+        # indexed gather table: pow2-padded rows so successive valsets
+        # of similar size reuse the compiled executable. Multi-device
+        # meshes skip it — the gather would need the full table
+        # replicated per shard, so the sharded route keeps shipping keys.
+        n_pad = 64
+        while n_pad < n:
+            n_pad *= 2
+        table = np.zeros((n_pad, 32), np.uint8)
+        table[:n] = pk_arr
+        rv.table_dev = jax.device_put(jnp.asarray(table))
+        rv.index = {
+            pk_arr[i].tobytes(): i for i in range(n) if pk_ok[i]
+        }
+    else:
+        rv.table_dev = None
+        rv.index = {}
     return rv
 
 
@@ -748,6 +953,27 @@ def _prepare_rsh(pk_arr: np.ndarray, msgs, sigs):
         ],
         axis=0,
     )
+    return rsh, valid
+
+
+def _prepare_rsh_compact(pk_arr: np.ndarray, msgs, sigs):
+    """Compact per-flush staging for the indexed key-store path: same
+    parse/hash as _prepare_rsh but packed as raw byte rows →
+    (rsh u8[96,B]: rows 0:32 R, 32:64 S, 64:96 h, valid)."""
+    n = len(msgs)
+    valid = np.ones(n, bool)
+    sig_parts = []
+    for i in range(n):
+        s = sigs[i]
+        if s is None or msgs[i] is None or len(s) != 64:
+            valid[i] = False
+            sig_parts.append(b"\x00" * 64)
+        else:
+            sig_parts.append(bytes(s))
+    sig_arr = np.frombuffer(b"".join(sig_parts), np.uint8).reshape(n, 64)
+    valid &= _s_below_l(sig_arr[:, 32:])
+    h_arr = _challenge_scalars(pk_arr, sig_arr, msgs, valid)
+    rsh = pack_compact_rows(sig_arr[:, :32], sig_arr[:, 32:], h_arr)
     return rsh, valid
 
 

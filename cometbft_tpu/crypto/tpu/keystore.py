@@ -1,0 +1,268 @@
+"""Generational device key store — the PR 1 resident valset cache
+grown into a device-side pubkey TABLE shared by scheduler flushes.
+
+Two consumers, one store:
+
+* `verify_valset_resident` (full-lane commit verification) keeps its
+  chunked resident rows — those live in each entry's ``chunks`` exactly
+  as the old ``_ResidentValset`` held them, so the dispatch layout and
+  the adopt-the-race-winner contract are unchanged.
+* The NEW indexed batch path (`verify_batch_indexed`): when every
+  pubkey of an ed25519 flush is already resident, steady-state
+  consensus traffic ships only msgs+sigs and an int32 index vector —
+  100 B/lane (96 B compact R ‖ S ‖ h + 4 B index) instead of re-shipping
+  32-byte keys every flush. The kernel gathers pubkey rows from the
+  on-device table (`ed25519_batch.verify_kernel_indexed`).
+
+Generations make staleness impossible to verify against: every entry
+is stamped with the store generation (bumped on every upload and
+invalidation) and the device-topology generation it was built under.
+A valset rotation produces a different valset_id (miss), an explicit
+`invalidate` drops entries, and a topology generation bump — quarantine
+re-slice, fault-domain change — makes every older entry undispatchable:
+`get` drops it and rebuilds, `verify_batch_indexed` refuses it
+(`stale_drops`). A stale-generation dispatch therefore MISSES; it never
+verifies against old keys or an old device slicing.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+# ~10k vals x 256B x 4 = 10 MB of HBM at most (chunks) plus the
+# indexed tables (32 B/key) on top — still < 2 MB per 10k-val entry
+CACHE_MAX = 4
+
+
+class KeyStoreEntry:
+    """One resident valset. ``chunks``/``pk_arr``/``pk_ok`` carry the
+    exact _ResidentValset layout (tests and verify_valset_resident
+    address them directly); the table/index pair is the indexed path's
+    view of the same keys."""
+
+    __slots__ = (
+        "valset_id",       # bytes digest the caller keyed this set by
+        "generation",      # store generation at upload (monotonic)
+        "topo_generation",  # device-topology generation at build
+        "chunks",          # list[(start, end, size, a_dev)] — resident rows
+        "pk_arr",          # np.uint8[n, 32] host copy of the key rows
+        "pk_ok",           # np.bool_[n] — False for malformed keys
+        "index",           # dict: pubkey bytes -> row in table_dev
+        "table_dev",       # device u8[n_pad, 32] gather table
+        "n",               # live key count
+    )
+
+
+def _topo_generation() -> int:
+    from cometbft_tpu.crypto.tpu import topology
+
+    return topology.default_topology().generation()
+
+
+class DeviceKeyStore:
+    def __init__(self, max_entries: int = CACHE_MAX):
+        self._entries: "OrderedDict[bytes, KeyStoreEntry]" = OrderedDict()
+        # verify_commit runs from consensus, blocksync, AND light
+        # threads concurrently; the OrderedDict get/move/insert/evict
+        # triad is not atomic, so every store touch takes this lock.
+        # Slow work (build + H2D upload) runs OUTSIDE it; a lost build
+        # race adopts the winner's rows.
+        self._mtx = threading.Lock()
+        self._max = int(max_entries)
+        self._gen = 0
+        self._stats = {
+            "hits": 0,
+            "misses": 0,
+            "uploads": 0,
+            "invalidations": 0,
+            "stale_drops": 0,
+            "indexed_dispatches": 0,
+            "indexed_lanes": 0,
+        }
+
+    def get(self, valset_id: bytes, pub_keys, build) -> KeyStoreEntry:
+        """Resident entry for valset_id, building (slow H2D, outside the
+        lock) on miss. An entry built under an older topology generation
+        is dropped and rebuilt — its rows were sliced for a mesh that no
+        longer exists."""
+        topo_gen = _topo_generation()
+        with self._mtx:
+            e = self._entries.get(valset_id)
+            if e is not None:
+                if e.topo_generation == topo_gen:
+                    self._entries.move_to_end(valset_id)
+                    self._stats["hits"] += 1
+                    return e
+                del self._entries[valset_id]
+                self._stats["stale_drops"] += 1
+            self._stats["misses"] += 1
+        e = build(pub_keys)  # slow: H2D upload — outside the lock
+        e.valset_id = bytes(valset_id)
+        e.topo_generation = topo_gen
+        with self._mtx:
+            won = self._entries.get(valset_id)
+            if won is not None and won.topo_generation == topo_gen:
+                # lost the race: reuse the winner's rows (one transient
+                # duplicate upload at most, never a corrupted LRU)
+                self._entries.move_to_end(valset_id)
+                return won
+            self._gen += 1
+            e.generation = self._gen
+            self._entries[valset_id] = e
+            self._stats["uploads"] += 1
+            while len(self._entries) > self._max:
+                self._entries.popitem(last=False)
+        return e
+
+    def lookup_fresh(self, topo_gen: Optional[int] = None
+                     ) -> List[KeyStoreEntry]:
+        """Entries dispatchable under the CURRENT topology generation,
+        most recently used first. Stale entries are dropped on sight —
+        never returned, never verified against."""
+        if topo_gen is None:
+            topo_gen = _topo_generation()
+        with self._mtx:
+            stale = [
+                vid for vid, e in self._entries.items()
+                if e.topo_generation != topo_gen
+            ]
+            for vid in stale:
+                del self._entries[vid]
+                self._stats["stale_drops"] += 1
+            return list(reversed(self._entries.values()))
+
+    def invalidate(self, valset_id: Optional[bytes] = None) -> int:
+        """Drop one entry (or all, valset_id=None). Bumps the store
+        generation so a snapshot taken before and after can't be
+        confused."""
+        with self._mtx:
+            if valset_id is None:
+                dropped = len(self._entries)
+                self._entries.clear()
+            else:
+                dropped = int(
+                    self._entries.pop(valset_id, None) is not None
+                )
+            if dropped:
+                self._gen += 1
+                self._stats["invalidations"] += dropped
+        return dropped
+
+    def note_indexed(self, lanes: int) -> None:
+        with self._mtx:
+            self._stats["indexed_dispatches"] += 1
+            self._stats["indexed_lanes"] += int(lanes)
+
+    def snapshot(self) -> dict:
+        """Queryable store state for scheduler snapshots / debug RPC."""
+        with self._mtx:
+            return {
+                "generation": self._gen,
+                "entries": [
+                    {
+                        "valset_id": getattr(e, "valset_id", b"").hex()[:16],
+                        "generation": getattr(e, "generation", 0),
+                        "topo_generation": e.topo_generation,
+                        "keys": e.n,
+                        "chunks": len(e.chunks),
+                    }
+                    for e in self._entries.values()
+                ],
+                "stats": dict(self._stats),
+            }
+
+
+_default = DeviceKeyStore()
+
+
+def default_store() -> DeviceKeyStore:
+    return _default
+
+
+def verify_batch_indexed(
+    pub_keys: Sequence[bytes],
+    msgs: Sequence[bytes],
+    sigs: Sequence[bytes],
+) -> Optional[List[bool]]:
+    """Steady-state indexed dispatch: if EVERY pubkey in the flush is
+    covered by one fresh resident entry, verify by shipping the compact
+    R ‖ S ‖ h rows plus an int32 index vector and gathering the pubkey
+    rows from the on-device table — 100 B/lane vs 128 for the full
+    compact wire. Returns None (caller falls back to verify_batch) when
+    no single entry covers the flush or the mesh is sharded: the table
+    gather would need full replication per shard, so the sharded route
+    keeps shipping keys."""
+    from cometbft_tpu.crypto.tpu import ed25519_batch as ed
+    from cometbft_tpu.crypto.tpu import mesh as mesh_mod
+
+    n = len(pub_keys)
+    if n == 0:
+        return []
+    if mesh_mod.n_devices() > 1:
+        return None
+    entries = _default.lookup_fresh()
+    if not entries:
+        return None
+    entry = None
+    for e in entries:
+        if all(bytes(pk) in e.index for pk in pub_keys):
+            entry = e
+            break
+    if entry is None:
+        return None
+
+    import jax
+    import jax.numpy as jnp
+    from collections import deque
+
+    idx_full = np.fromiter(
+        (entry.index[bytes(pk)] for pk in pub_keys),
+        dtype=np.int32, count=n,
+    )
+    max_chunk = mesh_mod.chunk_cap(ed._MAX_CHUNK, ed._MIN_PAD)
+    depth = mesh_mod.pipeline_depth()
+    out = np.zeros(n, bool)
+    inflight: "deque" = deque()
+
+    def retire(slot):
+        start, end, mask, valid = slot
+        out[start:end] = np.asarray(mask)[: end - start] & valid
+
+    # same double-buffered shape as the resident commit loop: pack +
+    # async H2D of chunk i+1 overlaps the device's work on chunk i.
+    # Only the per-flush staging (idx + rsh) is donated — the resident
+    # table must survive across flushes.
+    for start in range(0, n, max_chunk):
+        end = min(start + max_chunk, n)
+        rsh, valid = ed._prepare_rsh_compact(
+            np.stack([
+                np.frombuffer(bytes(pk), np.uint8) for pk in
+                pub_keys[start:end]
+            ]),
+            msgs[start:end], sigs[start:end],
+        )
+        size = ed._MIN_PAD
+        while size < end - start:
+            size *= 2
+        rsh_pad = np.zeros((96, size), np.uint8)
+        rsh_pad[:, : end - start] = rsh
+        idx_pad = np.zeros(size, np.int32)
+        idx_pad[: end - start] = idx_full[start:end]
+        idx_dev = jax.device_put(jnp.asarray(idx_pad))
+        rsh_dev = jax.device_put(jnp.asarray(rsh_pad))
+        mask = mesh_mod.run_single(
+            ed.verify_kernel_indexed,
+            [entry.table_dev, idx_dev, rsh_dev],
+            donate_from=1,
+        )
+        inflight.append((start, end, mask, valid))
+        while len(inflight) > depth:
+            retire(inflight.popleft())
+    while inflight:
+        retire(inflight.popleft())
+    _default.note_indexed(n)
+    return list(out)

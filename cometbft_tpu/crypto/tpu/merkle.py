@@ -42,6 +42,9 @@ _INNER_LEN = 65  # 0x01 || left32 || right32
 # cost dwarfs the compute; the routing stays opt-in
 # (crypto.merkle.enable_parallel) and this floor is env-tunable for
 # locally-attached TPUs where the round-trip is microseconds.
+# legacy floor, superseded by device_wins() for routing — kept only as
+# the documented default of the env knob (device_wins re-reads the env
+# per call, so monkeypatched tests see changes immediately)
 MIN_DEVICE_LEAVES = int(os.environ.get("CBFT_TPU_MERKLE_MIN_LEAVES", "128"))
 
 
@@ -159,7 +162,13 @@ def hash_from_byte_slices(
         return hashlib.sha256(b"").digest()
     if n == 1:
         return hashlib.sha256(_LEAF_PREFIX + bytes(items[0])).digest()
-    if not force_device and n < MIN_DEVICE_LEAVES:
+    # routing goes through the measured verdict, not a constant: at the
+    # round-5 sizes (10k leaves: 81.2 ms device vs 18.1 ms host) the
+    # device path must LOSE the decision even when a caller reaches this
+    # entry directly — only force_device (calibration's own sweep, A/B
+    # probes) bypasses it. device_wins keeps operator precedence for an
+    # explicitly-set CBFT_TPU_MERKLE_MIN_LEAVES.
+    if not force_device and not device_wins(n):
         return _host_tree(
             [
                 hashlib.sha256(_LEAF_PREFIX + bytes(item)).digest()

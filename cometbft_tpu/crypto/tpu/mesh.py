@@ -345,6 +345,31 @@ def pipeline_depth() -> int:
     return depth
 
 
+def prefetch_depth() -> int:
+    """How many chunks ahead of the compute pointer the dispatch loops
+    STAGE (pack + async device_put). 1 = designed double-buffering of
+    the wire itself: the next chunk's H2D is issued before the current
+    chunk's compute is even enqueued, so on a transfer-bound link
+    (~181 ms H2D vs ~0.1 ms compute per 16k chunk,
+    BENCH_onchip_probe.json) the transfer of chunk i+1 runs behind the
+    device's work on chunk i by construction, not by dispatch-queue
+    accident. 0 restores the lazy pre-PR-13 behavior (stage only the
+    chunk about to launch); deeper prefetch costs one chunk of staging
+    memory per step and buys nothing once the link is saturated."""
+    raw = os.environ.get("CBFT_TPU_PREFETCH_DEPTH")
+    if raw is None:
+        return 1
+    try:
+        depth = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"CBFT_TPU_PREFETCH_DEPTH={raw!r} is not an integer"
+        ) from None
+    if depth < 0:
+        raise ValueError(f"CBFT_TPU_PREFETCH_DEPTH={depth} must be >= 0")
+    return depth
+
+
 def run_single(kernel, args, donate_from: int = 0):
     """Run `kernel` single-device through the AOT executable registry
     with args [donate_from:] donated — the per-chunk staging buffers
@@ -375,14 +400,16 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
     selects WHOSE OOM-shrink ladder caps the chunk size — placement
     stays with jax.
 
-    Double-buffered: at most pipeline_depth() (default 2) chunk
-    dispatches are in flight — the host packs and device_puts chunk N+1
-    (async H2D) while the device computes chunk N, then the OLDEST
-    dispatch is retired (np.asarray blocks only on it). Transfer
-    dominates this link (~180 ms of a ~216 ms 16k dispatch,
-    MAXCHUNK16K.jsonl), so the overlap is the whole win; the depth bound
-    keeps staging memory at depth × chunk wire instead of the full
-    batch. Single-device dispatches donate their staging buffers
+    Double-buffered twice over: at most pipeline_depth() (default 2)
+    chunk dispatches are in flight before the OLDEST is retired
+    (np.asarray blocks only on it), and staging runs prefetch_depth()
+    (default 1) chunks AHEAD of the compute pointer — chunk N+1's pack
+    and async device_put are issued before chunk N's compute is
+    enqueued, so the transfer overlaps compute by construction.
+    Transfer dominates this link (~180 ms of a ~216 ms 16k dispatch,
+    MAXCHUNK16K.jsonl), so the overlap is the whole win; the two bounds
+    keep staging memory at (depth + prefetch) × chunk wire instead of
+    the full batch. Single-device dispatches donate their staging buffers
     (donating_kernel); the sharded path already does.
 
     `packed` is either a list of pre-packed arrays (trailing axis = the
@@ -491,19 +518,27 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
             )
         span.end(device_wait_ns=wait_ns)
 
-    for chunk_idx, start in enumerate(range(0, n, max_chunk)):
-        if cancel is not None and cancel.is_set():
-            raise DispatchCancelled(
-                f"dispatch cancelled before chunk {chunk_idx} "
-                f"(sigs [{start}:{n}] undone)"
-            )
+    # staged prefetch (PR 13): pack + async device_put run up to
+    # prefetch_depth() chunks AHEAD of the compute pointer, so the next
+    # chunk's H2D is on the wire before the current chunk's compute is
+    # even enqueued — transfer/compute overlap by construction. A staged
+    # chunk's transfer is "hidden" whenever other work was staged or in
+    # flight when it was issued (only chunk 0's H2D is exposed).
+    total_chunks = -(-n // max_chunk) if n > 0 else 0
+    prefetch = prefetch_depth()
+    staged: "deque" = deque()
+    next_stage = 0
+
+    def stage_next():
+        nonlocal next_stage
+        chunk_idx = next_stage
+        next_stage += 1
+        start = chunk_idx * max_chunk
         end = min(start + max_chunk, n)
         span = _trace.child_of_current(
             "chunk", chunk=chunk_idx, n_sigs=end - start
         )
-        # transfer issued while an earlier chunk is still in flight is
-        # hidden behind its compute — the pipeline-overlap accounting
-        pipelined = len(inflight) > 0
+        overlapped = len(inflight) > 0 or len(staged) > 0
         t_host = time.perf_counter_ns()
         try:
             pspan = span.child("wire_pack")
@@ -528,30 +563,50 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
             wire_bytes = sum(int(a.nbytes) for a in padded_args)
             if ndev > 1:
                 # legacy auto-shard path: the device_put happens inside
-                # sharded_verify, so there is no separable h2d window —
-                # the whole call lands in the compute phase
-                cspan = span.child("wire_compute")
-                mask = sharded_verify(kernel, padded_args)
+                # sharded_verify at launch, so there is no separable
+                # h2d window — staging ends at pack
+                placed = padded_args
                 t_h2d = t_pack
-                t_compute = time.perf_counter_ns()
-                cspan.end()
             else:
                 import jax
                 import jax.numpy as jnp
 
-                # explicit async device_put: H2D for this chunk starts
-                # now, overlapping the previous chunk's compute; the jit
-                # call then consumes already-placed (donated) buffers
+                # explicit async device_put at STAGE time: H2D for this
+                # chunk is issued before earlier chunks' compute has
+                # drained; the launch then consumes already-placed
+                # (donated) buffers
                 hspan = span.child("wire_h2d")
                 placed = [
                     jax.device_put(jnp.asarray(a)) for a in padded_args
                 ]
                 t_h2d = time.perf_counter_ns()
                 hspan.end()
-                cspan = span.child("wire_compute")
+        except DispatchCancelled:
+            span.end(error="cancelled")
+            raise
+        except Exception as exc:  # noqa: BLE001 - per-chunk context for triage
+            span.end(error=repr(exc))
+            raise RuntimeError(
+                f"staging of chunk {chunk_idx} (sigs [{start}:{end}]) "
+                f"failed: {exc}"
+            ) from exc
+        pack_s = (t_pack - t_host) / 1e9
+        h2d_s = (t_h2d - t_pack) / 1e9
+        staged.append((chunk_idx, start, end, size, placed, span,
+                       wire_bytes, pack_s, h2d_s, overlapped))
+
+    def launch(slot):
+        (chunk_idx, start, end, size, placed, span, wire_bytes,
+         pack_s, h2d_s, overlapped) = slot
+        t_launch = time.perf_counter_ns()
+        try:
+            cspan = span.child("wire_compute")
+            if ndev > 1:
+                mask = sharded_verify(kernel, placed)
+            else:
                 mask = run_single(kernel, placed)
-                t_compute = time.perf_counter_ns()
-                cspan.end()
+            t_compute = time.perf_counter_ns()
+            cspan.end()
         except DispatchCancelled:
             span.end(error="cancelled")
             raise
@@ -561,17 +616,17 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
                 f"dispatch of chunk {chunk_idx} (sigs [{start}:{end}]) "
                 f"failed: {exc}"
             ) from exc
+        compute_s = (t_compute - t_launch) / 1e9
+        hidden_s = h2d_s if overlapped else 0.0
         # host wall time: pack + pad + H2D issue + jit dispatch (returns
-        # before the device result is ready)
-        span.set_tag("host_ns", time.perf_counter_ns() - t_host)
+        # before the device result is ready); staged wait time excluded
+        span.set_tag(
+            "host_ns", int((pack_s + h2d_s + compute_s) * 1e9)
+        )
         span.set_tag("pad", size)
-        pack_s = (t_pack - t_host) / 1e9
-        h2d_s = (t_h2d - t_pack) / 1e9
-        compute_s = (t_compute - t_h2d) / 1e9
-        hidden_s = h2d_s if pipelined else 0.0
-        span.set_tag("pack_ns", t_pack - t_host)
-        span.set_tag("h2d_ns", t_h2d - t_pack)
-        span.set_tag("compute_ns", t_compute - t_h2d)
+        span.set_tag("pack_ns", int(pack_s * 1e9))
+        span.set_tag("h2d_ns", int(h2d_s * 1e9))
+        span.set_tag("compute_ns", int(compute_s * 1e9))
         span.set_tag("hidden_ns", int(hidden_s * 1e9))
         span.set_tag("wire_bytes", wire_bytes)
         _tot["pack"] += pack_s
@@ -587,6 +642,17 @@ def dispatch_batch(kernel, packed, n: int, max_chunk: int, min_pad: int,
             if _ledger is not None else None
         )
         inflight.append((chunk_idx, start, end, mask, span, winfo))
+
+    for chunk_idx in range(total_chunks):
+        if cancel is not None and cancel.is_set():
+            raise DispatchCancelled(
+                f"dispatch cancelled before chunk {chunk_idx} "
+                f"(sigs [{chunk_idx * max_chunk}:{n}] undone)"
+            )
+        while (next_stage < total_chunks
+               and next_stage <= chunk_idx + prefetch):
+            stage_next()
+        launch(staged.popleft())
         while len(inflight) > depth:
             retire(inflight.popleft())
     while inflight:
@@ -720,7 +786,8 @@ def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
     Same contract as dispatch_batch — ``packed`` is pre-packed arrays or
     a ``(start, end) -> list`` callable, the thread's cancel event is
     checked at every chunk boundary, chunks are double-buffered
-    (pipeline_depth), staging buffers are donated — plus the sharded
+    (pipeline_depth) with staging prefetched ahead of compute
+    (prefetch_depth), staging buffers are donated — plus the sharded
     specifics: the per-shard lane count is the MINIMUM chunk cap over
     the participating devices (each device's OOM-shrink ladder and
     memory-plane guard clamp it), each chunk pads to a pow2 per-shard
@@ -813,18 +880,27 @@ def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
             s.end(device_wait_ns=wait)
         span.end(device_wait_ns=wait)
 
-    for chunk_idx, start in enumerate(range(0, n, mega)):
-        if cancel is not None and cancel.is_set():
-            raise DispatchCancelled(
-                f"sharded dispatch cancelled before chunk {chunk_idx} "
-                f"(sigs [{start}:{n}] undone)"
-            )
+    # staged prefetch, mirroring dispatch_batch: pack + sharded
+    # device_put (NamedSharding placement fans the H2D out to every
+    # shard) run ahead of the compute pointer, so the next megachunk's
+    # transfer is in flight across the whole mesh while the current one
+    # computes.
+    total_chunks = -(-n // mega) if n > 0 else 0
+    prefetch = prefetch_depth()
+    staged: "deque" = deque()
+    next_stage = 0
+
+    def stage_next():
+        nonlocal next_stage, max_bucket
+        chunk_idx = next_stage
+        next_stage += 1
+        start = chunk_idx * mega
         end = min(start + mega, n)
         span = _trace.child_of_current(
             "sharded_chunk", chunk=chunk_idx, n_sigs=end - start,
             shards=nsh, generation=plan.generation,
         )
-        pipelined = len(inflight) > 0
+        overlapped = len(inflight) > 0 or len(staged) > 0
         t_host = time.perf_counter_ns()
         try:
             pspan = span.child("wire_pack")
@@ -860,6 +936,26 @@ def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
             ]
             t_h2d = time.perf_counter_ns()
             hspan.end()
+        except DispatchCancelled:
+            span.end(error="cancelled")
+            raise
+        except Exception as exc:  # noqa: BLE001 - per-chunk context for triage
+            span.end(error=repr(exc))
+            raise RuntimeError(
+                f"sharded staging of chunk {chunk_idx} "
+                f"(sigs [{start}:{end}] over {nsh} shards "
+                f"{plan.labels()}) failed: {exc}"
+            ) from exc
+        pack_s = (t_pack - t_host) / 1e9
+        h2d_s = (t_h2d - t_pack) / 1e9
+        staged.append((chunk_idx, start, end, per, size, placed, span,
+                       wire_bytes, pack_s, h2d_s, overlapped))
+
+    def launch(slot):
+        (chunk_idx, start, end, per, size, placed, span, wire_bytes,
+         pack_s, h2d_s, overlapped) = slot
+        t_launch = time.perf_counter_ns()
+        try:
             shard_spans = []
             real = end - start
             for si, h in enumerate(plan.handles):
@@ -888,15 +984,15 @@ def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
                 f"(sigs [{start}:{end}] over {nsh} shards "
                 f"{plan.labels()}) failed: {exc}"
             ) from exc
-        span.set_tag("host_ns", time.perf_counter_ns() - t_host)
+        compute_s = (t_compute - t_launch) / 1e9
+        hidden_s = h2d_s if overlapped else 0.0
+        span.set_tag(
+            "host_ns", int((pack_s + h2d_s + compute_s) * 1e9)
+        )
         span.set_tag("pad", size)
-        pack_s = (t_pack - t_host) / 1e9
-        h2d_s = (t_h2d - t_pack) / 1e9
-        compute_s = (t_compute - t_h2d) / 1e9
-        hidden_s = h2d_s if pipelined else 0.0
-        span.set_tag("pack_ns", t_pack - t_host)
-        span.set_tag("h2d_ns", t_h2d - t_pack)
-        span.set_tag("compute_ns", t_compute - t_h2d)
+        span.set_tag("pack_ns", int(pack_s * 1e9))
+        span.set_tag("h2d_ns", int(h2d_s * 1e9))
+        span.set_tag("compute_ns", int(compute_s * 1e9))
         span.set_tag("hidden_ns", int(hidden_s * 1e9))
         span.set_tag("wire_bytes", wire_bytes)
         _tot["pack"] += pack_s
@@ -912,6 +1008,17 @@ def dispatch_sharded(kernel, packed, n: int, max_chunk: int, min_pad: int,
         inflight.append(
             (chunk_idx, start, end, mask, span, shard_spans, winfo)
         )
+
+    for chunk_idx in range(total_chunks):
+        if cancel is not None and cancel.is_set():
+            raise DispatchCancelled(
+                f"sharded dispatch cancelled before chunk {chunk_idx} "
+                f"(sigs [{chunk_idx * mega}:{n}] undone)"
+            )
+        while (next_stage < total_chunks
+               and next_stage <= chunk_idx + prefetch):
+            stage_next()
+        launch(staged.popleft())
         while len(inflight) > depth:
             retire(inflight.popleft())
     while inflight:

@@ -199,6 +199,78 @@ def sha512_blocks(
     )
 
 
+def blocks_from_bytes(
+    prefix: jnp.ndarray,  # u8[P0, B] — device-resident hash prefix bytes
+    msg: jnp.ndarray,  # u8[MP, B] — raw message bytes, zero past mlen
+    mlen: jnp.ndarray,  # int32[B] — live message bytes per lane
+    max_blocks: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """ON-DEVICE SHA-512 padding: the byte stream per lane is
+    prefix ‖ msg[:mlen] ‖ 0x80 ‖ zeros ‖ 128-bit BE bit length, laid
+    into ``max_blocks`` 128-byte blocks and packed into the (hi, lo)
+    word planes sha512_blocks consumes. The caller guarantees
+    P0 + MP == max_blocks * 128 and that every lane's padded length
+    fits (stage_ragged_np's block arithmetic) — so the wire ships raw
+    bytes instead of pre-padded u32 block planes.
+
+    → (blocks_hi u32[max_blocks, 16, B], blocks_lo, n_live int32[B])."""
+    p0 = int(prefix.shape[0])
+    total = p0 + int(msg.shape[0])
+    body = jnp.concatenate([prefix, msg], axis=0).astype(jnp.uint32)
+    pos = jnp.arange(total, dtype=jnp.int32)[:, None]  # [total, 1]
+    tlen = (mlen.astype(jnp.int32) + jnp.int32(p0))[None, :]  # [1, B]
+    n_live = (tlen + 1 + 16 + 127) // 128  # ceil((tlen + 17) / 128)
+    end = n_live * 128  # last live byte position + 1, per lane
+    b = jnp.where(pos < tlen, body, jnp.uint32(0))
+    b = jnp.where(pos == tlen, jnp.uint32(0x80), b)
+    # big-endian 128-bit bit length occupies bytes [end-16, end); every
+    # real length fits 32 bits, so bytes with shift >= 32 stay zero
+    bit_len = tlen.astype(jnp.uint32) * jnp.uint32(8)
+    shift = (end - 1 - pos) * 8  # [total, B]
+    len_byte = (
+        bit_len >> jnp.clip(shift, 0, 31).astype(jnp.uint32)
+    ) & jnp.uint32(0xFF)
+    in_len = (pos >= end - 16) & (pos < end) & (shift < 32)
+    b = jnp.where(in_len, len_byte, b)
+    w = b.reshape(max_blocks, 16, 8, b.shape[-1])
+    hi = (
+        (w[:, :, 0] << 24) | (w[:, :, 1] << 16)
+        | (w[:, :, 2] << 8) | w[:, :, 3]
+    )
+    lo = (
+        (w[:, :, 4] << 24) | (w[:, :, 5] << 16)
+        | (w[:, :, 6] << 8) | w[:, :, 7]
+    )
+    return hi, lo, n_live[0]
+
+
+def stage_ragged_np(msgs: Sequence[bytes], prefix_len: int = 64):
+    """Host staging for blocks_from_bytes: raw message bytes only — no
+    SHA padding, no word packing, no per-message Python loop. The hashed
+    stream per lane is a ``prefix_len``-byte prefix (reassembled on
+    device) followed by msgs[i].
+
+    Returns (msg u8[MP, B], mlen int32[B]) with
+    MP = max_blocks·128 − prefix_len, so prefix ‖ msg is exactly the
+    padded block capacity and every lane's 0x80 terminator and length
+    field land inside it."""
+    n = len(msgs)
+    lens = np.array([len(m) for m in msgs], np.int64)
+    if n == 0:
+        return np.zeros((128 - prefix_len, 0), np.uint8), lens.astype(np.int32)
+    nblocks = np.maximum((prefix_len + lens + 1 + 16 + 127) // 128, 1)
+    cap = int(nblocks.max()) * 128 - prefix_len
+    buf = np.zeros((n, cap), np.uint8)
+    flat = np.frombuffer(b"".join(bytes(m) for m in msgs), np.uint8)
+    if flat.size:
+        row = np.repeat(np.arange(n), lens)
+        starts = np.zeros(n, np.int64)
+        np.cumsum(lens[:-1], out=starts[1:])
+        col = np.arange(flat.size, dtype=np.int64) - np.repeat(starts, lens)
+        buf[row, col] = flat
+    return np.ascontiguousarray(buf.T), lens.astype(np.int32)
+
+
 def pad_ragged_np(msgs: Sequence[bytes]):
     """Host packing: variable-length messages → one fixed-shape batch.
 

@@ -64,8 +64,12 @@ PHASES = CHUNK_PHASES + ("demux",)
 DEFAULT_WINDOW = 64     # EWMA window (samples); alpha = 2 / (window + 1)
 _MAX_SAMPLES = 512      # per-phase percentile retention per profile
 _MAX_DISPATCHES = 128   # recent dispatch records kept for reconciliation
-# ed25519 verify wire: 32 B pubkey + 64 B sig + 32 B SHA-512 prefix per
+# ed25519 verify wire: 32 B pubkey + 64 B sig + 32 B SHA-512 digest per
 # lane — the cold-boot bytes/lane guess before any chunk is observed.
+# Holds for both the compact uint8 wire (128 rows × 1 B) and the legacy
+# u32 word wire (32 rows × 4 B); the indexed key-store route (100
+# B/lane) and the device-hash route (96 B + message block) diverge from
+# it, which the live bytes_per_lane gauge then reflects.
 DEFAULT_BYTES_PER_LANE = 128.0
 
 
@@ -142,6 +146,13 @@ class Metrics:
             "Phase-sum / dispatch-wall reconciliation of the latest "
             "attributed dispatch, by route (1.0 = the five phases "
             "account for the whole dispatch).",
+        )
+        self.bytes_per_lane = r.gauge(
+            SUBSYSTEM, "bytes_per_lane",
+            "Wire bytes per real signature lane of the latest "
+            "attributed chunk, by route — the compact-format win "
+            "(uint8 rows / indexed key store) reads directly off this "
+            "gauge vs the 128 B/lane word-wire baseline.",
         )
 
     @classmethod
@@ -311,6 +322,10 @@ class WireLedger:
         )
         if bw > 0.0:
             m.effective_mbps.with_labels(device=device).set(round(bw, 2))
+        if lanes > 0 and wire_bytes > 0:
+            m.bytes_per_lane.with_labels(route=route).set(
+                round(wire_bytes / lanes, 2)
+            )
 
     def note_dispatch(
         self,

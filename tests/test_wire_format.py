@@ -1,0 +1,192 @@
+"""Compact uint8 wire — bit-identical to the legacy u32 word wire.
+
+The compact format (PR 13) ships raw 32-byte little-endian encodings as
+uint8 rows and reconstructs u32 words on device (bytes_to_words) before
+the shared limb-unpack / sign-extract / digit-window prologue. These
+tests pin the property the whole device-resident hot path rests on: for
+any batch — across chunk boundaries, non-canonical s, all-zero and
+all-ones rows — the on-device decompress produces bit-identical words
+and verdicts vs the host prepare_batch word wire. Runs on the virtual
+CPU mesh (conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto.tpu import ed25519_batch as eb
+
+# group order L: the canonical-s boundary
+_L = 2**252 + 27742317777372353535851937790883648493
+
+
+def _batch(n, tag=b"wf", corrupt_every=0):
+    keys = [ed.gen_priv_key_from_secret(tag + b"-%d" % i) for i in range(n)]
+    msgs = [b"wire format msg %d" % i for i in range(n)]
+    sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+    if corrupt_every:
+        for i in range(0, n, corrupt_every):
+            b = bytearray(sigs[i])
+            b[7] ^= 1
+            sigs[i] = bytes(b)
+    return [k.pub_key().bytes() for k in keys], msgs, sigs
+
+
+def _cpu(pks, msgs, sigs):
+    return [
+        ed.PubKeyEd25519(p).verify_signature(m, s)
+        for p, m, s in zip(pks, msgs, sigs)
+    ]
+
+
+def _words_from_compact(wire_c):
+    import jax.numpy as jnp
+
+    return np.asarray(eb.bytes_to_words(jnp.asarray(wire_c)))
+
+
+def _kernel_verdicts(pks, msgs, sigs):
+    """(word-kernel mask, compact-kernel mask, shared valid) for one
+    un-chunked dispatch of both formats on identical inputs."""
+    import jax.numpy as jnp
+
+    wire_w, valid_w = eb.prepare_batch(pks, msgs, sigs)
+    wire_c, valid_c = eb.prepare_batch_compact(pks, msgs, sigs)
+    np.testing.assert_array_equal(valid_w, valid_c)
+    got_w = np.asarray(eb.verify_kernel(jnp.asarray(wire_w)))
+    got_c = np.asarray(eb.verify_kernel_compact(jnp.asarray(wire_c)))
+    return got_w, got_c, valid_w
+
+
+class TestWordReconstruction:
+    """bytes_to_words(compact rows) must equal the host word pack —
+    the limb planes downstream are then identical by construction."""
+
+    def test_bit_identical_words(self):
+        pks, msgs, sigs = _batch(17, corrupt_every=5)
+        wire_w, valid_w = eb.prepare_batch(pks, msgs, sigs)
+        wire_c, valid_c = eb.prepare_batch_compact(pks, msgs, sigs)
+        assert wire_c.dtype == np.uint8
+        assert wire_c.shape == (128, 17)
+        assert wire_w.shape == (32, 17)
+        np.testing.assert_array_equal(_words_from_compact(wire_c), wire_w)
+        np.testing.assert_array_equal(valid_c, valid_w)
+
+    def test_row_layout(self):
+        # rows 0:32 A, 32:64 R, 64:96 S — raw bytes, lane-minor
+        pks, msgs, sigs = _batch(3)
+        wire_c, _ = eb.prepare_batch_compact(pks, msgs, sigs)
+        for lane in range(3):
+            assert wire_c[0:32, lane].tobytes() == pks[lane]
+            assert wire_c[32:64, lane].tobytes() == sigs[lane][:32]
+            assert wire_c[64:96, lane].tobytes() == sigs[lane][32:]
+
+    def test_device_hash_wire_shares_point_rows(self):
+        # the 96-row device-hash wire is the host-hash wire minus h
+        pks, msgs, sigs = _batch(5)
+        full, _ = eb.prepare_batch_compact(pks, msgs, sigs)
+        wire, msg, mlen, valid = eb.prepare_batch_device_hash_compact(
+            pks, msgs, sigs
+        )
+        assert wire.shape == (96, 5)
+        np.testing.assert_array_equal(wire, full[:96])
+        assert np.all(valid)
+        assert list(mlen) == [len(m) for m in msgs]
+
+
+class TestVerdictParity:
+    """Both kernels, identical batch → identical accept/reject masks,
+    and (& valid) identical to the serial CPU verifier."""
+
+    def test_mixed_valid_invalid(self):
+        pks, msgs, sigs = _batch(13, corrupt_every=4)
+        got_w, got_c, valid = _kernel_verdicts(pks, msgs, sigs)
+        np.testing.assert_array_equal(got_w, got_c)
+        want = _cpu(pks, msgs, sigs)
+        assert list(got_c & valid) == want
+
+    def test_non_canonical_s(self):
+        # s' = s + L encodes the same residue but MUST reject (the CPU
+        # path enforces canonical s); both wires carry the raw bytes and
+        # both must agree lane-for-lane
+        pks, msgs, sigs = _batch(4, tag=b"noncanon")
+        bad = list(sigs)
+        for i in (1, 3):
+            s_int = int.from_bytes(sigs[i][32:], "little")
+            bad[i] = sigs[i][:32] + (s_int + _L).to_bytes(32, "little")
+        got_w, got_c, valid = _kernel_verdicts(pks, msgs, bad)
+        np.testing.assert_array_equal(got_w, got_c)
+        assert list(valid) == [True, False, True, False]
+        assert list(got_c & valid) == _cpu(pks, msgs, bad)
+        assert _cpu(pks, msgs, bad) == [True, False, True, False]
+
+    def test_all_zero_and_all_ones_rows(self):
+        # degenerate encodings: zero A (identity-adjacent y=0), zero
+        # R/S, and 0xFF everywhere (y ≥ p, s ≥ L). No semantics asserted
+        # beyond: both formats produce the same words and the same
+        # verdicts, and nothing accepts that the CPU path rejects.
+        pks = [b"\x00" * 32, b"\xff" * 32, b"\x00" * 32, b"\xff" * 32]
+        sigs = [b"\x00" * 64, b"\xff" * 64, b"\xff" * 64, b"\x00" * 64]
+        msgs = [b"z", b"o", b"zo", b"oz"]
+        wire_w, _ = eb.prepare_batch(pks, msgs, sigs)
+        wire_c, _ = eb.prepare_batch_compact(pks, msgs, sigs)
+        np.testing.assert_array_equal(_words_from_compact(wire_c), wire_w)
+        got_w, got_c, valid = _kernel_verdicts(pks, msgs, sigs)
+        np.testing.assert_array_equal(got_w, got_c)
+        assert list(got_c & valid) == _cpu(pks, msgs, sigs)
+
+    def test_device_hash_compact_parity(self):
+        # fused on-device SHA-512 route, ragged message lengths
+        # straddling the 1-block/2-block boundary
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(23)
+        keys = [ed.gen_priv_key_from_secret(b"dh-%d" % i) for i in range(9)]
+        msgs = [bytes(rng.bytes(int(rng.integers(0, 200)))) for _ in keys]
+        sigs = [k.sign(m) for k, m in zip(keys, msgs)]
+        pks = [k.pub_key().bytes() for k in keys]
+        b = bytearray(sigs[2])
+        b[40] ^= 0x80
+        sigs[2] = bytes(b)
+
+        wire, msg, mlen, valid = eb.prepare_batch_device_hash_compact(
+            pks, msgs, sigs
+        )
+        got = np.asarray(
+            eb.verify_full_kernel_compact(
+                jnp.asarray(wire), jnp.asarray(msg), jnp.asarray(mlen)
+            )
+        )
+        _, host_c, _ = _kernel_verdicts(pks, msgs, sigs)
+        np.testing.assert_array_equal(got, host_c)
+        assert list(got & valid) == _cpu(pks, msgs, sigs)
+
+
+class TestChunkedCompactDispatch:
+    """verify_batch with the compact wire (the default) across chunk
+    boundaries: the staged-prefetch reassembly must keep lane order and
+    never smear a verdict onto a neighbor chunk."""
+
+    @pytest.mark.parametrize("size", [63, 64, 65, 129])
+    def test_boundary_sizes(self, size, monkeypatch):
+        monkeypatch.setenv("CBFT_TPU_MAX_CHUNK", "64")
+        monkeypatch.setenv("CBFT_TPU_WIRE", "compact")
+        monkeypatch.setenv("CBFT_TPU_HASH", "host")
+        pks, msgs, sigs = _batch(size, tag=b"chunk", corrupt_every=9)
+        got = eb.verify_batch(pks, msgs, sigs)
+        assert got == _cpu(pks, msgs, sigs)
+
+    def test_words_and_compact_agree_chunked(self, monkeypatch):
+        monkeypatch.setenv("CBFT_TPU_MAX_CHUNK", "64")
+        monkeypatch.setenv("CBFT_TPU_HASH", "host")
+        pks, msgs, sigs = _batch(100, tag=b"agree", corrupt_every=7)
+        monkeypatch.setenv("CBFT_TPU_WIRE", "compact")
+        got_c = eb.verify_batch(pks, msgs, sigs)
+        monkeypatch.setenv("CBFT_TPU_WIRE", "words")
+        got_w = eb.verify_batch(pks, msgs, sigs)
+        assert got_c == got_w == _cpu(pks, msgs, sigs)
+
+    def test_wire_format_env_validation(self, monkeypatch):
+        monkeypatch.setenv("CBFT_TPU_WIRE", "gzip")
+        with pytest.raises(ValueError, match="CBFT_TPU_WIRE"):
+            eb.wire_format()

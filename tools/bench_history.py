@@ -118,6 +118,13 @@ def direction(path: str, unit: Optional[str] = None) -> Optional[str]:
     # the link-regression guard
     if leaf.endswith(("_transfer_ms", "_prepare_ms")):
         return LOWER_IS_BETTER
+    # compact-wire guards (PR 13): payload size per signature lane must
+    # only ever shrink, and the dispatch loops' designed transfer/
+    # compute overlap must not regress toward exposed H2D
+    if leaf.endswith("_bytes_per_lane"):
+        return LOWER_IS_BETTER
+    if leaf.endswith("_overlap_ratio"):
+        return HIGHER_IS_BETTER
     if leaf.endswith(("_ms", "_s", "_us", "_ns")) or "_ms_" in leaf:
         return LOWER_IS_BETTER
     return None
