@@ -1034,6 +1034,94 @@ def _stage_overload():
     print(json.dumps(out), flush=True)
 
 
+def _stage_decisions():
+    """Decision-plane accuracy numbers (crypto/decisions.py): a warm
+    verify workload through a scheduler with the routing ledger
+    installed, then the ledger's own report card — per-(route, bucket)
+    prediction MAPE (the ISSUE-15 acceptance bound is <= 0.5 for every
+    profile with >= 5 observations), windowed regret, and the exact
+    reconciliation of ledger decision counts against the scheduler's
+    route counters. When CBFT_DECISIONS_SNAP names a path, a
+    verify_top-shaped snapshot lands there for tools/route_audit.py."""
+    _maybe_force_cpu()
+    _set_cache()
+    from cometbft_tpu.crypto import decisions as declib
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto import wire as wirelib
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+
+    wire_ledger = wirelib.WireLedger()
+    prev_wire = wirelib.set_default_ledger(wire_ledger)
+    ledger = declib.DecisionLedger(
+        cost_profile=wire_ledger.cost_profile()
+    )
+    prev = declib.set_default_ledger(ledger)
+    sched = VerifyScheduler(spec=BackendSpec("cpu"), flush_us=300)
+    sched.start()
+    try:
+        pks, msgs, sigs = _make_batch(256)
+        items = [
+            (ed.PubKeyEd25519(pk), m, s)
+            for pk, m, s in zip(pks, msgs, sigs)
+        ]
+        # warm: absorb any one-time import/compile wall before the
+        # ledger's cost model starts converging on steady-state cost
+        sched.submit(items[:64], subsystem="bench").result(timeout=60)
+        # two pow2 buckets, well past the >= 5-observation floor each
+        for _ in range(12):
+            ok, mask = sched.submit(
+                items[:64], subsystem="bench"
+            ).result(timeout=60)
+            assert ok and all(mask)
+            ok, mask = sched.submit(
+                items, subsystem="bench"
+            ).result(timeout=60)
+            assert ok and all(mask)
+        dsnap = ledger.snapshot()
+        qsnap = sched.queue_snapshot()
+    finally:
+        sched.stop()
+        declib.set_default_ledger(prev)
+        wirelib.set_default_ledger(prev_wire)
+
+    profiles = [
+        p for p in dsnap["profiles"]
+        if p["n"] >= 5 and p["mape"] is not None
+    ]
+    worst = max((p["mape"] for p in profiles), default=None)
+    counts, routes = dsnap["counts"], qsnap["routes"]
+    reconciled = all(
+        counts.get(r, 0) == routes.get(r, 0)
+        for r in set(counts) | set(routes)
+    )
+    snap_path = os.environ.get("CBFT_DECISIONS_SNAP")
+    if snap_path:
+        with open(snap_path, "w", encoding="utf-8") as f:
+            json.dump(
+                # "slo" marks the document a /debug/verify snapshot for
+                # verify_top.load_snapshot; the bench has no SLO plane
+                {
+                    "slo": {},
+                    "sources": {"decisions": dsnap, "scheduler": qsnap},
+                },
+                f, default=str,
+            )
+    out = {
+        "decisions": sum(counts.values()),
+        "profiles_scored": len(profiles),
+        "decisions_worst_mape": round(worst, 4) if worst is not None
+        else None,
+        "decisions_regret_ms": dsnap["windowed"]["regret_ms"],
+        "regret_rate": dsnap["windowed"]["regret_rate"],
+        "mape_ok": bool(profiles) and all(
+            p["mape"] <= 0.5 for p in profiles
+        ),
+        "reconciled": reconciled,
+    }
+    print(json.dumps(out), flush=True)
+
+
 _COLDBOOT_SCRIPT = r"""
 import json, time
 t0 = time.perf_counter()
@@ -1320,6 +1408,13 @@ def main():
     if parsed is not None:
         _append_history(parsed, stage="overload")
 
+    # decision-plane report card: prediction accuracy, regret, and the
+    # ledger/scheduler reconciliation (platform-neutral)
+    parsed, diag = _run_stage("decisions", _STAGE_ENV_CPU, 300)
+    stages["decisions"] = parsed if parsed is not None else diag
+    if parsed is not None:
+        _append_history(parsed, stage="decisions")
+
     # tracing overhead budget (<3% on the scheduler stage) + per-stage
     # dispatch breakdown — platform-neutral, so it always runs
     parsed, diag = _run_stage("trace", _STAGE_ENV_CPU, 300)
@@ -1411,6 +1506,7 @@ if __name__ == "__main__":
             "degraded": _stage_degraded,
             "overload": _stage_overload,
             "sharded": _stage_sharded,
+            "decisions": _stage_decisions,
             "trace": _stage_trace,
             "coldboot": _stage_coldboot,
         }[sys.argv[2]]()

@@ -719,6 +719,96 @@ def bench_wire() -> dict:
     }
 
 
+def bench_decisions() -> dict:
+    """Decision-ledger overhead (crypto/decisions.py), asserted on
+    CPU-only CI with the real ed25519 verify cost dominating:
+
+    - the bench_wire workload (8 requests × 64 real ed25519 sigs
+      through BackendSpec("cpu")) is timed with a DecisionLedger
+      installed as the process default and with no ledger installed,
+      best-of-3 per mode, interleaved so machine noise hits both
+      equally;
+    - ledger-on throughput must be within 1% of ledger-off throughput —
+      per flush the decision plane adds one RouteDecision open (inputs
+      snapshot + candidate pricing), one thread-local push/pop, and one
+      finish (EWMA folds + window deques) against a multi-ms dispatch;
+    - the ledger must actually have been engaged: every coalesced flush
+      lands exactly one decision record, so the ledger's route counts
+      must grow by at least one flush per ledger-on arm.
+
+    ``overhead_margin_pct`` is ``1.0 − overhead_pct`` so the harness's
+    ">0" invariant IS the <1% assertion.
+    """
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["CBFT_TPU_PROBE"] = "0"
+
+    from bench import _make_batch
+    from cometbft_tpu.crypto import decisions as declib
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+
+    n_reqs, per_req = 8, 64
+    pks, msgs, sigs = _make_batch(per_req)
+    items = [
+        (ed.PubKeyEd25519(pk), m, s) for pk, m, s in zip(pks, msgs, sigs)
+    ]
+    reqs = [list(items) for _ in range(n_reqs)]
+
+    def run_workload() -> float:
+        sched = VerifyScheduler(spec=BackendSpec("cpu"), flush_us=500)
+        sched.start()
+        try:
+            sched.submit(reqs[0], subsystem="bench").result(timeout=60)
+            t0 = time.perf_counter()
+            futs = [sched.submit(r, subsystem="bench") for r in reqs]
+            for f in futs:
+                ok, mask = f.result(timeout=60)
+                if not (ok and all(mask)):
+                    raise AssertionError("decisions bench verdict wrong")
+            return time.perf_counter() - t0
+        finally:
+            sched.stop()
+
+    ledger = declib.DecisionLedger()
+    off_s, on_s = [], []
+    prev = declib.set_default_ledger(None)
+    try:
+        for _ in range(3):  # interleave so drift hits both modes equally
+            declib.set_default_ledger(None)
+            off_s.append(run_workload())
+            declib.set_default_ledger(ledger)
+            on_s.append(run_workload())
+    finally:
+        declib.set_default_ledger(prev)
+    base, led = min(off_s), min(on_s)
+
+    n_decisions = sum(ledger.counts().values())
+    if n_decisions < 3:
+        raise AssertionError(
+            f"ledger recorded {n_decisions} decisions, expected >= 3 — "
+            "the scheduler's decision feeder was not engaged"
+        )
+
+    overhead_pct = (led - base) / base * 100.0
+    if overhead_pct >= 1.0:
+        raise AssertionError(
+            f"decision-ledger overhead {overhead_pct:.2f}% >= 1% budget "
+            f"(off={base * 1e3:.1f}ms on={led * 1e3:.1f}ms)"
+        )
+    total_sigs = n_reqs * per_req
+    return {
+        "baseline_ms": round(base * 1e3, 2),
+        "decisions_ms": round(led * 1e3, 2),
+        "baseline_sigs_per_sec": round(total_sigs / base, 1),
+        "decisions_sigs_per_sec": round(total_sigs / led, 1),
+        "overhead_margin_pct": round(1.0 - overhead_pct, 3),
+        "decision_records": int(n_decisions),
+    }
+
+
 def bench_pack() -> dict:
     """Host cost of the compact uint8 pack vs the u32 word pack it
     replaces (crypto/tpu/ed25519_batch.py), asserted on CPU-only CI —
@@ -788,6 +878,7 @@ def bench_pack() -> dict:
 
 SECTIONS = {
     "coldboot": bench_coldboot,
+    "decisions": bench_decisions,
     "pack": bench_pack,
     "ed25519": bench_ed25519,
     "validator_set": bench_validator_set,

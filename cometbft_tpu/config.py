@@ -258,6 +258,21 @@ class InstrumentationConfig:
     # EWMA window (in chunk observations) for the wire ledger's cost
     # profiles: alpha = 2/(window+1). CBFT_WIRE_WINDOW env wins.
     wire_window: int = 64
+    # Decision ledger (crypto/decisions.py): per-flush RouteDecision
+    # records with per-candidate predicted cost, prediction error,
+    # counterfactual regret, the time-series ring, and the anomaly
+    # watchdog. Off = one module-attribute read per flush.
+    # CBFT_DECISION_LEDGER env wins.
+    decision_ledger: bool = True
+    # Rolling decision window (in finished decisions) behind the
+    # windowed MAPE / regret rate and the EWMA accuracy profiles.
+    # CBFT_DECISION_WINDOW env wins.
+    decision_window: int = 64
+    # Anomaly-watchdog trip level: windowed prediction MAPE above this
+    # marks the router's world-model stale and fires one incident
+    # capture (hysteretic: re-arms after clean windows below half).
+    # CBFT_DECISION_MAPE_TRIP env wins.
+    decision_mape_trip: float = 2.0
 
 
 @dataclass
@@ -475,18 +490,32 @@ class Config:
                 "instrumentation.trace_dump_keep must be a positive "
                 f"integer, got {tdk!r}"
             )
-        for knob in ("mem_poll_ms", "profile_keep", "wire_window"):
+        for knob in (
+            "mem_poll_ms", "profile_keep", "wire_window",
+            "decision_window",
+        ):
             v = getattr(self.instrumentation, knob)
             if not isinstance(v, int) or isinstance(v, bool) or v < 1:
                 raise ValueError(
                     f"instrumentation.{knob} must be a positive "
                     f"integer, got {v!r}"
                 )
-        wl = self.instrumentation.wire_ledger
-        if not isinstance(wl, bool):
+        for knob in ("wire_ledger", "decision_ledger"):
+            v = getattr(self.instrumentation, knob)
+            if not isinstance(v, bool):
+                raise ValueError(
+                    f"instrumentation.{knob} must be a boolean, "
+                    f"got {v!r}"
+                )
+        mt = self.instrumentation.decision_mape_trip
+        if (
+            not isinstance(mt, (int, float))
+            or isinstance(mt, bool)
+            or float(mt) <= 0.0
+        ):
             raise ValueError(
-                "instrumentation.wire_ledger must be a boolean, "
-                f"got {wl!r}"
+                "instrumentation.decision_mape_trip must be a "
+                f"positive number, got {mt!r}"
             )
         pb = self.instrumentation.profile_on_burn
         if (

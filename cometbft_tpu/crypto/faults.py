@@ -1642,3 +1642,179 @@ def run_chaos_wire(
             k: v for k, v in summary.items() if k != "expected"
         })
     return summary
+
+
+def run_chaos_stale_model(
+    seed: int = 11,
+    batch: int = 16,
+    clean_flushes: int = 32,
+    jitter_flushes: int = 24,
+    recover_flushes: int = 120,
+    jitter_ms: float = 300.0,
+    logger=None,
+) -> dict:
+    """The staleness proof for the decision plane (crypto/decisions.py):
+    an injected link-jitter regime must trip the anomaly watchdog, fire
+    exactly ONE incident dump, and re-arm after clean windows.
+
+    One unsupervised VerifyScheduler over a FaultyBackend (inner CPU)
+    feeding a fresh DecisionLedger (the process default for the run;
+    ring sampled every finish so the watchdog evaluates deterministically
+    often), three regimes over the same live-mutable FaultPlan:
+
+    * **clean** — no injected jitter; the ledger's per-(route, bucket)
+      cost EWMA converges on the real dispatch wall, windowed MAPE
+      settles low, the watchdog arms (>= MIN_TRIP_OBS observations);
+    * **jitter** — ``plan.jitter_ms`` raised mid-run: every dispatch
+      stretches by a seeded jitter draw, measured walls leave the
+      model's predictions behind, windowed MAPE crosses the trip level
+      -> the watchdog fires ``on_anomaly`` ONCE (the flight-recorder
+      dump lands in a temp dir) and latches until the model adapts;
+    * **recover** — ``plan.clear()``: walls return to baseline, the
+      EWMA re-converges, the rolling window drains below HALF the trip
+      level, and after REARM_CLEAN consecutive clean samples the
+      watchdog is re-armed (it may already have re-armed late in the
+      jitter phase once the EWMA caught up — adaptation, not amnesia).
+
+    Asserts: every verdict correct in all three regimes; zero trips
+    during clean; exactly one trip + one anomaly fire + one dump file
+    for the whole run; the watchdog is re-armed (not tripped) at the
+    end. Returns a summary dict for tools/chaos.py and the tier-1 test.
+    """
+    import glob
+    import tempfile
+
+    from cometbft_tpu.crypto import decisions as declib
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+    from cometbft_tpu.libs import trace as chaostracelib
+
+    name = f"stale-model-{seed}"
+    plan = install(name=name, inner="cpu", plan=FaultPlan(seed=seed))
+
+    dump_dir = tempfile.mkdtemp(prefix="chaos_stale_model_")
+    tracer = chaostracelib.Tracer(sample=0.0)
+    tracer.set_dump_dir(dump_dir)
+    fires: List[Tuple[str, float]] = []
+
+    def on_anomaly(cause: str, value: float) -> None:
+        fires.append((cause, value))
+        tracer.dump(
+            f"decision_{cause}",
+            extra={"decision_anomaly": {"cause": cause, "value": value}},
+        )
+
+    ledger = declib.DecisionLedger(
+        window=16,
+        ring_interval_s=0.0,  # watchdog evaluates on every finish
+        on_anomaly=on_anomaly,
+    )
+    sched = VerifyScheduler(
+        spec=BackendSpec(name), flush_us=200, logger=logger
+    )
+    sched.start()
+
+    keys = [
+        ed.gen_priv_key_from_secret(b"stale-%d" % i) for i in range(batch)
+    ]
+    items = []
+    for i, k in enumerate(keys):
+        msg = b"stale model flush sig %d" % i
+        items.append((k.pub_key(), msg, k.sign(msg)))
+
+    wrong = 0
+
+    def drive(n_flushes: int) -> None:
+        nonlocal wrong
+        for _ in range(n_flushes):
+            ok, mask = sched.submit(items).result(timeout=30)
+            if not ok or not all(mask):
+                wrong += 1
+
+    # warm BEFORE the ledger installs: the faulty backend's first
+    # dispatch pays the TPU-package import, and that one-off wall must
+    # not seed the cost model (run_chaos_wire warms the same way)
+    drive(4)
+    prev = declib.set_default_ledger(ledger)
+
+    try:
+        drive(clean_flushes)
+        trips_clean = ledger.watchdog_state()["trips"]
+        plan.jitter_ms = jitter_ms
+        drive(jitter_flushes)
+        # probe the trip COUNT, not the latched flag: once the cost
+        # EWMA adapts to the jittery regime the window drains and the
+        # watchdog may legitimately re-arm before the phase ends
+        trips_jitter = ledger.watchdog_state()["trips"]
+        plan.clear()
+        drive(recover_flushes)
+    finally:
+        sched.stop()
+        declib.set_default_ledger(prev)
+
+    wd = ledger.watchdog_state()
+    win = ledger.snapshot()["windowed"]
+    dumps = sorted(glob.glob(os.path.join(dump_dir, "trace_dump_*.json")))
+
+    if wrong:
+        raise AssertionError(
+            f"stale-model chaos rung: {wrong} flushes returned wrong "
+            "verdicts"
+        )
+    if trips_clean:
+        raise AssertionError(
+            f"stale-model chaos rung: watchdog tripped {trips_clean}x "
+            "during the clean regime (false positive)"
+        )
+    if trips_jitter - trips_clean < 1:
+        raise AssertionError(
+            "stale-model chaos rung: injected jitter regime did not "
+            "trip the anomaly watchdog"
+        )
+    if wd["trips"] != 1 or len(fires) != 1:
+        raise AssertionError(
+            f"stale-model chaos rung: expected exactly one trip/fire, "
+            f"got trips={wd['trips']} fires={len(fires)}"
+        )
+    if len(dumps) != 1:
+        raise AssertionError(
+            f"stale-model chaos rung: expected exactly one incident "
+            f"dump, found {len(dumps)} in {dump_dir}"
+        )
+    if wd["tripped"] is not None:
+        raise AssertionError(
+            "stale-model chaos rung: watchdog did not re-arm after "
+            f"{recover_flushes} clean flushes (still tripped: "
+            f"{wd['tripped']})"
+        )
+
+    summary = {
+        "batch": batch,
+        "clean_flushes": clean_flushes,
+        "jitter_flushes": jitter_flushes,
+        "recover_flushes": recover_flushes,
+        "injected_jitter_ms": jitter_ms,
+        "trip_cause": fires[0][0],
+        "trip_value": round(fires[0][1], 3),
+        "trips": wd["trips"],
+        "anomaly_fires": len(fires),
+        "incident_dumps": len(dumps),
+        "dump_path": dumps[0],
+        "rearmed": wd["tripped"] is None,
+        "final_mape": win["mape"],
+        "wrong_verdicts": wrong,
+        "expected": {
+            "wrong_verdicts": 0,
+            "trips": 1,
+            "anomaly_fires": 1,
+            "incident_dumps": 1,
+            "rearmed": True,
+        },
+        "ok": True,
+    }
+    if logger is not None:
+        logger.info("chaos stale-model rung passed", **{
+            k: v for k, v in summary.items() if k != "expected"
+        })
+    return summary

@@ -89,7 +89,12 @@ import threading
 import time
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
-from cometbft_tpu.crypto import PubKey, qos as qoslib, wire as wirelib
+from cometbft_tpu.crypto import (
+    PubKey,
+    decisions as declib,
+    qos as qoslib,
+    wire as wirelib,
+)
 from cometbft_tpu.crypto.batch import (
     Backend,
     BackendSpec,
@@ -1089,12 +1094,33 @@ class VerifyScheduler(BaseService):
         origins = [
             (len(req.items), req.subsystem, req.height) for req in batch
         ]
+        # decision plane ride-along: one RouteDecision per flush, input
+        # gathering gated on an installed ledger so the off-edge is a
+        # single attribute read (bench_micro's decisions section bounds
+        # the on-edge under 1%)
+        declgr = declib.default_ledger()
+        dec = None
+        if declgr is not None:
+            dec = declgr.open(
+                n=len(items),
+                reason=reason,
+                capacity=self._decision_capacity(),
+                breakers=self._decision_breakers(),
+                keystore=self._decision_keystore(),
+                qos={name: c[1] for name, c in by_class.items()} or None,
+            )
+        t_verify = time.perf_counter()
         try:
-            with tracelib.use(dspan):
+            with tracelib.use(dspan), declib.use(dec):
                 mask, wire_route = self._verify(items, reason, origins)
         except BaseException as exc:
             dspan.end(error=repr(exc))
             raise
+        finally:
+            # finish whenever the route ladder ran (taken was noted) so
+            # ledger counts reconcile with _routes even on a raise
+            if dec is not None and dec.taken is not None:
+                declgr.finish(dec, time.perf_counter() - t_verify)
         # flush-level ledger tag: which wire route served this dispatch
         # rides on the dispatch span, and the verdict-demux loop below is
         # the ledger's fifth phase (host-side fan-out back to futures)
@@ -1124,6 +1150,38 @@ class VerifyScheduler(BaseService):
             ledger.note_demux(
                 wire_route, len(items), time.perf_counter() - t_demux
             )
+
+    # decision-plane input gathering — each best-effort and only run
+    # when a decision ledger is installed
+
+    def _decision_capacity(self) -> Optional[float]:
+        sup = self._supervisor
+        if sup is None:
+            return None
+        try:
+            return sup.healthy_capacity_fraction()
+        except Exception:  # noqa: BLE001 - inputs are advisory
+            return None
+
+    def _decision_breakers(self) -> Optional[Dict[str, str]]:
+        sup = self._supervisor
+        if sup is None:
+            return None
+        try:
+            return sup.device_states()
+        except Exception:  # noqa: BLE001 - inputs are advisory
+            return None
+
+    def _decision_keystore(self) -> Optional[Dict[str, object]]:
+        # same sys.modules guard as the memory-plane poll: CPU-only
+        # schedulers never import the TPU package for this
+        kslib = sys.modules.get("cometbft_tpu.crypto.tpu.keystore")
+        if kslib is None:
+            return None
+        try:
+            return kslib.default_store().residency()
+        except Exception:  # noqa: BLE001 - inputs are advisory
+            return None
 
     def _route_for(self, n: int) -> Optional[str]:
         """Per-flush routing decision over the three-way ladder. The CPU
@@ -1158,11 +1216,15 @@ class VerifyScheduler(BaseService):
 
     def _note_route(self, route: Optional[str]) -> None:
         if self.spec.name == "cpu":
-            self._routes["cpu"] += 1
+            label = "cpu"
         elif route == "sharded":
-            self._routes["sharded"] += 1
+            label = "sharded"
         else:
-            self._routes["single"] += 1
+            label = "single"
+        self._routes[label] += 1
+        # the decision record's taken route IS this counter's label, so
+        # ledger counts and queue_snapshot routes reconcile to the unit
+        declib.note_taken(label)
 
     def _verify(
         self,
@@ -1208,6 +1270,7 @@ class VerifyScheduler(BaseService):
             return mask, wire_route
         except Exception as exc:  # noqa: BLE001 - device plane died mid-flight
             self.metrics.cpu_fallbacks.add()
+            declib.note_event("cpu_fallback", final="cpu")
             self.logger.error(
                 "verify dispatch failed; falling back to CPU",
                 err=repr(exc), n=len(items), reason=reason,

@@ -87,7 +87,7 @@ import threading
 import time
 from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
-from cometbft_tpu.crypto import PubKey
+from cometbft_tpu.crypto import PubKey, decisions as declib
 from cometbft_tpu.crypto.batch import (
     Backend,
     BackendSpec,
@@ -791,6 +791,9 @@ class BackendSupervisor:
                 # the mesh was (or became) unavailable: fall through to
                 # the per-domain partition over whatever still serves
                 self.metrics.sharded_fallbacks.add()
+                # attribute the divergence back to the originating flush
+                # decision (the scheduler parked it on this thread)
+                declib.note_event("sharded_fallback", final="single")
                 route = None
             with self._lock:
                 healthy = [d for d in self._domains if d.state != BROKEN]
@@ -801,6 +804,7 @@ class BackendSupervisor:
                 # while the breakers are open.
                 self._maybe_probe_async()
                 self.metrics.cpu_routed.add()
+                declib.note_event("cpu_routed", final="cpu")
                 mask = self._cpu_verify(items)
                 span.end(outcome="cpu_routed")
                 return mask
@@ -895,6 +899,7 @@ class BackendSupervisor:
                     reason=reason, sharded=True,
                 )
                 self.metrics.sharded_reslices.add()
+                declib.note_event("sharded_reslice")
                 continue
             except Exception as exc:  # noqa: BLE001 - any program death
                 mspan.end(error=repr(exc))
@@ -914,6 +919,7 @@ class BackendSupervisor:
                     reason=reason,
                 )
                 self.metrics.sharded_reslices.add()
+                declib.note_event("sharded_reslice")
                 continue
             mspan.end(outcome="ok")
             return self._release_shard(
@@ -1031,9 +1037,11 @@ class BackendSupervisor:
             self._trip(
                 dom, "watchdog", err=str(exc), n=len(items), reason=reason
             )
+            declib.note_event("shard_cpu", final="cpu")
             return self._cpu_verify(items), "watchdog_cpu"
         except Exception as exc:  # noqa: BLE001 - any backend death
             self._note_failure(dom, exc, len(items), reason)
+            declib.note_event("shard_cpu", final="cpu")
             return self._cpu_verify(items), "failure_cpu"
         return self._release_shard(dom, items, mask, source, reason, origins)
 

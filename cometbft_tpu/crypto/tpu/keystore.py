@@ -157,6 +157,22 @@ class DeviceKeyStore:
             self._stats["indexed_dispatches"] += 1
             self._stats["indexed_lanes"] += int(lanes)
 
+    def residency(self) -> dict:
+        """Cheap per-flush residency summary for decision-plane inputs:
+        entry/key counts, generation, and hit rate — no per-entry rows,
+        one short lock hold."""
+        with self._mtx:
+            hits = self._stats["hits"]
+            misses = self._stats["misses"]
+            lookups = hits + misses
+            return {
+                "entries": len(self._entries),
+                "keys": sum(e.n for e in self._entries.values()),
+                "generation": self._gen,
+                "hit_rate": (hits / lookups) if lookups else None,
+                "indexed_dispatches": self._stats["indexed_dispatches"],
+            }
+
     def snapshot(self) -> dict:
         """Queryable store state for scheduler snapshots / debug RPC."""
         with self._mtx:

@@ -404,8 +404,19 @@ class WireLedger:
         the nearest measured bucket scaled around the link's fixed
         latency), then the cold link-probe seed; None when neither
         exists. This is the CostProfile interface the learned router
-        (ROADMAP item 5b) consumes."""
-        bucket = _pow2(bucket)
+        (ROADMAP item 5b) consumes.
+
+        Pinned edge behavior (the decision plane queries this for
+        every candidate on every flush, so it must NEVER raise):
+        an unknown route or a cold ledger falls down the ladder to the
+        link-probe seed, then None; a bucket below the smallest
+        observed scales only the size-dependent part down (never below
+        the link's fixed latency, never negative); a malformed bucket
+        (None, non-numeric) answers None."""
+        try:
+            bucket = _pow2(bucket)
+        except (TypeError, ValueError):
+            return None
         with self._lock:
             cands = [
                 (k[1], p) for k, p in self._profiles.items()
@@ -436,7 +447,10 @@ class WireLedger:
             # transfer
             n_chunks = -(-bucket // b0)
             hidden_ms = (p.overlap() or 0.0) * p.ewma_s["h2d"] * 1e3
-            return per_chunk * n_chunks - hidden_ms * (n_chunks - 1)
+            return max(
+                per_chunk,
+                per_chunk * n_chunks - hidden_ms * (n_chunks - 1),
+            )
         # cold: the probed link curve
         if link:
             try:

@@ -178,6 +178,7 @@ class Node(BaseService):
         from cometbft_tpu.p2p.metrics import Metrics as P2PMetrics
         from cometbft_tpu.state.metrics import Metrics as SMMetrics
 
+        from cometbft_tpu.crypto.decisions import Metrics as DecisionMetrics
         from cometbft_tpu.crypto.qos import QoSMetrics
         from cometbft_tpu.crypto.tpu.aot import Metrics as AotMetrics
         from cometbft_tpu.crypto.tpu.memory import Metrics as MemPlaneMetrics
@@ -198,6 +199,7 @@ class Node(BaseService):
             tel_metrics = TelMetrics(self.metrics_registry)
             memplane_metrics = MemPlaneMetrics(self.metrics_registry)
             wire_metrics = WireMetrics(self.metrics_registry)
+            decision_metrics = DecisionMetrics(self.metrics_registry)
         else:
             self.metrics_registry = None
             cons_metrics = ConsMetrics.nop()
@@ -211,6 +213,7 @@ class Node(BaseService):
             tel_metrics = TelMetrics.nop()
             memplane_metrics = MemPlaneMetrics.nop()
             wire_metrics = WireMetrics.nop()
+            decision_metrics = DecisionMetrics.nop()
         # the AOT executable registry is process-global (it backs the
         # mesh dispatch layer, which predates any Node); the node only
         # lends it an exporter, exactly like the topology default above
@@ -366,6 +369,67 @@ class Node(BaseService):
         self.tracer.set_dump_context(
             lambda: {"memory": _mem_plane.snapshot()}
         )
+
+        # 0h. the decision ledger (crypto/decisions.py): one
+        # RouteDecision per coalesced flush — inputs, per-candidate
+        # predicted cost (over the wire ledger's CostProfile), taken vs
+        # final route, prediction error, counterfactual regret — plus
+        # the time-series ring and the anomaly watchdog. The watchdog
+        # fires the same incident-capture path a breaker trip does:
+        # flight-recorder dump + profiler one-shot, tagged with the
+        # anomaly cause.
+        from cometbft_tpu.crypto import decisions as declib
+
+        if declib.decision_ledger_default(
+            config.instrumentation.decision_ledger
+        ):
+            _tracer, _profiler = self.tracer, self.profiler
+
+            def _on_route_anomaly(cause: str, value: float) -> None:
+                _tracer.dump(
+                    f"decision_{cause}",
+                    extra={"decision_anomaly": {
+                        "cause": cause, "value": value,
+                    }},
+                )
+                _profiler.on_breaker_trip(f"decision_{cause}")
+
+            self.decision_ledger = declib.DecisionLedger(
+                window=declib.decision_window_default(
+                    config.instrumentation.decision_window
+                ),
+                mape_trip=declib.decision_mape_trip_default(
+                    config.instrumentation.decision_mape_trip
+                ),
+                cost_profile=(
+                    self.wire_ledger.cost_profile()
+                    if self.wire_ledger is not None else None
+                ),
+                metrics=decision_metrics,
+                on_anomaly=_on_route_anomaly,
+            )
+            declib.set_default_ledger(self.decision_ledger)
+            self.telemetry_hub.register_source(
+                "decisions", self.decision_ledger.snapshot
+            )
+        else:
+            self.decision_ledger = None
+
+        # 0i. the device key store as its own telemetry source: decision
+        # records cite residency from the same plane /debug/verify
+        # serves. The sys.modules guard keeps CPU-only nodes from ever
+        # importing the TPU package for it.
+        def _keystore_source():
+            import sys as _sys
+
+            kslib = _sys.modules.get("cometbft_tpu.crypto.tpu.keystore")
+            if kslib is None:
+                return {"resident": False}
+            snap = kslib.default_store().snapshot()
+            snap["resident"] = bool(snap.get("entries"))
+            return snap
+
+        self.telemetry_hub.register_source("keystore", _keystore_source)
 
         # 0a. the backend supervisor: every coalesced dispatch runs
         # under its watchdog / circuit breaker / corruption audit, so a
@@ -1024,6 +1088,16 @@ class Node(BaseService):
             ledger = getattr(self, "wire_ledger", None)
             if ledger is not None and wirelib.default_ledger() is ledger:
                 wirelib.set_default_ledger(None)
+        except Exception:  # noqa: BLE001 - teardown is best-effort
+            pass
+        # same for the decision ledger — a later node's flushes must
+        # not fold into a stopped node's accuracy profiles
+        try:
+            from cometbft_tpu.crypto import decisions as declib
+
+            dledger = getattr(self, "decision_ledger", None)
+            if dledger is not None and declib.default_ledger() is dledger:
+                declib.set_default_ledger(None)
         except Exception:  # noqa: BLE001 - teardown is best-effort
             pass
         # same for the memory plane — and fold what it LEARNED (observed

@@ -230,3 +230,22 @@ class TestSnapshotPlumbing:
             "hits", "misses", "uploads", "stale_drops",
             "indexed_dispatches",
         }
+
+    def test_residency_summary_for_decision_plane(self, store):
+        # PR 15: the cheap per-flush summary the decision ledger embeds
+        # in every RouteDecision (and the telemetry keystore source)
+        empty = store.residency()
+        assert empty["entries"] == 0 and empty["keys"] == 0
+        # stats survive invalidate(): hit_rate is None only on a virgin
+        # store, else a ratio
+        assert empty["hit_rate"] is None or 0.0 <= empty["hit_rate"] <= 1.0
+        keys, pks, vid = _valset(4, b"resid")
+        _resident(vid, pks, keys)
+        msgs, sigs = _flush(keys, b"resid-hit")
+        assert eb.verify_valset_resident(vid, pks, msgs, sigs) == \
+            [True] * 4
+        res = store.residency()
+        assert res["entries"] == 1 and res["keys"] == 4
+        assert res["generation"] >= 1
+        assert 0.0 < res["hit_rate"] <= 1.0
+        assert isinstance(res["indexed_dispatches"], int)

@@ -371,6 +371,111 @@ class TestExpositionConformance:
                 {"route": "single"}, 0.5) in samples
 
 
+    def test_verify_route_family_conformance(self):
+        """The decision ledger's verify_route_* families, driven by a
+        real DecisionLedger (undiverted + diverted decisions, a forced
+        watchdog trip), must survive the strict v0.0.4 parse with the
+        route and cause labels intact."""
+        from cometbft_tpu.crypto import decisions as declib
+
+        r = Registry("cometbft")
+        led = declib.DecisionLedger(
+            window=declib.MIN_TRIP_OBS,
+            ring_interval_s=0.0,
+            metrics=declib.Metrics(r),
+        )
+        for _ in range(declib.MIN_TRIP_OBS + declib.MIN_SELF_OBS):
+            dec = led.open(n=16, reason="size")
+            dec.taken = "cpu"
+            led.finish(dec, 0.002)
+        fb = led.open(n=16, reason="size")
+        fb.taken = "sharded"
+        led.note_event(fb, "sharded_fallback", final="single")
+        led.finish(fb, 0.010)
+        dec = led.open(n=16, reason="size")  # stale wall: trips mape
+        dec.taken = "cpu"
+        led.finish(dec, 0.200)
+        types, samples = _parse_exposition(r.expose())
+        for counter in ("decisions", "fallbacks", "anomaly_trips"):
+            assert types[f"cometbft_verify_route_{counter}"] == "counter"
+        for gauge in ("mape", "regret_ms", "anomaly"):
+            assert types[f"cometbft_verify_route_{gauge}"] == "gauge"
+        assert (
+            types["cometbft_verify_route_error_seconds"] == "histogram"
+        )
+        by_route = {
+            l.get("route"): v for n, l, v in samples
+            if n == "cometbft_verify_route_decisions"
+        }
+        assert by_route.get("cpu", 0) >= declib.MIN_TRIP_OBS
+        assert by_route.get("sharded") == 1.0
+        assert ("cometbft_verify_route_fallbacks", {"route": "sharded"},
+                1.0) in samples
+        assert ("cometbft_verify_route_anomaly_trips", {"cause": "mape"},
+                1.0) in samples
+        assert ("cometbft_verify_route_anomaly", {}, 1.0) in samples
+
+
+class TestReadmeDocDrift:
+    def test_every_verify_family_documented_in_readme(self):
+        """Doc-drift guard (PR 15 satellite): every verify_* metric
+        family the crypto planes can export must appear by name in
+        README.md — a new instrument without its reference-table row
+        fails tier-1."""
+        import os
+
+        from cometbft_tpu.crypto import decisions as declib
+        from cometbft_tpu.crypto import qos as qoslib
+        from cometbft_tpu.crypto import scheduler as schedlib
+        from cometbft_tpu.crypto import supervisor as suplib
+        from cometbft_tpu.crypto import telemetry as tellib
+        from cometbft_tpu.crypto import wire as wirelib
+        from cometbft_tpu.crypto.tpu import aot as aotlib
+        from cometbft_tpu.crypto.tpu import memory as memlib
+
+        r = Registry("cometbft")
+        declib.Metrics(r)
+        qoslib.QoSMetrics(r)
+        schedlib.Metrics(r)
+        suplib.Metrics(r)
+        tellib.Metrics(r)
+        wirelib.Metrics(r)
+        aotlib.Metrics(r)
+        memlib.Metrics(r)
+        families = sorted(
+            name[len("cometbft_"):]
+            for name in r._instruments
+            if name.startswith("cometbft_verify_")
+        )
+        assert families, "no verify_* families registered?"
+        readme = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "README.md",
+        )
+        with open(readme, "r", encoding="utf-8") as f:
+            doc = f.read()
+
+        def documented(fam: str) -> bool:
+            # reference-table rows carry the full family name; the
+            # Observability bullets document `verify_<sub>_*` with the
+            # member names backticked — honor both idioms
+            if fam in doc:
+                return True
+            parts = fam.split("_")
+            for cut in range(2, len(parts)):
+                prefix = "_".join(parts[:cut])
+                suffix = "_".join(parts[cut:])
+                if f"`{prefix}_*`" in doc and f"`{suffix}`" in doc:
+                    return True
+            return False
+
+        missing = [fam for fam in families if not documented(fam)]
+        assert not missing, (
+            "verify_* metric families exported but not documented in "
+            f"README.md: {missing}"
+        )
+
+
 class TestConcurrencyHammer:
     def test_with_labels_races_expose(self):
         """Satellite contract: scrapes concurrent with hot-path child

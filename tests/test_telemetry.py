@@ -441,6 +441,47 @@ class TestVerifyTopCLI:
         assert res.returncode == 0, res.stderr[-400:]
         assert "light" in res.stdout
 
+    def test_json_one_shot_round_trips_snapshot(self, tmp_path):
+        # PR 15 satellite: `verify_top --json` must emit ONE parseable
+        # machine-readable snapshot (route_audit's input contract)
+        from cometbft_tpu.crypto.decisions import DecisionLedger
+
+        hub = TelemetryHub()
+        hub.note_request(8, 0.0, 0.002, True, subsystem="consensus")
+        led = DecisionLedger(ring_interval_s=0.0)
+        for _ in range(4):
+            dec = led.open(n=8, reason="size")
+            dec.taken = "cpu"
+            led.finish(dec, 0.002)
+        hub.register_source("decisions", led.snapshot)
+        hub.register_source(
+            "keystore",
+            lambda: {"resident": True, "entries": [],
+                     "stats": {"hits": 3, "misses": 1}},
+        )
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(hub.snapshot()))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        res = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "verify_top.py"),
+             str(path), "--json"],
+            capture_output=True, text=True, timeout=60, cwd=repo,
+        )
+        assert res.returncode == 0, res.stderr[-400:]
+        doc = json.loads(res.stdout)  # exactly one JSON document
+        assert doc["sources"]["decisions"]["counts"] == {"cpu": 4}
+        assert doc["sources"]["keystore"]["resident"] is True
+        assert "slo" in doc
+        # and the human rendering carries the new sections
+        res = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools", "verify_top.py"),
+             str(path), "--once"],
+            capture_output=True, text=True, timeout=60, cwd=repo,
+        )
+        assert res.returncode == 0, res.stderr[-400:]
+        assert "decision plane" in res.stdout
+        assert "keystore" in res.stdout
+
     def test_rejects_non_snapshot(self, tmp_path):
         path = tmp_path / "junk.json"
         path.write_text('{"hello": 1}')

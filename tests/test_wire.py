@@ -215,6 +215,61 @@ class TestCostProfile:
         assert cp.observations("single", 256) == 3
 
 
+class TestPredictMsEdges:
+    """Pinned edge behavior (PR 15): the decision plane prices every
+    candidate on every flush through predict_ms, so it must NEVER
+    raise and its edges are regression-locked here."""
+
+    def test_unknown_route_falls_to_seed_then_none(self):
+        ledger = WireLedger(window=4)
+        for _ in range(3):
+            _note_uniform_chunk(ledger, route="single")
+        # no profile for the route, no link seed: None (not a raise)
+        assert ledger.predict_ms("no-such-route", 256) is None
+        # with a link seed the unknown route prices off the cold curve
+        ledger.seed_link({"effective_MBps": 1000.0,
+                          "fixed_latency_ms_est": 1.0})
+        pred = ledger.predict_ms("no-such-route", 256)
+        assert pred is not None and pred > 0.0
+
+    def test_bucket_below_smallest_observed_keeps_fixed_floor(self):
+        ledger = WireLedger(window=8)
+        ledger.seed_link({"fixed_latency_ms_est": 1.0})
+        for _ in range(5):
+            _note_uniform_chunk(ledger, bucket=1024, h2d_s=4e-3)
+        per_chunk = ledger.predict_ms("single", 1024)
+        tiny = ledger.predict_ms("single", 1)
+        # only the size-dependent part scales down: never below the
+        # link's fixed latency, never negative
+        assert tiny is not None and 1.0 <= tiny <= per_chunk
+
+    def test_bucket_above_largest_never_cheaper_than_one_chunk(self):
+        ledger = WireLedger(window=8)
+        # pathological overlap: hidden transfer bigger than the chunk
+        # itself must not predict a megabatch cheaper than one chunk
+        for _ in range(5):
+            _note_uniform_chunk(ledger, bucket=256, h2d_s=50e-3,
+                                hidden_s=50e-3)
+        per_chunk = ledger.predict_ms("single", 256)
+        mega = ledger.predict_ms("single", 16384)
+        assert mega >= per_chunk
+
+    def test_malformed_bucket_answers_none_never_raises(self):
+        ledger = WireLedger(window=4)
+        for _ in range(3):
+            _note_uniform_chunk(ledger)
+        for bad in (None, "256x", object()):
+            assert ledger.predict_ms("single", bad) is None
+        # and through the CostProfile wrapper the decision plane holds
+        assert ledger.cost_profile().predict_ms("single", None) is None
+
+    def test_cold_ledger_every_route_is_none(self):
+        ledger = WireLedger()
+        for route in ("cpu", "single", "sharded", "indexed",
+                      "device_hash"):
+            assert ledger.predict_ms(route, 64) is None
+
+
 # ---------------------------------------------------------------------------
 # calibration cold seed (tools/tpu_link_probe.py --merge roundtrip)
 # ---------------------------------------------------------------------------

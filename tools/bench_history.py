@@ -125,6 +125,14 @@ def direction(path: str, unit: Optional[str] = None) -> Optional[str]:
         return LOWER_IS_BETTER
     if leaf.endswith("_overlap_ratio"):
         return HIGHER_IS_BETTER
+    # decision-plane guards (PR 15): routing-prediction accuracy and
+    # counterfactual regret must only ever improve; explicit because
+    # "mape" is a ratio (the generic ratio rule would drop it) and the
+    # regret guard must survive a suffix-rule rework
+    if leaf.endswith("_mape"):
+        return LOWER_IS_BETTER
+    if leaf.endswith("_regret_ms"):
+        return LOWER_IS_BETTER
     if leaf.endswith(("_ms", "_s", "_us", "_ns")) or "_ms_" in leaf:
         return LOWER_IS_BETTER
     return None

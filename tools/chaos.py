@@ -1,6 +1,6 @@
 """Standalone chaos harness against the supervised verify plane.
 
-Seven modes:
+Eight modes:
 
 * default (smoke) — crypto/faults.py run_chaos_smoke: a fast,
   deterministic walk of every degradation-ladder rung (transient retry,
@@ -54,6 +54,14 @@ Seven modes:
   blames the slowdown on the h2d transfer phase (grew by at least half
   the injected sleep) and NOT compute (stays flat), with every verdict
   still ground-truth-exact. Fast and deterministic; runs in tier-1 CI.
+
+* --stale-model — crypto/faults.py run_chaos_stale_model: the
+  decision-plane staleness proof. A clean regime lets the routing
+  ledger's cost model converge; injected link jitter then leaves the
+  model's predictions behind, the windowed MAPE crosses the trip
+  level, and the anomaly watchdog must fire exactly ONE incident
+  capture (flight-recorder dump) and re-arm once walls recover —
+  proving the watchdog detects a stale cost model without flapping.
 
 * --soak — crypto/faults.py run_chaos_soak: a randomized fault schedule
   (exceptions, hangs, silent verdict corruption, sudden death, jitter,
@@ -147,6 +155,14 @@ def main() -> int:
     ap.add_argument("--jitter-ms", type=float, default=25.0,
                     help="[wire] per-put jitter draw ceiling "
                          "(default 25)")
+    ap.add_argument("--stale-model", action="store_true",
+                    help="run the decision-plane staleness rung: "
+                         "injected link jitter must trip the routing "
+                         "ledger's anomaly watchdog, fire exactly one "
+                         "incident dump, and re-arm after recovery")
+    ap.add_argument("--stale-jitter-ms", type=float, default=300.0,
+                    help="[stale-model] per-dispatch jitter draw "
+                         "ceiling for the stale regime (default 300)")
     args = ap.parse_args()
 
     if args.inner == "cpu":
@@ -200,6 +216,26 @@ def main() -> int:
             <= max(5.0, 0.25 * summary["injected_jitter_ms"])
         )
         print("CHAOS WIRE", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+
+    if args.stale_model:
+        from cometbft_tpu.crypto.faults import run_chaos_stale_model
+
+        summary = run_chaos_stale_model(
+            seed=args.seed, jitter_ms=args.stale_jitter_ms,
+        )
+        print(json.dumps(summary, indent=2))
+        # run_chaos_stale_model asserts the invariants inline; re-check
+        # the headline ones so --stale-model reads like the other rungs
+        ok = (
+            summary["ok"]
+            and summary["wrong_verdicts"] == 0
+            and summary["trips"] == 1
+            and summary["anomaly_fires"] == 1
+            and summary["incident_dumps"] == 1
+            and summary["rearmed"]
+        )
+        print("CHAOS STALE-MODEL", "PASS" if ok else "FAIL")
         return 0 if ok else 1
 
     if args.overload:

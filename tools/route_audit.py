@@ -1,0 +1,246 @@
+"""route_audit — offline audit of the decision plane.
+
+Reads one /debug/verify snapshot (URL, snapshot file, or a
+``verify_top --json`` dump) and answers the questions the learned
+router (ROADMAP item 5b) will be judged by:
+
+* per-(route, bucket) prediction accuracy — observation count, EWMA
+  measured cost, EWMA absolute error, MAPE;
+* the top-K regret decisions — the flushes where the road not taken
+  was predicted cheapest (the router's training signal);
+* reconciliation — per-route decision counts vs the scheduler's route
+  counters (they must match to the unit; a drift means attribution is
+  broken);
+* watchdog state (tripped cause, trip count).
+
+Usage:
+    python tools/route_audit.py http://127.0.0.1:26660
+    python tools/route_audit.py snap.json --top 10
+    python tools/route_audit.py snap.json --chrome trace.json
+
+``--chrome`` exports the recent decision records as a chrome://tracing
+/ Perfetto-loadable trace-events JSON: one complete event per decision
+on a per-route track, with the record's inputs, candidates, error, and
+regret in args.
+
+Exit status: 0 clean, 1 load/parse error, 2 reconciliation drift or a
+tripped watchdog (CI gates on it).
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.verify_top import load_snapshot, _fmt_table  # noqa: E402
+
+
+def _round(v: Any, nd: int = 3) -> Any:
+    return round(v, nd) if isinstance(v, (int, float)) else "-"
+
+
+def error_table(decisions: Dict[str, Any]) -> str:
+    """The per-(route, bucket) prediction-accuracy table."""
+    rows = []
+    for p in decisions.get("profiles", []):
+        rows.append({
+            "route": p.get("route", "-"),
+            "bucket": p.get("bucket", "-"),
+            "n": p.get("n", 0),
+            "cost_ms": _round(p.get("cost_ewma_ms")),
+            "err_ms": _round(p.get("err_ewma_ms")),
+            "mape": _round(p.get("mape")),
+        })
+    return _fmt_table(
+        rows, ["route", "bucket", "n", "cost_ms", "err_ms", "mape"]
+    )
+
+
+def top_regret(
+    decisions: Dict[str, Any], k: int = 10
+) -> List[Dict[str, Any]]:
+    """The K recent decisions with the largest counterfactual regret."""
+    recent = [
+        r for r in decisions.get("recent", [])
+        if isinstance(r.get("regret_ms"), (int, float))
+    ]
+    recent.sort(key=lambda r: r["regret_ms"], reverse=True)
+    return recent[:k]
+
+
+def reconcile(
+    decisions: Dict[str, Any], scheduler: Dict[str, Any]
+) -> List[str]:
+    """Per-route decision counts vs the scheduler's route counters.
+    → list of human-readable drift lines (empty = clean)."""
+    counts = decisions.get("counts", {})
+    routes = scheduler.get("routes", {})
+    drifts = []
+    for route in sorted(set(counts) | set(routes)):
+        want = routes.get(route, 0)
+        got = counts.get(route, 0)
+        if want != got:
+            drifts.append(
+                f"route {route}: scheduler counted {want} flushes, "
+                f"ledger recorded {got} decisions"
+            )
+    return drifts
+
+
+def chrome_trace(decisions: Dict[str, Any]) -> Dict[str, Any]:
+    """Recent decision records as chrome://tracing trace-events JSON:
+    one complete ("X") event per decision, tracks per taken route."""
+    events = []
+    routes = sorted({
+        r.get("taken", "?") for r in decisions.get("recent", [])
+    })
+    tids = {r: i + 1 for i, r in enumerate(routes)}
+    for r in decisions.get("recent", []):
+        wall_ms = r.get("wall_ms")
+        if not isinstance(wall_ms, (int, float)):
+            continue
+        events.append({
+            "name": f"{r.get('final', '?')} n={r.get('n', '?')}",
+            "cat": "decision",
+            "ph": "X",
+            "ts": int(float(r.get("ts", 0.0)) * 1e6),
+            "dur": max(1, int(wall_ms * 1e3)),
+            "pid": 1,
+            "tid": tids.get(r.get("taken", "?"), 0),
+            "args": {
+                "seq": r.get("seq"),
+                "reason": r.get("reason"),
+                "bucket": r.get("bucket"),
+                "taken": r.get("taken"),
+                "final": r.get("final"),
+                "events": r.get("events"),
+                "predicted_ms": r.get("predicted_ms"),
+                "error_ms": r.get("error_ms"),
+                "regret_ms": r.get("regret_ms"),
+                "capacity": r.get("capacity"),
+                "qos": r.get("qos"),
+            },
+        })
+    meta = [
+        {
+            "name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+            "args": {"name": f"route:{route}"},
+        }
+        for route, tid in tids.items()
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Audit the decision plane: prediction accuracy, "
+                    "top-K regret, route reconciliation."
+    )
+    ap.add_argument(
+        "source",
+        help="a node's /debug/verify URL, a snapshot JSON file, or a "
+             "verify_top --json dump",
+    )
+    ap.add_argument(
+        "--top", type=int, default=10,
+        help="how many top-regret decisions to print (default 10)",
+    )
+    ap.add_argument(
+        "--chrome", metavar="PATH",
+        help="write the recent decisions as chrome://tracing "
+             "trace-events JSON to PATH",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        snap = load_snapshot(args.source)
+    except Exception as exc:  # noqa: BLE001 - CLI surface
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    sources = snap.get("sources", {})
+    decisions = sources.get("decisions") if isinstance(sources, dict) \
+        else None
+    if not isinstance(decisions, dict):
+        print(
+            "error: snapshot has no decisions source (decision ledger "
+            "off, or a pre-decision-plane node)", file=sys.stderr,
+        )
+        return 1
+    scheduler = sources.get("scheduler", {})
+
+    counts = decisions.get("counts", {})
+    win = decisions.get("windowed", {})
+    print(
+        "decision plane  "
+        + "  ".join(f"{r}={counts.get(r, 0)}" for r in sorted(counts))
+        + f"  window={decisions.get('window', '?')}"
+        f"  mape={_round(win.get('mape'))}"
+        f"  regret_rate={_round(win.get('regret_rate'))}"
+        f"  regret_ms={_round(win.get('regret_ms'))}"
+    )
+    print()
+    print("prediction accuracy (per route, bucket):")
+    print(error_table(decisions))
+
+    regrets = top_regret(decisions, args.top)
+    print()
+    print(f"top-{args.top} regret decisions:")
+    rows = [
+        {
+            "seq": r.get("seq", "-"),
+            "reason": r.get("reason", "-"),
+            "n": r.get("n", "-"),
+            "taken": r.get("taken", "-"),
+            "final": r.get("final", "-"),
+            "wall_ms": _round(r.get("wall_ms")),
+            "regret_ms": _round(r.get("regret_ms")),
+            "best": min(
+                (
+                    (v, c) for c, v in (r.get("predicted_ms") or {}).items()
+                    if isinstance(v, (int, float))
+                ),
+                default=(None, "-"),
+            )[1],
+        }
+        for r in regrets
+    ]
+    print(_fmt_table(
+        rows,
+        ["seq", "reason", "n", "taken", "final", "wall_ms", "regret_ms",
+         "best"],
+    ))
+
+    wd = decisions.get("watchdog", {})
+    print()
+    print(
+        f"watchdog  tripped={wd.get('tripped') or '-'}  "
+        f"trips={wd.get('trips', 0)}  "
+        f"mape_trip={wd.get('mape_trip', '-')}  "
+        f"regret_trip={wd.get('regret_trip', '-')}"
+    )
+
+    drifts = reconcile(decisions, scheduler)
+    if drifts:
+        print()
+        for d in drifts:
+            print(f"RECONCILIATION DRIFT: {d}")
+    else:
+        print("reconciliation  ledger counts == scheduler route counters")
+
+    if args.chrome:
+        doc = chrome_trace(decisions)
+        with open(args.chrome, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        print(
+            f"chrome trace: {args.chrome} "
+            f"({len(doc['traceEvents'])} events)"
+        )
+
+    return 2 if (drifts or wd.get("tripped")) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,9 +13,12 @@ Usage:
     python tools/verify_top.py http://127.0.0.1:26660          # path added
     python tools/verify_top.py snapshot.json --once
     python tools/verify_top.py URL --interval 1 --count 10
+    python tools/verify_top.py URL --json > snap.json
 
-``--once`` prints a single frame and exits (tests / CI / cron); without
-it the screen refreshes every ``--interval`` seconds until ^C.
+``--once`` prints a single frame and exits (tests / CI / cron);
+``--json`` prints one machine-readable snapshot (the raw /debug/verify
+document — what route_audit consumes) and exits; without either the
+screen refreshes every ``--interval`` seconds until ^C.
 """
 
 import argparse
@@ -93,6 +96,29 @@ def _phase_bar(phase_ms: List[float], width: int = 16) -> str:
     for c in sorted(cells, key=lambda c: c[1] - c[2], reverse=True)[:short]:
         c[2] += 1
     return "".join(c[0] * c[2] for c in cells).ljust(width, "-")
+
+
+_SPARK_GLYPHS = " .:-=+*#%@"
+
+
+def _sparkline(values: List[Any], width: int = 32) -> str:
+    """ASCII-safe sparkline over the newest ``width`` samples, scaled
+    to the visible max (None samples render as spaces)."""
+    tail = list(values)[-width:]
+    nums = [v for v in tail if isinstance(v, (int, float))]
+    if not nums:
+        return "-" * width
+    hi = max(nums)
+    lo = min(nums)
+    span = (hi - lo) or 1.0
+    cells = []
+    for v in tail:
+        if not isinstance(v, (int, float)):
+            cells.append(" ")
+            continue
+        lvl = int((v - lo) / span * (len(_SPARK_GLYPHS) - 1))
+        cells.append(_SPARK_GLYPHS[lvl])
+    return "".join(cells).rjust(width)
 
 
 def _human_bytes(v: Any) -> str:
@@ -196,6 +222,20 @@ def render(snap: Dict[str, Any]) -> str:
                     f"burn={bo.get('last_burn', '-')}  "
                     f"state={bo.get('last_state', '-')}"
                 )
+    ks = sources.get("keystore", {}) if isinstance(sources, dict) else {}
+    ks_entries = ks.get("entries") if isinstance(ks, dict) else None
+    if isinstance(ks_entries, list):
+        stats = ks.get("stats", {}) if isinstance(ks.get("stats"), dict) \
+            else {}
+        lookups = stats.get("hits", 0) + stats.get("misses", 0)
+        hit_rate = stats.get("hits", 0) / lookups if lookups else None
+        out.append(
+            f"keystore  entries={len(ks_entries)}  "
+            f"keys={sum(e.get('keys', 0) for e in ks_entries)}  "
+            f"gen={ks.get('generation', '-')}  "
+            f"hit_rate={_pct(hit_rate)}  "
+            f"indexed={stats.get('indexed_dispatches', 0)}"
+        )
     fill = snap.get("lane_fill", {})
     if fill.get("padded_lanes"):
         out.append(
@@ -303,6 +343,54 @@ def render(snap: Dict[str, Any]) -> str:
                 )
             )
 
+    dec = sources.get("decisions", {}) if isinstance(sources, dict) else {}
+    dec_profiles = dec.get("profiles") if isinstance(dec, dict) else None
+    if isinstance(dec_profiles, list) and dec_profiles:
+        counts = dec.get("counts", {})
+        win = dec.get("windowed", {})
+        wd = dec.get("watchdog", {})
+        out.append("")
+        out.append(
+            f"decision plane (window={dec.get('window', '?')}, "
+            "decisions="
+            + ",".join(
+                f"{r}={counts.get(r, 0)}" for r in sorted(counts)
+            )
+            + f", mape={win.get('mape', '-')}"
+            f", regret_rate={win.get('regret_rate', '-')}"
+            + ("  ANOMALY:" + wd["tripped"] if wd.get("tripped") else "")
+            + "):"
+        )
+        dec_rows = []
+        for p in dec_profiles:
+            dec_rows.append({
+                "route": p.get("route", "-"),
+                "bucket": p.get("bucket", "-"),
+                "n": p.get("n", "-"),
+                "cost_ms": round(p.get("cost_ewma_ms", 0.0), 3),
+                "err_ms": round(p.get("err_ewma_ms", 0.0), 3),
+                "mape": round(p.get("mape", 0.0), 3),
+            })
+        out.append(_fmt_table(
+            dec_rows,
+            ["route", "bucket", "n", "cost_ms", "err_ms", "mape"],
+        ))
+        ring = dec.get("ring")
+        if isinstance(ring, list) and ring:
+            for field, label in (
+                ("mape", "mape"),
+                ("regret_rate", "regret"),
+                ("duty_cycle", "duty"),
+                ("p99_ms", "p99ms"),
+                ("burn_rate", "burn"),
+            ):
+                series = [s.get(field) for s in ring]
+                if any(isinstance(v, (int, float)) for v in series):
+                    out.append(
+                        f"  {label:>6} |{_sparkline(series)}| "
+                        f"last={series[-1] if series[-1] is not None else '-'}"
+                    )
+
     lat_rows = []
     for label in sorted(domains):
         model = domains[label].get("latency_model")
@@ -360,6 +448,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="print one frame and exit (tests / CI)",
     )
     ap.add_argument(
+        "--json", action="store_true",
+        help="print one machine-readable snapshot (the raw "
+             "/debug/verify document) and exit — the CI / route_audit "
+             "one-shot mode",
+    )
+    ap.add_argument(
         "--interval", type=float, default=2.0,
         help="refresh period in seconds (default 2)",
     )
@@ -376,6 +470,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         except Exception as exc:  # noqa: BLE001 - CLI surface
             print(f"error: {exc}", file=sys.stderr)
             return 1
+        if args.json:
+            print(json.dumps(snap, indent=2, sort_keys=True, default=str))
+            return 0
         frame = render(snap)
         if args.once:
             print(frame)
