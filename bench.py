@@ -1107,6 +1107,15 @@ def _stage_decisions():
                 },
                 f, default=str,
             )
+    # live-router acceptance gate (ISSUE 16): route_audit's own
+    # --assert-live judgement over the snapshot this stage just built —
+    # every priced-tagged decision took its feasible argmin and any
+    # rollback carries a justifying cause. The audit tool IS the gate;
+    # the bench only runs it.
+    from tools import route_audit
+
+    live_problems = route_audit.assert_live(dsnap, qsnap)
+    assert not live_problems, f"route_audit --assert-live: {live_problems}"
     out = {
         "decisions": sum(counts.values()),
         "profiles_scored": len(profiles),
@@ -1118,6 +1127,118 @@ def _stage_decisions():
             p["mape"] <= 0.5 for p in profiles
         ),
         "reconciled": reconciled,
+        "route_audit_live_ok": not live_problems,
+    }
+    print(json.dumps(out), flush=True)
+
+
+def _stage_routing():
+    """Live-router head-to-head (ISSUE 16): the SAME warm workload
+    through two schedulers over a fault-free CPU-inner device backend —
+    one pinned to the threshold ladder (CBFT_ROUTER=threshold), one on
+    the priced argmin — recording throughput, per-flush p99, the priced
+    run's windowed regret, and its taken-vs-argmin divergence (the
+    route_audit --assert-live judgement, run in-process as the
+    acceptance gate). The priced ledger seeds the cpu rung expensive so
+    the argmin can engage the moment the single-chip self-EWMA warms."""
+    _maybe_force_cpu()
+    _set_cache()
+    from cometbft_tpu.crypto import decisions as declib
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.faults import FaultPlan, install
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+    from cometbft_tpu.crypto.supervisor import BackendSupervisor
+    from tools import route_audit
+
+    n = 512
+    pks, msgs, sigs = _make_batch(n)
+    items = [
+        (ed.PubKeyEd25519(pk), m, s) for pk, m, s in zip(pks, msgs, sigs)
+    ]
+    rounds = 16
+
+    def run(router: str):
+        install(name=f"bench-routing-{router}", inner="cpu",
+                plan=FaultPlan())
+        sup = BackendSupervisor(
+            spec=BackendSpec(f"bench-routing-{router}"),
+            dispatch_timeout_ms=10_000, breaker_threshold=3,
+            audit_pct=0, retry_ms=5,
+        )
+        ledger = declib.DecisionLedger(
+            # price the host rung well above any measured device wall so
+            # the argmin engages (and never dodges to cpu) as soon as
+            # the single-chip rung has MIN_SELF_OBS observations
+            seed=lambda route, bucket: 1e6 if route == "cpu" else None,
+        )
+        prev = declib.set_default_ledger(ledger)
+        sched = VerifyScheduler(
+            spec=BackendSpec(f"bench-routing-{router}"), flush_us=300,
+            supervisor=sup, router=router,
+        )
+        sched.start()
+        walls = []
+        try:
+            sched.submit(items[:64], subsystem="bench").result(timeout=60)
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                t = time.perf_counter()
+                ok, mask = sched.submit(
+                    items, subsystem="bench"
+                ).result(timeout=60)
+                walls.append((time.perf_counter() - t) * 1e3)
+                assert ok and all(mask)
+            total_s = time.perf_counter() - t0
+        finally:
+            sched.stop()
+            declib.set_default_ledger(prev)
+            sup.stop()
+        walls.sort()
+        p99 = walls[min(len(walls) - 1, int(0.99 * len(walls)))]
+        return {
+            "sigs_per_sec": round(rounds * n / total_s, 1),
+            "p99_ms": round(p99, 3),
+            "decisions": ledger.snapshot(),
+            "scheduler": sched.queue_snapshot(),
+        }
+
+    thr = run("threshold")
+    pri = run("priced")
+    dsnap, qsnap = pri["decisions"], pri["scheduler"]
+    problems = route_audit.assert_live(dsnap, qsnap)
+    assert not problems, f"route_audit --assert-live: {problems}"
+    priced_recs = [
+        r for r in dsnap["recent"] if r.get("router") == "priced"
+    ]
+    # worst fractional taken-vs-argmin divergence over priced records
+    # (0.0 = every priced flush took its argmin exactly)
+    divergence = 0.0
+    for r in priced_recs:
+        preds = r.get("predicted_ms") or {}
+        feas = r.get("feasible") or {}
+        pt = preds.get(r.get("taken"))
+        cands = [
+            v for c, v in preds.items()
+            if isinstance(v, (int, float)) and feas.get(c, False)
+        ]
+        if isinstance(pt, (int, float)) and cands and min(cands) > 0:
+            divergence = max(divergence, pt / min(cands) - 1.0)
+    out = {
+        "threshold_sigs_per_sec": thr["sigs_per_sec"],
+        "priced_sigs_per_sec": pri["sigs_per_sec"],
+        "priced_vs_threshold": round(
+            pri["sigs_per_sec"] / max(thr["sigs_per_sec"], 1e-9), 3
+        ),
+        "threshold_p99_ms": thr["p99_ms"],
+        "priced_p99_ms": pri["p99_ms"],
+        "priced_flushes": len(priced_recs),
+        "routing_regret_ms": dsnap["windowed"]["regret_ms"],
+        "routing_regret_rate": dsnap["windowed"]["regret_rate"],
+        "routing_route_divergence": round(divergence, 4),
+        "router_live": qsnap["router"]["live"],
+        "router_rollbacks": qsnap["router"]["rollbacks"],
+        "live_ok": not problems,
     }
     print(json.dumps(out), flush=True)
 
@@ -1415,6 +1536,14 @@ def main():
     if parsed is not None:
         _append_history(parsed, stage="decisions")
 
+    # live-router head-to-head: threshold vs priced argmin through the
+    # same workload (throughput, p99, regret, taken-vs-argmin
+    # divergence) — platform-neutral (CPU-inner faulty backend)
+    parsed, diag = _run_stage("routing", _STAGE_ENV_CPU, 300)
+    stages["routing"] = parsed if parsed is not None else diag
+    if parsed is not None:
+        _append_history(parsed, stage="routing")
+
     # tracing overhead budget (<3% on the scheduler stage) + per-stage
     # dispatch breakdown — platform-neutral, so it always runs
     parsed, diag = _run_stage("trace", _STAGE_ENV_CPU, 300)
@@ -1507,6 +1636,7 @@ if __name__ == "__main__":
             "overload": _stage_overload,
             "sharded": _stage_sharded,
             "decisions": _stage_decisions,
+            "routing": _stage_routing,
             "trace": _stage_trace,
             "coldboot": _stage_coldboot,
         }[sys.argv[2]]()

@@ -352,6 +352,14 @@ class CryptoConfig:
     # falling back to 4096. CBFT_SHARD_MIN_BATCH env wins;
     # CBFT_MESH_ROUTE=single|sharded overrides the decision entirely.
     shard_min_batch: int = 0
+    # Live router for the verification scheduler (crypto/scheduler.py):
+    # "priced" (default) takes the cheapest decision-ledger-priced
+    # feasible candidate per coalesced flush (falling back to the
+    # threshold ladder while cold, and rolling back hysteretically when
+    # the anomaly watchdog says the cost model is stale); "threshold"
+    # keeps the legacy comparison pile as the only router. CBFT_ROUTER
+    # env wins; CBFT_MESH_ROUTE pins beat either router.
+    router: str = "priced"
     # AOT warm-boot phase (crypto/tpu/aot.py): pre-lower and compile the
     # pow2 shape-bucket ladder before traffic arrives so no dispatch
     # ever pays trace+compile. "background" (default) warms on a thread
@@ -449,6 +457,12 @@ class Config:
             raise ValueError(
                 "crypto.qos_tenant_rate must be a non-negative integer, "
                 f"got {qtr!r}"
+            )
+        rt = self.crypto.router
+        if rt not in ("priced", "threshold"):
+            raise ValueError(
+                "crypto.router must be one of ['priced', 'threshold'], "
+                f"got {rt!r}"
             )
         wb = self.crypto.warm_boot
         if wb not in ("eager", "background", "off"):

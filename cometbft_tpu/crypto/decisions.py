@@ -199,8 +199,9 @@ class RouteDecision:
 
     __slots__ = (
         "seq", "t_open", "n", "bucket", "reason", "capacity",
-        "breakers", "keystore", "qos", "predicted", "taken", "final",
-        "events", "wall_ms", "error_ms", "regret_ms",
+        "breakers", "keystore", "qos", "predicted", "feasible",
+        "router", "taken", "final", "events", "wall_ms", "error_ms",
+        "regret_ms",
     )
 
     def __init__(
@@ -213,6 +214,7 @@ class RouteDecision:
         keystore: Optional[Dict[str, Any]],
         qos: Optional[Dict[str, Any]],
         predicted: Dict[str, Optional[float]],
+        feasible: Optional[Dict[str, bool]] = None,
     ):
         self.seq = seq
         self.t_open = time.time()
@@ -224,6 +226,15 @@ class RouteDecision:
         self.keystore = keystore
         self.qos = qos
         self.predicted = predicted
+        # per-candidate feasibility at decision time (None = unknown,
+        # treat every candidate as takeable — the pre-live-router
+        # shape). A candidate infeasible when the decision was made
+        # (breaker BROKEN, non-resident keys, mesh below two devices)
+        # must never count as a "road not taken" in regret.
+        self.feasible = feasible
+        # which router produced the taken route: "priced" | "threshold"
+        # | "rolled-back" | "pinned" (None = pre-router record)
+        self.router: Optional[str] = None
         self.taken: Optional[str] = None
         self.final: Optional[str] = None
         self.events: List[str] = []
@@ -251,6 +262,10 @@ class RouteDecision:
             "keystore": self.keystore,
             "qos": self.qos,
             "predicted_ms": dict(self.predicted),
+            "feasible": (
+                dict(self.feasible) if self.feasible is not None else None
+            ),
+            "router": self.router,
             "taken": self.taken,
             "final": self.final or self.taken,
             "diverted": self.diverted,
@@ -289,6 +304,7 @@ class DecisionLedger:
         metrics: Optional[Metrics] = None,
         on_anomaly: Optional[Callable[[str, float], None]] = None,
         clock: Callable[[], float] = time.monotonic,
+        seed: Optional[Callable[[str, int], Optional[float]]] = None,
     ):
         self.window = max(1, int(window))
         self.mape_trip = float(mape_trip)
@@ -297,6 +313,10 @@ class DecisionLedger:
         self.metrics = metrics if metrics is not None else Metrics.nop()
         self.on_anomaly = on_anomaly
         self._cost_profile = cost_profile
+        # third prediction rung: a (route, bucket) -> ms callable (the
+        # calibration-sweep seed, calibration_seed_ms) consulted only
+        # when both the self EWMA and the wire profile are cold
+        self._seed = seed
         self._clock = clock
         self._lock = threading.Lock()
         self._seq = 0
@@ -320,7 +340,8 @@ class DecisionLedger:
     def predict_ms(self, route: str, bucket: int) -> Optional[float]:
         """Predicted wall ms for ``bucket`` lanes on ``route`` — the
         ledger's own measured-wall EWMA once warm (≥ MIN_SELF_OBS),
-        then the wire CostProfile, then None. Never raises."""
+        then the wire CostProfile, then the calibration seed, then
+        None. Never raises."""
         bucket = _pow2(bucket)
         with self._lock:
             st = self._stats.get((route, bucket))
@@ -329,8 +350,15 @@ class DecisionLedger:
         cp = self._cost_profile
         if cp is not None:
             try:
-                return cp.predict_ms(route, bucket)
+                pred = cp.predict_ms(route, bucket)
             except Exception:  # noqa: BLE001 - predictions are advisory
+                pred = None
+            if pred is not None:
+                return pred
+        if self._seed is not None:
+            try:
+                return self._seed(route, bucket)
+            except Exception:  # noqa: BLE001 - seeding is advisory
                 return None
         return None
 
@@ -354,6 +382,7 @@ class DecisionLedger:
         breakers: Optional[Dict[str, str]] = None,
         keystore: Optional[Dict[str, Any]] = None,
         qos: Optional[Dict[str, Any]] = None,
+        feasible: Optional[Dict[str, bool]] = None,
     ) -> RouteDecision:
         with self._lock:
             self._seq += 1
@@ -363,6 +392,7 @@ class DecisionLedger:
             seq=seq, n=n, reason=reason, capacity=capacity,
             breakers=breakers, keystore=keystore, qos=qos,
             predicted=self._candidates(bucket),
+            feasible=feasible,
         )
 
     def finish(self, dec: RouteDecision, wall_s: float) -> None:
@@ -378,7 +408,16 @@ class DecisionLedger:
         if dec.final is None:
             dec.final = taken
         pred_taken = dec.predicted.get(taken)
-        priced = [v for v in dec.predicted.values() if v is not None]
+        # counterfactual regret is computed over candidates that were
+        # FEASIBLE at decision time (feasible=None = the pre-router
+        # shape, every priced candidate counts): a route that could
+        # never have been taken (breaker BROKEN, non-resident keys)
+        # must not inflate the regret rate
+        feas = dec.feasible
+        priced = [
+            v for c, v in dec.predicted.items()
+            if v is not None and (feas is None or feas.get(c, True))
+        ]
         if pred_taken is not None and priced:
             dec.regret_ms = max(0.0, pred_taken - min(priced))
         ape = None
@@ -450,6 +489,12 @@ class DecisionLedger:
             dec.final = final
 
     # --- windowed quality ----------------------------------------------------
+
+    def windowed(self) -> Dict[str, Optional[float]]:
+        """Public windowed-quality snapshot (mape / regret_ms /
+        regret_rate / observations) — the live router's rollback guard
+        polls this per flush."""
+        return self._windowed()
 
     def _windowed(self) -> Dict[str, Optional[float]]:
         # caller holds no lock; reads are over deque snapshots
@@ -660,6 +705,16 @@ def note_taken(route: str) -> None:
         dec.taken = route
 
 
+def note_router(router: str) -> None:
+    """Tag the current decision with the router that produced it
+    ("priced" | "threshold" | "rolled-back" | "pinned"); no-op without
+    a decision. route_audit --assert-live judges only "priced"-tagged
+    records against the argmin."""
+    dec = current()
+    if dec is not None:
+        dec.router = router
+
+
 def note_event(event: str, final: Optional[str] = None) -> None:
     """Attribute a supervisor-side event to the current decision
     (no-op without one)."""
@@ -668,6 +723,21 @@ def note_event(event: str, final: Optional[str] = None) -> None:
         dec.events.append(event)
         if final is not None:
             dec.final = final
+
+
+def calibration_seed_ms(route: str, bucket: int) -> Optional[float]:
+    """The third prediction rung: per-route cost seeded from the
+    persisted calibration sweep (crypto/tpu/calibrate.py measured
+    device_ms / cpu_ms / sharded_ms points, nearest size scaled).
+    Best-effort — any missing table / degraded TPU package answers
+    None. Pass as ``DecisionLedger(seed=...)``; never imported eagerly
+    so CPU-only processes stay TPU-free until a table exists."""
+    try:
+        from cometbft_tpu.crypto.tpu import calibrate
+
+        return calibrate.route_cost_seed_ms(route, bucket)
+    except Exception:  # noqa: BLE001 - seeding is advisory
+        return None
 
 
 # --- process default ---------------------------------------------------------

@@ -1676,10 +1676,18 @@ def run_chaos_stale_model(
       watchdog is re-armed (it may already have re-armed late in the
       jitter phase once the EWMA caught up — adaptation, not amnesia).
 
+    The scheduler runs with the PRICED live router (ISSUE 16), its cpu
+    rung seeded expensive so the argmin engages once the single-chip
+    self-EWMA warms: the jitter trip must also ROLL THE ROUTER BACK to
+    the threshold ladder (hysteretic guard), and the recovery regime
+    must RE-ADMIT it after clean windows — the stale-model proof that a
+    lying cost model cannot keep steering live routing.
+
     Asserts: every verdict correct in all three regimes; zero trips
     during clean; exactly one trip + one anomaly fire + one dump file
     for the whole run; the watchdog is re-armed (not tripped) at the
-    end. Returns a summary dict for tools/chaos.py and the tier-1 test.
+    end; exactly one priced-router rollback, re-admitted by the end.
+    Returns a summary dict for tools/chaos.py and the tier-1 test.
     """
     import glob
     import tempfile
@@ -1709,9 +1717,15 @@ def run_chaos_stale_model(
         window=16,
         ring_interval_s=0.0,  # watchdog evaluates on every finish
         on_anomaly=on_anomaly,
+        # price the host rung expensive: cpu is never walked on this
+        # run (so no self-EWMA) and there is no wire profile — without
+        # a seed the priced argmin would stay cold and the rollback
+        # guard would have nothing to protect
+        seed=lambda route, bucket: 1e6 if route == "cpu" else None,
     )
     sched = VerifyScheduler(
-        spec=BackendSpec(name), flush_us=200, logger=logger
+        spec=BackendSpec(name), flush_us=200, logger=logger,
+        router="priced",
     )
     sched.start()
 
@@ -1754,7 +1768,12 @@ def run_chaos_stale_model(
         declib.set_default_ledger(prev)
 
     wd = ledger.watchdog_state()
-    win = ledger.snapshot()["windowed"]
+    snap = ledger.snapshot()
+    win = snap["windowed"]
+    router = sched.queue_snapshot()["router"]
+    priced_records = sum(
+        1 for r in snap["recent"] if r.get("router") == "priced"
+    )
     dumps = sorted(glob.glob(os.path.join(dump_dir, "trace_dump_*.json")))
 
     if wrong:
@@ -1788,6 +1807,22 @@ def run_chaos_stale_model(
             f"{recover_flushes} clean flushes (still tripped: "
             f"{wd['tripped']})"
         )
+    if not priced_records:
+        raise AssertionError(
+            "stale-model chaos rung: the priced router never engaged "
+            "(no priced-tagged decision records in the recent ring)"
+        )
+    if router["rollbacks"] != 1:
+        raise AssertionError(
+            "stale-model chaos rung: expected exactly one priced-router "
+            f"rollback from the jitter trip, got {router['rollbacks']}"
+        )
+    if router["rolled_back"] or router["readmits"] != 1:
+        raise AssertionError(
+            "stale-model chaos rung: priced router was not re-admitted "
+            f"after recovery (rolled_back={router['rolled_back']}, "
+            f"readmits={router['readmits']})"
+        )
 
     summary = {
         "batch": batch,
@@ -1804,12 +1839,21 @@ def run_chaos_stale_model(
         "rearmed": wd["tripped"] is None,
         "final_mape": win["mape"],
         "wrong_verdicts": wrong,
+        "router_mode": router["mode"],
+        "router_live": router["live"],
+        "router_rollbacks": router["rollbacks"],
+        "router_readmits": router["readmits"],
+        "router_rollback_cause": router["rollback_cause"],
+        "router_priced_records": priced_records,
         "expected": {
             "wrong_verdicts": 0,
             "trips": 1,
             "anomaly_fires": 1,
             "incident_dumps": 1,
             "rearmed": True,
+            "router_rollbacks": 1,
+            "router_readmits": 1,
+            "router_live": "priced",
         },
         "ok": True,
     }

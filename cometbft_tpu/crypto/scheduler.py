@@ -112,6 +112,17 @@ DEFAULT_SUBMIT_TIMEOUT_MS = 5_000
 DEFAULT_SHARD_MIN_BATCH = 4096
 SUBSYSTEM = "verify_scheduler"
 
+# live router modes ([crypto] router / CBFT_ROUTER): "priced" takes the
+# cheapest decision-ledger-priced feasible candidate per flush,
+# "threshold" keeps the legacy comparison ladder (size crossover +
+# shard_min_batch + pins) as the only router
+ROUTER_PRICED = "priced"
+ROUTER_THRESHOLD = "threshold"
+ROUTERS = (ROUTER_THRESHOLD, ROUTER_PRICED)
+# consecutive clean guard checks before a rolled-back priced router is
+# re-admitted — the qos brownout re-admission shape applied to routing
+ROUTER_REARM_CLEAN = 3
+
 # the single lane the scheduler degrades to when QoS is off
 _FIFO = "fifo"
 _FLUSH_REASONS = ("size", "deadline", "explicit", "drain", "broken")
@@ -151,6 +162,26 @@ def submit_timeout_default(config_timeout_ms: Optional[int] = None) -> int:
     if config_timeout_ms is not None:
         return int(config_timeout_ms)
     return DEFAULT_SUBMIT_TIMEOUT_MS
+
+
+def router_default(config_value: Optional[str] = None) -> str:
+    """Resolve the live-router mode: CBFT_ROUTER env > [crypto] router
+    > "priced" (the priced argmin is the steady-state router; it falls
+    back to thresholds on its own when cold or rolled back, so the
+    default is safe even without a decision ledger). An unrecognized
+    value degrades to "threshold" — never raises on the flush path."""
+    raw = os.environ.get("CBFT_ROUTER")
+    if raw is not None:
+        raw = raw.strip().lower()
+        if raw in ROUTERS:
+            return raw
+        return ROUTER_THRESHOLD
+    if config_value:
+        value = str(config_value).strip().lower()
+        if value in ROUTERS:
+            return value
+        return ROUTER_THRESHOLD
+    return ROUTER_PRICED
 
 
 def shard_min_batch_default(config_value: Optional[int] = None) -> int:
@@ -368,6 +399,7 @@ class VerifyScheduler(BaseService):
         qos_metrics: Optional[qoslib.QoSMetrics] = None,
         tenant_rate: Optional[int] = None,
         submit_timeout_ms: Optional[int] = None,
+        router: Optional[str] = None,
     ):
         super().__init__("VerifyScheduler", logger)
         if isinstance(spec, BackendSpec):
@@ -458,7 +490,26 @@ class VerifyScheduler(BaseService):
         # flush, and per-route dispatch counts feed /debug + verify_top
         self._shard_min_batch_cfg = shard_min_batch
         self._shard_min_batch_resolved: Optional[int] = None
-        self._routes = {"cpu": 0, "single": 0, "sharded": 0}
+        self._routes = {"cpu": 0, "single": 0, "sharded": 0, "indexed": 0}
+
+        # -- live priced router (CBFT_ROUTER / [crypto] router) ------------
+        # "priced": per-flush argmin over decision-ledger-priced feasible
+        # candidates, with a hysteretic rollback to the threshold ladder
+        # while the anomaly watchdog says the cost model is stale.
+        self._router_mode = router_default(router)
+        self._router_rolled_back = False
+        self._router_clean = 0          # clean flushes toward re-admission
+        self._router_rollbacks = 0
+        self._router_readmits = 0
+        self._router_rollback_cause: Optional[str] = None
+        # which router produced the LAST flush's route (verify_top line)
+        self._router_last: Optional[str] = None
+        # CBFT_MESH_ROUTE parse-once cache: (raw env value, verdict) —
+        # a malformed pin logs exactly one warning per distinct value
+        # instead of re-parsing and re-logging on every flush
+        self._pin_cache: Optional[
+            Tuple[Optional[str], Optional[str]]
+        ] = None
 
     # -- knob introspection --------------------------------------------------
 
@@ -492,6 +543,19 @@ class VerifyScheduler(BaseService):
             )
         return self._shard_min_batch_resolved
 
+    @property
+    def router_mode(self) -> str:
+        return self._router_mode
+
+    def _router_live(self) -> str:
+        """The router that would serve the next unpinned flush:
+        "priced" | "threshold" | "rolled-back" (verify_top's label)."""
+        if self._router_mode != ROUTER_PRICED:
+            return ROUTER_THRESHOLD
+        if self._router_rolled_back:
+            return "rolled-back"
+        return ROUTER_PRICED
+
     def queue_snapshot(self) -> dict:
         """Point-in-time queue state for the health/capacity plane
         (/debug/verify): what is waiting, what budget the next
@@ -507,6 +571,16 @@ class VerifyScheduler(BaseService):
                 "dispatches": self.n_dispatches,
                 "routes": dict(self._routes),
                 "flush_reasons": dict(self._flush_reasons),
+                "router": {
+                    "mode": self._router_mode,
+                    "live": self._router_live(),
+                    "rolled_back": self._router_rolled_back,
+                    "rollbacks": self._router_rollbacks,
+                    "readmits": self._router_readmits,
+                    "rollback_cause": self._router_rollback_cause,
+                    "clean_streak": self._router_clean,
+                    "last": self._router_last,
+                },
             }
             # device key-store state rides along (resident valsets,
             # generation, indexed-dispatch stats) — best-effort: the
@@ -1101,13 +1175,15 @@ class VerifyScheduler(BaseService):
         declgr = declib.default_ledger()
         dec = None
         if declgr is not None:
+            breakers = self._decision_breakers()
             dec = declgr.open(
                 n=len(items),
                 reason=reason,
                 capacity=self._decision_capacity(),
-                breakers=self._decision_breakers(),
+                breakers=breakers,
                 keystore=self._decision_keystore(),
                 qos={name: c[1] for name, c in by_class.items()} or None,
+                feasible=self._decision_feasible(items, breakers),
             )
         t_verify = time.perf_counter()
         try:
@@ -1183,8 +1259,35 @@ class VerifyScheduler(BaseService):
         except Exception:  # noqa: BLE001 - inputs are advisory
             return None
 
+    def _pin_route(self) -> Optional[str]:
+        """CBFT_MESH_ROUTE operator pin, parsed ONCE per distinct raw
+        value and cached. A malformed pin logs exactly one warning and
+        then routes on size/price like no pin at all — the old shape
+        re-parsed (and re-logged) on every flush. The cache keys on the
+        raw value, so flipping the env var mid-run still takes effect
+        on the next flush."""
+        raw = os.environ.get("CBFT_MESH_ROUTE")
+        cached = self._pin_cache
+        if cached is not None and cached[0] == raw:
+            return cached[1]
+        verdict: Optional[str] = None
+        try:
+            from cometbft_tpu.crypto.tpu import mesh
+        except Exception:  # noqa: BLE001 - no TPU package, no pinning
+            self._pin_cache = (raw, None)
+            return None
+        try:
+            verdict = mesh.parse_route(raw)
+        except ValueError:
+            self.logger.error(
+                "malformed CBFT_MESH_ROUTE; routing on size", value=raw,
+            )
+        self._pin_cache = (raw, verdict)
+        return verdict
+
     def _route_for(self, n: int) -> Optional[str]:
-        """Per-flush routing decision over the three-way ladder. The CPU
+        """Threshold routing ladder — the pre-priced shape, and what the
+        priced router falls back to when cold or rolled back. The CPU
         rung stays where it always was (a cpu spec / the calibrated
         per-curve floor inside the backend); this decides single-chip vs
         sharded mesh for a device-bound flush: CBFT_MESH_ROUTE operator
@@ -1192,21 +1295,12 @@ class VerifyScheduler(BaseService):
         flush clears shard_min_batch > None (legacy single-chip auto)."""
         if self.spec.name == "cpu":
             return None
-        try:
-            from cometbft_tpu.crypto.tpu import mesh
-        except Exception:  # noqa: BLE001 - no TPU package, no routing
-            return None
-        try:
-            override = mesh.route_override()
-        except Exception:  # noqa: BLE001 - malformed CBFT_MESH_ROUTE
-            self.logger.error(
-                "malformed CBFT_MESH_ROUTE; routing on size",
-                value=os.environ.get("CBFT_MESH_ROUTE"),
-            )
-            override = None
+        override = self._pin_route()
         if override is not None:
             return override
         try:
+            from cometbft_tpu.crypto.tpu import mesh
+
             topo = getattr(self._supervisor, "topology", None)
             if n >= self.shard_min_batch and mesh.sharded_available(topo):
                 return mesh.ROUTE_SHARDED
@@ -1214,14 +1308,176 @@ class VerifyScheduler(BaseService):
             pass
         return None
 
-    def _note_route(self, route: Optional[str]) -> None:
+    def _decision_feasible(
+        self,
+        items: List[Item],
+        breakers: Optional[Dict[str, str]],
+    ) -> Dict[str, bool]:
+        """Per-candidate feasibility at decision time — the one filter
+        BOTH the priced argmin and the ledger's regret math apply, so a
+        candidate that could never have been taken (breaker BROKEN,
+        non-resident keys, mesh below two devices) can neither be chosen
+        nor counted as a cheaper road not taken.
+
+        * cpu — always feasible (the ground truth never goes away); a
+          cpu backend spec makes it the ONLY feasible rung.
+        * single — feasible unless every supervised breaker is BROKEN
+          (the supervisor would cpu-route the dispatch anyway).
+        * sharded — single's gate AND a supervised healthy ≥2-device
+          mesh.
+        * indexed — single's gate AND a supervised single-device mesh
+          AND every pubkey of the flush resident in one fresh keystore
+          entry (keystore.covers; sys.modules-guarded so CPU-only nodes
+          never import the TPU package here).
+        * device_hash — never a verify-flush candidate (it serves the
+          hash plane); priced for observability, filtered here.
+        """
+        feasible = {
+            "cpu": True, "single": False, "sharded": False,
+            "indexed": False, "device_hash": False,
+        }
         if self.spec.name == "cpu":
-            label = "cpu"
-        elif route == "sharded":
-            label = "sharded"
+            return feasible
+        all_broken = bool(breakers) and all(
+            s == "broken" for s in breakers.values()
+        )
+        feasible["single"] = not all_broken
+        if all_broken:
+            return feasible
+        n_dev = 0
+        if self._supervisor is not None:
+            try:
+                from cometbft_tpu.crypto.tpu import mesh
+
+                topo = getattr(self._supervisor, "topology", None)
+                feasible["sharded"] = bool(mesh.sharded_available(topo))
+                n_dev = mesh.n_devices()
+            except Exception:  # noqa: BLE001 - feasibility is advisory
+                n_dev = 0
+        kslib = sys.modules.get("cometbft_tpu.crypto.tpu.keystore")
+        if kslib is not None and n_dev == 1:
+            try:
+                feasible["indexed"] = bool(
+                    kslib.covers([pk for pk, _, _ in items])
+                )
+            except Exception:  # noqa: BLE001 - feasibility is advisory
+                pass
+        return feasible
+
+    def _router_guard(self, declgr) -> bool:
+        """Hysteretic rollback guard for the priced router — the qos
+        brownout shape applied to routing. Roll back to the threshold
+        ladder the moment the decision plane's anomaly watchdog trips
+        (stale world-model) or the windowed regret-event rate crosses
+        the ledger's trip level; re-admit the priced router only after
+        ROUTER_REARM_CLEAN consecutive clean flushes below HALF the
+        trip level. Returns True when priced routing may serve this
+        flush."""
+        wd = declgr.watchdog_state()
+        win = declgr.windowed()
+        tripped = wd.get("tripped")
+        rate = win.get("regret_rate") or 0.0
+        obs = win.get("observations") or 0
+        hot = tripped is not None or (
+            obs >= declib.MIN_TRIP_OBS and rate > declgr.regret_trip
+        )
+        if not self._router_rolled_back:
+            if hot:
+                self._router_rolled_back = True
+                self._router_clean = 0
+                self._router_rollbacks += 1
+                self._router_rollback_cause = tripped or "regret"
+                self.logger.error(
+                    "priced router rolled back to thresholds",
+                    cause=self._router_rollback_cause,
+                    regret_rate=round(rate, 4),
+                )
+                return False
+            return True
+        clean = tripped is None and rate <= declgr.regret_trip / 2.0
+        if clean:
+            self._router_clean += 1
+            if self._router_clean >= ROUTER_REARM_CLEAN:
+                self._router_rolled_back = False
+                self._router_clean = 0
+                self._router_readmits += 1
+                self._router_rollback_cause = None
+                self.logger.info(
+                    "priced router re-admitted after clean windows"
+                )
+                return True
         else:
-            label = "single"
-        self._routes[label] += 1
+            self._router_clean = 0
+        return False
+
+    def _priced_argmin(
+        self, dec
+    ) -> Optional[Tuple[str, Optional[str]]]:
+        """The cheapest feasible candidate from the open decision's
+        priced menu, as (counted label, supervisor route) — or None when
+        the model is too cold to judge: ANY feasible primary rung
+        (cpu/single/sharded) still unpriced means an argmin over the
+        partial menu would systematically dodge the routes it cannot
+        see, so cold flushes stay on thresholds and keep feeding the
+        prediction ladder observations."""
+        feas = dec.feasible or {}
+        best: Optional[Tuple[str, float]] = None
+        for cand, pred in dec.predicted.items():
+            if not feas.get(cand, False):
+                continue
+            if pred is None:
+                if cand in declib.ROUTES:
+                    return None  # cold primary: no argmin this flush
+                continue  # unpriced sub-route: just not a candidate
+            if best is None or pred < best[1]:
+                best = (cand, pred)
+        if best is None:
+            return None
+        label = best[0]
+        if label == "cpu":
+            # argmin says host: dispatched straight on the ground truth
+            return "cpu", None
+        if label == "single":
+            # priced single keeps the legacy per-domain partition (the
+            # supervisor's None route) — "single" as a supervisor route
+            # means PINNED to one chip, which is the pin's business
+            return "single", None
+        return label, label  # "sharded" / "indexed"
+
+    def _route(self, n: int, items: List[Item]) -> Tuple[
+        str, Optional[str], str
+    ]:
+        """Live routing decision for one coalesced flush:
+        (counted label, supervisor route, router tag). Precedence:
+        CBFT_MESH_ROUTE pin > priced argmin over feasible candidates
+        (router mode "priced", rollback guard cold, every feasible
+        primary priced) > the threshold ladder."""
+        if self.spec.name == "cpu":
+            return "cpu", None, ROUTER_THRESHOLD
+        pin = self._pin_route()
+        if pin is not None:
+            label = "sharded" if pin == "sharded" else "single"
+            return label, pin, "pinned"
+        tag = ROUTER_THRESHOLD
+        if self._router_mode == ROUTER_PRICED:
+            dec = declib.current()
+            declgr = declib.default_ledger()
+            if dec is not None and declgr is not None:
+                if self._router_guard(declgr):
+                    choice = self._priced_argmin(dec)
+                    if choice is not None:
+                        return choice[0], choice[1], ROUTER_PRICED
+                    # cold model: threshold fallback, tagged as such
+                else:
+                    tag = "rolled-back"
+        route = (
+            self._route_for(n) if self._supervisor is not None else None
+        )
+        label = "sharded" if route == "sharded" else "single"
+        return label, route, tag
+
+    def _note_route(self, label: str) -> None:
+        self._routes[label] = self._routes.get(label, 0) + 1
         # the decision record's taken route IS this counter's label, so
         # ledger counts and queue_snapshot routes reconcile to the unit
         declib.note_taken(label)
@@ -1234,21 +1490,25 @@ class VerifyScheduler(BaseService):
         = None,
     ) -> Tuple[List[bool], str]:
         """Returns (verdict mask, wire-route label). The label is the
-        ledger key for demux attribution: "cpu" for host backends,
-        "sharded"/"single" mirroring _note_route's ladder."""
-        if self.spec.name == "cpu":
-            wire_route = "cpu"
-        else:
-            wire_route = "single"
+        ledger key for demux attribution: "cpu" for host dispatches,
+        "sharded"/"indexed"/"single" mirroring _note_route's ladder."""
+        label, route, router = self._route(len(items), items)
+        self._note_route(label)
+        declib.note_router(router)
+        self._router_last = router
+        wire_route = (
+            label if label in ("cpu", "sharded", "indexed") else "single"
+        )
+        if label == "cpu" and self.spec.name != "cpu":
+            # the priced argmin chose the host rung for a device spec
+            # (small flush under the transfer floor): dispatch straight
+            # on the ground truth — no supervisor round-trip to lose
+            return self._cpu_ground_truth(items), "cpu"
         if self._supervisor is not None:
             # supervised path: watchdog, circuit breaker, retry/hedge
             # ladder, and corruption audit live in crypto/supervisor.py —
             # it never raises for a device failure (CPU re-verify is
             # built in); origins let its triage attribute bad signatures
-            route = self._route_for(len(items))
-            self._note_route(route)
-            if route == "sharded":
-                wire_route = "sharded"
             if route is not None:
                 return self._supervisor.verify_items(
                     items, reason=reason, origins=origins, route=route
@@ -1256,7 +1516,6 @@ class VerifyScheduler(BaseService):
             return self._supervisor.verify_items(
                 items, reason=reason, origins=origins
             ), wire_route
-        self._note_route(None)
         try:
             bv = new_batch_verifier(self.spec)
             for pk, m, s in items:

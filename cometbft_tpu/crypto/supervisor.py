@@ -502,6 +502,19 @@ class Metrics:
             "Sharded-routed batches that fell back to the per-domain "
             "partition path because the mesh was or became unavailable.",
         )
+        self.indexed_dispatches = r.counter(
+            SUBSYSTEM, "indexed_dispatches",
+            "Batches dispatched on the keystore's indexed steady-state "
+            "wire (resident pubkey table + int32 index vector, "
+            "100 B/lane; routing mode 'indexed').",
+        )
+        self.indexed_fallbacks = r.counter(
+            SUBSYSTEM, "indexed_fallbacks",
+            "Indexed-routed batches that fell back to the per-domain "
+            "partition path because keystore coverage was lost between "
+            "the routing decision and the dispatch (or the dispatch "
+            "raised).",
+        )
 
     @classmethod
     def nop(cls) -> "Metrics":
@@ -795,6 +808,18 @@ class BackendSupervisor:
                 # decision (the scheduler parked it on this thread)
                 declib.note_event("sharded_fallback", final="single")
                 route = None
+            if route == "indexed":
+                mask = self._verify_indexed(items)
+                if mask is not None:
+                    span.end(outcome="indexed")
+                    return mask
+                # coverage lost (eviction/rotation raced the routing
+                # decision) or the dispatch raised: the keyed partition
+                # path serves the flush — verdicts never depend on the
+                # optimization being available
+                self.metrics.indexed_fallbacks.add()
+                declib.note_event("indexed_fallback", final="single")
+                route = None
             with self._lock:
                 healthy = [d for d in self._domains if d.state != BROKEN]
                 n_domains = len(self._domains)
@@ -847,6 +872,33 @@ class BackendSupervisor:
                 shards.append((dom, start, end))
             start = end
         return shards or [(use[0], 0, n)]
+
+    def _verify_indexed(self, items: List[Item]) -> Optional[List[bool]]:
+        """ONE indexed steady-state dispatch through the device key
+        store (keystore.verify_batch_indexed): ships compact R ‖ S ‖ h
+        rows plus an int32 index vector and gathers resident pubkey
+        rows on-device — 100 B/lane instead of the 128 B keyed wire.
+        Returns None when the store refuses (coverage lost since the
+        routing decision, sharded mesh, degraded TPU package) or the
+        dispatch raises, so verify_items falls through to the fully
+        supervised partition path."""
+        try:
+            from cometbft_tpu.crypto.tpu import keystore
+
+            mask = keystore.verify_batch_indexed(
+                [pk for pk, _, _ in items],
+                [m for _, m, _ in items],
+                [s for _, _, s in items],
+            )
+        except Exception as exc:  # noqa: BLE001 - fall back, never raise
+            self.logger.error(
+                "indexed dispatch failed; partition fallback",
+                err=repr(exc), n=len(items),
+            )
+            return None
+        if mask is not None:
+            self.metrics.indexed_dispatches.add()
+        return mask
 
     def _verify_mesh(
         self,

@@ -297,6 +297,79 @@ def shard_min_batch(topology_fp: Optional[str] = None) -> Optional[int]:
     return None
 
 
+def _nearest_scaled_ms(
+    points: dict, key: str, bucket: int
+) -> Optional[float]:
+    """``key`` ms at the measured size nearest ``bucket`` (log space),
+    scaled linearly by the size ratio — the cold-route seed the priced
+    router consumes before any live observation exists."""
+    best: Optional[Tuple[int, float]] = None
+    for raw_n, row in points.items():
+        try:
+            n = int(raw_n)
+            v = float(row[key])
+        except (TypeError, KeyError, ValueError):
+            continue
+        if n <= 0 or v <= 0.0:
+            continue
+        if best is None or (
+            abs(n.bit_length() - bucket.bit_length())
+            < abs(best[0].bit_length() - bucket.bit_length())
+        ):
+            best = (n, v)
+    if best is None:
+        return None
+    n, v = best
+    return v * (bucket / n)
+
+
+def route_cost_seed_ms(route: str, bucket: int) -> Optional[float]:
+    """Predicted wall ms for ``bucket`` lanes on ``route`` from the
+    persisted calibration sweep — the THIRD rung of the decision
+    ledger's prediction ladder (self EWMA → wire CostProfile → this).
+    Answers from the measured per-size points: ``cpu``/``single`` from
+    the ed25519 sweep, ``sharded`` from the current topology's sharded
+    sweep, ``device_hash`` from the hash-placement sweep. The indexed
+    sub-route has no calibration sweep (it only exists against a live
+    resident key store), so it prices None until observed live."""
+    table = load_table()
+    if not table:
+        return None
+    try:
+        bucket = max(1, int(bucket))
+    except (TypeError, ValueError):
+        return None
+    if route in ("cpu", "single"):
+        points = table.get("ed25519")
+        if not isinstance(points, dict):
+            return None
+        key = "cpu_ms" if route == "cpu" else "device_ms"
+        return _nearest_scaled_ms(points, key, bucket)
+    if route == "device_hash":
+        points = table.get("hash")
+        if not isinstance(points, dict):
+            return None
+        return _nearest_scaled_ms(points, "device_ms", bucket)
+    if route == "sharded":
+        sharded = table.get("sharded")
+        if not isinstance(sharded, dict):
+            return None
+        try:
+            from cometbft_tpu.crypto.tpu import aot
+
+            fp = str(aot.topology_fingerprint())
+        except Exception:  # noqa: BLE001 - no device plane, no seed
+            return None
+        section = sharded.get(fp)
+        if not isinstance(section, dict):
+            return None
+        points = section.get("points")
+        if not isinstance(points, dict):
+            return None
+        return _nearest_scaled_ms(points, "sharded_ms", bucket)
+    return None
+
+
 def save_table(table: dict, path: str) -> None:
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     tmp = path + ".tmp"

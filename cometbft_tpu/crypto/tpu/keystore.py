@@ -63,6 +63,21 @@ def _topo_generation() -> int:
     return topology.default_topology().generation()
 
 
+def _key_bytes(pk) -> bytes:
+    """Normalize one pubkey to its raw 32 bytes. The scheduler's
+    feasibility probe and the supervisor's indexed dispatch hand the
+    flush items' PubKey OBJECTS straight through, while batch.py and
+    the tests pass raw bytes — the store accepts both (``bytes(obj)``
+    on a PubKey raises TypeError, which the callers' advisory
+    try/excepts would silently turn into "never indexed")."""
+    if isinstance(pk, (bytes, bytearray, memoryview)):
+        return bytes(pk)
+    b = getattr(pk, "bytes", None)
+    if callable(b):
+        return b()
+    return bytes(pk)
+
+
 class DeviceKeyStore:
     def __init__(self, max_entries: int = CACHE_MAX):
         self._entries: "OrderedDict[bytes, KeyStoreEntry]" = OrderedDict()
@@ -152,6 +167,22 @@ class DeviceKeyStore:
                 self._stats["invalidations"] += dropped
         return dropped
 
+    def covers(self, pub_keys: Sequence[bytes]) -> bool:
+        """True when ONE fresh resident entry covers every pubkey in
+        ``pub_keys`` — the priced router's indexed-feasibility probe.
+        Pure host-side dict lookups (no device touch); advisory only:
+        verify_batch_indexed re-checks under its own lookup, so a
+        concurrent eviction between this answer and the dispatch just
+        downgrades to the keyed single-chip wire."""
+        if not pub_keys:
+            return False
+        entries = self.lookup_fresh()
+        for e in entries:
+            index = e.index
+            if all(_key_bytes(pk) in index for pk in pub_keys):
+                return True
+        return False
+
     def note_indexed(self, lanes: int) -> None:
         with self._mtx:
             self._stats["indexed_dispatches"] += 1
@@ -199,6 +230,13 @@ def default_store() -> DeviceKeyStore:
     return _default
 
 
+def covers(pub_keys: Sequence[bytes]) -> bool:
+    """Module-level convenience over the default store — the
+    scheduler's decision-feasibility gathering calls this through the
+    sys.modules guard (no import cost for CPU-only nodes)."""
+    return _default.covers(pub_keys)
+
+
 def verify_batch_indexed(
     pub_keys: Sequence[bytes],
     msgs: Sequence[bytes],
@@ -225,28 +263,44 @@ def verify_batch_indexed(
         return None
     entry = None
     for e in entries:
-        if all(bytes(pk) in e.index for pk in pub_keys):
+        if all(_key_bytes(pk) in e.index for pk in pub_keys):
             entry = e
             break
     if entry is None:
         return None
 
+    import time
+
     import jax
     import jax.numpy as jnp
     from collections import deque
 
+    from cometbft_tpu.crypto import wire as wirelib
+
     idx_full = np.fromiter(
-        (entry.index[bytes(pk)] for pk in pub_keys),
+        (entry.index[_key_bytes(pk)] for pk in pub_keys),
         dtype=np.int32, count=n,
     )
     max_chunk = mesh_mod.chunk_cap(ed._MAX_CHUNK, ed._MIN_PAD)
     depth = mesh_mod.pipeline_depth()
     out = np.zeros(n, bool)
     inflight: "deque" = deque()
+    # per-chunk phase attribution into the wire ledger under the
+    # "indexed" route key — this is what lets the decision plane PRICE
+    # the 100 B/lane path (and the bytes_per_lane gauge prove it)
+    ledger = wirelib.default_ledger()
 
     def retire(slot):
-        start, end, mask, valid = slot
+        start, end, mask, valid, winfo = slot
+        t_d2h = time.perf_counter()
         out[start:end] = np.asarray(mask)[: end - start] & valid
+        if ledger is not None and winfo is not None:
+            size, wire_bytes, pack_s, h2d_s, compute_s = winfo
+            ledger.note_chunk(
+                "indexed", "dev0", size, end - start, wire_bytes,
+                pack_s, h2d_s, compute_s,
+                time.perf_counter() - t_d2h,
+            )
 
     # same double-buffered shape as the resident commit loop: pack +
     # async H2D of chunk i+1 overlaps the device's work on chunk i.
@@ -254,9 +308,10 @@ def verify_batch_indexed(
     # table must survive across flushes.
     for start in range(0, n, max_chunk):
         end = min(start + max_chunk, n)
+        t_pack = time.perf_counter()
         rsh, valid = ed._prepare_rsh_compact(
             np.stack([
-                np.frombuffer(bytes(pk), np.uint8) for pk in
+                np.frombuffer(_key_bytes(pk), np.uint8) for pk in
                 pub_keys[start:end]
             ]),
             msgs[start:end], sigs[start:end],
@@ -268,14 +323,24 @@ def verify_batch_indexed(
         rsh_pad[:, : end - start] = rsh
         idx_pad = np.zeros(size, np.int32)
         idx_pad[: end - start] = idx_full[start:end]
+        t_h2d = time.perf_counter()
         idx_dev = jax.device_put(jnp.asarray(idx_pad))
         rsh_dev = jax.device_put(jnp.asarray(rsh_pad))
+        t_compute = time.perf_counter()
         mask = mesh_mod.run_single(
             ed.verify_kernel_indexed,
             [entry.table_dev, idx_dev, rsh_dev],
             donate_from=1,
         )
-        inflight.append((start, end, mask, valid))
+        t_done = time.perf_counter()
+        winfo = (
+            size,
+            rsh_pad.nbytes + idx_pad.nbytes,  # 100 B per padded lane
+            t_h2d - t_pack,
+            t_compute - t_h2d,
+            t_done - t_compute,
+        )
+        inflight.append((start, end, mask, valid, winfo))
         while len(inflight) > depth:
             retire(inflight.popleft())
     while inflight:

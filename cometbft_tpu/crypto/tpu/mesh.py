@@ -114,11 +114,11 @@ class route_scope:
         return False
 
 
-def route_override() -> Optional[str]:
-    """Operator A/B override of the scheduler's routing decision:
-    CBFT_MESH_ROUTE=auto|single|sharded (auto/unset = learned
-    crossover)."""
-    raw = os.environ.get("CBFT_MESH_ROUTE")
+def parse_route(raw: Optional[str]) -> Optional[str]:
+    """Parse one CBFT_MESH_ROUTE value: ROUTE_SINGLE / ROUTE_SHARDED
+    for a pin, None for auto/unset (size routing), ValueError on
+    anything else. Pure — the scheduler's parse-once pin cache and
+    route_override share it."""
     if raw is None:
         return None
     raw = raw.strip().lower()
@@ -129,6 +129,13 @@ def route_override() -> Optional[str]:
     raise ValueError(
         f"CBFT_MESH_ROUTE={raw!r} must be auto, single, or sharded"
     )
+
+
+def route_override() -> Optional[str]:
+    """Operator A/B override of the scheduler's routing decision:
+    CBFT_MESH_ROUTE=auto|single|sharded (auto/unset = learned
+    crossover)."""
+    return parse_route(os.environ.get("CBFT_MESH_ROUTE"))
 
 
 def maybe_init_distributed() -> bool:

@@ -71,6 +71,16 @@ _MAX_DISPATCHES = 128   # recent dispatch records kept for reconciliation
 # B/lane) and the device-hash route (96 B + message block) diverge from
 # it, which the live bytes_per_lane gauge then reflects.
 DEFAULT_BYTES_PER_LANE = 128.0
+# Per-route cold-boot bytes/lane where the wire format is known to
+# diverge from the compact baseline: the indexed key-store route ships
+# 96 B compact R ‖ S ‖ h plus a 4 B int32 table index, the device-hash
+# route ships the 96 B rows without the precomputed digest. Used by
+# the cold link-probe seed so a never-observed indexed candidate is
+# priced with its real (smaller) transfer leg.
+ROUTE_BYTES_PER_LANE = {
+    "indexed": 100.0,
+    "device_hash": 96.0,
+}
 
 
 def wire_ledger_default(config_value: bool = True) -> bool:
@@ -459,8 +469,9 @@ class WireLedger:
                 mbps = 0.0
             fixed = self._link_fixed_ms_from(link)
             if mbps > 0.0 or fixed > 0.0:
+                bpl = ROUTE_BYTES_PER_LANE.get(route, DEFAULT_BYTES_PER_LANE)
                 xfer = (
-                    bucket * DEFAULT_BYTES_PER_LANE / (mbps * 1e6) * 1e3
+                    bucket * bpl / (mbps * 1e6) * 1e3
                     if mbps > 0.0 else 0.0
                 )
                 return fixed + xfer
@@ -488,6 +499,22 @@ class WireLedger:
                 if k[0] == route and k[1] == bucket
                 and (device is None or k[2] == device)
             )
+
+    def bytes_per_lane(self, route: str) -> Optional[float]:
+        """Steady-state wire bytes per real signature lane for
+        ``route`` — the EWMA over every attributed chunk, weighted
+        toward the best-observed profile. None until the route has
+        been observed. The bench routing stage and the indexed-route
+        acceptance check (≤ 100 B/lane) read this."""
+        with self._lock:
+            cands = [
+                p for k, p in self._profiles.items()
+                if k[0] == route and p.n > 0 and p.lanes_ewma > 0.0
+            ]
+            if not cands:
+                return None
+            p = max(cands, key=lambda p: p.n)
+            return p.bytes_ewma / p.lanes_ewma
 
     def cost_profile(self) -> "CostProfile":
         return CostProfile(self)

@@ -407,6 +407,11 @@ class Node(BaseService):
                 ),
                 metrics=decision_metrics,
                 on_anomaly=_on_route_anomaly,
+                # third prediction rung: the persisted calibration sweep
+                # prices routes the wire ledger never observes live
+                # (notably cpu on a device node), which is what lets the
+                # priced router engage before any route has been walked
+                seed=declib.calibration_seed_ms,
             )
             declib.set_default_ledger(self.decision_ledger)
             self.telemetry_hub.register_source(
@@ -465,6 +470,7 @@ class Node(BaseService):
             qos=config.crypto.qos_classes,
             qos_metrics=qos_metrics,
             tenant_rate=config.crypto.qos_tenant_rate,
+            router=config.crypto.router,
         )
         self.telemetry_hub.register_source(
             "scheduler", self.verify_scheduler.queue_snapshot

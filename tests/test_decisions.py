@@ -479,3 +479,9 @@ class TestChaosStaleModelRung:
         assert summary["incident_dumps"] == 1
         assert summary["rearmed"] is True
         assert summary["trip_cause"] in ("mape", "regret")
+        # ISSUE 16: the trip must also roll the PRICED live router back
+        # to thresholds exactly once, and recovery must re-admit it
+        assert summary["router_rollbacks"] == 1
+        assert summary["router_readmits"] == 1
+        assert summary["router_live"] == "priced"
+        assert summary["router_priced_records"] > 0

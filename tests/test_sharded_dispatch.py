@@ -329,7 +329,9 @@ class TestThreeWayRouting:
         try:
             assert sched._route_for(1_000_000) is None
             snap = sched.queue_snapshot()
-            assert snap["routes"] == {"cpu": 0, "single": 0, "sharded": 0}
+            assert snap["routes"] == {
+                "cpu": 0, "single": 0, "sharded": 0, "indexed": 0,
+            }
         finally:
             sched.on_stop()
 

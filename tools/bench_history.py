@@ -133,6 +133,14 @@ def direction(path: str, unit: Optional[str] = None) -> Optional[str]:
         return LOWER_IS_BETTER
     if leaf.endswith("_regret_ms"):
         return LOWER_IS_BETTER
+    # live-router guards (PR 16): taken-vs-argmin divergence of the
+    # priced router and the steady-state indexed wire's bytes/lane are
+    # both one-way ratchets (a ratio and a _steady suffix the generic
+    # rules would drop)
+    if leaf.endswith("_route_divergence"):
+        return LOWER_IS_BETTER
+    if leaf.endswith("_bytes_per_lane_steady"):
+        return LOWER_IS_BETTER
     if leaf.endswith(("_ms", "_s", "_us", "_ns")) or "_ms_" in leaf:
         return LOWER_IS_BETTER
     return None

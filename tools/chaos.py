@@ -62,6 +62,9 @@ Eight modes:
   level, and the anomaly watchdog must fire exactly ONE incident
   capture (flight-recorder dump) and re-arm once walls recover —
   proving the watchdog detects a stale cost model without flapping.
+  The scheduler runs the PRICED live router: the trip must also roll
+  routing back to the threshold ladder exactly once, and recovery must
+  re-admit the priced argmin (hysteretic rollback guard, ISSUE 16).
 
 * --soak — crypto/faults.py run_chaos_soak: a randomized fault schedule
   (exceptions, hangs, silent verdict corruption, sudden death, jitter,
@@ -234,6 +237,9 @@ def main() -> int:
             and summary["anomaly_fires"] == 1
             and summary["incident_dumps"] == 1
             and summary["rearmed"]
+            and summary["router_rollbacks"] == 1
+            and summary["router_readmits"] == 1
+            and summary["router_live"] == "priced"
         )
         print("CHAOS STALE-MODEL", "PASS" if ok else "FAIL")
         return 0 if ok else 1

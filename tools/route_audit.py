@@ -11,12 +11,18 @@ router (ROADMAP item 5b) will be judged by:
 * reconciliation — per-route decision counts vs the scheduler's route
   counters (they must match to the unit; a drift means attribution is
   broken);
-* watchdog state (tripped cause, trip count).
+* watchdog state (tripped cause, trip count);
+* with ``--assert-live``: the LIVE priced router's honesty — every
+  "priced"-tagged decision record must have taken the argmin of its own
+  feasible priced candidates (divergence above ``--live-tolerance`` is
+  a failure), and the scheduler must not sit rolled back without a
+  watchdog trip or trip history to justify it.
 
 Usage:
     python tools/route_audit.py http://127.0.0.1:26660
     python tools/route_audit.py snap.json --top 10
     python tools/route_audit.py snap.json --chrome trace.json
+    python tools/route_audit.py snap.json --assert-live
 
 ``--chrome`` exports the recent decision records as a chrome://tracing
 / Perfetto-loadable trace-events JSON: one complete event per decision
@@ -90,6 +96,75 @@ def reconcile(
     return drifts
 
 
+def assert_live(
+    decisions: Dict[str, Any],
+    scheduler: Dict[str, Any],
+    tolerance: float = 0.10,
+) -> List[str]:
+    """CI gate over the LIVE priced router → violation lines (empty =
+    clean). Judged only on "priced"-tagged decision records — pinned /
+    threshold / rolled-back flushes are the other routers' business:
+
+    * the taken route's predicted cost must be within ``tolerance``
+      (fractional) of the cheapest FEASIBLE priced candidate — priced
+      routing that doesn't take its own argmin is lying about itself;
+    * a priced record must never have taken a candidate it marked
+      infeasible at decision time;
+    * the scheduler must not sit rolled back without a recorded cause
+      (watchdog trip or windowed regret) to justify it.
+    """
+    problems: List[str] = []
+    for r in decisions.get("recent", []):
+        if r.get("router") != "priced":
+            continue
+        seq = r.get("seq", "?")
+        taken = r.get("taken")
+        preds = r.get("predicted_ms") or {}
+        feas = r.get("feasible") or {}
+        if feas and not feas.get(taken, False):
+            problems.append(
+                f"decision {seq}: priced router took {taken!r}, which "
+                "it marked infeasible at decision time"
+            )
+            continue
+        pt = preds.get(taken)
+        if not isinstance(pt, (int, float)):
+            problems.append(
+                f"decision {seq}: priced router took unpriced route "
+                f"{taken!r}"
+            )
+            continue
+        cands = [
+            v for c, v in preds.items()
+            if isinstance(v, (int, float))
+            and (not feas or feas.get(c, False))
+        ]
+        if not cands:
+            continue
+        best = min(cands)
+        if pt > best * (1.0 + tolerance) + 1e-9:
+            problems.append(
+                f"decision {seq}: took {taken} predicted at {pt:.3f}ms "
+                f"but the feasible argmin was {best:.3f}ms "
+                f"(>{tolerance:.0%} over)"
+            )
+    router = scheduler.get("router") or {}
+    wd = decisions.get("watchdog", {})
+    if router.get("rolled_back"):
+        cause = router.get("rollback_cause")
+        if not cause:
+            problems.append(
+                "priced router rolled back without a recorded cause"
+            )
+        elif cause != "regret" and not wd.get("tripped") \
+                and not wd.get("trips"):
+            problems.append(
+                f"priced router rolled back on {cause!r} but the "
+                "watchdog never tripped"
+            )
+    return problems
+
+
 def chrome_trace(decisions: Dict[str, Any]) -> Dict[str, Any]:
     """Recent decision records as chrome://tracing trace-events JSON:
     one complete ("X") event per decision, tracks per taken route."""
@@ -152,6 +227,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--chrome", metavar="PATH",
         help="write the recent decisions as chrome://tracing "
              "trace-events JSON to PATH",
+    )
+    ap.add_argument(
+        "--assert-live", action="store_true",
+        help="fail (exit 2) when a priced-tagged decision diverged "
+             "from its feasible argmin beyond --live-tolerance, or the "
+             "router sits rolled back without a justifying trip",
+    )
+    ap.add_argument(
+        "--live-tolerance", type=float, default=0.10,
+        help="fractional taken-vs-argmin divergence allowed by "
+             "--assert-live (default 0.10)",
     )
     args = ap.parse_args(argv)
 
@@ -230,6 +316,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         print("reconciliation  ledger counts == scheduler route counters")
 
+    live_problems: List[str] = []
+    if args.assert_live:
+        live_problems = assert_live(
+            decisions, scheduler, tolerance=args.live_tolerance
+        )
+        router = scheduler.get("router") or {}
+        n_priced = sum(
+            1 for r in decisions.get("recent", [])
+            if r.get("router") == "priced"
+        )
+        print()
+        print(
+            f"live router  mode={router.get('mode', '-')}  "
+            f"live={router.get('live', '-')}  "
+            f"priced_records={n_priced}  "
+            f"rollbacks={router.get('rollbacks', 0)}  "
+            f"readmits={router.get('readmits', 0)}"
+        )
+        for p in live_problems:
+            print(f"LIVE ROUTER VIOLATION: {p}")
+        if not live_problems:
+            print(
+                "live router  every priced decision took its feasible "
+                f"argmin (tolerance {args.live_tolerance:.0%})"
+            )
+
     if args.chrome:
         doc = chrome_trace(decisions)
         with open(args.chrome, "w", encoding="utf-8") as f:
@@ -239,7 +351,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"({len(doc['traceEvents'])} events)"
         )
 
-    return 2 if (drifts or wd.get("tripped")) else 0
+    return 2 if (drifts or live_problems or wd.get("tripped")) else 0
 
 
 if __name__ == "__main__":

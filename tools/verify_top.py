@@ -175,8 +175,16 @@ def render(snap: Dict[str, Any]) -> str:
             total = sum(routes.values()) or 1
             line = "routing  " + "  ".join(
                 f"{r}={routes.get(r, 0)} ({routes.get(r, 0) * 100 // total}%)"
-                for r in ("cpu", "single", "sharded")
+                for r in ("cpu", "single", "sharded", "indexed")
             )
+            # which router is live right now: priced argmin, the
+            # threshold ladder, or priced-but-rolled-back (stale model)
+            router = sched.get("router")
+            if isinstance(router, dict):
+                line += f"  router={router.get('live', '-')}"
+                rb = router.get("rollbacks", 0)
+                if rb:
+                    line += f" (rollbacks={rb})"
             reasons = sched.get("flush_reasons")
             if isinstance(reasons, dict):
                 # broken-state flushes are the "device plane fell over
