@@ -1243,6 +1243,131 @@ def _stage_routing():
     print(json.dumps(out), flush=True)
 
 
+def _stage_service():
+    """Verify-as-a-service head-to-head (ISSUE 17): 32 clients against
+    ONE daemon over a Unix socket, the SAME workload twice — cross-client
+    megabatch coalescing on vs off — over the same serialized device-pool
+    floor (one lock + a fixed per-dispatch cost, modeling one
+    accelerator). Coalescing merges all 32 clients' frames into one flush
+    per round and pays the pool floor ONCE; isolated mode pays it per
+    client frame. The gain is the aggregate-sigs/sec ratio; the
+    acceptance gate is >= 2x (structurally it lands far higher). Also
+    proves the compact wire contract end to end: cumulative payload
+    bytes per lane over the socket == 128."""
+    import threading
+
+    _maybe_force_cpu()
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto import service as servicelib
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+
+    CLIENTS = 32
+    LANES = 64
+    ROUNDS = 10
+    POOL_FLOOR_S = 0.008
+
+    key = ed.gen_priv_key_from_secret(b"bench-service")
+    items = []
+    for i in range(LANES):
+        msg = b"bench service lane %d" % i
+        items.append((key.pub_key(), msg, key.sign(msg)))
+
+    pool_mtx = threading.Lock()
+    inner = servicelib.host_row_verifier()
+
+    def floor_verifier(rows):
+        with pool_mtx:
+            time.sleep(POOL_FLOOR_S)
+            return inner(rows)
+
+    def run(coalesce: bool) -> dict:
+        sched = VerifyScheduler(
+            spec="cpu", flush_us=1000, lane_budget=CLIENTS * LANES,
+            row_verifier=floor_verifier,
+        )
+        sock = "/tmp/cbft-bench-svc-%d-%d.sock" % (
+            os.getpid(), int(coalesce)
+        )
+        service = servicelib.VerifyService(
+            sched, "unix://" + sock, coalesce=coalesce,
+            row_verifier=floor_verifier,
+        )
+        sched.start()
+        service.start()
+        clients = [
+            servicelib.RemoteVerifier(
+                "unix://" + sock, tenant="bench%d" % i, timeout_ms=60_000,
+            )
+            for i in range(CLIENTS)
+        ]
+        walls: list = []
+        wrong = [0]
+        try:
+            # warmup: every distinct lane pays its one true host
+            # verification here, outside the timed window
+            clients[0].submit(items, subsystem="bench").result(timeout=120)
+
+            def client_loop(rv):
+                for _ in range(ROUNDS):
+                    t0 = time.perf_counter()
+                    ok, mask = rv.submit(
+                        items, subsystem="bench"
+                    ).result(timeout=120)
+                    walls.append((time.perf_counter() - t0) * 1e3)
+                    if not ok or not all(mask):
+                        wrong[0] += 1
+
+            threads = [
+                threading.Thread(target=client_loop, args=(rv,))
+                for rv in clients
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            total_s = time.perf_counter() - t0
+            snap = service.snapshot()
+        finally:
+            for rv in clients:
+                rv.close()
+            service.stop()
+            sched.stop()
+            try:
+                os.unlink(sock)
+            except OSError:
+                pass
+        assert wrong[0] == 0, f"{wrong[0]} wrong verdicts over the wire"
+        walls.sort()
+        p99 = walls[min(len(walls) - 1, int(0.99 * len(walls)))]
+        return {
+            "sigs_per_sec": round(CLIENTS * ROUNDS * LANES / total_s, 1),
+            "p99_ms": round(p99, 3),
+            "bytes_per_lane": snap["bytes_per_lane"],
+            "inline_dispatches": snap["inline_dispatches"],
+        }
+
+    iso = run(coalesce=False)
+    coal = run(coalesce=True)
+    gain = coal["sigs_per_sec"] / max(iso["sigs_per_sec"], 1e-9)
+    bpl = coal["bytes_per_lane"]
+    assert all(v <= 128.0 for v in bpl.values()), bpl
+    assert iso["inline_dispatches"] >= CLIENTS * ROUNDS
+    assert coal["inline_dispatches"] == 0
+    out = {
+        "service_clients": CLIENTS,
+        "service_coalesced_sigs_per_sec": coal["sigs_per_sec"],
+        "service_isolated_sigs_per_sec": iso["sigs_per_sec"],
+        "service_coalesce_gain": round(gain, 3),
+        "service_coalesce_gain_ok": gain >= 2.0,
+        "service_p99_ms": coal["p99_ms"],
+        "service_isolated_p99_ms": iso["p99_ms"],
+        "service_bytes_per_lane": bpl,
+    }
+    assert gain >= 2.0, f"coalesce gain {gain:.2f} < 2x"
+    print(json.dumps(out), flush=True)
+
+
 _COLDBOOT_SCRIPT = r"""
 import json, time
 t0 = time.perf_counter()
@@ -1544,6 +1669,15 @@ def main():
     if parsed is not None:
         _append_history(parsed, stage="routing")
 
+    # verify-as-a-service: 32 clients against one daemon over a Unix
+    # socket — cross-client megabatch coalescing vs per-client isolated
+    # dispatch over the same serialized device-pool floor, plus the
+    # compact-wire bytes/lane proof (platform-neutral, jax-free)
+    parsed, diag = _run_stage("service", _STAGE_ENV_CPU, 600)
+    stages["service"] = parsed if parsed is not None else diag
+    if parsed is not None:
+        _append_history(parsed, stage="service")
+
     # tracing overhead budget (<3% on the scheduler stage) + per-stage
     # dispatch breakdown — platform-neutral, so it always runs
     parsed, diag = _run_stage("trace", _STAGE_ENV_CPU, 300)
@@ -1637,6 +1771,7 @@ if __name__ == "__main__":
             "sharded": _stage_sharded,
             "decisions": _stage_decisions,
             "routing": _stage_routing,
+            "service": _stage_service,
             "trace": _stage_trace,
             "coldboot": _stage_coldboot,
         }[sys.argv[2]]()

@@ -379,6 +379,18 @@ class CryptoConfig:
     # classes are never throttled — over-quota submits there are only
     # counted. CBFT_QOS_TENANT_RATE env wins.
     qos_tenant_rate: int = 0
+    # Shared verify daemon (crypto/service.py / tools/verifyd.py):
+    # "unix:///path.sock" or "tcp://host:port" points consensus
+    # preverify, blocksync, light, and mempool verification at a remote
+    # VerifyService (cross-client megabatch coalescing over one device
+    # pool) instead of the in-process scheduler, with local-CPU fallback
+    # on disconnect/timeout. "" (default) = in-process.
+    # CBFT_VERIFY_SERVICE env wins.
+    verify_service: str = ""
+    # Per-request deadline before the remote verifier gives up on the
+    # daemon and falls back to local CPU.
+    # CBFT_VERIFY_SERVICE_TIMEOUT_MS env wins.
+    verify_service_timeout_ms: int = 2000
 
 
 @dataclass
@@ -457,6 +469,18 @@ class Config:
             raise ValueError(
                 "crypto.qos_tenant_rate must be a non-negative integer, "
                 f"got {qtr!r}"
+            )
+        vs = self.crypto.verify_service
+        if vs:
+            # parse_address raises ValueError in the crypto.<knob> style
+            from cometbft_tpu.crypto import service as servicelib
+
+            servicelib.parse_address(vs)
+        vst = self.crypto.verify_service_timeout_ms
+        if not isinstance(vst, int) or isinstance(vst, bool) or vst < 1:
+            raise ValueError(
+                "crypto.verify_service_timeout_ms must be a positive "
+                f"integer, got {vst!r}"
             )
         rt = self.crypto.router
         if rt not in ("priced", "threshold"):

@@ -254,6 +254,32 @@ def resolve_class(
     return names[0]
 
 
+# wire code for "no class tag" — rides the verify-service frame header,
+# where a QoS class is one byte, not a string
+CLASS_CODE_UNTAGGED = 0xFF
+
+
+def class_code(name: Optional[str]) -> int:
+    """One-byte wire code for a class name (its CLASS_ORDER position).
+    Unknown or absent names travel as CLASS_CODE_UNTAGGED and resolve
+    server-side exactly like an untagged in-process submit — to the top
+    class, never to a sheddable one."""
+    if name in CLASS_ORDER:
+        return CLASS_ORDER.index(name)
+    return CLASS_CODE_UNTAGGED
+
+
+def class_name(code: int) -> Optional[str]:
+    """Inverse of class_code. None for the untagged sentinel; raises
+    ValueError for codes outside the ladder (the service answers those
+    with a typed bad_class error frame instead of guessing)."""
+    if code == CLASS_CODE_UNTAGGED:
+        return None
+    if 0 <= code < len(CLASS_ORDER):
+        return CLASS_ORDER[code]
+    raise ValueError(f"unknown qos class code {code}")
+
+
 class TokenBucket:
     """Classic token bucket in signature units. ``rate`` <= 0 means
     unlimited (every take succeeds). Not thread-safe — callers hold the

@@ -278,9 +278,26 @@ class VerifyFuture:
         self._result: Optional[Tuple[bool, List[bool]]] = None
         self._exc: Optional[BaseException] = None
         self.rejected = False
+        self._callbacks: List = []
 
     def done(self) -> bool:
         return self._ev.is_set()
+
+    def add_done_callback(self, fn) -> None:
+        """Run ``fn(self)`` once the future completes — immediately if
+        it already has. The verify service fans verdicts back out per
+        connection this way, so the flush worker hands each response to
+        a writer thread instead of blocking on N client sockets."""
+        with self._mtx:
+            if not self._ev.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
+
+    def _pop_callbacks(self) -> List:
+        cbs = self._callbacks
+        self._callbacks = []
+        return cbs
 
     def result(
         self, timeout: Optional[float] = None
@@ -302,6 +319,9 @@ class VerifyFuture:
                 return
             self._result = result
             self._ev.set()
+            cbs = self._pop_callbacks()
+        for fn in cbs:  # outside the lock: callbacks may inspect result()
+            fn(self)
 
     def _set_exception(self, exc: BaseException) -> None:
         with self._mtx:
@@ -309,11 +329,14 @@ class VerifyFuture:
                 return
             self._exc = exc
             self._ev.set()
+            cbs = self._pop_callbacks()
+        for fn in cbs:
+            fn(self)
 
 
 class _Request:
     __slots__ = ("items", "future", "t_submit", "span", "subsystem",
-                 "height", "qclass")
+                 "height", "qclass", "rows")
 
     def __init__(
         self,
@@ -322,6 +345,7 @@ class _Request:
         subsystem: Optional[str] = None,
         height: Optional[int] = None,
         qclass: str = _FIFO,
+        rows=None,
     ):
         self.items = items
         self.future = VerifyFuture()
@@ -336,6 +360,16 @@ class _Request:
         self.height = height
         # the priority class the subsystem tag resolved to
         self.qclass = qclass
+        # verify-service requests arrive as pre-packed wire rows
+        # (service.RowPayload) instead of (pk, msg, sig) triples; the
+        # socket bytes ARE the dispatch payload (zero double-
+        # marshalling), so ``items`` stays empty and every size
+        # accounting goes through ``n_lanes``
+        self.rows = rows
+
+    @property
+    def n_lanes(self) -> int:
+        return self.rows.n if self.rows is not None else len(self.items)
 
 
 class _Lane:
@@ -400,6 +434,7 @@ class VerifyScheduler(BaseService):
         tenant_rate: Optional[int] = None,
         submit_timeout_ms: Optional[int] = None,
         router: Optional[str] = None,
+        row_verifier=None,
     ):
         super().__init__("VerifyScheduler", logger)
         if isinstance(spec, BackendSpec):
@@ -490,7 +525,13 @@ class VerifyScheduler(BaseService):
         # flush, and per-route dispatch counts feed /debug + verify_top
         self._shard_min_batch_cfg = shard_min_batch
         self._shard_min_batch_resolved: Optional[int] = None
-        self._routes = {"cpu": 0, "single": 0, "sharded": 0, "indexed": 0}
+        self._routes = {
+            "cpu": 0, "single": 0, "sharded": 0, "indexed": 0, "service": 0,
+        }
+        # verify-service row flushes: pre-packed wire rows verify through
+        # this callable (service.resolve_row_verifier picks device vs
+        # host ground truth lazily on the first row dispatch)
+        self._row_verifier = row_verifier
 
         # -- live priced router (CBFT_ROUTER / [crypto] router) ------------
         # "priced": per-flush argmin over decision-ledger-priced feasible
@@ -772,6 +813,48 @@ class VerifyScheduler(BaseService):
             req.future._set((True, []))
             span.end(outcome="empty")
             return req.future
+        return self._submit_req(req, subsystem or qoslib.TENANT_UNTAGGED)
+
+    def submit_rows(
+        self,
+        payload,
+        tenant: Optional[str] = None,
+        qclass: Optional[str] = None,
+        height: Optional[int] = None,
+    ) -> VerifyFuture:
+        """Queue a verify-service row payload (service.RowPayload — the
+        client's pre-packed compact/indexed wire rows, the exact socket
+        bytes) for the next coalesced dispatch. Runs the SAME admission
+        ladder as ``submit`` — brownout, per-tenant quota, lane
+        backpressure — keyed on the remote tenant, with the QoS class
+        taken from the frame header (untagged resolves to the top class,
+        exactly like an in-process untagged submit). Row requests ride
+        the same flushes as triple requests: cross-client coalescing IS
+        this queue."""
+        if qclass is None or qclass not in self._class_names:
+            qclass = qoslib.resolve_class(qclass, self._class_names)
+        span = self._tracer.start_span("request", n_sigs=payload.n)
+        if not span.noop:
+            span.set_tag("subsystem", tenant or "remote")
+            span.set_tag("transport", "service")
+            if height is not None:
+                span.set_tag("height", int(height))
+            if self._qos_enabled:
+                span.set_tag("qos_class", qclass)
+        req = _Request(
+            [], span, tenant or "remote", height, qclass, rows=payload
+        )
+        self.metrics.requests.add()
+        self.metrics.signatures.add(req.n_lanes)
+        if payload.n == 0:
+            req.future._set((True, []))
+            span.end(outcome="empty")
+            return req.future
+        return self._submit_req(req, tenant or qoslib.TENANT_UNTAGGED)
+
+    def _submit_req(self, req: _Request, tenant: str) -> VerifyFuture:
+        """The admission ladder shared by triple and row submissions."""
+        qclass = req.qclass
         if not self.is_running():
             # standalone / post-stop: synchronous inline dispatch keeps
             # the contract (future complete on return, exact verdicts)
@@ -795,12 +878,10 @@ class VerifyScheduler(BaseService):
                 action = (
                     "drop" if policy == qoslib.POLICY_DROP else "shed"
                 )
-            elif not self._quotas.try_take(
-                subsystem or qoslib.TENANT_UNTAGGED, len(req.items)
-            ):
+            elif not self._quotas.try_take(tenant, req.n_lanes):
                 lane.quota_rejections += 1
                 self.qos_metrics.quota_rejections.with_labels(
-                    tenant=subsystem or qoslib.TENANT_UNTAGGED
+                    tenant=tenant
                 ).add()
                 if policy == qoslib.POLICY_SHED:
                     action = "shed"
@@ -849,9 +930,9 @@ class VerifyScheduler(BaseService):
                         )
             if action is None:
                 lane.reqs.append(req)
-                lane.pending_sigs += len(req.items)
+                lane.pending_sigs += req.n_lanes
                 lane.admits += 1
-                self._pending_lanes += len(req.items)
+                self._pending_lanes += req.n_lanes
                 self.metrics.queue_depth.set(self._depth_locked())
                 self.metrics.pending_lanes.set(self._pending_lanes)
                 if self._qos_enabled:
@@ -877,7 +958,7 @@ class VerifyScheduler(BaseService):
         self.metrics.backpressure_timeouts.add()
         self.logger.error(
             "verify queue full past deadline; verifying inline on CPU",
-            n=len(req.items), qclass=qclass, max_queue=lane.bound,
+            n=req.n_lanes, qclass=qclass, max_queue=lane.bound,
             timeout_s=self._submit_timeout_s,
         )
         self._inline_cpu(req, outcome="backpressure_cpu")
@@ -888,6 +969,24 @@ class VerifyScheduler(BaseService):
         RED-meter the verdict under its tenant tag — an overloaded
         tenant must look overloaded in /debug/verify, not drop out of
         its own rate the moment its traffic stops riding the device."""
+        if req.rows is not None:
+            # a row request holds only wire rows — the server has no
+            # triples to ground-truth cheaply, but the REMOTE client
+            # still holds them plus an idle CPU. Refuse with a rejected
+            # verdict; the client's fallback ladder pays the verify.
+            req.future.rejected = True
+            req.future._set((False, [False] * req.n_lanes))
+            req.span.end(outcome=outcome, ok=False)
+            if self._telemetry is not None:
+                self._telemetry.note_request(
+                    n_sigs=req.n_lanes,
+                    wait_s=time.monotonic() - req.t_submit,
+                    service_s=0.0,
+                    ok=False,
+                    subsystem=req.subsystem,
+                    height=req.height,
+                )
+            return
         t0 = time.monotonic()
         mask = self._cpu_ground_truth(req.items)
         service_s = time.monotonic() - t0
@@ -914,7 +1013,7 @@ class VerifyScheduler(BaseService):
         ).add()
         self.qos_metrics.shed_sigs.with_labels(
             qclass=lane.spec.name
-        ).add(len(req.items))
+        ).add(req.n_lanes)
         self._inline_cpu(req, outcome="qos_shed")
 
     def _drop(self, req: _Request, lane: _Lane) -> None:
@@ -930,13 +1029,13 @@ class VerifyScheduler(BaseService):
         ).add()
         self.qos_metrics.shed_sigs.with_labels(
             qclass=lane.spec.name
-        ).add(len(req.items))
+        ).add(req.n_lanes)
         req.future.rejected = True
-        req.future._set((False, [False] * len(req.items)))
+        req.future._set((False, [False] * req.n_lanes))
         req.span.end(outcome="qos_drop", ok=False)
         if self._telemetry is not None:
             self._telemetry.note_request(
-                n_sigs=len(req.items),
+                n_sigs=req.n_lanes,
                 wait_s=time.monotonic() - req.t_submit,
                 service_s=0.0,
                 ok=False,
@@ -1047,7 +1146,7 @@ class VerifyScheduler(BaseService):
         def take(lane: _Lane) -> None:
             nonlocal total
             req = lane.reqs.popleft()
-            n = len(req.items)
+            n = req.n_lanes
             lane.pending_sigs -= n
             self._pending_lanes -= n
             total += n
@@ -1058,7 +1157,7 @@ class VerifyScheduler(BaseService):
                 # an empty batch always takes one request: an oversize
                 # request still has to dispatch somewhere
                 return True
-            return total + len(lane.reqs[0].items) <= budget
+            return total + lane.reqs[0].n_lanes <= budget
 
         top = lanes[0]
         while top.reqs:
@@ -1082,12 +1181,12 @@ class VerifyScheduler(BaseService):
                 lane.deficit += lane.spec.weight * quantum
                 while (
                     lane.reqs
-                    and lane.deficit >= len(lane.reqs[0].items)
+                    and lane.deficit >= lane.reqs[0].n_lanes
                 ):
                     if not fits(lane):
                         budget_full = True
                         break
-                    lane.deficit -= len(lane.reqs[0].items)
+                    lane.deficit -= lane.reqs[0].n_lanes
                     take(lane)
                 if budget_full:
                     break
@@ -1121,14 +1220,19 @@ class VerifyScheduler(BaseService):
         parent = None
         waits: List[float] = []
         by_class: Dict[str, List[int]] = {}
+        n_total = 0
+        has_rows = False
         for req in batch:
             wait_s = t0 - req.t_submit
             waits.append(wait_s)
             self.metrics.request_wait_seconds.observe(wait_s)
             items.extend(req.items)
+            n_total += req.n_lanes
+            if req.rows is not None:
+                has_rows = True
             counts = by_class.setdefault(req.qclass, [0, 0])
             counts[0] += 1
-            counts[1] += len(req.items)
+            counts[1] += req.n_lanes
             if not req.span.noop:
                 req.span.set_tag("wait_us", int(wait_s * 1e6))
                 if parent is None:
@@ -1141,14 +1245,14 @@ class VerifyScheduler(BaseService):
             self._flush_reasons[reason] = (
                 self._flush_reasons.get(reason, 0) + 1
             )
-        lane_fill = min(1.0, len(items) / self._lane_budget)
+        lane_fill = min(1.0, n_total / self._lane_budget)
         self.metrics.lane_fill_ratio.observe(lane_fill)
         dspan = self._tracer.start_span(
             "dispatch",
             parent=parent,
             reason=reason,
             n_requests=len(batch),
-            n_sigs=len(items),
+            n_sigs=n_total,
             lane_fill=round(lane_fill, 4),
         )
         if not dspan.noop:
@@ -1166,15 +1270,17 @@ class VerifyScheduler(BaseService):
         # demux shape for supervisor triage attribution: one
         # (n_items, subsystem, height) per coalesced request, item order
         origins = [
-            (len(req.items), req.subsystem, req.height) for req in batch
+            (req.n_lanes, req.subsystem, req.height) for req in batch
         ]
         # decision plane ride-along: one RouteDecision per flush, input
         # gathering gated on an installed ledger so the off-edge is a
         # single attribute read (bench_micro's decisions section bounds
-        # the on-edge under 1%)
+        # the on-edge under 1%). Row flushes skip it: their rows are
+        # already committed to the compact wire, so there is no route
+        # choice to price.
         declgr = declib.default_ledger()
         dec = None
-        if declgr is not None:
+        if declgr is not None and not has_rows:
             breakers = self._decision_breakers()
             dec = declgr.open(
                 n=len(items),
@@ -1188,7 +1294,11 @@ class VerifyScheduler(BaseService):
         t_verify = time.perf_counter()
         try:
             with tracelib.use(dspan), declib.use(dec):
-                mask, wire_route = self._verify(items, reason, origins)
+                if has_rows:
+                    mask = self._verify_rows(batch)
+                    wire_route = "service"
+                else:
+                    mask, wire_route = self._verify(items, reason, origins)
         except BaseException as exc:
             dspan.end(error=repr(exc))
             raise
@@ -1205,8 +1315,8 @@ class VerifyScheduler(BaseService):
         t_demux = time.perf_counter()
         pos = 0
         for i, req in enumerate(batch):
-            sub = mask[pos : pos + len(req.items)]
-            pos += len(req.items)
+            sub = mask[pos : pos + req.n_lanes]
+            pos += req.n_lanes
             ok = all(sub)
             req.future._set((ok, sub))
             req.span.end(ok=ok)
@@ -1214,7 +1324,7 @@ class VerifyScheduler(BaseService):
                 # the coalesced dispatch's service time is every rider's
                 # service time — they all waited on the same flush
                 self._telemetry.note_request(
-                    n_sigs=len(req.items),
+                    n_sigs=req.n_lanes,
                     wait_s=waits[i],
                     service_s=service_s,
                     ok=ok,
@@ -1224,8 +1334,25 @@ class VerifyScheduler(BaseService):
         ledger = wirelib.default_ledger()
         if ledger is not None:
             ledger.note_demux(
-                wire_route, len(items), time.perf_counter() - t_demux
+                wire_route, n_total, time.perf_counter() - t_demux
             )
+
+    def _verify_rows(self, batch: List[_Request]) -> List[bool]:
+        """Verify a coalesced flush carrying row payloads: the requests'
+        wire rows (plus any triple riders, packed once into the same
+        layout) concatenate into ONE compact megabatch for the row
+        verifier — the cross-client coalescing dispatch. The lazy import
+        mirrors how the service imports the scheduler: neither pays for
+        the other unless row traffic actually flows."""
+        from cometbft_tpu.crypto import service as servicelib
+
+        verifier = self._row_verifier
+        if verifier is None:
+            verifier = self._row_verifier = servicelib.resolve_row_verifier(
+                self.spec
+            )
+        self._note_route("service")
+        return servicelib.verify_mixed_flush(batch, verifier)
 
     # decision-plane input gathering — each best-effort and only run
     # when a decision ledger is installed

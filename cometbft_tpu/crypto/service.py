@@ -1,0 +1,1706 @@
+"""Verify-as-a-service — the VerifyScheduler behind a real network
+boundary, with cross-client megabatch coalescing over the compact wire
+format.
+
+The QoS plane (crypto/qos.py), per-tenant RED metering (telemetry.py),
+and the compact 128 B / indexed 100 B wire rows (PR 13) made the
+scheduler multi-tenant in everything but transport: "tenants" were
+threads in one process. This module adds the transport. A
+``VerifyService`` listens on a Unix domain socket (TCP optional) and
+feeds frames from N client connections into ONE ``VerifyScheduler`` —
+cross-client coalescing: the batch sweep says a lone 1024-lane flush
+earns ~25k sigs/sec while a 16384-lane megabatch earns ~75k, so merging
+many small client flushes raises fleet throughput AND each client's
+latency. A ``RemoteVerifier`` duck-types the crypto Backend contract
+(``spec`` + ``submit``, like ScheduledBatchVerifier) so every existing
+call site — consensus preverify, blocksync, light, mempool — points at
+a shared daemon the moment the node sets ``[crypto] verify_service`` /
+``CBFT_VERIFY_SERVICE``.
+
+Zero double-marshalling is the design invariant: the RPC payload IS the
+PR 13 wire format. The client packs compact u8[128,B] rows (or 100 B
+indexed rows when its cached keystore generation matches the server's)
+exactly once via ``ed25519_batch.prepare_batch_compact`` /
+``_prepare_rsh_compact`` — the same ``pack_compact_rows`` plane layout
+the kernels consume — and the server ``device_put``s those same bytes.
+Nothing is ever re-marshalled into triples on the server.
+
+Frame protocol (length-prefixed binary, no external deps):
+
+    u32 LE frame length (header + payload)
+    40-byte header:  <4sBBBBQII16s
+        magic      b"CBVS"
+        version    1
+        ftype      HELLO | CLIENT_HELLO | REQ | RESP | ERR |
+                   REGISTER | REGISTERED
+        qclass     QoS class code (qos.class_code; 0xFF = untagged)
+        kind       0 = compact 128 B rows, 1 = indexed 100 B rows
+        req_id     u64, client-assigned, echoed on RESP/ERR
+        n_lanes    u32 lanes in this frame (HELLO: server max_lanes)
+        generation u32 keystore generation (the indexed handshake)
+        valset_id  16 bytes (sha256(pubkey rows)[:16]; REGISTER/indexed)
+    payload:
+        REQ compact   u8[128, n] C-order — exactly 128 B/lane
+        REQ indexed   u8[96, n] R ‖ S ‖ h rows + n × i32 LE table
+                      indices — exactly 100 B/lane
+        RESP          1 status byte (0 ok, 1 rejected) + bitmask
+                      (np.packbits little) of per-lane verdicts
+        ERR           u16 LE code + utf8 message
+        REGISTER      n × 32-byte pubkey rows
+        CLIENT_HELLO  utf8 tenant name
+
+Tenant identity is the connection (CLIENT_HELLO), the QoS class rides
+in the frame header, and ``qos.resolve_class`` / ``TenantQuotas`` /
+brownout apply unchanged inside the scheduler. Refused row requests
+(shed/drop/backpressure) are answered ``rejected`` — the remote client
+holds the original triples and its own CPU, so IT pays the fallback
+verify, never the shared device plane's host.
+
+Fallback ladder, client side: indexed frame → (stale generation,
+unknown valset) re-register + compact frame → (rejected, timeout,
+disconnect, any error) local CPU ground truth, with the verdict reason
+kept distinct (``future.reason``) and counted per cause.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cometbft_tpu.crypto import qos as qoslib, wire as wirelib
+from cometbft_tpu.crypto.batch import BackendSpec, CPUBatchVerifier
+from cometbft_tpu.crypto.scheduler import Item, VerifyFuture
+from cometbft_tpu.libs.log import Logger
+from cometbft_tpu.libs.metrics import Registry
+from cometbft_tpu.libs.service import BaseService
+
+SUBSYSTEM = "verify_service"
+
+# -- frame protocol ----------------------------------------------------------
+
+MAGIC = b"CBVS"
+VERSION = 1
+
+FT_HELLO = 0
+FT_CLIENT_HELLO = 1
+FT_REQ = 2
+FT_RESP = 3
+FT_ERR = 4
+FT_REGISTER = 5
+FT_REGISTERED = 6
+_FT_NAMES = {
+    FT_HELLO: "hello",
+    FT_CLIENT_HELLO: "client_hello",
+    FT_REQ: "req",
+    FT_RESP: "resp",
+    FT_ERR: "err",
+    FT_REGISTER: "register",
+    FT_REGISTERED: "registered",
+}
+
+KIND_COMPACT = 0
+KIND_INDEXED = 1
+_KIND_NAMES = {KIND_COMPACT: "compact", KIND_INDEXED: "indexed"}
+
+COMPACT_ROW_BYTES = 128
+RSH_ROW_BYTES = 96
+INDEX_BYTES = 4
+INDEXED_ROW_BYTES = RSH_ROW_BYTES + INDEX_BYTES  # 100 B/lane
+
+_LEN = struct.Struct("<I")
+_HEADER = struct.Struct("<4sBBBBQII16s")
+HEADER_BYTES = _HEADER.size
+VALSET_ID_BYTES = 16
+_ERR_HEAD = struct.Struct("<H")
+
+# typed error codes (satellite: malformed/truncated/oversized frames get
+# a typed error frame and the accept loop survives)
+ERR_MALFORMED = 1
+ERR_OVERSIZE = 2
+ERR_STALE_GENERATION = 3
+ERR_UNKNOWN_VALSET = 4
+ERR_BAD_CLASS = 5
+ERR_BAD_VERSION = 6
+ERR_INTERNAL = 7
+ERR_NAMES = {
+    ERR_MALFORMED: "malformed",
+    ERR_OVERSIZE: "oversize",
+    ERR_STALE_GENERATION: "stale_generation",
+    ERR_UNKNOWN_VALSET: "unknown_valset",
+    ERR_BAD_CLASS: "bad_class",
+    ERR_BAD_VERSION: "bad_version",
+    ERR_INTERNAL: "internal",
+}
+
+# RESP status byte
+ST_OK = 0
+ST_REJECTED = 1
+
+DEFAULT_ADDRESS = "unix:///tmp/cbft-verifyd.sock"
+DEFAULT_TIMEOUT_MS = 2_000
+# registration frames carry raw 32-byte key rows; bound them the same
+# way REQ lanes are bounded so one garbage client cannot OOM the server
+MAX_REGISTER_KEYS = 16_384
+_DRAIN_CHUNK = 65_536
+
+
+def verify_service_default(config_value: Optional[str] = None) -> str:
+    """Shared-daemon address: CBFT_VERIFY_SERVICE env > [crypto]
+    verify_service > "" (in-process scheduler, the default)."""
+    raw = os.environ.get("CBFT_VERIFY_SERVICE")
+    if raw is not None:
+        return raw.strip()
+    if config_value:
+        return str(config_value).strip()
+    return ""
+
+
+def service_timeout_default(config_timeout_ms: Optional[int] = None) -> int:
+    """Per-request deadline (ms) before the client falls back to local
+    CPU: CBFT_VERIFY_SERVICE_TIMEOUT_MS env > configured > 2000."""
+    raw = os.environ.get("CBFT_VERIFY_SERVICE_TIMEOUT_MS")
+    if raw is not None:
+        return int(raw)
+    if config_timeout_ms is not None:
+        return int(config_timeout_ms)
+    return DEFAULT_TIMEOUT_MS
+
+
+def parse_address(addr: str) -> Tuple[str, Any]:
+    """("unix", path) or ("tcp", (host, port)). A bare filesystem path
+    is accepted as a unix address; anything else raises ValueError in
+    config.validate_basic's style."""
+    a = str(addr).strip()
+    if a.startswith("unix://"):
+        path = a[len("unix://"):]
+        if not path:
+            raise ValueError("verify_service unix:// address needs a path")
+        return "unix", path
+    if a.startswith("tcp://"):
+        rest = a[len("tcp://"):]
+        host, sep, port = rest.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(
+                f"verify_service tcp:// address must be tcp://HOST:PORT, "
+                f"got {addr!r}"
+            )
+        return "tcp", (host, int(port))
+    if "://" not in a and (a.startswith(("/", ".")) or os.sep in a):
+        # a bare filesystem path; an unrecognized scheme must NOT fall
+        # through here (ftp://x contains os.sep and would silently
+        # become a unix path)
+        return "unix", a
+    raise ValueError(
+        f"verify_service address must be unix://PATH or tcp://HOST:PORT, "
+        f"got {addr!r}"
+    )
+
+
+def max_frame_bytes(max_lanes: int) -> int:
+    """Frame-length bound derived from the lane budget (itself
+    max_chunk-derived): the largest legal frame is a full compact REQ or
+    a full REGISTER, whichever is bigger, plus the header."""
+    lanes = max(1, int(max_lanes))
+    body = max(lanes * COMPACT_ROW_BYTES, MAX_REGISTER_KEYS * 32)
+    return HEADER_BYTES + body
+
+
+class FrameError(Exception):
+    """Typed protocol error; ``code`` is one of the ERR_* constants and
+    is what travels in the error frame."""
+
+    def __init__(self, code: int, msg: str):
+        super().__init__(msg)
+        self.code = code
+
+
+class Frame:
+    __slots__ = ("ftype", "qclass", "kind", "req_id", "n_lanes",
+                 "generation", "valset_id", "payload")
+
+    def __init__(self, ftype, qclass, kind, req_id, n_lanes, generation,
+                 valset_id, payload):
+        self.ftype = ftype
+        self.qclass = qclass
+        self.kind = kind
+        self.req_id = req_id
+        self.n_lanes = n_lanes
+        self.generation = generation
+        self.valset_id = valset_id
+        self.payload = payload
+
+
+def encode_frame(
+    ftype: int,
+    *,
+    qclass: int = qoslib.CLASS_CODE_UNTAGGED,
+    kind: int = KIND_COMPACT,
+    req_id: int = 0,
+    n_lanes: int = 0,
+    generation: int = 0,
+    valset_id: bytes = b"",
+    payload: bytes = b"",
+) -> bytes:
+    vid = bytes(valset_id)[:VALSET_ID_BYTES].ljust(VALSET_ID_BYTES, b"\x00")
+    header = _HEADER.pack(
+        MAGIC, VERSION, ftype & 0xFF, qclass & 0xFF, kind & 0xFF,
+        req_id & 0xFFFFFFFFFFFFFFFF, n_lanes & 0xFFFFFFFF,
+        generation & 0xFFFFFFFF, vid,
+    )
+    return _LEN.pack(HEADER_BYTES + len(payload)) + header + payload
+
+
+def decode_frame(buf: bytes) -> Frame:
+    """Parse one length-stripped frame. Raises FrameError — MALFORMED
+    for a short/garbled header, BAD_VERSION for a future protocol."""
+    if len(buf) < HEADER_BYTES:
+        raise FrameError(
+            ERR_MALFORMED, f"frame shorter than header ({len(buf)} bytes)"
+        )
+    magic, version, ftype, qclass, kind, req_id, n_lanes, generation, vid = (
+        _HEADER.unpack_from(buf)
+    )
+    if magic != MAGIC:
+        raise FrameError(ERR_MALFORMED, f"bad magic {magic!r}")
+    if version != VERSION:
+        raise FrameError(ERR_BAD_VERSION, f"unsupported version {version}")
+    return Frame(
+        ftype, qclass, kind, req_id, n_lanes, generation, vid,
+        buf[HEADER_BYTES:],
+    )
+
+
+def req_payload_bytes(kind: int, n_lanes: int) -> int:
+    if kind == KIND_COMPACT:
+        return COMPACT_ROW_BYTES * n_lanes
+    if kind == KIND_INDEXED:
+        return INDEXED_ROW_BYTES * n_lanes
+    raise FrameError(ERR_MALFORMED, f"unknown row kind {kind}")
+
+
+def encode_error(code: int, msg: str) -> bytes:
+    return _ERR_HEAD.pack(code & 0xFFFF) + msg.encode(
+        "utf-8", errors="replace"
+    )
+
+
+def decode_error(payload: bytes) -> Tuple[int, str]:
+    if len(payload) < _ERR_HEAD.size:
+        return ERR_INTERNAL, "truncated error frame"
+    (code,) = _ERR_HEAD.unpack_from(payload)
+    return code, payload[_ERR_HEAD.size:].decode("utf-8", errors="replace")
+
+
+# -- socket helpers ----------------------------------------------------------
+
+
+def _recv_exact(sock, n: int, tick: Optional[Callable[[], bool]] = None
+                ) -> Optional[bytes]:
+    """Read exactly n bytes. None on EOF or socket error (the caller
+    treats both as disconnect — a mid-frame EOF IS a truncated frame).
+    Socket timeouts loop, calling ``tick()`` between slices when given
+    (the client's pending-expiry hook); tick() returning False aborts."""
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            if tick is not None and not tick():
+                return None
+            continue
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def _drain(sock, n: int) -> bool:
+    """Discard n bytes in bounded chunks (the oversize-frame recovery:
+    the typed error already went out; the stream stays framed)."""
+    left = n
+    while left > 0:
+        got = _recv_exact(sock, min(left, _DRAIN_CHUNK))
+        if got is None:
+            return False
+        left -= len(got)
+    return True
+
+
+def _pk_bytes(pk) -> bytes:
+    """Normalize one pubkey to raw bytes (same contract as
+    keystore._key_bytes: PubKey objects and raw bytes both travel)."""
+    if isinstance(pk, (bytes, bytearray, memoryview)):
+        return bytes(pk)
+    b = getattr(pk, "bytes", None)
+    if callable(b):
+        return b()
+    return bytes(pk)
+
+
+# -- packing (client side, and server-side triples riding a row flush) -------
+
+
+def pack_items_compact(
+    items: Sequence[Item],
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(wire u8[128, n], valid bool[n]) for (pk, msg, sig) triples —
+    the exact ed25519_batch.prepare_batch_compact plane layout
+    (A ‖ R ‖ S ‖ h rows via pack_compact_rows), packed ONCE. Lanes with
+    malformed inputs or s ≥ L come back valid=False (their rows are
+    zero-filled); the client strips them before framing, the server
+    masks them after the kernel."""
+    from cometbft_tpu.crypto.tpu import ed25519_batch as ed
+
+    pks = [_pk_bytes(pk) for pk, _, _ in items]
+    msgs = [m for _, m, _ in items]
+    sigs = [s for _, _, s in items]
+    wire, valid = ed.prepare_batch_compact(pks, msgs, sigs)
+    return wire, np.asarray(valid, dtype=bool)
+
+
+def pack_items_indexed(
+    items: Sequence[Item], index: Dict[bytes, int],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(rsh u8[96, n], idx i32[n], valid bool[n]) for triples whose
+    pubkeys are ALL in ``index`` (the caller's coverage check) — the
+    100 B/lane indexed wire."""
+    from cometbft_tpu.crypto.tpu import ed25519_batch as ed
+
+    pk_arr = np.stack([
+        np.frombuffer(_pk_bytes(pk), np.uint8) for pk, _, _ in items
+    ])
+    msgs = [m for _, m, _ in items]
+    sigs = [s for _, _, s in items]
+    rsh, valid = ed._prepare_rsh_compact(pk_arr, msgs, sigs)
+    idx = np.fromiter(
+        (index[_pk_bytes(pk)] for pk, _, _ in items),
+        dtype=np.int32, count=len(items),
+    )
+    return rsh, idx, np.asarray(valid, dtype=bool)
+
+
+class RowPayload:
+    """One client frame's rows as the scheduler carries them: the exact
+    socket bytes (never re-marshalled into triples), plus — for indexed
+    frames — the resident keystore entry the indices address. The entry
+    OBJECT rides along (valset ids are content-addressed), so a
+    concurrent LRU eviction cannot swap the keys out from under an
+    admitted request; the generation check is a frame-accept-time
+    freshness protocol only."""
+
+    __slots__ = ("kind", "wire", "idx", "entry", "valset_id", "n")
+
+    def __init__(self, kind: int, wire: np.ndarray,
+                 idx: Optional[np.ndarray] = None, entry=None,
+                 valset_id: bytes = b""):
+        self.kind = kind
+        self.wire = wire
+        self.idx = idx
+        self.entry = entry
+        self.valset_id = valset_id
+        self.n = int(wire.shape[1])
+
+    def as_compact(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(u8[128, n] compact rows, valid mask). Indexed payloads
+        host-gather their pubkey rows from the carried entry — used when
+        the flush mixes kinds or runs on the host verifier; a uniform
+        indexed flush on a live device plane keeps the on-device
+        gather instead."""
+        if self.kind == KIND_COMPACT:
+            return self.wire, np.ones(self.n, dtype=bool)
+        rows = self.entry.pk_arr[self.idx]          # [n, 32] host gather
+        valid = np.asarray(self.entry.pk_ok[self.idx], dtype=bool).copy()
+        wire = np.empty((COMPACT_ROW_BYTES, self.n), np.uint8)
+        wire[:32] = rows.T
+        wire[32:] = self.wire
+        return wire, valid
+
+
+# -- row verification (host ground truth + device dispatch) ------------------
+
+
+def _verify_row(col: bytes) -> bool:
+    """Ground-truth verify of ONE compact wire column (A‖R‖S‖h, 128 B):
+    cofactorless [s]B + [h](−A) == R over the pure-Python group — the
+    same check the kernel runs, minus the batching. ~2.6 ms/lane; the
+    CachingRowVerifier amortizes it."""
+    from cometbft_tpu.crypto import purepy as pp
+
+    a = pp._pt_decode(bytes(col[0:32]))
+    if a is None:
+        return False
+    s = int.from_bytes(col[64:96], "little")
+    if s >= pp._L:
+        return False
+    h = int.from_bytes(col[96:128], "little")
+    na = (pp._P - a[0], a[1], a[2], pp._P - a[3])
+    q = pp._IDENT
+    add = pp._pt_add
+    b = pp._B
+    for i in range(max(s.bit_length(), h.bit_length()) - 1, -1, -1):
+        q = add(q, q)
+        if (s >> i) & 1:
+            q = add(q, b)
+        if (h >> i) & 1:
+            q = add(q, na)
+    return pp._pt_encode(q) == bytes(col[32:64])
+
+
+class CachingRowVerifier:
+    """Host row verifier over compact wire columns with a bounded
+    memoization LRU keyed by the full 128-byte lane. Every DISTINCT lane
+    is truly verified (Shamir double-scalar, exact kernel semantics);
+    repeats are a dict hit — which is what makes the chaos/bench soaks
+    honest AND fast, and is the last rung of the service fallback ladder
+    when no device plane exists."""
+
+    def __init__(self, max_entries: int = 65_536):
+        self._cache: "collections.OrderedDict[bytes, bool]" = (
+            collections.OrderedDict()
+        )
+        self._max = max(1, int(max_entries))
+        self._mtx = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def __call__(self, rows: np.ndarray) -> np.ndarray:
+        cols = np.ascontiguousarray(rows.T)
+        out = np.zeros(cols.shape[0], dtype=bool)
+        for i in range(cols.shape[0]):
+            key = cols[i].tobytes()
+            with self._mtx:
+                v = self._cache.get(key)
+                if v is not None:
+                    self._cache.move_to_end(key)
+                    self.hits += 1
+            if v is None:
+                v = _verify_row(key)  # slow — outside the lock
+                with self._mtx:
+                    self.misses += 1
+                    self._cache[key] = v
+                    while len(self._cache) > self._max:
+                        self._cache.popitem(last=False)
+            out[i] = v
+        return out
+
+
+def dispatch_rows(rows: np.ndarray) -> np.ndarray:
+    """Device dispatch of concatenated compact wire columns — the
+    zero-double-marshalling half of the tentpole: the u8[128, B] bytes
+    that crossed the socket are the bytes ``device_put`` here. Chunked
+    and pow2-padded exactly like the keyed single-chip loop, with every
+    chunk attributed into the wire ledger under the "service" route so
+    bytes-per-lane is provable from /debug/verify."""
+    import jax
+    import jax.numpy as jnp
+
+    from cometbft_tpu.crypto.tpu import ed25519_batch as ed
+    from cometbft_tpu.crypto.tpu import mesh as mesh_mod
+
+    n = int(rows.shape[1])
+    out = np.zeros(n, dtype=bool)
+    if n == 0:
+        return out
+    max_chunk = mesh_mod.chunk_cap(ed._MAX_CHUNK, ed._MIN_PAD)
+    ledger = wirelib.default_ledger()
+    for start in range(0, n, max_chunk):
+        end = min(start + max_chunk, n)
+        t_pack = time.perf_counter()
+        size = ed._MIN_PAD
+        while size < end - start:
+            size *= 2
+        pad = np.zeros((COMPACT_ROW_BYTES, size), np.uint8)
+        pad[:, : end - start] = rows[:, start:end]
+        t_h2d = time.perf_counter()
+        dev = jax.device_put(jnp.asarray(pad))
+        t_compute = time.perf_counter()
+        mask = mesh_mod.run_single(
+            ed.verify_kernel_compact, [dev], donate_from=0
+        )
+        t_done = time.perf_counter()
+        out[start:end] = np.asarray(mask)[: end - start]
+        if ledger is not None:
+            ledger.note_chunk(
+                "service", "dev0", size, end - start, pad.nbytes,
+                t_h2d - t_pack, t_compute - t_h2d, t_done - t_compute,
+                time.perf_counter() - t_done,
+            )
+    return out
+
+
+_host_verifier: Optional[CachingRowVerifier] = None
+_host_mtx = threading.Lock()
+
+
+def host_row_verifier() -> CachingRowVerifier:
+    """Process-shared host verifier so memoized verdicts span every
+    scheduler/service in the process (tests spin up several)."""
+    global _host_verifier
+    with _host_mtx:
+        if _host_verifier is None:
+            _host_verifier = CachingRowVerifier()
+        return _host_verifier
+
+
+def resolve_row_verifier(spec=None) -> Callable[[np.ndarray], np.ndarray]:
+    """Pick the row verifier for a scheduler that received row payloads:
+    the device kernel when the node runs a real accelerator plane, the
+    host ground truth otherwise. (The CPU-jax compact kernel pays a
+    multi-second compile for no batching win — the host path is both
+    faster and exact for CPU-only deployments.)"""
+    name = getattr(spec, "name", None) or os.environ.get(
+        "CMT_CRYPTO_BACKEND", "cpu"
+    )
+    if name != "cpu":
+        try:
+            import jax
+
+            if jax.default_backend() != "cpu":
+                return dispatch_rows
+        except Exception:  # noqa: BLE001 - no device plane, host rung
+            pass
+    return host_row_verifier()
+
+
+def verify_mixed_flush(batch, row_verifier) -> List[bool]:
+    """Verdict mask for one coalesced flush that contains at least one
+    row-payload request. Triple requests pack ONCE into the same compact
+    layout; row requests contribute their exact socket bytes (indexed
+    frames host-gather their key rows unless the whole flush stays on
+    the device path); the concatenated u8[128, N] block verifies in one
+    shot — this is the cross-client megabatch."""
+    blocks: List[np.ndarray] = []
+    valids: List[np.ndarray] = []
+    for req in batch:
+        rows = getattr(req, "rows", None)
+        if rows is not None:
+            w, v = rows.as_compact()
+        else:
+            w, v = pack_items_compact(req.items)
+        blocks.append(w)
+        valids.append(np.asarray(v, dtype=bool))
+    full = blocks[0] if len(blocks) == 1 else np.concatenate(blocks, axis=1)
+    valid = valids[0] if len(valids) == 1 else np.concatenate(valids)
+    try:
+        mask = np.asarray(row_verifier(full), dtype=bool)[: full.shape[1]]
+    except Exception:  # noqa: BLE001 - device died mid-flight: host rung
+        mask = np.asarray(
+            host_row_verifier()(full), dtype=bool
+        )[: full.shape[1]]
+    mask = mask & valid
+    return [bool(b) for b in mask]
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+class ServiceMetrics:
+    """verify_service_* instruments (libs/metrics.py), wired into the
+    node registry alongside the scheduler's."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry if registry is not None else Registry()
+        self.frames = r.counter(
+            SUBSYSTEM, "frames", "Frames received, by type."
+        )
+        self.lanes = r.counter(
+            SUBSYSTEM, "lanes", "Request lanes received, by wire kind."
+        )
+        self.bytes_rx = r.counter(
+            SUBSYSTEM, "bytes_rx", "Payload bytes received."
+        )
+        self.bytes_tx = r.counter(
+            SUBSYSTEM, "bytes_tx", "Frame bytes sent."
+        )
+        self.bytes_per_lane = r.gauge(
+            SUBSYSTEM, "bytes_per_lane",
+            "Socket payload bytes per lane of the last request frame, by "
+            "wire kind — the zero-double-marshalling proof "
+            "(compact ≤ 128, indexed ≤ 100).",
+        )
+        self.disconnects = r.counter(
+            SUBSYSTEM, "disconnects",
+            "Connections that died with requests in flight, by tenant.",
+        )
+        self.errors = r.counter(
+            SUBSYSTEM, "errors", "Typed error frames sent, by code."
+        )
+        self.stale_drops = r.counter(
+            SUBSYSTEM, "stale_drops",
+            "Indexed frames refused for a stale keystore generation.",
+        )
+        self.pending = r.gauge(
+            SUBSYSTEM, "pending",
+            "Requests accepted from clients and not yet answered.",
+        )
+
+    @classmethod
+    def nop(cls) -> "ServiceMetrics":
+        return cls(None)
+
+
+# -- server ------------------------------------------------------------------
+
+
+class _Conn:
+    __slots__ = ("sock", "tenant", "alive", "pending", "outq", "cv",
+                 "reader", "writer", "mtx")
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.tenant: Optional[str] = None
+        self.alive = True
+        # req_id -> n_lanes, for the leak check on disconnect/stop
+        self.pending: Dict[int, int] = {}
+        self.outq: "collections.deque[bytes]" = collections.deque()
+        self.mtx = threading.Lock()
+        self.cv = threading.Condition(self.mtx)
+        self.reader: Optional[threading.Thread] = None
+        self.writer: Optional[threading.Thread] = None
+
+
+class VerifyService(BaseService):
+    """The server half: accept loop + per-connection reader/writer
+    threads feeding one VerifyScheduler. Frames from N connections merge
+    into the scheduler's coalesced flushes (deadline / lane-budget /
+    QoS semantics preserved — ``submit_rows`` runs the same admission
+    ladder as ``submit``), and per-request verdicts fan back out per
+    connection via future done-callbacks, so the flush worker never
+    blocks on a slow client socket.
+
+    ``coalesce=False`` dispatches each frame isolated in its reader
+    thread — the bench head-to-head baseline proving what cross-client
+    coalescing buys."""
+
+    def __init__(
+        self,
+        scheduler,
+        address: str = DEFAULT_ADDRESS,
+        *,
+        coalesce: bool = True,
+        max_lanes: Optional[int] = None,
+        row_verifier: Optional[Callable] = None,
+        metrics: Optional[ServiceMetrics] = None,
+        telemetry=None,
+        logger: Optional[Logger] = None,
+    ):
+        super().__init__("VerifyService", logger)
+        self._sched = scheduler
+        self._family, self._target = parse_address(address)
+        self._coalesce = bool(coalesce)
+        if max_lanes is None:
+            max_lanes = getattr(scheduler, "_lane_budget", None) or 8192
+        self._max_lanes = max(1, int(max_lanes))
+        self._max_frame = max_frame_bytes(self._max_lanes)
+        self._row_verifier = row_verifier
+        self.metrics = metrics if metrics is not None else ServiceMetrics.nop()
+        self._telemetry = telemetry
+        self._listener: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._cmtx = threading.Lock()
+        self._bound: Optional[Any] = None
+        # snapshot source-of-truth counters (the instruments may be nop)
+        self._smtx = threading.Lock()
+        self._frames: Dict[str, int] = {}
+        self._lanes: Dict[str, int] = {}
+        self._payload_bytes: Dict[str, int] = {}
+        self._errors: Dict[str, int] = {}
+        self._disconnects: Dict[str, int] = {}
+        self._stale_drops = 0
+        self._inline_dispatches = 0
+        if telemetry is not None:
+            telemetry.register_source("service", self.snapshot)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def on_start(self) -> None:
+        if self._family == "unix":
+            path = self._target
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.bind(path)
+            self._bound = path
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind(self._target)
+            self._bound = sock.getsockname()
+        sock.listen(128)
+        self._listener = sock
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="verify-service"
+        )
+        self._accept_thread.start()
+        self.logger.info(
+            "verify service listening", address=self.address(),
+            max_lanes=self._max_lanes, coalesce=self._coalesce,
+        )
+
+    def on_stop(self) -> None:
+        listener = self._listener
+        if listener is not None:
+            # shutdown() first: close() alone does not wake a thread
+            # blocked in accept() on the same fd, and the join below
+            # would eat its full timeout on every daemon stop
+            try:
+                listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                listener.close()
+            except OSError:
+                pass
+        t = self._accept_thread
+        if t is not None:
+            t.join(timeout=5.0)
+        with self._cmtx:
+            conns = list(self._conns)
+        for conn in conns:
+            self._teardown(conn)
+        for conn in conns:
+            for t in (conn.reader, conn.writer):
+                if t is not None and t is not threading.current_thread():
+                    t.join(timeout=5.0)
+        if self._family == "unix":
+            try:
+                os.unlink(self._target)
+            except OSError:
+                pass
+
+    def address(self) -> str:
+        """The actual bound address (tcp port 0 resolves here)."""
+        if self._family == "unix":
+            return f"unix://{self._bound or self._target}"
+        host, port = self._bound or self._target
+        return f"tcp://{host}:{port}"
+
+    def pending_requests(self) -> int:
+        """Accepted-but-unanswered requests across live connections —
+        the never-leak-past-stop invariant's observable (0 after
+        stop())."""
+        with self._cmtx:
+            conns = list(self._conns)
+        total = 0
+        for conn in conns:
+            with conn.mtx:
+                total += len(conn.pending)
+        return total
+
+    # -- accept + per-connection threads -----------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._quit.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed by stop()
+            conn = _Conn(sock)
+            with self._cmtx:
+                self._conns.add(conn)
+            self._enqueue(conn, encode_frame(
+                FT_HELLO, n_lanes=self._max_lanes,
+                generation=self._generation(),
+            ))
+            conn.writer = threading.Thread(
+                target=self._write_loop, args=(conn,), daemon=True,
+                name="verify-service-w",
+            )
+            conn.reader = threading.Thread(
+                target=self._read_loop, args=(conn,), daemon=True,
+                name="verify-service-r",
+            )
+            conn.writer.start()
+            conn.reader.start()
+
+    def _read_loop(self, conn: _Conn) -> None:
+        try:
+            while conn.alive and not self._quit.is_set():
+                head = _recv_exact(conn.sock, _LEN.size)
+                if head is None:
+                    break
+                (length,) = _LEN.unpack(head)
+                if length > self._max_frame:
+                    # typed refusal, then discard the body: the stream
+                    # stays framed, the connection survives
+                    self._send_err(conn, 0, ERR_OVERSIZE, (
+                        f"frame of {length} bytes exceeds the "
+                        f"{self._max_frame}-byte bound"
+                    ))
+                    if not _drain(conn.sock, length):
+                        break
+                    continue
+                if length < HEADER_BYTES:
+                    # the stream cannot be re-framed after a short
+                    # header — refuse and hang up
+                    self._send_err(conn, 0, ERR_MALFORMED, (
+                        f"frame of {length} bytes is shorter than the "
+                        f"{HEADER_BYTES}-byte header"
+                    ))
+                    break
+                buf = _recv_exact(conn.sock, length)
+                if buf is None:
+                    break  # truncated mid-frame: disconnect path
+                with self._smtx:
+                    self._payload_bytes["rx"] = (
+                        self._payload_bytes.get("rx", 0) + length
+                    )
+                self.metrics.bytes_rx.add(length)
+                try:
+                    frame = decode_frame(buf)
+                except FrameError as fe:
+                    # bad magic / future version: framing is untrusted
+                    self._send_err(conn, 0, fe.code, str(fe))
+                    break
+                try:
+                    self._handle(conn, frame)
+                except FrameError as fe:
+                    # per-request refusal (bad class, stale generation,
+                    # unknown valset, size mismatch): typed error, the
+                    # connection and its other requests survive
+                    self._send_err(conn, frame.req_id, fe.code, str(fe))
+        except Exception as exc:  # noqa: BLE001 - one conn never kills accept
+            self.logger.error(
+                "verify service connection failed", err=repr(exc),
+                tenant=conn.tenant,
+            )
+        finally:
+            self._teardown(conn, drain=True)
+
+    def _write_loop(self, conn: _Conn) -> None:
+        while True:
+            with conn.cv:
+                while conn.alive and not conn.outq:
+                    conn.cv.wait(0.5)
+                if not conn.alive and not conn.outq:
+                    return
+                data = conn.outq.popleft()
+            try:
+                conn.sock.sendall(data)
+            except OSError:
+                self._teardown(conn)
+                return
+            self.metrics.bytes_tx.add(len(data))
+
+    def _teardown(self, conn: _Conn, drain: bool = False) -> None:
+        """Idempotent connection teardown. Pending futures stay with the
+        scheduler (they complete inside their coalesced flush — other
+        tenants' riders are untouched); THIS tenant's in-flight requests
+        are metered as disconnected and their responses dropped.
+
+        ``drain`` (the reader's hangup path only) gives the writer a
+        bounded window to flush queued frames first — a header-level
+        refusal enqueues its typed error right before the reader breaks,
+        and closing the socket immediately would race that error frame
+        away from the very client it refuses. The writer's own failure
+        path must NOT drain: its queue can never send again."""
+        if drain:
+            deadline = time.monotonic() + 0.5
+            while time.monotonic() < deadline:
+                with conn.mtx:
+                    if not conn.outq or not conn.alive:
+                        break
+                time.sleep(0.005)
+        with conn.mtx:
+            if not conn.alive:
+                return
+            conn.alive = False
+            n_pending = len(conn.pending)
+            conn.pending.clear()
+            conn.cv.notify_all()
+        tenant = conn.tenant or "unknown"
+        if n_pending:
+            with self._smtx:
+                self._disconnects[tenant] = (
+                    self._disconnects.get(tenant, 0) + n_pending
+                )
+            self.metrics.disconnects.with_labels(tenant=tenant).add(
+                n_pending
+            )
+            if self._telemetry is not None:
+                self._telemetry.note_disconnect(tenant, n_pending)
+            self.logger.info(
+                "client disconnected mid-flight", tenant=tenant,
+                pending=n_pending,
+            )
+        with self._cmtx:
+            self._conns.discard(conn)
+        # shutdown() before close(): the reader may be blocked in
+        # recv() on this fd, and close() alone does not wake it — the
+        # stop path would then burn its full join timeout per conn
+        try:
+            conn.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        self.metrics.pending.set(self.pending_requests())
+
+    # -- frame handling ----------------------------------------------------
+
+    def _handle(self, conn: _Conn, frame: Frame) -> None:
+        name = _FT_NAMES.get(frame.ftype)
+        if name is None:
+            raise FrameError(ERR_MALFORMED, f"unknown frame type {frame.ftype}")
+        with self._smtx:
+            self._frames[name] = self._frames.get(name, 0) + 1
+        self.metrics.frames.with_labels(type=name).add()
+        if frame.ftype == FT_CLIENT_HELLO:
+            conn.tenant = frame.payload.decode(
+                "utf-8", errors="replace"
+            ) or None
+            return
+        if frame.ftype == FT_REGISTER:
+            self._handle_register(conn, frame)
+            return
+        if frame.ftype == FT_REQ:
+            self._handle_req(conn, frame)
+            return
+        # HELLO/RESP/ERR/REGISTERED are server-to-client only
+        raise FrameError(
+            ERR_MALFORMED, f"unexpected client frame type {name}"
+        )
+
+    def _handle_register(self, conn: _Conn, frame: Frame) -> None:
+        payload = frame.payload
+        if not payload or len(payload) % 32:
+            raise FrameError(
+                ERR_MALFORMED,
+                f"register payload of {len(payload)} bytes is not a "
+                f"multiple of 32",
+            )
+        n = len(payload) // 32
+        if n > MAX_REGISTER_KEYS:
+            raise FrameError(
+                ERR_OVERSIZE, f"{n} keys exceeds the register bound "
+                f"{MAX_REGISTER_KEYS}",
+            )
+        valset_id = hashlib.sha256(payload).digest()[:VALSET_ID_BYTES]
+        keys = [payload[i * 32:(i + 1) * 32] for i in range(n)]
+        store = self._keystore()
+        store.register(valset_id, keys)
+        self._enqueue(conn, encode_frame(
+            FT_REGISTERED, req_id=frame.req_id, n_lanes=n,
+            generation=store.generation(), valset_id=valset_id,
+        ))
+
+    def _handle_req(self, conn: _Conn, frame: Frame) -> None:
+        n = frame.n_lanes
+        if n < 1 or n > self._max_lanes:
+            raise FrameError(
+                ERR_MALFORMED,
+                f"{n} lanes outside the [1, {self._max_lanes}] bound",
+            )
+        expect = req_payload_bytes(frame.kind, n)
+        if len(frame.payload) != expect:
+            raise FrameError(
+                ERR_MALFORMED,
+                f"{_KIND_NAMES[frame.kind]} payload of "
+                f"{len(frame.payload)} bytes for {n} lanes "
+                f"(expected {expect})",
+            )
+        try:
+            qname = qoslib.class_name(frame.qclass)
+        except ValueError as exc:
+            raise FrameError(ERR_BAD_CLASS, str(exc)) from None
+        kind_name = _KIND_NAMES[frame.kind]
+        if frame.kind == KIND_COMPACT:
+            rows = np.frombuffer(frame.payload, np.uint8).reshape(
+                COMPACT_ROW_BYTES, n
+            )
+            payload = RowPayload(KIND_COMPACT, rows)
+        else:
+            store = self._keystore()
+            entry = store.entry_for(frame.valset_id, frame.generation)
+            if entry is None:
+                if frame.generation != store.generation():
+                    with self._smtx:
+                        self._stale_drops += 1
+                    self.metrics.stale_drops.add()
+                    raise FrameError(
+                        ERR_STALE_GENERATION,
+                        f"client generation {frame.generation} != "
+                        f"{store.generation()}",
+                    )
+                raise FrameError(
+                    ERR_UNKNOWN_VALSET,
+                    f"valset {frame.valset_id.hex()} is not registered",
+                )
+            rsh = np.frombuffer(
+                frame.payload[: RSH_ROW_BYTES * n], np.uint8
+            ).reshape(RSH_ROW_BYTES, n)
+            idx = np.frombuffer(frame.payload[RSH_ROW_BYTES * n:], "<i4")
+            if idx.size and (idx.min() < 0 or idx.max() >= entry.n):
+                raise FrameError(
+                    ERR_MALFORMED,
+                    f"table index outside [0, {entry.n})",
+                )
+            payload = RowPayload(
+                KIND_INDEXED, rsh, idx, entry, frame.valset_id
+            )
+        with self._smtx:
+            self._lanes[kind_name] = self._lanes.get(kind_name, 0) + n
+            self._payload_bytes[kind_name] = (
+                self._payload_bytes.get(kind_name, 0) + len(frame.payload)
+            )
+        self.metrics.lanes.with_labels(kind=kind_name).add(n)
+        self.metrics.bytes_per_lane.with_labels(kind=kind_name).set(
+            len(frame.payload) / n
+        )
+        if not self._coalesce:
+            self._dispatch_isolated(conn, frame, payload)
+            return
+        fut = self._sched.submit_rows(
+            payload, tenant=conn.tenant, qclass=qname,
+        )
+        with conn.mtx:
+            if not conn.alive:
+                return  # raced teardown: disconnect already metered
+            conn.pending[frame.req_id] = n
+        self.metrics.pending.set(self.pending_requests())
+        fut.add_done_callback(
+            lambda f, c=conn, fr=frame: self._complete(c, fr, f)
+        )
+
+    def _dispatch_isolated(
+        self, conn: _Conn, frame: Frame, payload: RowPayload
+    ) -> None:
+        """coalesce=False: verify this frame alone, in this reader
+        thread — the per-client-isolated baseline the bench stage
+        measures the coalescing gain against."""
+        verifier = self._row_verifier
+        if verifier is None:
+            verifier = self._row_verifier = resolve_row_verifier(
+                getattr(self._sched, "spec", None)
+            )
+        rows, valid = payload.as_compact()
+        mask = np.asarray(verifier(rows), dtype=bool)[: payload.n] & valid
+        with self._smtx:
+            self._inline_dispatches += 1
+        self._respond(conn, frame.req_id, ST_OK, mask)
+
+    def _complete(self, conn: _Conn, frame: Frame, fut: VerifyFuture
+                  ) -> None:
+        """Done-callback on the scheduler's worker (or an inline-dispatch
+        submitter): encode the verdict and hand it to the connection's
+        writer — never block the flush loop on a client socket."""
+        with conn.mtx:
+            known = conn.pending.pop(frame.req_id, None)
+        self.metrics.pending.set(self.pending_requests())
+        if known is None or not conn.alive:
+            return  # disconnected mid-flight: metered in _teardown
+        try:
+            _, sub = fut.result(timeout=0)
+            mask = np.asarray(sub, dtype=bool)
+            status = ST_REJECTED if fut.rejected else ST_OK
+        except Exception:  # noqa: BLE001 - failed flush = rejected verdict
+            mask = np.zeros(frame.n_lanes, dtype=bool)
+            status = ST_REJECTED
+        self._respond(conn, frame.req_id, status, mask)
+
+    def _respond(self, conn: _Conn, req_id: int, status: int,
+                 mask: np.ndarray) -> None:
+        payload = bytes([status]) + np.packbits(
+            mask, bitorder="little"
+        ).tobytes()
+        self._enqueue(conn, encode_frame(
+            FT_RESP, req_id=req_id, n_lanes=int(mask.size),
+            generation=self._generation(), payload=payload,
+        ))
+
+    def _send_err(self, conn: _Conn, req_id: int, code: int, msg: str
+                  ) -> None:
+        name = ERR_NAMES.get(code, str(code))
+        with self._smtx:
+            self._errors[name] = self._errors.get(name, 0) + 1
+        self.metrics.errors.with_labels(code=name).add()
+        self._enqueue(conn, encode_frame(
+            FT_ERR, req_id=req_id, generation=self._generation(),
+            payload=encode_error(code, msg),
+        ))
+
+    def _enqueue(self, conn: _Conn, data: bytes) -> None:
+        with conn.cv:
+            if not conn.alive:
+                return
+            conn.outq.append(data)
+            conn.cv.notify_all()
+
+    # -- keystore (generation handshake) -----------------------------------
+
+    def _keystore(self):
+        from cometbft_tpu.crypto.tpu import keystore
+
+        return keystore.default_store()
+
+    def _generation(self) -> int:
+        # same sys.modules guard as the scheduler's decision inputs: a
+        # compact-only CPU service never imports the TPU package just to
+        # stamp generation 0 on its frames
+        ks = sys.modules.get("cometbft_tpu.crypto.tpu.keystore")
+        if ks is None:
+            return 0
+        try:
+            return ks.default_store().generation()
+        except Exception:  # noqa: BLE001 - advisory header field
+            return 0
+
+    # -- observability -----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The "service" TelemetryHub source: connection/tenant counts,
+        frame/lane/byte counters, and the bytes-per-lane proof."""
+        with self._cmtx:
+            conns = list(self._conns)
+        tenants = sorted({c.tenant for c in conns if c.tenant})
+        with self._smtx:
+            lanes = dict(self._lanes)
+            payload_bytes = dict(self._payload_bytes)
+            out = {
+                "address": self.address() if self._bound else None,
+                "coalesce": self._coalesce,
+                "max_lanes": self._max_lanes,
+                "connections": len(conns),
+                "tenants": tenants,
+                "frames": dict(self._frames),
+                "lanes": lanes,
+                "errors": dict(self._errors),
+                "disconnects": dict(self._disconnects),
+                "stale_drops": self._stale_drops,
+                "inline_dispatches": self._inline_dispatches,
+            }
+        out["pending"] = self.pending_requests()
+        out["bytes_per_lane"] = {
+            kind: payload_bytes[kind] / lanes[kind]
+            for kind in ("compact", "indexed")
+            if lanes.get(kind)
+        }
+        return out
+
+
+# -- client ------------------------------------------------------------------
+
+
+class _ClientValset:
+    __slots__ = ("valset_id", "index", "pub_keys", "registered_gen")
+
+    def __init__(self, valset_id, index, pub_keys, registered_gen):
+        self.valset_id = valset_id
+        self.index = index
+        self.pub_keys = pub_keys
+        self.registered_gen = registered_gen
+
+
+class _Agg:
+    """One submit()'s state across its frame parts (requests larger than
+    the server's max_lanes split into several frames). Any part failing
+    — rejected, typed error, timeout, disconnect — flips the whole
+    request to the local CPU ground truth exactly once."""
+
+    __slots__ = ("items", "future", "mask", "remaining", "failed",
+                 "req_ids", "mtx")
+
+    def __init__(self, items, future, n_parts):
+        self.items = items
+        self.future = future
+        self.mask = np.zeros(len(items), dtype=bool)
+        self.remaining = n_parts
+        self.failed = False
+        self.req_ids: List[int] = []
+        self.mtx = threading.Lock()
+
+
+class _PendingPart:
+    __slots__ = ("agg", "base", "sent_idx", "deadline")
+
+    def __init__(self, agg, base, sent_idx, deadline):
+        self.agg = agg
+        self.base = base
+        self.sent_idx = sent_idx
+        self.deadline = deadline
+
+
+class RemoteVerifier:
+    """Client half: duck-types the crypto Backend contract the way the
+    scheduler does (``spec`` + ``submit(items, subsystem=, height=) ->
+    VerifyFuture``), so ``new_batch_verifier`` adapts it for every call
+    site unchanged. Packs each request ONCE into compact (or indexed,
+    when a registered valset covers it at the server's current keystore
+    generation) wire rows, demuxes verdicts by req_id on a receiver
+    thread, and falls back to the LOCAL CPU ground truth — with the
+    verdict reason kept distinct — on disconnect, timeout, rejection, or
+    stale generation. No caller ever hangs on a dead daemon."""
+
+    def __init__(
+        self,
+        address: str,
+        tenant: Optional[str] = None,
+        spec=None,
+        timeout_ms: Optional[int] = None,
+        connect_timeout_s: float = 1.0,
+        retry_s: float = 1.0,
+        logger: Optional[Logger] = None,
+    ):
+        if isinstance(spec, BackendSpec):
+            self.spec = spec
+        else:
+            self.spec = BackendSpec(name=spec) if spec else BackendSpec(
+                name="cpu"
+            )
+        self._address = address
+        self._family, self._target = parse_address(address)
+        self._tenant = tenant or "remote"
+        self._timeout_s = service_timeout_default(timeout_ms) / 1e3
+        self._connect_timeout_s = connect_timeout_s
+        self._retry_s = retry_s
+        self.logger = logger
+        self._mtx = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._recv_thread: Optional[threading.Thread] = None
+        self._pending: Dict[int, _PendingPart] = {}
+        self._reg_waiters: Dict[int, list] = {}
+        self._req_id = 0
+        self._server_gen: Optional[int] = None
+        self._max_lanes = 8192
+        self._valsets: Dict[bytes, _ClientValset] = {}
+        self._stats: Dict[str, int] = {}
+        self._next_retry = 0.0
+        self._closed = False
+
+    # -- Backend contract --------------------------------------------------
+
+    def submit(
+        self,
+        items: Sequence[Item],
+        subsystem: Optional[str] = None,
+        height: Optional[int] = None,
+    ) -> VerifyFuture:
+        triples = [(pk, bytes(m), bytes(s)) for pk, m, s in items]
+        fut = VerifyFuture()
+        if not triples:
+            fut._set((True, []))
+            return fut
+        agg = _Agg(triples, fut, 0)
+        try:
+            self._submit_remote(agg, subsystem)
+        except Exception:  # noqa: BLE001 - daemon down: local ground truth
+            self._fail_agg(agg, "disconnected")
+        return fut
+
+    def close(self) -> None:
+        with self._mtx:
+            self._closed = True
+            sock = self._sock
+            self._sock = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._drop_pending("disconnected")
+
+    def kill_connection(self) -> None:
+        """Chaos hook: sever the transport abruptly (no close frame, no
+        draining) as if the client process died mid-flight. In-flight
+        futures resolve via the local-CPU fallback with
+        ``reason="disconnected"``; the next submit reconnects."""
+        with self._mtx:
+            sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    # -- request path ------------------------------------------------------
+
+    def _submit_remote(self, agg: _Agg, subsystem: Optional[str]) -> None:
+        self._ensure_connected()
+        qcode = qoslib.class_code(
+            qoslib.SUBSYSTEM_ALIASES.get(subsystem, subsystem)
+        )
+        valset = self._covering_valset(agg.items)
+        deadline = time.monotonic() + self._timeout_s
+        parts: List[Tuple[bytes, _PendingPart]] = []
+        base = 0
+        step = max(1, self._max_lanes)
+        while base < len(agg.items):
+            part_items = agg.items[base:base + step]
+            if valset is not None:
+                rsh, idx, valid = pack_items_indexed(
+                    part_items, valset.index
+                )
+                sent = np.nonzero(valid)[0]
+                payload = (
+                    np.ascontiguousarray(rsh[:, sent]).tobytes()
+                    + np.ascontiguousarray(idx[sent]).tobytes()
+                )
+                kind = KIND_INDEXED
+            else:
+                wire, valid = pack_items_compact(part_items)
+                sent = np.nonzero(valid)[0]
+                # all-valid is the common case and ships the packed
+                # buffer as-is — pack once, send those bytes
+                if sent.size == len(part_items):
+                    payload = wire.tobytes()
+                else:
+                    payload = np.ascontiguousarray(
+                        wire[:, sent]
+                    ).tobytes()
+                kind = KIND_COMPACT
+            if sent.size:
+                with self._mtx:
+                    self._req_id += 1
+                    rid = self._req_id
+                    pend = _PendingPart(agg, base, sent, deadline)
+                    self._pending[rid] = pend
+                agg.req_ids.append(rid)
+                agg.remaining += 1
+                frame = encode_frame(
+                    FT_REQ, qclass=qcode, kind=kind, req_id=rid,
+                    n_lanes=int(sent.size),
+                    generation=(valset.registered_gen if valset else 0),
+                    valset_id=(valset.valset_id if valset else b""),
+                    payload=payload,
+                )
+                parts.append((frame, pend))
+            base += step
+        if not parts:
+            # every lane was locally known-invalid: exact verdict, no
+            # frame, no fallback
+            agg.future._set((False, [False] * len(agg.items)))
+            return
+        for frame, _ in parts:
+            try:
+                self._send(frame)
+            except OSError as exc:
+                self._on_disconnect()
+                raise ConnectionError(str(exc)) from exc
+
+    def _covering_valset(self, items) -> Optional[_ClientValset]:
+        """A registered valset covering every pubkey of the request, at
+        the server's CURRENT generation — re-registering first when the
+        cached one went stale (the resync half of the handshake). None
+        means ship full 128 B compact rows."""
+        with self._mtx:
+            valsets = list(self._valsets.values())
+            server_gen = self._server_gen
+        for vs in valsets:
+            try:
+                covered = all(
+                    _pk_bytes(pk) in vs.index for pk, _, _ in items
+                )
+            except Exception:  # noqa: BLE001 - unhashable key: compact
+                continue
+            if not covered:
+                continue
+            if vs.registered_gen == server_gen and server_gen is not None:
+                return vs
+            try:
+                self._register(vs.pub_keys)
+                return self._valsets.get(vs.valset_id)
+            except Exception:  # noqa: BLE001 - resync failed: compact
+                self._count("resync_failed")
+                return None
+        return None
+
+    def register_valset(self, pub_keys: Sequence[bytes]) -> bytes:
+        """Register a valset with the server's keystore so later
+        submits covered by it ship 100 B indexed frames. Returns the
+        16-byte valset id. Raises on a dead daemon (callers treat
+        registration as an optimization)."""
+        self._ensure_connected()
+        return self._register(pub_keys)
+
+    def _register(self, pub_keys: Sequence[bytes]) -> bytes:
+        keys = [_pk_bytes(pk) for pk in pub_keys]
+        if not keys or any(len(k) != 32 for k in keys):
+            raise ValueError("register_valset needs 32-byte ed25519 keys")
+        if len(keys) > MAX_REGISTER_KEYS:
+            raise ValueError(
+                f"{len(keys)} keys exceeds the register bound "
+                f"{MAX_REGISTER_KEYS}"
+            )
+        payload = b"".join(keys)
+        valset_id = hashlib.sha256(payload).digest()[:VALSET_ID_BYTES]
+        waiter = [threading.Event(), None]
+        with self._mtx:
+            self._req_id += 1
+            rid = self._req_id
+            self._reg_waiters[rid] = waiter
+        try:
+            self._send(encode_frame(
+                FT_REGISTER, req_id=rid, n_lanes=len(keys),
+                payload=payload,
+            ))
+            if not waiter[0].wait(self._timeout_s):
+                raise TimeoutError("valset registration timed out")
+        finally:
+            with self._mtx:
+                self._reg_waiters.pop(rid, None)
+        gen = waiter[1]
+        index = {k: i for i, k in enumerate(keys)}
+        with self._mtx:
+            self._server_gen = gen
+            self._valsets[valset_id] = _ClientValset(
+                valset_id, index, list(keys), gen
+            )
+        self._count("registrations")
+        return valset_id
+
+    # -- connection --------------------------------------------------------
+
+    def _ensure_connected(self) -> None:
+        with self._mtx:
+            if self._closed:
+                raise ConnectionError("remote verifier closed")
+            if self._sock is not None:
+                return
+            if time.monotonic() < self._next_retry:
+                raise ConnectionError("verify service unreachable (backoff)")
+        if self._family == "unix":
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(self._connect_timeout_s)
+        try:
+            sock.connect(self._target)
+        except OSError:
+            with self._mtx:
+                self._next_retry = time.monotonic() + self._retry_s
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise
+        sock.settimeout(0.2)
+        with self._mtx:
+            self._sock = sock
+            self._recv_thread = threading.Thread(
+                target=self._recv_loop, args=(sock,), daemon=True,
+                name="verify-remote",
+            )
+            self._recv_thread.start()
+        self._send(encode_frame(
+            FT_CLIENT_HELLO, payload=self._tenant.encode("utf-8"),
+        ))
+        self._count("connects")
+
+    def _send(self, data: bytes) -> None:
+        with self._mtx:
+            sock = self._sock
+        if sock is None:
+            raise ConnectionError("verify service not connected")
+        sock.sendall(data)
+
+    def _recv_loop(self, sock: socket.socket) -> None:
+        def tick() -> bool:
+            self._expire_pending()
+            with self._mtx:
+                return self._sock is sock and not self._closed
+        while True:
+            head = _recv_exact(sock, _LEN.size, tick=tick)
+            if head is None:
+                break
+            (length,) = _LEN.unpack(head)
+            if length < HEADER_BYTES or length > max_frame_bytes(
+                self._max_lanes
+            ):
+                break
+            buf = _recv_exact(sock, length, tick=tick)
+            if buf is None:
+                break
+            try:
+                frame = decode_frame(buf)
+                self._on_frame(frame)
+            except FrameError:
+                break
+        with self._mtx:
+            stale = self._sock is not sock
+        if not stale:
+            self._on_disconnect()
+
+    # -- response demux ----------------------------------------------------
+
+    def _on_frame(self, frame: Frame) -> None:
+        if frame.ftype == FT_HELLO:
+            with self._mtx:
+                self._server_gen = frame.generation
+                if frame.n_lanes:
+                    self._max_lanes = frame.n_lanes
+            return
+        if frame.ftype == FT_REGISTERED:
+            with self._mtx:
+                self._server_gen = frame.generation
+                waiter = self._reg_waiters.get(frame.req_id)
+            if waiter is not None:
+                waiter[1] = frame.generation
+                waiter[0].set()
+            return
+        if frame.ftype == FT_RESP:
+            with self._mtx:
+                self._server_gen = frame.generation
+                pend = self._pending.pop(frame.req_id, None)
+            if pend is None:
+                return
+            status = frame.payload[0] if frame.payload else ST_REJECTED
+            if status != ST_OK:
+                # a server-side ADMISSION verdict (QoS shed/drop/quota),
+                # not a transport failure: propagate the rejection like
+                # the local scheduler would. CPU-fallback-verifying here
+                # would defeat the shed — the overloaded server's load
+                # would bounce to every client's CPU instead
+                self._reject_agg(pend.agg)
+                return
+            bits = np.unpackbits(
+                np.frombuffer(frame.payload[1:], np.uint8),
+                bitorder="little",
+            )[: frame.n_lanes].astype(bool)
+            self._complete_part(pend, bits)
+            return
+        if frame.ftype == FT_ERR:
+            code, msg = decode_error(frame.payload)
+            with self._mtx:
+                pend = self._pending.pop(frame.req_id, None)
+                if code == ERR_STALE_GENERATION:
+                    self._server_gen = frame.generation
+            if code == ERR_STALE_GENERATION:
+                # every cached valset registered under an older
+                # generation is now suspect; the next submit
+                # re-registers (resync) before going indexed again
+                self._count("stale")
+                if pend is not None:
+                    self._fail_agg(pend.agg, "stale")
+                return
+            if code == ERR_UNKNOWN_VALSET and pend is not None:
+                with self._mtx:
+                    for vid in list(self._valsets):
+                        self._valsets.pop(vid, None)
+            self._count(f"err_{ERR_NAMES.get(code, code)}")
+            if pend is not None:
+                self._fail_agg(pend.agg, "error")
+
+    def _complete_part(self, pend: _PendingPart, bits: np.ndarray) -> None:
+        agg = pend.agg
+        with agg.mtx:
+            if agg.failed or agg.future.done():
+                return
+            if bits.size >= pend.sent_idx.size:
+                agg.mask[pend.base + pend.sent_idx] = (
+                    bits[: pend.sent_idx.size]
+                )
+            agg.remaining -= 1
+            done = agg.remaining == 0
+        if done:
+            mask = [bool(b) for b in agg.mask]
+            agg.future._set((all(mask), mask))
+            self._count("remote_ok")
+
+    def _reject_agg(self, agg: _Agg) -> None:
+        """Mirror the local scheduler's shed/drop verdict: rejected=True,
+        not-ok, all-False — callers already handle rejected futures
+        (retry later / treat as unverified), and the admission layer's
+        load-shedding decision survives the network boundary."""
+        with agg.mtx:
+            if agg.failed:
+                return
+            agg.failed = True
+        with self._mtx:
+            for rid in agg.req_ids:
+                self._pending.pop(rid, None)
+        self._count("rejected")
+        agg.future.rejected = True
+        agg.future.reason = "rejected"
+        agg.future._set((False, [False] * len(agg.mask)))
+
+    def _fail_agg(self, agg: _Agg, reason: str) -> None:
+        """Local-CPU fallback for the WHOLE request, exactly once; the
+        reason stays distinct on the future (``disconnected`` for a dead
+        daemon is the contract the node's health checks key on)."""
+        with agg.mtx:
+            if agg.failed:
+                return
+            agg.failed = True
+        with self._mtx:
+            for rid in agg.req_ids:
+                self._pending.pop(rid, None)
+        self._count(reason)
+        bv = CPUBatchVerifier()
+        for pk, m, s in agg.items:
+            bv.add(pk, m, s)
+        _, mask = bv.verify()
+        agg.future.reason = reason
+        agg.future._set((all(mask), mask))
+
+    def _expire_pending(self) -> None:
+        now = time.monotonic()
+        with self._mtx:
+            expired = [
+                p for p in self._pending.values() if now > p.deadline
+            ]
+        for pend in expired:
+            self._fail_agg(pend.agg, "timeout")
+
+    def _on_disconnect(self) -> None:
+        with self._mtx:
+            sock = self._sock
+            self._sock = None
+            self._next_retry = time.monotonic() + self._retry_s
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._drop_pending("disconnected")
+
+    def _drop_pending(self, reason: str) -> None:
+        with self._mtx:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        seen = set()
+        for pend in pending:
+            if id(pend.agg) in seen:
+                continue
+            seen.add(id(pend.agg))
+            self._fail_agg(pend.agg, reason)
+
+    def _count(self, key: str) -> None:
+        with self._mtx:
+            self._stats[key] = self._stats.get(key, 0) + 1
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        with self._mtx:
+            return dict(self._stats)
+
+    def snapshot(self) -> dict:
+        """The client-side "service" TelemetryHub source a node
+        registers when it points its backends at a shared daemon."""
+        with self._mtx:
+            return {
+                "address": self._address,
+                "tenant": self._tenant,
+                "connected": self._sock is not None,
+                "server_generation": self._server_gen,
+                "max_lanes": self._max_lanes,
+                "valsets": len(self._valsets),
+                "pending": len(self._pending),
+                "stats": dict(self._stats),
+            }

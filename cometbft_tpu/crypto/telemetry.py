@@ -139,6 +139,11 @@ class Metrics:
             "service), by submitting subsystem.",
             buckets=MICRO_BUCKETS,
         )
+        self.red_disconnects = r.counter(
+            SUBSYSTEM, "red_disconnects",
+            "Verify-service requests whose client connection died before "
+            "the verdict could be delivered, by tenant.",
+        )
         self.slo_target_ms = r.gauge(
             SLO_SUBSYSTEM, "target_ms",
             "Configured commit-verify latency target "
@@ -308,6 +313,10 @@ class TelemetryHub:
         # subsystem -> [requests, errors, sigs, last_height,
         #               deque[(t, latency_s)]]
         self._subsystems: Dict[str, List[Any]] = {}
+        # tenant -> requests abandoned by a mid-flight disconnect; kept
+        # beside the positional RED recs, not inside them, so existing
+        # rec indexing stays untouched
+        self._disconnects: Dict[str, int] = {}
         self._sources: Dict[str, Callable[[], Any]] = {}
         self._capacity_fn: Optional[Callable[[], float]] = None
         self._burn_watchers: List[Callable[[float], None]] = []
@@ -347,6 +356,24 @@ class TelemetryHub:
             m.red_errors.with_labels(subsystem=name).add()
         m.red_sigs.with_labels(subsystem=name).add(int(n_sigs))
         m.red_latency_seconds.with_labels(subsystem=name).observe(latency_s)
+
+    def note_disconnect(self, tenant: Optional[str], n: int = 1) -> None:
+        """``n`` verify-service requests orphaned by ``tenant``'s
+        connection dying mid-flight. RED-metered per tenant (a flapping
+        client must look flappy in /debug/verify) and surfaced in
+        ``subsystems()`` beside the tenant's request/error rates."""
+        name = tenant or UNTAGGED
+        with self._mtx:
+            self._disconnects[name] = (
+                self._disconnects.get(name, 0) + int(n)
+            )
+            if name not in self._subsystems:
+                # make the tenant visible in the RED view even if every
+                # one of its requests died before a verdict was metered
+                self._subsystems[name] = [
+                    0, 0, 0, None, deque(maxlen=_MAX_SAMPLES)
+                ]
+        self.metrics.red_disconnects.with_labels(tenant=name).add(int(n))
 
     def note_device_busy(
         self, device: str, t0: float, t1: float, n_sigs: int
@@ -493,6 +520,7 @@ class TelemetryHub:
                 name: (rec[0], rec[1], rec[2], rec[3], list(rec[4]))
                 for name, rec in self._subsystems.items()
             }
+            disconnects = dict(self._disconnects)
         out = {}
         for name, (reqs, errs, sigs, height, samples) in rows.items():
             live = sorted(lat for t, lat in samples if t > cutoff)
@@ -503,6 +531,7 @@ class TelemetryHub:
                 "errors": errs,
                 "sigs": sigs,
                 "last_height": height,
+                "disconnects": disconnects.get(name, 0),
                 "window_requests": len(live),
                 "rate_per_sec": round(len(live) / self.window_s, 3),
                 "p50_ms": None if p50 is None else round(p50 * 1e3, 3),

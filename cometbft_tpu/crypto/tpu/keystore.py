@@ -173,15 +173,93 @@ class DeviceKeyStore:
         Pure host-side dict lookups (no device touch); advisory only:
         verify_batch_indexed re-checks under its own lookup, so a
         concurrent eviction between this answer and the dispatch just
-        downgrades to the keyed single-chip wire."""
+        downgrades to the keyed single-chip wire. Host-only service
+        entries (no device table) don't count — they cannot feed the
+        on-device gather this probe is pricing."""
         if not pub_keys:
             return False
         entries = self.lookup_fresh()
         for e in entries:
+            if e.table_dev is None:
+                continue
             index = e.index
             if all(_key_bytes(pk) in index for pk in pub_keys):
                 return True
         return False
+
+    def generation(self) -> int:
+        """Current store generation — the freshness token of the verify
+        service's indexed-frame handshake (stamped on HELLO/RESP frames;
+        a client whose cached value diverges must re-register before
+        shipping 100 B indexed rows again)."""
+        with self._mtx:
+            return self._gen
+
+    def entry_for(self, valset_id: bytes,
+                  generation: Optional[int] = None) -> Optional[KeyStoreEntry]:
+        """Frame-accept-time lookup for the verify service: the entry
+        for ``valset_id``, but ONLY while the client's cached store
+        generation matches the store's — a stale client is refused
+        (``stale_drops`` counted) and falls back to full 128 B compact
+        rows rather than ever verifying against a key space it has not
+        resynced with."""
+        vid = bytes(valset_id)
+        with self._mtx:
+            if generation is not None and generation != self._gen:
+                self._stats["stale_drops"] += 1
+                return None
+            e = self._entries.get(vid)
+            if e is None:
+                return None
+            self._entries.move_to_end(vid)
+            self._stats["hits"] += 1
+            return e
+
+    def register(self, valset_id: bytes, pub_keys) -> KeyStoreEntry:
+        """Host-side registration for the verify service's generation
+        handshake: build (or reuse) an entry carrying only the host key
+        rows + index — ``table_dev`` stays None, and the device-dispatch
+        probes above skip such entries — and bump the store generation
+        on insert, so every remote client's cached generation goes stale
+        exactly when the key space changes. Malformed-length keys get a
+        zeroed row with ``pk_ok`` False (refused at verify, like the
+        device build does)."""
+        vid = bytes(valset_id)
+        with self._mtx:
+            e = self._entries.get(vid)
+            if e is not None:
+                self._entries.move_to_end(vid)
+                self._stats["hits"] += 1
+                return e
+            self._stats["misses"] += 1
+        keys = [_key_bytes(pk) for pk in pub_keys]
+        n = len(keys)
+        e = KeyStoreEntry()
+        e.valset_id = vid
+        e.topo_generation = _topo_generation()
+        e.chunks = []
+        e.pk_arr = np.zeros((n, 32), np.uint8)
+        e.pk_ok = np.zeros(n, bool)
+        e.index = {}
+        e.table_dev = None
+        e.n = n
+        for i, k in enumerate(keys):
+            if len(k) == 32:
+                e.pk_arr[i] = np.frombuffer(k, np.uint8)
+                e.pk_ok[i] = True
+            e.index.setdefault(k, i)
+        with self._mtx:
+            won = self._entries.get(vid)
+            if won is not None:
+                self._entries.move_to_end(vid)
+                return won
+            self._gen += 1
+            e.generation = self._gen
+            self._entries[vid] = e
+            self._stats["uploads"] += 1
+            while len(self._entries) > self._max:
+                self._entries.popitem(last=False)
+        return e
 
     def note_indexed(self, lanes: int) -> None:
         with self._mtx:
@@ -263,6 +341,8 @@ def verify_batch_indexed(
         return None
     entry = None
     for e in entries:
+        if e.table_dev is None:
+            continue  # host-only service entry: nothing to gather from
         if all(_key_bytes(pk) in e.index for pk in pub_keys):
             entry = e
             break

@@ -80,6 +80,9 @@ DEFAULT_BYTES_PER_LANE = 128.0
 ROUTE_BYTES_PER_LANE = {
     "indexed": 100.0,
     "device_hash": 96.0,
+    # verify-as-a-service row flushes: the socket payload IS the compact
+    # wire (128 B/lane on the frame, re-used verbatim for device_put)
+    "service": 128.0,
 }
 
 

@@ -158,6 +158,7 @@ class Node(BaseService):
         # localnet runners) can mix backends and min_batch values. The
         # CLI entrypoint (default_new_node) additionally sets the
         # process default backend name.
+        from cometbft_tpu.crypto import service as verify_servicelib
         from cometbft_tpu.crypto.batch import BackendSpec
 
         self.crypto_spec = BackendSpec(
@@ -485,6 +486,30 @@ class Node(BaseService):
         self.telemetry_hub.register_source(
             "topology", verify_topology.snapshot
         )
+        # shared verify daemon ([crypto] verify_service /
+        # CBFT_VERIFY_SERVICE): when set, every verification-carrying
+        # subsystem below points at a RemoteVerifier over the daemon —
+        # cross-client megabatch coalescing on one device pool, with
+        # local-CPU fallback on disconnect/timeout — instead of the
+        # in-process scheduler (which still exists for standalone use
+        # and as the local fallback's spec donor)
+        self.remote_verifier = None
+        self.crypto_backend = self.verify_scheduler
+        vs_addr = verify_servicelib.verify_service_default(
+            config.crypto.verify_service
+        )
+        if vs_addr:
+            self.remote_verifier = verify_servicelib.RemoteVerifier(
+                vs_addr,
+                tenant=config.base.moniker,
+                spec=self.crypto_spec,
+                timeout_ms=config.crypto.verify_service_timeout_ms,
+                logger=self.logger,
+            )
+            self.crypto_backend = self.remote_verifier
+            self.telemetry_hub.register_source(
+                "service", self.remote_verifier.snapshot
+            )
 
         # 1. stores
         self.block_store = BlockStore(db_provider("blockstore", config))
@@ -592,7 +617,7 @@ class Node(BaseService):
         # 7. evidence
         self.evidence_pool = EvidencePool(
             db_provider("evidence", config), self.state_store,
-            self.block_store, crypto_backend=self.verify_scheduler,
+            self.block_store, crypto_backend=self.crypto_backend,
         )
         self.evidence_reactor = EvidenceReactor(self.evidence_pool)
 
@@ -603,7 +628,7 @@ class Node(BaseService):
             mempool=self.mempool,
             evidence_pool=self.evidence_pool,
             event_bus=self.event_bus,
-            crypto_backend=self.verify_scheduler,
+            crypto_backend=self.crypto_backend,
             metrics=sm_metrics,
             logger=self.logger,
         )
@@ -613,7 +638,7 @@ class Node(BaseService):
         self.blocksync_reactor = BlocksyncReactor(
             state, self.block_executor, self.block_store,
             fast_sync=fast_sync and not self.state_sync_enabled,
-            crypto_backend=self.verify_scheduler,
+            crypto_backend=self.crypto_backend,
             logger=self.logger,
         )
         self._fast_sync_after_statesync = fast_sync
@@ -639,7 +664,7 @@ class Node(BaseService):
             config.consensus, state, self.block_executor, self.block_store,
             tx_notifier=self.mempool, evpool=self.evidence_pool, wal=wal,
             event_bus=self.event_bus,
-            crypto_backend=self.verify_scheduler, metrics=cons_metrics,
+            crypto_backend=self.crypto_backend, metrics=cons_metrics,
             logger=self.logger,
         )
         if priv_validator is not None:
@@ -965,7 +990,7 @@ class Node(BaseService):
                         height=ss_cfg.trust_height,
                         hash=bytes.fromhex(ss_cfg.trust_hash),
                     ),
-                    crypto_backend=self.verify_scheduler,
+                    crypto_backend=self.crypto_backend,
                     logger=self.logger,
                 )
             else:
@@ -1061,6 +1086,16 @@ class Node(BaseService):
                 self.logger.error("error stopping service", err=str(exc))
         if self.consensus_state.is_running():
             self.consensus_state.stop()
+        # the remote verifier first: close() fails any still-pending
+        # requests over to the local-CPU fallback before the scheduler
+        # (its spec donor) drains
+        if self.remote_verifier is not None:
+            try:
+                self.remote_verifier.close()
+            except Exception as exc:
+                self.logger.error(
+                    "error closing remote verifier", err=str(exc)
+                )
         # after every verification-carrying service: stop() drains the
         # queue (dispatching, not abandoning), so no future hangs
         if self.verify_scheduler.is_running():

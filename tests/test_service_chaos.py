@@ -1,0 +1,43 @@
+"""Verify-as-a-service chaos rung (PR 17) in tier-1.
+
+One daemon (VerifyScheduler + VerifyService on a Unix socket), 36
+clients over real sockets: deterministic disconnect containment (four
+clients severed mid-flight against a frozen pool), a 2.5x flood with
+QoS shed/drop visible to remote tenants as honest rejections, and
+bottom-up brownout recovery — the same invariants tools/chaos.py
+--service gates on. Mirrors the in-process overload rung's tier-1 test
+(tests/test_qos.py::TestChaosOverloadRung)."""
+
+
+class TestChaosServiceRung:
+    def test_service_rung_end_to_end(self):
+        from cometbft_tpu.crypto.faults import run_chaos_service
+
+        s = run_chaos_service(seed=29, flood_s=1.0)
+        assert s["wrong_verdicts"] == 0, s["wrong_by_phase"]
+        assert s["latency_ok"], (
+            f"loaded p99 {s['loaded_p99_ms']}ms over bound "
+            f"{s['latency_bound_ms']}ms"
+        )
+        # consensus never shed/dropped while flood tenants were
+        assert s["consensus_sheds"] == 0
+        assert s["consensus_drops"] == 0
+        assert s["flood_sheds"] >= 1
+        assert s["flood_drops"] >= 1
+        # QoS verdicts crossed the wire as rejections, not CPU bounces
+        assert s["rejected"] >= 1
+        # disconnect containment: every killed client's in-flight
+        # request resolved via the LOCAL fallback with the distinct
+        # reason, and the server metered the severed tenants
+        assert s["disconnect_fallbacks"] >= 4, s["kill_reasons"]
+        assert s["killed_client_fallbacks"] >= 1
+        assert s["disconnects_metered"] >= 1
+        # overload tripped the brownout; recovery re-admitted bottom-up
+        assert s["brownout"]["trips"] >= 1
+        assert s["readmitted"]
+        assert not s["brownout"]["disabled"]
+        # the service drained: no request left behind
+        assert s["pending_after"] == 0
+        # the wire never grew past the compact bound
+        assert s["bytes_per_lane_ok"], s["bytes_per_lane"]
+        assert s["bytes_per_lane"]["compact"] == 128.0

@@ -331,6 +331,7 @@ class TestThreeWayRouting:
             snap = sched.queue_snapshot()
             assert snap["routes"] == {
                 "cpu": 0, "single": 0, "sharded": 0, "indexed": 0,
+                "service": 0,
             }
         finally:
             sched.on_stop()

@@ -141,6 +141,15 @@ def direction(path: str, unit: Optional[str] = None) -> Optional[str]:
         return LOWER_IS_BETTER
     if leaf.endswith("_bytes_per_lane_steady"):
         return LOWER_IS_BETTER
+    # verify-as-a-service guards (PR 17): what cross-client coalescing
+    # buys over isolated per-client dispatch is a ratio (the generic
+    # rules would drop it) and must only grow; the coalesced service's
+    # per-request p99 is already covered by the generic _ms rule but is
+    # pinned here so a suffix-rule rework can't silently drop it
+    if leaf.endswith("_coalesce_gain"):
+        return HIGHER_IS_BETTER
+    if leaf.endswith("_service_p99_ms") or leaf == "service_p99_ms":
+        return LOWER_IS_BETTER
     if leaf.endswith(("_ms", "_s", "_us", "_ns")) or "_ms_" in leaf:
         return LOWER_IS_BETTER
     return None

@@ -1,6 +1,6 @@
 """Standalone chaos harness against the supervised verify plane.
 
-Eight modes:
+Nine modes:
 
 * default (smoke) — crypto/faults.py run_chaos_smoke: a fast,
   deterministic walk of every degradation-ladder rung (transient retry,
@@ -65,6 +65,19 @@ Eight modes:
   The scheduler runs the PRICED live router: the trip must also roll
   routing back to the threshold ladder exactly once, and recovery must
   re-admit the priced argmin (hysteretic rollback guard, ISSUE 16).
+
+* --service — crypto/faults.py run_chaos_service: the
+  verify-as-a-service rung. One daemon (VerifyScheduler + VerifyService
+  on a Unix socket), 32 flood clients + 4 consensus clients over real
+  sockets: four clients are killed abruptly mid-flight (their futures
+  must resolve via the local-CPU fallback with reason "disconnected",
+  a survivor sharing the SAME coalesced flush must still get correct
+  verdicts, and the server must meter the disconnects and keep
+  serving); then a blocksync+mempool flood at ~2.5x dispatch capacity
+  must leave consensus p99 inside its bound while the merged queue's
+  QoS layer sheds/drops flood (honest rejections over the wire, never
+  wrong verdicts), brownout trips and re-admits, payload stays at
+  <= 128 bytes/lane, and the service drains to zero pending.
 
 * --soak — crypto/faults.py run_chaos_soak: a randomized fault schedule
   (exceptions, hangs, silent verdict corruption, sudden death, jitter,
@@ -144,6 +157,13 @@ def main() -> int:
     ap.add_argument("--flood-s", type=float, default=1.5,
                     help="[overload] flood duration per phase "
                          "(default 1.5)")
+    ap.add_argument("--service", action="store_true",
+                    help="run the verify-as-a-service rung: 32+4 "
+                         "clients over a Unix socket against one "
+                         "coalescing daemon — disconnect containment, "
+                         "QoS under flood, brownout re-admission, "
+                         "bytes/lane bound, zero wrong verdicts "
+                         "(uses --flood-s)")
     ap.add_argument("--memory-guard", action="store_true",
                     help="run the proactive-vs-reactive OOM rung "
                          "(memory plane pre-dispatch guard)")
@@ -267,6 +287,30 @@ def main() -> int:
             and summary["starved_without_qos"]
         )
         print("CHAOS OVERLOAD", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+
+    if args.service:
+        from cometbft_tpu.crypto.faults import run_chaos_service
+
+        summary = run_chaos_service(seed=args.seed, flood_s=args.flood_s)
+        print(json.dumps(summary, indent=2))
+        ok = (
+            summary["wrong_verdicts"] == 0
+            and summary["latency_ok"]
+            and summary["consensus_sheds"] == 0
+            and summary["consensus_drops"] == 0
+            and summary["flood_sheds"] >= 1
+            and summary["flood_drops"] >= 1
+            and summary["rejected"] >= 1
+            and summary["disconnect_fallbacks"] >= 4
+            and summary["killed_client_fallbacks"] >= 1
+            and summary["disconnects_metered"] >= 1
+            and summary["brownout"]["trips"] >= 1
+            and summary["readmitted"]
+            and summary["pending_after"] == 0
+            and summary["bytes_per_lane_ok"]
+        )
+        print("CHAOS SERVICE", "PASS" if ok else "FAIL")
         return 0 if ok else 1
 
     if args.memory_guard:

@@ -244,6 +244,47 @@ def render(snap: Dict[str, Any]) -> str:
             f"hit_rate={_pct(hit_rate)}  "
             f"indexed={stats.get('indexed_dispatches', 0)}"
         )
+    svc = sources.get("service", {}) if isinstance(sources, dict) else {}
+    if isinstance(svc, dict) and svc:
+        if "coalesce" in svc:
+            # server-side snapshot (a verifyd daemon's VerifyService)
+            frames = svc.get("frames", {})
+            lanes_by_kind = svc.get("lanes", {})
+            bpl = svc.get("bytes_per_lane", {})
+            out.append(
+                f"service (server)  addr={svc.get('address', '-')}  "
+                f"coalesce={'on' if svc.get('coalesce') else 'OFF'}  "
+                f"conns={svc.get('connections', 0)}  "
+                f"tenants={len(svc.get('tenants', []) or [])}  "
+                f"pending={svc.get('pending', 0)}"
+            )
+            out.append(
+                "service wire  "
+                + "  ".join(
+                    f"{k}={lanes_by_kind.get(k, 0)} lanes"
+                    + (
+                        f" @{bpl[k]:.1f}B/lane" if k in bpl else ""
+                    )
+                    for k in ("compact", "indexed")
+                )
+                + f"  req_frames={frames.get('req', 0)}  "
+                f"errors={sum((svc.get('errors') or {}).values())}  "
+                f"disconnects={sum((svc.get('disconnects') or {}).values())}"
+                f"  stale_drops={svc.get('stale_drops', 0)}"
+            )
+        elif "connected" in svc:
+            # client-side snapshot (this node's RemoteVerifier)
+            stats = svc.get("stats", {}) if isinstance(
+                svc.get("stats"), dict) else {}
+            out.append(
+                f"service (client)  addr={svc.get('address', '-')}  "
+                f"{'connected' if svc.get('connected') else 'DISCONNECTED'}"
+                f"  gen={svc.get('server_generation', '-')}  "
+                f"valsets={svc.get('valsets', 0)}  "
+                f"pending={svc.get('pending', 0)}  "
+                f"remote_ok={stats.get('remote_ok', 0)}  "
+                f"fallbacks={sum(stats.get(k, 0) for k in ('disconnected', 'timeout', 'rejected', 'stale', 'error'))}"
+            )
     fill = snap.get("lane_fill", {})
     if fill.get("padded_lanes"):
         out.append(

@@ -1,0 +1,150 @@
+"""verifyd — standalone verify-as-a-service daemon.
+
+Runs ONE VerifyScheduler + VerifyService pair and listens on a Unix
+socket (default) or TCP address so many nodes / light clients can share
+one device pool. Client frames carry the compact wire format directly
+(crypto/service.py), so the daemon's only per-request work is
+device_put + the coalesced kernel dispatch; verdicts fan back out as
+one status byte + a packed verdict bitmap per request.
+
+Usage:
+    python tools/verifyd.py                              # unix socket
+    python tools/verifyd.py --address tcp://0.0.0.0:26670
+    python tools/verifyd.py --backend tpu --flush-us 500 --qos on
+    python tools/verifyd.py --no-coalesce                # bench baseline
+    python tools/verifyd.py --stats 5                    # JSON snapshots
+
+Point nodes at it with ``[crypto] verify_service = "unix:///..."`` or
+``CBFT_VERIFY_SERVICE``; they fall back to local CPU verification on
+disconnect/timeout, so the daemon is never a liveness dependency.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from cometbft_tpu.crypto import service as servicelib
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+    from cometbft_tpu.crypto.telemetry import TelemetryHub
+    from cometbft_tpu.libs.log import new_tm_logger
+
+    ap = argparse.ArgumentParser(
+        description="Shared verify-as-a-service daemon (one device pool, "
+                    "N clients, cross-client megabatch coalescing)."
+    )
+    ap.add_argument(
+        "--address", default=servicelib.DEFAULT_ADDRESS,
+        help="listen address: unix:///path.sock or tcp://host:port "
+             f"(default {servicelib.DEFAULT_ADDRESS})",
+    )
+    ap.add_argument(
+        "--backend", default=None,
+        help="verify backend name (cpu | tpu | ...; default: "
+             "CMT_CRYPTO_BACKEND or cpu)",
+    )
+    ap.add_argument(
+        "--flush-us", type=int, default=None,
+        help="coalescing window in microseconds (default: scheduler "
+             "default / CBFT_VERIFY_FLUSH_US)",
+    )
+    ap.add_argument(
+        "--max-chunk", type=int, default=None,
+        help="lane budget per coalesced flush (default: backend "
+             "max_chunk / CBFT_TPU_MAX_CHUNK)",
+    )
+    ap.add_argument(
+        "--qos", default="default",
+        help="QoS class spec for the merged queue — 'default' (the five "
+             "built-in classes), 'off', or an explicit "
+             "'name:policy:weight,...' list (default: default)",
+    )
+    ap.add_argument(
+        "--tenant-rate", type=int, default=None,
+        help="per-tenant lanes/sec quota (tenant = client connection "
+             "name); 0/unset = unlimited",
+    )
+    ap.add_argument(
+        "--no-coalesce", action="store_true",
+        help="dispatch each client frame isolated (the bench baseline "
+             "— proves what cross-client coalescing buys)",
+    )
+    ap.add_argument(
+        "--stats", type=float, default=0.0, metavar="SECONDS",
+        help="print a JSON service snapshot every N seconds",
+    )
+    args = ap.parse_args(argv)
+
+    try:
+        servicelib.parse_address(args.address)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    logger = new_tm_logger()
+    hub = TelemetryHub()
+    scheduler = VerifyScheduler(
+        spec=args.backend,
+        flush_us=args.flush_us,
+        lane_budget=args.max_chunk,
+        logger=logger.with_(module="scheduler"),
+        telemetry=hub,
+        qos=args.qos,
+        tenant_rate=args.tenant_rate,
+    )
+    service = servicelib.VerifyService(
+        scheduler,
+        args.address,
+        coalesce=not args.no_coalesce,
+        telemetry=hub,
+        logger=logger.with_(module="verifyd"),
+    )
+    scheduler.start()
+    try:
+        service.start()
+    except Exception as exc:  # noqa: BLE001 - CLI surface
+        print(f"error: cannot listen on {args.address}: {exc}",
+              file=sys.stderr)
+        scheduler.stop()
+        return 1
+
+    print(
+        f"verifyd listening on {service.address()}  "
+        f"backend={scheduler.spec.name}  "
+        f"coalesce={'on' if not args.no_coalesce else 'OFF'}  "
+        f"qos={args.qos}",
+        flush=True,
+    )
+
+    done = threading.Event()
+
+    def _stop(signum, frame):  # noqa: ARG001 - signal signature
+        done.set()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+
+    try:
+        while not done.wait(args.stats if args.stats > 0 else 1.0):
+            if args.stats > 0:
+                print(
+                    json.dumps(service.snapshot(), sort_keys=True,
+                               default=str),
+                    flush=True,
+                )
+    finally:
+        service.stop()
+        scheduler.stop()
+        print("verifyd stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
