@@ -1034,6 +1034,35 @@ def _stage_overload():
     print(json.dumps(out), flush=True)
 
 
+def _stage_adversary():
+    """Adversarial-committee numbers (crypto/adversary.py): the
+    committee-size ladder (128 -> 1k validators) under a 25% byzantine
+    vote flood with churn, equivocation bursts, and spam — p50/p99
+    commit-verify per committee size while the storm rages, plus the
+    zero-wrong-verdict and exact-attribution gates as booleans so the
+    history ledger records pass/fail, not just milliseconds. The
+    ``adversary_<n>_p99_ms`` / ``adversary_wrong_verdicts`` leaves ride
+    the regression sentinel (tools/bench_history.py direction rules)."""
+    _maybe_force_cpu()
+    _set_cache()
+    from cometbft_tpu.crypto.adversary import run_adversary_ladder
+
+    s = run_adversary_ladder(
+        seed=int(os.environ.get("CBFT_BENCH_SEED", "17")),
+        sizes=(128, 512, 1024),
+        heights=6,
+    )
+    out = {"adversary_ok": s["ok"], "adversary_wrong_verdicts": 0}
+    for n, r in s["rungs"].items():
+        out["adversary_wrong_verdicts"] += r["wrong_verdicts"]
+        out[f"adversary_{n}_p50_ms"] = r["loaded_p50_ms"]
+        out[f"adversary_{n}_p99_ms"] = r["loaded_p99_ms"]
+        out[f"adversary_{n}_unloaded_p99_ms"] = r["unloaded_p99_ms"]
+        out[f"adversary_{n}_latency_ok"] = r["latency_ok"]
+        out[f"adversary_{n}_offenders_exact"] = r["offenders_exact"]
+    print(json.dumps(out), flush=True)
+
+
 def _stage_decisions():
     """Decision-plane accuracy numbers (crypto/decisions.py): a warm
     verify workload through a scheduler with the routing ledger
@@ -1700,6 +1729,14 @@ def main():
     if parsed is not None:
         _append_history(parsed, stage="sharded")
 
+    # adversarial-committee ladder: p50/p99 commit-verify per committee
+    # size (128 -> 1k) under a byzantine storm, zero-wrong-verdict gate
+    # riding the sentinel (platform-neutral, CPU-inner faulty backend)
+    parsed, diag = _run_stage("adversary", _STAGE_ENV_CPU, 600)
+    stages["adversary"] = parsed if parsed is not None else diag
+    if parsed is not None:
+        _append_history(parsed, stage="adversary")
+
     last_onchip = None
     if result is None:
         # TPU unavailable — same kernel on the host CPU platform so the
@@ -1768,6 +1805,7 @@ if __name__ == "__main__":
             "supervisor": _stage_supervisor,
             "degraded": _stage_degraded,
             "overload": _stage_overload,
+            "adversary": _stage_adversary,
             "sharded": _stage_sharded,
             "decisions": _stage_decisions,
             "routing": _stage_routing,

@@ -1,0 +1,932 @@
+"""Adversarial committee harness: byzantine vote floods, valset churn,
+equivocation storms, and mid-storm daemon restarts at committee scale.
+
+Every other robustness rung (crypto/faults.py) attacks the verify stack
+from the *backend* side — injected device hangs, OOMs, corruption. This
+module attacks it from the *workload* side: it synthesizes validator
+committees at 128/512/1k/4k scale (types/test_util.py fixtures — real
+ed25519 keys, real canonical vote sign-bytes) and drives the full
+scheduler → supervisor → service stack with composable attack plans:
+
+* **byzantine vote floods** — a configurable fraction (1%..100%) of each
+  height's precommits carries a corrupted signature, stressing the
+  failed-batch triage bisection (supervisor._triage) and its
+  ⌈log₂ n⌉ + 1 pass bound;
+* **equivocation storms** — bursts of double-sign evidence
+  (types.evidence.DuplicateVoteEvidence) whose vote pairs ride the
+  block-policy ``evidence`` QoS tenant;
+* **rapid valset churn** — rotation every N heights, re-keying a
+  fraction of the committee and re-registering the new set, stressing
+  keystore generation invalidation, LRU residency (the pinned-entry
+  guard), and the service registration handshake;
+* **non-validator vote spam** — validly-signed votes from keys outside
+  the committee, riding the drop-policy ``mempool`` tenant (honest QoS
+  rejections allowed, wrong verdicts never);
+* **mid-storm verifyd crash/restart** — the PR 17 network boundary is
+  killed with requests in flight and restarted with an invalidated
+  keystore, forcing the client's full
+  disconnected → fallback → reconnect → re-register → indexed walk.
+
+An InvariantChecker holds the construction-time ground truth for every
+submitted item (the harness corrupted the signature, so it KNOWS) plus a
+sampled CPU re-verification oracle, and asserts **zero wrong verdicts**:
+no byzantine vote accepted, no honest vote rejected except as an honest
+QoS shed/drop on a sheddable class — a drop that claims validity is
+wrong, and a block-policy (consensus/evidence) rejection is wrong.
+Liveness is judged as loaded consensus p99 within 2x of the unloaded
+bound, and triage must attribute every injected byzantine signature to
+exactly the subsystem that submitted it (and convict nobody else).
+
+Entry points: ``run_campaign(plan)`` is the engine;
+``run_chaos_adversary(...)`` is the deterministic tier-1 rung (the
+ISSUE-18 acceptance shape: 512 validators, 25% byzantine, per-8-height
+churn, one mid-storm kill/restart); ``run_adversary_ladder(...)`` walks
+committee sizes for the soak rung and the bench stage.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+CHAIN_ID = "adversary-chain"
+
+# one storm-heavy dispatch quantum: a full-committee flush plus the
+# batched triage bisection passes plus the CPU confirmation of the
+# convicted lanes — on the host ground-truth path that is ~2
+# full-committee verifies (~0.15 ms/lane each), so the floor scales
+# with committee size; 60 ms is the small-committee noise floor. A
+# latency bound below 2x this quantum fails on host verify speed, not
+# on lost liveness.
+DISPATCH_FLOOR_MS = 60.0
+
+
+def _dispatch_floor_ms(committee: int) -> float:
+    return max(DISPATCH_FLOOR_MS, 0.3 * committee)
+
+
+def _forced_triage_depth(committee: int, byzantine_rate: float) -> int:
+    """Serial device passes per height the configured flood can force.
+
+    Triage coalesces every live suspect segment into ONE dispatch per
+    pass, so the serial depth is set by the LONGEST byzantine run, not
+    the count: bisecting a run of length L costs ~ceil(log2 L)+1 passes
+    on top of the initial dispatch. Seats are sampled uniformly, so the
+    expected longest run at rate r is ~log(n)/log(1/r) (geometric runs);
+    at r=1 the whole committee is one run. A latency bound that ignores
+    this flunks total-takeover campaigns on bisection arithmetic, not on
+    lost liveness."""
+    bad = committee * byzantine_rate
+    if bad < 1.0:
+        return 1
+    if byzantine_rate >= 1.0:
+        run = float(committee)
+    else:
+        run = min(float(committee),
+                  max(1.0, math.log(committee)
+                      / math.log(1.0 / byzantine_rate)))
+    passes = math.ceil(math.log2(max(2.0, run))) + 1
+    return 1 + passes
+
+
+def _corrupt(sig: bytes) -> bytes:
+    """Flip the low bit of the last signature byte — same corruption the
+    service rung uses, guaranteed invalid, length-preserving."""
+    return bytes(sig[:-1]) + bytes([sig[-1] ^ 0x01])
+
+
+def _percentile_ms(samples_s: Sequence[float], q: float) -> float:
+    if not samples_s:
+        return 0.0
+    xs = sorted(samples_s)
+    idx = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[idx] * 1e3
+
+
+def _p99_ms(samples_s: Sequence[float]) -> float:
+    return _percentile_ms(samples_s, 0.99)
+
+
+def _p50_ms(samples_s: Sequence[float]) -> float:
+    return _percentile_ms(samples_s, 0.50)
+
+
+# ---------------------------------------------------------------------------
+# committee synthesis
+# ---------------------------------------------------------------------------
+
+
+class Committee:
+    """A deterministic validator committee with per-epoch key rotation.
+
+    Keys derive from ``(seed, epoch, index)`` secrets, so a rotation
+    genuinely re-keys the rotated seats (new pubkeys, new valset id) —
+    the keystore and the service registration handshake see real churn,
+    not a relabeled set. Members are kept in the ValidatorSet's
+    canonical order so evidence construction resolves addresses.
+    """
+
+    def __init__(self, n: int, seed: int, power: int = 100):
+        from cometbft_tpu.types.validator import Validator
+        from cometbft_tpu.types.validator_set import ValidatorSet
+
+        self.n = n
+        self.seed = seed
+        self.power = power
+        self.epoch = 0
+        self.rotations = 0
+        self._epoch_of = [0] * n
+        self._Validator = Validator
+        self._ValidatorSet = ValidatorSet
+        self._build()
+
+    def _build(self) -> None:
+        from cometbft_tpu.crypto import ed25519
+        from cometbft_tpu.types.priv_validator import MockPV
+
+        privs = [
+            MockPV(ed25519.gen_priv_key_from_secret(
+                b"adversary-%d-e%d-v%d" % (self.seed, self._epoch_of[i], i)
+            ))
+            for i in range(self.n)
+        ]
+        vals = [
+            self._Validator.new(pv.get_pub_key(), self.power)
+            for pv in privs
+        ]
+        self.valset = self._ValidatorSet(vals)
+        by_addr = {pv.get_pub_key().address(): pv for pv in privs}
+        self.privs = [by_addr[v.address] for v in self.valset.validators]
+        self.pubs = [pv.get_pub_key() for pv in self.privs]
+
+    def pk_bytes(self) -> List[bytes]:
+        from cometbft_tpu.crypto.service import _pk_bytes
+
+        return [_pk_bytes(pk) for pk in self.pubs]
+
+    def valset_id(self) -> bytes:
+        """Same id scheme as the service registration handshake."""
+        return hashlib.sha256(b"".join(self.pk_bytes())).digest()[:16]
+
+    def rotate(self, frac: float, rng: random.Random) -> int:
+        """Re-key ``frac`` of the seats (at least one) with next-epoch
+        secrets and rebuild the canonical set. Returns seats rotated."""
+        self.epoch += 1
+        self.rotations += 1
+        k = min(self.n, max(1, int(round(frac * self.n))))
+        for i in rng.sample(range(self.n), k):
+            self._epoch_of[i] = self.epoch
+        self._build()
+        return k
+
+    def block_id(self, height: int, fork: int = 0):
+        from cometbft_tpu.types.test_util import make_block_id
+
+        h = hashlib.sha256(
+            b"adversary-block-%d-%d-%d" % (self.seed, height, fork)
+        ).digest()
+        return make_block_id(h, 1000, b"\x02" * 32)
+
+    def precommit_items(
+        self, height: int, byzantine: Set[int]
+    ) -> Tuple[List[tuple], List[bool]]:
+        """One height's precommits as verify triples: every member signs
+        the canonical vote sign-bytes; ``byzantine`` seats ship a
+        corrupted signature. Returns (items, expected_mask)."""
+        from cometbft_tpu.proto.gogo import Timestamp
+        from cometbft_tpu.types.test_util import make_vote
+        from cometbft_tpu.types.vote import (
+            SIGNED_MSG_TYPE_PRECOMMIT,
+            vote_sign_bytes,
+        )
+
+        bid = self.block_id(height)
+        ts = Timestamp.now()
+        items: List[tuple] = []
+        expected: List[bool] = []
+        for i, pv in enumerate(self.privs):
+            vote = make_vote(
+                pv, CHAIN_ID, i, height, 0,
+                SIGNED_MSG_TYPE_PRECOMMIT, bid, ts,
+            )
+            msg = vote_sign_bytes(CHAIN_ID, vote)
+            sig = vote.signature
+            good = i not in byzantine
+            items.append((self.pubs[i], msg, _corrupt(sig) if not good
+                          else sig))
+            expected.append(good)
+        return items, expected
+
+    def equivocation_burst(
+        self, height: int, count: int, rng: random.Random
+    ) -> Tuple[List[object], List[tuple]]:
+        """``count`` double-sign evidence objects (two conflicting
+        precommits each) from distinct seats, plus the 2*count verify
+        triples their signatures make. All signatures are VALID — the
+        misbehavior is the conflict, not a bad signature, so the verify
+        plane must accept every lane."""
+        from cometbft_tpu.proto.gogo import Timestamp
+        from cometbft_tpu.types.evidence import DuplicateVoteEvidence
+        from cometbft_tpu.types.test_util import make_vote
+        from cometbft_tpu.types.vote import (
+            SIGNED_MSG_TYPE_PRECOMMIT,
+            vote_sign_bytes,
+        )
+
+        count = min(count, self.n)
+        ts = Timestamp.now()
+        evidence: List[object] = []
+        items: List[tuple] = []
+        for i in rng.sample(range(self.n), count):
+            pv = self.privs[i]
+            votes = []
+            for fork in (0, 1):
+                v = make_vote(
+                    pv, CHAIN_ID, i, height, 0,
+                    SIGNED_MSG_TYPE_PRECOMMIT,
+                    self.block_id(height, fork=fork), ts,
+                )
+                votes.append(v)
+                items.append(
+                    (self.pubs[i], vote_sign_bytes(CHAIN_ID, v),
+                     v.signature)
+                )
+            ev = DuplicateVoteEvidence.new(
+                votes[0], votes[1], ts, self.valset
+            )
+            ev.validate_basic()
+            evidence.append(ev)
+        return evidence, items
+
+
+# spam signer keys are deterministic in (seed, index) — cache them so a
+# 16-height storm does not pay 16x the same keygens
+_SPAM_SIGNERS: Dict[Tuple[int, int], object] = {}
+
+
+def spam_items(
+    seed: int, height: int, count: int
+) -> Tuple[List[tuple], List[bool]]:
+    """``count`` validly-signed precommits from keys OUTSIDE any
+    committee — the non-validator spam tenant. The verify plane must
+    either accept them (the signatures ARE valid) or reject them
+    honestly via QoS shed/drop; consensus-layer membership filtering is
+    not the signature plane's job."""
+    from cometbft_tpu.crypto import ed25519
+    from cometbft_tpu.proto.gogo import Timestamp
+    from cometbft_tpu.types.priv_validator import MockPV
+    from cometbft_tpu.types.test_util import make_block_id, make_vote
+    from cometbft_tpu.types.vote import (
+        SIGNED_MSG_TYPE_PRECOMMIT,
+        vote_sign_bytes,
+    )
+
+    bid = make_block_id(
+        hashlib.sha256(b"adversary-spam-%d-%d" % (seed, height)).digest(),
+        1000, b"\x02" * 32,
+    )
+    ts = Timestamp.now()
+    items: List[tuple] = []
+    for i in range(count):
+        key = (seed, i)
+        pv = _SPAM_SIGNERS.get(key)
+        if pv is None:
+            pv = MockPV(ed25519.gen_priv_key_from_secret(
+                b"adversary-spam-%d-%d" % (seed, i)
+            ))
+            _SPAM_SIGNERS[key] = pv
+        v = make_vote(
+            pv, CHAIN_ID, i, height, 0, SIGNED_MSG_TYPE_PRECOMMIT, bid, ts
+        )
+        items.append(
+            (pv.get_pub_key(), vote_sign_bytes(CHAIN_ID, v), v.signature)
+        )
+    return items, [True] * len(items)
+
+
+# ---------------------------------------------------------------------------
+# attack plans
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AttackPlan:
+    """A composable storm description. Every knob is deterministic under
+    ``seed``; the tier-1 rung and the soak ladder are just different
+    plans through the same engine."""
+
+    committee: int = 512
+    heights: int = 16
+    byzantine_rate: float = 0.25
+    churn_every: int = 8           # rotate every N heights (0 = never)
+    churn_frac: float = 0.25       # fraction of seats re-keyed per churn
+    equivocation_every: int = 4    # evidence burst every N heights (0 = off)
+    equivocation_burst: int = 8    # double-sign pairs per burst
+    spam_per_height: int = 32      # non-validator votes per height (0 = off)
+    service: bool = True           # drive the PR 17 network boundary too
+    kill_restart_height: Optional[int] = None  # verifyd dies here (None = no)
+    seed: int = 1234
+    jitter_ms: float = 5.0         # injected per-dispatch device jitter
+    slo_target_ms: int = 250
+    unloaded_rounds: int = 12
+    oracle_sample: int = 128       # CPU re-verified lanes (beyond truth)
+    inner: str = "cpu"
+
+
+# ---------------------------------------------------------------------------
+# invariants
+# ---------------------------------------------------------------------------
+
+# QoS classes where an honest shed/drop is an allowed outcome; a
+# rejection on any other class is a wrong verdict (block-policy work
+# must never be shed)
+_SHEDDABLE = {"mempool", "blocksync", "light"}
+
+
+class InvariantChecker:
+    """Construction-time ground truth plus a sampled CPU oracle.
+
+    The harness corrupted the byzantine signatures itself, so the
+    expected mask of every submitted batch is known without any
+    verification. ``settle`` resolves each tracked future against it:
+
+    * a completed future's mask must equal the expectation lane-for-lane
+      (a True on a byzantine lane = ``byz_accepted``, a False on an
+      honest lane = ``honest_rejected``);
+    * a rejected future (QoS shed/drop) must never claim validity
+      (``ok`` or any True lane = ``reject_claimed_valid``) and is only
+      honest on a sheddable class (``block_class_rejected`` otherwise);
+    * a seeded sample of lanes is re-verified on the CPU oracle to
+      confirm the constructed truth itself (``oracle_mismatch``).
+    """
+
+    def __init__(self, seed: int, oracle_sample: int = 128):
+        self._rng = random.Random(seed ^ 0x0DD5EED)
+        self._budget = max(0, oracle_sample)
+        self._pending: List[Tuple[str, object, List[bool], List[tuple]]] = []
+        self._oracle: List[Tuple[tuple, bool]] = []
+        self.counts: Dict[str, int] = {
+            "byz_accepted": 0,
+            "honest_rejected": 0,
+            "reject_claimed_valid": 0,
+            "block_class_rejected": 0,
+            "oracle_mismatch": 0,
+        }
+        self.settled = 0
+        self.lanes_checked = 0
+        self.rejected = 0
+        self.rejected_by_class: Dict[str, int] = {}
+
+    def track(
+        self,
+        qclass: str,
+        fut,
+        expected: List[bool],
+        items: List[tuple],
+    ) -> None:
+        self._pending.append((qclass, fut, list(expected), items))
+        # reservoir-free sampling: flip a coin per batch while budget
+        # remains — deterministic under the seed, spread across classes
+        if self._budget > 0 and items:
+            k = min(len(items), max(1, self._budget // 8))
+            for i in self._rng.sample(range(len(items)), k):
+                if self._budget <= 0:
+                    break
+                self._oracle.append((items[i], expected[i]))
+                self._budget -= 1
+
+    def score(
+        self, qclass: str, fut, expected: List[bool], timeout: float = 60.0
+    ) -> None:
+        """Resolve one future now (the engine uses this for the
+        latency-sampled consensus submits)."""
+        self._settle_one(qclass, fut, expected, timeout)
+
+    def _settle_one(self, qclass, fut, expected, timeout) -> None:
+        ok, mask = fut.result(timeout=timeout)
+        self.settled += 1
+        if getattr(fut, "rejected", False):
+            self.rejected += 1
+            self.rejected_by_class[qclass] = (
+                self.rejected_by_class.get(qclass, 0) + 1
+            )
+            if ok or any(mask):
+                self.counts["reject_claimed_valid"] += 1
+            if qclass not in _SHEDDABLE:
+                self.counts["block_class_rejected"] += 1
+            return
+        self.lanes_checked += len(expected)
+        for exp, got in zip(expected, mask):
+            if got and not exp:
+                self.counts["byz_accepted"] += 1
+            elif exp and not got:
+                self.counts["honest_rejected"] += 1
+
+    def settle(self, timeout: float = 60.0) -> None:
+        pending, self._pending = self._pending, []
+        for qclass, fut, expected, _items in pending:
+            self._settle_one(qclass, fut, expected, timeout)
+
+    def run_oracle(self) -> int:
+        """CPU-re-verify the sampled lanes against the constructed
+        truth. Returns lanes oracle-checked."""
+        from cometbft_tpu.crypto import batch as cryptobatch
+
+        if not self._oracle:
+            return 0
+        bv = cryptobatch.CPUBatchVerifier()
+        for (pk, msg, sig), _exp in self._oracle:
+            bv.add(pk, msg, sig)
+        _ok, mask = bv.verify()
+        for (_item, exp), got in zip(self._oracle, mask):
+            if bool(got) != bool(exp):
+                self.counts["oracle_mismatch"] += 1
+        n = len(self._oracle)
+        self._oracle = []
+        return n
+
+    @property
+    def wrong_verdicts(self) -> int:
+        return sum(self.counts.values())
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+def _qos_env(fn):
+    """Run ``fn`` under the storm's QoS knobs (default ladder, 5 ms shed
+    deadline), restoring the environment after — scheduler construction
+    reads these once."""
+    save = {
+        k: os.environ.get(k)
+        for k in ("CBFT_QOS_CLASSES", "CBFT_QOS_SHED_MS")
+    }
+    os.environ["CBFT_QOS_CLASSES"] = "default"
+    os.environ["CBFT_QOS_SHED_MS"] = "5"
+    try:
+        return fn()
+    finally:
+        for k, v in save.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_campaign(plan: AttackPlan, logger=None) -> dict:
+    """Drive one adversarial campaign through the full stack and return
+    the invariant summary (an ``expected`` sub-dict documents what the
+    callers assert, chaos-rung style)."""
+    from cometbft_tpu.crypto import faults as faultslib
+    from cometbft_tpu.crypto import service as servicelib
+    from cometbft_tpu.crypto.batch import BackendSpec
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+    from cometbft_tpu.crypto.supervisor import BackendSupervisor
+    from cometbft_tpu.crypto.telemetry import TelemetryHub
+    from cometbft_tpu.crypto.tpu import keystore as keystorelib
+
+    rng = random.Random(plan.seed)
+    committee = Committee(plan.committee, seed=plan.seed)
+    checker = InvariantChecker(plan.seed, oracle_sample=plan.oracle_sample)
+
+    # the "device": the honest CPU verifier behind an injected jitter —
+    # a non-cpu spec, so the supervisor actually supervises (triage,
+    # breaker, attribution) instead of short-circuiting to ground truth
+    name = "chaos-adversary-%d" % plan.seed
+    faultslib.install(
+        name=name, inner=plan.inner,
+        plan=faultslib.FaultPlan(seed=plan.seed, jitter_ms=plan.jitter_ms),
+    )
+
+    hub = TelemetryHub(slo_target_ms=plan.slo_target_ms, window_s=1.5)
+    sup = BackendSupervisor(
+        spec=BackendSpec(name), dispatch_timeout_ms=30_000,
+        breaker_threshold=8, audit_pct=0, probe_base_ms=10,
+        probe_max_ms=80, hedge_pct=0, retry_ms=5, logger=logger,
+    )
+    sched = _qos_env(lambda: VerifyScheduler(
+        spec=BackendSpec(name), supervisor=sup, flush_us=200,
+        lane_budget=8192, max_queue=256, telemetry=hub,
+        submit_timeout_ms=1000, logger=logger,
+    ))
+    hub.add_burn_watcher(sched.on_burn)
+    sched.start()
+
+    ks = keystorelib.default_store()
+    ks.invalidate()
+    ks_before = ks.residency()
+    ks_registrations = 0
+
+    stop_scrape = threading.Event()
+
+    def scraper():
+        while not stop_scrape.is_set():
+            hub.snapshot()
+            time.sleep(0.05)
+
+    scrape_t = threading.Thread(target=scraper, daemon=True)
+    scrape_t.start()
+
+    # -- optional service leg: ONE daemon on a unix socket, one remote
+    # client mirroring the consensus storm across the network boundary
+    svc = {"service": None, "sched": None}
+    client = None
+    sock_path = "/tmp/cbft-adversary-%d-%d.sock" % (plan.seed, os.getpid())
+    pool_mtx = threading.Lock()
+    svc_rng = random.Random(plan.seed ^ 0x5E1C)
+    restarts = 0
+
+    def floor_verifier(rows):
+        # a single serialized accelerator: memoized host ground truth
+        # behind one lock plus a seeded 2-8 ms floor per flush
+        with pool_mtx:
+            time.sleep(0.002 + 0.006 * svc_rng.random())
+            return _svc_inner(rows)
+
+    def start_server():
+        s2 = _qos_env(lambda: VerifyScheduler(
+            spec="cpu", flush_us=200, lane_budget=8192, max_queue=256,
+            submit_timeout_ms=1000, row_verifier=floor_verifier,
+            logger=logger,
+        ))
+        v2 = servicelib.VerifyService(
+            s2, "unix://" + sock_path, logger=logger,
+        )
+        s2.start()
+        v2.start()
+        svc["sched"], svc["service"] = s2, v2
+
+    def stop_server():
+        if svc["service"] is not None:
+            svc["service"].stop()
+            svc["service"] = None
+        if svc["sched"] is not None:
+            svc["sched"].stop()
+            svc["sched"] = None
+
+    if plan.service:
+        _svc_inner = servicelib.host_row_verifier()
+        start_server()
+        client = servicelib.RemoteVerifier(
+            "unix://" + sock_path, tenant="adversary",
+            timeout_ms=20_000, retry_s=0.05, logger=logger,
+        )
+        try:
+            client.register_valset(committee.pk_bytes())
+        except Exception:  # noqa: BLE001 - registration is an optimization
+            pass
+
+    svc_wrong = 0
+    svc_disconnect_walk: Dict[str, int] = {}
+    evidence_total = 0
+    spam_total = 0
+    byz_total = 0
+    honest_total = 0
+    unloaded: List[float] = []
+    loaded: List[float] = []
+    svc_loaded: List[float] = []
+
+    runs0 = sup.metrics.triage_runs.value()
+    passes0 = sup.metrics.triage_passes.value()
+
+    try:
+        # -- warmup (backend setup, memoized service pool) + unloaded
+        # baseline: clean full-committee heights, no storm
+        warm_items, warm_exp = committee.precommit_items(0, set())
+        sched.submit(
+            warm_items, subsystem="consensus", height=0
+        ).result(timeout=120)
+        if client is not None:
+            client.submit(
+                warm_items, subsystem="consensus", height=0
+            ).result(timeout=120)
+        for r in range(plan.unloaded_rounds):
+            items, expected = committee.precommit_items(0, set())
+            t0 = time.monotonic()
+            fut = sched.submit(items, subsystem="consensus", height=0)
+            fut.result(timeout=60)
+            unloaded.append(time.monotonic() - t0)
+            checker.score("consensus", fut, expected)
+
+        # -- the storm --------------------------------------------------
+        n_byz_per_height = int(round(plan.byzantine_rate * plan.committee))
+        for h in range(1, plan.heights + 1):
+            if plan.churn_every and h % plan.churn_every == 0:
+                committee.rotate(plan.churn_frac, rng)
+                # the node-side residency path: the rotated set becomes
+                # a registered keystore valset (LRU pressure = churn)
+                ks.register(committee.valset_id(), committee.pk_bytes())
+                ks_registrations += 1
+                if client is not None:
+                    try:
+                        client.register_valset(committee.pk_bytes())
+                    except Exception:  # noqa: BLE001 - optimization only
+                        pass
+
+            # spam + equivocation ride ahead of the consensus submit so
+            # the storm classes genuinely contend for the same flushes
+            if plan.spam_per_height:
+                s_items, s_exp = spam_items(
+                    plan.seed, h, plan.spam_per_height
+                )
+                spam_total += len(s_items)
+                checker.track(
+                    "mempool",
+                    sched.submit(s_items, subsystem="mempool", height=h),
+                    s_exp, s_items,
+                )
+            if (plan.equivocation_every
+                    and h % plan.equivocation_every == 0):
+                evs, e_items = committee.equivocation_burst(
+                    h, plan.equivocation_burst, rng
+                )
+                evidence_total += len(evs)
+                checker.track(
+                    "evidence",
+                    sched.submit(e_items, subsystem="evidence", height=h),
+                    [True] * len(e_items), e_items,
+                )
+
+            byz = set(rng.sample(range(plan.committee), n_byz_per_height))
+            items, expected = committee.precommit_items(h, byz)
+            byz_total += len(byz)
+            honest_total += len(items) - len(byz)
+
+            t0 = time.monotonic()
+            fut = sched.submit(items, subsystem="consensus", height=h)
+            fut.result(timeout=60)
+            loaded.append(time.monotonic() - t0)
+            checker.score("consensus", fut, expected)
+
+            if client is not None:
+                if (plan.kill_restart_height is not None
+                        and h == plan.kill_restart_height):
+                    # kill verifyd with a request in flight: freeze the
+                    # pool so the frames go pending, tear the daemon
+                    # down under them, and make the client prove its
+                    # containment (local ground truth, reason metered)
+                    with pool_mtx:
+                        k_fut = client.submit(
+                            items, subsystem="consensus", height=h
+                        )
+                        time.sleep(0.1)
+                        svc["service"].stop()
+                        svc["service"] = None
+                    svc["sched"].stop()
+                    svc["sched"] = None
+                    okk, kmask = k_fut.result(timeout=60)
+                    if getattr(k_fut, "reason", None) != "disconnected":
+                        svc_wrong += 1
+                    if kmask != expected:
+                        svc_wrong += 1
+                    # restart with an invalidated keystore: every client
+                    # generation is now stale, so resuming the indexed
+                    # route REQUIRES the re-register walk
+                    ks.invalidate()
+                    restarts += 1
+                    start_server()
+                else:
+                    t0 = time.monotonic()
+                    s_fut = client.submit(
+                        items, subsystem="consensus", height=h
+                    )
+                    oks, smask = s_fut.result(timeout=60)
+                    svc_loaded.append(time.monotonic() - t0)
+                    if getattr(s_fut, "rejected", False) or smask != expected:
+                        svc_wrong += 1
+
+        # -- drain + oracle --------------------------------------------
+        checker.settle()
+        oracle_lanes = checker.run_oracle()
+
+        runs = sup.metrics.triage_runs.value() - runs0
+        passes = sup.metrics.triage_passes.value() - passes0
+        offenders = {
+            c._labels["subsystem"]: c.value()
+            for c in sup.metrics.triage_offenders._series()
+            if "subsystem" in c._labels
+        }
+        snap = sched.queue_snapshot()
+        sup_state = sup.state()
+        ks_after = ks.residency()
+        client_stats = client.stats() if client is not None else {}
+        svc_snap = (
+            svc["service"].snapshot() if svc["service"] is not None else {}
+        )
+    finally:
+        stop_scrape.set()
+        scrape_t.join(timeout=10)
+        if client is not None:
+            client.close()
+        stop_server()
+        sched.stop()
+        sup.stop()
+        ks.invalidate()
+        try:
+            os.unlink(sock_path)
+        except OSError:
+            pass
+
+    # every byzantine signature was submitted under the consensus
+    # subsystem, so exact attribution means: triage convicted exactly
+    # byz_total consensus lanes and nobody else, ever
+    expected_offenders = (
+        {"consensus": float(byz_total)} if byz_total else {}
+    )
+    # the largest batch one flush can coalesce bounds each triage run
+    max_flush = (plan.committee + plan.spam_per_height
+                 + 2 * plan.equivocation_burst)
+    pass_bound = (math.ceil(math.log2(max_flush)) + 1) if max_flush > 1 else 1
+
+    cls = snap["qos"]["classes"]
+    # the SLO is attack-aware: the storm dispatch quantum times the
+    # serial triage depth the configured flood can force (a 100%
+    # takeover legitimately costs ceil(log2 n)+1 extra passes a height;
+    # that is bisection working, not liveness lost)
+    depth = _forced_triage_depth(plan.committee, plan.byzantine_rate)
+    floor_ms = _dispatch_floor_ms(plan.committee) * depth
+    latency_bound_ms = 2.0 * max(_p99_ms(unloaded), floor_ms)
+    loaded_p99 = _p99_ms(loaded)
+
+    summary = {
+        "seed": plan.seed,
+        "committee": plan.committee,
+        "heights": plan.heights,
+        "byzantine_rate": plan.byzantine_rate,
+        "churn_every": plan.churn_every,
+        "rotations": committee.rotations,
+        "injected": {
+            "byzantine": byz_total,
+            "honest": honest_total,
+            "equivocation_pairs": evidence_total,
+            "spam": spam_total,
+        },
+        "wrong_verdicts": checker.wrong_verdicts + svc_wrong,
+        "wrong_by_kind": dict(checker.counts),
+        "service_wrong_verdicts": svc_wrong,
+        "lanes_checked": checker.lanes_checked,
+        "oracle_lanes": oracle_lanes,
+        "rejected": checker.rejected,
+        "rejected_by_class": dict(checker.rejected_by_class),
+        "offenders": offenders,
+        "expected_offenders": expected_offenders,
+        "offenders_exact": offenders == expected_offenders,
+        "triage_runs": runs,
+        "triage_passes": passes,
+        "triage_pass_bound": pass_bound,
+        "triage_pass_bound_ok": passes <= max(1, runs) * pass_bound,
+        "unloaded_p50_ms": round(_p50_ms(unloaded), 2),
+        "unloaded_p99_ms": round(_p99_ms(unloaded), 2),
+        "loaded_p50_ms": round(_p50_ms(loaded), 2),
+        "loaded_p99_ms": round(loaded_p99, 2),
+        "latency_bound_ms": round(latency_bound_ms, 2),
+        "latency_ok": loaded_p99 <= latency_bound_ms,
+        "consensus_sheds": cls["consensus"]["sheds"],
+        "consensus_drops": cls["consensus"]["drops"],
+        "evidence_sheds": cls["evidence"]["sheds"],
+        "evidence_drops": cls["evidence"]["drops"],
+        "spam_sheds": cls["mempool"]["sheds"],
+        "spam_drops": cls["mempool"]["drops"],
+        "supervisor_state": sup_state,
+        "keystore": {
+            "registrations": ks_registrations,
+            "thrash": (
+                ks_after.get("thrash", 0) - ks_before.get("thrash", 0)
+            ),
+            "entries": ks_after.get("entries", 0),
+        },
+        "service": {
+            "enabled": plan.service,
+            "restarts": restarts,
+            "wrong_verdicts": svc_wrong,
+            "p99_ms": round(_p99_ms(svc_loaded), 2),
+            "client": {
+                k: client_stats.get(k, 0)
+                for k in ("connects", "registrations", "remote_ok",
+                          "disconnected", "stale", "resync_failed")
+            },
+            "snapshot_lanes": {
+                str(k): v
+                for k, v in (svc_snap.get("lanes") or {}).items()
+            },
+        },
+        "expected": {
+            "wrong_verdicts": 0,
+            "offenders": "exactly {consensus: n_byzantine}",
+            "triage_passes": "<= runs * (ceil(log2 max_flush)+1)",
+            "consensus_sheds": 0,
+            "consensus_drops": 0,
+            "evidence_sheds": 0,
+            "evidence_drops": 0,
+            "supervisor_state": "healthy (bad sigs are not device "
+                                "incidents)",
+            "latency": "loaded p99 <= 2x max(unloaded p99, %.0fms "
+                       "= quantum x forced triage depth %d)"
+            % (floor_ms, depth),
+            "service_walk": "disconnected >= 1, connects >= 2, "
+                            "registrations >= 2 when a restart is "
+                            "planned",
+        },
+    }
+    return summary
+
+
+def campaign_ok(summary: dict) -> bool:
+    """The rung gate shared by tools/chaos.py, the tier-1 test, and the
+    bench stage: zero wrong verdicts, exact attribution, bounded triage,
+    block classes never shed, liveness held, breaker never moved."""
+    ok = (
+        summary["wrong_verdicts"] == 0
+        and summary["offenders_exact"]
+        and summary["triage_pass_bound_ok"]
+        and summary["consensus_sheds"] == 0
+        and summary["consensus_drops"] == 0
+        and summary["evidence_sheds"] == 0
+        and summary["evidence_drops"] == 0
+        and summary["supervisor_state"] == "healthy"
+        and summary["latency_ok"]
+    )
+    if summary["service"]["enabled"] and summary["service"]["restarts"]:
+        c = summary["service"]["client"]
+        ok = ok and (
+            c["disconnected"] >= 1
+            and c["connects"] >= 2
+            and c["registrations"] >= 2
+            and c["remote_ok"] >= 1
+        )
+    return ok
+
+
+# ---------------------------------------------------------------------------
+# rungs
+# ---------------------------------------------------------------------------
+
+
+def run_chaos_adversary(
+    seed: int = 1234,
+    committee: int = 512,
+    heights: int = 16,
+    byzantine_rate: float = 0.25,
+    churn_every: int = 8,
+    service: bool = True,
+    logger=None,
+) -> dict:
+    """The deterministic tier-1 adversary rung — the ISSUE-18 acceptance
+    shape: 512 validators, 25% byzantine flood, per-8-height churn, an
+    equivocation burst every 4 heights, non-validator spam every height,
+    and one mid-storm verifyd kill/restart across the network boundary.
+    """
+    plan = AttackPlan(
+        committee=committee,
+        heights=heights,
+        byzantine_rate=byzantine_rate,
+        churn_every=churn_every,
+        service=service,
+        kill_restart_height=(heights // 2) if service else None,
+        seed=seed,
+    )
+    return run_campaign(plan, logger=logger)
+
+
+def run_adversary_ladder(
+    seed: int = 1234,
+    sizes: Sequence[int] = (128, 512, 1024),
+    heights: int = 8,
+    byzantine_rate: float = 0.25,
+    service: bool = False,
+    logger=None,
+) -> dict:
+    """Walk the committee-size ladder (the soak rung and the bench
+    stage): one in-process campaign per size, p50/p99 commit-verify and
+    the zero-wrong-verdict gate at each."""
+    rungs = {}
+    ok = True
+    for n in sizes:
+        plan = AttackPlan(
+            committee=n,
+            heights=heights,
+            byzantine_rate=byzantine_rate,
+            churn_every=max(2, heights // 2),
+            equivocation_every=max(2, heights // 2),
+            spam_per_height=max(8, n // 16),
+            service=service,
+            kill_restart_height=None,
+            seed=seed + n,
+        )
+        s = run_campaign(plan, logger=logger)
+        rungs[str(n)] = s
+        ok = ok and campaign_ok(s)
+    return {
+        "seed": seed,
+        "sizes": list(sizes),
+        "ok": ok,
+        "rungs": rungs,
+    }

@@ -2229,3 +2229,14 @@ def run_chaos_stale_model(
             k: v for k, v in summary.items() if k != "expected"
         })
     return summary
+
+
+def run_chaos_adversary(**kwargs) -> dict:
+    """Workload-side chaos: the adversarial committee rung
+    (crypto/adversary.py) — byzantine vote floods, valset churn,
+    equivocation storms, and a mid-storm verifyd restart. Thin
+    delegation so the chaos registry stays the one place callers look
+    for every rung."""
+    from cometbft_tpu.crypto import adversary
+
+    return adversary.run_chaos_adversary(**kwargs)

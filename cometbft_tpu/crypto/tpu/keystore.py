@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict
+from contextlib import contextmanager
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -54,6 +55,8 @@ class KeyStoreEntry:
         "index",           # dict: pubkey bytes -> row in table_dev
         "table_dev",       # device u8[n_pad, 32] gather table
         "n",               # live key count
+        "hits",            # uses since upload (0 at eviction = thrash)
+        "pins",            # in-flight dispatches holding LRU immunity
     )
 
 
@@ -97,7 +100,30 @@ class DeviceKeyStore:
             "stale_drops": 0,
             "indexed_dispatches": 0,
             "indexed_lanes": 0,
+            # LRU evictions of entries that never served a single use:
+            # the churn-thrash signal (valsets rotating faster than
+            # flushes drain the cache)
+            "keystore_thrash": 0,
         }
+
+    def _evict_excess_locked(self) -> None:
+        """LRU eviction that honors pins: an in-flight indexed dispatch
+        pins its entry, so per-height valset rotation can never yank the
+        incoming table out from under a flush mid-dispatch. If every
+        entry is pinned the cache overflows temporarily (unpin resumes
+        eviction). An evicted entry that never served a hit counts as
+        ``keystore_thrash``."""
+        while len(self._entries) > self._max:
+            victim_id = None
+            for vid, e in self._entries.items():  # oldest first
+                if getattr(e, "pins", 0) <= 0:
+                    victim_id = vid
+                    break
+            if victim_id is None:
+                return
+            e = self._entries.pop(victim_id)
+            if getattr(e, "hits", 0) == 0:
+                self._stats["keystore_thrash"] += 1
 
     def get(self, valset_id: bytes, pub_keys, build) -> KeyStoreEntry:
         """Resident entry for valset_id, building (slow H2D, outside the
@@ -111,6 +137,7 @@ class DeviceKeyStore:
                 if e.topo_generation == topo_gen:
                     self._entries.move_to_end(valset_id)
                     self._stats["hits"] += 1
+                    e.hits = getattr(e, "hits", 0) + 1
                     return e
                 del self._entries[valset_id]
                 self._stats["stale_drops"] += 1
@@ -127,11 +154,46 @@ class DeviceKeyStore:
                 return won
             self._gen += 1
             e.generation = self._gen
+            e.hits = getattr(e, "hits", 0)
+            e.pins = getattr(e, "pins", 0)
             self._entries[valset_id] = e
             self._stats["uploads"] += 1
-            while len(self._entries) > self._max:
-                self._entries.popitem(last=False)
+            self._evict_excess_locked()
         return e
+
+    def pin(self, valset_id: bytes) -> bool:
+        """Mark the entry immune to LRU eviction (refcounted) for the
+        duration of an in-flight dispatch, and count the use. Pins guard
+        against cache PRESSURE only: explicit ``invalidate`` and
+        topology-staleness drops still apply — a dispatch that already
+        holds the entry object completes against its own table either
+        way. Returns False when the entry is already gone."""
+        with self._mtx:
+            e = self._entries.get(bytes(valset_id))
+            if e is None:
+                return False
+            e.pins = getattr(e, "pins", 0) + 1
+            e.hits = getattr(e, "hits", 0) + 1
+            return True
+
+    def unpin(self, valset_id: bytes) -> None:
+        with self._mtx:
+            e = self._entries.get(bytes(valset_id))
+            if e is not None:
+                e.pins = max(0, getattr(e, "pins", 0) - 1)
+            # eviction deferred while everything was pinned resumes here
+            self._evict_excess_locked()
+
+    @contextmanager
+    def pinned(self, valset_id: bytes):
+        """``with store.pinned(vid) as ok:`` — pin for the block when the
+        entry exists (ok True), always balanced on exit."""
+        ok = self.pin(valset_id)
+        try:
+            yield ok
+        finally:
+            if ok:
+                self.unpin(valset_id)
 
     def lookup_fresh(self, topo_gen: Optional[int] = None
                      ) -> List[KeyStoreEntry]:
@@ -213,6 +275,7 @@ class DeviceKeyStore:
                 return None
             self._entries.move_to_end(vid)
             self._stats["hits"] += 1
+            e.hits = getattr(e, "hits", 0) + 1
             return e
 
     def register(self, valset_id: bytes, pub_keys) -> KeyStoreEntry:
@@ -230,6 +293,7 @@ class DeviceKeyStore:
             if e is not None:
                 self._entries.move_to_end(vid)
                 self._stats["hits"] += 1
+                e.hits = getattr(e, "hits", 0) + 1
                 return e
             self._stats["misses"] += 1
         keys = [_key_bytes(pk) for pk in pub_keys]
@@ -243,6 +307,8 @@ class DeviceKeyStore:
         e.index = {}
         e.table_dev = None
         e.n = n
+        e.hits = 0
+        e.pins = 0
         for i, k in enumerate(keys):
             if len(k) == 32:
                 e.pk_arr[i] = np.frombuffer(k, np.uint8)
@@ -257,8 +323,7 @@ class DeviceKeyStore:
             e.generation = self._gen
             self._entries[vid] = e
             self._stats["uploads"] += 1
-            while len(self._entries) > self._max:
-                self._entries.popitem(last=False)
+            self._evict_excess_locked()
         return e
 
     def note_indexed(self, lanes: int) -> None:
@@ -280,6 +345,7 @@ class DeviceKeyStore:
                 "generation": self._gen,
                 "hit_rate": (hits / lookups) if lookups else None,
                 "indexed_dispatches": self._stats["indexed_dispatches"],
+                "thrash": self._stats["keystore_thrash"],
             }
 
     def snapshot(self) -> dict:
@@ -294,6 +360,7 @@ class DeviceKeyStore:
                         "topo_generation": e.topo_generation,
                         "keys": e.n,
                         "chunks": len(e.chunks),
+                        "pins": getattr(e, "pins", 0),
                     }
                     for e in self._entries.values()
                 ],
@@ -385,45 +452,49 @@ def verify_batch_indexed(
     # same double-buffered shape as the resident commit loop: pack +
     # async H2D of chunk i+1 overlaps the device's work on chunk i.
     # Only the per-flush staging (idx + rsh) is donated — the resident
-    # table must survive across flushes.
-    for start in range(0, n, max_chunk):
-        end = min(start + max_chunk, n)
-        t_pack = time.perf_counter()
-        rsh, valid = ed._prepare_rsh_compact(
-            np.stack([
-                np.frombuffer(_key_bytes(pk), np.uint8) for pk in
-                pub_keys[start:end]
-            ]),
-            msgs[start:end], sigs[start:end],
-        )
-        size = ed._MIN_PAD
-        while size < end - start:
-            size *= 2
-        rsh_pad = np.zeros((96, size), np.uint8)
-        rsh_pad[:, : end - start] = rsh
-        idx_pad = np.zeros(size, np.int32)
-        idx_pad[: end - start] = idx_full[start:end]
-        t_h2d = time.perf_counter()
-        idx_dev = jax.device_put(jnp.asarray(idx_pad))
-        rsh_dev = jax.device_put(jnp.asarray(rsh_pad))
-        t_compute = time.perf_counter()
-        mask = mesh_mod.run_single(
-            ed.verify_kernel_indexed,
-            [entry.table_dev, idx_dev, rsh_dev],
-            donate_from=1,
-        )
-        t_done = time.perf_counter()
-        winfo = (
-            size,
-            rsh_pad.nbytes + idx_pad.nbytes,  # 100 B per padded lane
-            t_h2d - t_pack,
-            t_compute - t_h2d,
-            t_done - t_compute,
-        )
-        inflight.append((start, end, mask, valid, winfo))
-        while len(inflight) > depth:
+    # table must survive across flushes. The entry is PINNED for the
+    # whole chunk loop: per-height valset rotation would otherwise LRU-
+    # evict the incoming table mid-flush (churn thrash) and force the
+    # next flush to re-upload what this one was still gathering from.
+    with _default.pinned(entry.valset_id):
+        for start in range(0, n, max_chunk):
+            end = min(start + max_chunk, n)
+            t_pack = time.perf_counter()
+            rsh, valid = ed._prepare_rsh_compact(
+                np.stack([
+                    np.frombuffer(_key_bytes(pk), np.uint8) for pk in
+                    pub_keys[start:end]
+                ]),
+                msgs[start:end], sigs[start:end],
+            )
+            size = ed._MIN_PAD
+            while size < end - start:
+                size *= 2
+            rsh_pad = np.zeros((96, size), np.uint8)
+            rsh_pad[:, : end - start] = rsh
+            idx_pad = np.zeros(size, np.int32)
+            idx_pad[: end - start] = idx_full[start:end]
+            t_h2d = time.perf_counter()
+            idx_dev = jax.device_put(jnp.asarray(idx_pad))
+            rsh_dev = jax.device_put(jnp.asarray(rsh_pad))
+            t_compute = time.perf_counter()
+            mask = mesh_mod.run_single(
+                ed.verify_kernel_indexed,
+                [entry.table_dev, idx_dev, rsh_dev],
+                donate_from=1,
+            )
+            t_done = time.perf_counter()
+            winfo = (
+                size,
+                rsh_pad.nbytes + idx_pad.nbytes,  # 100 B per padded lane
+                t_h2d - t_pack,
+                t_compute - t_h2d,
+                t_done - t_compute,
+            )
+            inflight.append((start, end, mask, valid, winfo))
+            while len(inflight) > depth:
+                retire(inflight.popleft())
+        while inflight:
             retire(inflight.popleft())
-    while inflight:
-        retire(inflight.popleft())
     _default.note_indexed(n)
     return list(out)

@@ -504,6 +504,72 @@ class TestTriage:
         finally:
             sup.stop()
 
+    def test_half_bad_batch_exact_verdicts_and_attribution(self):
+        # PR 18 edge: a 50% byzantine flood, invalid lanes interleaved
+        # with honest ones — worst case for run-coalescing (every
+        # suspect segment is a singleton). Verdicts stay lane-exact,
+        # attribution splits exactly across the contributing
+        # subsystems, and the breaker never moves for signature crime.
+        plan, sup = _faulty()
+        n = 64
+        items = _make_items(n, b"half")
+        for lane in range(1, n, 2):
+            pk, m, s = items[lane]
+            items[lane] = (pk, m, bytes(s[:-1]) + bytes([s[-1] ^ 1]))
+        truth = _cpu_mask(items)
+        assert truth.count(False) == n // 2
+        try:
+            before = sup.metrics.device_dispatches.value()
+            mask = sup.verify_items(
+                items, reason="flush",
+                origins=[(n // 2, "consensus", 9),
+                         (n // 2, "blocksync", 9)],
+            )
+            assert mask == truth
+            passes = sup.metrics.device_dispatches.value() - before - 1
+            assert 1 <= passes <= math.ceil(math.log2(n)) + 1
+            offenders = {
+                c._labels["subsystem"]: c.value()
+                for c in sup.metrics.triage_offenders._series()
+                if "subsystem" in c._labels
+            }
+            assert offenders == {"consensus": 16.0, "blocksync": 16.0}
+            assert sup.metrics.triage_divergence.value() == 0
+            assert sup.state() == HEALTHY
+        finally:
+            sup.stop()
+
+    def test_all_byzantine_flush_convicts_every_lane(self):
+        # PR 18 edge: 100% of the flush is invalid — one maximal
+        # suspect segment spanning the whole batch. Every lane
+        # convicts, the full flush is charged to its origin, the pass
+        # bound holds, and no conviction is overturned (so no breaker
+        # trip: a byzantine committee is not a device incident).
+        plan, sup = _faulty()
+        n = 32
+        items = _make_items(n, b"allbad")
+        for lane in range(n):
+            pk, m, s = items[lane]
+            items[lane] = (pk, m, bytes(s[:-1]) + bytes([s[-1] ^ 1]))
+        try:
+            before = sup.metrics.device_dispatches.value()
+            mask = sup.verify_items(
+                items, reason="flush", origins=[(n, "consensus", 3)],
+            )
+            assert mask == [False] * n
+            passes = sup.metrics.device_dispatches.value() - before - 1
+            assert 1 <= passes <= math.ceil(math.log2(n)) + 1
+            offenders = {
+                c._labels["subsystem"]: c.value()
+                for c in sup.metrics.triage_offenders._series()
+                if "subsystem" in c._labels
+            }
+            assert offenders == {"consensus": float(n)}
+            assert sup.metrics.triage_divergence.value() == 0
+            assert sup.state() == HEALTHY
+        finally:
+            sup.stop()
+
     def test_triage_device_death_falls_back_to_cpu(self):
         # the device dies mid-triage: remaining suspects go to the CPU
         # ground truth, verdicts stay exact, no breaker strike for it

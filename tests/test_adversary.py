@@ -1,0 +1,106 @@
+"""Adversarial-committee rung (PR 18) in tier-1.
+
+A hostile committee drives the full scheduler -> supervisor -> service
+stack through one storm campaign: byzantine signature floods at a 25%
+lane rate, double-sign evidence bursts through the ``evidence`` QoS
+tenant, non-validator vote spam on ``mempool``, a valset rotation
+mid-storm (keystore generation invalidation + service re-register),
+and a verifyd kill/restart while a request is on the wire. The
+zero-wrong-verdict invariants are the same ones tools/chaos.py
+--adversary gates on; the fast rung here runs a 128-seat committee so
+tier-1 stays quick, the slow soak walks the 512-seat acceptance shape.
+"""
+
+import math
+
+import pytest
+
+
+def _assert_invariants(s):
+    # safety: no wrong verdict anywhere — not on device, not on the
+    # CPU fallback, not across the service wire, not vs the oracle
+    assert s["wrong_verdicts"] == 0, s["wrong_by_kind"]
+    assert s["service_wrong_verdicts"] == 0
+    # attribution: every injected byzantine lane charged to consensus,
+    # nothing charged to the honest evidence/spam tenants
+    assert s["offenders_exact"], (s["offenders"], s["expected_offenders"])
+    # triage stayed inside the bisection pass bound per run
+    assert s["triage_pass_bound_ok"], (
+        f"{s['triage_passes']} passes over {s['triage_runs']} runs, "
+        f"bound {s['triage_pass_bound']}/run"
+    )
+    # liveness: block-class tenants never shed or dropped, the breaker
+    # never left healthy, and storm p99 held the committee-scaled SLO
+    assert s["consensus_sheds"] == 0 and s["consensus_drops"] == 0
+    assert s["evidence_sheds"] == 0 and s["evidence_drops"] == 0
+    assert s["supervisor_state"] == "healthy"
+    assert s["latency_ok"], (
+        f"loaded p99 {s['loaded_p99_ms']}ms over bound "
+        f"{s['latency_bound_ms']}ms"
+    )
+
+
+class TestAdversaryRung:
+    def test_adversary_campaign_fast(self):
+        from cometbft_tpu.crypto.adversary import (
+            AttackPlan,
+            campaign_ok,
+            run_campaign,
+        )
+
+        plan = AttackPlan(
+            committee=128,
+            heights=8,
+            byzantine_rate=0.25,
+            churn_every=4,
+            equivocation_every=2,
+            equivocation_burst=4,
+            spam_per_height=16,
+            service=True,
+            kill_restart_height=4,
+            seed=37,
+        )
+        s = run_campaign(plan)
+        _assert_invariants(s)
+        # the storm actually happened: floods, bursts, spam, a rotation
+        assert s["injected"]["byzantine"] == 8 * 32
+        assert s["injected"]["equivocation_pairs"] >= 8
+        assert s["injected"]["spam"] >= 64
+        assert s["rotations"] >= 1
+        assert s["keystore"]["registrations"] >= 1
+        # the rotation churned the committee through the keystore
+        # without thrashing live entries out from under a dispatch
+        assert s["triage_runs"] >= 1
+        # restart recovery: the mid-storm kill resolved the in-flight
+        # request locally with the distinct reason, then the client
+        # walked reconnect -> re-register -> indexed resume
+        svc = s["service"]
+        assert svc["restarts"] == 1
+        assert svc["client"]["disconnected"] >= 1
+        assert svc["client"]["connects"] >= 2
+        assert svc["client"]["registrations"] >= 2
+        assert svc["client"]["remote_ok"] >= 1
+        # the single gate the chaos CLI applies agrees
+        assert campaign_ok(s), s
+
+    def test_pass_bound_shape(self):
+        # the structural bound the campaign asserts per triage run is
+        # the PR 5 bisection guarantee: ceil(log2 n) + 1 passes
+        from cometbft_tpu.crypto.adversary import AttackPlan
+
+        p = AttackPlan(committee=512, spam_per_height=32,
+                       equivocation_burst=8)
+        worst = p.committee + p.spam_per_height + 2 * p.equivocation_burst
+        assert math.ceil(math.log2(worst)) + 1 == 11
+
+    @pytest.mark.slow
+    def test_adversary_acceptance_512_soak(self):
+        from cometbft_tpu.crypto.adversary import run_chaos_adversary
+
+        s = run_chaos_adversary(seed=41, committee=512, heights=16,
+                                byzantine_rate=0.25, churn_every=8)
+        _assert_invariants(s)
+        assert s["injected"]["byzantine"] == 16 * 128
+        assert s["service"]["restarts"] == 1
+        assert s["service"]["client"]["disconnected"] >= 1
+        assert s["service"]["client"]["connects"] >= 2

@@ -249,3 +249,77 @@ class TestSnapshotPlumbing:
         assert res["generation"] >= 1
         assert 0.0 < res["hit_rate"] <= 1.0
         assert isinstance(res["indexed_dispatches"], int)
+
+
+class TestChurnThrash:
+    """PR 18: valset churn faster than flushes drain the cache must
+    never yank an in-flight table (pins) and must be visible as the
+    ``keystore_thrash`` counter (evictions of never-hit entries)."""
+
+    def _pks(self, tag, n=3):
+        return [hashlib.sha256(tag + b"-%d" % i).digest()
+                for i in range(n)]
+
+    def test_pinned_entry_survives_lru_pressure(self, store):
+        vid_a = hashlib.sha256(b"pin-a").digest()
+        store.register(vid_a, self._pks(b"pin-a"))
+        assert store.pin(vid_a)
+        try:
+            # churn well past CACHE_MAX while the dispatch is in flight
+            for i in range(keystore.CACHE_MAX + 2):
+                vid = hashlib.sha256(b"pin-press-%d" % i).digest()
+                store.register(vid, self._pks(b"pin-press-%d" % i))
+            with store._mtx:
+                held = set(store._entries.keys())
+            assert vid_a in held, "pinned entry yanked under pressure"
+            assert len(held) == keystore.CACHE_MAX
+        finally:
+            store.unpin(vid_a)
+        # eviction resumes once the dispatch lands: the next insert
+        # takes out the (now oldest, unpinned) formerly-pinned entry
+        vid_z = hashlib.sha256(b"pin-z").digest()
+        store.register(vid_z, self._pks(b"pin-z"))
+        with store._mtx:
+            held = set(store._entries.keys())
+        assert vid_a not in held
+        assert vid_z in held
+
+    def test_pin_context_manager_balances(self, store):
+        vid = hashlib.sha256(b"pin-ctx").digest()
+        store.register(vid, self._pks(b"pin-ctx"))
+        with store.pinned(vid) as ok:
+            assert ok
+            with store._mtx:
+                assert store._entries[vid].pins == 1
+        with store._mtx:
+            assert store._entries[vid].pins == 0
+        # pinning a missing entry reports False and never raises
+        with store.pinned(b"\x00" * 32) as ok:
+            assert not ok
+
+    def test_thrash_counts_never_hit_evictions(self, store):
+        base = store.residency()["thrash"]
+        # the adversary's churn shape: rotate valsets faster than any
+        # flush touches them — every eviction is of a never-hit entry
+        for i in range(keystore.CACHE_MAX + 3):
+            vid = hashlib.sha256(b"thrash-%d" % i).digest()
+            store.register(vid, self._pks(b"thrash-%d" % i))
+        assert store.residency()["thrash"] == base + 3
+
+    def test_served_entries_do_not_count_as_thrash(self, store):
+        base = store.residency()["thrash"]
+        # entries that served at least one flush are working-set
+        # turnover, not thrash
+        vids = []
+        for i in range(keystore.CACHE_MAX):
+            vid = hashlib.sha256(b"used-%d" % i).digest()
+            store.register(vid, self._pks(b"used-%d" % i))
+            store.register(vid, self._pks(b"used-%d" % i))  # a hit
+            vids.append(vid)
+        for i in range(keystore.CACHE_MAX):
+            vid = hashlib.sha256(b"churn-%d" % i).digest()
+            store.register(vid, self._pks(b"churn-%d" % i))
+        with store._mtx:
+            held = set(store._entries.keys())
+        assert all(v not in held for v in vids), "all churned out"
+        assert store.residency()["thrash"] == base

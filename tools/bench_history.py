@@ -150,6 +150,15 @@ def direction(path: str, unit: Optional[str] = None) -> Optional[str]:
         return HIGHER_IS_BETTER
     if leaf.endswith("_service_p99_ms") or leaf == "service_p99_ms":
         return LOWER_IS_BETTER
+    # adversarial-committee guards (PR 18): wrong verdicts are a
+    # zero-tolerance one-way ratchet (a bare count the n_-prefix/count
+    # conventions would otherwise drop), and the per-committee-size
+    # storm p99 leaves are pinned so a suffix-rule rework can't
+    # silently drop the committee-scale latency guard
+    if leaf.endswith("_wrong_verdicts"):
+        return LOWER_IS_BETTER
+    if leaf.startswith("adversary_") and leaf.endswith("_p99_ms"):
+        return LOWER_IS_BETTER
     if leaf.endswith(("_ms", "_s", "_us", "_ns")) or "_ms_" in leaf:
         return LOWER_IS_BETTER
     return None
@@ -335,7 +344,7 @@ def _self_test() -> int:
     injected 20% regression MUST flag. → process exit code."""
     import tempfile
 
-    def rec(sps: float, p50: float) -> dict:
+    def rec(sps: float, p50: float, adv_p99: float = 80.0) -> dict:
         return {
             "metric": "selftest_throughput",
             "value": round(sps, 1),
@@ -343,20 +352,44 @@ def _self_test() -> int:
             "stages": {
                 "run": {"sigs_per_sec": round(sps, 1)},
                 "p50": {"verify_commit_p50_ms": round(p50, 2)},
+                "adversary": {
+                    "adversary_512_p99_ms": round(adv_p99, 2),
+                    "adversary_wrong_verdicts": 0,
+                },
             },
         }
 
-    stable = [rec(1000.0 + 3 * i, 50.0 + 0.05 * i) for i in range(5)]
+    stable = [
+        rec(1000.0 + 3 * i, 50.0 + 0.05 * i, 80.0 + 0.2 * i)
+        for i in range(5)
+    ]
     cases = {
         # newest within ~1% of the rolling median: must NOT flag
         "clean": (stable + [rec(1010.0, 50.3)], 0),
         # one noisy run, then back in band: a blip, must NOT flag
-        "blip": (stable + [rec(800.0, 62.0), rec(1011.0, 50.3)], 0),
-        # injected 20% throughput drop + 24% latency bump, sustained
-        # over the confirmation window: MUST flag
-        "regressed": (stable + [rec(801.0, 61.8), rec(800.0, 62.0)], 1),
+        "blip": (stable + [rec(800.0, 62.0, 101.0),
+                           rec(1011.0, 50.3)], 0),
+        # injected 20% throughput drop + 24% latency bump (storm p99
+        # included), sustained over the confirmation window: MUST flag
+        "regressed": (stable + [rec(801.0, 61.8, 100.5),
+                                rec(800.0, 62.0, 101.0)], 1),
     }
     failures = []
+    # the adversary wrong-verdict leaf's healthy baseline is 0, which
+    # the band math skips (base == 0) — so prove the direction rules
+    # themselves: a wrong-verdict increase and a storm-p99 increase are
+    # both regressions, and the spelled-out leaves carry a direction
+    for path, want in (
+        ("stages.adversary.adversary_wrong_verdicts", LOWER_IS_BETTER),
+        ("stages.adversary.adversary_512_p99_ms", LOWER_IS_BETTER),
+        ("stages.adversary.adversary_1024_p50_ms", LOWER_IS_BETTER),
+    ):
+        got = direction(path)
+        ok = got == want
+        print(f"self-test direction {path}: {got} "
+              f"{'ok' if ok else 'FAIL (want %s)' % want}")
+        if not ok:
+            failures.append(path)
     with tempfile.TemporaryDirectory() as td:
         for name, (rows, want_rc) in cases.items():
             ledger = os.path.join(td, f"{name}.jsonl")
@@ -373,6 +406,7 @@ def _self_test() -> int:
                 ok = (
                     "stages.run.sigs_per_sec" in flagged
                     and "stages.p50.verify_commit_p50_ms" in flagged
+                    and "stages.adversary.adversary_512_p99_ms" in flagged
                 )
             print(f"self-test {name}: rc={rc} (want {want_rc}) "
                   f"{'ok' if ok else 'FAIL'}")

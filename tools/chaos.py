@@ -79,6 +79,21 @@ Nine modes:
   wrong verdicts), brownout trips and re-admits, payload stays at
   <= 128 bytes/lane, and the service drains to zero pending.
 
+* --adversary — crypto/adversary.py run_chaos_adversary: the
+  workload-side attack rung. A synthesized committee (default 512
+  validators, real ed25519 keys and canonical vote sign-bytes) storms
+  the full stack: 25% byzantine vote flood per height, valset churn
+  every 8 heights, equivocation (double-sign evidence) bursts through
+  the evidence tenant, non-validator vote spam through the mempool
+  tenant, and one mid-storm verifyd kill/restart across the service
+  boundary. Asserts zero wrong verdicts (construction-time ground
+  truth + CPU oracle), exact triage attribution of every injected
+  byzantine signature, the ceil(log2 n)+1 triage pass bound, consensus
+  p99 within 2x the unloaded bound, a healthy breaker (bad signatures
+  are not device incidents), and the client's full disconnected ->
+  reconnect -> re-register -> indexed recovery walk. With --soak it
+  walks the committee ladder (128/512/1k/4k) instead — the slow tier.
+
 * --soak — crypto/faults.py run_chaos_soak: a randomized fault schedule
   (exceptions, hangs, silent verdict corruption, sudden death, jitter,
   OOM, transient flaps) over N simulated blocks through a supervised
@@ -186,6 +201,21 @@ def main() -> int:
     ap.add_argument("--stale-jitter-ms", type=float, default=300.0,
                     help="[stale-model] per-dispatch jitter draw "
                          "ceiling for the stale regime (default 300)")
+    ap.add_argument("--adversary", action="store_true",
+                    help="run the adversarial-committee rung: byzantine "
+                         "vote flood + valset churn + equivocation "
+                         "storm + spam + mid-storm verifyd restart, "
+                         "zero wrong verdicts and exact attribution "
+                         "(with --soak: the 128/512/1k/4k committee "
+                         "ladder instead)")
+    ap.add_argument("--committee", type=int, default=512,
+                    help="[adversary] validator-committee size "
+                         "(default 512)")
+    ap.add_argument("--heights", type=int, default=16,
+                    help="[adversary] storm heights (default 16)")
+    ap.add_argument("--byz-rate", type=float, default=0.25,
+                    help="[adversary] byzantine signature rate per "
+                         "height (default 0.25)")
     args = ap.parse_args()
 
     if args.inner == "cpu":
@@ -198,6 +228,33 @@ def main() -> int:
         os.environ["CBFT_FAULT_OOM_RATE"] = str(args.oom_rate)
     if args.transient_n is not None:
         os.environ["CBFT_FAULT_TRANSIENT_N"] = str(args.transient_n)
+
+    if args.adversary:
+        from cometbft_tpu.crypto.adversary import (
+            campaign_ok,
+            run_adversary_ladder,
+            run_chaos_adversary,
+        )
+
+        if args.soak:
+            summary = run_adversary_ladder(
+                seed=args.seed, sizes=(128, 512, 1024, 4096),
+                heights=args.heights, byzantine_rate=args.byz_rate,
+            )
+            print(json.dumps(summary, indent=2, default=str))
+            ok = summary["ok"]
+            print("CHAOS ADVERSARY-SOAK", "PASS" if ok else "FAIL",
+                  "seed=%d" % args.seed)
+            return 0 if ok else 1
+        summary = run_chaos_adversary(
+            seed=args.seed, committee=args.committee,
+            heights=args.heights, byzantine_rate=args.byz_rate,
+        )
+        print(json.dumps(summary, indent=2, default=str))
+        ok = campaign_ok(summary)
+        print("CHAOS ADVERSARY", "PASS" if ok else "FAIL",
+              "seed=%d" % args.seed)
+        return 0 if ok else 1
 
     if args.soak:
         from cometbft_tpu.crypto.faults import run_chaos_soak
@@ -218,7 +275,8 @@ def main() -> int:
             and summary["readmitted"]
             and summary["device_resumed_after_recovery"]
         )
-        print("CHAOS SOAK", "PASS" if ok else "FAIL")
+        print("CHAOS SOAK", "PASS" if ok else "FAIL",
+              "seed=%d" % args.seed)
         return 0 if ok else 1
 
     if args.wire:
@@ -238,7 +296,8 @@ def main() -> int:
             and summary["compute_delta_ms"]
             <= max(5.0, 0.25 * summary["injected_jitter_ms"])
         )
-        print("CHAOS WIRE", "PASS" if ok else "FAIL")
+        print("CHAOS WIRE", "PASS" if ok else "FAIL",
+              "seed=%d" % args.seed)
         return 0 if ok else 1
 
     if args.stale_model:
@@ -261,7 +320,8 @@ def main() -> int:
             and summary["router_readmits"] == 1
             and summary["router_live"] == "priced"
         )
-        print("CHAOS STALE-MODEL", "PASS" if ok else "FAIL")
+        print("CHAOS STALE-MODEL", "PASS" if ok else "FAIL",
+              "seed=%d" % args.seed)
         return 0 if ok else 1
 
     if args.overload:
@@ -286,7 +346,8 @@ def main() -> int:
             and summary["readmitted"]
             and summary["starved_without_qos"]
         )
-        print("CHAOS OVERLOAD", "PASS" if ok else "FAIL")
+        print("CHAOS OVERLOAD", "PASS" if ok else "FAIL",
+              "seed=%d" % args.seed)
         return 0 if ok else 1
 
     if args.service:
@@ -310,7 +371,8 @@ def main() -> int:
             and summary["pending_after"] == 0
             and summary["bytes_per_lane_ok"]
         )
-        print("CHAOS SERVICE", "PASS" if ok else "FAIL")
+        print("CHAOS SERVICE", "PASS" if ok else "FAIL",
+              "seed=%d" % args.seed)
         return 0 if ok else 1
 
     if args.memory_guard:
@@ -331,7 +393,8 @@ def main() -> int:
             and summary["guard_cap"] <= args.lanes_threshold
             and summary["state_final"] == summary["expected"]["state_final"]
         )
-        print("CHAOS MEMORY-GUARD", "PASS" if ok else "FAIL")
+        print("CHAOS MEMORY-GUARD", "PASS" if ok else "FAIL",
+              "seed=%d" % args.seed)
         return 0 if ok else 1
 
     if args.sharded:
@@ -371,7 +434,8 @@ def main() -> int:
                 for s in summary["final_states"].values()
             )
         )
-        print("CHAOS SHARDED", "PASS" if ok else "FAIL")
+        print("CHAOS SHARDED", "PASS" if ok else "FAIL",
+              "seed=%d" % args.seed)
         return 0 if ok else 1
 
     if args.devices > 1:
@@ -409,7 +473,8 @@ def main() -> int:
                 for s in summary["final_states"].values()
             )
         )
-        print("CHAOS MULTIDEVICE", "PASS" if ok else "FAIL")
+        print("CHAOS MULTIDEVICE", "PASS" if ok else "FAIL",
+              "seed=%d" % args.seed)
         return 0 if ok else 1
 
     from cometbft_tpu.crypto.faults import run_chaos_smoke
@@ -432,7 +497,8 @@ def main() -> int:
         and summary["probe_ok"]
         and summary["state_final"] == summary["expected"]["state_final"]
     )
-    print("CHAOS SMOKE", "PASS" if ok else "FAIL")
+    print("CHAOS SMOKE", "PASS" if ok else "FAIL",
+              "seed=%d" % args.seed)
     return 0 if ok else 1
 
 

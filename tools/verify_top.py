@@ -242,7 +242,8 @@ def render(snap: Dict[str, Any]) -> str:
             f"keys={sum(e.get('keys', 0) for e in ks_entries)}  "
             f"gen={ks.get('generation', '-')}  "
             f"hit_rate={_pct(hit_rate)}  "
-            f"indexed={stats.get('indexed_dispatches', 0)}"
+            f"indexed={stats.get('indexed_dispatches', 0)}  "
+            f"thrash={stats.get('keystore_thrash', 0)}"
         )
     svc = sources.get("service", {}) if isinstance(sources, dict) else {}
     if isinstance(svc, dict) and svc:
