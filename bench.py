@@ -1282,13 +1282,19 @@ def _stage_service():
     client frame. The gain is the aggregate-sigs/sec ratio; the
     acceptance gate is >= 2x (structurally it lands far higher). Also
     proves the compact wire contract end to end: cumulative payload
-    bytes per lane over the socket == 128."""
+    bytes per lane over the socket == 128. A quiet single-client pass
+    then runs the same wire with cross-process tracing sampled at 1.0
+    on every request (client remote-root + wire trace extension +
+    server-adopted spans) vs off; the min-of-reps wall delta is the
+    propagation overhead, budgeted < 3% like the in-process trace
+    stage."""
     import threading
 
     _maybe_force_cpu()
     from cometbft_tpu.crypto import ed25519 as ed
     from cometbft_tpu.crypto import service as servicelib
     from cometbft_tpu.crypto.scheduler import VerifyScheduler
+    from cometbft_tpu.libs import trace as tracelib
 
     CLIENTS = 32
     LANES = 64
@@ -1376,6 +1382,79 @@ def _stage_service():
             "inline_dispatches": snap["inline_dispatches"],
         }
 
+    def trace_walls() -> dict:
+        """The 32-client run's phase noise swamps a 3%% budget, so the
+        trace-propagation delta is measured on the quietest wire path
+        instead: ONE server stack (tight flush window, the same
+        serialized device-pool floor — the accelerator cost every real
+        dispatch pays is the denominator tracing overhead is judged
+        against) and TWO sequential-submit clients against it — tracing
+        off vs sampled at 1.0 — whose reps interleave, so both arms see
+        the same scheduler, the same flush thread, and equally warm
+        caches. The server tracer samples locally at 0: only the traced
+        client's propagated contexts record server-side (adopted spans
+        record unconditionally, and light up the full per-dispatch
+        attribution tree), which is exactly the per-request cost the
+        extension adds. Min-of-reps wall per arm, like the in-process
+        trace stage."""
+        SEQ, AB_ROUNDS = 8, 6
+        server_tracer = tracelib.Tracer(sample=0.0, buffer=4096)
+        sched = VerifyScheduler(
+            spec="cpu", flush_us=50, lane_budget=LANES,
+            row_verifier=floor_verifier, tracer=server_tracer,
+        )
+        sock = "/tmp/cbft-bench-svc-tr-%d.sock" % os.getpid()
+        service = servicelib.VerifyService(
+            sched, "unix://" + sock, coalesce=True,
+            row_verifier=floor_verifier,
+        )
+        sched.start()
+        service.start()
+        client_tracer = tracelib.Tracer(sample=1.0, buffer=4096)
+        rvs = {
+            False: servicelib.RemoteVerifier(
+                "unix://" + sock, tenant="bench-notrace",
+                timeout_ms=60_000,
+            ),
+            True: servicelib.RemoteVerifier(
+                "unix://" + sock, tenant="bench-trace",
+                timeout_ms=60_000, tracer=client_tracer,
+            ),
+        }
+        best = {False: None, True: None}
+        try:
+            for rv in rvs.values():  # warm (+ HELLO handshake), untimed
+                rv.submit(items, subsystem="bench").result(timeout=120)
+            for _ in range(AB_ROUNDS):
+                for arm, rv in rvs.items():
+                    t0 = time.perf_counter()
+                    for _ in range(SEQ):
+                        ok, mask = rv.submit(
+                            items, subsystem="bench"
+                        ).result(timeout=120)
+                        assert ok and all(mask)
+                    dt = time.perf_counter() - t0
+                    if best[arm] is None or dt < best[arm]:
+                        best[arm] = dt
+        finally:
+            for rv in rvs.values():
+                rv.close()
+            service.stop()
+            sched.stop()
+            try:
+                os.unlink(sock)
+            except OSError:
+                pass
+        # sanity: the overhead number must cover a LIVE stitched path,
+        # not tracing that silently failed to propagate
+        names = set()
+        for tracer in (client_tracer, server_tracer):
+            for tr in tracer.recent(1024):
+                for sp in tr["spans"]:
+                    names.add(sp["name"])
+        assert {"submit", "pack", "wire_wait", "request"} <= names, names
+        return best
+
     iso = run(coalesce=False)
     coal = run(coalesce=True)
     gain = coal["sigs_per_sec"] / max(iso["sigs_per_sec"], 1e-9)
@@ -1383,6 +1462,12 @@ def _stage_service():
     assert all(v <= 128.0 for v in bpl.values()), bpl
     assert iso["inline_dispatches"] >= CLIENTS * ROUNDS
     assert coal["inline_dispatches"] == 0
+    walls_by_arm = trace_walls()
+    off_wall, on_wall = walls_by_arm[False], walls_by_arm[True]
+    overhead_pct = (
+        max(0.0, (on_wall - off_wall) / off_wall * 100.0)
+        if off_wall else 0.0
+    )
     out = {
         "service_clients": CLIENTS,
         "service_coalesced_sigs_per_sec": coal["sigs_per_sec"],
@@ -1392,9 +1477,18 @@ def _stage_service():
         "service_p99_ms": coal["p99_ms"],
         "service_isolated_p99_ms": iso["p99_ms"],
         "service_bytes_per_lane": bpl,
+        "service_trace_off_ms": round(off_wall * 1e3, 3),
+        "service_trace_on_ms": round(on_wall * 1e3, 3),
+        "service_trace_overhead_pct": round(overhead_pct, 2),
+        "service_trace_overhead_ok": overhead_pct <= 3.0,
     }
-    assert gain >= 2.0, f"coalesce gain {gain:.2f} < 2x"
+    # numbers first, verdicts second: a failed gate still leaves the
+    # measurement on stdout (same idiom as the trace stage)
     print(json.dumps(out), flush=True)
+    assert gain >= 2.0, f"coalesce gain {gain:.2f} < 2x"
+    assert overhead_pct <= 3.0, (
+        f"service trace overhead {overhead_pct:.2f}% > 3%"
+    )
 
 
 _COLDBOOT_SCRIPT = r"""

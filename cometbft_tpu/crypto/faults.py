@@ -1547,10 +1547,15 @@ def run_chaos_service(
     Returns a summary dict; callers (the tier-1 service-chaos test,
     ``tools/chaos.py --service``) assert on it.
     """
+    import json
+    import shutil
+    import tempfile
+
     from cometbft_tpu.crypto import ed25519 as ed
     from cometbft_tpu.crypto import service as servicelib
     from cometbft_tpu.crypto.scheduler import VerifyScheduler
     from cometbft_tpu.crypto.telemetry import TelemetryHub
+    from cometbft_tpu.libs import trace as tracelib
 
     CONSENSUS_N = 8
     FLOOD_N = 16
@@ -1630,6 +1635,33 @@ def run_chaos_service(
     service = servicelib.VerifyService(
         sched, "unix://" + sock_path, telemetry=hub, logger=logger,
     )
+    # the daemon's incident plumbing, in-harness: a flight recorder
+    # whose dump embeds the service view, flushed on the first brownout
+    # trip — the chaos rung then proves the dump carries the tenant
+    # panel the operator needs
+    dump_dir = tempfile.mkdtemp(prefix="cbft-chaos-svc-dump-")
+    tracer = tracelib.Tracer(sample=0.0, seed=seed, dump_dir=dump_dir)
+    tracer.set_dump_context(lambda: {
+        "service": service.snapshot(),
+        "timeline": hub.timeline(),
+    })
+    incident = {"path": None, "fired": False}
+
+    def _on_incident(ev):
+        # dump off-thread: the trip fires inside the burn-watcher path
+        # and the flood phase is measuring consensus latency
+        if ev.get("kind") != "brownout_trip" or incident["fired"]:
+            return
+        incident["fired"] = True
+
+        def _dump():
+            incident["path"] = tracer.dump(
+                "brownout_trip", extra={"event": ev}
+            )
+
+        threading.Thread(target=_dump, daemon=True).start()
+
+    hub.add_event_listener(_on_incident)
     sched.start()
     service.start()
 
@@ -1655,15 +1687,18 @@ def run_chaos_service(
         scrape_t.start()
 
         address = "unix://" + sock_path
+        # clients share the hub: their fallback/rejection events land on
+        # the SAME timeline as the server's disconnect/brownout events,
+        # exactly as a node + daemon pair merged by fleet verify_top
         for i in range(CONSENSUS_CLIENTS):
             consensus_clients.append(servicelib.RemoteVerifier(
                 address, tenant="cons%d" % i, timeout_ms=10_000,
-                retry_s=0.05, logger=logger,
+                retry_s=0.05, telemetry=hub, logger=logger,
             ))
         for i in range(FLOOD_CLIENTS):
             clients.append(servicelib.RemoteVerifier(
                 address, tenant="flood", timeout_ms=5_000,
-                retry_s=0.05, logger=logger,
+                retry_s=0.05, telemetry=hub, logger=logger,
             ))
         killed_clients = clients[:KILLED]
         survivor = clients[KILLED]
@@ -1723,6 +1758,7 @@ def run_chaos_service(
                     flood_items["mempool"], subsystem="mempool"
                 ))
             time.sleep(0.1)  # frames reach the server, go pending
+            kill_t0 = time.time()  # timeline events use the wall clock
             for rv in killed_clients:
                 rv.kill_connection()
             time.sleep(0.1)  # server readers observe the dead sockets
@@ -1745,6 +1781,33 @@ def run_chaos_service(
                 wrong["survivor"] += 1  # neighbor's death leaked here
         disconnects_metered = sum(
             service.snapshot()["disconnects"].values()
+        )
+        # the incident timeline must have captured the kill from BOTH
+        # sides — the server's disconnect, the client's typed fallback —
+        # on one non-decreasing wall clock
+        tl = hub.timeline()
+        tl_server_disc = [
+            ev for ev in tl
+            if ev.get("kind") == "disconnect"
+            and ev.get("source") == "server"
+            and ev.get("tenant") == "flood"
+            and ev.get("t", 0.0) >= kill_t0 - 0.001
+        ]
+        tl_client_fb = [
+            ev for ev in tl
+            if ev.get("kind") == "client_fallback"
+            and ev.get("source") == "client"
+            and ev.get("reason") == "disconnected"
+            and ev.get("t", 0.0) >= kill_t0 - 0.001
+        ]
+        tl_ordered = all(
+            tl[i].get("t", 0.0) <= tl[i + 1].get("t", 0.0)
+            for i in range(len(tl) - 1)
+        )
+        timeline_ok = (
+            len(tl_server_disc) >= 1
+            and len(tl_client_fb) >= KILLED
+            and tl_ordered
         )
 
         # -- phase 2: flood ---------------------------------------------
@@ -1808,6 +1871,26 @@ def run_chaos_service(
         svc_snap = service.snapshot()
         pending_after = service.pending_requests()
         killed_stats = [rv.stats() for rv in killed_clients]
+        # the brownout trip must have flushed an incident dump that
+        # embeds the service view: the tenant panel and the event ring
+        dump_wait = time.monotonic() + 5.0
+        while incident["fired"] and incident["path"] is None \
+                and time.monotonic() < dump_wait:
+            time.sleep(0.05)
+        incident_dump_ok = False
+        if incident["path"]:
+            try:
+                with open(incident["path"], "r", encoding="utf-8") as f:
+                    dump_doc = json.load(f)
+                incident_dump_ok = (
+                    dump_doc.get("reason") == "brownout_trip"
+                    and bool(
+                        dump_doc.get("service", {}).get("tenants_panel")
+                    )
+                    and isinstance(dump_doc.get("timeline"), list)
+                )
+            except (OSError, ValueError):
+                incident_dump_ok = False
     finally:
         stop_flood.set()
         stop_scrape.set()
@@ -1819,6 +1902,7 @@ def run_chaos_service(
             os.unlink(sock_path)
         except OSError:
             pass
+        shutil.rmtree(dump_dir, ignore_errors=True)
 
     cls = snap["qos"]["classes"]
     bpl = svc_snap.get("bytes_per_lane", {})
@@ -1855,6 +1939,11 @@ def run_chaos_service(
         "pending_after": pending_after,
         "bytes_per_lane": bpl,
         "bytes_per_lane_ok": all(v <= 128.0 for v in bpl.values()),
+        "timeline_ok": timeline_ok,
+        "timeline_events": len(tl),
+        "timeline_kill_disconnects": len(tl_server_disc),
+        "timeline_kill_fallbacks": len(tl_client_fb),
+        "incident_dump_ok": incident_dump_ok,
         "service": {
             k: svc_snap[k]
             for k in ("frames", "lanes", "errors", "disconnects",
@@ -1869,6 +1958,8 @@ def run_chaos_service(
             "disconnect_fallbacks": ">= %d" % KILLED,
             "disconnects_metered": ">= 1",
             "brownout_trips": ">= 1",
+            "timeline_ok": True,
+            "incident_dump_ok": True,
             "readmitted": True,
             "pending_after": 0,
             "bytes_per_lane": "<= 128 on every kind",

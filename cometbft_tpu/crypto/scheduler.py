@@ -719,6 +719,13 @@ class VerifyScheduler(BaseService):
             self.logger.info(
                 "qos brownout: class re-admitted", qclass=cls,
             )
+        if self._telemetry is not None:
+            note = getattr(self._telemetry, "note_event", None)
+            if note is not None:
+                note(
+                    "brownout_trip" if disabled else "brownout_readmit",
+                    {"qclass": cls},
+                )
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -821,6 +828,7 @@ class VerifyScheduler(BaseService):
         tenant: Optional[str] = None,
         qclass: Optional[str] = None,
         height: Optional[int] = None,
+        trace_ctx=None,
     ) -> VerifyFuture:
         """Queue a verify-service row payload (service.RowPayload — the
         client's pre-packed compact/indexed wire rows, the exact socket
@@ -830,10 +838,21 @@ class VerifyScheduler(BaseService):
         taken from the frame header (untagged resolves to the top class,
         exactly like an in-process untagged submit). Row requests ride
         the same flushes as triple requests: cross-client coalescing IS
-        this queue."""
+        this queue.
+
+        ``trace_ctx`` — (trace_id, span_id, sampled) off the wire frame's
+        v2 extension: the server-side request span ADOPTS the client's
+        trace (same trace_id, parented under the client submit span) so
+        the stitched trace crosses the socket."""
         if qclass is None or qclass not in self._class_names:
             qclass = qoslib.resolve_class(qclass, self._class_names)
-        span = self._tracer.start_span("request", n_sigs=payload.n)
+        if trace_ctx is not None and trace_ctx[2]:
+            span = self._tracer.adopt_span(
+                "request", trace_ctx[0], trace_ctx[1], sampled=True,
+                n_sigs=payload.n,
+            )
+        else:
+            span = self._tracer.start_span("request", n_sigs=payload.n)
         if not span.noop:
             span.set_tag("subsystem", tenant or "remote")
             span.set_tag("transport", "service")

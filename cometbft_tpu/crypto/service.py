@@ -88,7 +88,12 @@ SUBSYSTEM = "verify_service"
 # -- frame protocol ----------------------------------------------------------
 
 MAGIC = b"CBVS"
-VERSION = 1
+# v2 adds an optional extension block between header and payload on REQ
+# frames (currently: trace context). Frames WITHOUT extensions are still
+# emitted with version=1 headers, byte-identical to the v1 wire, so a v1
+# peer interops unchanged; the version byte is parsed per frame.
+VERSION = 2
+MIN_VERSION = 1
 
 FT_HELLO = 0
 FT_CLIENT_HELLO = 1
@@ -121,6 +126,14 @@ _HEADER = struct.Struct("<4sBBBBQII16s")
 HEADER_BYTES = _HEADER.size
 VALSET_ID_BYTES = 16
 _ERR_HEAD = struct.Struct("<H")
+
+# v2 extension block: u8 ext_len (TLV bytes that follow), then TLV
+# entries of (u8 type, u8 len, len value bytes). Unknown types are
+# skipped per spec; a TLV running past ext_len is malformed.
+EXT_TRACE = 1
+_EXT_TRACE = struct.Struct("<QQB")  # trace_id, span_id, flags
+TRACE_FLAG_SAMPLED = 0x01
+_MAX_EXT_BYTES = 255  # ext_len is a u8
 
 # typed error codes (satellite: malformed/truncated/oversized frames get
 # a typed error frame and the accept loop survives)
@@ -208,10 +221,11 @@ def parse_address(addr: str) -> Tuple[str, Any]:
 def max_frame_bytes(max_lanes: int) -> int:
     """Frame-length bound derived from the lane budget (itself
     max_chunk-derived): the largest legal frame is a full compact REQ or
-    a full REGISTER, whichever is bigger, plus the header."""
+    a full REGISTER, whichever is bigger, plus the header and the v2
+    extension allowance (1 length byte + up to 255 TLV bytes)."""
     lanes = max(1, int(max_lanes))
     body = max(lanes * COMPACT_ROW_BYTES, MAX_REGISTER_KEYS * 32)
-    return HEADER_BYTES + body
+    return HEADER_BYTES + 1 + _MAX_EXT_BYTES + body
 
 
 class FrameError(Exception):
@@ -225,10 +239,10 @@ class FrameError(Exception):
 
 class Frame:
     __slots__ = ("ftype", "qclass", "kind", "req_id", "n_lanes",
-                 "generation", "valset_id", "payload")
+                 "generation", "valset_id", "payload", "trace_ctx")
 
     def __init__(self, ftype, qclass, kind, req_id, n_lanes, generation,
-                 valset_id, payload):
+                 valset_id, payload, trace_ctx=None):
         self.ftype = ftype
         self.qclass = qclass
         self.kind = kind
@@ -237,6 +251,8 @@ class Frame:
         self.generation = generation
         self.valset_id = valset_id
         self.payload = payload
+        # (trace_id, span_id, sampled) off the v2 extension block, or None
+        self.trace_ctx = trace_ctx
 
 
 def encode_frame(
@@ -249,19 +265,77 @@ def encode_frame(
     generation: int = 0,
     valset_id: bytes = b"",
     payload: bytes = b"",
+    trace_ctx: Optional[Tuple[int, int, bool]] = None,
 ) -> bytes:
+    """Encode one frame. Without ``trace_ctx`` the frame is the exact v1
+    wire (version byte 1, no extension block) — a v2 sender talking to a
+    v1 peer never trips its version check. With ``trace_ctx``
+    (trace_id, span_id, sampled) the header says version 2 and an
+    extension block rides between header and payload."""
     vid = bytes(valset_id)[:VALSET_ID_BYTES].ljust(VALSET_ID_BYTES, b"\x00")
+    if trace_ctx is None:
+        version, ext = MIN_VERSION, b""
+    else:
+        tid, sid, sampled = trace_ctx
+        tlv_val = _EXT_TRACE.pack(
+            tid & 0xFFFFFFFFFFFFFFFF, sid & 0xFFFFFFFFFFFFFFFF,
+            TRACE_FLAG_SAMPLED if sampled else 0,
+        )
+        tlv = bytes((EXT_TRACE, len(tlv_val))) + tlv_val
+        version, ext = VERSION, bytes((len(tlv),)) + tlv
     header = _HEADER.pack(
-        MAGIC, VERSION, ftype & 0xFF, qclass & 0xFF, kind & 0xFF,
+        MAGIC, version, ftype & 0xFF, qclass & 0xFF, kind & 0xFF,
         req_id & 0xFFFFFFFFFFFFFFFF, n_lanes & 0xFFFFFFFF,
         generation & 0xFFFFFFFF, vid,
     )
-    return _LEN.pack(HEADER_BYTES + len(payload)) + header + payload
+    return (
+        _LEN.pack(HEADER_BYTES + len(ext) + len(payload))
+        + header + ext + payload
+    )
+
+
+def _decode_extensions(
+    buf: bytes,
+) -> Tuple[Optional[Tuple[int, int, bool]], int]:
+    """Parse the v2 extension block starting at HEADER_BYTES. Returns
+    (trace_ctx or None, payload offset). Unknown TLV types are skipped;
+    a block overrunning the frame or a TLV overrunning the block is
+    malformed."""
+    if len(buf) < HEADER_BYTES + 1:
+        raise FrameError(ERR_MALFORMED, "v2 frame missing extension length")
+    ext_len = buf[HEADER_BYTES]
+    pos = HEADER_BYTES + 1
+    end = pos + ext_len
+    if len(buf) < end:
+        raise FrameError(
+            ERR_MALFORMED,
+            f"extension block of {ext_len} bytes overruns the frame",
+        )
+    trace_ctx = None
+    while pos < end:
+        if pos + 2 > end:
+            raise FrameError(ERR_MALFORMED, "truncated extension TLV head")
+        etype, elen = buf[pos], buf[pos + 1]
+        pos += 2
+        if pos + elen > end:
+            raise FrameError(
+                ERR_MALFORMED,
+                f"extension {etype} of {elen} bytes overruns the block",
+            )
+        if etype == EXT_TRACE and elen == _EXT_TRACE.size:
+            tid, sid, flags = _EXT_TRACE.unpack_from(buf, pos)
+            trace_ctx = (tid, sid, bool(flags & TRACE_FLAG_SAMPLED))
+        # any other type (or a differently-sized trace TLV from a newer
+        # minor revision) is skipped per spec
+        pos += elen
+    return trace_ctx, end
 
 
 def decode_frame(buf: bytes) -> Frame:
     """Parse one length-stripped frame. Raises FrameError — MALFORMED
-    for a short/garbled header, BAD_VERSION for a future protocol."""
+    for a short/garbled header, BAD_VERSION for a future protocol.
+    Versions 1 and 2 are both accepted; v2 frames may carry an
+    extension block (unknown extension types are ignored)."""
     if len(buf) < HEADER_BYTES:
         raise FrameError(
             ERR_MALFORMED, f"frame shorter than header ({len(buf)} bytes)"
@@ -271,11 +345,15 @@ def decode_frame(buf: bytes) -> Frame:
     )
     if magic != MAGIC:
         raise FrameError(ERR_MALFORMED, f"bad magic {magic!r}")
-    if version != VERSION:
+    if not (MIN_VERSION <= version <= VERSION):
         raise FrameError(ERR_BAD_VERSION, f"unsupported version {version}")
+    trace_ctx: Optional[Tuple[int, int, bool]] = None
+    body_at = HEADER_BYTES
+    if version >= 2:
+        trace_ctx, body_at = _decode_extensions(buf)
     return Frame(
         ftype, qclass, kind, req_id, n_lanes, generation, vid,
-        buf[HEADER_BYTES:],
+        buf[body_at:], trace_ctx,
     )
 
 
@@ -644,6 +722,14 @@ class ServiceMetrics:
             SUBSYSTEM, "pending",
             "Requests accepted from clients and not yet answered.",
         )
+        self.refusals = r.counter(
+            SUBSYSTEM, "refusals",
+            "Typed per-request refusals, by tenant and code.",
+        )
+        self.registrations = r.counter(
+            SUBSYSTEM, "registrations",
+            "Valset registrations accepted, by tenant.",
+        )
 
     @classmethod
     def nop(cls) -> "ServiceMetrics":
@@ -661,8 +747,9 @@ class _Conn:
         self.sock = sock
         self.tenant: Optional[str] = None
         self.alive = True
-        # req_id -> n_lanes, for the leak check on disconnect/stop
-        self.pending: Dict[int, int] = {}
+        # req_id -> (n_lanes, t0), for the leak check on disconnect/stop
+        # and the per-tenant service latency (t0 = accept time)
+        self.pending: Dict[int, Tuple[int, float]] = {}
         self.outq: "collections.deque[bytes]" = collections.deque()
         self.mtx = threading.Lock()
         self.cv = threading.Condition(self.mtx)
@@ -693,12 +780,16 @@ class VerifyService(BaseService):
         row_verifier: Optional[Callable] = None,
         metrics: Optional[ServiceMetrics] = None,
         telemetry=None,
+        advertise_trace: bool = True,
         logger: Optional[Logger] = None,
     ):
         super().__init__("VerifyService", logger)
         self._sched = scheduler
         self._family, self._target = parse_address(address)
         self._coalesce = bool(coalesce)
+        # advertise_trace=False simulates a v1 server (no capability byte
+        # in the HELLO payload, so v2 clients stay on the pure v1 wire)
+        self._advertise_trace = bool(advertise_trace)
         if max_lanes is None:
             max_lanes = getattr(scheduler, "_lane_budget", None) or 8192
         self._max_lanes = max(1, int(max_lanes))
@@ -720,8 +811,29 @@ class VerifyService(BaseService):
         self._disconnects: Dict[str, int] = {}
         self._stale_drops = 0
         self._inline_dispatches = 0
+        # per-tenant service panel: RED + wire shape + refusal taxonomy
+        self._tenant_stats: Dict[str, Dict[str, Any]] = {}
         if telemetry is not None:
             telemetry.register_source("service", self.snapshot)
+
+    def _tenant(self, tenant: Optional[str]) -> Dict[str, Any]:
+        """The per-tenant stats record (callers hold _smtx)."""
+        rec = self._tenant_stats.get(tenant or "unknown")
+        if rec is None:
+            rec = self._tenant_stats[tenant or "unknown"] = {
+                "requests": 0,
+                "responses": 0,
+                "rejected": 0,
+                "dur_total_s": 0.0,
+                "lanes": {},
+                "payload_bytes": 0,
+                "refusals": {},
+                "disconnects": 0,
+                "registrations": 0,
+                "generations_seen": 0,
+                "last_generation": None,
+            }
+        return rec
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -812,9 +924,16 @@ class VerifyService(BaseService):
             conn = _Conn(sock)
             with self._cmtx:
                 self._conns.add(conn)
+            # Capability advertisement rides the HELLO *payload* (one
+            # byte: the highest protocol version we speak). The header
+            # stays version 1 so v1 clients decode it, and v1 clients
+            # provably ignore HELLO payload bytes — only a v2 client
+            # reads the byte and starts shipping extended frames.
             self._enqueue(conn, encode_frame(
                 FT_HELLO, n_lanes=self._max_lanes,
                 generation=self._generation(),
+                payload=(bytes((VERSION,)) if self._advertise_trace
+                         else b""),
             ))
             conn.writer = threading.Thread(
                 target=self._write_loop, args=(conn,), daemon=True,
@@ -928,6 +1047,7 @@ class VerifyService(BaseService):
                 self._disconnects[tenant] = (
                     self._disconnects.get(tenant, 0) + n_pending
                 )
+                self._tenant(tenant)["disconnects"] += n_pending
             self.metrics.disconnects.with_labels(tenant=tenant).add(
                 n_pending
             )
@@ -995,9 +1115,18 @@ class VerifyService(BaseService):
         keys = [payload[i * 32:(i + 1) * 32] for i in range(n)]
         store = self._keystore()
         store.register(valset_id, keys)
+        gen = store.generation()
+        tenant = conn.tenant or "unknown"
+        with self._smtx:
+            self._tenant(conn.tenant)["registrations"] += 1
+        self.metrics.registrations.with_labels(tenant=tenant).add()
+        if self._telemetry is not None:
+            self._telemetry.note_event("valset_registered", {
+                "tenant": tenant, "keys": n, "generation": gen,
+            })
         self._enqueue(conn, encode_frame(
             FT_REGISTERED, req_id=frame.req_id, n_lanes=n,
-            generation=store.generation(), valset_id=valset_id,
+            generation=gen, valset_id=valset_id,
         ))
 
     def _handle_req(self, conn: _Conn, frame: Frame) -> None:
@@ -1059,6 +1188,13 @@ class VerifyService(BaseService):
             self._payload_bytes[kind_name] = (
                 self._payload_bytes.get(kind_name, 0) + len(frame.payload)
             )
+            rec = self._tenant(conn.tenant)
+            rec["requests"] += 1
+            rec["lanes"][kind_name] = rec["lanes"].get(kind_name, 0) + n
+            rec["payload_bytes"] += len(frame.payload)
+            if rec["last_generation"] != frame.generation:
+                rec["last_generation"] = frame.generation
+                rec["generations_seen"] += 1
         self.metrics.lanes.with_labels(kind=kind_name).add(n)
         self.metrics.bytes_per_lane.with_labels(kind=kind_name).set(
             len(frame.payload) / n
@@ -1068,11 +1204,12 @@ class VerifyService(BaseService):
             return
         fut = self._sched.submit_rows(
             payload, tenant=conn.tenant, qclass=qname,
+            trace_ctx=frame.trace_ctx,
         )
         with conn.mtx:
             if not conn.alive:
                 return  # raced teardown: disconnect already metered
-            conn.pending[frame.req_id] = n
+            conn.pending[frame.req_id] = (n, time.monotonic())
         self.metrics.pending.set(self.pending_requests())
         fut.add_done_callback(
             lambda f, c=conn, fr=frame: self._complete(c, fr, f)
@@ -1112,6 +1249,13 @@ class VerifyService(BaseService):
         except Exception:  # noqa: BLE001 - failed flush = rejected verdict
             mask = np.zeros(frame.n_lanes, dtype=bool)
             status = ST_REJECTED
+        _, t0 = known
+        with self._smtx:
+            rec = self._tenant(conn.tenant)
+            rec["responses"] += 1
+            rec["dur_total_s"] += time.monotonic() - t0
+            if status == ST_REJECTED:
+                rec["rejected"] += 1
         self._respond(conn, frame.req_id, status, mask)
 
     def _respond(self, conn: _Conn, req_id: int, status: int,
@@ -1127,9 +1271,13 @@ class VerifyService(BaseService):
     def _send_err(self, conn: _Conn, req_id: int, code: int, msg: str
                   ) -> None:
         name = ERR_NAMES.get(code, str(code))
+        tenant = conn.tenant or "unknown"
         with self._smtx:
             self._errors[name] = self._errors.get(name, 0) + 1
+            rec = self._tenant(conn.tenant)
+            rec["refusals"][name] = rec["refusals"].get(name, 0) + 1
         self.metrics.errors.with_labels(code=name).add()
+        self.metrics.refusals.with_labels(tenant=tenant, code=name).add()
         self._enqueue(conn, encode_frame(
             FT_ERR, req_id=req_id, generation=self._generation(),
             payload=encode_error(code, msg),
@@ -1172,8 +1320,23 @@ class VerifyService(BaseService):
         with self._smtx:
             lanes = dict(self._lanes)
             payload_bytes = dict(self._payload_bytes)
+            panel = {}
+            for name, rec in self._tenant_stats.items():
+                row = dict(rec)
+                row["lanes"] = dict(rec["lanes"])
+                row["refusals"] = dict(rec["refusals"])
+                resp = rec["responses"]
+                row["mean_ms"] = (
+                    rec["dur_total_s"] / resp * 1e3 if resp else 0.0
+                )
+                lane_total = sum(rec["lanes"].values())
+                row["bytes_per_lane"] = (
+                    rec["payload_bytes"] / lane_total if lane_total else 0.0
+                )
+                panel[name] = row
             out = {
                 "address": self.address() if self._bound else None,
+                "protocol_version": VERSION,
                 "coalesce": self._coalesce,
                 "max_lanes": self._max_lanes,
                 "connections": len(conns),
@@ -1184,6 +1347,7 @@ class VerifyService(BaseService):
                 "disconnects": dict(self._disconnects),
                 "stale_drops": self._stale_drops,
                 "inline_dispatches": self._inline_dispatches,
+                "tenants_panel": panel,
             }
         out["pending"] = self.pending_requests()
         out["bytes_per_lane"] = {
@@ -1214,7 +1378,7 @@ class _Agg:
     request to the local CPU ground truth exactly once."""
 
     __slots__ = ("items", "future", "mask", "remaining", "failed",
-                 "req_ids", "mtx")
+                 "req_ids", "mtx", "span", "wire_span")
 
     def __init__(self, items, future, n_parts):
         self.items = items
@@ -1224,6 +1388,11 @@ class _Agg:
         self.failed = False
         self.req_ids: List[int] = []
         self.mtx = threading.Lock()
+        # client-side trace spans (NOOP_SPAN when unsampled): the submit
+        # root whose id ships in the v2 extension, and the wire_wait
+        # child covering send -> final verdict
+        self.span = None
+        self.wire_span = None
 
 
 class _PendingPart:
@@ -1255,6 +1424,8 @@ class RemoteVerifier:
         timeout_ms: Optional[int] = None,
         connect_timeout_s: float = 1.0,
         retry_s: float = 1.0,
+        tracer=None,
+        telemetry=None,
         logger: Optional[Logger] = None,
     ):
         if isinstance(spec, BackendSpec):
@@ -1269,6 +1440,11 @@ class RemoteVerifier:
         self._timeout_s = service_timeout_default(timeout_ms) / 1e3
         self._connect_timeout_s = connect_timeout_s
         self._retry_s = retry_s
+        self._tracer = tracer
+        self._telemetry = telemetry
+        # highest protocol version the server advertised (HELLO payload
+        # byte); trace extensions ship only when it is >= 2
+        self._server_proto = 1
         self.logger = logger
         self._mtx = threading.Lock()
         self._sock: Optional[socket.socket] = None
@@ -1297,6 +1473,11 @@ class RemoteVerifier:
             fut._set((True, []))
             return fut
         agg = _Agg(triples, fut, 0)
+        if self._tracer is not None:
+            agg.span = self._tracer.start_remote_root(
+                "submit", n_sigs=len(triples), tenant=self._tenant,
+                subsystem=subsystem or "?", transport="remote",
+            )
         try:
             self._submit_remote(agg, subsystem)
         except Exception:  # noqa: BLE001 - daemon down: local ground truth
@@ -1335,8 +1516,17 @@ class RemoteVerifier:
         qcode = qoslib.class_code(
             qoslib.SUBSYSTEM_ALIASES.get(subsystem, subsystem)
         )
+        root = agg.span
+        traced = root is not None and not root.noop
+        # ship the trace context only when the server advertised v2 — a
+        # v1 server would refuse the extended frame outright
+        ctx = (
+            (root.trace_id, root.span_id, True)
+            if traced and self._server_proto >= 2 else None
+        )
         valset = self._covering_valset(agg.items)
         deadline = time.monotonic() + self._timeout_s
+        pack_span = root.child("pack") if traced else None
         parts: List[Tuple[bytes, _PendingPart]] = []
         base = 0
         step = max(1, self._max_lanes)
@@ -1377,15 +1567,23 @@ class RemoteVerifier:
                     n_lanes=int(sent.size),
                     generation=(valset.registered_gen if valset else 0),
                     valset_id=(valset.valset_id if valset else b""),
-                    payload=payload,
+                    payload=payload, trace_ctx=ctx,
                 )
                 parts.append((frame, pend))
             base += step
+        if pack_span is not None:
+            pack_span.end(
+                parts=len(parts),
+                kind=_KIND_NAMES[KIND_INDEXED if valset else KIND_COMPACT],
+            )
         if not parts:
             # every lane was locally known-invalid: exact verdict, no
             # frame, no fallback
             agg.future._set((False, [False] * len(agg.items)))
+            self._finish_spans(agg, "local_invalid")
             return
+        if traced:
+            agg.wire_span = root.child("wire_wait", parts=len(parts))
         for frame, _ in parts:
             try:
                 self._send(frame)
@@ -1544,6 +1742,11 @@ class RemoteVerifier:
                 self._server_gen = frame.generation
                 if frame.n_lanes:
                     self._max_lanes = frame.n_lanes
+                # capability byte: the highest protocol version the
+                # server speaks (absent/empty payload = a v1 server)
+                self._server_proto = (
+                    frame.payload[0] if frame.payload else 1
+                )
             return
         if frame.ftype == FT_REGISTERED:
             with self._mtx:
@@ -1611,6 +1814,15 @@ class RemoteVerifier:
             mask = [bool(b) for b in agg.mask]
             agg.future._set((all(mask), mask))
             self._count("remote_ok")
+            self._finish_spans(agg, "ok")
+
+    def _finish_spans(self, agg: _Agg, outcome: str) -> None:
+        """End the submit root (and its wire_wait child) exactly once;
+        Span.end is idempotent so racing completion paths are safe."""
+        if agg.wire_span is not None:
+            agg.wire_span.end(outcome=outcome)
+        if agg.span is not None:
+            agg.span.end(outcome=outcome)
 
     def _reject_agg(self, agg: _Agg) -> None:
         """Mirror the local scheduler's shed/drop verdict: rejected=True,
@@ -1625,9 +1837,15 @@ class RemoteVerifier:
             for rid in agg.req_ids:
                 self._pending.pop(rid, None)
         self._count("rejected")
+        if self._telemetry is not None:
+            self._telemetry.note_event(
+                "client_rejected", {"tenant": self._tenant},
+                source="client",
+            )
         agg.future.rejected = True
         agg.future.reason = "rejected"
         agg.future._set((False, [False] * len(agg.mask)))
+        self._finish_spans(agg, "rejected")
 
     def _fail_agg(self, agg: _Agg, reason: str) -> None:
         """Local-CPU fallback for the WHOLE request, exactly once; the
@@ -1641,12 +1859,19 @@ class RemoteVerifier:
             for rid in agg.req_ids:
                 self._pending.pop(rid, None)
         self._count(reason)
+        if self._telemetry is not None:
+            self._telemetry.note_event(
+                "client_fallback",
+                {"tenant": self._tenant, "reason": reason},
+                source="client",
+            )
         bv = CPUBatchVerifier()
         for pk, m, s in agg.items:
             bv.add(pk, m, s)
         _, mask = bv.verify()
         agg.future.reason = reason
         agg.future._set((all(mask), mask))
+        self._finish_spans(agg, reason)
 
     def _expire_pending(self) -> None:
         now = time.monotonic()
@@ -1699,6 +1924,7 @@ class RemoteVerifier:
                 "tenant": self._tenant,
                 "connected": self._sock is not None,
                 "server_generation": self._server_gen,
+                "server_proto": self._server_proto,
                 "max_lanes": self._max_lanes,
                 "valsets": len(self._valsets),
                 "pending": len(self._pending),

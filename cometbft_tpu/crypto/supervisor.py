@@ -1818,8 +1818,24 @@ class BackendSupervisor:
         with self._lock:
             newly_opened = self._trip_locked(dom, cause)
         if newly_opened:
+            self._note_timeline("breaker_open", device=dom.handle.label,
+                                cause=cause)
             self._capture_incident_profile(cause)
             self._dump_incident(cause)
+
+    def _note_timeline(self, kind: str, **detail) -> None:
+        """Feed one breaker/watchdog event into the hub's incident
+        timeline. Best-effort: a hub predating note_event (or none at
+        all) costs one attribute read."""
+        if self._telemetry is None:
+            return
+        note = getattr(self._telemetry, "note_event", None)
+        if note is None:
+            return
+        try:
+            note(kind, detail)
+        except Exception:  # noqa: BLE001 - diagnostics only
+            pass
 
     def _trip_locked(self, dom: _Domain, cause: str) -> bool:
         """Open one domain's breaker; True if it was not already open
@@ -1906,6 +1922,7 @@ class BackendSupervisor:
             self.logger.info(
                 "verify circuit breaker closed", device=dom.handle.label
             )
+            self._note_timeline("breaker_close", device=dom.handle.label)
         self._set_state_locked(dom, HEALTHY)
         dom.consecutive_failures = 0
         dom.backoff_s = self._probe_base_s

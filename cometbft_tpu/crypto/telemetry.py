@@ -66,6 +66,8 @@ DEFAULT_WINDOW_S = 60.0
 DEFAULT_OBJECTIVE = 0.99
 # Bound per-window sample retention (requests, busy intervals, chunks).
 _MAX_SAMPLES = 4096
+# Incident-timeline ring capacity (discrete control-plane events).
+_TIMELINE_EVENTS = 256
 # Requests with no origin tag meter under this tenant.
 UNTAGGED = "untagged"
 
@@ -320,6 +322,14 @@ class TelemetryHub:
         self._sources: Dict[str, Callable[[], Any]] = {}
         self._capacity_fn: Optional[Callable[[], float]] = None
         self._burn_watchers: List[Callable[[float], None]] = []
+        # incident timeline: a bounded ring of discrete control-plane
+        # events (breaker motion, brownout steps, watchdog trips,
+        # keystore churn, disconnects) stamped on ONE wall clock so
+        # client- and server-side incidents order against each other
+        self._timeline: Deque[Dict[str, Any]] = deque(
+            maxlen=_TIMELINE_EVENTS
+        )
+        self._event_listeners: List[Callable[[Dict[str, Any]], None]] = []
 
     # -- feeders (hot path) --------------------------------------------------
 
@@ -374,6 +384,53 @@ class TelemetryHub:
                     0, 0, 0, None, deque(maxlen=_MAX_SAMPLES)
                 ]
         self.metrics.red_disconnects.with_labels(tenant=name).add(int(n))
+        self.note_event("disconnect", {"tenant": name, "pending": int(n)})
+
+    def note_event(
+        self,
+        kind: str,
+        detail: Optional[Dict[str, Any]] = None,
+        source: str = "server",
+    ) -> None:
+        """Append one discrete event to the incident timeline.
+
+        ``kind`` names the event (brownout_trip, breaker_open,
+        watchdog_trip, valset_registered, disconnect, client_fallback…),
+        ``source`` says which side of the wire saw it ("server" /
+        "client"), and the stamp is ``time.time()`` — WALL clock, not the
+        hub's monotonic clock, so rings exported from two processes
+        merge onto one axis."""
+        ev: Dict[str, Any] = {"t": time.time(), "kind": kind,
+                              "source": source}
+        if detail:
+            ev.update(detail)
+        with self._mtx:
+            self._timeline.append(ev)
+            listeners = list(self._event_listeners)
+        for fn in listeners:
+            try:
+                fn(ev)
+            except Exception:  # noqa: BLE001 - listener is advisory
+                pass
+
+    def add_event_listener(
+        self, fn: Callable[[Dict[str, Any]], None]
+    ) -> None:
+        """Observe every timeline event as it lands (outside the hub
+        lock). verifyd wires its incident-dump trigger here — a
+        brownout trip or breaker open flushes the flight recorder with
+        the service panel embedded."""
+        with self._mtx:
+            self._event_listeners.append(fn)
+
+    def timeline(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """The incident timeline, oldest first; ``limit`` keeps the
+        newest N."""
+        with self._mtx:
+            events = list(self._timeline)
+        if limit is not None:
+            events = events[-max(0, int(limit)):]
+        return events
 
     def note_device_busy(
         self, device: str, t0: float, t1: float, n_sigs: int
@@ -593,6 +650,7 @@ class TelemetryHub:
             "slo": slo,
             "headroom": head,
             "sources": sources,
+            "timeline": self.timeline(),
         }
 
 

@@ -198,6 +198,7 @@ class Span:
         "tags",
         "t0_ns",
         "t1_ns",
+        "local_root",
     )
     noop = False
 
@@ -209,6 +210,7 @@ class Span:
         parent_id: Optional[int],
         name: str,
         tags: Dict[str, Any],
+        local_root: Optional[bool] = None,
     ):
         self.tracer = tracer
         self.trace_id = trace_id
@@ -218,6 +220,11 @@ class Span:
         self.tags = tags
         self.t0_ns = time.perf_counter_ns()
         self.t1_ns: Optional[int] = None
+        # A trace completes in THIS process when its local root ends.  For
+        # ordinary roots that is parent_id is None; a span adopted from a
+        # remote parent (trace context off the wire) is a local root with a
+        # non-None parent_id pointing at the other process's span.
+        self.local_root = (parent_id is None) if local_root is None else local_root
 
     def set_tag(self, key: str, value: Any) -> "Span":
         self.tags[key] = value
@@ -363,6 +370,54 @@ class Tracer:
             return cur.child(name, **tags)
         return self.start_span(name, **tags)
 
+    # -- cross-process propagation ----------------------------------------
+
+    def start_remote_root(self, name: str, **tags: Any) -> Span:
+        """Root span whose trace id is safe to ship across processes.
+
+        Regular roots use small sequential ids (cheap, debuggable) which
+        would collide between two independent tracers; a remote root draws
+        a random 63-bit trace id so client- and server-side dumps join on
+        it unambiguously.  Sampling semantics match ``start_span``."""
+        if self.sample <= 0.0:
+            return NOOP_SPAN
+        if self.sample < 1.0:
+            with self._mtx:
+                roll = self._rng.random()
+            if roll >= self.sample:
+                return NOOP_SPAN
+        with self._mtx:
+            trace_id = self._rng.getrandbits(63) | 1
+            span_id = self._rng.getrandbits(63) | 1
+            self.n_started += 1
+        return Span(self, trace_id, span_id, None, name, tags)
+
+    def adopt_span(
+        self,
+        name: str,
+        trace_id: int,
+        parent_id: int,
+        sampled: bool = True,
+        **tags: Any,
+    ) -> Span:
+        """Continue a trace begun in another process.
+
+        The remote sender already made the sampling decision (carried in
+        the wire flag); a sampled context always produces a real span here
+        regardless of the local sampling fraction, so the two halves of the
+        trace stay joinable.  The span is a *local root* — it completes a
+        trace in this process's flight recorder when it ends — but keeps
+        ``parent_id`` pointing at the remote parent so a merged report can
+        re-nest it."""
+        if not sampled:
+            return NOOP_SPAN
+        with self._mtx:
+            span_id = self._rng.getrandbits(63) | 1
+            self.n_started += 1
+        return Span(
+            self, trace_id, span_id, parent_id, name, tags, local_root=True
+        )
+
     # -- recorder ----------------------------------------------------------
 
     def recent(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
@@ -486,7 +541,7 @@ class Tracer:
             span.t1_ns = time.perf_counter_ns()
             if tags:
                 span.tags.update(tags)
-            if span.parent_id is None:
+            if span.local_root:
                 # Root ended: trace complete.  Stragglers ending after this
                 # point find no open record and are dropped.
                 spans = self._open.pop(span.trace_id, [])

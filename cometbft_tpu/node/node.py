@@ -504,6 +504,8 @@ class Node(BaseService):
                 tenant=config.base.moniker,
                 spec=self.crypto_spec,
                 timeout_ms=config.crypto.verify_service_timeout_ms,
+                tracer=self.tracer,
+                telemetry=self.telemetry_hub,
                 logger=self.logger,
             )
             self.crypto_backend = self.remote_verifier

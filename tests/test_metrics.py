@@ -427,6 +427,7 @@ class TestReadmeDocDrift:
         from cometbft_tpu.crypto import decisions as declib
         from cometbft_tpu.crypto import qos as qoslib
         from cometbft_tpu.crypto import scheduler as schedlib
+        from cometbft_tpu.crypto import service as servicelib
         from cometbft_tpu.crypto import supervisor as suplib
         from cometbft_tpu.crypto import telemetry as tellib
         from cometbft_tpu.crypto import wire as wirelib
@@ -437,6 +438,7 @@ class TestReadmeDocDrift:
         declib.Metrics(r)
         qoslib.QoSMetrics(r)
         schedlib.Metrics(r)
+        servicelib.ServiceMetrics(r)
         suplib.Metrics(r)
         tellib.Metrics(r)
         wirelib.Metrics(r)

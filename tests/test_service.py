@@ -421,14 +421,17 @@ class TestFrameFuzz:
     ):
         items = _batch(2, tag=b"fuzz")
         wire, _ = svc.pack_items_compact(items)
-        whole = svc.encode_frame(
-            svc.FT_REQ, kind=svc.KIND_COMPACT, req_id=1, n_lanes=2,
-            payload=wire.tobytes(),
-        )
-        for cut in range(1, len(whole)):
-            s = _raw_conn(daemon)
-            s.sendall(whole[:cut])
-            s.close()
+        for ctx in (None, (0x1234ABCD, 0x77, True)):
+            # both header shapes: the v1 wire and the v2 extended header
+            # carrying a trace-context extension block
+            whole = svc.encode_frame(
+                svc.FT_REQ, kind=svc.KIND_COMPACT, req_id=1, n_lanes=2,
+                payload=wire.tobytes(), trace_ctx=ctx,
+            )
+            for cut in range(1, len(whole)):
+                s = _raw_conn(daemon)
+                s.sendall(whole[:cut])
+                s.close()
         # the service survived all of it: a real client still verifies
         ok, mask = daemon.client("after-fuzz").submit(
             items, subsystem="consensus"

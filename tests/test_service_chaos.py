@@ -41,3 +41,11 @@ class TestChaosServiceRung:
         # the wire never grew past the compact bound
         assert s["bytes_per_lane_ok"], s["bytes_per_lane"]
         assert s["bytes_per_lane"]["compact"] == 128.0
+        # the incident timeline saw the kill from BOTH sides (server
+        # disconnect + client typed fallback) on one ordered clock, and
+        # the brownout trip flushed an incident dump embedding the
+        # per-tenant service panel
+        assert s["timeline_ok"], (
+            s["timeline_kill_disconnects"], s["timeline_kill_fallbacks"],
+        )
+        assert s["incident_dump_ok"]

@@ -1,0 +1,425 @@
+"""Cross-process trace propagation (PR 19): the frame v2 trace-context
+extension, v1<->v2 interop (identical verdicts, zero refusals, unknown
+extension bytes ignored), and the stitched client->server trace over a
+real Unix socket — client pack/wire_wait spans and the server's adopted
+request span sharing ONE trace_id, merged into one stage table by
+tools/trace_report.py. Runs on the virtual CPU mesh (conftest.py)."""
+
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import service as svc
+from cometbft_tpu.crypto.scheduler import VerifyScheduler
+from cometbft_tpu.libs.trace import Tracer
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+
+_LEN = struct.Struct("<I")
+_CTX = (0x1A2B3C4D5E6F7081 & 0x7FFFFFFFFFFFFFFF, 0x55AA55AA55AA55A1, True)
+
+
+def _batch(n, tag=b"trc", bad=()):
+    keys = [ed.gen_priv_key_from_secret(tag + b"-%d" % i) for i in range(n)]
+    items = []
+    for i, k in enumerate(keys):
+        msg = tag + b" msg %d" % i
+        sig = k.sign(msg)
+        if i in bad:
+            sig = bytes(sig[:-1]) + bytes([sig[-1] ^ 0x01])
+        items.append((k.pub_key(), msg, sig))
+    return items
+
+
+def _expected(items):
+    return [
+        ed.PubKeyEd25519(svc._pk_bytes(pk)).verify_signature(m, s)
+        for pk, m, s in items
+    ]
+
+
+# ---------------------------------------------------------------------------
+# frame v2 codec: the trace extension block
+# ---------------------------------------------------------------------------
+
+
+class TestTraceExtensionCodec:
+    def test_no_ctx_emits_the_exact_v1_wire(self):
+        """A v2 sender without a trace context MUST be byte-identical to
+        v1 — that is the whole interop story."""
+        buf = svc.encode_frame(
+            svc.FT_REQ, req_id=9, n_lanes=1, payload=b"\x42" * 128,
+        )
+        assert buf[8] == svc.MIN_VERSION == 1
+        (length,) = _LEN.unpack(buf[:4])
+        assert length == svc.HEADER_BYTES + 128  # no extension byte
+        f = svc.decode_frame(buf[4:])
+        assert f.trace_ctx is None
+        assert f.payload == b"\x42" * 128
+
+    @pytest.mark.parametrize("sampled", [True, False])
+    def test_trace_ctx_round_trips(self, sampled):
+        tid, sid, _ = _CTX
+        buf = svc.encode_frame(
+            svc.FT_REQ, qclass=2, kind=svc.KIND_COMPACT, req_id=77,
+            n_lanes=3, payload=b"\x07" * (3 * 128),
+            trace_ctx=(tid, sid, sampled),
+        )
+        assert buf[8] == 2
+        f = svc.decode_frame(buf[4:])
+        assert f.trace_ctx == (tid, sid, sampled)
+        assert f.req_id == 77 and f.n_lanes == 3
+        assert f.payload == b"\x07" * (3 * 128)
+
+    def test_unknown_extension_tlvs_are_skipped(self):
+        """Future minor revisions may ride new TLVs next to the trace
+        one; a v2 decoder skips what it does not know and still finds
+        the payload at the right offset."""
+        tid, sid, _ = _CTX
+        whole = svc.encode_frame(
+            svc.FT_REQ, req_id=5, n_lanes=1, payload=b"\x11" * 128,
+            trace_ctx=(tid, sid, True),
+        )
+        body = bytearray(whole[4:])
+        ext_len = body[svc.HEADER_BYTES]
+        old_ext = bytes(
+            body[svc.HEADER_BYTES + 1:svc.HEADER_BYTES + 1 + ext_len]
+        )
+        unknown = bytes([0x7F, 3]) + b"abc"  # type 0x7f, 3 value bytes
+        new_ext = unknown + old_ext + unknown
+        rebuilt = (
+            bytes(body[:svc.HEADER_BYTES])
+            + bytes([len(new_ext)]) + new_ext
+            + bytes(body[svc.HEADER_BYTES + 1 + ext_len:])
+        )
+        f = svc.decode_frame(rebuilt)
+        assert f.trace_ctx == (tid, sid, True)
+        assert f.payload == b"\x11" * 128
+
+    def test_extension_overruns_are_typed_malformed(self):
+        tid, sid, _ = _CTX
+        whole = svc.encode_frame(
+            svc.FT_REQ, n_lanes=1, payload=b"\x00" * 128,
+            trace_ctx=(tid, sid, True),
+        )
+        # ext_len pointing past the end of the frame
+        body = bytearray(whole[4:])
+        body[svc.HEADER_BYTES] = 255
+        short = bytes(body[:svc.HEADER_BYTES + 10])
+        with pytest.raises(svc.FrameError) as ei:
+            svc.decode_frame(short)
+        assert ei.value.code == svc.ERR_MALFORMED
+        # TLV length overrunning its block
+        body = bytearray(whole[4:])
+        body[svc.HEADER_BYTES + 2] = 250
+        with pytest.raises(svc.FrameError) as ei:
+            svc.decode_frame(bytes(body))
+        assert ei.value.code == svc.ERR_MALFORMED
+
+    def test_v2_header_cut_before_ext_is_typed_malformed(self):
+        tid, sid, _ = _CTX
+        whole = svc.encode_frame(
+            svc.FT_REQ, n_lanes=1, payload=b"\x00" * 128,
+            trace_ctx=(tid, sid, True),
+        )
+        with pytest.raises(svc.FrameError) as ei:
+            svc.decode_frame(whole[4:4 + svc.HEADER_BYTES])
+        assert ei.value.code == svc.ERR_MALFORMED
+
+    def test_max_frame_budget_covers_the_extension_block(self):
+        tid, sid, _ = _CTX
+        whole = svc.encode_frame(
+            svc.FT_REQ, n_lanes=4, payload=b"\x00" * (4 * 128),
+            trace_ctx=(tid, sid, True),
+        )
+        assert len(whole) - 4 <= svc.max_frame_bytes(4)
+
+
+# ---------------------------------------------------------------------------
+# live interop: v1 clients x v2 servers in every combination
+# ---------------------------------------------------------------------------
+
+
+class _Daemon:
+    """One scheduler + service on a fresh Unix socket, optionally traced
+    and optionally advertising the v2 trace capability."""
+
+    def __init__(self, tag, advertise_trace=True, tracer=None):
+        self.tracer = tracer
+        self.sched = VerifyScheduler(
+            spec="cpu", flush_us=200, lane_budget=256, max_queue=256,
+            qos="off", tracer=tracer,
+        )
+        self.path = "/tmp/cbft-test-trc-%s-%d.sock" % (tag, os.getpid())
+        self.address = "unix://" + self.path
+        self.service = svc.VerifyService(
+            self.sched, self.address, advertise_trace=advertise_trace,
+        )
+        self.sched.start()
+        self.service.start()
+        self.clients = []
+
+    def client(self, tenant, tracer=None):
+        c = svc.RemoteVerifier(
+            self.address, tenant=tenant, timeout_ms=15_000,
+            retry_s=0.05, tracer=tracer,
+        )
+        self.clients.append(c)
+        return c
+
+    def stop(self):
+        for c in self.clients:
+            c.close()
+        self.service.stop()
+        self.sched.stop()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+def _raw_conn(daemon):
+    deadline = time.monotonic() + 20
+    while True:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(10)
+        try:
+            s.connect(daemon.path)
+            break
+        except OSError:
+            # accept backlog briefly full under the fuzz loop's
+            # connection churn — retry until the listener drains
+            s.close()
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.01)
+    s.sendall(svc.encode_frame(
+        svc.FT_CLIENT_HELLO, payload=b"raw",
+    ))
+    return s
+
+
+def _read_frame(s):
+    buf = b""
+    while len(buf) < 4:
+        chunk = s.recv(4 - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    (length,) = _LEN.unpack(buf)
+    buf = b""
+    while len(buf) < length:
+        chunk = s.recv(length - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return svc.decode_frame(buf)
+
+
+def _no_refusals(service):
+    snap = service.snapshot()
+    assert snap["errors"] == {}, snap["errors"]
+    for tenant, rec in snap["tenants_panel"].items():
+        assert rec["refusals"] == {}, (tenant, rec["refusals"])
+
+
+class TestInterop:
+    def test_v2_client_against_v1_server_stays_on_v1_wire(self):
+        """advertise_trace=False IS a v1 server: no capability byte in
+        the HELLO payload, so a traced v2 client must keep shipping
+        plain v1 frames — same verdicts, zero refusals."""
+        d = _Daemon("v1srv", advertise_trace=False)
+        try:
+            tracer = Tracer(sample=1.0, seed=7)
+            c = d.client("v2c", tracer=tracer)
+            items = _batch(6, tag=b"v1srv", bad=(1, 4))
+            ok, mask = c.submit(items, subsystem="consensus").result(
+                timeout=30
+            )
+            assert not ok and mask == _expected(items)
+            assert c.snapshot()["server_proto"] == 1
+            assert tracer.n_started >= 1  # client still traces locally
+            _no_refusals(d.service)
+        finally:
+            d.stop()
+
+    def test_v1_client_against_v2_server(self):
+        """An untraced client (= the v1 wire: no tracer, no extension
+        bytes ever) gets identical verdicts from a v2 server."""
+        d = _Daemon("v1cli", advertise_trace=True)
+        try:
+            c = d.client("v1c")
+            items = _batch(6, tag=b"v1cli", bad=(0,))
+            ok, mask = c.submit(items, subsystem="consensus").result(
+                timeout=30
+            )
+            assert not ok and mask == _expected(items)
+            _no_refusals(d.service)
+        finally:
+            d.stop()
+
+    def test_raw_v2_trace_frame_gets_a_normal_verdict(self):
+        """A hand-built frame carrying the trace extension verifies like
+        its v1 twin — the server strips the extension before the exact
+        payload-size check."""
+        d = _Daemon("rawv2")
+        try:
+            items = _batch(2, tag=b"rawv2")
+            wire, _ = svc.pack_items_compact(items)
+            tid, sid, _ = _CTX
+            s = _raw_conn(d)
+            try:
+                s.sendall(svc.encode_frame(
+                    svc.FT_REQ, req_id=3, n_lanes=2,
+                    payload=wire.tobytes(), trace_ctx=(tid, sid, True),
+                ))
+                frame = _read_frame(s)
+                while frame is not None and frame.ftype == svc.FT_HELLO:
+                    frame = _read_frame(s)
+                assert frame is not None and frame.ftype == svc.FT_RESP
+                assert frame.req_id == 3
+                assert frame.payload[0] == svc.ST_OK
+                bits = np.unpackbits(
+                    np.frombuffer(frame.payload[1:], np.uint8),
+                    bitorder="little",
+                )[:2]
+                assert list(bits.astype(bool)) == [True, True]
+            finally:
+                s.close()
+            _no_refusals(d.service)
+        finally:
+            d.stop()
+
+    def test_trace_frame_truncation_at_every_offset(self):
+        """The every-offset truncation fuzz, rerun over the EXTENDED
+        header: no cut of a trace-bearing frame may kill the accept
+        loop."""
+        d = _Daemon("fuzzv2")
+        try:
+            items = _batch(2, tag=b"fuzzv2")
+            wire, _ = svc.pack_items_compact(items)
+            tid, sid, _ = _CTX
+            whole = svc.encode_frame(
+                svc.FT_REQ, kind=svc.KIND_COMPACT, req_id=1, n_lanes=2,
+                payload=wire.tobytes(), trace_ctx=(tid, sid, True),
+            )
+            for cut in range(1, len(whole)):
+                s = _raw_conn(d)
+                s.sendall(whole[:cut])
+                s.close()
+            ok, mask = d.client("after-fuzz").submit(
+                items, subsystem="consensus"
+            ).result(timeout=30)
+            assert ok and mask == [True, True]
+        finally:
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# the stitched trace: one trace_id across two flight recorders
+# ---------------------------------------------------------------------------
+
+
+def _wait(pred, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestStitchedTrace:
+    def test_submit_stitches_across_the_socket(self):
+        server_tracer = Tracer(sample=0.0, seed=11)
+        client_tracer = Tracer(sample=1.0, seed=13)
+        d = _Daemon("stitch", advertise_trace=True, tracer=server_tracer)
+        try:
+            c = d.client("stitch-t", tracer=client_tracer)
+            # warm up: the capability byte rides the async HELLO, so the
+            # first submit may still be on proto 1
+            c.submit(_batch(2, tag=b"warm")).result(timeout=30)
+            assert _wait(lambda: c.snapshot()["server_proto"] >= 2)
+
+            before = {t["trace_id"] for t in client_tracer.recent()}
+            items = _batch(4, tag=b"stitch", bad=(2,))
+            ok, mask = c.submit(items, subsystem="consensus").result(
+                timeout=30
+            )
+            assert not ok and mask == _expected(items)
+
+            assert _wait(lambda: any(
+                t["trace_id"] not in before
+                for t in client_tracer.recent()
+            ))
+            ctrace = next(
+                t for t in client_tracer.recent()
+                if t["trace_id"] not in before
+            )
+            assert ctrace["root"] == "submit"
+            cnames = {s["name"] for s in ctrace["spans"]}
+            assert {"submit", "pack", "wire_wait"} <= cnames
+
+            # the server adopted the client's trace: same trace_id in
+            # the OTHER process's flight recorder even though the server
+            # tracer samples nothing locally (sample=0)
+            assert _wait(lambda: any(
+                t["trace_id"] == ctrace["trace_id"]
+                for t in server_tracer.recent()
+            ))
+            strace = next(
+                t for t in server_tracer.recent()
+                if t["trace_id"] == ctrace["trace_id"]
+            )
+            req = next(
+                s for s in strace["spans"] if s["name"] == "request"
+            )
+            submit_span = next(
+                s for s in ctrace["spans"] if s["name"] == "submit"
+            )
+            assert submit_span["parent_id"] is None
+            assert req["parent_id"] == submit_span["span_id"]
+
+            # tools/trace_report.py fuses the two dumps into one tree
+            import trace_report
+
+            merged = trace_report.merge_traces(
+                [[ctrace], [strace]]
+            )
+            assert len(merged) == 1
+            mnames = {s["name"] for s in merged[0]["spans"]}
+            assert {"submit", "pack", "wire_wait", "request"} <= mnames
+            stages = {
+                r["stage"] for r in trace_report.stage_table(merged)
+            }
+            assert {"submit", "request"} <= stages
+        finally:
+            d.stop()
+
+    def test_unsampled_submit_ships_no_extension(self):
+        """sample=0 on the client = NOOP span = pure v1 frames even
+        against a v2 server; the server never adopts anything."""
+        server_tracer = Tracer(sample=0.0, seed=3)
+        d = _Daemon("nosample", tracer=server_tracer)
+        try:
+            c = d.client("quiet", tracer=Tracer(sample=0.0))
+            c.submit(_batch(2, tag=b"warm2")).result(timeout=30)
+            assert _wait(lambda: c.snapshot()["server_proto"] >= 2)
+            ok, _mask = c.submit(_batch(3, tag=b"quiet")).result(
+                timeout=30
+            )
+            assert ok
+            assert server_tracer.recent() == []
+            _no_refusals(d.service)
+        finally:
+            d.stop()

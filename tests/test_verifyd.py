@@ -1,0 +1,200 @@
+"""verifyd daemon ops surface (PR 19): the programmatic Daemon builder,
+its /metrics + /debug/verify + /debug/traces endpoints (per-tenant
+service panel, incident timeline), and the event-triggered incident
+dump embedding the service view. Runs the real HTTP server on a free
+port and a real client over a Unix socket."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import service as svc
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+
+import verifyd  # noqa: E402
+
+
+def _batch(n, tag=b"vd", bad=()):
+    keys = [ed.gen_priv_key_from_secret(tag + b"-%d" % i) for i in range(n)]
+    items = []
+    for i, k in enumerate(keys):
+        msg = tag + b" msg %d" % i
+        sig = k.sign(msg)
+        if i in bad:
+            sig = bytes(sig[:-1]) + bytes([sig[-1] ^ 0x01])
+        items.append((k.pub_key(), msg, sig))
+    return items
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as resp:
+        return resp.read().decode("utf-8")
+
+
+def _wait(pred, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    path = "/tmp/cbft-test-verifyd-%d.sock" % os.getpid()
+    d = verifyd.Daemon(
+        "unix://" + path,
+        backend="cpu",
+        flush_us=200,
+        metrics_addr="127.0.0.1:0",
+        trace_sample=1.0,
+        dump_dir=str(tmp_path),
+    )
+    d.start()
+    clients = []
+
+    def client(tenant):
+        c = svc.RemoteVerifier(
+            d.service.address(), tenant=tenant, timeout_ms=15_000,
+            retry_s=0.05,
+        )
+        clients.append(c)
+        return c
+
+    d.test_client = client
+    yield d
+    for c in clients:
+        c.close()
+    d.stop()
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+class TestDaemonOpsSurface:
+    def test_metrics_and_debug_verify_serve_the_service_panel(
+        self, daemon
+    ):
+        c = daemon.test_client("panel-t")
+        items = _batch(5, bad=(1,))
+        ok, mask = c.submit(items, subsystem="consensus").result(timeout=30)
+        assert not ok and mask.count(False) == 1
+
+        assert daemon.metrics_port is not None
+        text = _get(daemon.metrics_port, "/metrics")
+        assert "verify_service_frames" in text
+        assert "verify_service_lanes" in text
+        assert "verify_service_bytes_per_lane" in text
+
+        doc = json.loads(_get(daemon.metrics_port, "/debug/verify"))
+        panel = doc["sources"]["service"]["tenants_panel"]
+        assert "panel-t" in panel
+        row = panel["panel-t"]
+        assert row["requests"] >= 1 and row["responses"] >= 1
+        assert row["mean_ms"] > 0.0
+        assert row["refusals"] == {}
+        assert doc["sources"]["service"]["protocol_version"] == svc.VERSION
+        assert "timeline" in doc
+
+    def test_debug_traces_capture_adopted_requests(self, daemon):
+        c = daemon.test_client("traced-t")
+        c.submit(_batch(3)).result(timeout=30)
+        assert _wait(lambda: json.loads(
+            _get(daemon.metrics_port, "/debug/traces")
+        ).get("traces"))
+        doc = json.loads(_get(daemon.metrics_port, "/debug/traces"))
+        names = {
+            s["name"] for tr in doc["traces"] for s in tr.get("spans", ())
+        }
+        assert "request" in names
+
+    def test_midflight_disconnect_lands_on_the_timeline(self, tmp_path):
+        """Kill a client with a request provably in flight (the device
+        pool is gated shut): the server's teardown must put a
+        ``disconnect`` event on the hub timeline and /debug/verify must
+        surface it."""
+        import threading
+
+        gate = threading.Event()
+        inner = svc.host_row_verifier()
+
+        def verifier(rows):
+            gate.wait(20)
+            return inner(rows)
+
+        path = "/tmp/cbft-test-verifyd-gate-%d.sock" % os.getpid()
+        d = verifyd.Daemon(
+            "unix://" + path, backend="cpu", flush_us=200,
+            metrics_addr="127.0.0.1:0", dump_dir=str(tmp_path),
+            row_verifier=verifier,
+        )
+        d.start()
+        c = svc.RemoteVerifier(
+            d.service.address(), tenant="churn-t", timeout_ms=15_000,
+            retry_s=0.05,
+        )
+        try:
+            fut = c.submit(_batch(3, tag=b"gate"))
+            assert _wait(lambda: d.service.pending_requests() > 0)
+            c.kill_connection()
+            assert _wait(lambda: any(
+                ev.get("kind") == "disconnect"
+                and ev.get("tenant") == "churn-t"
+                for ev in d.hub.timeline()
+            ))
+            gate.set()
+            ok, _mask = fut.result(timeout=30)  # local CPU fallback
+            assert ok
+            doc = json.loads(_get(d.metrics_port, "/debug/verify"))
+            kinds = {ev.get("kind") for ev in doc["timeline"]}
+            assert "disconnect" in kinds
+        finally:
+            gate.set()
+            c.close()
+            d.stop()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def test_incident_event_dumps_with_the_service_view(self, daemon):
+        c = daemon.test_client("incident-t")
+        c.submit(_batch(4)).result(timeout=30)
+        daemon.hub.note_event("brownout_trip", {"qclass": "mempool"})
+        assert _wait(lambda: daemon.last_dump is not None, timeout=10)
+        with open(daemon.last_dump, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["reason"] == "brownout_trip"
+        assert doc["event"]["qclass"] == "mempool"
+        assert "incident-t" in doc["service"]["tenants_panel"]
+        assert any(
+            ev.get("kind") == "brownout_trip" for ev in doc["timeline"]
+        )
+
+    def test_non_incident_events_do_not_dump(self, daemon):
+        daemon.hub.note_event("valset_registered", {"tenant": "x"})
+        time.sleep(0.1)
+        assert daemon.last_dump is None
+
+    def test_stop_is_clean_and_idempotent_endpoints_die(self, daemon):
+        port = daemon.metrics_port
+        assert _get(port, "/metrics")
+
+
+class TestDaemonCli:
+    def test_bad_address_is_a_usage_error(self, capsys):
+        assert verifyd.main(["--address", "ftp://nope"]) == 2
+        assert "error" in capsys.readouterr().err

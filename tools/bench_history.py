@@ -159,6 +159,15 @@ def direction(path: str, unit: Optional[str] = None) -> Optional[str]:
         return LOWER_IS_BETTER
     if leaf.startswith("adversary_") and leaf.endswith("_p99_ms"):
         return LOWER_IS_BETTER
+    # cross-process observability guards (PR 19): what end-to-end trace
+    # propagation (v2 wire extension + adopted server spans) costs per
+    # request is a percentage the generic rules would drop, and typed
+    # service refusal counts are bare counters — both are one-way
+    # ratchets that must only ever shrink
+    if leaf.endswith("_trace_overhead_pct"):
+        return LOWER_IS_BETTER
+    if leaf.endswith("_refusals"):
+        return LOWER_IS_BETTER
     if leaf.endswith(("_ms", "_s", "_us", "_ns")) or "_ms_" in leaf:
         return LOWER_IS_BETTER
     return None
@@ -344,7 +353,8 @@ def _self_test() -> int:
     injected 20% regression MUST flag. → process exit code."""
     import tempfile
 
-    def rec(sps: float, p50: float, adv_p99: float = 80.0) -> dict:
+    def rec(sps: float, p50: float, adv_p99: float = 80.0,
+            trace_ovh: float = 1.0) -> dict:
         return {
             "metric": "selftest_throughput",
             "value": round(sps, 1),
@@ -356,23 +366,29 @@ def _self_test() -> int:
                     "adversary_512_p99_ms": round(adv_p99, 2),
                     "adversary_wrong_verdicts": 0,
                 },
+                "service": {
+                    "service_trace_overhead_pct": round(trace_ovh, 2),
+                    "service_refusals": 0,
+                },
             },
         }
 
     stable = [
-        rec(1000.0 + 3 * i, 50.0 + 0.05 * i, 80.0 + 0.2 * i)
+        rec(1000.0 + 3 * i, 50.0 + 0.05 * i, 80.0 + 0.2 * i,
+            1.0 + 0.01 * i)
         for i in range(5)
     ]
     cases = {
         # newest within ~1% of the rolling median: must NOT flag
         "clean": (stable + [rec(1010.0, 50.3)], 0),
         # one noisy run, then back in band: a blip, must NOT flag
-        "blip": (stable + [rec(800.0, 62.0, 101.0),
+        "blip": (stable + [rec(800.0, 62.0, 101.0, 1.4),
                            rec(1011.0, 50.3)], 0),
         # injected 20% throughput drop + 24% latency bump (storm p99
-        # included), sustained over the confirmation window: MUST flag
-        "regressed": (stable + [rec(801.0, 61.8, 100.5),
-                                rec(800.0, 62.0, 101.0)], 1),
+        # and a 40% trace-propagation-overhead creep included),
+        # sustained over the confirmation window: MUST flag
+        "regressed": (stable + [rec(801.0, 61.8, 100.5, 1.41),
+                                rec(800.0, 62.0, 101.0, 1.4)], 1),
     }
     failures = []
     # the adversary wrong-verdict leaf's healthy baseline is 0, which
@@ -383,6 +399,11 @@ def _self_test() -> int:
         ("stages.adversary.adversary_wrong_verdicts", LOWER_IS_BETTER),
         ("stages.adversary.adversary_512_p99_ms", LOWER_IS_BETTER),
         ("stages.adversary.adversary_1024_p50_ms", LOWER_IS_BETTER),
+        # PR 19 ratchets: refusal counts' healthy baseline is 0 (band
+        # math skips it), so the direction rule is the whole guard
+        ("stages.service.service_trace_overhead_pct", LOWER_IS_BETTER),
+        ("stages.service.service_refusals", LOWER_IS_BETTER),
+        ("stages.service.service_tenant_refusals", LOWER_IS_BETTER),
     ):
         got = direction(path)
         ok = got == want
@@ -407,6 +428,8 @@ def _self_test() -> int:
                     "stages.run.sigs_per_sec" in flagged
                     and "stages.p50.verify_commit_p50_ms" in flagged
                     and "stages.adversary.adversary_512_p99_ms" in flagged
+                    and "stages.service.service_trace_overhead_pct"
+                    in flagged
                 )
             print(f"self-test {name}: rc={rc} (want {want_rc}) "
                   f"{'ok' if ok else 'FAIL'}")

@@ -370,6 +370,8 @@ def main() -> int:
             and summary["readmitted"]
             and summary["pending_after"] == 0
             and summary["bytes_per_lane_ok"]
+            and summary["timeline_ok"]
+            and summary["incident_dump_ok"]
         )
         print("CHAOS SERVICE", "PASS" if ok else "FAIL",
               "seed=%d" % args.seed)

@@ -11,10 +11,16 @@ live node's /debug/traces endpoint, and prints:
 Optionally re-exports the traces as Chrome trace-event JSON (--chrome)
 for Perfetto / chrome://tracing.
 
+Several sources stitch into ONE report joined on trace_id — pass a
+node client's dump plus the verifyd daemon's dump and traces the
+client propagated over the verify-service wire fuse back into a single
+span tree (client pack / wire wait + server coalesce / dispatch).
+
 Usage:
     python tools/trace_report.py NODE_HOME/data/trace_dump_watchdog.json
     python tools/trace_report.py http://127.0.0.1:26660/debug/traces
     python tools/trace_report.py dump.json --top 3 --chrome out.json
+    python tools/trace_report.py client_dump.json daemon_dump.json
 """
 
 import argparse
@@ -47,6 +53,47 @@ def load_traces(source: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
         )
     meta = {k: v for k, v in doc.items() if k != "traces"}
     return meta, doc["traces"]
+
+
+def merge_traces(
+    trace_lists: List[List[Dict[str, Any]]],
+) -> List[Dict[str, Any]]:
+    """Stitch traces from SEVERAL dumps (e.g. a node client's flight
+    recorder plus the verifyd daemon's) into one list, joined on
+    trace_id. Entries sharing a trace_id — the client's submit root and
+    the server's adopted request span — fuse into one trace: spans
+    concatenated, root taken from whichever side holds the parentless
+    span, duration from the longest side (clocks are per-process, so
+    durations are comparable but absolute starts are not)."""
+    merged: Dict[str, Dict[str, Any]] = {}
+    order: List[str] = []
+    for traces in trace_lists:
+        for tr in traces:
+            tid = str(tr.get("trace_id", "?"))
+            spans = list(tr.get("spans", ()))
+            cur = merged.get(tid)
+            if cur is None:
+                merged[tid] = {
+                    "trace_id": tid,
+                    "root": tr.get("root", "?"),
+                    "dur_us": float(tr.get("dur_us", 0.0)),
+                    "spans": spans,
+                }
+                order.append(tid)
+                continue
+            cur["spans"] = cur["spans"] + spans
+            cur["dur_us"] = max(
+                cur["dur_us"], float(tr.get("dur_us", 0.0))
+            )
+            # the true root is the parentless span — the client-side
+            # submit; a server-only entry's "root" is its adopted span
+            if any(sp.get("parent_id") is None for sp in spans):
+                cur["root"] = tr.get("root", cur["root"])
+    for tid in order:
+        merged[tid]["spans"].sort(
+            key=lambda s: float(s.get("start_us", 0.0))
+        )
+    return [merged[tid] for tid in order]
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -229,8 +276,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Per-stage latency report from a verify-trace dump."
     )
     ap.add_argument(
-        "source",
-        help="dump file path, or a node's /debug/traces URL",
+        "sources", nargs="+", metavar="source",
+        help="dump file path(s), or /debug/traces URL(s); several "
+             "sources (e.g. a node client dump + the verifyd daemon "
+             "dump) are stitched into one report joined on trace_id",
     )
     ap.add_argument(
         "--top", type=int, default=5,
@@ -247,10 +296,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = ap.parse_args(argv)
     try:
-        meta, traces = load_traces(args.source)
+        loaded = [load_traces(src) for src in args.sources]
     except (OSError, ValueError, json.JSONDecodeError) as exc:
         print(f"error: {exc}", file=sys.stderr)
         return 1
+    if len(loaded) == 1:
+        meta, traces = loaded[0]
+    else:
+        # first dump's meta (reason/wall_time) heads the stitched report
+        meta = loaded[0][0]
+        traces = merge_traces([tr for _, tr in loaded])
+        meta = dict(meta)
+        meta.setdefault("stitched_sources", len(loaded))
     print(render(meta, traces, top=args.top, wire=args.wire))
     if args.chrome:
         from cometbft_tpu.libs.trace import chrome_trace

@@ -15,10 +15,21 @@ Usage:
     python tools/verify_top.py URL --interval 1 --count 10
     python tools/verify_top.py URL --json > snap.json
 
+Fleet mode: pass SEVERAL endpoints (the verifyd daemon plus N node
+clients) and verify_top renders ONE merged table — per-tenant
+correlation of client-side fallback reasons against server-side
+refusals/sheds/disconnects, plus the merged incident timeline ordered
+on the shared wall clock:
+
+    python tools/verify_top.py http://daemon:26670 \\
+        http://node1:26660 http://node2:26660 --once
+    python tools/verify_top.py daemon.json c1.json c2.json --json
+
 ``--once`` prints a single frame and exits (tests / CI / cron);
 ``--json`` prints one machine-readable snapshot (the raw /debug/verify
-document — what route_audit consumes) and exits; without either the
-screen refreshes every ``--interval`` seconds until ^C.
+document — what route_audit consumes — or, in fleet mode, the merged
+fleet document) and exits; without either the screen refreshes every
+``--interval`` seconds until ^C.
 """
 
 import argparse
@@ -119,6 +130,24 @@ def _sparkline(values: List[Any], width: int = 32) -> str:
         lvl = int((v - lo) / span * (len(_SPARK_GLYPHS) - 1))
         cells.append(_SPARK_GLYPHS[lvl])
     return "".join(cells).rjust(width)
+
+
+def _fmt_event(ev: Dict[str, Any], origin: Optional[str] = None) -> str:
+    """One incident-timeline line: wall-clock stamp, side, kind, detail."""
+    t = ev.get("t")
+    if isinstance(t, (int, float)):
+        ts = time.strftime("%H:%M:%S", time.localtime(t))
+        ts += f".{int((t % 1) * 1000):03d}"
+    else:
+        ts = "-"
+    head = f"{ts}  [{ev.get('source', '?')}]"
+    if origin:
+        head += f" {origin}"
+    detail = " ".join(
+        f"{k}={v}" for k, v in sorted(ev.items())
+        if k not in ("t", "kind", "source", "origin")
+    )
+    return f"{head}  {ev.get('kind', '?')}  {detail}".rstrip()
 
 
 def _human_bytes(v: Any) -> str:
@@ -481,6 +510,218 @@ def render(snap: Dict[str, Any]) -> str:
         ["subsystem", "req", "err", "sigs", "req/s", "p50_ms", "p99_ms",
          "height"],
     ))
+
+    events = snap.get("timeline")
+    if isinstance(events, list) and events:
+        out.append("")
+        out.append(f"incident timeline (last {min(len(events), 12)} "
+                   f"of {len(events)}, oldest first):")
+        for ev in events[-12:]:
+            if isinstance(ev, dict):
+                out.append("  " + _fmt_event(ev))
+    return "\n".join(out)
+
+
+# -- fleet mode --------------------------------------------------------------
+
+# the client-side stats() keys that mean "this request fell back to the
+# local CPU path" — the rows correlated against server-side refusals
+_FALLBACK_KEYS = ("disconnected", "timeout", "rejected", "stale", "error")
+
+
+def _svc_source(snap: Dict[str, Any]) -> Dict[str, Any]:
+    sources = snap.get("sources", {})
+    svc = sources.get("service", {}) if isinstance(sources, dict) else {}
+    return svc if isinstance(svc, dict) else {}
+
+
+def merge_fleet(snaps: List[Any]) -> Dict[str, Any]:
+    """Merge N /debug/verify snapshots — one verifyd daemon plus node
+    clients — into ONE fleet document.
+
+    ``snaps`` is a list of ``(label, snapshot)`` pairs. The server is
+    recognised by its service source carrying ``coalesce``; clients by
+    ``connected``. The merge correlates per tenant: the client's
+    fallback reasons (its stats() counters) against the server's view
+    of the same tenant (requests/rejected/refusals/disconnects from the
+    tenants_panel), and splices every side's incident timeline onto the
+    shared wall clock.
+    """
+    endpoints: List[Dict[str, Any]] = []
+    daemon: Optional[Dict[str, Any]] = None
+    daemon_label: Optional[str] = None
+    clients: Dict[str, Dict[str, Any]] = {}
+    timeline: List[Dict[str, Any]] = []
+    snapshots: Dict[str, Any] = {}
+    for label, snap in snaps:
+        snapshots[label] = snap
+        svc = _svc_source(snap)
+        if "coalesce" in svc:
+            role = "server"
+            if daemon is None:
+                daemon = svc
+                daemon_label = label
+        elif "connected" in svc:
+            role = "client"
+            clients[label] = svc
+        else:
+            role = "node"
+        endpoints.append({
+            "endpoint": label,
+            "role": role,
+            "state": (snap.get("sources", {}).get("supervisor", {})
+                      or {}).get("state", "-")
+            if isinstance(snap.get("sources"), dict) else "-",
+        })
+        events = snap.get("timeline")
+        if isinstance(events, list):
+            for ev in events:
+                if isinstance(ev, dict):
+                    e = dict(ev)
+                    e["origin"] = label
+                    timeline.append(e)
+    # one clock: every note_event() stamps wall time, so a plain sort
+    # interleaves server breaker motion with client fallbacks correctly
+    timeline.sort(key=lambda e: e.get("t")
+                  if isinstance(e.get("t"), (int, float)) else 0.0)
+
+    correlation: Dict[str, Dict[str, Any]] = {}
+
+    def _row(tenant: str) -> Dict[str, Any]:
+        if tenant not in correlation:
+            correlation[tenant] = {
+                "tenant": tenant,
+                "client": None,
+                "connected": None,
+                "remote_ok": 0,
+                "fallbacks": {k: 0 for k in _FALLBACK_KEYS},
+                "server_requests": 0,
+                "server_responses": 0,
+                "server_rejected": 0,
+                "server_refusals": {},
+                "server_disconnects": 0,
+                "server_mean_ms": 0.0,
+            }
+        return correlation[tenant]
+
+    for label, svc in clients.items():
+        tenant = svc.get("tenant") or label
+        stats = svc.get("stats", {})
+        stats = stats if isinstance(stats, dict) else {}
+        row = _row(str(tenant))
+        row["client"] = label
+        row["connected"] = bool(svc.get("connected"))
+        row["remote_ok"] += stats.get("remote_ok", 0)
+        for k in _FALLBACK_KEYS:
+            row["fallbacks"][k] += stats.get(k, 0)
+    panel = (daemon or {}).get("tenants_panel", {})
+    if isinstance(panel, dict):
+        for tenant, rec in panel.items():
+            if not isinstance(rec, dict):
+                continue
+            row = _row(str(tenant))
+            row["server_requests"] = rec.get("requests", 0)
+            row["server_responses"] = rec.get("responses", 0)
+            row["server_rejected"] = rec.get("rejected", 0)
+            refusals = rec.get("refusals", {})
+            row["server_refusals"] = dict(refusals) \
+                if isinstance(refusals, dict) else {}
+            row["server_disconnects"] = rec.get("disconnects", 0)
+            mean = rec.get("mean_ms", 0.0)
+            row["server_mean_ms"] = round(mean, 3) \
+                if isinstance(mean, (int, float)) else 0.0
+
+    return {
+        "fleet": True,
+        "ts": time.time(),
+        "endpoints": endpoints,
+        "daemon_endpoint": daemon_label,
+        "daemon": daemon,
+        "clients": clients,
+        "correlation": correlation,
+        "timeline": timeline,
+        "snapshots": snapshots,
+    }
+
+
+def render_fleet(fleet: Dict[str, Any]) -> str:
+    """One frame of the merged fleet picture, plain text."""
+    out: List[str] = []
+    endpoints = fleet.get("endpoints", [])
+    daemon = fleet.get("daemon") or {}
+    out.append(
+        f"verify fleet  endpoints={len(endpoints)}  "
+        f"daemon={fleet.get('daemon_endpoint') or '-'}  "
+        f"clients={len(fleet.get('clients', {}))}"
+    )
+    if daemon:
+        frames = daemon.get("frames", {})
+        out.append(
+            f"daemon  addr={daemon.get('address', '-')}  "
+            f"proto=v{daemon.get('protocol_version', 1)}  "
+            f"coalesce={'on' if daemon.get('coalesce') else 'OFF'}  "
+            f"conns={daemon.get('connections', 0)}  "
+            f"req_frames={frames.get('req', 0)}  "
+            f"pending={daemon.get('pending', 0)}  "
+            f"stale_drops={daemon.get('stale_drops', 0)}"
+        )
+    out.append("")
+    out.append("endpoints:")
+    out.append(_fmt_table(
+        [dict(e) for e in endpoints if isinstance(e, dict)],
+        ["endpoint", "role", "state"],
+    ))
+
+    out.append("")
+    out.append("tenant correlation (client fallbacks vs server refusals):")
+    corr_rows = []
+    for tenant in sorted(fleet.get("correlation", {})):
+        row = fleet["correlation"][tenant]
+        fb = row.get("fallbacks", {})
+        refusals = row.get("server_refusals", {})
+        conn = row.get("connected")
+        corr_rows.append({
+            "tenant": tenant,
+            "client": row.get("client") or "-",
+            "conn": "-" if conn is None else ("up" if conn else "DOWN"),
+            "ok": row.get("remote_ok", 0),
+            "fb_disc": fb.get("disconnected", 0),
+            "fb_tmo": fb.get("timeout", 0),
+            "fb_rej": fb.get("rejected", 0),
+            "fb_stale": fb.get("stale", 0),
+            "fb_err": fb.get("error", 0),
+            "srv_req": row.get("server_requests", 0),
+            "srv_rej": row.get("server_rejected", 0),
+            "srv_refuse": sum(refusals.values()) if refusals else 0,
+            "srv_disc": row.get("server_disconnects", 0),
+            "mean_ms": row.get("server_mean_ms", 0.0),
+        })
+    out.append(_fmt_table(
+        corr_rows,
+        ["tenant", "client", "conn", "ok", "fb_disc", "fb_tmo", "fb_rej",
+         "fb_stale", "fb_err", "srv_req", "srv_rej", "srv_refuse",
+         "srv_disc", "mean_ms"],
+    ))
+    refusal_kinds: Dict[str, int] = {}
+    for row in fleet.get("correlation", {}).values():
+        for code, n in (row.get("server_refusals") or {}).items():
+            refusal_kinds[code] = refusal_kinds.get(code, 0) + n
+    if refusal_kinds:
+        out.append(
+            "refusals by reason  " + "  ".join(
+                f"{code}={n}" for code, n in sorted(refusal_kinds.items())
+            )
+        )
+
+    events = fleet.get("timeline", [])
+    out.append("")
+    if events:
+        out.append(f"incident timeline (last {min(len(events), 20)} "
+                   f"of {len(events)}, oldest first, merged clock):")
+        for ev in events[-20:]:
+            out.append("  " + _fmt_event(ev, origin=ev.get("origin")))
+    else:
+        out.append("incident timeline: (no events)")
     return "\n".join(out)
 
 
@@ -489,9 +730,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         description="Live capacity view of a node's verify path."
     )
     ap.add_argument(
-        "source",
+        "sources", nargs="+", metavar="source",
         help="a node's /debug/verify URL (path appended if missing) or "
-             "a snapshot JSON file",
+             "a snapshot JSON file; several sources (daemon + node "
+             "clients) switch to the merged fleet view",
     )
     ap.add_argument(
         "--once", action="store_true",
@@ -513,17 +755,34 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     args = ap.parse_args(argv)
 
+    # duplicate sources stay addressable in fleet tables/json keys
+    labels: List[str] = []
+    for src in args.sources:
+        label = src
+        n = 2
+        while label in labels:
+            label = f"{src}#{n}"
+            n += 1
+        labels.append(label)
+
     frames = 0
     while True:
         try:
-            snap = load_snapshot(args.source)
+            snaps = [
+                (label, load_snapshot(src))
+                for label, src in zip(labels, args.sources)
+            ]
         except Exception as exc:  # noqa: BLE001 - CLI surface
             print(f"error: {exc}", file=sys.stderr)
             return 1
+        if len(snaps) == 1:
+            doc: Any = snaps[0][1]
+        else:
+            doc = merge_fleet(snaps)
         if args.json:
-            print(json.dumps(snap, indent=2, sort_keys=True, default=str))
+            print(json.dumps(doc, indent=2, sort_keys=True, default=str))
             return 0
-        frame = render(snap)
+        frame = render(doc) if len(snaps) == 1 else render_fleet(doc)
         if args.once:
             print(frame)
             return 0

@@ -7,12 +7,20 @@ one device pool. Client frames carry the compact wire format directly
 device_put + the coalesced kernel dispatch; verdicts fan back out as
 one status byte + a packed verdict bitmap per request.
 
+Ops surface (--metrics-addr): the daemon serves the node's
+MetricsServer routes — ``/metrics`` (Prometheus text), ``/debug/verify``
+(one JSON snapshot: SLO, devices, per-tenant service panel, incident
+timeline), ``/debug/traces`` (+ ``/chrome``) off the daemon's flight
+recorder. Incident dumps fire on breaker opens and brownout trips and
+embed the service view (which tenants were riding the failing flush).
+
 Usage:
     python tools/verifyd.py                              # unix socket
     python tools/verifyd.py --address tcp://0.0.0.0:26670
     python tools/verifyd.py --backend tpu --flush-us 500 --qos on
     python tools/verifyd.py --no-coalesce                # bench baseline
     python tools/verifyd.py --stats 5                    # JSON snapshots
+    python tools/verifyd.py --metrics-addr 127.0.0.1:26670
 
 Point nodes at it with ``[crypto] verify_service = "unix:///..."`` or
 ``CBFT_VERIFY_SERVICE``; they fall back to local CPU verification on
@@ -29,12 +37,115 @@ from typing import List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# incidents whose timeline event flushes the flight recorder to disk
+_DUMP_EVENTS = ("brownout_trip", "breaker_open")
+_STATS_JOIN_S = 2.0
+
+
+class Daemon:
+    """The verifyd component graph, constructed without being started —
+    tests (and the chaos harness) drive it in-process; ``main`` drives
+    it from the CLI. One scheduler, one service, one telemetry hub, one
+    tracer, and (optionally) one MetricsServer."""
+
+    def __init__(
+        self,
+        address: str,
+        *,
+        backend: Optional[str] = None,
+        flush_us: Optional[int] = None,
+        max_chunk: Optional[int] = None,
+        qos: str = "default",
+        tenant_rate: Optional[int] = None,
+        coalesce: bool = True,
+        metrics_addr: Optional[str] = None,
+        trace_sample: Optional[float] = None,
+        dump_dir: Optional[str] = None,
+        advertise_trace: bool = True,
+        row_verifier=None,
+        logger=None,
+    ):
+        from cometbft_tpu.crypto import service as servicelib
+        from cometbft_tpu.crypto.scheduler import VerifyScheduler
+        from cometbft_tpu.crypto.telemetry import Metrics, TelemetryHub
+        from cometbft_tpu.libs import trace as tracelib
+        from cometbft_tpu.libs.log import new_tm_logger
+        from cometbft_tpu.libs.metrics import MetricsServer, Registry
+
+        self.logger = logger if logger is not None else new_tm_logger()
+        self.registry = Registry(namespace="cometbft")
+        self.tracer = tracelib.Tracer(sample=trace_sample, dump_dir=dump_dir)
+        tracelib.attach_stage_metrics(self.tracer, self.registry)
+        self.hub = TelemetryHub(metrics=Metrics(self.registry))
+        self.scheduler = VerifyScheduler(
+            spec=backend,
+            flush_us=flush_us,
+            lane_budget=max_chunk,
+            logger=self.logger.with_(module="scheduler"),
+            telemetry=self.hub,
+            tracer=self.tracer,
+            qos=qos,
+            tenant_rate=tenant_rate,
+            row_verifier=row_verifier,
+        )
+        self.hub.add_burn_watcher(self.scheduler.on_burn)
+        self.service = servicelib.VerifyService(
+            self.scheduler,
+            address,
+            coalesce=coalesce,
+            row_verifier=row_verifier,
+            metrics=servicelib.ServiceMetrics(self.registry),
+            telemetry=self.hub,
+            advertise_trace=advertise_trace,
+            logger=self.logger.with_(module="verifyd"),
+        )
+        # every incident dump carries the service view: which tenants
+        # were riding the failing flush, and the event ring around it
+        self.tracer.set_dump_context(lambda: {
+            "service": self.service.snapshot(),
+            "timeline": self.hub.timeline(),
+        })
+        self.hub.add_event_listener(self._on_event)
+        self._metrics_addr = metrics_addr
+        self._metrics_server: Optional[MetricsServer] = MetricsServer(
+            self.registry, tracer=self.tracer, telemetry=self.hub,
+        ) if metrics_addr is not None else None
+        self.metrics_port: Optional[int] = None
+        self.last_dump: Optional[str] = None
+
+    def _on_event(self, ev: dict) -> None:
+        if ev.get("kind") not in _DUMP_EVENTS:
+            return
+        path = self.tracer.dump(str(ev["kind"]), extra={"event": ev})
+        if path:
+            self.last_dump = path
+            self.logger.error(
+                "verifyd incident: flight recorder dumped",
+                kind=ev["kind"], path=path,
+            )
+
+    def start(self) -> None:
+        self.scheduler.start()
+        try:
+            self.service.start()
+        except Exception:
+            self.scheduler.stop()
+            raise
+        if self._metrics_server is not None:
+            host, _, port = self._metrics_addr.rpartition(":")
+            self.metrics_port = self._metrics_server.serve(
+                host or "127.0.0.1", int(port or 0)
+            )
+
+    def stop(self) -> None:
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+        self.service.stop()
+        self.scheduler.stop()
+
 
 def main(argv: Optional[List[str]] = None) -> int:
     from cometbft_tpu.crypto import service as servicelib
-    from cometbft_tpu.crypto.scheduler import VerifyScheduler
-    from cometbft_tpu.crypto.telemetry import TelemetryHub
-    from cometbft_tpu.libs.log import new_tm_logger
 
     ap = argparse.ArgumentParser(
         description="Shared verify-as-a-service daemon (one device pool, "
@@ -80,6 +191,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--stats", type=float, default=0.0, metavar="SECONDS",
         help="print a JSON service snapshot every N seconds",
     )
+    ap.add_argument(
+        "--metrics-addr", default=None, metavar="HOST:PORT",
+        help="serve /metrics, /debug/verify, /debug/traces on this "
+             "address (port 0 picks a free port)",
+    )
+    ap.add_argument(
+        "--trace-sample", type=float, default=None,
+        help="flight-recorder sampling fraction for daemon-rooted "
+             "traces (client-propagated sampled traces always record; "
+             "default: CBFT_TRACE_SAMPLE or 0)",
+    )
+    ap.add_argument(
+        "--dump-dir", default=None,
+        help="directory for incident trace dumps (breaker open / "
+             "brownout trip; default: CBFT_TRACE_DUMP_DIR)",
+    )
     args = ap.parse_args(argv)
 
     try:
@@ -88,40 +215,34 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: {exc}", file=sys.stderr)
         return 2
 
-    logger = new_tm_logger()
-    hub = TelemetryHub()
-    scheduler = VerifyScheduler(
-        spec=args.backend,
+    daemon = Daemon(
+        args.address,
+        backend=args.backend,
         flush_us=args.flush_us,
-        lane_budget=args.max_chunk,
-        logger=logger.with_(module="scheduler"),
-        telemetry=hub,
+        max_chunk=args.max_chunk,
         qos=args.qos,
         tenant_rate=args.tenant_rate,
-    )
-    service = servicelib.VerifyService(
-        scheduler,
-        args.address,
         coalesce=not args.no_coalesce,
-        telemetry=hub,
-        logger=logger.with_(module="verifyd"),
+        metrics_addr=args.metrics_addr,
+        trace_sample=args.trace_sample,
+        dump_dir=args.dump_dir,
     )
-    scheduler.start()
     try:
-        service.start()
+        daemon.start()
     except Exception as exc:  # noqa: BLE001 - CLI surface
         print(f"error: cannot listen on {args.address}: {exc}",
               file=sys.stderr)
-        scheduler.stop()
         return 1
 
-    print(
-        f"verifyd listening on {service.address()}  "
-        f"backend={scheduler.spec.name}  "
+    line = (
+        f"verifyd listening on {daemon.service.address()}  "
+        f"backend={daemon.scheduler.spec.name}  "
         f"coalesce={'on' if not args.no_coalesce else 'OFF'}  "
-        f"qos={args.qos}",
-        flush=True,
+        f"qos={args.qos}"
     )
+    if daemon.metrics_port is not None:
+        line += f"  metrics=http://127.0.0.1:{daemon.metrics_port}/metrics"
+    print(line, flush=True)
 
     done = threading.Event()
 
@@ -131,17 +252,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGINT, _stop)
     signal.signal(signal.SIGTERM, _stop)
 
-    try:
-        while not done.wait(args.stats if args.stats > 0 else 1.0):
-            if args.stats > 0:
+    # The stats printer gets its own thread so the idle path (no
+    # --stats) blocks straight on the shutdown event instead of waking
+    # every second just to loop; teardown joins it bounded.
+    stats_thread: Optional[threading.Thread] = None
+    if args.stats > 0:
+
+        def _stats_loop() -> None:
+            while not done.wait(args.stats):
                 print(
-                    json.dumps(service.snapshot(), sort_keys=True,
+                    json.dumps(daemon.service.snapshot(), sort_keys=True,
                                default=str),
                     flush=True,
                 )
+
+        stats_thread = threading.Thread(
+            target=_stats_loop, daemon=True, name="verifyd-stats"
+        )
+        stats_thread.start()
+
+    try:
+        done.wait()
     finally:
-        service.stop()
-        scheduler.stop()
+        done.set()
+        if stats_thread is not None:
+            stats_thread.join(timeout=_STATS_JOIN_S)
+        daemon.stop()
         print("verifyd stopped", flush=True)
     return 0
 
