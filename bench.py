@@ -1063,6 +1063,42 @@ def _stage_adversary():
     print(json.dumps(out), flush=True)
 
 
+def _stage_ha():
+    """HA verify-fleet numbers (crypto/faults.py run_chaos_ha): three
+    replicated verifyd daemons under committee load through a rolling
+    drain-restart, a hard kill, a socket blackhole, and a wrong-key
+    client. The leaves that ride the regression sentinel: the failover
+    verdict gap p99 (``ha_failover_gap_ms``, lower is better), the
+    zero-CPU proof for the rolling restart
+    (``ha_rolling_cpu_fallbacks``), the zero-wrong-verdict gate, and the
+    fleet-vs-single aggregate throughput. ``ha_fleet_gain`` is recorded
+    informationally — a single daemon's cross-client coalescing can
+    legitimately beat a 3-way fleet split on a small box."""
+    _maybe_force_cpu()
+    _set_cache()
+    from cometbft_tpu.crypto.faults import run_chaos_ha
+
+    s = run_chaos_ha(seed=int(os.environ.get("CBFT_BENCH_SEED", "17")))
+    out = {
+        "ha_replicas": s["replicas"],
+        "ha_wrong_verdicts": s["wrong_verdicts"],
+        "ha_failover_gap_ms": s["failover_gap_p99_ms"],
+        "ha_rolling_failovers": s["rolling_failovers"],
+        "ha_rolling_cpu_fallbacks": s["rolling_cpu_fallbacks"],
+        "ha_rolling_readmits": s["rolling_readmits"],
+        "ha_kill_failovers": s["kill_failovers"],
+        "ha_blackhole_quarantined": s["blackhole_quarantined"],
+        "ha_quarantine_picks_leaked": s["quarantine_picks_leaked"],
+        "ha_probe_readmitted": s["probe_readmitted"],
+        "ha_evil_unauthorized": s["evil_unauthorized"],
+        "ha_evil_requests_served": s["evil_requests_served"],
+        "ha_fleet_sigs_per_sec": s["fleet_sigs_per_sec"],
+        "ha_single_sigs_per_sec": s["single_sigs_per_sec"],
+        "ha_fleet_gain": s["fleet_gain"],
+    }
+    print(json.dumps(out), flush=True)
+
+
 def _stage_decisions():
     """Decision-plane accuracy numbers (crypto/decisions.py): a warm
     verify workload through a scheduler with the routing ledger
@@ -1831,6 +1867,14 @@ def main():
     if parsed is not None:
         _append_history(parsed, stage="adversary")
 
+    # HA verify fleet: failover gap p99 + rolling zero-CPU proof +
+    # fleet-vs-single aggregate throughput across three replicated
+    # daemons (platform-neutral, CPU-inner floor backend)
+    parsed, diag = _run_stage("ha", _STAGE_ENV_CPU, 600)
+    stages["ha"] = parsed if parsed is not None else diag
+    if parsed is not None:
+        _append_history(parsed, stage="ha")
+
     last_onchip = None
     if result is None:
         # TPU unavailable — same kernel on the host CPU platform so the
@@ -1900,6 +1944,7 @@ if __name__ == "__main__":
             "degraded": _stage_degraded,
             "overload": _stage_overload,
             "adversary": _stage_adversary,
+            "ha": _stage_ha,
             "sharded": _stage_sharded,
             "decisions": _stage_decisions,
             "routing": _stage_routing,

@@ -384,13 +384,29 @@ class CryptoConfig:
     # preverify, blocksync, light, and mempool verification at a remote
     # VerifyService (cross-client megabatch coalescing over one device
     # pool) instead of the in-process scheduler, with local-CPU fallback
-    # on disconnect/timeout. "" (default) = in-process.
+    # on disconnect/timeout. A COMMA list of addresses turns the client
+    # into the HA replica-set verifier (crypto/ha.py): per-endpoint
+    # breakers + health probes, failover to a healthy secondary above
+    # the local-CPU rung. "" (default) = in-process.
     # CBFT_VERIFY_SERVICE env wins.
     verify_service: str = ""
     # Per-request deadline before the remote verifier gives up on the
     # daemon and falls back to local CPU.
     # CBFT_VERIFY_SERVICE_TIMEOUT_MS env wins.
     verify_service_timeout_ms: int = 2000
+    # Per-node key file for the verify service's HMAC session auth:
+    # when set, the client answers the daemon's HELLO challenge with
+    # HMAC(key, challenge ‖ node_id) and the authenticated node id
+    # becomes the tenant identity (quotas/RED survive reconnects and
+    # NAT). "" = no auth (v1 interop). CBFT_VERIFY_AUTH_KEY env wins.
+    verify_auth_key: str = ""
+    # Reconnect backoff ceiling for the verify-service client: retries
+    # back off exponentially with jitter from 1s up to this cap, so a
+    # dead daemon is not hammered by every node in lockstep.
+    verify_retry_cap_ms: int = 30_000
+    # HA fleet probe cadence base: a breaker-quarantined or draining
+    # endpoint is probed with capped exponential backoff starting here.
+    verify_probe_ms: int = 250
 
 
 @dataclass
@@ -472,16 +488,24 @@ class Config:
             )
         vs = self.crypto.verify_service
         if vs:
-            # parse_address raises ValueError in the crypto.<knob> style
+            # parse_address_list raises ValueError in the crypto.<knob>
+            # style for each element (a comma list selects the HA
+            # replica-set client)
             from cometbft_tpu.crypto import service as servicelib
 
-            servicelib.parse_address(vs)
+            servicelib.parse_address_list(vs)
         vst = self.crypto.verify_service_timeout_ms
         if not isinstance(vst, int) or isinstance(vst, bool) or vst < 1:
             raise ValueError(
                 "crypto.verify_service_timeout_ms must be a positive "
                 f"integer, got {vst!r}"
             )
+        for knob in ("verify_retry_cap_ms", "verify_probe_ms"):
+            v = getattr(self.crypto, knob)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                raise ValueError(
+                    f"crypto.{knob} must be a positive integer, got {v!r}"
+                )
         rt = self.crypto.router
         if rt not in ("priced", "threshold"):
             raise ValueError(

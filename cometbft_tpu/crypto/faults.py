@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import os
 import random
+import socket
 import threading
 import time
 from typing import List, Optional, Tuple
@@ -2331,3 +2332,494 @@ def run_chaos_adversary(**kwargs) -> dict:
     from cometbft_tpu.crypto import adversary
 
     return adversary.run_chaos_adversary(**kwargs)
+
+
+def run_chaos_ha(
+    seed: int = 17,
+    logger=None,
+    replicas: int = 3,
+    load_threads: int = 3,
+) -> dict:
+    """The HA verify-fleet rung: ``replicas`` verifyd daemons (each its
+    own scheduler + serialized "accelerator" floor + authenticated
+    VerifyService on a Unix socket) behind ONE HAVerifier, driven
+    through the full replica-set failure matrix under committee load:
+
+    1. **Rolling drain-restart** — every replica in turn is silently
+       drained (``drain(broadcast=False)``: the NEXT request eats a
+       typed ST_DRAINING, deterministically exercising the per-request
+       failover path), then broadcast-drained, fully stopped once its
+       in-flight work answers, restarted, and probe re-admitted before
+       the next replica goes. Invariant: zero wrong verdicts and ZERO
+       local-CPU fallbacks — the failover rung absorbs every drained
+       connection, and the drain is attributed ``draining``, not
+       ``disconnected``.
+    2. **Hard kill** — one replica dies abruptly with clients attached;
+       in-flight and subsequent requests fail over within a bounded gap
+       (disconnect-shaped, so well under the request timeout — never a
+       timeout wait), attributed ``disconnected`` on the killed
+       endpoint's client.
+    3. **Blackhole partition** — one replica is replaced by a listener
+       that accepts frames and never answers. The client eats request
+       timeouts until the endpoint's breaker opens (quarantine: no
+       further picks), then the real daemon returns and the endpoint is
+       re-admitted by its OWN health probe — never by live traffic.
+    4. **Auth refusal** — a wrong-key HAVerifier is refused typed
+       ERR_UNAUTHORIZED on every endpoint: bounded attempts, verdicts
+       still ground truth via the CPU rung, and the bad tenant never
+       reaches any daemon's scheduler.
+    5. **Aggregate throughput** — the same committee load through the
+       3-replica fleet vs ONE plain client on one daemon, recorded as
+       sigs/sec (the bench `ha` stage's comparison).
+
+    Returns a summary dict; the tier-1 fast test and
+    ``tools/chaos.py --ha`` assert on it.
+    """
+    from cometbft_tpu.crypto import ed25519 as ed
+    from cometbft_tpu.crypto import ha as halib
+    from cometbft_tpu.crypto import service as servicelib
+    from cometbft_tpu.crypto.scheduler import VerifyScheduler
+    from cometbft_tpu.crypto.telemetry import TelemetryHub
+
+    N_SIGS = 8
+    BAD_LANE = 2
+    AUTH_KEY = b"chaos-ha-%d" % seed
+    TIMEOUT_MS = 1500
+    GAP_BOUND_MS = TIMEOUT_MS / 2.0
+    PROBE_BASE_S = 0.05
+    PROBE_CAP_S = 0.5
+
+    rng = random.Random(seed)
+    keys = [
+        ed.gen_priv_key_from_secret(b"chaos-ha-%d" % i) for i in range(8)
+    ]
+    items = []
+    for i in range(N_SIGS):
+        k = keys[i % len(keys)]
+        msg = b"ha committee %d" % i
+        sig = k.sign(msg)
+        if i == BAD_LANE:
+            sig = bytes(sig[:-1]) + bytes([sig[-1] ^ 0x01])
+        items.append((k.pub_key(), msg, sig))
+    expected_mask = [i != BAD_LANE for i in range(N_SIGS)]
+
+    base = "/tmp/cbft-chaos-ha-%d-%d" % (seed, os.getpid())
+
+    class _FleetDaemon:
+        """One replica: scheduler + service with its OWN serialized
+        pool floor (each daemon is its own accelerator) and its own
+        hub, like a real verifyd process."""
+
+        def __init__(self, idx: int):
+            self.idx = idx
+            self.address = "unix://%s-%d.sock" % (base, idx)
+            self.hub = TelemetryHub()
+            drng = random.Random(seed * 1000 + idx)
+            mtx = threading.Lock()
+            inner = servicelib.host_row_verifier()
+
+            def floor(rows, _mtx=mtx, _rng=drng, _inner=inner):
+                with _mtx:
+                    time.sleep(0.004 + 0.008 * _rng.random())
+                    return _inner(rows)
+
+            self.sched = VerifyScheduler(
+                spec="cpu", flush_us=200, qos="off",
+                row_verifier=floor, logger=logger,
+            )
+            self.service = servicelib.VerifyService(
+                self.sched, self.address, telemetry=self.hub,
+                auth_key=AUTH_KEY, logger=logger,
+            )
+            self.running = False
+
+        def start(self):
+            self.sched.start()
+            self.service.start()
+            self.running = True
+
+        def stop(self):
+            if not self.running:
+                return
+            self.running = False
+            self.service.stop()
+            self.sched.stop()
+
+        def restart(self):
+            # a restarted replica is a NEW process: fresh scheduler +
+            # service on the same address (stop() already unlinked it)
+            self.__init__(self.idx)
+            self.start()
+
+    daemons = [_FleetDaemon(i) for i in range(replicas)]
+    for d in daemons:
+        d.start()
+    addresses = [d.address for d in daemons]
+
+    client_hub = TelemetryHub()
+    hv = halib.HAVerifier(
+        addresses, tenant="committee", timeout_ms=TIMEOUT_MS,
+        connect_timeout_s=0.5, retry_s=0.05, retry_cap_s=2.0,
+        auth_key=AUTH_KEY, node_id="committee",
+        probe_base_s=PROBE_BASE_S, probe_cap_s=PROBE_CAP_S,
+        seed=seed, telemetry=client_hub, logger=logger,
+    )
+    rv_by_addr = dict(hv.endpoints())
+
+    # background committee load: every future tagged with the phase it
+    # was submitted in, resolved and classified at the end
+    phase = {"name": "baseline"}
+    load_records: List[tuple] = []
+    load_mtx = threading.Lock()
+    stop_load = threading.Event()
+
+    def loader():
+        while not stop_load.is_set():
+            tag = phase["name"]
+            fut = hv.submit(items, subsystem="consensus")
+            with load_mtx:
+                load_records.append((tag, fut))
+            time.sleep(0.01)
+
+    def _submit_ok(timeout=20.0):
+        fut = hv.submit(items, subsystem="consensus")
+        ok, mask = fut.result(timeout=timeout)
+        return fut, ok, mask
+
+    wrong = {"baseline": 0, "rolling": 0, "kill": 0, "blackhole": 0,
+             "auth": 0, "throughput": 0, "load": 0}
+    cpu_fallbacks_by_phase = {k: 0 for k in wrong}
+    failover_reasons: dict = {}
+    rolling_failovers = 0
+    blackhole_quarantined = False
+    quarantine_picks_leaked = 0
+
+    load_pool = [
+        threading.Thread(target=loader, daemon=True)
+        for _ in range(load_threads)
+    ]
+    try:
+        for t in load_pool:
+            t.start()
+
+        # -- baseline: all replicas healthy -----------------------------
+        for _ in range(20):
+            fut, ok, mask = _submit_ok()
+            if mask != expected_mask:
+                wrong["baseline"] += 1
+            if getattr(fut, "reason", None) not in (None, "failover"):
+                cpu_fallbacks_by_phase["baseline"] += 1
+
+        # -- phase 1: rolling drain-restart -----------------------------
+        phase["name"] = "rolling"
+        rolling_readmits = 0
+        for d in daemons:
+            ep_rv = rv_by_addr[d.address]
+            # silent drain: no FT_DRAINING broadcast, so the NEXT frame
+            # the client sends here is answered typed ST_DRAINING and
+            # must fail over — the deterministic per-request path
+            d.service.drain(broadcast=False)
+            # the draining failover may land on this thread OR on a
+            # background loader — either way it shows in the fleet-wide
+            # counter, which is what the invariant is about
+            fo_before = hv.stats().get("failovers", 0)
+            for _ in range(80):
+                fut, ok, mask = _submit_ok()
+                r = getattr(fut, "reason", None)
+                if mask != expected_mask:
+                    wrong["rolling"] += 1
+                if r is not None and r != "failover":
+                    cpu_fallbacks_by_phase["rolling"] += 1
+                if hv.stats().get("failovers", 0) > fo_before \
+                        and ep_rv.server_draining:
+                    break
+            rolling_failovers += \
+                hv.stats().get("failovers", 0) - fo_before
+            # broadcast so every attached client routes around, answer
+            # the in-flight tail, then the replica goes down for real
+            d.service.drain()
+            deadline = time.monotonic() + 10.0
+            while d.service.pending_requests() > 0 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+            d.stop()
+            d.restart()
+            # the endpoint re-enters rotation ONLY via its health probe
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline:
+                if not ep_rv.server_draining \
+                        and hv.endpoint_state(d.address) == halib.HEALTHY:
+                    rolling_readmits += 1
+                    break
+                time.sleep(0.02)
+
+        # -- phase 2: hard kill -----------------------------------------
+        phase["name"] = "kill"
+        victim = daemons[rng.randrange(replicas)]
+        victim_rv = rv_by_addr[victim.address]
+        # make sure the victim has live traffic to sever
+        for _ in range(10):
+            fut, ok, mask = _submit_ok()
+            if mask != expected_mask:
+                wrong["kill"] += 1
+        failovers_before_kill = hv.stats().get("failovers", 0)
+        victim.stop()
+        for _ in range(40):
+            fut, ok, mask = _submit_ok()
+            r = getattr(fut, "reason", None)
+            if mask != expected_mask:
+                wrong["kill"] += 1
+            if r is not None and r != "failover":
+                cpu_fallbacks_by_phase["kill"] += 1
+        # the failover gap (submit -> verdict for requests that lost an
+        # endpoint mid-flight) comes from the fleet's own samples — the
+        # background load absorbs most of the kill, not this thread.
+        # Snapshot BEFORE the blackhole phase, whose probe-quarantine
+        # waits would otherwise pollute the p99.
+        kill_failovers = hv.stats().get("failovers", 0) \
+            - failovers_before_kill
+        gap_p99 = hv.gap_p99_ms() or 0.0
+        kill_attributed = victim_rv.stats().get("disconnected", 0)
+        victim.restart()
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if hv.endpoint_state(victim.address) == halib.HEALTHY \
+                    and not victim_rv.server_draining:
+                break
+            time.sleep(0.02)
+
+        # -- phase 3: blackhole partition -------------------------------
+        phase["name"] = "blackhole"
+        hole = daemons[(daemons.index(victim) + 1) % replicas]
+        hole_rv = rv_by_addr[hole.address]
+        hole.stop()
+        hole_path = hole.address[len("unix://"):]
+        black_sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        black_sock.bind(hole_path)
+        black_sock.listen(16)
+        black_conns: List[socket.socket] = []
+        stop_hole = threading.Event()
+
+        def _blackhole():
+            # accept, read, never answer: the partitioned-replica model
+            while not stop_hole.is_set():
+                try:
+                    c, _ = black_sock.accept()
+                except OSError:
+                    return
+                black_conns.append(c)
+        hole_t = threading.Thread(target=_blackhole, daemon=True)
+        hole_t.start()
+
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            fut, ok, mask = _submit_ok(timeout=30.0)
+            r = getattr(fut, "reason", None)
+            if mask != expected_mask:
+                wrong["blackhole"] += 1
+            if r is not None and r != "failover":
+                cpu_fallbacks_by_phase["blackhole"] += 1
+            if hv.endpoint_state(hole.address) == halib.BROKEN:
+                blackhole_quarantined = True
+                break
+        # with auth on, a blackholed endpoint is a no-HELLO connect —
+        # "disconnected"-shaped, never a request-timeout wait; the
+        # probe's own failures escalate it to BROKEN even when healthy
+        # peers keep it out of the live pick rotation
+        hole_strikes = hole_rv.stats().get("disconnected", 0) \
+            + hole_rv.stats().get("timeout", 0)
+        # quarantine: a BROKEN endpoint gets zero picks from live traffic
+        picks_before = [
+            e for e in hv.snapshot()["endpoints"]
+            if e["address"] == hole.address
+        ][0]["picks"]
+        for _ in range(15):
+            fut, ok, mask = _submit_ok()
+            if mask != expected_mask:
+                wrong["blackhole"] += 1
+        picks_after = [
+            e for e in hv.snapshot()["endpoints"]
+            if e["address"] == hole.address
+        ][0]["picks"]
+        quarantine_picks_leaked = picks_after - picks_before
+        # heal the partition: real daemon back on the same address; the
+        # breaker must be re-opened by the PROBE, not by traffic
+        stop_hole.set()
+        try:
+            black_sock.close()
+        except OSError:
+            pass
+        for c in black_conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        hole_t.join(timeout=5.0)
+        hole.restart()
+        probe_readmitted = False
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if hv.endpoint_state(hole.address) == halib.HEALTHY:
+                probe_readmitted = True
+                break
+            time.sleep(0.02)
+        readmissions = hv.stats().get("probe_readmissions", 0)
+
+        # -- phase 4: wrong-key client ----------------------------------
+        phase["name"] = "auth"
+        evil = halib.HAVerifier(
+            addresses, tenant="evil", timeout_ms=TIMEOUT_MS,
+            connect_timeout_s=0.5, retry_s=0.05, retry_cap_s=2.0,
+            auth_key=b"not-the-key", node_id="evil",
+            probe_base_s=PROBE_BASE_S, probe_cap_s=PROBE_CAP_S,
+            seed=seed + 1, logger=logger,
+        )
+        evil_unauthorized = 0
+        try:
+            for _ in range(6):
+                fut = evil.submit(items, subsystem="consensus")
+                ok, mask = fut.result(timeout=20.0)
+                if mask != expected_mask:
+                    wrong["auth"] += 1
+                if getattr(fut, "reason", None) == "unauthorized":
+                    evil_unauthorized += 1
+            evil_attempts = sum(
+                rv.stats().get("connect_attempts", 0)
+                for _, rv in evil.endpoints()
+            )
+        finally:
+            evil.close()
+        server_auth_rejects = sum(
+            d.service.snapshot().get("auth_rejects", 0) for d in daemons
+        )
+        evil_served = sum(
+            (d.service.snapshot().get("tenants_panel", {})
+             .get("evil", {}) or {}).get("requests", 0)
+            for d in daemons
+        )
+
+        # -- phase 5: aggregate throughput vs single daemon -------------
+        phase["name"] = "throughput"
+        stop_load.set()
+        for t in load_pool:
+            t.join(timeout=30.0)
+
+        def _pump(backend, rounds):
+            errs = 0
+            done = [0]
+
+            def w():
+                for _ in range(rounds):
+                    f = backend.submit(items, subsystem="consensus")
+                    ok, mask = f.result(timeout=30.0)
+                    if mask != expected_mask:
+                        errs_l[0] += 1
+                    done[0] += 1
+            errs_l = [0]
+            ths = [threading.Thread(target=w, daemon=True)
+                   for _ in range(4)]
+            t0 = time.monotonic()
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(timeout=120.0)
+            dt = max(time.monotonic() - t0, 1e-6)
+            return done[0] * N_SIGS / dt, errs_l[0]
+
+        fleet_sigs, errs = _pump(hv, 20)
+        wrong["throughput"] += errs
+        single = servicelib.RemoteVerifier(
+            daemons[0].address, tenant="single", timeout_ms=TIMEOUT_MS,
+            retry_s=0.05, auth_key=AUTH_KEY, node_id="single",
+            logger=logger,
+        )
+        try:
+            single_sigs, errs = _pump(single, 20)
+            wrong["throughput"] += errs
+        finally:
+            single.close()
+
+        # -- resolve the background load --------------------------------
+        with load_mtx:
+            records = list(load_records)
+        load_by_phase: dict = {}
+        for tag, fut in records:
+            ok, mask = fut.result(timeout=30.0)
+            r = getattr(fut, "reason", None)
+            rec = load_by_phase.setdefault(
+                tag, {"n": 0, "failover": 0, "cpu": 0}
+            )
+            rec["n"] += 1
+            if mask != expected_mask:
+                wrong["load"] += 1
+            if r == "failover":
+                rec["failover"] += 1
+            elif r is not None:
+                rec["cpu"] += 1
+                cpu_fallbacks_by_phase[tag] = \
+                    cpu_fallbacks_by_phase.get(tag, 0) + 1
+        for _, rv in hv.endpoints():
+            for reason, n in rv.stats().items():
+                if reason in servicelib.FAILOVER_REASONS:
+                    failover_reasons[reason] = \
+                        failover_reasons.get(reason, 0) + n
+        hv_stats = hv.stats()
+    finally:
+        stop_load.set()
+        hv.close()
+        for d in daemons:
+            d.stop()
+        for i in range(replicas):
+            try:
+                os.unlink("%s-%d.sock" % (base, i))
+            except OSError:
+                pass
+
+    summary = {
+        "seed": seed,
+        "replicas": replicas,
+        "wrong_verdicts": sum(wrong.values()),
+        "wrong_by_phase": wrong,
+        "rolling_failovers": rolling_failovers,
+        "rolling_readmits": rolling_readmits,
+        "rolling_cpu_fallbacks": cpu_fallbacks_by_phase["rolling"],
+        "cpu_fallbacks_by_phase": cpu_fallbacks_by_phase,
+        "kill_failovers": kill_failovers,
+        "kill_attributed_disconnects": kill_attributed,
+        "failover_gap_p99_ms": round(gap_p99, 2),
+        "failover_gap_bound_ms": GAP_BOUND_MS,
+        "blackhole_quarantined": blackhole_quarantined,
+        "blackhole_strikes": hole_strikes,
+        "quarantine_picks_leaked": quarantine_picks_leaked,
+        "probe_readmitted": probe_readmitted,
+        "probe_readmissions": readmissions,
+        "failover_reasons": failover_reasons,
+        "evil_unauthorized": evil_unauthorized,
+        "evil_connect_attempts": evil_attempts,
+        "server_auth_rejects": server_auth_rejects,
+        "evil_requests_served": evil_served,
+        "load_by_phase": load_by_phase,
+        "fleet_sigs_per_sec": round(fleet_sigs, 1),
+        "single_sigs_per_sec": round(single_sigs, 1),
+        "fleet_gain": round(fleet_sigs / max(single_sigs, 1e-6), 2),
+        "ha_stats": hv_stats,
+        "expected": {
+            "wrong_verdicts": 0,
+            "rolling_failovers": ">= %d" % replicas,
+            "rolling_cpu_fallbacks": 0,
+            "rolling_readmits": replicas,
+            "kill_failovers": ">= 1",
+            "kill_attributed_disconnects": ">= 1",
+            "failover_gap_p99_ms": "<= %.0f" % GAP_BOUND_MS,
+            "blackhole_quarantined": True,
+            "quarantine_picks_leaked": 0,
+            "probe_readmitted": True,
+            "probe_readmissions": ">= 1",
+            "failover_reasons": "draining >= %d, disconnected >= 1"
+                                % replicas,
+            "evil_unauthorized": ">= 1",
+            "server_auth_rejects": ">= 1",
+            "evil_requests_served": 0,
+        },
+    }
+    return summary

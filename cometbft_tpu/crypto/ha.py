@@ -1,0 +1,561 @@
+"""HA verify fleet — N verifyd endpoints as one replica set.
+
+``HAVerifier`` duck-types the crypto Backend contract exactly like
+``RemoteVerifier`` (``spec`` + ``submit(items, subsystem=, height=) ->
+VerifyFuture``), so a node pointing ``[crypto] verify_service`` at a
+comma list of daemons gets fleet semantics with zero call-site changes.
+One inner RemoteVerifier per endpoint; on top of them:
+
+* **Failover rung above local CPU.** Each inner client is constructed
+  with the ``failover`` hook, so a transport-shaped failure
+  (disconnect / timeout / typed ST_DRAINING refusal) hands the
+  in-flight items straight back here and they are resubmitted to a
+  healthy secondary — verify is idempotent and req_ids are
+  per-connection, so the resubmit is safe. Only an all-endpoints-down
+  state reaches the local-CPU ground truth, and the caller's future
+  reads ``reason="failover"`` when a secondary absorbed the failure —
+  metered distinctly from ``disconnected``.
+
+* **Per-endpoint circuit breakers** with the supervisor's domain-breaker
+  shape: HEALTHY → DEGRADED (strikes under threshold) → BROKEN
+  (quarantined — no picks). A BROKEN endpoint is re-admitted only by
+  its OWN health probe, never by live traffic, so a blackholed replica
+  cannot keep eating requests while it times out.
+
+* **Health probes** with capped exponential backoff + jitter: a probe
+  connects, reads the server HELLO (which carries the draining flag),
+  and hangs up. Probe success on a non-draining endpoint resets the
+  breaker; a draining endpoint that restarted clean is put back in
+  rotation the same way.
+
+* **Weighted selection**: among HEALTHY endpoints the pick is weighted
+  by inverse observed latency EWMA (a slow replica still serves, it
+  just gets fewer picks); DEGRADED endpoints serve only when no
+  HEALTHY one exists; BROKEN and draining endpoints are skipped.
+
+The per-request flow lives in a small ctx dict threaded through the
+inner client (``failover_ctx``): the OUTER future the caller holds, the
+packed triples, the set of endpoints already tried this request, and
+the hop count. The failed inner future is never completed once the hook
+takes ownership — only the final inner future (remote success, or the
+inner client's CPU rung when the fleet is exhausted) completes, and its
+verdict/reason is copied onto the outer future.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from cometbft_tpu.crypto import service as servicelib
+from cometbft_tpu.crypto.batch import BackendSpec, CPUBatchVerifier
+from cometbft_tpu.crypto.scheduler import Item, VerifyFuture
+
+SUBSYSTEM = "verify_ha"
+
+# breaker states, same shape as the supervisor's domain breakers
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+BROKEN = "broken"
+
+DEFAULT_BREAKER_THRESHOLD = 3
+DEFAULT_PROBE_BASE_S = 0.25
+DEFAULT_PROBE_CAP_S = 5.0
+_PROBE_TICK_S = 0.02
+_EWMA_ALPHA = 0.2
+_GAP_SAMPLES = 512
+
+
+class _Endpoint:
+    __slots__ = ("address", "rv", "state", "strikes", "ewma_ms", "picks",
+                 "failures", "probe_fails", "next_probe", "readmissions",
+                 "last_error")
+
+    def __init__(self, address: str, rv):
+        self.address = address
+        self.rv = rv
+        self.state = HEALTHY
+        self.strikes = 0
+        self.ewma_ms: Optional[float] = None
+        self.picks = 0
+        self.failures = 0
+        self.probe_fails = 0
+        self.next_probe = 0.0
+        self.readmissions = 0
+        self.last_error: Optional[str] = None
+
+
+class HAVerifier:
+    """Replica-set client over N verifyd endpoints (see module doc)."""
+
+    def __init__(
+        self,
+        addresses: Sequence[str],
+        tenant: Optional[str] = None,
+        spec=None,
+        timeout_ms: Optional[int] = None,
+        connect_timeout_s: float = 1.0,
+        retry_s: float = 1.0,
+        retry_cap_s: float = 30.0,
+        auth_key: Optional[bytes] = None,
+        node_id: Optional[str] = None,
+        breaker_threshold: int = DEFAULT_BREAKER_THRESHOLD,
+        probe_base_s: float = DEFAULT_PROBE_BASE_S,
+        probe_cap_s: float = DEFAULT_PROBE_CAP_S,
+        seed: Optional[int] = None,
+        tracer=None,
+        telemetry=None,
+        logger=None,
+    ):
+        if not addresses:
+            raise ValueError("HAVerifier needs at least one endpoint")
+        if isinstance(spec, BackendSpec):
+            self.spec = spec
+        else:
+            self.spec = BackendSpec(name=spec) if spec else BackendSpec(
+                name="cpu"
+            )
+        self._tenant = tenant or "remote"
+        self._telemetry = telemetry
+        self.logger = logger
+        self._breaker_threshold = max(1, int(breaker_threshold))
+        self._probe_base_s = max(1e-3, float(probe_base_s))
+        self._probe_cap_s = max(self._probe_base_s, float(probe_cap_s))
+        self._connect_timeout_s = float(connect_timeout_s)
+        self._rng = random.Random(seed)
+        self._mtx = threading.Lock()
+        self._stats: Dict[str, int] = {}
+        self._gaps: "collections.deque[float]" = collections.deque(
+            maxlen=_GAP_SAMPLES
+        )
+        self._closed = False
+        self._endpoints: List[_Endpoint] = []
+        for addr in addresses:
+            rv = servicelib.RemoteVerifier(
+                addr,
+                tenant=self._tenant,
+                spec=self.spec,
+                timeout_ms=timeout_ms,
+                connect_timeout_s=connect_timeout_s,
+                retry_s=retry_s,
+                retry_cap_s=retry_cap_s,
+                auth_key=auth_key,
+                node_id=node_id,
+                tracer=tracer,
+                telemetry=telemetry,
+                logger=logger,
+            )
+            ep = _Endpoint(addr, rv)
+            # functools.partial-style binding without the import: the
+            # hook must know WHICH endpoint failed to strike its breaker
+            rv._failover = (
+                lambda items, reason, fut, ctx, _ep=ep:
+                self._on_transport_fail(_ep, items, reason, fut, ctx)
+            )
+            self._endpoints.append(ep)
+        if telemetry is not None:
+            telemetry.register_source("ha", self.snapshot)
+        self._probe_quit = threading.Event()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True, name="verify-ha-probe"
+        )
+        self._probe_thread.start()
+
+    # -- Backend contract --------------------------------------------------
+
+    def submit(
+        self,
+        items: Sequence[Item],
+        subsystem: Optional[str] = None,
+        height: Optional[int] = None,
+    ) -> VerifyFuture:
+        triples = [(pk, bytes(m), bytes(s)) for pk, m, s in items]
+        outer = VerifyFuture()
+        if not triples:
+            outer._set((True, []))
+            return outer
+        ctx: Dict[str, Any] = {
+            "outer": outer,
+            "items": triples,
+            "subsystem": subsystem,
+            "tried": set(),
+            "hops": 0,
+            "first_reason": None,
+            "t0": time.monotonic(),
+        }
+        self._try(ctx)
+        return outer
+
+    def register_valset(self, pub_keys: Sequence[bytes]) -> bytes:
+        """Best-effort on every endpoint (each daemon has its own
+        keystore); returns the id from the first that succeeds."""
+        valset_id: Optional[bytes] = None
+        last_exc: Optional[Exception] = None
+        for ep in self._endpoints:
+            try:
+                vid = ep.rv.register_valset(pub_keys)
+                if valset_id is None:
+                    valset_id = vid
+            except Exception as exc:  # noqa: BLE001 - optimization only
+                last_exc = exc
+        if valset_id is None:
+            raise last_exc if last_exc is not None else ConnectionError(
+                "no endpoint accepted the valset"
+            )
+        return valset_id
+
+    def close(self) -> None:
+        with self._mtx:
+            self._closed = True
+        self._probe_quit.set()
+        self._probe_thread.join(timeout=2.0)
+        for ep in self._endpoints:
+            ep.rv.close()
+
+    # -- request routing ---------------------------------------------------
+
+    def _try(self, ctx: Dict[str, Any]) -> None:
+        """Pick an endpoint and submit; the inner client's failover hook
+        re-enters here on transport failure until the fleet is
+        exhausted."""
+        ep = self._pick(ctx["tried"])
+        if ep is None:
+            self._local_fallback(ctx)
+            return
+        ctx["tried"].add(ep.address)
+        t0 = time.monotonic()
+        inner = ep.rv.submit(
+            ctx["items"], subsystem=ctx["subsystem"], failover_ctx=ctx
+        )
+        # When the hook took ownership mid-submit, `inner` never
+        # completes and this callback never fires — only the final
+        # inner future (success, or the CPU rung) reports out.
+        inner.add_done_callback(
+            lambda f, _ep=ep, _t0=t0: self._inner_done(ctx, _ep, _t0, f)
+        )
+
+    def _pick(self, exclude) -> Optional[_Endpoint]:
+        """Weighted endpoint selection: HEALTHY first (inverse-latency
+        weights), DEGRADED only when no HEALTHY exists; BROKEN and
+        draining endpoints never serve new work."""
+        with self._mtx:
+            candidates = [
+                ep for ep in self._endpoints
+                if ep.address not in exclude
+                and ep.state != BROKEN
+                and not ep.rv.server_draining
+            ]
+            healthy = [ep for ep in candidates if ep.state == HEALTHY]
+            pool = healthy or candidates
+            if not pool:
+                return None
+            weights = [
+                1.0 / (1.0 + (ep.ewma_ms if ep.ewma_ms is not None
+                              else 1.0))
+                for ep in pool
+            ]
+            ep = self._rng.choices(pool, weights=weights, k=1)[0]
+            ep.picks += 1
+            return ep
+
+    def _on_transport_fail(
+        self, ep: _Endpoint, items, reason: str, future, ctx
+    ) -> bool:
+        """The inner RemoteVerifier's failover hook. Returns True when
+        this layer takes ownership of completing the caller's future on
+        a secondary (or the shared CPU rung)."""
+        if reason == "draining":
+            # an intentional drain is not a fault: no strike, the
+            # endpoint just stops getting picks until its probe sees a
+            # clean restart
+            pass
+        else:
+            self._strike(ep, reason)
+        if ctx is None:
+            return False  # direct use of the inner client: its ladder
+        outer: VerifyFuture = ctx["outer"]
+        if outer.done():
+            return True  # a parallel path already completed the caller
+        if ctx["first_reason"] is None:
+            ctx["first_reason"] = reason
+        ctx["hops"] += 1
+        self._count("failover_attempts")
+        self._try(ctx)
+        return True
+
+    def _inner_done(
+        self, ctx: Dict[str, Any], ep: _Endpoint, t0: float, f: VerifyFuture
+    ) -> None:
+        outer: VerifyFuture = ctx["outer"]
+        if outer.done():
+            return
+        try:
+            result = f.result(timeout=0)
+        except Exception:  # noqa: BLE001 - inner futures never raise
+            return
+        reason = getattr(f, "reason", None)
+        if getattr(f, "rejected", False):
+            # an admission verdict (QoS shed), not a transport failure:
+            # propagate so the server's load-shedding decision survives
+            outer.rejected = True
+            outer.reason = reason or "rejected"
+            self._count("rejected")
+        elif reason is None:
+            self._credit(ep, (time.monotonic() - t0) * 1e3)
+            if ctx["hops"]:
+                outer.reason = "failover"
+                self._count("failovers")
+                self._note_gap(ctx)
+            else:
+                self._count("remote_ok")
+        else:
+            # a non-transport reason ("error" / "stale" /
+            # "unauthorized") never enters the failover hook: the inner
+            # client resolved on its own CPU rung — keep its reason
+            # distinct on the outer future
+            outer.reason = reason
+            self._count("cpu_fallback")
+            self._count(f"cpu_{reason}")
+        outer._set(result)
+
+    def _local_fallback(self, ctx: Dict[str, Any]) -> None:
+        """All endpoints down (or excluded): the last rung, local CPU
+        ground truth, with the FIRST transport reason on the future."""
+        outer: VerifyFuture = ctx["outer"]
+        if outer.done():
+            return
+        reason = ctx["first_reason"] or "disconnected"
+        self._count("all_down")
+        self._count("cpu_fallback")
+        self._count(f"cpu_{reason}")
+        if self._telemetry is not None:
+            self._telemetry.note_event("ha_all_down", {
+                "tenant": self._tenant, "reason": reason,
+                "tried": len(ctx["tried"]),
+            }, source="client")
+        self._note_gap(ctx)
+        bv = CPUBatchVerifier()
+        for pk, m, s in ctx["items"]:
+            bv.add(pk, m, s)
+        _, mask = bv.verify()
+        outer.reason = reason
+        outer._set((all(mask), mask))
+
+    def _note_gap(self, ctx: Dict[str, Any]) -> None:
+        """Failover gap sample: submit() to final verdict for requests
+        that lost at least one endpoint mid-flight — the bench stage's
+        ``ha_failover_gap_ms`` p99 comes from here."""
+        with self._mtx:
+            self._gaps.append(time.monotonic() - ctx["t0"])
+
+    # -- breaker -----------------------------------------------------------
+
+    def _strike(self, ep: _Endpoint, reason: str) -> None:
+        opened = False
+        with self._mtx:
+            ep.strikes += 1
+            ep.failures += 1
+            ep.last_error = reason
+            if ep.strikes >= self._breaker_threshold:
+                if ep.state != BROKEN:
+                    ep.state = BROKEN
+                    ep.probe_fails = 0
+                    ep.next_probe = time.monotonic() + self._rng.uniform(
+                        0.0, self._probe_base_s
+                    )
+                    opened = True
+            else:
+                ep.state = DEGRADED
+        if opened:
+            self._count("breaker_opens")
+            if self._telemetry is not None:
+                self._telemetry.note_event("ha_breaker_open", {
+                    "address": ep.address, "reason": reason,
+                    "strikes": ep.strikes,
+                }, source="client")
+
+    def _credit(self, ep: _Endpoint, latency_ms: float) -> None:
+        with self._mtx:
+            ep.strikes = 0
+            if ep.state != BROKEN:
+                # BROKEN exits only via the probe: one straggler verdict
+                # limping home must not re-admit a blackholed endpoint
+                ep.state = HEALTHY
+            ep.ewma_ms = (
+                latency_ms if ep.ewma_ms is None
+                else (1 - _EWMA_ALPHA) * ep.ewma_ms
+                + _EWMA_ALPHA * latency_ms
+            )
+
+    # -- health probes -----------------------------------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._probe_quit.wait(_PROBE_TICK_S):
+            now = time.monotonic()
+            with self._mtx:
+                # BROKEN and draining endpoints re-enter rotation ONLY
+                # via their probe; DEGRADED ones are probed too so a
+                # striked endpoint that never gets picked (healthy
+                # peers absorb all traffic) can still heal
+                due = [
+                    ep for ep in self._endpoints
+                    if (ep.state != HEALTHY or ep.rv.server_draining)
+                    and now >= ep.next_probe
+                ]
+            for ep in due:
+                self._probe(ep)
+
+    def _probe(self, ep: _Endpoint) -> None:
+        """One health probe: connect, read the server HELLO, hang up.
+        Success on a non-draining endpoint re-admits it; failure backs
+        off exponentially with jitter, capped."""
+        ok, draining = self._probe_once(ep.address)
+        now = time.monotonic()
+        if ok and not draining:
+            readmitted = False
+            with self._mtx:
+                ep.probe_fails = 0
+                ep.strikes = 0
+                if ep.state == BROKEN:
+                    ep.readmissions += 1
+                    readmitted = True
+                ep.state = HEALTHY
+            ep.rv.clear_draining()
+            if readmitted:
+                self._count("probe_readmissions")
+                if self._telemetry is not None:
+                    self._telemetry.note_event("ha_probe_readmit", {
+                        "address": ep.address,
+                    }, source="client")
+            return
+        if not ok:
+            # a failed probe IS a strike: a DEGRADED endpoint that live
+            # traffic never picks (healthy peers absorb it all) still
+            # escalates to BROKEN quarantine instead of lingering
+            self._strike(ep, "probe_failed")
+        with self._mtx:
+            ep.probe_fails += 1
+            window = min(
+                self._probe_cap_s,
+                self._probe_base_s * (2 ** min(ep.probe_fails - 1, 16)),
+            )
+            ep.next_probe = now + self._rng.uniform(window / 2, window)
+            self._count_locked("probes_failed" if not ok
+                               else "probes_draining")
+
+    def _probe_once(self, address: str) -> Tuple[bool, bool]:
+        """(reachable, draining) for one endpoint, via a throwaway
+        connection that only reads the HELLO frame."""
+        try:
+            family, target = servicelib.parse_address(address)
+            sock = socket.socket(
+                socket.AF_UNIX if family == "unix" else socket.AF_INET,
+                socket.SOCK_STREAM,
+            )
+            sock.settimeout(self._connect_timeout_s)
+            try:
+                sock.connect(target)
+                # tick=False aborts on the FIRST socket timeout: a
+                # blackholed endpoint (accepts, never answers) must read
+                # as probe failure, not hang the probe thread
+                head = servicelib._recv_exact(sock, 4, tick=lambda: False)
+                if head is None:
+                    return False, False
+                (length,) = servicelib._LEN.unpack(head)
+                if length < servicelib.HEADER_BYTES or length > 4096:
+                    return False, False
+                buf = servicelib._recv_exact(
+                    sock, length, tick=lambda: False
+                )
+                if buf is None:
+                    return False, False
+                frame = servicelib.decode_frame(buf)
+                if frame.ftype != servicelib.FT_HELLO:
+                    return False, False
+                flags = (
+                    frame.payload[1] if len(frame.payload) >= 2 else 0
+                )
+                draining = bool(
+                    flags & servicelib.HELLO_FLAG_DRAINING
+                )
+                return True, draining
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        except (OSError, servicelib.FrameError, ValueError):
+            return False, False
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _count(self, key: str) -> None:
+        with self._mtx:
+            self._stats[key] = self._stats.get(key, 0) + 1
+
+    def _count_locked(self, key: str) -> None:
+        """Caller holds self._mtx."""
+        self._stats[key] = self._stats.get(key, 0) + 1
+
+    # -- observability -----------------------------------------------------
+
+    def endpoints(self) -> List[Tuple[str, Any]]:
+        """Chaos/test hook: [(address, inner RemoteVerifier)]."""
+        return [(ep.address, ep.rv) for ep in self._endpoints]
+
+    def endpoint_state(self, address: str) -> Optional[str]:
+        with self._mtx:
+            for ep in self._endpoints:
+                if ep.address == address:
+                    return ep.state
+        return None
+
+    def stats(self) -> Dict[str, int]:
+        with self._mtx:
+            return dict(self._stats)
+
+    def gap_p99_ms(self) -> Optional[float]:
+        """p99 of the failover-gap samples (submit → verdict for
+        requests that lost an endpoint mid-flight)."""
+        with self._mtx:
+            samples = sorted(self._gaps)
+        if not samples:
+            return None
+        rank = max(0, int(0.99 * len(samples)) - 1) if len(samples) > 1 \
+            else 0
+        return round(samples[min(rank + 1, len(samples) - 1)] * 1e3, 3)
+
+    def snapshot(self) -> dict:
+        """The "ha" TelemetryHub source: fleet stats plus a
+        per-endpoint panel (breaker state, strikes, drain flag, latency
+        EWMA, pick share) — what verify_top's fleet mode renders."""
+        with self._mtx:
+            panel = [
+                {
+                    "address": ep.address,
+                    "state": ep.state,
+                    "draining": ep.rv.server_draining,
+                    "connected": ep.rv.connected,
+                    "strikes": ep.strikes,
+                    "failures": ep.failures,
+                    "picks": ep.picks,
+                    "readmissions": ep.readmissions,
+                    "ewma_ms": (
+                        None if ep.ewma_ms is None
+                        else round(ep.ewma_ms, 3)
+                    ),
+                    "last_error": ep.last_error,
+                }
+                for ep in self._endpoints
+            ]
+            stats = dict(self._stats)
+        return {
+            "tenant": self._tenant,
+            "endpoints": panel,
+            "stats": stats,
+            "failover_gap_p99_ms": self.gap_p99_ms(),
+        }

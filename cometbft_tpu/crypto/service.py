@@ -32,7 +32,7 @@ Frame protocol (length-prefixed binary, no external deps):
         magic      b"CBVS"
         version    1
         ftype      HELLO | CLIENT_HELLO | REQ | RESP | ERR |
-                   REGISTER | REGISTERED
+                   REGISTER | REGISTERED | AUTH | AUTH_OK | DRAINING
         qclass     QoS class code (qos.class_code; 0xFF = untagged)
         kind       0 = compact 128 B rows, 1 = indexed 100 B rows
         req_id     u64, client-assigned, echoed on RESP/ERR
@@ -48,6 +48,19 @@ Frame protocol (length-prefixed binary, no external deps):
         ERR           u16 LE code + utf8 message
         REGISTER      n × 32-byte pubkey rows
         CLIENT_HELLO  utf8 tenant name
+        AUTH          32-byte HMAC-SHA256(key, challenge ‖ node_id)
+                      + utf8 node id (client answer to the HELLO
+                      challenge when the server requires auth)
+        AUTH_OK       empty (session authenticated)
+        DRAINING      empty (server entered graceful drain; pick
+                      another endpoint for NEW work — in-flight
+                      requests are still answered)
+
+The HELLO payload is [proto_version u8, flags u8, 16-byte challenge?]:
+flags bit0 = the server is draining, bit1 = the server requires the
+HMAC challenge-response (the challenge bytes follow). v1 servers send
+an empty payload and v1 clients ignore HELLO payload bytes entirely, so
+both extensions ride the existing version negotiation unchanged.
 
 Tenant identity is the connection (CLIENT_HELLO), the QoS class rides
 in the frame header, and ``qos.resolve_class`` / ``TenantQuotas`` /
@@ -57,16 +70,20 @@ holds the original triples and its own CPU, so IT pays the fallback
 verify, never the shared device plane's host.
 
 Fallback ladder, client side: indexed frame → (stale generation,
-unknown valset) re-register + compact frame → (rejected, timeout,
-disconnect, any error) local CPU ground truth, with the verdict reason
-kept distinct (``future.reason``) and counted per cause.
+unknown valset) re-register + compact frame → (disconnect, timeout,
+draining) FAILOVER to a healthy secondary when an HA hook is installed
+(crypto/ha.py) → (rejected, any error, all endpoints down) local CPU
+ground truth, with the verdict reason kept distinct (``future.reason``)
+and counted per cause.
 """
 
 from __future__ import annotations
 
 import collections
 import hashlib
+import hmac
 import os
+import random
 import socket
 import struct
 import sys
@@ -102,6 +119,9 @@ FT_RESP = 3
 FT_ERR = 4
 FT_REGISTER = 5
 FT_REGISTERED = 6
+FT_AUTH = 7
+FT_AUTH_OK = 8
+FT_DRAINING = 9
 _FT_NAMES = {
     FT_HELLO: "hello",
     FT_CLIENT_HELLO: "client_hello",
@@ -110,6 +130,9 @@ _FT_NAMES = {
     FT_ERR: "err",
     FT_REGISTER: "register",
     FT_REGISTERED: "registered",
+    FT_AUTH: "auth",
+    FT_AUTH_OK: "auth_ok",
+    FT_DRAINING: "draining",
 }
 
 KIND_COMPACT = 0
@@ -144,6 +167,7 @@ ERR_UNKNOWN_VALSET = 4
 ERR_BAD_CLASS = 5
 ERR_BAD_VERSION = 6
 ERR_INTERNAL = 7
+ERR_UNAUTHORIZED = 8
 ERR_NAMES = {
     ERR_MALFORMED: "malformed",
     ERR_OVERSIZE: "oversize",
@@ -152,11 +176,34 @@ ERR_NAMES = {
     ERR_BAD_CLASS: "bad_class",
     ERR_BAD_VERSION: "bad_version",
     ERR_INTERNAL: "internal",
+    ERR_UNAUTHORIZED: "unauthorized",
 }
 
-# RESP status byte
+# RESP status byte. ST_DRAINING is the graceful-drain refusal: the
+# request was NOT admitted (the server stopped accepting new work) and
+# the client should fail over to another endpoint immediately instead
+# of burning its timeout — unlike ST_REJECTED it is a transport-shaped
+# signal, not an admission verdict, so the HA rung may retry it.
 ST_OK = 0
 ST_REJECTED = 1
+ST_DRAINING = 2
+
+# HELLO payload flags (second byte; absent = 0 for older servers)
+HELLO_FLAG_DRAINING = 0x01
+HELLO_FLAG_AUTH = 0x02
+
+# authenticated sessions: HMAC-SHA256 challenge-response riding HELLO
+AUTH_CHALLENGE_BYTES = 16
+AUTH_MAC_BYTES = 32
+# a wrong-key client gets this many typed refusals before the server
+# hangs up the connection (its reconnects are then backoff-bounded)
+MAX_AUTH_ATTEMPTS = 3
+
+# transport-shaped failure reasons the HA failover rung may resubmit to
+# a secondary (verify is idempotent). "rejected" (admission verdict),
+# "error", and "unauthorized" (the whole fleet shares the key) are NOT
+# failover-eligible.
+FAILOVER_REASONS = ("disconnected", "timeout", "draining")
 
 DEFAULT_ADDRESS = "unix:///tmp/cbft-verifyd.sock"
 DEFAULT_TIMEOUT_MS = 2_000
@@ -175,6 +222,38 @@ def verify_service_default(config_value: Optional[str] = None) -> str:
     if config_value:
         return str(config_value).strip()
     return ""
+
+
+def verify_auth_key_default(config_value: Optional[str] = None) -> str:
+    """Path of the shared HMAC key file: CBFT_VERIFY_AUTH_KEY env >
+    [crypto] verify_auth_key > "" (unauthenticated, the v1 default)."""
+    raw = os.environ.get("CBFT_VERIFY_AUTH_KEY")
+    if raw is not None:
+        return raw.strip()
+    if config_value:
+        return str(config_value).strip()
+    return ""
+
+
+def load_auth_key(path: str) -> bytes:
+    """Read the shared HMAC key from a per-node key file (surrounding
+    whitespace stripped so `openssl rand -hex 32 > key` round-trips)."""
+    with open(path, "rb") as fh:
+        key = fh.read().strip()
+    if not key:
+        raise ValueError(f"auth key file {path!r} is empty")
+    return key
+
+
+def auth_mac(key: bytes, challenge: bytes, node_id: str) -> bytes:
+    """The AUTH frame's proof: HMAC-SHA256(key, challenge ‖ node_id).
+    Binding the node id into the MAC makes the authenticated identity
+    unforgeable — the server adopts it as the tenant, so quotas/RED
+    follow the key holder across reconnects and NAT."""
+    return hmac.new(
+        bytes(key), bytes(challenge) + node_id.encode("utf-8"),
+        hashlib.sha256,
+    ).digest()
 
 
 def service_timeout_default(config_timeout_ms: Optional[int] = None) -> int:
@@ -218,6 +297,22 @@ def parse_address(addr: str) -> Tuple[str, Any]:
     )
 
 
+def parse_address_list(addr: str) -> List[str]:
+    """``verify_service`` accepts a comma-separated endpoint list (the
+    HA replica set). Each element validates via parse_address; a single
+    address yields a one-element list."""
+    out: List[str] = []
+    for part in str(addr).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        parse_address(part)
+        out.append(part)
+    if not out:
+        raise ValueError("verify_service endpoint list is empty")
+    return out
+
+
 def max_frame_bytes(max_lanes: int) -> int:
     """Frame-length bound derived from the lane budget (itself
     max_chunk-derived): the largest legal frame is a full compact REQ or
@@ -235,6 +330,18 @@ class FrameError(Exception):
     def __init__(self, code: int, msg: str):
         super().__init__(msg)
         self.code = code
+
+
+class _FatalFrameError(FrameError):
+    """A typed refusal after which the server hangs up the connection
+    (repeated auth failures): the error frame still goes out first, but
+    the read loop breaks instead of serving more frames."""
+
+
+class AuthError(ConnectionError):
+    """The server required authentication and refused ours (wrong key /
+    refused node id). NOT failover-eligible — the whole fleet shares the
+    key, so a secondary would refuse the same credentials."""
 
 
 class Frame:
@@ -741,12 +848,16 @@ class ServiceMetrics:
 
 class _Conn:
     __slots__ = ("sock", "tenant", "alive", "pending", "outq", "cv",
-                 "reader", "writer", "mtx")
+                 "reader", "writer", "mtx", "authenticated", "challenge",
+                 "auth_fails")
 
     def __init__(self, sock):
         self.sock = sock
         self.tenant: Optional[str] = None
         self.alive = True
+        self.authenticated = False
+        self.challenge: Optional[bytes] = None
+        self.auth_fails = 0
         # req_id -> (n_lanes, t0), for the leak check on disconnect/stop
         # and the per-tenant service latency (t0 = accept time)
         self.pending: Dict[int, Tuple[int, float]] = {}
@@ -781,12 +892,19 @@ class VerifyService(BaseService):
         metrics: Optional[ServiceMetrics] = None,
         telemetry=None,
         advertise_trace: bool = True,
+        auth_key: Optional[bytes] = None,
         logger: Optional[Logger] = None,
     ):
         super().__init__("VerifyService", logger)
         self._sched = scheduler
         self._family, self._target = parse_address(address)
         self._coalesce = bool(coalesce)
+        self._auth_key = bytes(auth_key) if auth_key else None
+        if self._auth_key is not None and not advertise_trace:
+            # the challenge rides the HELLO payload; a server simulating
+            # the v1 empty-payload HELLO cannot also demand auth
+            raise ValueError("auth_key requires advertise_trace=True")
+        self._draining = False
         # advertise_trace=False simulates a v1 server (no capability byte
         # in the HELLO payload, so v2 clients stay on the pure v1 wire)
         self._advertise_trace = bool(advertise_trace)
@@ -810,6 +928,9 @@ class VerifyService(BaseService):
         self._errors: Dict[str, int] = {}
         self._disconnects: Dict[str, int] = {}
         self._stale_drops = 0
+        self._drain_refusals = 0
+        self._auth_ok = 0
+        self._auth_rejects = 0
         self._inline_dispatches = 0
         # per-tenant service panel: RED + wire shape + refusal taxonomy
         self._tenant_stats: Dict[str, Dict[str, Any]] = {}
@@ -924,16 +1045,27 @@ class VerifyService(BaseService):
             conn = _Conn(sock)
             with self._cmtx:
                 self._conns.add(conn)
-            # Capability advertisement rides the HELLO *payload* (one
-            # byte: the highest protocol version we speak). The header
-            # stays version 1 so v1 clients decode it, and v1 clients
-            # provably ignore HELLO payload bytes — only a v2 client
-            # reads the byte and starts shipping extended frames.
+            # Capability advertisement rides the HELLO *payload*
+            # ([version, flags, challenge?]). The header stays version 1
+            # so v1 clients decode it, and v1 clients provably ignore
+            # HELLO payload bytes — only a v2 client reads them and
+            # starts shipping extended frames / answering the challenge.
+            if self._advertise_trace:
+                flags = 0
+                challenge = b""
+                if self._draining:
+                    flags |= HELLO_FLAG_DRAINING
+                if self._auth_key is not None:
+                    conn.challenge = os.urandom(AUTH_CHALLENGE_BYTES)
+                    flags |= HELLO_FLAG_AUTH
+                    challenge = conn.challenge
+                hello_payload = bytes((VERSION, flags)) + challenge
+            else:
+                hello_payload = b""
             self._enqueue(conn, encode_frame(
                 FT_HELLO, n_lanes=self._max_lanes,
                 generation=self._generation(),
-                payload=(bytes((VERSION,)) if self._advertise_trace
-                         else b""),
+                payload=hello_payload,
             ))
             conn.writer = threading.Thread(
                 target=self._write_loop, args=(conn,), daemon=True,
@@ -987,6 +1119,12 @@ class VerifyService(BaseService):
                     break
                 try:
                     self._handle(conn, frame)
+                except _FatalFrameError as fe:
+                    # typed refusal, then hang up (repeated auth
+                    # failures): the drain window in _teardown flushes
+                    # the error frame to the refused client first
+                    self._send_err(conn, frame.req_id, fe.code, str(fe))
+                    break
                 except FrameError as fe:
                     # per-request refusal (bad class, stale generation,
                     # unknown valset, size mismatch): typed error, the
@@ -1082,20 +1220,79 @@ class VerifyService(BaseService):
             self._frames[name] = self._frames.get(name, 0) + 1
         self.metrics.frames.with_labels(type=name).add()
         if frame.ftype == FT_CLIENT_HELLO:
-            conn.tenant = frame.payload.decode(
-                "utf-8", errors="replace"
-            ) or None
+            # a tenant HINT only: under auth the authenticated node id
+            # wins (set in _handle_auth), so a client cannot ride
+            # another tenant's quota by renaming its connection
+            if not (self._auth_key is not None and conn.authenticated):
+                conn.tenant = frame.payload.decode(
+                    "utf-8", errors="replace"
+                ) or None
             return
+        if frame.ftype == FT_AUTH:
+            self._handle_auth(conn, frame)
+            return
+        if self._auth_key is not None and not conn.authenticated and \
+                frame.ftype in (FT_REQ, FT_REGISTER):
+            # unauthenticated work NEVER reaches the scheduler
+            raise FrameError(
+                ERR_UNAUTHORIZED, "session not authenticated"
+            )
         if frame.ftype == FT_REGISTER:
             self._handle_register(conn, frame)
             return
         if frame.ftype == FT_REQ:
             self._handle_req(conn, frame)
             return
-        # HELLO/RESP/ERR/REGISTERED are server-to-client only
+        # HELLO/RESP/ERR/REGISTERED/AUTH_OK/DRAINING are
+        # server-to-client only
         raise FrameError(
             ERR_MALFORMED, f"unexpected client frame type {name}"
         )
+
+    def _handle_auth(self, conn: _Conn, frame: Frame) -> None:
+        if self._auth_key is None:
+            # no key configured: acknowledge so a keyed client pointed
+            # at an open server still completes its handshake
+            conn.authenticated = True
+            self._enqueue(conn, encode_frame(
+                FT_AUTH_OK, req_id=frame.req_id,
+                generation=self._generation(),
+            ))
+            return
+        payload = frame.payload
+        ok = False
+        node_id = ""
+        if len(payload) > AUTH_MAC_BYTES and conn.challenge is not None:
+            mac = payload[:AUTH_MAC_BYTES]
+            node_id = payload[AUTH_MAC_BYTES:].decode(
+                "utf-8", errors="replace"
+            )
+            want = auth_mac(self._auth_key, conn.challenge, node_id)
+            ok = bool(node_id) and hmac.compare_digest(mac, want)
+        if not ok:
+            conn.auth_fails += 1
+            with self._smtx:
+                self._auth_rejects += 1
+            if conn.auth_fails >= MAX_AUTH_ATTEMPTS:
+                raise _FatalFrameError(
+                    ERR_UNAUTHORIZED,
+                    f"auth refused {conn.auth_fails} times; disconnecting",
+                )
+            raise FrameError(ERR_UNAUTHORIZED, "bad auth response")
+        conn.authenticated = True
+        # tenant identity = the authenticated node id: quotas and RED
+        # metering follow the key holder across reconnects and NAT
+        conn.tenant = node_id
+        with self._smtx:
+            self._auth_ok += 1
+        if self._telemetry is not None:
+            self._telemetry.note_event(
+                "session_authenticated", {"tenant": node_id}
+            )
+        self._enqueue(conn, encode_frame(
+            FT_AUTH_OK, req_id=frame.req_id,
+            generation=self._generation(),
+        ))
 
     def _handle_register(self, conn: _Conn, frame: Frame) -> None:
         payload = frame.payload
@@ -1130,6 +1327,26 @@ class VerifyService(BaseService):
         ))
 
     def _handle_req(self, conn: _Conn, frame: Frame) -> None:
+        if self._draining:
+            # graceful drain: new work is refused with a typed
+            # ST_DRAINING response (clients fail over immediately
+            # instead of eating a timeout); in-flight work still answers
+            tenant = conn.tenant or "unknown"
+            with self._smtx:
+                self._drain_refusals += 1
+                rec = self._tenant(conn.tenant)
+                rec["refusals"]["draining"] = (
+                    rec["refusals"].get("draining", 0) + 1
+                )
+            self.metrics.refusals.with_labels(
+                tenant=tenant, code="draining"
+            ).add()
+            self._enqueue(conn, encode_frame(
+                FT_RESP, req_id=frame.req_id, n_lanes=0,
+                generation=self._generation(),
+                payload=bytes((ST_DRAINING,)),
+            ))
+            return
         n = frame.n_lanes
         if n < 1 or n > self._max_lanes:
             raise FrameError(
@@ -1290,6 +1507,42 @@ class VerifyService(BaseService):
             conn.outq.append(data)
             conn.cv.notify_all()
 
+    # -- graceful drain ----------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self, broadcast: bool = True) -> None:
+        """Enter graceful drain: stop admitting new REQ frames (typed
+        ST_DRAINING refusals), keep answering in-flight work, and
+        broadcast FT_DRAINING so connected clients stop picking this
+        endpoint for new submits. Idempotent; the listener keeps
+        accepting (new connections see the draining HELLO flag).
+        ``broadcast=False`` sets the flag without notifying — the chaos
+        harness uses it to exercise the per-request ST_DRAINING path
+        deterministically."""
+        with self._smtx:
+            first = not self._draining
+            self._draining = True
+        if first:
+            self.logger.info(
+                "verify service draining",
+                pending=self.pending_requests(),
+            )
+            if self._telemetry is not None:
+                self._telemetry.note_event("drain_started", {
+                    "pending": self.pending_requests(),
+                })
+        if not broadcast:
+            return
+        with self._cmtx:
+            conns = list(self._conns)
+        for conn in conns:
+            self._enqueue(conn, encode_frame(
+                FT_DRAINING, generation=self._generation(),
+            ))
+
     # -- keystore (generation handshake) -----------------------------------
 
     def _keystore(self):
@@ -1346,6 +1599,11 @@ class VerifyService(BaseService):
                 "errors": dict(self._errors),
                 "disconnects": dict(self._disconnects),
                 "stale_drops": self._stale_drops,
+                "draining": self._draining,
+                "drain_refusals": self._drain_refusals,
+                "auth_required": self._auth_key is not None,
+                "auth_ok": self._auth_ok,
+                "auth_rejects": self._auth_rejects,
                 "inline_dispatches": self._inline_dispatches,
                 "tenants_panel": panel,
             }
@@ -1378,7 +1636,7 @@ class _Agg:
     request to the local CPU ground truth exactly once."""
 
     __slots__ = ("items", "future", "mask", "remaining", "failed",
-                 "req_ids", "mtx", "span", "wire_span")
+                 "req_ids", "mtx", "span", "wire_span", "ctx")
 
     def __init__(self, items, future, n_parts):
         self.items = items
@@ -1388,6 +1646,10 @@ class _Agg:
         self.failed = False
         self.req_ids: List[int] = []
         self.mtx = threading.Lock()
+        # opaque HA-failover context (crypto/ha.py), handed back to the
+        # failover hook so the fleet layer can resubmit these items to a
+        # secondary even when submit() fails before returning
+        self.ctx = None
         # client-side trace spans (NOOP_SPAN when unsampled): the submit
         # root whose id ships in the v2 extension, and the wire_wait
         # child covering send -> final verdict
@@ -1424,6 +1686,10 @@ class RemoteVerifier:
         timeout_ms: Optional[int] = None,
         connect_timeout_s: float = 1.0,
         retry_s: float = 1.0,
+        retry_cap_s: float = 30.0,
+        auth_key: Optional[bytes] = None,
+        node_id: Optional[str] = None,
+        failover: Optional[Callable] = None,
         tracer=None,
         telemetry=None,
         logger: Optional[Logger] = None,
@@ -1440,13 +1706,31 @@ class RemoteVerifier:
         self._timeout_s = service_timeout_default(timeout_ms) / 1e3
         self._connect_timeout_s = connect_timeout_s
         self._retry_s = retry_s
+        self._retry_cap_s = max(retry_cap_s, retry_s)
+        self._auth_key = bytes(auth_key) if auth_key else None
+        self._node_id = node_id or self._tenant
+        # failover(items, reason, future, ctx) -> bool: the HA rung
+        # (crypto/ha.py). True = it owns completing the future on a
+        # secondary; False/raise = fall through to the local CPU rung.
+        self._failover = failover
         self._tracer = tracer
         self._telemetry = telemetry
         # highest protocol version the server advertised (HELLO payload
         # byte); trace extensions ship only when it is >= 2
         self._server_proto = 1
+        self._server_flags = 0
+        self._server_draining = False
+        self._challenge: Optional[bytes] = None
+        self._hello_evt: Optional[threading.Event] = None
+        # [done Event, ok bool] for the in-flight AUTH round-trip
+        self._auth_waiter: Optional[list] = None
         self.logger = logger
         self._mtx = threading.Lock()
+        # serializes the connect+handshake so a concurrent submit can
+        # never race a half-authenticated socket with an FT_REQ (the
+        # server would refuse it ERR_UNAUTHORIZED despite a good key)
+        self._conn_lock = threading.Lock()
+        self._session_ready = False
         self._sock: Optional[socket.socket] = None
         self._recv_thread: Optional[threading.Thread] = None
         self._pending: Dict[int, _PendingPart] = {}
@@ -1457,6 +1741,10 @@ class RemoteVerifier:
         self._valsets: Dict[bytes, _ClientValset] = {}
         self._stats: Dict[str, int] = {}
         self._next_retry = 0.0
+        self._connect_fails = 0
+        self._auth_fails = 0
+        self._last_backoff_s = 0.0
+        self._rng = random.Random()
         self._closed = False
 
     # -- Backend contract --------------------------------------------------
@@ -1466,6 +1754,7 @@ class RemoteVerifier:
         items: Sequence[Item],
         subsystem: Optional[str] = None,
         height: Optional[int] = None,
+        failover_ctx=None,
     ) -> VerifyFuture:
         triples = [(pk, bytes(m), bytes(s)) for pk, m, s in items]
         fut = VerifyFuture()
@@ -1473,6 +1762,7 @@ class RemoteVerifier:
             fut._set((True, []))
             return fut
         agg = _Agg(triples, fut, 0)
+        agg.ctx = failover_ctx
         if self._tracer is not None:
             agg.span = self._tracer.start_remote_root(
                 "submit", n_sigs=len(triples), tenant=self._tenant,
@@ -1480,6 +1770,10 @@ class RemoteVerifier:
             )
         try:
             self._submit_remote(agg, subsystem)
+        except AuthError:
+            # the fleet shares the key — a secondary would refuse the
+            # same credentials, so never failover, go straight to CPU
+            self._fail_agg(agg, "unauthorized")
         except Exception:  # noqa: BLE001 - daemon down: local ground truth
             self._fail_agg(agg, "disconnected")
         return fut
@@ -1664,14 +1958,59 @@ class RemoteVerifier:
 
     # -- connection --------------------------------------------------------
 
+    def _note_retry(self, auth: bool = False) -> None:
+        """Capped exponential backoff with full jitter before the next
+        connect attempt — a dead daemon is not hammered in lockstep by
+        every node whose socket it dropped. Auth refusals back off the
+        same way (equal jitter, so the bounded-attempts property is
+        deterministic) without resetting on mere TCP success."""
+        with self._mtx:
+            if auth:
+                self._auth_fails += 1
+                fails = self._auth_fails
+            else:
+                self._connect_fails += 1
+                fails = self._connect_fails
+            window = min(
+                self._retry_cap_s,
+                max(self._retry_s, 1e-3) * (2 ** min(fails - 1, 16)),
+            )
+            lo = window / 2 if auth else 0.0
+            self._last_backoff_s = window
+            # max(): the teardown path also notes a retry, and its
+            # fresh (small) window must not shrink an auth backoff
+            self._next_retry = max(
+                self._next_retry,
+                time.monotonic() + self._rng.uniform(lo, window),
+            )
+
     def _ensure_connected(self) -> None:
         with self._mtx:
             if self._closed:
                 raise ConnectionError("remote verifier closed")
-            if self._sock is not None:
+            if self._sock is not None and self._session_ready:
+                return
+        # one thread runs the handshake; the rest block here and re-check
+        # (the holder either finished — ready — or tore the socket down)
+        with self._conn_lock:
+            self._connect_locked()
+
+    def _connect_locked(self) -> None:
+        with self._mtx:
+            if self._closed:
+                raise ConnectionError("remote verifier closed")
+            if self._sock is not None and self._session_ready:
                 return
             if time.monotonic() < self._next_retry:
+                # attribution survives the backoff window: a client the
+                # server REFUSED stays "unauthorized" (CPU rung, never
+                # failover) until its next real attempt says otherwise
+                if self._auth_fails > 0:
+                    raise AuthError(
+                        "verify service refused authentication (backoff)"
+                    )
                 raise ConnectionError("verify service unreachable (backoff)")
+        self._count("connect_attempts")
         if self._family == "unix":
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         else:
@@ -1680,16 +2019,22 @@ class RemoteVerifier:
         try:
             sock.connect(self._target)
         except OSError:
-            with self._mtx:
-                self._next_retry = time.monotonic() + self._retry_s
+            self._note_retry()
             try:
                 sock.close()
             except OSError:
                 pass
             raise
         sock.settimeout(0.2)
+        hello_evt = threading.Event()
         with self._mtx:
             self._sock = sock
+            self._session_ready = False
+            self._server_draining = False
+            self._server_flags = 0
+            self._challenge = None
+            self._hello_evt = hello_evt
+            self._auth_waiter = None
             self._recv_thread = threading.Thread(
                 target=self._recv_loop, args=(sock,), daemon=True,
                 name="verify-remote",
@@ -1699,6 +2044,53 @@ class RemoteVerifier:
             FT_CLIENT_HELLO, payload=self._tenant.encode("utf-8"),
         ))
         self._count("connects")
+        with self._mtx:
+            self._connect_fails = 0
+        if self._auth_key is None:
+            with self._mtx:
+                self._session_ready = True
+            return
+        # authenticated session: the HELLO carries the challenge; answer
+        # it and hold this submit until the server acknowledges. Against
+        # a no-auth server the flag is simply absent (v1 interop).
+        if not hello_evt.wait(self._connect_timeout_s):
+            self._on_disconnect()
+            raise ConnectionError("no HELLO from verify service")
+        with self._mtx:
+            challenge = self._challenge
+            required = bool(self._server_flags & HELLO_FLAG_AUTH)
+            if not required:
+                self._session_ready = True
+                return
+            waiter = [threading.Event(), False]
+            self._auth_waiter = waiter
+        mac = auth_mac(self._auth_key, challenge or b"", self._node_id)
+        try:
+            self._send(encode_frame(
+                FT_AUTH,
+                payload=mac + self._node_id.encode("utf-8"),
+            ))
+        except OSError as exc:
+            self._on_disconnect()
+            raise ConnectionError(str(exc)) from exc
+        answered = waiter[0].wait(self._timeout_s)
+        if answered and not waiter[1]:
+            # a typed verdict: the server LOOKED at our credentials and
+            # refused them — not failover-eligible (shared fleet key)
+            self._count("unauthorized")
+            self._note_retry(auth=True)
+            self._on_disconnect()
+            raise AuthError("verify service refused authentication")
+        if not answered:
+            # no verdict at all: the server died or stalled
+            # mid-handshake (rolling restart, blackhole). That is a
+            # transport failure — a secondary may well accept the same
+            # key, so it must stay failover-eligible
+            self._on_disconnect()
+            raise ConnectionError("no auth verdict from verify service")
+        with self._mtx:
+            self._auth_fails = 0
+            self._session_ready = True
 
     def _send(self, data: bytes) -> None:
         with self._mtx:
@@ -1738,15 +2130,51 @@ class RemoteVerifier:
 
     def _on_frame(self, frame: Frame) -> None:
         if frame.ftype == FT_HELLO:
+            payload = frame.payload
             with self._mtx:
                 self._server_gen = frame.generation
                 if frame.n_lanes:
                     self._max_lanes = frame.n_lanes
-                # capability byte: the highest protocol version the
-                # server speaks (absent/empty payload = a v1 server)
-                self._server_proto = (
-                    frame.payload[0] if frame.payload else 1
+                # capability bytes: [version, flags, challenge?]
+                # (absent/empty payload = a v1 server)
+                self._server_proto = payload[0] if payload else 1
+                self._server_flags = (
+                    payload[1] if len(payload) >= 2 else 0
                 )
+                self._server_draining = bool(
+                    self._server_flags & HELLO_FLAG_DRAINING
+                )
+                if (self._server_flags & HELLO_FLAG_AUTH) and \
+                        len(payload) >= 2 + AUTH_CHALLENGE_BYTES:
+                    self._challenge = bytes(
+                        payload[2:2 + AUTH_CHALLENGE_BYTES]
+                    )
+                evt = self._hello_evt
+            if evt is not None:
+                evt.set()
+            return
+        if frame.ftype == FT_AUTH_OK:
+            with self._mtx:
+                waiter = self._auth_waiter
+            if waiter is not None:
+                waiter[1] = True
+                waiter[0].set()
+            self._count("auth_ok")
+            return
+        if frame.ftype == FT_DRAINING:
+            # the server entered graceful drain: stop sending NEW work
+            # there (the HA layer skips draining endpoints); in-flight
+            # requests are still answered, so pendings stay put
+            with self._mtx:
+                already = self._server_draining
+                self._server_draining = True
+            if not already:
+                self._count("server_draining")
+                if self._telemetry is not None:
+                    self._telemetry.note_event("server_draining", {
+                        "tenant": self._tenant,
+                        "address": self._address,
+                    }, source="client")
             return
         if frame.ftype == FT_REGISTERED:
             with self._mtx:
@@ -1763,6 +2191,15 @@ class RemoteVerifier:
             if pend is None:
                 return
             status = frame.payload[0] if frame.payload else ST_REJECTED
+            if status == ST_DRAINING:
+                # typed drain refusal: transport-shaped, so the HA rung
+                # fails this over to a secondary immediately instead of
+                # eating a timeout; solo clients take the CPU rung with
+                # the reason kept distinct from a crash
+                with self._mtx:
+                    self._server_draining = True
+                self._fail_agg(pend.agg, "draining")
+                return
             if status != ST_OK:
                 # a server-side ADMISSION verdict (QoS shed/drop/quota),
                 # not a transport failure: propagate the rejection like
@@ -1790,6 +2227,19 @@ class RemoteVerifier:
                 self._count("stale")
                 if pend is not None:
                     self._fail_agg(pend.agg, "stale")
+                return
+            if code == ERR_UNAUTHORIZED:
+                # typed auth refusal: wake the handshake waiter (wrong
+                # key) and resolve any refused request on the CPU rung
+                # under its own reason — never the failover rung
+                with self._mtx:
+                    waiter = self._auth_waiter
+                if waiter is not None and not waiter[0].is_set():
+                    waiter[1] = False
+                    waiter[0].set()
+                self._count("err_unauthorized")
+                if pend is not None:
+                    self._fail_agg(pend.agg, "unauthorized")
                 return
             if code == ERR_UNKNOWN_VALSET and pend is not None:
                 with self._mtx:
@@ -1848,9 +2298,14 @@ class RemoteVerifier:
         self._finish_spans(agg, "rejected")
 
     def _fail_agg(self, agg: _Agg, reason: str) -> None:
-        """Local-CPU fallback for the WHOLE request, exactly once; the
-        reason stays distinct on the future (``disconnected`` for a dead
-        daemon is the contract the node's health checks key on)."""
+        """Fallback ladder for the WHOLE request, exactly once. With an
+        HA hook installed, transport-shaped failures (disconnect /
+        timeout / draining) first offer the items to a healthy secondary
+        — verify is idempotent and req_ids are per-connection, so the
+        resubmit is safe; only when the hook declines (all endpoints
+        down) does the local CPU rung run. The reason stays distinct on
+        the future (``disconnected`` for a dead daemon is the contract
+        the node's health checks key on)."""
         with agg.mtx:
             if agg.failed:
                 return
@@ -1859,12 +2314,27 @@ class RemoteVerifier:
             for rid in agg.req_ids:
                 self._pending.pop(rid, None)
         self._count(reason)
+        if self._failover is not None and reason in FAILOVER_REASONS:
+            try:
+                took = self._failover(
+                    agg.items, reason, agg.future, agg.ctx
+                )
+            except Exception:  # noqa: BLE001 - broken HA layer: CPU rung
+                took = False
+            if took:
+                # the HA layer owns completion now; this agg's future is
+                # never set here, and `failover` is metered distinctly
+                # from the transport reason that triggered it
+                self._count("failed_over")
+                if self._telemetry is not None:
+                    self._telemetry.note_fallback(
+                        self._tenant, "failover",
+                        kind="client_failover", detail={"via": reason},
+                    )
+                self._finish_spans(agg, "failover")
+                return
         if self._telemetry is not None:
-            self._telemetry.note_event(
-                "client_fallback",
-                {"tenant": self._tenant, "reason": reason},
-                source="client",
-            )
+            self._telemetry.note_fallback(self._tenant, reason)
         bv = CPUBatchVerifier()
         for pk, m, s in agg.items:
             bv.add(pk, m, s)
@@ -1886,7 +2356,8 @@ class RemoteVerifier:
         with self._mtx:
             sock = self._sock
             self._sock = None
-            self._next_retry = time.monotonic() + self._retry_s
+            self._session_ready = False
+        self._note_retry()
         if sock is not None:
             try:
                 sock.close()
@@ -1911,6 +2382,29 @@ class RemoteVerifier:
 
     # -- observability -----------------------------------------------------
 
+    @property
+    def server_draining(self) -> bool:
+        """True once the current endpoint signalled graceful drain (the
+        FT_DRAINING broadcast, a draining HELLO flag, or an ST_DRAINING
+        refusal) — the HA layer skips such endpoints for new work."""
+        with self._mtx:
+            return self._server_draining
+
+    def clear_draining(self) -> None:
+        """HA probe hook: the endpoint restarted and its HELLO no longer
+        carries the draining flag, so new work may route here again."""
+        with self._mtx:
+            self._server_draining = False
+
+    @property
+    def connected(self) -> bool:
+        with self._mtx:
+            return self._sock is not None
+
+    @property
+    def address(self) -> str:
+        return self._address
+
     def stats(self) -> Dict[str, int]:
         with self._mtx:
             return dict(self._stats)
@@ -1925,8 +2419,20 @@ class RemoteVerifier:
                 "connected": self._sock is not None,
                 "server_generation": self._server_gen,
                 "server_proto": self._server_proto,
+                "server_draining": self._server_draining,
+                "auth": self._auth_key is not None,
                 "max_lanes": self._max_lanes,
                 "valsets": len(self._valsets),
                 "pending": len(self._pending),
+                "reconnect": {
+                    "connect_fails": self._connect_fails,
+                    "auth_fails": self._auth_fails,
+                    "last_backoff_s": round(self._last_backoff_s, 4),
+                    "next_retry_in_s": round(
+                        max(0.0, self._next_retry - time.monotonic()), 4
+                    ),
+                    "retry_base_s": self._retry_s,
+                    "retry_cap_s": self._retry_cap_s,
+                },
                 "stats": dict(self._stats),
             }

@@ -146,6 +146,13 @@ class Metrics:
             "Verify-service requests whose client connection died before "
             "the verdict could be delivered, by tenant.",
         )
+        self.red_fallbacks = r.counter(
+            SUBSYSTEM, "red_fallbacks",
+            "Client-side verify fallback ladder events, by tenant and "
+            "reason (disconnected / timeout / draining / stale / error / "
+            "unauthorized hit the local-CPU rung; failover = absorbed by "
+            "a healthy secondary instead).",
+        )
         self.slo_target_ms = r.gauge(
             SLO_SUBSYSTEM, "target_ms",
             "Configured commit-verify latency target "
@@ -319,6 +326,9 @@ class TelemetryHub:
         # beside the positional RED recs, not inside them, so existing
         # rec indexing stays untouched
         self._disconnects: Dict[str, int] = {}
+        # tenant -> {reason: count} of client-side fallback ladder
+        # events (disconnected/timeout/draining/... plus HA failovers)
+        self._fallbacks: Dict[str, Dict[str, int]] = {}
         self._sources: Dict[str, Callable[[], Any]] = {}
         self._capacity_fn: Optional[Callable[[], float]] = None
         self._burn_watchers: List[Callable[[float], None]] = []
@@ -385,6 +395,37 @@ class TelemetryHub:
                 ]
         self.metrics.red_disconnects.with_labels(tenant=name).add(int(n))
         self.note_event("disconnect", {"tenant": name, "pending": int(n)})
+
+    def note_fallback(
+        self,
+        tenant: Optional[str],
+        reason: str,
+        kind: str = "client_fallback",
+        detail: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """One client-side fallback ladder event: RED-metered per
+        (tenant, reason) and stamped on the incident timeline. The
+        reason taxonomy keeps an intentional drain (``draining``), a
+        crash (``disconnected``), and an HA-absorbed resubmit
+        (``failover``, kind ``client_failover``) distinguishable in
+        every panel."""
+        name = tenant or UNTAGGED
+        with self._mtx:
+            per = self._fallbacks.setdefault(name, {})
+            per[reason] = per.get(reason, 0) + 1
+            if name not in self._subsystems:
+                # keep the tenant visible in the RED view even when its
+                # every request resolved on the fallback ladder
+                self._subsystems[name] = [
+                    0, 0, 0, None, deque(maxlen=_MAX_SAMPLES)
+                ]
+        self.metrics.red_fallbacks.with_labels(
+            tenant=name, reason=reason
+        ).add()
+        ev: Dict[str, Any] = {"tenant": name, "reason": reason}
+        if detail:
+            ev.update(detail)
+        self.note_event(kind, ev, source="client")
 
     def note_event(
         self,
@@ -578,6 +619,9 @@ class TelemetryHub:
                 for name, rec in self._subsystems.items()
             }
             disconnects = dict(self._disconnects)
+            fallbacks = {
+                name: dict(per) for name, per in self._fallbacks.items()
+            }
         out = {}
         for name, (reqs, errs, sigs, height, samples) in rows.items():
             live = sorted(lat for t, lat in samples if t > cutoff)
@@ -589,6 +633,7 @@ class TelemetryHub:
                 "sigs": sigs,
                 "last_height": height,
                 "disconnects": disconnects.get(name, 0),
+                "fallbacks": fallbacks.get(name, {}),
                 "window_requests": len(live),
                 "rate_per_sec": round(len(live) / self.window_s, 3),
                 "p50_ms": None if p50 is None else round(p50 * 1e3, 3),

@@ -319,10 +319,14 @@ class MetricsServer(RouteServer):
       (``?ms=`` overrides the duration) and returns its path as JSON;
       503 when the profiler is unavailable (no jax, no profile dir, or
       a capture already in flight).
+
+    Callers may add ops routes (verifyd's ``/drain``) via
+    ``extra_routes``: a ``path -> handler(query) -> (status, content_type,
+    body)`` dict merged last, so it can also override a built-in.
     """
 
     def __init__(self, registry: Registry, tracer=None, telemetry=None,
-                 profiler=None):
+                 profiler=None, extra_routes=None):
         import json
 
         routes = {
@@ -398,6 +402,8 @@ class MetricsServer(RouteServer):
                     _trace.chrome_trace(tracer.recent(_limit(q)))
                 ).encode(),
             )
+        if extra_routes:
+            routes.update(extra_routes)
         super().__init__(routes)
 
 

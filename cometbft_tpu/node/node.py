@@ -499,19 +499,51 @@ class Node(BaseService):
             config.crypto.verify_service
         )
         if vs_addr:
-            self.remote_verifier = verify_servicelib.RemoteVerifier(
-                vs_addr,
-                tenant=config.base.moniker,
-                spec=self.crypto_spec,
-                timeout_ms=config.crypto.verify_service_timeout_ms,
-                tracer=self.tracer,
-                telemetry=self.telemetry_hub,
-                logger=self.logger,
+            endpoints = verify_servicelib.parse_address_list(vs_addr)
+            auth_path = verify_servicelib.verify_auth_key_default(
+                config.crypto.verify_auth_key
             )
+            auth_key = (
+                verify_servicelib.load_auth_key(auth_path)
+                if auth_path else None
+            )
+            if len(endpoints) > 1:
+                # comma list = HA replica set (crypto/ha.py): breakers,
+                # health probes, failover rung above local CPU; it
+                # registers its own "ha" telemetry source with the
+                # per-endpoint panel
+                from cometbft_tpu.crypto import ha as halib
+
+                self.remote_verifier = halib.HAVerifier(
+                    endpoints,
+                    tenant=config.base.moniker,
+                    spec=self.crypto_spec,
+                    timeout_ms=config.crypto.verify_service_timeout_ms,
+                    retry_cap_s=config.crypto.verify_retry_cap_ms / 1e3,
+                    probe_base_s=config.crypto.verify_probe_ms / 1e3,
+                    auth_key=auth_key,
+                    node_id=config.base.moniker,
+                    tracer=self.tracer,
+                    telemetry=self.telemetry_hub,
+                    logger=self.logger,
+                )
+            else:
+                self.remote_verifier = verify_servicelib.RemoteVerifier(
+                    endpoints[0],
+                    tenant=config.base.moniker,
+                    spec=self.crypto_spec,
+                    timeout_ms=config.crypto.verify_service_timeout_ms,
+                    retry_cap_s=config.crypto.verify_retry_cap_ms / 1e3,
+                    auth_key=auth_key,
+                    node_id=config.base.moniker,
+                    tracer=self.tracer,
+                    telemetry=self.telemetry_hub,
+                    logger=self.logger,
+                )
+                self.telemetry_hub.register_source(
+                    "service", self.remote_verifier.snapshot
+                )
             self.crypto_backend = self.remote_verifier
-            self.telemetry_hub.register_source(
-                "service", self.remote_verifier.snapshot
-            )
 
         # 1. stores
         self.block_store = BlockStore(db_provider("blockstore", config))

@@ -1,0 +1,390 @@
+"""HA verify fleet (PR 20): replicated verifyd endpoints behind
+``HAVerifier`` — per-request failover on drain and kill, the all-down
+local-CPU rung with exact reason attribution, breaker quarantine plus
+probe re-admission, the verifyd graceful-drain timeout, and the chaos
+rung as a fast tier-1 gate. Runs real scheduler+service daemons over
+Unix sockets on the virtual CPU mesh (conftest.py)."""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519 as ed
+from cometbft_tpu.crypto import ha as halib
+from cometbft_tpu.crypto import service as svc
+from cometbft_tpu.crypto.scheduler import VerifyScheduler
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"),
+)
+
+
+def _batch(n, tag=b"ha", bad=()):
+    keys = [ed.gen_priv_key_from_secret(tag + b"-%d" % i) for i in range(n)]
+    items = []
+    for i, k in enumerate(keys):
+        msg = tag + b" msg %d" % i
+        sig = k.sign(msg)
+        if i in bad:
+            sig = bytes(sig[:-1]) + bytes([sig[-1] ^ 0x01])
+        items.append((k.pub_key(), msg, sig))
+    return items
+
+
+def _expected(items):
+    return [
+        ed.PubKeyEd25519(svc._pk_bytes(pk)).verify_signature(m, s)
+        for pk, m, s in items
+    ]
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class _Node:
+    """One restartable scheduler+service replica on a fixed socket."""
+
+    def __init__(self, tag, idx, auth_key=None):
+        self.tag, self.idx, self.auth_key = tag, idx, auth_key
+        self.path = "/tmp/cbft-test-ha-%s-%d-%d.sock" % (
+            tag, idx, os.getpid()
+        )
+        self.address = "unix://" + self.path
+        self.running = False
+        self._build()
+
+    def _build(self):
+        self.sched = VerifyScheduler(
+            spec="cpu", flush_us=200, lane_budget=256,
+            max_queue=256, qos="off",
+        )
+        self.service = svc.VerifyService(
+            self.sched, self.address, auth_key=self.auth_key,
+        )
+
+    def start(self):
+        self.sched.start()
+        self.service.start()
+        self.running = True
+
+    def stop(self):
+        if not self.running:
+            return
+        self.running = False
+        self.service.stop()
+        self.sched.stop()
+
+    def restart(self):
+        self._build()
+        self.start()
+
+
+@pytest.fixture
+def fleet(request):
+    tag = request.node.name.replace("[", "-").replace("]", "")[:32]
+    nodes = [_Node(tag, i) for i in range(2)]
+    for n in nodes:
+        n.start()
+    verifiers = []
+
+    def make_hv(**kw):
+        kw.setdefault("tenant", "committee")
+        kw.setdefault("timeout_ms", 4000)
+        kw.setdefault("connect_timeout_s", 0.5)
+        kw.setdefault("retry_s", 0.05)
+        kw.setdefault("retry_cap_s", 1.0)
+        kw.setdefault("probe_base_s", 0.05)
+        kw.setdefault("probe_cap_s", 0.5)
+        kw.setdefault("seed", 11)
+        hv = halib.HAVerifier([n.address for n in nodes], **kw)
+        verifiers.append(hv)
+        return hv
+
+    yield nodes, make_hv
+    for hv in verifiers:
+        hv.close()
+    for n in nodes:
+        n.stop()
+        try:
+            os.unlink(n.path)
+        except OSError:
+            pass
+
+
+class TestFailover:
+    def test_silent_drain_fails_over_without_touching_cpu(self, fleet):
+        nodes, make_hv = fleet
+        hv = make_hv()
+        items = _batch(6, tag=b"drain-fo", bad=(2,))
+        want = _expected(items)
+        for _ in range(6):
+            ok, mask = hv.submit(
+                items, subsystem="consensus"
+            ).result(timeout=20)
+            assert not ok and mask == want
+        # silent drain: no FT_DRAINING broadcast, so the NEXT request
+        # routed here eats a typed ST_DRAINING and must fail over
+        nodes[0].service.drain(broadcast=False)
+        saw = False
+        for _ in range(40):
+            fut = hv.submit(items, subsystem="consensus")
+            ok, mask = fut.result(timeout=20)
+            assert not ok and mask == want
+            r = getattr(fut, "reason", None)
+            assert r in (None, "failover"), r
+            if r == "failover":
+                saw = True
+                break
+        assert saw, hv.stats()
+        s = hv.stats()
+        assert s.get("failovers", 0) >= 1
+        assert s.get("cpu_fallback", 0) == 0
+        # exact attribution: the drained endpoint's client recorded the
+        # transport reason, and "draining" is failover-eligible
+        ep_stats = dict(hv.endpoints())[nodes[0].address].stats()
+        assert ep_stats.get("draining", 0) >= 1
+        assert "draining" in svc.FAILOVER_REASONS
+
+    def test_hard_kill_fails_over_with_disconnect_attribution(self, fleet):
+        nodes, make_hv = fleet
+        hv = make_hv()
+        items = _batch(4, tag=b"kill-fo")
+        for _ in range(6):
+            ok, mask = hv.submit(
+                items, subsystem="consensus"
+            ).result(timeout=20)
+            assert ok and mask == [True] * 4
+        nodes[1].stop()
+        saw = False
+        for _ in range(40):
+            fut = hv.submit(items, subsystem="consensus")
+            ok, mask = fut.result(timeout=20)
+            assert ok and mask == [True] * 4
+            if getattr(fut, "reason", None) == "failover":
+                saw = True
+                break
+        assert saw, hv.stats()
+        ep_stats = dict(hv.endpoints())[nodes[1].address].stats()
+        assert ep_stats.get("disconnected", 0) >= 1
+
+    def test_all_down_resolves_on_cpu_with_first_reason(self):
+        dead = [
+            "unix:///tmp/cbft-test-ha-void-%d-%d.sock" % (i, os.getpid())
+            for i in range(2)
+        ]
+        hv = halib.HAVerifier(
+            dead, tenant="lonely", timeout_ms=2000,
+            connect_timeout_s=0.2, retry_s=0.05, retry_cap_s=0.5,
+            probe_base_s=10.0, seed=3,
+        )
+        try:
+            items = _batch(5, tag=b"all-down", bad=(0, 4))
+            fut = hv.submit(items, subsystem="consensus")
+            ok, mask = fut.result(timeout=20)
+            # ground truth from the local CPU rung, reason = what took
+            # the fleet out (never the generic "failover")
+            assert not ok and mask == _expected(items)
+            assert fut.reason == "disconnected"
+            s = hv.stats()
+            assert s.get("all_down", 0) >= 1
+            assert s.get("cpu_fallback", 0) >= 1
+            assert s.get("cpu_disconnected", 0) >= 1
+            assert s.get("failovers", 0) == 0
+        finally:
+            hv.close()
+
+
+class TestBreaker:
+    def test_quarantine_blocks_picks_until_probe_readmission(self, fleet):
+        nodes, make_hv = fleet
+        hv = make_hv(breaker_threshold=2)
+        items = _batch(3, tag=b"breaker")
+        for _ in range(6):
+            ok, _ = hv.submit(
+                items, subsystem="consensus"
+            ).result(timeout=20)
+            assert ok
+        nodes[0].stop()
+        # traffic strikes knock the dead endpoint out of HEALTHY, then
+        # its own failed probes escalate it to BROKEN even while the
+        # healthy peer absorbs every live pick
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            ok, _ = hv.submit(
+                items, subsystem="consensus"
+            ).result(timeout=20)
+            assert ok
+            if hv.endpoint_state(nodes[0].address) == halib.BROKEN:
+                break
+            time.sleep(0.02)
+        assert hv.endpoint_state(nodes[0].address) == halib.BROKEN, \
+            hv.snapshot()
+        assert hv.stats().get("breaker_opens", 0) >= 1
+        picks_before = [
+            e for e in hv.snapshot()["endpoints"]
+            if e["address"] == nodes[0].address
+        ][0]["picks"]
+        for _ in range(12):
+            fut = hv.submit(items, subsystem="consensus")
+            ok, _ = fut.result(timeout=20)
+            assert ok and getattr(fut, "reason", None) is None
+        picks_after = [
+            e for e in hv.snapshot()["endpoints"]
+            if e["address"] == nodes[0].address
+        ][0]["picks"]
+        assert picks_after == picks_before
+        # the breaker re-opens ONLY via the health probe
+        nodes[0].restart()
+        assert _wait(
+            lambda: hv.endpoint_state(nodes[0].address) == halib.HEALTHY
+        ), hv.snapshot()
+        assert hv.stats().get("probe_readmissions", 0) >= 1
+
+
+class TestChaosHaRung:
+    def test_chaos_ha_fast(self):
+        """The chaos rung itself as a tier-1 gate: two replicas through
+        rolling drain-restart, hard kill, blackhole, and a wrong-key
+        client — zero wrong verdicts, zero rolling CPU fallbacks, exact
+        attribution, quarantine + re-admission."""
+        from cometbft_tpu.crypto.faults import run_chaos_ha
+
+        s = run_chaos_ha(seed=5, replicas=2, load_threads=2)
+        assert s["wrong_verdicts"] == 0
+        assert s["rolling_failovers"] >= 2
+        assert s["rolling_cpu_fallbacks"] == 0
+        assert s["rolling_readmits"] == 2
+        assert s["kill_failovers"] >= 1
+        assert s["failover_gap_p99_ms"] <= s["failover_gap_bound_ms"]
+        assert s["blackhole_quarantined"] is True
+        assert s["quarantine_picks_leaked"] == 0
+        assert s["probe_readmitted"] is True
+        assert s["failover_reasons"].get("draining", 0) >= 2
+        assert s["failover_reasons"].get("disconnected", 0) >= 1
+        assert s["evil_unauthorized"] >= 1
+        assert s["server_auth_rejects"] >= 1
+        assert s["evil_requests_served"] == 0
+
+
+class TestVerifydDrainTimeout:
+    def test_drain_timeout_abandons_and_counts(self, tmp_path):
+        import verifyd
+
+        gate = threading.Event()
+        inner = svc.host_row_verifier()
+
+        def gated(rows):
+            gate.wait(20)
+            return inner(rows)
+
+        path = "/tmp/cbft-test-ha-vd-%d.sock" % os.getpid()
+        d = verifyd.Daemon(
+            "unix://" + path, backend="cpu", flush_us=200,
+            metrics_addr="127.0.0.1:0", dump_dir=str(tmp_path),
+            row_verifier=gated, drain_timeout_ms=300,
+        )
+        d.start()
+        c = svc.RemoteVerifier(
+            d.service.address(), tenant="stuck", timeout_ms=15_000,
+            retry_s=0.05,
+        )
+        try:
+            items = _batch(4, tag=b"vd-drain")
+            fut = c.submit(items, subsystem="consensus")
+            assert _wait(lambda: d.service.pending_requests() >= 1)
+            # the pool never thaws: the bounded drain must give up and
+            # report exactly how many frames it abandoned
+            t0 = time.monotonic()
+            abandoned = d.drain()
+            assert abandoned >= 1
+            assert time.monotonic() - t0 < 5.0
+            assert d.service.draining
+        finally:
+            gate.set()
+            fut.result(timeout=20)
+            c.close()
+            d.stop()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def test_drain_waits_out_inflight_when_it_completes(self, tmp_path):
+        import verifyd
+
+        gate = threading.Event()
+        inner = svc.host_row_verifier()
+
+        def gated(rows):
+            gate.wait(20)
+            return inner(rows)
+
+        path = "/tmp/cbft-test-ha-vd2-%d.sock" % os.getpid()
+        d = verifyd.Daemon(
+            "unix://" + path, backend="cpu", flush_us=200,
+            metrics_addr="127.0.0.1:0", dump_dir=str(tmp_path),
+            row_verifier=gated, drain_timeout_ms=10_000,
+        )
+        d.start()
+        c = svc.RemoteVerifier(
+            d.service.address(), tenant="patient", timeout_ms=15_000,
+            retry_s=0.05,
+        )
+        try:
+            items = _batch(3, tag=b"vd-wait", bad=(1,))
+            fut = c.submit(items, subsystem="consensus")
+            assert _wait(lambda: d.service.pending_requests() >= 1)
+            done = []
+            t = threading.Thread(
+                target=lambda: done.append(d.drain()), daemon=True
+            )
+            t.start()
+            time.sleep(0.1)
+            gate.set()
+            t.join(timeout=10)
+            assert done == [0]
+            ok, mask = fut.result(timeout=20)
+            assert not ok and mask == _expected(items)
+            assert getattr(fut, "reason", None) is None
+        finally:
+            gate.set()
+            c.close()
+            d.stop()
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+class TestHaBenchDirections:
+    def test_sentinel_directions_for_the_ha_stage(self):
+        import importlib.util
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "bench_history_ha_test",
+            os.path.join(repo, "tools", "bench_history.py"),
+        )
+        bh = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bh)
+        for leaf in ("ha_failover_gap_ms",
+                     "stages.ha.ha_failover_gap_ms"):
+            assert bh.direction(leaf) == bh.LOWER_IS_BETTER, leaf
+        for leaf in ("ha_rolling_cpu_fallbacks", "ha_wrong_verdicts",
+                     "stages.ha.ha_rolling_cpu_fallbacks"):
+            assert bh.direction(leaf) == bh.LOWER_IS_BETTER, leaf
+        assert (bh.direction("stages.ha.ha_fleet_sigs_per_sec")
+                == bh.HIGHER_IS_BETTER)
+        # ratios and booleans stay directionless
+        assert bh.direction("ha_fleet_gain") is None
+        assert bh.direction("ha_probe_readmitted") is None

@@ -208,7 +208,8 @@ class _Daemon:
     gate the row verifier blocks on (freezing the 'device pool' so
     requests are provably in flight when chaos strikes)."""
 
-    def __init__(self, tag, coalesce=True, gate=None, flush_us=200):
+    def __init__(self, tag, coalesce=True, gate=None, flush_us=200,
+                 auth_key=None):
         self.gate = gate
         inner = svc.host_row_verifier()
 
@@ -225,16 +226,17 @@ class _Daemon:
         self.address = "unix://" + self.path
         self.service = svc.VerifyService(
             self.sched, self.address, coalesce=coalesce,
-            row_verifier=verifier,
+            row_verifier=verifier, auth_key=auth_key,
         )
         self.sched.start()
         self.service.start()
         self.clients = []
 
-    def client(self, tenant, timeout_ms=15_000):
+    def client(self, tenant, timeout_ms=15_000, auth_key=None,
+               node_id=None, retry_s=0.05):
         c = svc.RemoteVerifier(
             self.address, tenant=tenant, timeout_ms=timeout_ms,
-            retry_s=0.05,
+            retry_s=retry_s, auth_key=auth_key, node_id=node_id,
         )
         self.clients.append(c)
         return c
@@ -497,6 +499,41 @@ class TestFrameFuzz:
         finally:
             s.close()
 
+    def test_auth_and_drain_frame_truncation_never_kills_the_accept_loop(
+        self, daemon
+    ):
+        """The PR 20 frame types get the same truncation treatment as
+        FT_REQ: every prefix of an AUTH / DRAINING / AUTH_OK frame, cut
+        mid-header and mid-payload, must leave the accept loop alive."""
+        shapes = [
+            svc.encode_frame(
+                svc.FT_AUTH,
+                payload=b"\x5a" * svc.AUTH_MAC_BYTES + b"node-x",
+            ),
+            svc.encode_frame(svc.FT_DRAINING),
+            svc.encode_frame(svc.FT_AUTH_OK, req_id=9),
+        ]
+        for whole in shapes:
+            for cut in range(1, len(whole)):
+                s = _raw_conn(daemon)
+                s.sendall(whole[:cut])
+                s.close()
+        items = _batch(2, tag=b"fuzz-auth")
+        ok, mask = daemon.client("after-auth-fuzz").submit(
+            items, subsystem="consensus"
+        ).result(timeout=30)
+        assert ok and mask == [True, True]
+
+    def test_client_sent_draining_and_auth_ok_are_refused_typed(
+        self, daemon
+    ):
+        _expect_err(
+            daemon, svc.encode_frame(svc.FT_DRAINING), svc.ERR_MALFORMED,
+        )
+        _expect_err(
+            daemon, svc.encode_frame(svc.FT_AUTH_OK), svc.ERR_MALFORMED,
+        )
+
     def test_connection_survives_a_typed_refusal(self, daemon):
         """Per-request refusals don't kill the connection: a good frame
         on the SAME socket still gets its verdict."""
@@ -594,6 +631,190 @@ class TestGenerationHandshake:
             assert all(v <= 128.0 for v in snap["bytes_per_lane"].values())
         finally:
             d.stop()
+
+
+# ---------------------------------------------------------------------------
+# authenticated sessions (PR 20): HMAC challenge-response on HELLO
+# ---------------------------------------------------------------------------
+
+
+_KEY = b"test-fleet-key-20"
+
+
+class TestAuthSessions:
+    def test_wrong_key_is_refused_typed_with_no_retry_storm(self):
+        d = _Daemon("auth-wrong", auth_key=_KEY)
+        try:
+            c = d.client(
+                "evil", timeout_ms=4000, auth_key=b"not-the-key",
+                node_id="evil", retry_s=0.2,
+            )
+            items = _batch(4, tag=b"auth-w", bad=(1,))
+            want = _expected(items)
+            fut = c.submit(items, subsystem="consensus")
+            ok, mask = fut.result(timeout=20)
+            # ground truth via the local CPU rung, typed reason — never
+            # the failover rung (the whole fleet shares the key)
+            assert fut.reason == "unauthorized"
+            assert not ok and mask == want
+            assert c.stats().get("unauthorized", 0) >= 1
+            assert "unauthorized" not in svc.FAILOVER_REASONS
+            # a burst of submits must not hammer the daemon: auth
+            # refusals escalate the reconnect backoff
+            for _ in range(10):
+                f = c.submit(items, subsystem="consensus")
+                f.result(timeout=20)
+                assert f.reason == "unauthorized"
+            assert c.stats().get("connect_attempts", 0) <= 4
+            snap = d.service.snapshot()
+            assert snap["auth_rejects"] >= 1
+            # refused work never reached the scheduler
+            assert sum(snap["lanes"].values()) == 0
+            panel = snap.get("tenants_panel", {})
+            assert (panel.get("evil", {}) or {}).get("requests", 0) == 0
+        finally:
+            d.stop()
+
+    def test_right_key_tenant_is_the_authenticated_node_id(self):
+        d = _Daemon("auth-right", auth_key=_KEY)
+        try:
+            # the CLIENT_HELLO tenant hint must not let a key holder
+            # ride another tenant's quota: the authenticated id wins
+            c = d.client(
+                "pretender", auth_key=_KEY, node_id="node-7",
+            )
+            items = _batch(3, tag=b"auth-r", bad=(0,))
+            fut = c.submit(items, subsystem="consensus")
+            ok, mask = fut.result(timeout=30)
+            assert not ok and mask == _expected(items)
+            assert getattr(fut, "reason", None) is None
+            assert c.stats().get("auth_ok", 0) >= 1
+            snap = d.service.snapshot()
+            assert snap["auth_ok"] >= 1
+            panel = snap["tenants_panel"]
+            assert panel.get("node-7", {}).get("requests", 0) >= 1
+            assert "pretender" not in panel
+        finally:
+            d.stop()
+
+    def test_keyless_client_against_auth_server_is_refused_typed(self):
+        d = _Daemon("auth-keyless", auth_key=_KEY)
+        try:
+            c = d.client("naive")
+            items = _batch(3, tag=b"auth-k")
+            fut = c.submit(items, subsystem="consensus")
+            ok, mask = fut.result(timeout=20)
+            assert fut.reason == "unauthorized"
+            assert ok and mask == [True] * 3
+            assert c.stats().get("err_unauthorized", 0) >= 1
+            assert sum(d.service.snapshot()["lanes"].values()) == 0
+        finally:
+            d.stop()
+
+    def test_keyed_client_against_open_server_interops(self, daemon):
+        # v1/no-auth interop: the open server's HELLO carries no auth
+        # flag, so the keyed client skips the handshake and just works
+        c = daemon.client("keyed", auth_key=_KEY, node_id="keyed-1")
+        items = _batch(3, tag=b"interop", bad=(2,))
+        fut = c.submit(items, subsystem="consensus")
+        ok, mask = fut.result(timeout=30)
+        assert not ok and mask == _expected(items)
+        assert getattr(fut, "reason", None) is None
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (PR 20): in-flight answered, new work refused typed
+# ---------------------------------------------------------------------------
+
+
+class TestDrain:
+    def test_drain_answers_inflight_and_refuses_new_typed(self):
+        gate = threading.Event()
+        d = _Daemon("drain", gate=gate)
+        try:
+            holder = d.client("holder")
+            items = _batch(5, tag=b"drain", bad=(2,))
+            want = _expected(items)
+            fut = holder.submit(items, subsystem="consensus")
+            deadline = time.monotonic() + 10
+            while (d.service.pending_requests() < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert d.service.pending_requests() >= 1
+            d.service.drain()
+            assert d.service.snapshot()["draining"] is True
+            # NEW work is refused with the typed ST_DRAINING status and
+            # resolves on the caller's local CPU rung, distinct reason
+            late = d.client("late")
+            f2 = late.submit(items, subsystem="consensus")
+            ok2, mask2 = f2.result(timeout=20)
+            assert f2.reason == "draining"
+            assert not ok2 and mask2 == want
+            # the parked in-flight request is still answered — drain is
+            # graceful, not a guillotine
+            gate.set()
+            ok, mask = fut.result(timeout=30)
+            assert getattr(fut, "reason", None) is None
+            assert not ok and mask == want
+            snap = d.service.snapshot()
+            assert snap["drain_refusals"] >= 1
+        finally:
+            gate.set()
+            d.stop()
+
+    def test_drain_broadcast_reaches_connected_clients(self):
+        d = _Daemon("drain-bcast")
+        try:
+            c = d.client("watcher")
+            ok, _ = c.submit(
+                _batch(2, tag=b"bcast"), subsystem="consensus"
+            ).result(timeout=30)
+            assert ok
+            assert not c.server_draining
+            d.service.drain()
+            deadline = time.monotonic() + 10
+            while (not c.server_draining
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert c.server_draining
+            assert c.stats().get("server_draining", 0) >= 1
+            assert c.snapshot()["server_draining"] is True
+        finally:
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# reconnect backoff (PR 20): a dead daemon is not hammered
+# ---------------------------------------------------------------------------
+
+
+class TestReconnectBackoff:
+    def test_dead_endpoint_backoff_bounds_connect_attempts(self):
+        c = svc.RemoteVerifier(
+            "unix:///tmp/cbft-test-noexist-%d.sock" % os.getpid(),
+            tenant="lonely", timeout_ms=2000, retry_s=0.2,
+            retry_cap_s=1.0,
+        )
+        try:
+            items = _batch(2, tag=b"backoff")
+            want = _expected(items)
+            for _ in range(10):
+                f = c.submit(items, subsystem="consensus")
+                ok, mask = f.result(timeout=10)
+                assert f.reason == "disconnected"
+                assert mask == want
+            # ten rapid submits, at most a few real connect() calls:
+            # the capped-exponential window swallowed the rest
+            assert 1 <= c.stats().get("connect_attempts", 0) <= 4
+            snap = c.snapshot()
+            assert snap["connected"] is False
+            r = snap["reconnect"]
+            assert r["connect_fails"] >= 1
+            assert r["last_backoff_s"] > 0
+            assert r["retry_base_s"] == 0.2
+            assert r["retry_cap_s"] == 1.0
+        finally:
+            c.close()
 
 
 # ---------------------------------------------------------------------------

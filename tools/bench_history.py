@@ -168,6 +168,15 @@ def direction(path: str, unit: Optional[str] = None) -> Optional[str]:
         return LOWER_IS_BETTER
     if leaf.endswith("_refusals"):
         return LOWER_IS_BETTER
+    # HA verify-fleet guards (PR 20): the failover verdict gap is
+    # already covered by the generic _ms rule but is pinned here so a
+    # suffix-rule rework can't silently drop the availability guard,
+    # and CPU fallbacks during a ROLLING restart are a zero-tolerance
+    # bare counter (the count conventions would otherwise drop it)
+    if leaf.endswith("_failover_gap_ms"):
+        return LOWER_IS_BETTER
+    if leaf.endswith("_cpu_fallbacks"):
+        return LOWER_IS_BETTER
     if leaf.endswith(("_ms", "_s", "_us", "_ns")) or "_ms_" in leaf:
         return LOWER_IS_BETTER
     return None
@@ -404,6 +413,13 @@ def _self_test() -> int:
         ("stages.service.service_trace_overhead_pct", LOWER_IS_BETTER),
         ("stages.service.service_refusals", LOWER_IS_BETTER),
         ("stages.service.service_tenant_refusals", LOWER_IS_BETTER),
+        # PR 20 ratchets: the HA failover verdict gap is pinned past
+        # any suffix-rule rework, and rolling-restart CPU fallbacks
+        # (healthy baseline 0 — band math skips it) regress on any rise
+        ("stages.ha.ha_failover_gap_ms", LOWER_IS_BETTER),
+        ("stages.ha.ha_rolling_cpu_fallbacks", LOWER_IS_BETTER),
+        ("stages.ha.ha_wrong_verdicts", LOWER_IS_BETTER),
+        ("stages.ha.ha_fleet_sigs_per_sec", HIGHER_IS_BETTER),
     ):
         got = direction(path)
         ok = got == want

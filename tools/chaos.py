@@ -79,6 +79,19 @@ Nine modes:
   wrong verdicts), brownout trips and re-admits, payload stays at
   <= 128 bytes/lane, and the service drains to zero pending.
 
+* --ha — crypto/faults.py run_chaos_ha: the HA verify-fleet rung.
+  Three authenticated verifyd replicas behind ONE HAVerifier under
+  committee load: a rolling drain-restart of every replica (typed
+  ST_DRAINING refusals deterministically exercise the per-request
+  failover rung — zero wrong verdicts, ZERO local-CPU fallbacks, drains
+  attributed "draining" not "disconnected"), one hard kill (failover
+  within a bounded gap, attributed "disconnected"), one socket
+  blackhole (breaker quarantine with zero pick leakage, then
+  re-admission by the endpoint's OWN health probe), a wrong-key client
+  refused typed ERR_UNAUTHORIZED on every endpoint without ever
+  reaching a scheduler, and an aggregate-throughput comparison against
+  a single daemon.
+
 * --adversary — crypto/adversary.py run_chaos_adversary: the
   workload-side attack rung. A synthesized committee (default 512
   validators, real ed25519 keys and canonical vote sign-bytes) storms
@@ -179,6 +192,15 @@ def main() -> int:
                          "QoS under flood, brownout re-admission, "
                          "bytes/lane bound, zero wrong verdicts "
                          "(uses --flood-s)")
+    ap.add_argument("--ha", action="store_true",
+                    help="run the HA verify-fleet rung: 3 authenticated "
+                         "replicas behind one HAVerifier — rolling "
+                         "drain-restart with zero CPU fallbacks, hard "
+                         "kill inside the failover-gap bound, blackhole "
+                         "quarantine + probe re-admission, wrong-key "
+                         "refusal, fleet-vs-single throughput")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="[ha] daemon replicas in the fleet (default 3)")
     ap.add_argument("--memory-guard", action="store_true",
                     help="run the proactive-vs-reactive OOM rung "
                          "(memory plane pre-dispatch guard)")
@@ -374,6 +396,35 @@ def main() -> int:
             and summary["incident_dump_ok"]
         )
         print("CHAOS SERVICE", "PASS" if ok else "FAIL",
+              "seed=%d" % args.seed)
+        return 0 if ok else 1
+
+    if args.ha:
+        from cometbft_tpu.crypto.faults import run_chaos_ha
+
+        summary = run_chaos_ha(seed=args.seed, replicas=args.replicas)
+        print(json.dumps(summary, indent=2, default=str))
+        ok = (
+            summary["wrong_verdicts"] == 0
+            and summary["rolling_failovers"] >= args.replicas
+            and summary["rolling_cpu_fallbacks"] == 0
+            and summary["rolling_readmits"] == args.replicas
+            and summary["kill_failovers"] >= 1
+            and summary["kill_attributed_disconnects"] >= 1
+            and summary["failover_gap_p99_ms"]
+            <= summary["failover_gap_bound_ms"]
+            and summary["blackhole_quarantined"]
+            and summary["quarantine_picks_leaked"] == 0
+            and summary["probe_readmitted"]
+            and summary["probe_readmissions"] >= 1
+            and summary["failover_reasons"].get("draining", 0)
+            >= args.replicas
+            and summary["failover_reasons"].get("disconnected", 0) >= 1
+            and summary["evil_unauthorized"] >= 1
+            and summary["server_auth_rejects"] >= 1
+            and summary["evil_requests_served"] == 0
+        )
+        print("CHAOS HA", "PASS" if ok else "FAIL",
               "seed=%d" % args.seed)
         return 0 if ok else 1
 

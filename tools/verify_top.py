@@ -287,6 +287,7 @@ def render(snap: Dict[str, Any]) -> str:
                 f"conns={svc.get('connections', 0)}  "
                 f"tenants={len(svc.get('tenants', []) or [])}  "
                 f"pending={svc.get('pending', 0)}"
+                f"{'  DRAINING' if svc.get('draining') else ''}"
             )
             out.append(
                 "service wire  "
@@ -313,7 +314,32 @@ def render(snap: Dict[str, Any]) -> str:
                 f"valsets={svc.get('valsets', 0)}  "
                 f"pending={svc.get('pending', 0)}  "
                 f"remote_ok={stats.get('remote_ok', 0)}  "
-                f"fallbacks={sum(stats.get(k, 0) for k in ('disconnected', 'timeout', 'rejected', 'stale', 'error'))}"
+                f"fallbacks={sum(stats.get(k, 0) for k in ('disconnected', 'timeout', 'rejected', 'stale', 'error', 'draining'))}"
+                f"  failovers={stats.get('failed_over', 0)}"
+                f"{'  DRAINING' if svc.get('server_draining') else ''}"
+            )
+    fleet = sources.get("ha", {}) if isinstance(sources, dict) else {}
+    if isinstance(fleet, dict) and fleet.get("endpoints"):
+        # HA replica-set client (crypto/ha.py): one row per endpoint
+        # with breaker state, drain flag, and pick share
+        stats = fleet.get("stats", {}) if isinstance(
+            fleet.get("stats"), dict) else {}
+        out.append(
+            f"ha fleet  endpoints={len(fleet['endpoints'])}  "
+            f"failovers={stats.get('failovers', 0)}  "
+            f"all_down={stats.get('all_down', 0)}  "
+            f"readmits={stats.get('probe_readmissions', 0)}  "
+            f"gap_p99_ms={fleet.get('failover_gap_p99_ms') or '-'}"
+        )
+        for ep in fleet["endpoints"]:
+            if not isinstance(ep, dict):
+                continue
+            out.append(
+                f"  {ep.get('address', '-')}  {ep.get('state', '-')}"
+                f"{'  DRAINING' if ep.get('draining') else ''}  "
+                f"picks={ep.get('picks', 0)}  "
+                f"strikes={ep.get('strikes', 0)}  "
+                f"ewma_ms={ep.get('ewma_ms') if ep.get('ewma_ms') is not None else '-'}"
             )
     fill = snap.get("lane_fill", {})
     if fill.get("padded_lanes"):
@@ -524,9 +550,12 @@ def render(snap: Dict[str, Any]) -> str:
 
 # -- fleet mode --------------------------------------------------------------
 
-# the client-side stats() keys that mean "this request fell back to the
-# local CPU path" — the rows correlated against server-side refusals
-_FALLBACK_KEYS = ("disconnected", "timeout", "rejected", "stale", "error")
+# the client-side stats() keys that mean "this request left the happy
+# remote path" — the rows correlated against server-side refusals.
+# draining (an intentional drain, NOT a crash) and failover (absorbed by
+# a healthy secondary instead of the local CPU) are metered distinctly.
+_FALLBACK_KEYS = ("disconnected", "timeout", "rejected", "stale", "error",
+                  "draining", "failed_over")
 
 
 def _svc_source(snap: Dict[str, Any]) -> Dict[str, Any]:
@@ -572,6 +601,10 @@ def merge_fleet(snaps: List[Any]) -> Dict[str, Any]:
             "state": (snap.get("sources", {}).get("supervisor", {})
                       or {}).get("state", "-")
             if isinstance(snap.get("sources"), dict) else "-",
+            # a draining server (or a client that saw its server drain)
+            # must read as an intentional restart, not a crash
+            "drain": "draining" if svc.get("draining")
+            or svc.get("server_draining") else "-",
         })
         events = snap.get("timeline")
         if isinstance(events, list):
@@ -669,7 +702,7 @@ def render_fleet(fleet: Dict[str, Any]) -> str:
     out.append("endpoints:")
     out.append(_fmt_table(
         [dict(e) for e in endpoints if isinstance(e, dict)],
-        ["endpoint", "role", "state"],
+        ["endpoint", "role", "state", "drain"],
     ))
 
     out.append("")
@@ -690,6 +723,8 @@ def render_fleet(fleet: Dict[str, Any]) -> str:
             "fb_rej": fb.get("rejected", 0),
             "fb_stale": fb.get("stale", 0),
             "fb_err": fb.get("error", 0),
+            "fb_drn": fb.get("draining", 0),
+            "fb_fo": fb.get("failed_over", 0),
             "srv_req": row.get("server_requests", 0),
             "srv_rej": row.get("server_rejected", 0),
             "srv_refuse": sum(refusals.values()) if refusals else 0,
@@ -699,8 +734,8 @@ def render_fleet(fleet: Dict[str, Any]) -> str:
     out.append(_fmt_table(
         corr_rows,
         ["tenant", "client", "conn", "ok", "fb_disc", "fb_tmo", "fb_rej",
-         "fb_stale", "fb_err", "srv_req", "srv_rej", "srv_refuse",
-         "srv_disc", "mean_ms"],
+         "fb_stale", "fb_err", "fb_drn", "fb_fo", "srv_req", "srv_rej",
+         "srv_refuse", "srv_disc", "mean_ms"],
     ))
     refusal_kinds: Dict[str, int] = {}
     for row in fleet.get("correlation", {}).values():

@@ -58,6 +58,8 @@ class Daemon:
         qos: str = "default",
         tenant_rate: Optional[int] = None,
         coalesce: bool = True,
+        auth_key: Optional[bytes] = None,
+        drain_timeout_ms: int = 10_000,
         metrics_addr: Optional[str] = None,
         trace_sample: Optional[float] = None,
         dump_dir: Optional[str] = None,
@@ -97,8 +99,10 @@ class Daemon:
             metrics=servicelib.ServiceMetrics(self.registry),
             telemetry=self.hub,
             advertise_trace=advertise_trace,
+            auth_key=auth_key,
             logger=self.logger.with_(module="verifyd"),
         )
+        self.drain_timeout_ms = int(drain_timeout_ms)
         # every incident dump carries the service view: which tenants
         # were riding the failing flush, and the event ring around it
         self.tracer.set_dump_context(lambda: {
@@ -109,6 +113,7 @@ class Daemon:
         self._metrics_addr = metrics_addr
         self._metrics_server: Optional[MetricsServer] = MetricsServer(
             self.registry, tracer=self.tracer, telemetry=self.hub,
+            extra_routes={"/drain": self._drain_route},
         ) if metrics_addr is not None else None
         self.metrics_port: Optional[int] = None
         self.last_dump: Optional[str] = None
@@ -123,6 +128,44 @@ class Daemon:
                 "verifyd incident: flight recorder dumped",
                 kind=ev["kind"], path=path,
             )
+
+    def _drain_route(self, _q):
+        """``/drain`` ops route: flip the service into draining (idempotent
+        — new REQs get typed ST_DRAINING, in-flight work still answers)
+        and report what is left in flight. Process exit stays with the
+        supervisor's SIGTERM; this route only initiates the drain so a
+        rolling restart can stop the bleeding before the kill."""
+        import json
+
+        already = self.service.draining
+        self.service.drain()
+        return (200, "application/json", json.dumps({
+            "draining": True,
+            "already_draining": already,
+            "pending_requests": self.service.pending_requests(),
+        }).encode())
+
+    def drain(self, timeout_ms: Optional[int] = None) -> int:
+        """Graceful drain bounded by --drain-timeout-ms: stop accepting
+        new frames, wait for in-flight work to answer, and return the
+        count of frames abandoned at the deadline (0 = clean drain).
+        SIGTERM can never hang a supervised daemon forever."""
+        import time
+
+        bound_ms = self.drain_timeout_ms if timeout_ms is None else timeout_ms
+        self.service.drain()
+        deadline = time.monotonic() + max(0, bound_ms) / 1e3
+        while self.service.pending_requests() > 0:
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.01)
+        abandoned = self.service.pending_requests()
+        if abandoned:
+            self.logger.error(
+                "drain timeout: abandoning in-flight frames",
+                abandoned=abandoned, bound_ms=bound_ms,
+            )
+        return abandoned
 
     def start(self) -> None:
         self.scheduler.start()
@@ -188,6 +231,18 @@ def main(argv: Optional[List[str]] = None) -> int:
              "— proves what cross-client coalescing buys)",
     )
     ap.add_argument(
+        "--auth-key", default=None, metavar="PATH",
+        help="per-node key file for HMAC session auth: clients must "
+             "answer the HELLO challenge with this key or are refused "
+             "typed ERR_UNAUTHORIZED (default: open, v1 interop)",
+    )
+    ap.add_argument(
+        "--drain-timeout-ms", type=int, default=10_000,
+        help="bound on the SIGTERM graceful-drain phase; at the "
+             "deadline the daemon hard-exits and logs the count of "
+             "abandoned in-flight frames (default: 10000)",
+    )
+    ap.add_argument(
         "--stats", type=float, default=0.0, metavar="SECONDS",
         help="print a JSON service snapshot every N seconds",
     )
@@ -215,6 +270,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"error: {exc}", file=sys.stderr)
         return 2
 
+    auth_key = None
+    if args.auth_key is not None:
+        try:
+            auth_key = servicelib.load_auth_key(args.auth_key)
+        except (OSError, ValueError) as exc:
+            print(f"error: cannot load --auth-key: {exc}", file=sys.stderr)
+            return 2
+
     daemon = Daemon(
         args.address,
         backend=args.backend,
@@ -223,6 +286,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         qos=args.qos,
         tenant_rate=args.tenant_rate,
         coalesce=not args.no_coalesce,
+        auth_key=auth_key,
+        drain_timeout_ms=args.drain_timeout_ms,
         metrics_addr=args.metrics_addr,
         trace_sample=args.trace_sample,
         dump_dir=args.dump_dir,
@@ -238,19 +303,28 @@ def main(argv: Optional[List[str]] = None) -> int:
         f"verifyd listening on {daemon.service.address()}  "
         f"backend={daemon.scheduler.spec.name}  "
         f"coalesce={'on' if not args.no_coalesce else 'OFF'}  "
-        f"qos={args.qos}"
+        f"qos={args.qos}  "
+        f"auth={'on' if auth_key else 'off'}"
     )
     if daemon.metrics_port is not None:
         line += f"  metrics=http://127.0.0.1:{daemon.metrics_port}/metrics"
     print(line, flush=True)
 
     done = threading.Event()
+    # SIGTERM drains first (rolling-restart contract: answer in-flight
+    # work, refuse new frames typed so clients fail over, exit bounded
+    # by --drain-timeout-ms); SIGINT stays the immediate stop.
+    graceful = {"drain": False}
 
     def _stop(signum, frame):  # noqa: ARG001 - signal signature
         done.set()
 
+    def _term(signum, frame):  # noqa: ARG001 - signal signature
+        graceful["drain"] = True
+        done.set()
+
     signal.signal(signal.SIGINT, _stop)
-    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGTERM, _term)
 
     # The stats printer gets its own thread so the idle path (no
     # --stats) blocks straight on the shutdown event instead of waking
@@ -277,6 +351,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         done.set()
         if stats_thread is not None:
             stats_thread.join(timeout=_STATS_JOIN_S)
+        if graceful["drain"]:
+            abandoned = daemon.drain()
+            print(
+                f"verifyd drained  abandoned={abandoned}", flush=True
+            )
         daemon.stop()
         print("verifyd stopped", flush=True)
     return 0
